@@ -1,0 +1,79 @@
+"""Data type zoo.
+
+TPU-native analog of ND4J's ``org.nd4j.linalg.api.buffer.DataType``
+(reference: nd4j/nd4j-backends/nd4j-api-parent/nd4j-api/src/main/java/org/nd4j/
+linalg/api/buffer/DataType.java). Each DL4J dtype maps onto a numpy/jax dtype;
+UTF8 is represented host-side only (strings never reach the MXU).
+"""
+
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+
+class DataType(enum.Enum):
+    """Mirrors the reference dtype set; values are the canonical names."""
+
+    FLOAT = "float32"
+    DOUBLE = "float64"
+    HALF = "float16"
+    BFLOAT16 = "bfloat16"
+    INT8 = "int8"
+    INT16 = "int16"
+    INT32 = "int32"
+    INT64 = "int64"
+    UINT8 = "uint8"
+    UINT16 = "uint16"
+    UINT32 = "uint32"
+    UINT64 = "uint64"
+    BOOL = "bool"
+    UTF8 = "utf8"  # host-side only
+
+    # ------------------------------------------------------------------
+    def to_np(self) -> np.dtype:
+        if self is DataType.UTF8:
+            raise TypeError("UTF8 arrays are host-side objects, not device dtypes")
+        if self is DataType.BFLOAT16:
+            import ml_dtypes
+
+            return np.dtype(ml_dtypes.bfloat16)
+        return np.dtype(self.value)
+
+    @property
+    def is_fp(self) -> bool:
+        return self in (DataType.FLOAT, DataType.DOUBLE, DataType.HALF, DataType.BFLOAT16)
+
+    @property
+    def is_int(self) -> bool:
+        return self.value.startswith(("int", "uint"))
+
+    @property
+    def width(self) -> int:
+        """Byte width of one element."""
+        if self is DataType.BOOL:
+            return 1
+        if self is DataType.UTF8:
+            raise TypeError("UTF8 has no fixed width")
+        return self.to_np().itemsize
+
+    @staticmethod
+    def from_np(dtype) -> "DataType":
+        name = np.dtype(dtype).name
+        if name == "bfloat16":
+            return DataType.BFLOAT16
+        for dt in DataType:
+            if dt.value == name:
+                return dt
+        raise TypeError(f"no DataType for numpy dtype {name!r}")
+
+
+# Convenience aliases matching Nd4j default naming.
+FLOAT = DataType.FLOAT
+DOUBLE = DataType.DOUBLE
+HALF = DataType.HALF
+BFLOAT16 = DataType.BFLOAT16
+INT = DataType.INT32
+LONG = DataType.INT64
+BOOL = DataType.BOOL

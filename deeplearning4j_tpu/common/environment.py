@@ -1,0 +1,111 @@
+"""Process-wide environment singleton.
+
+TPU-native analog of libnd4j's ``sd::Environment`` + ND4J's
+``Nd4j.getEnvironment()`` (reference: libnd4j/include/system/Environment.h,
+nd4j-api org/nd4j/linalg/factory/Environment.java). Fronts jax.config knobs,
+XLA flags, and framework toggles behind one object so user code has a single
+place to flip debug/verbose/determinism, matching the reference's pattern of
+env-var + runtime-settable flags.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+
+class Environment:
+    _instance: Optional["Environment"] = None
+    _lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._debug = _env_bool("DL4J_TPU_DEBUG", False)
+        self._verbose = _env_bool("DL4J_TPU_VERBOSE", False)
+        self._profiling = False
+        self._check_nan = False          # NAN_PANIC analog (jax_debug_nans)
+        self._deterministic = _env_bool("DL4J_TPU_DETERMINISTIC", False)
+        self._default_dtype = os.environ.get("DL4J_TPU_DTYPE", "float32")
+        self._allow_pallas = _env_bool("DL4J_TPU_ALLOW_PALLAS", True)
+        self._properties: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def get(cls) -> "Environment":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    # --- flags ---------------------------------------------------------
+    def is_debug(self) -> bool:
+        return self._debug
+
+    def set_debug(self, v: bool) -> None:
+        self._debug = bool(v)
+
+    def is_verbose(self) -> bool:
+        return self._verbose
+
+    def set_verbose(self, v: bool) -> None:
+        self._verbose = bool(v)
+
+    def is_profiling(self) -> bool:
+        return self._profiling
+
+    def set_profiling(self, v: bool) -> None:
+        self._profiling = bool(v)
+
+    def is_check_nan(self) -> bool:
+        return self._check_nan
+
+    def set_check_nan(self, v: bool) -> None:
+        """NAN_PANIC analog: makes jax raise on any NaN produced under jit."""
+        import jax
+
+        self._check_nan = bool(v)
+        jax.config.update("jax_debug_nans", bool(v))
+
+    def is_deterministic(self) -> bool:
+        return self._deterministic
+
+    def set_deterministic(self, v: bool) -> None:
+        self._deterministic = bool(v)
+
+    def allow_pallas(self) -> bool:
+        return self._allow_pallas
+
+    def set_allow_pallas(self, v: bool) -> None:
+        self._allow_pallas = bool(v)
+
+    def default_dtype(self) -> str:
+        return self._default_dtype
+
+    def set_default_dtype(self, name: str) -> None:
+        self._default_dtype = name
+
+    # --- device info -----------------------------------------------------
+    def devices(self) -> List[Any]:
+        import jax
+
+        return jax.devices()
+
+    def num_devices(self) -> int:
+        return len(self.devices())
+
+    def is_tpu(self) -> bool:
+        return any(d.platform in ("tpu", "axon") for d in self.devices())
+
+    # --- generic key/value (ND4JSystemProperties analog) -----------------
+    def set_property(self, key: str, value: Any) -> None:
+        self._properties[key] = value
+
+    def get_property(self, key: str, default: Any = None) -> Any:
+        return self._properties.get(key, default)
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")

@@ -1,0 +1,141 @@
+"""Array factory — the ``Nd4j`` static-factory analog.
+
+Reference: nd4j-api ``org.nd4j.linalg.factory.Nd4j`` (create/zeros/ones/rand/
+randn/arange/linspace/valueArrayOf/eye/concat/stack/...). Backed directly by
+jnp; every produced buffer lives on the default jax device (HBM on TPU).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.dtypes import DataType
+from ..common.environment import Environment
+from .ndarray import NDArray, _as_jax, _normalize_shape
+from .rng import get_random
+
+
+def _np_dtype(dtype) -> Any:
+    if dtype is None:
+        return np.dtype(Environment.get().default_dtype())
+    if isinstance(dtype, DataType):
+        return dtype.to_np()
+    return np.dtype(dtype) if not hasattr(dtype, "dtype") else dtype
+
+
+def create(data=None, shape: Optional[Sequence[int]] = None, dtype=None) -> NDArray:
+    """Nd4j.create analog: from data, or zero-filled by shape."""
+    dt = _np_dtype(dtype)
+    if data is None:
+        if shape is None:
+            raise ValueError("create() needs data or shape")
+        return NDArray(jnp.zeros(tuple(shape), dtype=dt))
+    arr = jnp.asarray(np.asarray(data, dtype=dt))
+    if shape is not None:
+        arr = arr.reshape(tuple(shape))
+    return NDArray(arr)
+
+
+def zeros(*shape, dtype=None) -> NDArray:
+    return NDArray(jnp.zeros(_normalize_shape(shape), dtype=_np_dtype(dtype)))
+
+
+def ones(*shape, dtype=None) -> NDArray:
+    return NDArray(jnp.ones(_normalize_shape(shape), dtype=_np_dtype(dtype)))
+
+
+def zeros_like(arr) -> NDArray:
+    return NDArray(jnp.zeros_like(_as_jax(arr)))
+
+
+def ones_like(arr) -> NDArray:
+    return NDArray(jnp.ones_like(_as_jax(arr)))
+
+
+def value_array_of(shape: Sequence[int], value, dtype=None) -> NDArray:
+    return NDArray(jnp.full(tuple(shape), value, dtype=_np_dtype(dtype)))
+
+
+def scalar(value, dtype=None) -> NDArray:
+    return NDArray(jnp.asarray(value, dtype=_np_dtype(dtype)))
+
+
+def eye(n: int, dtype=None) -> NDArray:
+    return NDArray(jnp.eye(n, dtype=_np_dtype(dtype)))
+
+
+def arange(*args, dtype=None) -> NDArray:
+    return NDArray(jnp.arange(*args, dtype=_np_dtype(dtype)))
+
+
+def linspace(start, stop, num: int, dtype=None) -> NDArray:
+    return NDArray(jnp.linspace(start, stop, num, dtype=_np_dtype(dtype)))
+
+
+def rand(*shape, dtype=None) -> NDArray:
+    return get_random().uniform(_normalize_shape(shape), dtype=_np_dtype(dtype))
+
+
+def randn(*shape, dtype=None) -> NDArray:
+    return get_random().gaussian(_normalize_shape(shape), dtype=_np_dtype(dtype))
+
+
+def concat(dim: int, *arrays) -> NDArray:
+    return NDArray(jnp.concatenate([_as_jax(a) for a in arrays], axis=dim))
+
+
+def stack(dim: int, *arrays) -> NDArray:
+    return NDArray(jnp.stack([_as_jax(a) for a in arrays], axis=dim))
+
+
+def hstack(*arrays) -> NDArray:
+    return concat(-1, *arrays)
+
+
+def vstack(*arrays) -> NDArray:
+    return concat(0, *arrays)
+
+
+def tile(arr, *reps) -> NDArray:
+    return NDArray(jnp.tile(_as_jax(arr), _normalize_shape(reps)))
+
+
+def where(cond, x, y) -> NDArray:
+    return NDArray(jnp.where(_as_jax(cond), _as_jax(x), _as_jax(y)))
+
+
+def sort(arr, dim: int = -1, descending: bool = False) -> NDArray:
+    s = jnp.sort(_as_jax(arr), axis=dim)
+    if descending:
+        s = jnp.flip(s, axis=dim)
+    return NDArray(s)
+
+
+def gemm(a, b, transpose_a: bool = False, transpose_b: bool = False,
+         alpha: float = 1.0, beta: float = 0.0, c=None) -> NDArray:
+    """BLAS gemm analog (reference MmulHelper) — rides the MXU via dot."""
+    av, bv = _as_jax(a), _as_jax(b)
+    if transpose_a:
+        av = av.T
+    if transpose_b:
+        bv = bv.T
+    out = alpha * (av @ bv)
+    if c is not None and beta != 0.0:
+        out = out + beta * _as_jax(c)
+    return NDArray(out)
+
+
+def matmul(a, b) -> NDArray:
+    return NDArray(_as_jax(a) @ _as_jax(b))
+
+
+def write(arr: NDArray, path: str) -> None:
+    """Nd4j.write analog — raw npy container."""
+    np.save(path, arr.to_numpy())
+
+
+def read(path: str) -> NDArray:
+    return NDArray(jnp.asarray(np.load(path)))

@@ -1,0 +1,377 @@
+"""NDArray — the INDArray analog, a mutable shell over immutable XLA buffers.
+
+Reference: nd4j-api ``org.nd4j.linalg.api.ndarray.{INDArray, BaseNDArray}``.
+
+Design (SURVEY.md §7.1.3 "functional core, stateful shell"): the engine is pure
+jax — device buffers are immutable and every op is traceable — while this class
+provides the reference's mutation culture (``addi``/``muli``/``assign``/view
+writes) by swapping the underlying buffer. A *view* holds a reference to its
+parent plus an index spec; writes to a view recurse up the chain as functional
+scatter-updates (``x.at[idx].set``) so ``slice.addi(...)`` alias-updates the
+base, matching BaseNDArray view semantics without host round-trips.
+
+Divergence from the reference (documented, deliberate): ``reshape``/``permute``
+return fresh arrays rather than stride-tricked views — XLA has no user-visible
+strides, and write-through reshaped views are not supported. All other view
+writes (slicing, ``get``, ``slice()``, ``tensor_along_dimension``) alias.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.dtypes import DataType
+
+IndexSpec = Union[int, slice, Tuple[Any, ...]]
+
+
+def _as_jax(value) -> jax.Array:
+    if isinstance(value, NDArray):
+        return value.value
+    return jnp.asarray(value)
+
+
+def _normalize_shape(shape) -> Tuple[int, ...]:
+    """Accept both f(2, 3) and f((2, 3)) varargs-shape call styles."""
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        return tuple(shape[0])
+    return tuple(shape)
+
+
+def _clean_idx(idx):
+    """Unwrap NDArray (fancy/boolean) indices to raw jax arrays."""
+    if isinstance(idx, NDArray):
+        return idx.value
+    if isinstance(idx, tuple):
+        return tuple(i.value if isinstance(i, NDArray) else i for i in idx)
+    return idx
+
+
+class NDArray:
+    """Mutable tensor handle. ``.value`` is the current immutable jax buffer."""
+
+    __slots__ = ("_value", "_base", "_idx")
+
+    def __init__(self, value, base: Optional["NDArray"] = None, idx: Optional[IndexSpec] = None):
+        self._base = base
+        self._idx = idx
+        self._value = None if base is not None else jnp.asarray(value)
+
+    # --- buffer access -------------------------------------------------
+    @property
+    def value(self) -> jax.Array:
+        if self._base is not None:
+            return self._base.value[self._idx]
+        return self._value
+
+    def _set_value(self, new: jax.Array) -> None:
+        if self._base is not None:
+            self._base._write(self._idx, new)
+        else:
+            self._value = new
+
+    def _write(self, idx: IndexSpec, new: jax.Array) -> None:
+        if self._base is not None:
+            cur = self.value
+            self._base._write(self._idx, cur.at[idx].set(new))
+        else:
+            self._value = self._value.at[idx].set(new)
+
+    @property
+    def is_view(self) -> bool:
+        return self._base is not None
+
+    # --- metadata ------------------------------------------------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self.value.shape)
+
+    @property
+    def rank(self) -> int:
+        return self.value.ndim
+
+    @property
+    def ndim(self) -> int:
+        return self.value.ndim
+
+    def length(self) -> int:
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    def size(self, dim: int) -> int:
+        return self.shape[dim]
+
+    def data_type(self) -> DataType:
+        return DataType.from_np(self.value.dtype)
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    def is_scalar(self) -> bool:
+        return self.value.ndim == 0 or self.length() == 1
+
+    def is_vector(self) -> bool:
+        return self.rank == 1 or (self.rank == 2 and 1 in self.shape)
+
+    def is_matrix(self) -> bool:
+        return self.rank == 2
+
+    def rows(self) -> int:
+        return self.shape[0]
+
+    def columns(self) -> int:
+        return self.shape[1]
+
+    # --- conversion ----------------------------------------------------
+    def to_numpy(self) -> np.ndarray:
+        return np.asarray(self.value)
+
+    def dup(self) -> "NDArray":
+        return NDArray(self.value)
+
+    def cast(self, dtype: Union[DataType, Any]) -> "NDArray":
+        np_dt = dtype.to_np() if isinstance(dtype, DataType) else np.dtype(dtype)
+        return NDArray(self.value.astype(np_dt))
+
+    def astype(self, dtype) -> "NDArray":
+        return self.cast(dtype)
+
+    # --- scalar access -------------------------------------------------
+    def get_double(self, *indices: int) -> float:
+        return float(self.value[tuple(indices)] if indices else self.value)
+
+    def get_int(self, *indices: int) -> int:
+        return int(self.value[tuple(indices)] if indices else self.value)
+
+    def get_scalar(self, *indices: int) -> "NDArray":
+        return NDArray(self.value[tuple(indices)])
+
+    def put_scalar(self, indices, value) -> "NDArray":
+        if isinstance(indices, int):
+            indices = (indices,)
+        self._write(tuple(indices), jnp.asarray(value, dtype=self.dtype))
+        return self
+
+    # --- views ---------------------------------------------------------
+    def __getitem__(self, idx) -> "NDArray":
+        return NDArray(None, base=self, idx=_clean_idx(idx))
+
+    def __setitem__(self, idx, value) -> None:
+        self._write(_clean_idx(idx), jnp.asarray(_as_jax(value), dtype=self.dtype))
+
+    def get(self, idx) -> "NDArray":
+        """View via index (INDArray.get(INDArrayIndex...) analog)."""
+        return self[idx]
+
+    def slice_view(self, i: int, dim: int = 0) -> "NDArray":
+        idx = tuple([slice(None)] * dim + [i])
+        return self[idx]
+
+    def tensor_along_dimension(self, index: int, *dims: int) -> "NDArray":
+        """TAD analog: the index-th subtensor spanning `dims`."""
+        dims = tuple(d % self.rank for d in dims)
+        other = [d for d in range(self.rank) if d not in dims]
+        counts = [self.shape[d] for d in other]
+        sub = np.unravel_index(index, counts) if counts else ()
+        idx: list = [slice(None)] * self.rank
+        for d, i in zip(other, sub):
+            idx[d] = int(i)
+        return self[tuple(idx)]
+
+    def assign(self, other) -> "NDArray":
+        new = jnp.broadcast_to(jnp.asarray(_as_jax(other), dtype=self.dtype), self.shape)
+        self._set_value(new)
+        return self
+
+    # --- shape ops (fresh arrays; see module docstring) ----------------
+    def reshape(self, *shape) -> "NDArray":
+        return NDArray(self.value.reshape(_normalize_shape(shape)))
+
+    def ravel(self) -> "NDArray":
+        return NDArray(self.value.ravel())
+
+    def permute(self, *dims) -> "NDArray":
+        return NDArray(jnp.transpose(self.value, _normalize_shape(dims)))
+
+    def transpose(self) -> "NDArray":
+        return NDArray(self.value.T)
+
+    @property
+    def T(self) -> "NDArray":
+        return self.transpose()
+
+    def broadcast(self, *shape) -> "NDArray":
+        return NDArray(jnp.broadcast_to(self.value, _normalize_shape(shape)))
+
+    def repeat(self, repeats: int, axis: int) -> "NDArray":
+        return NDArray(jnp.repeat(self.value, repeats, axis=axis))
+
+    # --- arithmetic: pure ----------------------------------------------
+    def add(self, other) -> "NDArray":
+        return NDArray(self.value + _as_jax(other))
+
+    def sub(self, other) -> "NDArray":
+        return NDArray(self.value - _as_jax(other))
+
+    def mul(self, other) -> "NDArray":
+        return NDArray(self.value * _as_jax(other))
+
+    def div(self, other) -> "NDArray":
+        return NDArray(self.value / _as_jax(other))
+
+    def rsub(self, other) -> "NDArray":
+        return NDArray(_as_jax(other) - self.value)
+
+    def rdiv(self, other) -> "NDArray":
+        return NDArray(_as_jax(other) / self.value)
+
+    def neg(self) -> "NDArray":
+        return NDArray(-self.value)
+
+    def mmul(self, other) -> "NDArray":
+        return NDArray(self.value @ _as_jax(other))
+
+    # --- arithmetic: in-place (the DL4J `i` suffix family) -------------
+    def addi(self, other) -> "NDArray":
+        self._set_value(jnp.asarray(self.value + _as_jax(other), dtype=self.dtype))
+        return self
+
+    def subi(self, other) -> "NDArray":
+        self._set_value(jnp.asarray(self.value - _as_jax(other), dtype=self.dtype))
+        return self
+
+    def muli(self, other) -> "NDArray":
+        self._set_value(jnp.asarray(self.value * _as_jax(other), dtype=self.dtype))
+        return self
+
+    def divi(self, other) -> "NDArray":
+        self._set_value(jnp.asarray(self.value / _as_jax(other), dtype=self.dtype))
+        return self
+
+    def rsubi(self, other) -> "NDArray":
+        self._set_value(jnp.asarray(_as_jax(other) - self.value, dtype=self.dtype))
+        return self
+
+    def rdivi(self, other) -> "NDArray":
+        self._set_value(jnp.asarray(_as_jax(other) / self.value, dtype=self.dtype))
+        return self
+
+    def negi(self) -> "NDArray":
+        self._set_value(-self.value)
+        return self
+
+    # --- python operators ----------------------------------------------
+    __add__ = add
+    __sub__ = sub
+    __mul__ = mul
+    __truediv__ = div
+    __matmul__ = mmul
+    __neg__ = neg
+
+    def __radd__(self, other):
+        return NDArray(_as_jax(other) + self.value)
+
+    def __rsub__(self, other):
+        return self.rsub(other)
+
+    def __rmul__(self, other):
+        return NDArray(_as_jax(other) * self.value)
+
+    def __rtruediv__(self, other):
+        return self.rdiv(other)
+
+    def __pow__(self, p):
+        return NDArray(self.value ** p)
+
+    def __lt__(self, other):
+        return NDArray(self.value < _as_jax(other))
+
+    def __le__(self, other):
+        return NDArray(self.value <= _as_jax(other))
+
+    def __gt__(self, other):
+        return NDArray(self.value > _as_jax(other))
+
+    def __ge__(self, other):
+        return NDArray(self.value >= _as_jax(other))
+
+    def eq(self, other):
+        return NDArray(self.value == _as_jax(other))
+
+    def neq(self, other):
+        return NDArray(self.value != _as_jax(other))
+
+    # Elementwise like numpy — NDArray is consequently unhashable.
+    __eq__ = eq
+    __ne__ = neq
+    __hash__ = None
+
+    # --- reductions ----------------------------------------------------
+    def sum(self, *dims, keepdims: bool = False) -> "NDArray":
+        return NDArray(jnp.sum(self.value, axis=dims or None, keepdims=keepdims))
+
+    def mean(self, *dims, keepdims: bool = False) -> "NDArray":
+        return NDArray(jnp.mean(self.value, axis=dims or None, keepdims=keepdims))
+
+    def std(self, *dims, keepdims: bool = False, bias_corrected: bool = True) -> "NDArray":
+        ddof = 1 if bias_corrected else 0
+        return NDArray(jnp.std(self.value, axis=dims or None, keepdims=keepdims, ddof=ddof))
+
+    def var(self, *dims, keepdims: bool = False, bias_corrected: bool = True) -> "NDArray":
+        ddof = 1 if bias_corrected else 0
+        return NDArray(jnp.var(self.value, axis=dims or None, keepdims=keepdims, ddof=ddof))
+
+    def max(self, *dims, keepdims: bool = False) -> "NDArray":
+        return NDArray(jnp.max(self.value, axis=dims or None, keepdims=keepdims))
+
+    def min(self, *dims, keepdims: bool = False) -> "NDArray":
+        return NDArray(jnp.min(self.value, axis=dims or None, keepdims=keepdims))
+
+    def prod(self, *dims, keepdims: bool = False) -> "NDArray":
+        return NDArray(jnp.prod(self.value, axis=dims or None, keepdims=keepdims))
+
+    def argmax(self, *dims) -> "NDArray":
+        return NDArray(jnp.argmax(self.value, axis=dims[0] if dims else None))
+
+    def argmin(self, *dims) -> "NDArray":
+        return NDArray(jnp.argmin(self.value, axis=dims[0] if dims else None))
+
+    def cumsum(self, dim: int = 0) -> "NDArray":
+        return NDArray(jnp.cumsum(self.value, axis=dim))
+
+    def norm1(self, *dims) -> "NDArray":
+        return NDArray(jnp.sum(jnp.abs(self.value), axis=dims or None))
+
+    def norm2(self, *dims) -> "NDArray":
+        return NDArray(jnp.sqrt(jnp.sum(jnp.square(self.value), axis=dims or None)))
+
+    def norm_max(self, *dims) -> "NDArray":
+        return NDArray(jnp.max(jnp.abs(self.value), axis=dims or None))
+
+    # --- comparisons ----------------------------------------------------
+    def equals_to(self, other, eps: float = 1e-5) -> bool:
+        other_v = _as_jax(other)
+        if tuple(other_v.shape) != self.shape:
+            return False
+        # f64 comparison so DOUBLE/INT64 values beyond f32 precision don't
+        # collapse to false equality (x64 is enabled at package import).
+        cmp_dt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        return bool(jnp.all(jnp.abs(self.value.astype(cmp_dt) - other_v.astype(cmp_dt)) <= eps))
+
+    def __repr__(self) -> str:
+        return f"NDArray(shape={self.shape}, dtype={self.value.dtype}, view={self.is_view})\n{np.asarray(self.value)}"
+
+    def __len__(self) -> int:
+        return self.shape[0]
+
+    # jax interop: NDArray can be passed straight into jnp functions.
+    def __jax_array__(self) -> jax.Array:
+        return self.value
+
+    def __array__(self, dtype=None) -> np.ndarray:
+        a = np.asarray(self.value)
+        return a.astype(dtype) if dtype is not None else a

@@ -1,0 +1,95 @@
+"""RNG — stateful shell over jax threefry keys.
+
+Reference: nd4j-api ``org.nd4j.linalg.api.rng.Random`` + libnd4j Philox streams
+(libnd4j/include/graph/RandomGenerator.h, helpers/RandomLauncher.h).
+
+Parity note (SURVEY.md §7.3.5): stream parity with the reference is
+*statistical*, not bitwise — the reference uses Philox/mt19937, jax uses
+threefry. Each draw splits the internal key so repeated calls produce
+independent streams, and ``set_seed`` makes a run reproducible.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .ndarray import NDArray
+
+
+class Random:
+    """Stateful random stream. Thread-safe via a lock; one instance per thread
+    is handed out by :func:`get_random` (the Nd4j.getRandomFactory() pattern)."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self._key = jax.random.PRNGKey(seed)
+        self._seed = seed
+
+    def set_seed(self, seed: int) -> None:
+        with self._lock:
+            self._key = jax.random.PRNGKey(seed)
+            self._seed = seed
+
+    def get_seed(self) -> int:
+        return self._seed
+
+    def next_key(self) -> jax.Array:
+        """Split off a fresh subkey (the primitive everything else uses)."""
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    # --- distribution draws -------------------------------------------
+    def uniform(self, shape: Sequence[int], low: float = 0.0, high: float = 1.0,
+                dtype=jnp.float32) -> NDArray:
+        return NDArray(jax.random.uniform(self.next_key(), tuple(shape), dtype=dtype,
+                                          minval=low, maxval=high))
+
+    def gaussian(self, shape: Sequence[int], mean: float = 0.0, std: float = 1.0,
+                 dtype=jnp.float32) -> NDArray:
+        return NDArray(jax.random.normal(self.next_key(), tuple(shape), dtype=dtype) * std + mean)
+
+    def bernoulli(self, shape: Sequence[int], p: float = 0.5) -> NDArray:
+        return NDArray(jax.random.bernoulli(self.next_key(), p, tuple(shape)))
+
+    def binomial(self, shape: Sequence[int], n: int, p: float) -> NDArray:
+        draws = jax.random.bernoulli(self.next_key(), p, (n,) + tuple(shape))
+        return NDArray(jnp.sum(draws.astype(jnp.int32), axis=0))
+
+    def randint(self, shape: Sequence[int], low: int, high: int) -> NDArray:
+        return NDArray(jax.random.randint(self.next_key(), tuple(shape), low, high))
+
+    def permutation(self, n: int) -> NDArray:
+        return NDArray(jax.random.permutation(self.next_key(), n))
+
+    def next_gaussian(self) -> float:
+        return float(jax.random.normal(self.next_key(), ()))
+
+    def next_double(self) -> float:
+        return float(jax.random.uniform(self.next_key(), ()))
+
+    def next_int(self, bound: int) -> int:
+        return int(jax.random.randint(self.next_key(), (), 0, bound))
+
+
+_thread_local = threading.local()
+_default_seed = 119  # Nd4j's default seed
+
+
+def get_random() -> Random:
+    """Per-thread Random instance (Nd4j.getRandom() analog)."""
+    r = getattr(_thread_local, "random", None)
+    if r is None:
+        r = Random(_default_seed)
+        _thread_local.random = r
+    return r
+
+
+def set_default_seed(seed: int) -> None:
+    global _default_seed
+    _default_seed = seed
+    get_random().set_seed(seed)

@@ -1,0 +1,35 @@
+"""Test harness configuration.
+
+Forces the jax CPU backend with 8 virtual devices so multi-chip SPMD logic is
+exercised without TPU hardware — the analog of the reference's
+backend-parameterized test strategy (SURVEY.md §4.2/§4.5: one suite, N
+backends; in-process fakes for distribution). Must run before jax initializes.
+"""
+
+import os
+
+import jax
+
+# The shell pre-sets JAX_PLATFORMS=axon (the tunneled TPU) and the axon plugin
+# overrides the env var, so the jax.config API is the reliable override. Tests
+# run on an 8-device virtual CPU mesh unless opted onto hardware with
+# DL4J_TPU_TEST_ON_TPU=1.
+if not os.environ.get("DL4J_TPU_TEST_ON_TPU"):
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+
+# fp64 available for gradient checks (reference GradientCheckUtil enforces fp64).
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fixed_seed():
+    """Deterministic streams per test (reference tests fix Nd4j seeds)."""
+    from deeplearning4j_tpu.ndarray.rng import get_random
+
+    get_random().set_seed(12345)
+    np.random.seed(12345)
+    yield

@@ -1,0 +1,220 @@
+"""NDArray semantics tests — ports the reference's NDArrayTest* concerns
+(views/strides/cast/in-place ops) to the TPU build (SURVEY.md §4.1/§7.3.2)."""
+
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu as d4t
+from deeplearning4j_tpu import factory as nd
+
+
+class TestBasics:
+    def test_create_shape_dtype(self):
+        a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+        assert a.shape == (2, 2)
+        assert a.data_type() == d4t.DataType.FLOAT
+
+    def test_zeros_ones(self):
+        assert nd.zeros(3, 4).to_numpy().sum() == 0
+        assert nd.ones(3, 4).to_numpy().sum() == 12
+
+    def test_dtype_zoo(self):
+        for dt in (d4t.DataType.FLOAT, d4t.DataType.DOUBLE, d4t.DataType.BFLOAT16,
+                   d4t.DataType.INT32, d4t.DataType.INT64, d4t.DataType.UINT8,
+                   d4t.DataType.BOOL):
+            a = nd.zeros(2, 2, dtype=dt)
+            assert a.data_type() == dt, dt
+
+    def test_cast(self):
+        a = nd.create([1.5, 2.5])
+        b = a.cast(d4t.DataType.INT32)
+        assert b.data_type() == d4t.DataType.INT32
+        assert b.to_numpy().tolist() == [1, 2]
+
+    def test_arange_linspace_eye(self):
+        assert nd.arange(5).to_numpy().tolist() == [0, 1, 2, 3, 4]
+        assert np.allclose(nd.linspace(0, 1, 5).to_numpy(), [0, 0.25, 0.5, 0.75, 1])
+        assert np.allclose(nd.eye(3).to_numpy(), np.eye(3))
+
+
+class TestInPlace:
+    def test_addi_muli(self):
+        a = nd.create([1.0, 2.0, 3.0])
+        a.addi(1.0).muli(2.0)
+        assert a.to_numpy().tolist() == [4.0, 6.0, 8.0]
+
+    def test_assign(self):
+        a = nd.zeros(2, 3)
+        a.assign(7.0)
+        assert (a.to_numpy() == 7).all()
+
+    def test_put_scalar(self):
+        a = nd.zeros(2, 2)
+        a.put_scalar((0, 1), 5.0)
+        assert a.get_double(0, 1) == 5.0
+        assert a.to_numpy().sum() == 5.0
+
+
+class TestViews:
+    def test_view_read(self):
+        a = nd.create(np.arange(12).reshape(3, 4), dtype=d4t.DataType.FLOAT)
+        row = a[1]
+        assert row.to_numpy().tolist() == [4, 5, 6, 7]
+
+    def test_view_write_aliases_base(self):
+        """The SURVEY §7.3.2 hard case: addi on a slice must update the base."""
+        a = nd.create(np.zeros((3, 4)), dtype=d4t.DataType.FLOAT)
+        row = a[1]
+        row.addi(5.0)
+        expected = np.zeros((3, 4))
+        expected[1] = 5.0
+        assert np.allclose(a.to_numpy(), expected)
+
+    def test_view_of_view_write(self):
+        a = nd.create(np.zeros((3, 4)), dtype=d4t.DataType.FLOAT)
+        row = a[2]
+        elem = row[1:3]
+        elem.assign(9.0)
+        assert a.to_numpy()[2, 1] == 9.0 and a.to_numpy()[2, 2] == 9.0
+        assert a.to_numpy().sum() == 18.0
+
+    def test_setitem(self):
+        a = nd.zeros(3, 3)
+        a[0, :] = nd.ones(3)
+        assert a.to_numpy()[0].sum() == 3
+
+    def test_tensor_along_dimension(self):
+        a = nd.create(np.arange(24).reshape(2, 3, 4), dtype=d4t.DataType.FLOAT)
+        tad = a.tensor_along_dimension(1, 2)  # spans dim 2; index 1 of (2,3) flattened
+        assert tad.to_numpy().tolist() == [4, 5, 6, 7]
+
+    def test_dup_detaches(self):
+        a = nd.create([1.0, 2.0])
+        b = a.dup()
+        b.addi(10)
+        assert a.to_numpy().tolist() == [1.0, 2.0]
+
+
+class TestShapeOps:
+    def test_reshape_permute(self):
+        a = nd.arange(6, dtype=d4t.DataType.FLOAT).reshape(2, 3)
+        assert a.shape == (2, 3)
+        assert a.permute(1, 0).shape == (3, 2)
+        assert a.T.shape == (3, 2)
+
+    def test_broadcast(self):
+        a = nd.ones(1, 3).broadcast(4, 3)
+        assert a.shape == (4, 3)
+
+    def test_concat_stack(self):
+        a, b = nd.ones(2, 3), nd.zeros(2, 3)
+        assert nd.concat(0, a, b).shape == (4, 3)
+        assert nd.concat(1, a, b).shape == (2, 6)
+        assert nd.stack(0, a, b).shape == (2, 2, 3)
+
+
+class TestArithmetic:
+    def test_ops(self):
+        a, b = nd.create([1.0, 2.0]), nd.create([3.0, 4.0])
+        assert (a + b).to_numpy().tolist() == [4.0, 6.0]
+        assert (a - b).to_numpy().tolist() == [-2.0, -2.0]
+        assert (a * b).to_numpy().tolist() == [3.0, 8.0]
+        assert (b / a).to_numpy().tolist() == [3.0, 2.0]
+        assert a.rsub(1.0).to_numpy().tolist() == [0.0, -1.0]
+        assert a.rdiv(2.0).to_numpy().tolist() == [2.0, 1.0]
+
+    def test_mmul_rides_dot(self):
+        a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+        b = nd.eye(2)
+        assert np.allclose(a.mmul(b).to_numpy(), a.to_numpy())
+
+    def test_gemm(self):
+        a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+        out = nd.gemm(a, a, transpose_b=True, alpha=2.0)
+        assert np.allclose(out.to_numpy(), 2.0 * (a.to_numpy() @ a.to_numpy().T))
+
+
+class TestReductions:
+    def test_reductions(self):
+        a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+        assert a.sum().get_double() == 10.0
+        assert a.mean().get_double() == 2.5
+        assert a.max().get_double() == 4.0
+        assert a.min().get_double() == 1.0
+        assert a.sum(0).to_numpy().tolist() == [4.0, 6.0]
+        assert a.sum(1).to_numpy().tolist() == [3.0, 7.0]
+        assert a.argmax(1).to_numpy().tolist() == [1, 1]
+        assert abs(a.norm2().get_double() - np.sqrt(30.0)) < 1e-5
+
+    def test_std_bias_correction(self):
+        a = nd.create([1.0, 2.0, 3.0, 4.0])
+        assert abs(a.std().get_double() - np.std(a.to_numpy(), ddof=1)) < 1e-6
+        assert abs(a.std(bias_corrected=False).get_double() - np.std(a.to_numpy())) < 1e-6
+
+
+class TestRng:
+    def test_reproducible(self):
+        r = d4t.get_random()
+        r.set_seed(42)
+        a = r.uniform((100,))
+        r.set_seed(42)
+        b = r.uniform((100,))
+        assert np.allclose(a.to_numpy(), b.to_numpy())
+
+    def test_streams_differ(self):
+        r = d4t.get_random()
+        a = r.uniform((100,))
+        b = r.uniform((100,))
+        assert not np.allclose(a.to_numpy(), b.to_numpy())
+
+    def test_gaussian_moments(self):
+        r = d4t.get_random()
+        g = r.gaussian((20000,), mean=1.0, std=2.0).to_numpy()
+        assert abs(g.mean() - 1.0) < 0.1
+        assert abs(g.std() - 2.0) < 0.1
+
+    def test_bernoulli(self):
+        r = d4t.get_random()
+        b = r.bernoulli((10000,), p=0.3).to_numpy()
+        assert abs(b.mean() - 0.3) < 0.05
+
+
+class TestEnvironment:
+    def test_singleton_flags(self):
+        env = d4t.Environment.get()
+        assert env is d4t.Environment.get()
+        env.set_verbose(True)
+        assert env.is_verbose()
+        env.set_verbose(False)
+        assert env.num_devices() >= 8  # virtual CPU mesh from conftest
+
+
+class TestReviewRegressions:
+    """Cases from the round-1 code review findings."""
+
+    def test_wide_dtypes_without_conftest_help(self):
+        # x64 is enabled by the package itself, not just the test harness
+        a = nd.create([2**40], dtype=d4t.DataType.INT64)
+        assert a.to_numpy()[0] == 2**40
+        assert nd.zeros(2, dtype=d4t.DataType.DOUBLE).data_type() == d4t.DataType.DOUBLE
+
+    def test_fancy_index_view(self):
+        base = nd.create([10.0, 20.0, 30.0])
+        sel = base[nd.create([0, 2], dtype=d4t.DataType.INT32)]
+        assert sel.to_numpy().tolist() == [10.0, 30.0]
+        sel.addi(1.0)
+        assert base.to_numpy().tolist() == [11.0, 20.0, 31.0]
+
+    def test_tad_negative_dim(self):
+        t = nd.create(np.arange(6).reshape(2, 3), dtype=d4t.DataType.FLOAT)
+        assert t.tensor_along_dimension(0, -1).to_numpy().tolist() == [0, 1, 2]
+
+    def test_elementwise_eq(self):
+        a, b = nd.create([1.0, 2.0]), nd.create([1.0, 5.0])
+        assert (a == b).to_numpy().tolist() == [True, False]
+        assert (a != b).to_numpy().tolist() == [False, True]
+
+    def test_equals_to_f64_precision(self):
+        a = nd.create([16777216.0], dtype=d4t.DataType.DOUBLE)
+        b = nd.create([16777217.0], dtype=d4t.DataType.DOUBLE)
+        assert not a.equals_to(b)
