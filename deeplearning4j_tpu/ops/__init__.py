@@ -1,0 +1,3 @@
+"""Op layer: registry + families (see registry.py for the design contract)."""
+
+from .registry import all_ops, coverage_report, exec_op, get_op, has_op, mark_validated, op

@@ -1,0 +1,81 @@
+"""Bitwise ops.
+
+Reference: libnd4j ``include/ops/declarable/generic/bitwise/`` (and/or/xor,
+shifts, cyclic shifts, bits_hamming_distance).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import op
+
+
+@op("bitwise_and", "bitwise", differentiable=False)
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+@op("bitwise_or", "bitwise", differentiable=False)
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+@op("bitwise_xor", "bitwise", differentiable=False)
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+@op("bitwise_not", "bitwise", differentiable=False)
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+@op("shift_left", "bitwise", differentiable=False)
+def shift_left(x, n):
+    return jnp.left_shift(x, n)
+
+
+@op("shift_right", "bitwise", differentiable=False)
+def shift_right(x, n):
+    return jnp.right_shift(x, n)
+
+
+def _rotate(x, n, left: bool):
+    """Rotate on the unsigned view: signed right-shift is arithmetic (sign-
+    extending) in XLA, and a shift by the full bit width is undefined."""
+    bits = x.dtype.itemsize * 8
+    udt = jnp.dtype(f"uint{bits}")
+    u = x.astype(udt) if not jnp.issubdtype(x.dtype, jnp.unsignedinteger) else x
+    n = jnp.asarray(n).astype(udt) % bits
+    back = (bits - n) % bits
+    if left:
+        out = jnp.left_shift(u, n) | jnp.where(n == 0, 0, jnp.right_shift(u, back))
+    else:
+        out = jnp.right_shift(u, n) | jnp.where(n == 0, 0, jnp.left_shift(u, back))
+    return out.astype(x.dtype)
+
+
+@op("cyclic_shift_left", "bitwise", differentiable=False)
+def cyclic_shift_left(x, n):
+    return _rotate(x, n, left=True)
+
+
+@op("cyclic_shift_right", "bitwise", differentiable=False)
+def cyclic_shift_right(x, n):
+    return _rotate(x, n, left=False)
+
+
+@op("bits_hamming_distance", "bitwise", differentiable=False)
+def bits_hamming_distance(x, y):
+    diff = jnp.bitwise_xor(x, y)
+    return jnp.sum(jnp.unpackbits(diff.view(jnp.uint8)).astype(jnp.int64)) \
+        if hasattr(jnp, "unpackbits") else _popcount_sum(diff)
+
+
+def _popcount_sum(v):
+    v = v.astype(jnp.uint64)
+    count = jnp.zeros_like(v)
+    for shift in range(64):
+        count = count + ((v >> shift) & 1)
+    return jnp.sum(count.astype(jnp.int64))

@@ -1,0 +1,140 @@
+"""Broadcastable pairwise ops.
+
+Reference: libnd4j ``include/ops/declarable/generic/broadcastable/*.cpp`` and
+the legacy pairwise/broadcast loop kernels (``include/loops/``). On TPU these
+all lower to fused XLA elementwise HLO — no hand kernels (SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+
+@op("add", "broadcastable")
+def add(x, y):
+    return jnp.add(x, y)
+
+
+@op("subtract", "broadcastable")
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+@op("multiply", "broadcastable")
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+@op("divide", "broadcastable")
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+@op("reversesubtract", "broadcastable")
+def reversesubtract(x, y):
+    return jnp.subtract(y, x)
+
+
+@op("reversedivide", "broadcastable")
+def reversedivide(x, y):
+    return jnp.divide(y, x)
+
+
+@op("pow", "broadcastable")
+def pow_(x, y):
+    return jnp.power(x, y)
+
+
+@op("mod", "broadcastable")
+def mod(x, y):
+    """Truncated remainder (Java/C % semantics): mod(-7, 3) == -1.
+    Distinct from floormod, which floors: floormod(-7, 3) == 2."""
+    return jnp.fmod(x, y)
+
+
+@op("floormod", "broadcastable")
+def floormod(x, y):
+    return jnp.mod(x, y)
+
+
+@op("floordiv", "broadcastable")
+def floordiv(x, y):
+    return jnp.floor_divide(x, y)
+
+
+@op("truncatediv", "broadcastable")
+def truncatediv(x, y):
+    return jnp.trunc(jnp.divide(x, y)).astype(jnp.result_type(x, y))
+
+
+@op("maximum", "broadcastable")
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@op("minimum", "broadcastable")
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@op("squaredsubtract", "broadcastable")
+def squaredsubtract(x, y):
+    return jnp.square(jnp.subtract(x, y))
+
+
+@op("atan2", "broadcastable")
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+@op("boolean_and", "broadcastable", differentiable=False)
+def boolean_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+@op("boolean_or", "broadcastable", differentiable=False)
+def boolean_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+@op("boolean_xor", "broadcastable", differentiable=False)
+def boolean_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+@op("boolean_not", "broadcastable", differentiable=False)
+def boolean_not(x):
+    return jnp.logical_not(x)
+
+
+@op("equals", "broadcastable", differentiable=False)
+def equals(x, y):
+    return jnp.equal(x, y)
+
+
+@op("not_equals", "broadcastable", differentiable=False)
+def not_equals(x, y):
+    return jnp.not_equal(x, y)
+
+
+@op("less", "broadcastable", differentiable=False)
+def less(x, y):
+    return jnp.less(x, y)
+
+
+@op("less_equal", "broadcastable", differentiable=False)
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+@op("greater", "broadcastable", differentiable=False)
+def greater(x, y):
+    return jnp.greater(x, y)
+
+
+@op("greater_equal", "broadcastable", differentiable=False)
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)

@@ -1,0 +1,220 @@
+"""Image ops.
+
+Reference: libnd4j ``include/ops/declarable/generic/images/`` +
+``helpers/{cpu,cuda}/image_resize`` (resize_bilinear/nearest, adjust_hue/
+saturation/contrast, rgb↔hsv/yuv, non_max_suppression, crop_and_resize,
+extract_image_patches). All NHWC like the reference image ops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import op
+
+
+@op("resize_nearest", "image")
+def resize_nearest(x, height: int, width: int, align_corners: bool = False):
+    """x: [N, H, W, C]."""
+    n, h, w, c = x.shape
+    if align_corners and height > 1:
+        rows = jnp.round(jnp.linspace(0, h - 1, height)).astype(jnp.int32)
+    else:
+        rows = jnp.floor(jnp.arange(height) * (h / height)).astype(jnp.int32)
+    if align_corners and width > 1:
+        cols = jnp.round(jnp.linspace(0, w - 1, width)).astype(jnp.int32)
+    else:
+        cols = jnp.floor(jnp.arange(width) * (w / width)).astype(jnp.int32)
+    return x[:, rows][:, :, cols]
+
+
+@op("resize_bilinear", "image")
+def resize_bilinear(x, height: int, width: int, align_corners: bool = False,
+                    half_pixel_centers: bool = False):
+    n, h, w, c = x.shape
+    dtype = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
+    xf = x.astype(dtype)
+
+    def src_coords(out_size, in_size):
+        if align_corners and out_size > 1:
+            return jnp.linspace(0.0, in_size - 1.0, out_size)
+        scale = in_size / out_size
+        if half_pixel_centers:
+            return jnp.maximum((jnp.arange(out_size) + 0.5) * scale - 0.5, 0.0)
+        return jnp.arange(out_size) * scale
+
+    ys = src_coords(height, h)
+    xs = src_coords(width, w)
+    y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    wy = (ys - y0).astype(dtype)[None, :, None, None]
+    wx = (xs - x0).astype(dtype)[None, None, :, None]
+    top = xf[:, y0][:, :, x0] * (1 - wx) + xf[:, y0][:, :, x1] * wx
+    bot = xf[:, y1][:, :, x0] * (1 - wx) + xf[:, y1][:, :, x1] * wx
+    return (top * (1 - wy) + bot * wy).astype(x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else dtype)
+
+
+@op("rgb_to_hsv", "image")
+def rgb_to_hsv(x):
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    maxc = jnp.maximum(jnp.maximum(r, g), b)
+    minc = jnp.minimum(jnp.minimum(r, g), b)
+    v = maxc
+    delta = maxc - minc
+    s = jnp.where(maxc > 0, delta / jnp.maximum(maxc, 1e-12), 0.0)
+    safe = jnp.maximum(delta, 1e-12)
+    rc = (maxc - r) / safe
+    gc = (maxc - g) / safe
+    bc = (maxc - b) / safe
+    h = jnp.where(r == maxc, bc - gc, jnp.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = jnp.where(delta > 0, (h / 6.0) % 1.0, 0.0)
+    return jnp.stack([h, s, v], axis=-1)
+
+
+@op("hsv_to_rgb", "image")
+def hsv_to_rgb(x):
+    h, s, v = x[..., 0], x[..., 1], x[..., 2]
+    i = jnp.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(jnp.int32) % 6
+    r = jnp.choose(i, [v, q, p, p, t, v], mode="clip")
+    g = jnp.choose(i, [t, v, v, q, p, p], mode="clip")
+    b = jnp.choose(i, [p, p, t, v, v, q], mode="clip")
+    return jnp.stack([r, g, b], axis=-1)
+
+
+@op("adjust_hue", "image")
+def adjust_hue(x, delta: float):
+    hsv = rgb_to_hsv(x)
+    h = (hsv[..., 0] + delta) % 1.0
+    return hsv_to_rgb(jnp.stack([h, hsv[..., 1], hsv[..., 2]], axis=-1))
+
+
+@op("adjust_saturation", "image")
+def adjust_saturation(x, factor: float):
+    hsv = rgb_to_hsv(x)
+    s = jnp.clip(hsv[..., 1] * factor, 0.0, 1.0)
+    return hsv_to_rgb(jnp.stack([hsv[..., 0], s, hsv[..., 2]], axis=-1))
+
+
+@op("adjust_contrast", "image")
+def adjust_contrast(x, factor: float):
+    mean = jnp.mean(x, axis=(-3, -2), keepdims=True)
+    return (x - mean) * factor + mean
+
+
+@op("rgb_to_grayscale", "image")
+def rgb_to_grayscale(x):
+    w = jnp.asarray([0.2989, 0.5870, 0.1140], dtype=x.dtype)
+    return jnp.sum(x * w, axis=-1, keepdims=True)
+
+
+@op("rgb_to_yuv", "image")
+def rgb_to_yuv(x):
+    m = jnp.asarray([[0.299, -0.14714119, 0.61497538],
+                     [0.587, -0.28886916, -0.51496512],
+                     [0.114, 0.43601035, -0.10001026]], dtype=x.dtype)
+    return x @ m
+
+
+@op("yuv_to_rgb", "image")
+def yuv_to_rgb(x):
+    m = jnp.asarray([[1.0, 1.0, 1.0],
+                     [0.0, -0.394642334, 2.03206185],
+                     [1.13988303, -0.58062185, 0.0]], dtype=x.dtype)
+    return x @ m
+
+
+@op("image_flip", "image")
+def image_flip(x, horizontal: bool = True):
+    return jnp.flip(x, axis=2 if horizontal else 1)
+
+
+@op("crop_and_resize", "image")
+def crop_and_resize(image, boxes, box_indices, crop_size):
+    """image: [N,H,W,C]; boxes: [M,4] normalized y1,x1,y2,x2."""
+    ch, cw = crop_size
+    image = jnp.asarray(image)
+
+    def one(box, bi):
+        y1, x1, y2, x2 = box
+        img = image[bi]
+        h, w = img.shape[0], img.shape[1]
+        ys = y1 * (h - 1) + jnp.arange(ch) / jnp.maximum(ch - 1, 1) * (y2 - y1) * (h - 1)
+        xs = x1 * (w - 1) + jnp.arange(cw) / jnp.maximum(cw - 1, 1) * (x2 - x1) * (w - 1)
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wy = (ys - y0)[:, None, None]
+        wx = (xs - x0)[None, :, None]
+        top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1i] * wx
+        bot = img[y1i][:, x0] * (1 - wx) + img[y1i][:, x1i] * wx
+        return top * (1 - wy) + bot * wy
+
+    return jax.vmap(one)(boxes, box_indices)
+
+
+@op("non_max_suppression", "image", differentiable=False)
+def non_max_suppression(boxes, scores, max_output_size: int,
+                        iou_threshold: float = 0.5, score_threshold: float = -jnp.inf):
+    """Greedy NMS with static output size (padded with -1), XLA-friendly
+    lax.fori_loop form. boxes: [N,4] (y1,x1,y2,x2); returns int32 [max_output_size]."""
+    boxes = jnp.asarray(boxes)
+    scores = jnp.asarray(scores)
+    n = boxes.shape[0]
+    y1, x1, y2, x2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    areas = jnp.maximum(y2 - y1, 0) * jnp.maximum(x2 - x1, 0)
+
+    def iou(i, j):
+        yy1 = jnp.maximum(y1[i], y1[j])
+        xx1 = jnp.maximum(x1[i], x1[j])
+        yy2 = jnp.minimum(y2[i], y2[j])
+        xx2 = jnp.minimum(x2[i], x2[j])
+        inter = jnp.maximum(yy2 - yy1, 0) * jnp.maximum(xx2 - xx1, 0)
+        return inter / jnp.maximum(areas[i] + areas[j] - inter, 1e-12)
+
+    def body(k, state):
+        sel, alive, scr = state
+        best = jnp.argmax(jnp.where(alive, scr, -jnp.inf))
+        ok = jnp.where(alive, scr, -jnp.inf)[best] > score_threshold
+        sel = sel.at[k].set(jnp.where(ok, best.astype(jnp.int32), -1))
+        ious = jax.vmap(lambda j: iou(best, j))(jnp.arange(n))
+        alive = alive & (ious <= iou_threshold) & ok
+        return sel, alive, scr
+
+    sel0 = jnp.full((max_output_size,), -1, dtype=jnp.int32)
+    alive0 = jnp.ones((n,), dtype=bool)
+    sel, _, _ = jax.lax.fori_loop(0, max_output_size, body, (sel0, alive0, scores))
+    return sel
+
+
+@op("extract_image_patches", "image")
+def extract_image_patches(x, ksizes, strides, rates=(1, 1), padding: str = "VALID"):
+    """x: [N,H,W,C] → [N,oh,ow,kh*kw*C] (TF semantics)."""
+    kh, kw = ksizes
+    sh, sw = strides
+    rh, rw = rates
+    n, h, w, c = x.shape
+    if padding.upper() == "SAME":
+        eff_kh, eff_kw = (kh - 1) * rh + 1, (kw - 1) * rw + 1
+        oh = -(-h // sh)
+        ow = -(-w // sw)
+        ph = max((oh - 1) * sh + eff_kh - h, 0)
+        pw = max((ow - 1) * sw + eff_kw - w, 0)
+        x = jnp.pad(x, ((0, 0), (ph // 2, ph - ph // 2), (pw // 2, pw - pw // 2), (0, 0)))
+        h, w = x.shape[1], x.shape[2]
+    eff_kh, eff_kw = (kh - 1) * rh + 1, (kw - 1) * rw + 1
+    oh = (h - eff_kh) // sh + 1
+    ow = (w - eff_kw) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            patches.append(x[:, i * rh:i * rh + oh * sh:sh, j * rw:j * rw + ow * sw:sw, :])
+    return jnp.concatenate(patches, axis=-1)

@@ -1,0 +1,159 @@
+"""Linear-algebra ops.
+
+Reference: libnd4j ``include/ops/declarable/generic/linalg/`` (svd, qr,
+cholesky, lstsq, triangular_solve, matrix_inverse, ...) + ``blas/`` matmul
+family and ``helpers/MmulHelper``. Dense factorizations route through
+jnp.linalg (XLA custom calls); matmuls ride the MXU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+
+@op("matmul", "linalg")
+def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+@op("batched_gemm", "linalg")
+def batched_gemm(x, y, transpose_x: bool = False, transpose_y: bool = False,
+                 alpha: float = 1.0):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    return alpha * jnp.matmul(x, y)
+
+
+@op("tensormmul", "linalg")
+def tensormmul(x, y, axes_x, axes_y):
+    return jnp.tensordot(x, y, axes=(tuple(axes_x), tuple(axes_y)))
+
+
+@op("outer", "linalg")
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@op("svd", "linalg")
+def svd(x, full_matrices: bool = False, compute_uv: bool = True):
+    if compute_uv:
+        u, s, vt = jnp.linalg.svd(x, full_matrices=full_matrices)
+        return s, u, jnp.swapaxes(vt, -1, -2)  # reference returns (s, u, v)
+    return jnp.linalg.svd(x, full_matrices=full_matrices, compute_uv=False)
+
+
+@op("qr", "linalg")
+def qr(x, full_matrices: bool = False):
+    return jnp.linalg.qr(x, mode="complete" if full_matrices else "reduced")
+
+
+@op("cholesky", "linalg")
+def cholesky(x):
+    return jnp.linalg.cholesky(x)
+
+
+@op("lu", "linalg")
+def lu(x):
+    import jax.scipy.linalg as jsl
+
+    lu_, piv = jsl.lu_factor(x)
+    return lu_, piv
+
+
+@op("triangular_solve", "linalg")
+def triangular_solve(a, b, lower: bool = True, adjoint: bool = False):
+    import jax.scipy.linalg as jsl
+
+    return jsl.solve_triangular(a, b, lower=lower, trans=1 if adjoint else 0)
+
+
+@op("solve", "linalg")
+def solve(a, b, adjoint: bool = False):
+    if adjoint:
+        a = jnp.swapaxes(a, -1, -2)
+    return jnp.linalg.solve(a, b)
+
+
+@op("lstsq", "linalg")
+def lstsq(a, b, l2_regularizer: float = 0.0):
+    if l2_regularizer > 0:
+        ata = jnp.swapaxes(a, -1, -2) @ a + l2_regularizer * jnp.eye(a.shape[-1], dtype=a.dtype)
+        return jnp.linalg.solve(ata, jnp.swapaxes(a, -1, -2) @ b)
+    return jnp.linalg.lstsq(a, b)[0]
+
+
+@op("matrix_inverse", "linalg")
+def matrix_inverse(x):
+    return jnp.linalg.inv(x)
+
+
+@op("pinv", "linalg")
+def pinv(x):
+    return jnp.linalg.pinv(x)
+
+
+@op("matrix_determinant", "linalg")
+def matrix_determinant(x):
+    return jnp.linalg.det(x)
+
+
+@op("log_matrix_determinant", "linalg")
+def log_matrix_determinant(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return sign, logdet
+
+
+@op("trace", "linalg")
+def trace(x):
+    return jnp.trace(x, axis1=-2, axis2=-1)
+
+
+@op("cross", "linalg")
+def cross(x, y):
+    return jnp.cross(x, y)
+
+
+@op("self_adjoint_eig", "linalg")
+def self_adjoint_eig(x):
+    """Symmetric/Hermitian eigendecomposition only (eigh). General eig is not
+    TPU-lowerable; the reference op set has no general eig either."""
+    return jnp.linalg.eigh(x)
+
+
+@op("norm", "linalg")
+def norm(x, ord=None, axis=None):
+    return jnp.linalg.norm(x, ord=ord, axis=axis)
+
+
+@op("matrix_band_part", "linalg")
+def matrix_band_part(x, num_lower: int, num_upper: int):
+    m, n = x.shape[-2], x.shape[-1]
+    i = jnp.arange(m)[:, None]
+    j = jnp.arange(n)[None, :]
+    keep_lower = (i - j) <= num_lower if num_lower >= 0 else jnp.ones((m, n), bool)
+    keep_upper = (j - i) <= num_upper if num_upper >= 0 else jnp.ones((m, n), bool)
+    return jnp.where(keep_lower & keep_upper, x, jnp.zeros((), dtype=x.dtype))
+
+
+@op("sufficient_statistics", "linalg")
+def sufficient_statistics(x, dims, shift=None):
+    ax = tuple(dims)
+    count = jnp.asarray(1.0)
+    for d in ax:
+        count = count * x.shape[d]
+    if shift is not None:
+        m = jnp.sum(x - shift, axis=ax)
+        v = jnp.sum(jnp.square(x - shift), axis=ax)
+    else:
+        m = jnp.sum(x, axis=ax)
+        v = jnp.sum(jnp.square(x), axis=ax)
+    return count, m, v, shift

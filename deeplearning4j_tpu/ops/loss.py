@@ -1,0 +1,189 @@
+"""Loss ops (raw-array level; the ILossFunction SPI shell lives in nn/).
+
+Reference: libnd4j ``include/ops/declarable/generic/loss/`` (log_loss,
+mean_sqerr_loss, hinge_loss, huber_loss, softmax_cross_entropy, ctc_loss...).
+Reductions follow the TF-style reduction modes the reference exposes:
+none / sum / mean_by_weight / mean_by_nonzero_weight.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import op
+
+
+def _reduce(per_ex, weights, reduction: str):
+    if weights is None:
+        weights = jnp.ones_like(per_ex)
+    weighted = per_ex * weights
+    r = reduction.lower()
+    if r == "none":
+        return weighted
+    if r == "sum":
+        return jnp.sum(weighted)
+    if r == "mean_by_weight":
+        return jnp.sum(weighted) / jnp.maximum(jnp.sum(weights), 1e-12)
+    if r == "mean_by_nonzero_weight" or r == "mean":
+        nz = jnp.sum((weights != 0).astype(per_ex.dtype))
+        return jnp.sum(weighted) / jnp.maximum(nz, 1.0)
+    raise ValueError(f"unknown reduction {reduction!r}")
+
+
+@op("log_loss", "loss")
+def log_loss(predictions, labels, weights=None, epsilon: float = 1e-7,
+             reduction: str = "mean_by_nonzero_weight"):
+    """Binary cross-entropy on probabilities."""
+    p = jnp.clip(predictions, epsilon, 1.0 - epsilon)
+    per = -(labels * jnp.log(p) + (1.0 - labels) * jnp.log1p(-p))
+    return _reduce(per, weights, reduction)
+
+
+@op("sigmoid_cross_entropy", "loss")
+def sigmoid_cross_entropy(logits, labels, weights=None, label_smoothing: float = 0.0,
+                          reduction: str = "mean_by_nonzero_weight"):
+    if label_smoothing > 0:
+        labels = labels * (1.0 - label_smoothing) + 0.5 * label_smoothing
+    per = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return _reduce(per, weights, reduction)
+
+
+@op("softmax_cross_entropy", "loss")
+def softmax_cross_entropy(logits, labels, weights=None, label_smoothing: float = 0.0,
+                          reduction: str = "mean_by_nonzero_weight"):
+    """labels: one-hot/soft distribution over last axis."""
+    if label_smoothing > 0:
+        n = logits.shape[-1]
+        labels = labels * (1.0 - label_smoothing) + label_smoothing / n
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    per = -jnp.sum(labels * logp, axis=-1)
+    return _reduce(per, weights, reduction)
+
+
+@op("sparse_softmax_cross_entropy", "loss")
+def sparse_softmax_cross_entropy(logits, labels, weights=None,
+                                 reduction: str = "mean_by_nonzero_weight"):
+    """labels: int class indices."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    per = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return _reduce(per, weights, reduction)
+
+
+@op("mean_sqerr_loss", "loss")
+def mean_sqerr_loss(predictions, labels, weights=None,
+                    reduction: str = "mean_by_nonzero_weight"):
+    per = jnp.mean(jnp.square(predictions - labels), axis=tuple(range(1, predictions.ndim))) \
+        if predictions.ndim > 1 else jnp.square(predictions - labels)
+    return _reduce(per, weights, reduction)
+
+
+@op("mean_pairwssqerr_loss", "loss")
+def mean_pairwssqerr_loss(predictions, labels, weights=None,
+                          reduction: str = "mean_by_nonzero_weight"):
+    diff = predictions - labels
+    b = diff.shape[0]
+    flat = diff.reshape(b, -1)
+    n = flat.shape[1]
+    sum_sq = jnp.sum(jnp.square(flat), axis=1)
+    sq_sum = jnp.square(jnp.sum(flat, axis=1))
+    per = (n * sum_sq - sq_sum) / jnp.maximum(n * (n - 1) / 2.0, 1.0) / 2.0
+    return _reduce(per, weights, reduction)
+
+
+@op("absolute_difference_loss", "loss")
+def absolute_difference_loss(predictions, labels, weights=None,
+                             reduction: str = "mean_by_nonzero_weight"):
+    per = jnp.mean(jnp.abs(predictions - labels), axis=tuple(range(1, predictions.ndim))) \
+        if predictions.ndim > 1 else jnp.abs(predictions - labels)
+    return _reduce(per, weights, reduction)
+
+
+@op("hinge_loss", "loss")
+def hinge_loss(logits, labels, weights=None, reduction: str = "mean_by_nonzero_weight"):
+    """labels in {0,1} (reference converts to ±1)."""
+    signed = 2.0 * labels - 1.0
+    per = jnp.mean(jnp.maximum(0.0, 1.0 - signed * logits),
+                   axis=tuple(range(1, logits.ndim))) if logits.ndim > 1 \
+        else jnp.maximum(0.0, 1.0 - signed * logits)
+    return _reduce(per, weights, reduction)
+
+
+@op("huber_loss", "loss")
+def huber_loss(predictions, labels, weights=None, delta: float = 1.0,
+               reduction: str = "mean_by_nonzero_weight"):
+    err = jnp.abs(predictions - labels)
+    quad = jnp.minimum(err, delta)
+    per_el = 0.5 * jnp.square(quad) + delta * (err - quad)
+    per = jnp.mean(per_el, axis=tuple(range(1, predictions.ndim))) \
+        if predictions.ndim > 1 else per_el
+    return _reduce(per, weights, reduction)
+
+
+@op("cosine_distance_loss", "loss")
+def cosine_distance_loss(predictions, labels, weights=None, dim: int = -1,
+                         reduction: str = "mean_by_nonzero_weight"):
+    per = 1.0 - jnp.sum(predictions * labels, axis=dim)
+    return _reduce(per, weights, reduction)
+
+
+@op("kld_loss", "loss")
+def kld_loss(predictions, labels, weights=None, epsilon: float = 1e-7,
+             reduction: str = "mean_by_nonzero_weight"):
+    p = jnp.clip(predictions, epsilon, 1.0)
+    l = jnp.clip(labels, epsilon, 1.0)
+    per = jnp.sum(labels * (jnp.log(l) - jnp.log(p)), axis=-1)
+    return _reduce(per, weights, reduction)
+
+
+@op("poisson_loss", "loss")
+def poisson_loss(predictions, labels, weights=None,
+                 reduction: str = "mean_by_nonzero_weight", log_input: bool = False):
+    if log_input:
+        per_el = jnp.exp(predictions) - labels * predictions
+    else:
+        per_el = predictions - labels * jnp.log(jnp.maximum(predictions, 1e-7))
+    per = jnp.mean(per_el, axis=tuple(range(1, predictions.ndim))) \
+        if predictions.ndim > 1 else per_el
+    return _reduce(per, weights, reduction)
+
+
+@op("ctc_loss", "loss")
+def ctc_loss(log_probs, targets, input_lengths, target_lengths, blank: int = 0):
+    """CTC via the stable log-alpha recursion over a lax.scan (reference
+    helpers/cpu/ctcLoss.cpp). log_probs: [B, T, C]; targets: [B, S]."""
+    b, t_max, c = log_probs.shape
+    s_max = targets.shape[1]
+    # extended label sequence with interleaved blanks: length 2S+1
+    ext = jnp.full((b, 2 * s_max + 1), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(targets.astype(jnp.int32))
+    ext_len = 2 * target_lengths.astype(jnp.int32) + 1
+    neg_inf = jnp.asarray(-1e30, dtype=log_probs.dtype)
+
+    # transition allowed from s-2 when ext[s] != blank and ext[s] != ext[s-2]
+    ext_prev2 = jnp.concatenate([jnp.full((b, 2), blank, jnp.int32), ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (ext != ext_prev2)
+
+    alpha0 = jnp.full((b, 2 * s_max + 1), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(log_probs[:, 0, blank])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(target_lengths > 0,
+                  jnp.take_along_axis(log_probs[:, 0], ext[:, 1:2], axis=1)[:, 0], neg_inf))
+
+    def step(alpha, xs_t):
+        lp_t, t = xs_t  # lp_t: [B, C]
+        prev1 = jnp.concatenate([jnp.full((b, 1), neg_inf), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate([jnp.full((b, 2), neg_inf), alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(can_skip, prev2, neg_inf)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)
+        new_alpha = merged + emit
+        # freeze past input_lengths
+        active = (t < input_lengths)[:, None]
+        return jnp.where(active, new_alpha, alpha), None
+
+    lp_rest = jnp.swapaxes(jnp.asarray(log_probs), 0, 1)[1:]  # [T-1, B, C]
+    alpha, _ = jax.lax.scan(step, alpha0, (lp_rest, jnp.arange(1, t_max)))
+    last = jnp.take_along_axis(alpha, (ext_len - 1)[:, None], axis=1)[:, 0]
+    last2 = jnp.take_along_axis(alpha, jnp.maximum(ext_len - 2, 0)[:, None], axis=1)[:, 0]
+    return -jnp.logaddexp(last, last2)

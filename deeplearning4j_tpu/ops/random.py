@@ -1,0 +1,89 @@
+"""Random ops — explicit-key distribution draws.
+
+Reference: libnd4j random kernels (``include/loops/random.cpp``, ``include/ops/
+declarable/generic/random/``: uniform/normal/gamma/poisson/multinomial/
+dropout). Every op takes a jax PRNG key explicitly so draws are traceable and
+reproducible under jit (the stateful shell lives in ndarray/rng.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import op
+
+
+@op("random_uniform", "random", differentiable=False)
+def random_uniform(key, shape, low: float = 0.0, high: float = 1.0, dtype=jnp.float32):
+    return jax.random.uniform(key, tuple(shape), dtype=dtype, minval=low, maxval=high)
+
+
+@op("random_normal", "random", differentiable=False)
+def random_normal(key, shape, mean: float = 0.0, stddev: float = 1.0, dtype=jnp.float32):
+    return jax.random.normal(key, tuple(shape), dtype=dtype) * stddev + mean
+
+
+@op("random_truncated_normal", "random", differentiable=False)
+def random_truncated_normal(key, shape, mean: float = 0.0, stddev: float = 1.0,
+                            dtype=jnp.float32):
+    return jax.random.truncated_normal(key, -2.0, 2.0, tuple(shape), dtype=dtype) * stddev + mean
+
+
+@op("random_lognormal", "random", differentiable=False)
+def random_lognormal(key, shape, mean: float = 0.0, stddev: float = 1.0, dtype=jnp.float32):
+    return jnp.exp(jax.random.normal(key, tuple(shape), dtype=dtype) * stddev + mean)
+
+
+@op("random_bernoulli", "random", differentiable=False)
+def random_bernoulli(key, shape, p: float = 0.5, dtype=jnp.float32):
+    return jax.random.bernoulli(key, p, tuple(shape)).astype(dtype)
+
+
+@op("random_binomial", "random", differentiable=False)
+def random_binomial(key, shape, trials: int, p: float, dtype=jnp.float32):
+    draws = jax.random.bernoulli(key, p, (trials,) + tuple(shape))
+    return jnp.sum(draws.astype(dtype), axis=0)
+
+
+@op("random_exponential", "random", differentiable=False)
+def random_exponential(key, shape, lam: float = 1.0, dtype=jnp.float32):
+    return jax.random.exponential(key, tuple(shape), dtype=dtype) / lam
+
+
+@op("random_gamma", "random", differentiable=False)
+def random_gamma(key, shape, alpha: float, beta: float = 1.0, dtype=jnp.float32):
+    return jax.random.gamma(key, alpha, tuple(shape), dtype=dtype) / beta
+
+
+@op("random_poisson", "random", differentiable=False)
+def random_poisson(key, shape, lam: float, dtype=jnp.int32):
+    return jax.random.poisson(key, lam, tuple(shape), dtype=dtype)
+
+
+@op("random_multinomial", "random", differentiable=False)
+def random_multinomial(key, logits, num_samples: int):
+    return jax.random.categorical(key, logits, shape=(logits.shape[0], num_samples))
+
+
+@op("random_shuffle", "random", differentiable=False)
+def random_shuffle(key, x, axis: int = 0):
+    return jax.random.permutation(key, x, axis=axis)
+
+
+@op("random_crop", "random", differentiable=False)
+def random_crop(key, x, crop_shape):
+    starts = [
+        jax.random.randint(k, (), 0, dim - c + 1)
+        for k, dim, c in zip(jax.random.split(key, x.ndim), x.shape, crop_shape)
+    ]
+    import jax.lax as lax
+
+    return lax.dynamic_slice(x, starts, tuple(crop_shape))
+
+
+@op("dropout_bp", "random", differentiable=False)
+def dropout_bp(key, grad, rate: float):
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, grad.shape)
+    return jnp.where(mask, grad / keep, 0.0).astype(grad.dtype)

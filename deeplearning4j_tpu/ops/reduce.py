@@ -1,0 +1,229 @@
+"""Reduction + index-accumulation ops.
+
+Reference: libnd4j legacy reduce/indexreduce/summarystats kernels
+(``include/loops/cpu/reduce/``, ``indexreduce.cpp``, ``summarystatsreduce.cpp``).
+XLA lowers all of these to tiled reduction HLO on TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import op
+
+
+def _axis(dims):
+    if dims is None or dims == ():
+        return None
+    if isinstance(dims, int):
+        return dims
+    return tuple(dims)
+
+
+@op("reduce_sum", "reduce")
+def reduce_sum(x, dims=None, keep_dims: bool = False):
+    return jnp.sum(x, axis=_axis(dims), keepdims=keep_dims)
+
+
+@op("reduce_mean", "reduce")
+def reduce_mean(x, dims=None, keep_dims: bool = False):
+    return jnp.mean(x, axis=_axis(dims), keepdims=keep_dims)
+
+
+@op("reduce_max", "reduce")
+def reduce_max(x, dims=None, keep_dims: bool = False):
+    return jnp.max(x, axis=_axis(dims), keepdims=keep_dims)
+
+
+@op("reduce_min", "reduce")
+def reduce_min(x, dims=None, keep_dims: bool = False):
+    return jnp.min(x, axis=_axis(dims), keepdims=keep_dims)
+
+
+@op("reduce_prod", "reduce")
+def reduce_prod(x, dims=None, keep_dims: bool = False):
+    return jnp.prod(x, axis=_axis(dims), keepdims=keep_dims)
+
+
+@op("reduce_variance", "reduce")
+def reduce_variance(x, dims=None, keep_dims: bool = False, bias_corrected: bool = True):
+    return jnp.var(x, axis=_axis(dims), keepdims=keep_dims, ddof=1 if bias_corrected else 0)
+
+
+@op("reduce_stdev", "reduce")
+def reduce_stdev(x, dims=None, keep_dims: bool = False, bias_corrected: bool = True):
+    return jnp.std(x, axis=_axis(dims), keepdims=keep_dims, ddof=1 if bias_corrected else 0)
+
+
+@op("reduce_norm1", "reduce")
+def reduce_norm1(x, dims=None, keep_dims: bool = False):
+    return jnp.sum(jnp.abs(x), axis=_axis(dims), keepdims=keep_dims)
+
+
+@op("reduce_norm2", "reduce")
+def reduce_norm2(x, dims=None, keep_dims: bool = False):
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=_axis(dims), keepdims=keep_dims))
+
+
+@op("reduce_norm_max", "reduce")
+def reduce_norm_max(x, dims=None, keep_dims: bool = False):
+    return jnp.max(jnp.abs(x), axis=_axis(dims), keepdims=keep_dims)
+
+
+@op("reduce_sqnorm", "reduce")
+def reduce_sqnorm(x, dims=None, keep_dims: bool = False):
+    return jnp.sum(jnp.square(x), axis=_axis(dims), keepdims=keep_dims)
+
+
+@op("reduce_logsumexp", "reduce")
+def reduce_logsumexp(x, dims=None, keep_dims: bool = False):
+    import jax
+
+    return jax.scipy.special.logsumexp(x, axis=_axis(dims), keepdims=keep_dims)
+
+
+@op("reduce_amean", "reduce")
+def reduce_amean(x, dims=None, keep_dims: bool = False):
+    return jnp.mean(jnp.abs(x), axis=_axis(dims), keepdims=keep_dims)
+
+
+@op("reduce_amax", "reduce")
+def reduce_amax(x, dims=None, keep_dims: bool = False):
+    return jnp.max(jnp.abs(x), axis=_axis(dims), keepdims=keep_dims)
+
+
+@op("reduce_amin", "reduce")
+def reduce_amin(x, dims=None, keep_dims: bool = False):
+    return jnp.min(jnp.abs(x), axis=_axis(dims), keepdims=keep_dims)
+
+
+@op("count_nonzero", "reduce", differentiable=False)
+def count_nonzero(x, dims=None, keep_dims: bool = False):
+    return jnp.sum((x != 0).astype(jnp.int64), axis=_axis(dims), keepdims=keep_dims)
+
+
+@op("count_zero", "reduce", differentiable=False)
+def count_zero(x, dims=None, keep_dims: bool = False):
+    return jnp.sum((x == 0).astype(jnp.int64), axis=_axis(dims), keepdims=keep_dims)
+
+
+@op("all", "reduce", differentiable=False)
+def all_(x, dims=None, keep_dims: bool = False):
+    return jnp.all(x, axis=_axis(dims), keepdims=keep_dims)
+
+
+@op("any", "reduce", differentiable=False)
+def any_(x, dims=None, keep_dims: bool = False):
+    return jnp.any(x, axis=_axis(dims), keepdims=keep_dims)
+
+
+@op("argmax", "indexreduce", differentiable=False)
+def argmax(x, dims=None, keep_dims: bool = False):
+    ax = _axis(dims)
+    if isinstance(ax, tuple):
+        ax = ax[0]
+    return jnp.argmax(x, axis=ax, keepdims=keep_dims)
+
+
+@op("argmin", "indexreduce", differentiable=False)
+def argmin(x, dims=None, keep_dims: bool = False):
+    ax = _axis(dims)
+    if isinstance(ax, tuple):
+        ax = ax[0]
+    return jnp.argmin(x, axis=ax, keepdims=keep_dims)
+
+
+@op("argamax", "indexreduce", differentiable=False)
+def argamax(x, dims=None, keep_dims: bool = False):
+    """Index of max absolute value (reference IAMax)."""
+    ax = _axis(dims)
+    if isinstance(ax, tuple):
+        ax = ax[0]
+    return jnp.argmax(jnp.abs(x), axis=ax, keepdims=keep_dims)
+
+
+@op("argamin", "indexreduce", differentiable=False)
+def argamin(x, dims=None, keep_dims: bool = False):
+    ax = _axis(dims)
+    if isinstance(ax, tuple):
+        ax = ax[0]
+    return jnp.argmin(jnp.abs(x), axis=ax, keepdims=keep_dims)
+
+
+@op("cumsum", "reduce")
+def cumsum(x, axis: int = 0, exclusive: bool = False, reverse: bool = False):
+    v = jnp.flip(x, axis) if reverse else x
+    out = jnp.cumsum(v, axis=axis)
+    if exclusive:
+        out = out - v
+    return jnp.flip(out, axis) if reverse else out
+
+
+@op("cumprod", "reduce")
+def cumprod(x, axis: int = 0, exclusive: bool = False, reverse: bool = False):
+    v = jnp.flip(x, axis) if reverse else x
+    out = jnp.cumprod(v, axis=axis)
+    if exclusive:
+        out = out / jnp.where(v == 0, 1, v)  # best-effort exclusive form
+    return jnp.flip(out, axis) if reverse else out
+
+
+@op("dot", "reduce")
+def dot(x, y, dims=None):
+    if dims is None:
+        return jnp.sum(x * y)
+    return jnp.sum(x * y, axis=_axis(dims))
+
+
+@op("cosine_similarity", "reduce")
+def cosine_similarity(x, y, dims=None):
+    ax = _axis(dims)
+    num = jnp.sum(x * y, axis=ax)
+    den = jnp.sqrt(jnp.sum(jnp.square(x), axis=ax)) * jnp.sqrt(jnp.sum(jnp.square(y), axis=ax))
+    return num / den
+
+
+@op("cosine_distance", "reduce")
+def cosine_distance(x, y, dims=None):
+    return 1.0 - cosine_similarity(x, y, dims)
+
+
+@op("euclidean_distance", "reduce")
+def euclidean_distance(x, y, dims=None):
+    return jnp.sqrt(jnp.sum(jnp.square(x - y), axis=_axis(dims)))
+
+
+@op("manhattan_distance", "reduce")
+def manhattan_distance(x, y, dims=None):
+    return jnp.sum(jnp.abs(x - y), axis=_axis(dims))
+
+
+@op("hamming_distance", "reduce", differentiable=False)
+def hamming_distance(x, y, dims=None):
+    return jnp.sum((x != y).astype(jnp.int64), axis=_axis(dims))
+
+
+@op("jaccard_distance", "reduce")
+def jaccard_distance(x, y, dims=None):
+    ax = _axis(dims)
+    num = jnp.sum(jnp.minimum(x, y), axis=ax)
+    den = jnp.sum(jnp.maximum(x, y), axis=ax)
+    return 1.0 - num / den
+
+
+@op("moments", "reduce")
+def moments(x, dims=None, keep_dims: bool = False):
+    ax = _axis(dims)
+    return jnp.mean(x, axis=ax, keepdims=keep_dims), jnp.var(x, axis=ax, keepdims=keep_dims)
+
+
+@op("normalize_moments", "reduce")
+def normalize_moments(counts, mean_ss, var_ss, shift: float = 0.0):
+    mean = mean_ss / counts + shift
+    variance = var_ss / counts - jnp.square(mean_ss / counts)
+    return mean, variance
+
+
+@op("zero_fraction", "reduce", differentiable=False)
+def zero_fraction(x):
+    return jnp.mean((x == 0).astype(jnp.float32))

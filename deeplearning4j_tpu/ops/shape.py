@@ -1,0 +1,446 @@
+"""Shape / gather-scatter / segment ops.
+
+Reference: libnd4j ``include/ops/declarable/generic/shape/``, ``transforms/``
+(concat/split/tile/gather/scatter/pad/...), and ``parity_ops/`` segment ops.
+Gather/scatter lower to XLA gather/scatter HLO; segment ops use jax's
+``segment_sum`` family which XLA tiles well on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+
+@op("reshape", "shape")
+def reshape(x, shape):
+    return jnp.reshape(x, tuple(shape))
+
+
+@op("permute", "shape")
+def permute(x, dims):
+    return jnp.transpose(x, tuple(dims))
+
+
+@op("transpose", "shape")
+def transpose(x):
+    return jnp.transpose(x)
+
+
+@op("expand_dims", "shape")
+def expand_dims(x, axis: int):
+    return jnp.expand_dims(x, axis)
+
+
+@op("squeeze", "shape")
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+@op("concat", "shape")
+def concat(*xs, axis: int = 0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+@op("split", "shape")
+def split(x, num_split: int, axis: int = 0):
+    return tuple(jnp.split(x, num_split, axis=axis))
+
+
+@op("split_v", "shape")
+def split_v(x, sizes: Sequence[int], axis: int = 0):
+    idx = list(jnp.cumsum(jnp.asarray(sizes))[:-1])
+    return tuple(jnp.split(x, [int(i) for i in idx], axis=axis))
+
+
+@op("stack", "shape")
+def stack(*xs, axis: int = 0):
+    return jnp.stack(xs, axis=axis)
+
+
+@op("unstack", "shape")
+def unstack(x, axis: int = 0):
+    n = x.shape[axis]
+    return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(x, n, axis=axis))
+
+
+@op("tile", "shape")
+def tile(x, reps):
+    return jnp.tile(x, tuple(reps))
+
+
+@op("repeat", "shape")
+def repeat(x, repeats: int, axis: int):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@op("reverse", "shape")
+def reverse(x, dims):
+    return jnp.flip(x, axis=tuple(dims) if not isinstance(dims, int) else dims)
+
+
+@op("pad", "shape")
+def pad(x, paddings, mode: str = "constant", constant_value: float = 0.0):
+    mode = mode.lower()
+    pads = tuple(tuple(p) for p in paddings)
+    if mode == "constant":
+        return jnp.pad(x, pads, mode="constant", constant_values=constant_value)
+    if mode == "reflect":
+        return jnp.pad(x, pads, mode="reflect")
+    if mode == "symmetric":
+        return jnp.pad(x, pads, mode="symmetric")
+    raise ValueError(f"unknown pad mode {mode!r}")
+
+
+@op("gather", "shape")
+def gather(x, indices, axis: int = 0):
+    return jnp.take(x, indices, axis=axis)
+
+
+@op("gather_nd", "shape")
+def gather_nd(x, indices):
+    """TF-style gather_nd: trailing index dim addresses leading x dims."""
+    indices = jnp.asarray(indices)
+    idx = tuple(jnp.moveaxis(indices, -1, 0))
+    return x[idx]
+
+
+@op("scatter_update", "scatter")
+def scatter_update(ref, indices, updates):
+    return jnp.asarray(ref).at[indices].set(updates)
+
+
+@op("scatter_add", "scatter")
+def scatter_add(ref, indices, updates):
+    return jnp.asarray(ref).at[indices].add(updates)
+
+
+@op("scatter_sub", "scatter")
+def scatter_sub(ref, indices, updates):
+    return jnp.asarray(ref).at[indices].add(-jnp.asarray(updates))
+
+
+@op("scatter_mul", "scatter")
+def scatter_mul(ref, indices, updates):
+    return jnp.asarray(ref).at[indices].multiply(updates)
+
+
+@op("scatter_div", "scatter")
+def scatter_div(ref, indices, updates):
+    return jnp.asarray(ref).at[indices].divide(updates)
+
+
+@op("scatter_max", "scatter")
+def scatter_max(ref, indices, updates):
+    return jnp.asarray(ref).at[indices].max(updates)
+
+
+@op("scatter_min", "scatter")
+def scatter_min(ref, indices, updates):
+    return jnp.asarray(ref).at[indices].min(updates)
+
+
+@op("slice", "shape")
+def slice_(x, begin, sizes):
+    return lax.dynamic_slice(x, tuple(begin), tuple(sizes))
+
+
+@op("strided_slice", "shape")
+def strided_slice(x, begin, end, strides=None):
+    idx = tuple(
+        slice(b, e, s)
+        for b, e, s in zip(begin, end, strides or [1] * len(begin))
+    )
+    return x[idx]
+
+
+@op("size", "shape", differentiable=False)
+def size(x):
+    return jnp.asarray(x.size, dtype=jnp.int64)
+
+
+@op("shape_of", "shape", differentiable=False)
+def shape_of(x):
+    return jnp.asarray(x.shape, dtype=jnp.int64)
+
+
+@op("rank", "shape", differentiable=False)
+def rank(x):
+    return jnp.asarray(x.ndim, dtype=jnp.int32)
+
+
+@op("fill", "shape", differentiable=False)
+def fill(shape, value, dtype=jnp.float32):
+    return jnp.full(tuple(shape), value, dtype=dtype)
+
+
+@op("zeros_as", "shape", differentiable=False)
+def zeros_as(x):
+    return jnp.zeros_like(x)
+
+
+@op("ones_as", "shape", differentiable=False)
+def ones_as(x):
+    return jnp.ones_like(x)
+
+
+@op("linspace", "shape", differentiable=False)
+def linspace(start, stop, num: int):
+    return jnp.linspace(start, stop, num)
+
+
+@op("range", "shape", differentiable=False)
+def range_(start, limit, delta=1):
+    return jnp.arange(start, limit, delta)
+
+
+@op("eye", "shape", differentiable=False)
+def eye(rows: int, cols: int = None):
+    return jnp.eye(rows, cols)
+
+
+@op("diag", "shape")
+def diag(x):
+    """Input vector → diagonal matrix (reference diag op)."""
+    return jnp.diag(x.ravel()).reshape(x.shape + x.shape)if x.ndim > 1 else jnp.diag(x)
+
+
+@op("diag_part", "shape")
+def diag_part(x):
+    return jnp.diagonal(x)
+
+
+@op("matrix_diag", "shape")
+def matrix_diag(x):
+    """Batched: last dim becomes a diagonal matrix."""
+    return x[..., :, None] * jnp.eye(x.shape[-1], dtype=x.dtype)
+
+
+@op("matrix_diag_part", "shape")
+def matrix_diag_part(x):
+    return jnp.diagonal(x, axis1=-2, axis2=-1)
+
+
+@op("matrix_set_diag", "shape")
+def matrix_set_diag(x, diagonal):
+    eye = jnp.eye(x.shape[-2], x.shape[-1], dtype=bool)
+    return jnp.where(eye, _diag_embed(diagonal, x.shape), x)
+
+
+def _diag_embed(diagonal, shape):
+    out = jnp.zeros(shape, dtype=diagonal.dtype)
+    idx = jnp.arange(min(shape[-2], shape[-1]))
+    return out.at[..., idx, idx].set(diagonal)
+
+
+@op("broadcast_to", "shape")
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+@op("meshgrid", "shape")
+def meshgrid(*xs, indexing: str = "xy"):
+    return tuple(jnp.meshgrid(*xs, indexing=indexing))
+
+
+@op("where", "shape")
+def where(cond, x=None, y=None):
+    if x is None:
+        return jnp.argwhere(cond)
+    return jnp.where(cond, x, y)
+
+
+@op("select", "shape")
+def select(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+@op("boolean_mask", "shape", differentiable=False)
+def boolean_mask(x, mask):
+    return x[jnp.asarray(mask)]
+
+
+@op("one_hot", "shape", differentiable=False)
+def one_hot(indices, depth: int, on_value: float = 1.0, off_value: float = 0.0,
+            axis: int = -1, dtype=jnp.float32):
+    oh = jax.nn.one_hot(indices, depth, axis=axis, dtype=dtype)
+    return oh * (on_value - off_value) + off_value
+
+
+@op("flatten_2d", "shape")
+def flatten_2d(x, axis: int = 1):
+    """Collapse dims [axis:] (reference Flatten2D)."""
+    lead = int(jnp.prod(jnp.asarray(x.shape[:axis]))) if axis > 0 else 1
+    return jnp.reshape(x, (lead, -1))
+
+
+@op("top_k", "shape", differentiable=False)
+def top_k(x, k: int, sorted: bool = True):
+    return lax.top_k(x, k)
+
+
+@op("in_top_k", "shape", differentiable=False)
+def in_top_k(predictions, targets, k: int):
+    _, idx = lax.top_k(predictions, k)
+    return jnp.any(idx == targets[:, None], axis=-1)
+
+
+@op("unique", "shape", differentiable=False)
+def unique(x):
+    vals, idx = jnp.unique(x, return_inverse=True, size=x.size, fill_value=0)
+    return vals, idx
+
+
+@op("sequence_mask", "shape", differentiable=False)
+def sequence_mask(lengths, maxlen: int, dtype=jnp.bool_):
+    return (jnp.arange(maxlen)[None, :] < jnp.asarray(lengths)[..., None]).astype(dtype)
+
+
+@op("confusion_matrix", "shape", differentiable=False)
+def confusion_matrix(labels, predictions, num_classes: int, weights=None):
+    idx = labels.astype(jnp.int32) * num_classes + predictions.astype(jnp.int32)
+    w = weights if weights is not None else jnp.ones_like(idx, dtype=jnp.float64)
+    flat = jnp.zeros((num_classes * num_classes,), dtype=w.dtype).at[idx].add(w)
+    return flat.reshape(num_classes, num_classes)
+
+
+@op("dynamic_partition", "shape", differentiable=False)
+def dynamic_partition(x, partitions, num_partitions: int):
+    """Static-shaped variant: returns (num_partitions, N) padded with zeros +
+    a mask — XLA needs static shapes (SURVEY.md §7.3.3 dynamic-shape policy)."""
+    outs = []
+    for p in range(num_partitions):
+        mask = partitions == p
+        outs.append(jnp.where(mask, x, jnp.zeros_like(x)))
+    return tuple(outs)
+
+
+@op("dynamic_stitch", "shape", differentiable=False)
+def dynamic_stitch(indices, data):
+    n = sum(i.size for i in indices)
+    out = jnp.zeros((n,) + data[0].shape[1:], dtype=data[0].dtype)
+    for idx, d in zip(indices, data):
+        out = out.at[idx.ravel()].set(d.reshape((-1,) + d.shape[len(idx.shape):]))
+    return out
+
+
+# --- segment ops (reference parity_ops/segment_*.cpp) ------------------------
+
+
+@op("segment_sum", "segment")
+def segment_sum(data, segment_ids, num_segments: int):
+    return jax.ops.segment_sum(data, segment_ids, num_segments)
+
+
+@op("segment_mean", "segment")
+def segment_mean(data, segment_ids, num_segments: int):
+    sums = jax.ops.segment_sum(data, segment_ids, num_segments)
+    counts = jax.ops.segment_sum(jnp.ones_like(data), segment_ids, num_segments)
+    return sums / jnp.maximum(counts, 1)
+
+
+@op("segment_max", "segment")
+def segment_max(data, segment_ids, num_segments: int):
+    return jax.ops.segment_max(data, segment_ids, num_segments)
+
+
+@op("segment_min", "segment")
+def segment_min(data, segment_ids, num_segments: int):
+    return jax.ops.segment_min(data, segment_ids, num_segments)
+
+
+@op("segment_prod", "segment")
+def segment_prod(data, segment_ids, num_segments: int):
+    return jax.ops.segment_prod(data, segment_ids, num_segments)
+
+
+@op("unsorted_segment_sum", "segment")
+def unsorted_segment_sum(data, segment_ids, num_segments: int):
+    return jax.ops.segment_sum(data, segment_ids, num_segments, indices_are_sorted=False)
+
+
+@op("unsorted_segment_mean", "segment")
+def unsorted_segment_mean(data, segment_ids, num_segments: int):
+    sums = jax.ops.segment_sum(data, segment_ids, num_segments, indices_are_sorted=False)
+    counts = jax.ops.segment_sum(jnp.ones_like(data), segment_ids, num_segments,
+                                 indices_are_sorted=False)
+    return sums / jnp.maximum(counts, 1)
+
+
+@op("unsorted_segment_max", "segment")
+def unsorted_segment_max(data, segment_ids, num_segments: int):
+    return jax.ops.segment_max(data, segment_ids, num_segments, indices_are_sorted=False)
+
+
+@op("unsorted_segment_min", "segment")
+def unsorted_segment_min(data, segment_ids, num_segments: int):
+    return jax.ops.segment_min(data, segment_ids, num_segments, indices_are_sorted=False)
+
+
+@op("unsorted_segment_prod", "segment")
+def unsorted_segment_prod(data, segment_ids, num_segments: int):
+    return jax.ops.segment_prod(data, segment_ids, num_segments, indices_are_sorted=False)
+
+
+@op("unsorted_segment_sqrt_n", "segment")
+def unsorted_segment_sqrt_n(data, segment_ids, num_segments: int):
+    sums = jax.ops.segment_sum(data, segment_ids, num_segments, indices_are_sorted=False)
+    counts = jax.ops.segment_sum(jnp.ones_like(data), segment_ids, num_segments,
+                                 indices_are_sorted=False)
+    return sums / jnp.sqrt(jnp.maximum(counts, 1))
+
+
+# --- space/depth rearrangement (reference generic/transforms) ----------------
+
+
+@op("space_to_depth", "shape")
+def space_to_depth(x, block_size: int, data_format: str = "NHWC"):
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 2, 3, 1))
+    n, h, w, c = x.shape
+    b = block_size
+    x = x.reshape(n, h // b, b, w // b, b, c).transpose(0, 1, 3, 2, 4, 5)
+    x = x.reshape(n, h // b, w // b, b * b * c)
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    return x
+
+
+@op("depth_to_space", "shape")
+def depth_to_space(x, block_size: int, data_format: str = "NHWC"):
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 2, 3, 1))
+    n, h, w, c = x.shape
+    b = block_size
+    x = x.reshape(n, h, w, b, b, c // (b * b)).transpose(0, 1, 3, 2, 4, 5)
+    x = x.reshape(n, h * b, w * b, c // (b * b))
+    if data_format == "NCHW":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    return x
+
+
+@op("batch_to_space", "shape")
+def batch_to_space(x, block_shape, crops):
+    n = x.shape[0]
+    bs = int(jnp.prod(jnp.asarray(block_shape)))
+    b0, b1 = block_shape
+    _, h, w, c = x.shape
+    out = x.reshape(b0, b1, n // bs, h, w, c).transpose(2, 3, 0, 4, 1, 5)
+    out = out.reshape(n // bs, h * b0, w * b1, c)
+    (ct, cb), (cl, cr) = crops
+    return out[:, ct:out.shape[1] - cb, cl:out.shape[2] - cr, :]
+
+
+@op("space_to_batch", "shape")
+def space_to_batch(x, block_shape, paddings):
+    (pt, pb), (pl, pr) = paddings
+    x = jnp.pad(x, ((0, 0), (pt, pb), (pl, pr), (0, 0)))
+    n, h, w, c = x.shape
+    b0, b1 = block_shape
+    out = x.reshape(n, h // b0, b0, w // b1, b1, c).transpose(2, 4, 0, 1, 3, 5)
+    return out.reshape(n * b0 * b1, h // b0, w // b1, c)

@@ -1,0 +1,335 @@
+"""Elementwise transform ops (same/float/strict families) + activations.
+
+Reference: libnd4j legacy transform kernels (``include/loops/cpu/transform/``)
+and the ``IActivation`` SPI impl set (nd4j-api
+``org/nd4j/linalg/activations/impl/`` — ReLU..GELU..Mish, SURVEY.md §2.1).
+All lower to XLA elementwise HLO and fuse into neighbors.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+# --- float transforms -------------------------------------------------------
+
+
+@op("abs", "transform")
+def abs_(x):
+    return jnp.abs(x)
+
+
+@op("neg", "transform")
+def neg(x):
+    return jnp.negative(x)
+
+
+@op("sign", "transform")
+def sign(x):
+    return jnp.sign(x)
+
+
+@op("ceil", "transform")
+def ceil(x):
+    return jnp.ceil(x)
+
+
+@op("floor", "transform")
+def floor(x):
+    return jnp.floor(x)
+
+
+@op("round", "transform")
+def round_(x):
+    return jnp.round(x)
+
+
+@op("rint", "transform")
+def rint(x):
+    return jnp.rint(x)
+
+
+@op("square", "transform")
+def square(x):
+    return jnp.square(x)
+
+
+@op("cube", "transform")
+def cube(x):
+    return x * x * x
+
+
+@op("reciprocal", "transform")
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+@op("sqrt", "transform")
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+@op("rsqrt", "transform")
+def rsqrt(x):
+    return lax.rsqrt(x)
+
+
+@op("cbrt", "transform")
+def cbrt(x):
+    return jnp.cbrt(x)
+
+
+@op("exp", "transform")
+def exp(x):
+    return jnp.exp(x)
+
+
+@op("expm1", "transform")
+def expm1(x):
+    return jnp.expm1(x)
+
+
+@op("log", "transform")
+def log(x):
+    return jnp.log(x)
+
+
+@op("log1p", "transform")
+def log1p(x):
+    return jnp.log1p(x)
+
+
+@op("log2", "transform")
+def log2(x):
+    return jnp.log2(x)
+
+
+@op("log10", "transform")
+def log10(x):
+    return jnp.log10(x)
+
+
+@op("sin", "transform")
+def sin(x):
+    return jnp.sin(x)
+
+
+@op("cos", "transform")
+def cos(x):
+    return jnp.cos(x)
+
+
+@op("tan", "transform")
+def tan(x):
+    return jnp.tan(x)
+
+
+@op("asin", "transform")
+def asin(x):
+    return jnp.arcsin(x)
+
+
+@op("acos", "transform")
+def acos(x):
+    return jnp.arccos(x)
+
+
+@op("atan", "transform")
+def atan(x):
+    return jnp.arctan(x)
+
+
+@op("sinh", "transform")
+def sinh(x):
+    return jnp.sinh(x)
+
+
+@op("cosh", "transform")
+def cosh(x):
+    return jnp.cosh(x)
+
+
+@op("tanh", "transform")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@op("asinh", "transform")
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+@op("acosh", "transform")
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+@op("atanh", "transform")
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+@op("erf", "transform")
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+@op("erfc", "transform")
+def erfc(x):
+    return jax.scipy.special.erfc(x)
+
+
+@op("clip_by_value", "transform")
+def clip_by_value(x, clip_min: float, clip_max: float):
+    return jnp.clip(x, clip_min, clip_max)
+
+
+@op("clip_by_norm", "transform")
+def clip_by_norm(x, clip_norm: float):
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return jnp.where(norm > clip_norm, x * (clip_norm / norm), x)
+
+
+@op("clip_by_global_norm", "transform")
+def clip_by_global_norm(*xs, clip_norm: float):
+    gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(x)) for x in xs))
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(gnorm, 1e-12))
+    out = tuple(x * scale for x in xs)
+    return out if len(out) > 1 else out[0]
+
+
+@op("isnan", "transform", differentiable=False)
+def isnan(x):
+    return jnp.isnan(x)
+
+
+@op("isinf", "transform", differentiable=False)
+def isinf(x):
+    return jnp.isinf(x)
+
+
+@op("isfinite", "transform", differentiable=False)
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+@op("step", "transform", differentiable=False)
+def step(x):
+    return (x > 0).astype(x.dtype)
+
+
+# --- activations (IActivation SPI analog) -----------------------------------
+
+
+@op("relu", "activation")
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+@op("relu6", "activation")
+def relu6(x):
+    return jnp.clip(x, 0, 6)
+
+
+@op("leakyrelu", "activation")
+def leakyrelu(x, alpha: float = 0.01):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+@op("prelu", "activation")
+def prelu(x, alpha):
+    """Learned per-channel leak (alpha broadcasts against x)."""
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+@op("thresholdedrelu", "activation")
+def thresholdedrelu(x, theta: float = 1.0):
+    return jnp.where(x > theta, x, 0.0).astype(x.dtype)
+
+
+@op("elu", "activation")
+def elu(x, alpha: float = 1.0):
+    return jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@op("selu", "activation")
+def selu(x):
+    alpha, scale = 1.6732632423543772, 1.0507009873554805
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@op("gelu", "activation")
+def gelu(x):
+    """tanh-approximation GELU (matches the reference's GELU impl)."""
+    return 0.5 * x * (1.0 + jnp.tanh(0.7978845608028654 * (x + 0.044715 * x * x * x)))
+
+
+@op("gelu_exact", "activation")
+def gelu_exact(x):
+    return jax.nn.gelu(x, approximate=False)
+
+
+@op("mish", "activation")
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@op("swish", "activation")
+def swish(x):
+    return x * jax.nn.sigmoid(x)
+
+
+@op("sigmoid", "activation")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@op("hardsigmoid", "activation")
+def hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+@op("hardtanh", "activation")
+def hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+@op("rationaltanh", "activation")
+def rationaltanh(x):
+    """1.7159 * tanh_approx(2x/3) — reference RationalTanh."""
+    a = 0.6666667 * x
+    approx = jnp.sign(a) * (1.0 - 1.0 / (1.0 + jnp.abs(a) + a * a + 1.41645 * (a ** 4)))
+    return 1.7159 * approx
+
+
+@op("rectifiedtanh", "activation")
+def rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x)).astype(x.dtype)
+
+
+@op("softplus", "activation")
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+@op("softsign", "activation")
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@op("identity", "activation")
+def identity(x):
+    return x
+
+
+@op("softmax", "activation")
+def softmax(x, axis: int = -1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+@op("log_softmax", "activation")
+def log_softmax(x, axis: int = -1):
+    return jax.nn.log_softmax(x, axis=axis)
