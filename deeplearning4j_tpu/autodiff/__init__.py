@@ -1,0 +1,2 @@
+from .samediff import SameDiff, SDVariable, TrainingConfig, VariableType
+from .history import History

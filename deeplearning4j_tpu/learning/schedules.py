@@ -1,0 +1,108 @@
+"""Learning-rate schedules.
+
+Reference: nd4j-api ``org.nd4j.linalg.schedule.{ISchedule, StepSchedule,
+ExponentialSchedule, PolySchedule, InverseSchedule, SigmoidSchedule,
+CycleSchedule, FixedSchedule}``. Schedules are pure functions of the iteration
+counter so they trace cleanly into the compiled train step.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+
+class ISchedule:
+    def value_at(self, iteration, epoch: int = 0):
+        raise NotImplementedError
+
+    def __call__(self, iteration, epoch: int = 0):
+        return self.value_at(iteration, epoch)
+
+
+@dataclass
+class FixedSchedule(ISchedule):
+    value: float
+
+    def value_at(self, iteration, epoch: int = 0):
+        return self.value
+
+
+@dataclass
+class StepSchedule(ISchedule):
+    """lr * decay_rate^floor(iter / step)"""
+
+    initial_value: float
+    decay_rate: float
+    step: float
+
+    def value_at(self, iteration, epoch: int = 0):
+        import jax.numpy as jnp
+
+        return self.initial_value * self.decay_rate ** jnp.floor(iteration / self.step)
+
+
+@dataclass
+class ExponentialSchedule(ISchedule):
+    initial_value: float
+    gamma: float
+
+    def value_at(self, iteration, epoch: int = 0):
+        return self.initial_value * self.gamma ** iteration
+
+
+@dataclass
+class PolySchedule(ISchedule):
+    initial_value: float
+    power: float
+    max_iter: int
+
+    def value_at(self, iteration, epoch: int = 0):
+        import jax.numpy as jnp
+
+        frac = jnp.minimum(iteration / self.max_iter, 1.0)
+        return self.initial_value * (1.0 - frac) ** self.power
+
+
+@dataclass
+class InverseSchedule(ISchedule):
+    initial_value: float
+    gamma: float
+    power: float
+
+    def value_at(self, iteration, epoch: int = 0):
+        return self.initial_value / (1.0 + self.gamma * iteration) ** self.power
+
+
+@dataclass
+class SigmoidSchedule(ISchedule):
+    initial_value: float
+    gamma: float
+    step_size: int
+
+    def value_at(self, iteration, epoch: int = 0):
+        import jax.numpy as jnp
+
+        return self.initial_value / (1.0 + jnp.exp(self.gamma * (iteration - self.step_size)))
+
+
+@dataclass
+class CycleSchedule(ISchedule):
+    """1cycle-style: ramp up to max, back down, then annihilate."""
+
+    initial_value: float
+    max_value: float
+    cycle_length: int
+    annealing_cycles: float = 0.1
+
+    def value_at(self, iteration, epoch: int = 0):
+        import jax.numpy as jnp
+
+        up = self.cycle_length * (1.0 - self.annealing_cycles) / 2.0
+        pos = iteration % self.cycle_length
+        ramp_up = self.initial_value + (self.max_value - self.initial_value) * (pos / up)
+        ramp_down = self.max_value - (self.max_value - self.initial_value) * ((pos - up) / up)
+        anneal_start = 2 * up
+        anneal = self.initial_value * (1.0 - (pos - anneal_start) /
+                                       jnp.maximum(self.cycle_length - anneal_start, 1.0))
+        return jnp.where(pos < up, ramp_up, jnp.where(pos < anneal_start, ramp_down, anneal))

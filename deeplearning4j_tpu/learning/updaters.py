@@ -1,0 +1,280 @@
+"""Gradient updaters (optimizers).
+
+Reference: nd4j-api ``org.nd4j.linalg.learning.config.{Sgd,Adam,AdamW,
+Nesterovs,AdaGrad,AdaDelta,AdaMax,Nadam,AMSGrad,RmsProp,NoOp}`` + the stateful
+``GradientUpdater`` impls that call fused native updater kernels
+(``ops.impl.updaters.*``). Here each updater is a pure pytree transform —
+``init(params) -> state`` and ``apply(grads, state, params, iteration) ->
+(new_params, new_state)`` — that fuses into the compiled train step, which is
+exactly what the reference's fused native updater ops were approximating.
+
+Default hyperparameters match the reference config classes (e.g. Adam lr=1e-3,
+beta1=0.9, beta2=0.999, eps=1e-8; Nesterovs momentum=0.9; RmsProp decay=0.95).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from .schedules import ISchedule
+
+Pytree = Any
+
+
+def _lr_at(lr: Union[float, ISchedule], iteration):
+    if isinstance(lr, ISchedule):
+        return lr.value_at(iteration)
+    return lr
+
+
+class GradientUpdater:
+    """Base: stateless config; state is an explicit pytree."""
+
+    learning_rate: Union[float, ISchedule]
+
+    def init(self, params: Pytree) -> Pytree:
+        return {}
+
+    def apply(self, grads: Pytree, state: Pytree, params: Pytree, iteration):
+        raise NotImplementedError
+
+    # alias used by the training sessions
+    def update(self, grads, state, params, iteration):
+        return self.apply(grads, state, params, iteration)
+
+
+@dataclass
+class Sgd(GradientUpdater):
+    learning_rate: Union[float, ISchedule] = 1e-1
+
+    def apply(self, grads, state, params, iteration):
+        lr = _lr_at(self.learning_rate, iteration)
+        new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        return new_params, state
+
+
+@dataclass
+class NoOp(GradientUpdater):
+    learning_rate: Union[float, ISchedule] = 0.0
+
+    def apply(self, grads, state, params, iteration):
+        return params, state
+
+
+@dataclass
+class Nesterovs(GradientUpdater):
+    learning_rate: Union[float, ISchedule] = 0.1
+    momentum: float = 0.9
+
+    def init(self, params):
+        return {"v": jax.tree.map(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, params, iteration):
+        lr = _lr_at(self.learning_rate, iteration)
+        mu = self.momentum
+        # reference Nesterovs: vPrev = v; v = mu*v - lr*g; p += -mu*vPrev + (1+mu)*v
+        def upd(p, g, v):
+            v_new = mu * v - lr * g
+            p_new = p + (-mu * v + (1.0 + mu) * v_new)
+            return p_new, v_new
+
+        flat = jax.tree.map(upd, params, grads, state["v"])
+        new_params = jax.tree.map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
+        new_v = jax.tree.map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
+        return new_params, {"v": new_v}
+
+
+@dataclass
+class AdaGrad(GradientUpdater):
+    learning_rate: Union[float, ISchedule] = 1e-1
+    epsilon: float = 1e-6
+
+    def init(self, params):
+        return {"h": jax.tree.map(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, params, iteration):
+        lr = _lr_at(self.learning_rate, iteration)
+
+        def upd(p, g, h):
+            h_new = h + jnp.square(g)
+            p_new = p - lr * g / (jnp.sqrt(h_new) + self.epsilon)
+            return p_new, h_new
+
+        flat = jax.tree.map(upd, params, grads, state["h"])
+        return (jax.tree.map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple)),
+                {"h": jax.tree.map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))})
+
+
+@dataclass
+class AdaDelta(GradientUpdater):
+    rho: float = 0.95
+    epsilon: float = 1e-6
+    learning_rate: Union[float, ISchedule] = 1.0  # AdaDelta is LR-free
+
+    def init(self, params):
+        z = jax.tree.map(jnp.zeros_like, params)
+        return {"msg": z, "msdx": jax.tree.map(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, params, iteration):
+        rho, eps = self.rho, self.epsilon
+
+        def upd(p, g, msg, msdx):
+            msg_new = rho * msg + (1 - rho) * jnp.square(g)
+            dx = -jnp.sqrt(msdx + eps) / jnp.sqrt(msg_new + eps) * g
+            msdx_new = rho * msdx + (1 - rho) * jnp.square(dx)
+            return p + dx, msg_new, msdx_new
+
+        flat = jax.tree.map(upd, params, grads, state["msg"], state["msdx"])
+        pick = lambda i: jax.tree.map(lambda t: t[i], flat, is_leaf=lambda t: isinstance(t, tuple))
+        return pick(0), {"msg": pick(1), "msdx": pick(2)}
+
+
+@dataclass
+class RmsProp(GradientUpdater):
+    learning_rate: Union[float, ISchedule] = 1e-1
+    rms_decay: float = 0.95
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        return {"g2": jax.tree.map(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, params, iteration):
+        lr = _lr_at(self.learning_rate, iteration)
+        d = self.rms_decay
+
+        def upd(p, g, g2):
+            g2_new = d * g2 + (1 - d) * jnp.square(g)
+            return p - lr * g / (jnp.sqrt(g2_new) + self.epsilon), g2_new
+
+        flat = jax.tree.map(upd, params, grads, state["g2"])
+        pick = lambda i: jax.tree.map(lambda t: t[i], flat, is_leaf=lambda t: isinstance(t, tuple))
+        return pick(0), {"g2": pick(1)}
+
+
+@dataclass
+class Adam(GradientUpdater):
+    learning_rate: Union[float, ISchedule] = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        return {"m": jax.tree.map(jnp.zeros_like, params),
+                "v": jax.tree.map(jnp.zeros_like, params)}
+
+    def _moments(self, g, m, v):
+        m_new = self.beta1 * m + (1 - self.beta1) * g
+        v_new = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        return m_new, v_new
+
+    def apply(self, grads, state, params, iteration):
+        lr = _lr_at(self.learning_rate, iteration)
+        t = iteration + 1
+        bc1 = 1 - self.beta1 ** t
+        bc2 = 1 - self.beta2 ** t
+
+        def upd(p, g, m, v):
+            m_new, v_new = self._moments(g, m, v)
+            step = lr * (m_new / bc1) / (jnp.sqrt(v_new / bc2) + self.epsilon)
+            return p - step, m_new, v_new
+
+        flat = jax.tree.map(upd, params, grads, state["m"], state["v"])
+        pick = lambda i: jax.tree.map(lambda t_: t_[i], flat, is_leaf=lambda t_: isinstance(t_, tuple))
+        return pick(0), {"m": pick(1), "v": pick(2)}
+
+
+@dataclass
+class AdamW(Adam):
+    """Adam with decoupled weight decay (reference AdamW semantics)."""
+
+    weight_decay: float = 1e-2
+
+    def apply(self, grads, state, params, iteration):
+        lr = _lr_at(self.learning_rate, iteration)
+        t = iteration + 1
+        bc1 = 1 - self.beta1 ** t
+        bc2 = 1 - self.beta2 ** t
+
+        def upd(p, g, m, v):
+            m_new, v_new = self._moments(g, m, v)
+            step = lr * ((m_new / bc1) / (jnp.sqrt(v_new / bc2) + self.epsilon)
+                         + self.weight_decay * p)
+            return p - step, m_new, v_new
+
+        flat = jax.tree.map(upd, params, grads, state["m"], state["v"])
+        pick = lambda i: jax.tree.map(lambda t_: t_[i], flat, is_leaf=lambda t_: isinstance(t_, tuple))
+        return pick(0), {"m": pick(1), "v": pick(2)}
+
+
+@dataclass
+class AdaMax(Adam):
+    def apply(self, grads, state, params, iteration):
+        lr = _lr_at(self.learning_rate, iteration)
+        t = iteration + 1
+        bc1 = 1 - self.beta1 ** t
+
+        def upd(p, g, m, u):
+            m_new = self.beta1 * m + (1 - self.beta1) * g
+            u_new = jnp.maximum(self.beta2 * u, jnp.abs(g))
+            return p - lr * (m_new / bc1) / (u_new + self.epsilon), m_new, u_new
+
+        flat = jax.tree.map(upd, params, grads, state["m"], state["v"])
+        pick = lambda i: jax.tree.map(lambda t_: t_[i], flat, is_leaf=lambda t_: isinstance(t_, tuple))
+        return pick(0), {"m": pick(1), "v": pick(2)}
+
+
+@dataclass
+class Nadam(Adam):
+    def apply(self, grads, state, params, iteration):
+        lr = _lr_at(self.learning_rate, iteration)
+        t = iteration + 1
+        bc1 = 1 - self.beta1 ** t
+        bc2 = 1 - self.beta2 ** t
+
+        def upd(p, g, m, v):
+            m_new, v_new = self._moments(g, m, v)
+            m_hat = self.beta1 * m_new / bc1 + (1 - self.beta1) * g / bc1
+            return p - lr * m_hat / (jnp.sqrt(v_new / bc2) + self.epsilon), m_new, v_new
+
+        flat = jax.tree.map(upd, params, grads, state["m"], state["v"])
+        pick = lambda i: jax.tree.map(lambda t_: t_[i], flat, is_leaf=lambda t_: isinstance(t_, tuple))
+        return pick(0), {"m": pick(1), "v": pick(2)}
+
+
+@dataclass
+class AMSGrad(Adam):
+    def init(self, params):
+        z = jax.tree.map(jnp.zeros_like, params)
+        return {"m": z, "v": jax.tree.map(jnp.zeros_like, params),
+                "vhat": jax.tree.map(jnp.zeros_like, params)}
+
+    def apply(self, grads, state, params, iteration):
+        lr = _lr_at(self.learning_rate, iteration)
+        t = iteration + 1
+        bc1 = 1 - self.beta1 ** t
+        bc2 = 1 - self.beta2 ** t
+
+        def upd(p, g, m, v, vh):
+            m_new, v_new = self._moments(g, m, v)
+            vh_new = jnp.maximum(vh, v_new)
+            return (p - lr * (m_new / bc1) / (jnp.sqrt(vh_new / bc2) + self.epsilon),
+                    m_new, v_new, vh_new)
+
+        flat = jax.tree.map(upd, params, grads, state["m"], state["v"], state["vhat"])
+        pick = lambda i: jax.tree.map(lambda t_: t_[i], flat, is_leaf=lambda t_: isinstance(t_, tuple))
+        return pick(0), {"m": pick(1), "v": pick(2), "vhat": pick(3)}
+
+
+_BY_NAME = {
+    "sgd": Sgd, "adam": Adam, "adamw": AdamW, "nesterovs": Nesterovs,
+    "adagrad": AdaGrad, "adadelta": AdaDelta, "adamax": AdaMax, "nadam": Nadam,
+    "amsgrad": AMSGrad, "rmsprop": RmsProp, "noop": NoOp,
+}
+
+
+def updater_from_name(name: str, **kwargs) -> GradientUpdater:
+    return _BY_NAME[name.lower()](**kwargs)

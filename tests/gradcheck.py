@@ -1,0 +1,57 @@
+"""Central finite-difference gradient checker.
+
+Reference: ``org.deeplearning4j.gradientcheck.GradientCheckUtil`` (SURVEY.md
+§4.4): eps=1e-6, maxRelError=1e-3, fp64 enforced. Used by the autodiff and
+layer test suites.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import numpy as np
+
+EPS = 1e-6
+MAX_REL_ERROR = 1e-3
+MIN_ABS_ERROR = 1e-8
+
+
+def check_gradients(loss_fn: Callable[[Dict[str, np.ndarray]], float],
+                    params: Dict[str, np.ndarray],
+                    analytic: Dict[str, np.ndarray],
+                    eps: float = EPS,
+                    max_rel_error: float = MAX_REL_ERROR,
+                    sample: int = 64,
+                    seed: int = 0) -> None:
+    """Compare analytic grads vs central differences on sampled coordinates.
+
+    Sampling keeps runtime bounded like the reference's subset mode
+    (GradientCheckUtil supports per-parameter subsets for big nets).
+    """
+    rng = np.random.RandomState(seed)
+    for name, p in params.items():
+        p = np.asarray(p, dtype=np.float64)
+        a = np.asarray(analytic[name], dtype=np.float64)
+        assert a.shape == p.shape, f"{name}: grad shape {a.shape} != param {p.shape}"
+        n = p.size
+        coords = rng.choice(n, size=min(sample, n), replace=False)
+        flat = p.ravel()
+        for c in coords:
+            orig = flat[c]
+            mutated = dict(params)
+            plus = flat.copy()
+            plus[c] = orig + eps
+            mutated[name] = plus.reshape(p.shape)
+            f_plus = float(loss_fn(mutated))
+            minus = flat.copy()
+            minus[c] = orig - eps
+            mutated[name] = minus.reshape(p.shape)
+            f_minus = float(loss_fn(mutated))
+            numeric = (f_plus - f_minus) / (2 * eps)
+            ana = a.ravel()[c]
+            abs_err = abs(numeric - ana)
+            denom = max(abs(numeric), abs(ana))
+            rel_err = abs_err / denom if denom > 0 else 0.0
+            assert rel_err < max_rel_error or abs_err < MIN_ABS_ERROR, (
+                f"{name}[{c}]: analytic={ana:.8g} numeric={numeric:.8g} "
+                f"rel_err={rel_err:.3g}")

@@ -1,0 +1,463 @@
+"""SameDiff-analog tests: graph build, execution, autodiff (vs central finite
+differences — reference GradientCheckUtil settings), training convergence
+(XOR + MLP), serialization round-trip. Ports the concerns of the reference's
+``SameDiffTests`` / ``FlatBufferSerdeTests`` (SURVEY.md §4.2)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.autodiff import SameDiff, SDVariable, TrainingConfig
+from deeplearning4j_tpu.data import DataSet
+from deeplearning4j_tpu.learning import Adam, Sgd
+from gradcheck import check_gradients
+
+
+class TestGraphBuild:
+    def test_var_placeholder_const(self):
+        sd = SameDiff.create()
+        w = sd.var("w", shape=(3, 2))
+        x = sd.placeholder("x", shape=(None, 3))
+        c = sd.constant("c", np.ones((2,), np.float32))
+        assert w.var_type() == "VARIABLE"
+        assert x.var_type() == "PLACEHOLDER"
+        assert c.var_type() == "CONSTANT"
+        assert sd.variables() == ["w"]
+        assert sd.placeholders() == ["x"]
+
+    def test_unique_names(self):
+        sd = SameDiff.create()
+        a = sd.var("w", shape=(2,))
+        b = sd.var("w", shape=(2,))
+        assert a.name != b.name
+
+    def test_operators_build_graph(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(2, 2))
+        y = ((x + 1.0) * 2.0 - 0.5) / 4.0
+        out = y.eval({"x": np.zeros((2, 2), np.float32)})
+        np.testing.assert_allclose(out.to_numpy(), np.full((2, 2), 0.375), atol=1e-6)
+
+    def test_namespace_ops(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(3,))
+        y = sd.math.tanh(x)
+        z = sd.nn.relu(y)
+        data = np.array([-1.0, 0.0, 1.0], np.float32)
+        out = z.eval({"x": data})
+        np.testing.assert_allclose(out.to_numpy(), np.maximum(np.tanh(data), 0), atol=1e-6)
+
+    def test_unknown_op_raises(self):
+        sd = SameDiff.create()
+        with pytest.raises(KeyError):
+            sd.math.not_a_real_op
+
+    def test_matmul_chain(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(None, 4))
+        w = sd.var("w", shape=(4, 3), init="ones")
+        b = sd.var("b", shape=(3,), init="zeros")
+        out = (x @ w) + b
+        res = out.eval({"x": np.ones((2, 4), np.float32)})
+        np.testing.assert_allclose(res.to_numpy(), np.full((2, 3), 4.0), atol=1e-6)
+
+    def test_multi_output_op(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(4, 5))
+        mean, var = sd.math.moments(x, dims=(0,))
+        data = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+        m = mean.eval({"x": data})
+        np.testing.assert_allclose(m.to_numpy(), data.mean(0), atol=1e-5)
+        v = var.eval({"x": data})
+        np.testing.assert_allclose(v.to_numpy(), data.var(0), atol=1e-5)
+
+    def test_rename(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(2,))
+        y = (x * 2.0).rename("doubled")
+        out = sd.output({"x": np.ones(2, np.float32)}, ["doubled"])
+        np.testing.assert_allclose(out["doubled"].to_numpy(), [2.0, 2.0])
+
+    def test_summary(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(2,))
+        _ = x * 2.0
+        s = sd.summary()
+        assert "PLACEHOLDER" in s and "multiply" in s
+
+
+class TestExecution:
+    def test_whole_graph_single_module(self):
+        """The design claim: repeated eval reuses ONE compiled executable."""
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(None, 8))
+        w = sd.var("w", shape=(8, 8), init="xavier")
+        h = sd.math.tanh(x @ w)
+        out = sd.math.reduce_sum(h)
+        data = {"x": np.ones((4, 8), np.float32)}
+        first = out.eval(data)
+        assert len(sd._fn_cache) == 1
+        second = out.eval(data)
+        assert len(sd._fn_cache) == 1  # cache hit, no retrace
+        np.testing.assert_allclose(first.to_numpy(), second.to_numpy())
+
+    def test_dropout_train_vs_inference(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(1000,))
+        d = sd.nn.dropout(x, rate=0.5)
+        data = {"x": np.ones(1000, np.float32)}
+        inf = sd.output(data, [d.name], training=False)[d.name].to_numpy()
+        np.testing.assert_allclose(inf, 1.0)  # identity at inference
+        trn = sd.output(data, [d.name], training=True)[d.name].to_numpy()
+        assert (trn == 0).sum() > 300  # stochastic in training
+
+    def test_random_op_varies_per_call(self):
+        sd = SameDiff.create()
+        r = sd.random_ops.random_normal(shape=(100,))
+        a = r.eval({}).to_numpy()
+        b = r.eval({}).to_numpy()
+        assert not np.allclose(a, b)
+
+
+class TestAutodiff:
+    def test_simple_grad(self):
+        sd = SameDiff.create()
+        w = sd.var("w", init=np.array([2.0, 3.0], np.float64))
+        loss = sd.math.reduce_sum(w * w)
+        grads = sd.calculate_gradients({}, loss.name)
+        np.testing.assert_allclose(grads["w"].to_numpy(), [4.0, 6.0], atol=1e-6)
+
+    def test_gradcheck_mlp(self):
+        """Finite-difference check, fp64, reference GradientCheckUtil params."""
+        rng = np.random.RandomState(7)
+        sd = SameDiff.create()
+        x_data = rng.randn(4, 5)
+        y_data = np.eye(3)[rng.randint(0, 3, 4)]
+        x = sd.constant("x", x_data)
+        y = sd.constant("y", y_data)
+        w1 = sd.var("w1", init=rng.randn(5, 8) * 0.5)
+        b1 = sd.var("b1", init=rng.randn(8) * 0.1)
+        w2 = sd.var("w2", init=rng.randn(8, 3) * 0.5)
+        b2 = sd.var("b2", init=rng.randn(3) * 0.1)
+        h = sd.math.tanh((x @ w1) + b1)
+        logits = (h @ w2) + b2
+        loss = sd.loss_ops.softmax_cross_entropy(logits, y)
+        grads = sd.calculate_gradients({}, loss.name)
+
+        def loss_fn(params):
+            h_ = np.tanh(x_data @ params["w1"] + params["b1"])
+            lg = h_ @ params["w2"] + params["b2"]
+            lse = lg - lg.max(-1, keepdims=True)
+            logp = lse - np.log(np.exp(lse).sum(-1, keepdims=True))
+            return -(y_data * logp).sum(-1).mean()
+
+        params = {n: np.asarray(sd._vars[n].value, np.float64) for n in sd.variables()}
+        analytic = {n: g.to_numpy() for n, g in grads.items()}
+        check_gradients(loss_fn, params, analytic)
+
+    def test_gradcheck_through_ops(self):
+        """Grad flows through conv/pool/norm compositions."""
+        rng = np.random.RandomState(3)
+        sd = SameDiff.create()
+        x = sd.constant("x", rng.randn(2, 3, 8, 8))
+        w = sd.var("w", init=rng.randn(4, 3, 3, 3) * 0.3)
+        conv = sd.cnn.conv2d(x, w, strides=(1, 1), padding=(1, 1))
+        act = sd.math.tanh(conv)
+        pooled = sd.cnn.maxpool2d(act, kernel=(2, 2), strides=(2, 2))
+        loss = sd.math.reduce_mean(sd.math.square(pooled))
+        grads = sd.calculate_gradients({}, loss.name)
+        g = grads["w"].to_numpy()
+        assert g.shape == (4, 3, 3, 3)
+        assert np.abs(g).max() > 0  # nonzero flow
+
+        from deeplearning4j_tpu.ops import exec_op
+        import jax
+
+        x_const = jnp.asarray(np.asarray(sd._vars["x"].value))
+
+        def loss_fn(params):
+            out = exec_op("conv2d", x_const,
+                          jnp.asarray(params["w"]), strides=(1, 1), padding=(1, 1))
+            out = jnp.tanh(out)
+            out = exec_op("maxpool2d", out, kernel=(2, 2), strides=(2, 2))
+            return float(jnp.mean(jnp.square(out)))
+
+        check_gradients(loss_fn, {"w": np.asarray(sd._vars["w"].value, np.float64)},
+                        {"w": g}, sample=24)
+
+    def test_grad_wrt_subset(self):
+        sd = SameDiff.create()
+        a = sd.var("a", init=np.array([1.0]))
+        b = sd.var("b", init=np.array([2.0]))
+        loss = sd.math.reduce_sum(a * b)
+        grads = sd.calculate_gradients({}, loss.name, wrt=["a"])
+        assert set(grads) == {"a"}
+        np.testing.assert_allclose(grads["a"].to_numpy(), [2.0])
+
+
+class TestTraining:
+    def test_xor_converges(self):
+        """The M2 exit criterion (SURVEY.md §7.2): XOR converges."""
+        rng = np.random.RandomState(0)
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(None, 2))
+        y = sd.placeholder("y", shape=(None, 2))
+        w1 = sd.var("w1", init=rng.randn(2, 8).astype(np.float32) * 0.7)
+        b1 = sd.var("b1", shape=(8,), init="zeros")
+        w2 = sd.var("w2", init=rng.randn(8, 2).astype(np.float32) * 0.7)
+        b2 = sd.var("b2", shape=(2,), init="zeros")
+        h = sd.math.tanh((x @ w1) + b1)
+        logits = ((h @ w2) + b2).rename("logits")
+        loss = sd.loss_ops.softmax_cross_entropy(logits, y).rename("loss")
+        sd.set_loss_variables("loss")
+        sd.set_training_config(TrainingConfig(updater=Adam(learning_rate=0.1),
+                                              loss_name="loss"))
+        features = np.array([[0, 0], [0, 1], [1, 0], [1, 1]], np.float32)
+        labels = np.array([[1, 0], [0, 1], [0, 1], [1, 0]], np.float32)
+        history = sd.fit(DataSet(features, labels), epochs=200)
+        assert history.final_loss() < 0.05, history.loss_curve()[-5:]
+        preds = sd.output({"x": features}, ["logits"])["logits"].to_numpy()
+        assert (preds.argmax(1) == labels.argmax(1)).all()
+
+    def test_l2_regularization_shrinks_weights(self):
+        sd = SameDiff.create()
+        w = sd.var("w", init=np.full((4,), 5.0, np.float32))
+        x = sd.placeholder("x", shape=(4,))
+        loss = sd.math.reduce_sum(w * x * 0.0).rename("loss")  # loss indep of w
+        sd.set_training_config(TrainingConfig(updater=Sgd(learning_rate=0.1),
+                                              l2=0.1, loss_name="loss"))
+        sd.fit(DataSet(np.zeros((1, 4), np.float32)[0:1],
+                       np.zeros((1, 4), np.float32)), epochs=5,
+               feature_placeholder="x", label_placeholder=None)
+        # only the l2 term drives updates: weights must shrink toward 0
+        assert np.abs(sd._vars["w"].value).max() < 5.0
+
+    def test_updater_state_persists_across_fit_calls(self):
+        sd = SameDiff.create()
+        w = sd.var("w", init=np.array([1.0], np.float32))
+        x = sd.placeholder("x", shape=(None, 1))
+        loss = sd.math.reduce_sum((x * w) * (x * w)).rename("loss")
+        sd.set_training_config(TrainingConfig(updater=Adam(learning_rate=0.05),
+                                              loss_name="loss"))
+        ds = DataSet(np.ones((2, 1), np.float32), np.zeros((2, 1), np.float32))
+        sd.fit(ds, epochs=1)
+        st = sd._updater_state
+        assert st is not None and float(np.abs(st["m"]["w"]).sum()) > 0
+        sd.fit(ds, epochs=1)
+        assert sd._iteration == 2
+
+
+class TestSerde:
+    def test_round_trip(self, tmp_path):
+        rng = np.random.RandomState(1)
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(None, 3))
+        w = sd.var("w", init=rng.randn(3, 4).astype(np.float32))
+        b = sd.var("b", shape=(4,), init="zeros")
+        out = sd.math.sigmoid((x @ w) + b).rename("out")
+        data = {"x": rng.randn(2, 3).astype(np.float32)}
+        expected = out.eval(data).to_numpy()
+
+        path = str(tmp_path / "model.sdz")
+        sd.save(path)
+        sd2 = SameDiff.load(path)
+        got = sd2.output(data, ["out"])["out"].to_numpy()
+        np.testing.assert_allclose(got, expected, atol=1e-6)
+
+    def test_round_trip_with_training_config(self, tmp_path):
+        sd = SameDiff.create()
+        w = sd.var("w", init=np.ones((2,), np.float32))
+        loss = sd.math.reduce_sum(w * w).rename("loss")
+        sd.set_training_config(TrainingConfig(updater=Adam(learning_rate=0.02),
+                                              l2=0.01, loss_name="loss"))
+        path = str(tmp_path / "m.sdz")
+        sd.save(path, save_updater_state=True)
+        sd2 = SameDiff.load(path)
+        assert sd2._training_config.l2 == 0.01
+        assert sd2._training_config.updater.learning_rate == 0.02
+        assert type(sd2._training_config.updater).__name__ == "Adam"
+
+    def test_version_gate(self, tmp_path):
+        import json
+        import zipfile
+
+        sd = SameDiff.create()
+        sd.var("w", shape=(1,))
+        path = str(tmp_path / "m.sdz")
+        sd.save(path)
+        # corrupt the version
+        import io as _io
+
+        with zipfile.ZipFile(path) as zf:
+            graph = json.loads(zf.read("graph.json"))
+            vars_npz = zf.read("vars.npz")
+        graph["format_version"] = 999
+        with zipfile.ZipFile(path, "w") as zf:
+            zf.writestr("graph.json", json.dumps(graph))
+            zf.writestr("vars.npz", vars_npz)
+        with pytest.raises(ValueError, match="newer format"):
+            SameDiff.load(path)
+
+
+class TestUpdaters:
+    """Updater math sanity — each updater reduces a simple quadratic."""
+
+    @pytest.mark.parametrize("updater_name", [
+        "sgd", "adam", "adamw", "nesterovs", "adagrad", "adadelta",
+        "adamax", "nadam", "amsgrad", "rmsprop"])
+    def test_quadratic_descent(self, updater_name):
+        from deeplearning4j_tpu.learning import updater_from_name
+
+        upd = updater_from_name(updater_name)
+        steps = 300
+        if updater_name == "adadelta":
+            steps = 3000  # LR-free; ramps slowly by design
+        elif updater_name == "adagrad":
+            upd.learning_rate = 1.0  # effective LR decays as 1/sqrt(sum g^2)
+            steps = 1000
+        else:
+            upd.learning_rate = 0.1
+        params = {"w": jnp.asarray(np.array([3.0, -2.0], np.float32))}
+        state = upd.init(params)
+        for t in range(steps):
+            grads = {"w": 2 * params["w"]}
+            params, state = upd.apply(grads, state, params, t)
+        final = float(jnp.abs(params["w"]).max())
+        assert final < 0.5, f"{updater_name}: {params['w']}"
+
+    def test_noop(self):
+        from deeplearning4j_tpu.learning import NoOp
+
+        upd = NoOp()
+        params = {"w": jnp.ones(3)}
+        new_params, _ = upd.apply({"w": jnp.ones(3)}, upd.init(params), params, 0)
+        np.testing.assert_allclose(np.asarray(new_params["w"]), 1.0)
+
+    def test_schedules(self):
+        from deeplearning4j_tpu.learning import (ExponentialSchedule, FixedSchedule,
+                                                 InverseSchedule, PolySchedule,
+                                                 SigmoidSchedule, StepSchedule)
+
+        assert float(FixedSchedule(0.1)(100)) == pytest.approx(0.1)
+        assert float(StepSchedule(1.0, 0.5, 10)(25)) == pytest.approx(0.25)
+        assert float(ExponentialSchedule(1.0, 0.9)(2)) == pytest.approx(0.81)
+        assert float(PolySchedule(1.0, 2.0, 100)(50)) == pytest.approx(0.25)
+        assert float(InverseSchedule(1.0, 1.0, 1.0)(1)) == pytest.approx(0.5)
+        s = SigmoidSchedule(1.0, 0.5, 10)
+        assert float(s(0)) > 0.9 and float(s(20)) < 0.1
+
+
+class TestReviewRegressions:
+    """Round-1 code-review findings on the autodiff layer."""
+
+    def test_fit_explicit_feature_placeholder_not_clobbered(self):
+        rng = np.random.RandomState(0)
+        sd = SameDiff.create()
+        y = sd.placeholder("y", shape=(None, 2))      # labels FIRST
+        x = sd.placeholder("x", shape=(None, 2))
+        w = sd.var("w", init=rng.randn(2, 2).astype(np.float32))
+        loss = sd.loss_ops.mean_sqerr_loss(x @ w, y).rename("loss")
+        sd.set_training_config(TrainingConfig(updater=Sgd(learning_rate=0.1),
+                                              loss_name="loss"))
+        ds = DataSet(np.ones((4, 2), np.float32), np.zeros((4, 2), np.float32))
+        # explicit feature binding must survive even though phs order is [y, x]
+        h = sd.fit(ds, epochs=30, feature_placeholder="x", label_placeholder="y")
+        assert h.final_loss() < 0.05
+
+    def test_namespace_static_args_stay_static(self):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(6,))
+        reshaped = sd.math.reshape(x, (2, 3))
+        out = reshaped.eval({"x": np.arange(6, dtype=np.float32)})
+        assert out.shape == (2, 3)
+        s = sd.math.reduce_sum(x, 0)
+        assert float(s.eval({"x": np.ones(6, np.float32)}).get_double()) == 6.0
+
+    def test_static_args_survive_serde(self, tmp_path):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(6,))
+        out = sd.math.reshape(x, (2, 3)).rename("out")
+        path = str(tmp_path / "m.sdz")
+        sd.save(path)
+        sd2 = SameDiff.load(path)
+        got = sd2.output({"x": np.arange(6, dtype=np.float32)}, ["out"])["out"]
+        assert got.shape == (2, 3)
+
+    def test_unique_name_no_collision_after_load(self, tmp_path):
+        sd = SameDiff.create()
+        x = sd.placeholder("x", shape=(2,))
+        _ = x + 1.0  # 'add'
+        _ = x + 2.0  # 'add_1'
+        path = str(tmp_path / "m.sdz")
+        sd.save(path)
+        sd2 = SameDiff.load(path)
+        x2 = SDVariable(sd2, "x")
+        v = x2 + 3.0  # must NOT collide with existing 'add'/'add_1'
+        assert v.name not in ("add", "add_1")
+        assert len({n for n in sd2._vars}) == len(sd2._vars)
+
+    def test_unique_name_explicit_suffix_collision(self):
+        sd = SameDiff.create()
+        a = sd.placeholder("x_1", shape=(1,))
+        b = sd.placeholder("x", shape=(1,))
+        c = sd.placeholder("x", shape=(1,))
+        assert len({a.name, b.name, c.name}) == 3
+
+    def test_schedule_survives_training_config_serde(self, tmp_path):
+        from deeplearning4j_tpu.learning import StepSchedule
+
+        sd = SameDiff.create()
+        sd.var("w", shape=(1,))
+        sd.set_training_config(TrainingConfig(
+            updater=Adam(learning_rate=StepSchedule(0.1, 0.5, 1000)),
+            loss_name="loss"))
+        path = str(tmp_path / "m.sdz")
+        sd.save(path)
+        sd2 = SameDiff.load(path)
+        lr = sd2._training_config.updater.learning_rate
+        assert isinstance(lr, StepSchedule)
+        assert lr.initial_value == 0.1 and lr.step == 1000
+
+    def test_calculate_gradients_cached(self):
+        sd = SameDiff.create()
+        w = sd.var("w", init=np.array([2.0], np.float32))
+        loss = sd.math.reduce_sum(w * w).rename("loss")
+        sd.calculate_gradients({}, "loss")
+        n_cached = len(sd._fn_cache)
+        sd.calculate_gradients({}, "loss")
+        assert len(sd._fn_cache) == n_cached  # second call hits the cache
+
+    def test_empty_epoch_raises(self):
+        class EmptyIter:
+            def reset(self):
+                pass
+
+            def __iter__(self):
+                return iter([])
+
+        sd = SameDiff.create()
+        w = sd.var("w", shape=(1,))
+        x = sd.placeholder("x", shape=(1,))
+        loss = (w * x).sum().rename("loss")
+        sd.set_training_config(TrainingConfig(loss_name="loss"))
+        with pytest.raises(ValueError, match="no batches"):
+            sd.fit(EmptyIter(), epochs=1)
+
+    def test_dataset_save_load_extensionless(self, tmp_path):
+        ds = DataSet(np.ones((2, 3), np.float32), np.zeros((2, 1), np.float32))
+        p = str(tmp_path / "data")  # no .npz
+        ds.save(p)
+        back = DataSet.load(p)
+        np.testing.assert_allclose(back.features.to_numpy(), 1.0)
+
+    def test_merge_carries_masks(self):
+        a = DataSet(np.ones((2, 3, 4), np.float32), np.ones((2, 3), np.float32),
+                    features_mask=np.ones((2, 3), np.float32))
+        b = DataSet(np.zeros((1, 3, 4), np.float32), np.zeros((1, 3), np.float32),
+                    features_mask=np.zeros((1, 3), np.float32))
+        m = DataSet.merge([a, b])
+        assert m.features_mask is not None
+        assert m.features_mask.shape == (3, 3)
