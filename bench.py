@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Benchmark entry point (driver contract): prints ONE JSON line
+{"metric", "value", "unit", "vs_baseline"}.
+
+Current flagship config: LeNet/MNIST training throughput via
+MultiLayerNetwork.fit() on the default device (TPU under the driver;
+BASELINE.json configs[0]). vs_baseline compares against the reference-shaped
+CPU measurement recorded in BASELINE.md (the reference publishes no numbers —
+SURVEY.md §6 — so the CPU run of this same config is the baseline ledger row).
+
+Usage: python bench.py [--config lenet] [--steps N]
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+# Baseline ledger (see BASELINE.md "Measured" table). The LeNet row is this
+# same config measured with JAX_PLATFORMS=cpu on the build machine.
+BASELINES = {
+    "lenet_mnist_train": {"value": None, "unit": "images/sec"},  # filled below
+}
+# Measured 2026-07-29 on the build container CPU (see BASELINE.md):
+BASELINES["lenet_mnist_train"]["value"] = 1470.0
+
+
+def bench_lenet(steps: int) -> dict:
+    import jax
+
+    from deeplearning4j_tpu.data import MnistDataSetIterator
+    from deeplearning4j_tpu.learning import Nesterovs
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf import layers as L
+
+    batch = 128
+    conf = (NeuralNetConfiguration.builder()
+            .seed(123)
+            .updater(Nesterovs(learning_rate=0.01, momentum=0.9))
+            .activation("relu")
+            .weight_init("xavier")
+            .list()
+            .layer(L.ConvolutionLayer(n_out=20, kernel_size=(5, 5)))
+            .layer(L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(L.ConvolutionLayer(n_out=50, kernel_size=(5, 5)))
+            .layer(L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(L.DenseLayer(n_out=500))
+            .layer(L.OutputLayer(n_out=10, loss="mcxent", activation="softmax"))
+            .set_input_type(InputType.convolutional(28, 28, 1))
+            .build())
+    model = MultiLayerNetwork(conf).init()
+    it = MnistDataSetIterator(batch_size=batch, train=True,
+                              num_examples=batch * max(steps, 8), flatten=False)
+    # trim to full batches: a trailing partial batch would retrace the train
+    # step inside the timed region and skew the denominator
+    n_batches = it.total_examples() // batch
+    it.features = it.features[:n_batches * batch]
+    it.labels = it.labels[:n_batches * batch]
+
+    # warmup: first fit compiles the train-step module
+    warm = MnistDataSetIterator(batch_size=batch, train=True, num_examples=batch * 2,
+                                flatten=False)
+    model.fit(warm, epochs=1)
+
+    t0 = time.perf_counter()
+    model.fit(it, epochs=1)
+    # block on final params so the clock includes all device work
+    jax.block_until_ready(model._params)
+    dt = time.perf_counter() - t0
+    imgs_per_sec = n_batches * batch / dt
+    return {"metric": "lenet_mnist_train", "value": imgs_per_sec,
+            "unit": "images/sec"}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", default="lenet", choices=["lenet"])
+    parser.add_argument("--steps", type=int, default=64)
+    args = parser.parse_args()
+
+    if args.config == "lenet":
+        result = bench_lenet(args.steps)
+
+    base = BASELINES.get(result["metric"], {}).get("value")
+    result["vs_baseline"] = (result["value"] / base) if base else 1.0
+    print(json.dumps({"metric": result["metric"],
+                      "value": round(result["value"], 2),
+                      "unit": result["unit"],
+                      "vs_baseline": round(result["vs_baseline"], 3)}))
+
+
+if __name__ == "__main__":
+    main()

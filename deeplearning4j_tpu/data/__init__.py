@@ -1,1 +1,5 @@
 from .dataset import DataSet, MultiDataSet
+from .iterators import (DataSetIterator, NDArrayDataSetIterator, ExistingDataSetIterator,
+                        MultipleEpochsIterator, MnistDataSetIterator, IrisDataSetIterator)
+from .normalizers import (NormalizerStandardize, NormalizerMinMaxScaler,
+                          ImagePreProcessingScaler, normalizer_from_json)

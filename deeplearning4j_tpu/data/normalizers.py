@@ -1,0 +1,138 @@
+"""Data normalizers.
+
+Reference: nd4j-api ``org.nd4j.linalg.dataset.api.preprocessor.{
+NormalizerStandardize, NormalizerMinMaxScaler, ImagePreProcessingScaler}``
+(SURVEY.md §2.1): fit statistics once, transform per batch, serializable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .dataset import DataSet
+from ..ndarray.ndarray import NDArray
+
+
+class Normalizer:
+    def fit(self, data) -> None:
+        raise NotImplementedError
+
+    def transform(self, ds: DataSet) -> None:
+        raise NotImplementedError
+
+    def pre_process(self, ds: DataSet) -> None:
+        self.transform(ds)
+
+    def to_json(self) -> dict:
+        raise NotImplementedError
+
+
+class NormalizerStandardize(Normalizer):
+    def __init__(self):
+        self.mean: Optional[np.ndarray] = None
+        self.std: Optional[np.ndarray] = None
+
+    def fit(self, data) -> None:
+        feats = _collect_features(data)
+        axes = tuple(i for i in range(feats.ndim) if i != 1) if feats.ndim > 2 else (0,)
+        self.mean = feats.mean(axis=axes)
+        self.std = feats.std(axis=axes) + 1e-8
+
+    def transform(self, ds: DataSet) -> None:
+        x = ds.features.to_numpy()
+        shape = [1] * x.ndim
+        shape[1 if x.ndim > 2 else -1] = -1
+        ds.features = NDArray((x - self.mean.reshape(shape)) / self.std.reshape(shape))
+
+    def revert_features(self, arr: NDArray) -> NDArray:
+        x = arr.to_numpy()
+        shape = [1] * x.ndim
+        shape[1 if x.ndim > 2 else -1] = -1
+        return NDArray(x * self.std.reshape(shape) + self.mean.reshape(shape))
+
+    def to_json(self) -> dict:
+        return {"type": "standardize", "mean": self.mean.tolist(),
+                "std": self.std.tolist()}
+
+
+class NormalizerMinMaxScaler(Normalizer):
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0):
+        self.min_range = min_range
+        self.max_range = max_range
+        self.data_min: Optional[np.ndarray] = None
+        self.data_max: Optional[np.ndarray] = None
+
+    def fit(self, data) -> None:
+        feats = _collect_features(data)
+        if feats.ndim == 2:
+            # per-column stats (reference NormalizerMinMaxScaler contract)
+            self.data_min = feats.min(axis=0)
+            self.data_max = feats.max(axis=0)
+        else:
+            # images/sequences: global range (per-pixel ranges are meaningless)
+            self.data_min = np.asarray(feats.min())
+            self.data_max = np.asarray(feats.max())
+
+    def transform(self, ds: DataSet) -> None:
+        x = ds.features.to_numpy()
+        span = np.maximum(self.data_max - self.data_min, 1e-8)
+        scale = (self.max_range - self.min_range) / span
+        ds.features = NDArray((x - self.data_min) * scale + self.min_range)
+
+    def to_json(self) -> dict:
+        return {"type": "minmax", "data_min": np.asarray(self.data_min).tolist(),
+                "data_max": np.asarray(self.data_max).tolist(),
+                "min_range": self.min_range, "max_range": self.max_range}
+
+
+class ImagePreProcessingScaler(Normalizer):
+    """Scale raw pixel [0, maxValue] → [min, max] (default [0,1])."""
+
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0,
+                 max_pixel: float = 255.0):
+        self.min_range = min_range
+        self.max_range = max_range
+        self.max_pixel = max_pixel
+
+    def fit(self, data) -> None:
+        pass  # stateless
+
+    def transform(self, ds: DataSet) -> None:
+        x = ds.features.to_numpy().astype(np.float32)
+        ds.features = NDArray(x / self.max_pixel * (self.max_range - self.min_range)
+                              + self.min_range)
+
+    def to_json(self) -> dict:
+        return {"type": "image", "min_range": self.min_range,
+                "max_range": self.max_range, "max_pixel": self.max_pixel}
+
+
+def normalizer_from_json(d: dict) -> Normalizer:
+    t = d["type"]
+    if t == "standardize":
+        n = NormalizerStandardize()
+        n.mean = np.asarray(d["mean"])
+        n.std = np.asarray(d["std"])
+        return n
+    if t == "minmax":
+        n = NormalizerMinMaxScaler(d["min_range"], d["max_range"])
+        n.data_min = np.asarray(d["data_min"])
+        n.data_max = np.asarray(d["data_max"])
+        return n
+    if t == "image":
+        return ImagePreProcessingScaler(d["min_range"], d["max_range"], d["max_pixel"])
+    raise ValueError(f"unknown normalizer type {t!r}")
+
+
+def _collect_features(data) -> np.ndarray:
+    if isinstance(data, DataSet):
+        return data.features.to_numpy()
+    # iterator
+    parts = []
+    data.reset()
+    for ds in data:
+        parts.append(ds.features.to_numpy())
+    data.reset()
+    return np.concatenate(parts)

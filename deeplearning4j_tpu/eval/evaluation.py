@@ -1,0 +1,295 @@
+"""Evaluation suite — streaming, mergeable metrics.
+
+Reference: nd4j-api ``org.nd4j.evaluation.classification.{Evaluation,
+EvaluationBinary, ROC, ROCBinary, ROCMultiClass, EvaluationCalibration}`` and
+``regression.RegressionEvaluation`` (SURVEY.md §2.1). All accumulate
+incrementally over minibatches and merge across workers (the Spark-reducible
+contract — here, mergeable across data-parallel hosts).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class Evaluation:
+    """Multi-class classification metrics over one-hot or index labels."""
+
+    def __init__(self, num_classes: Optional[int] = None, top_n: int = 1):
+        self.num_classes = num_classes
+        self.top_n = top_n
+        self.confusion: Optional[np.ndarray] = None
+        self.top_n_correct = 0
+        self.count = 0
+
+    # ------------------------------------------------------------------
+    def eval(self, labels, predictions, mask=None) -> None:
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:  # time series [B,T,C] → flatten with mask
+            b, t, c = labels.shape
+            labels = labels.reshape(-1, c)
+            predictions = predictions.reshape(-1, c)
+            if mask is not None:
+                m = np.asarray(mask).reshape(-1).astype(bool)
+                labels, predictions = labels[m], predictions[m]
+        elif mask is not None:  # per-example mask on plain batches
+            m = np.asarray(mask).reshape(-1).astype(bool)
+            labels, predictions = labels[m], predictions[m]
+        if labels.ndim == 2:
+            true_idx = labels.argmax(1)
+            n_cls = labels.shape[1]
+        else:
+            true_idx = labels.astype(int)
+            n_cls = int(predictions.shape[-1])
+        pred_idx = predictions.argmax(1)
+        if self.confusion is None:
+            self.num_classes = self.num_classes or n_cls
+            self.confusion = np.zeros((self.num_classes, self.num_classes), np.int64)
+        np.add.at(self.confusion, (true_idx, pred_idx), 1)
+        self.count += len(true_idx)
+        if self.top_n > 1:
+            top = np.argsort(-predictions, axis=1)[:, :self.top_n]
+            self.top_n_correct += int((top == true_idx[:, None]).any(1).sum())
+        else:
+            self.top_n_correct += int((pred_idx == true_idx).sum())
+
+    def merge(self, other: "Evaluation") -> "Evaluation":
+        if self.confusion is None:
+            self.confusion = other.confusion
+            self.num_classes = other.num_classes
+        elif other.confusion is not None:
+            self.confusion = self.confusion + other.confusion
+        self.count += other.count
+        self.top_n_correct += other.top_n_correct
+        return self
+
+    # --- metrics -------------------------------------------------------
+    def accuracy(self) -> float:
+        if self.count == 0:
+            return 0.0
+        return float(np.trace(self.confusion)) / self.count
+
+    def top_n_accuracy(self) -> float:
+        return self.top_n_correct / self.count if self.count else 0.0
+
+    def _tp(self) -> np.ndarray:
+        return np.diag(self.confusion).astype(np.float64)
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        col = self.confusion.sum(0).astype(np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            per = np.where(col > 0, self._tp() / col, 0.0)
+        return float(per[cls]) if cls is not None else float(per[col > 0].mean() if (col > 0).any() else 0.0)
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        row = self.confusion.sum(1).astype(np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            per = np.where(row > 0, self._tp() / row, 0.0)
+        return float(per[cls]) if cls is not None else float(per[row > 0].mean() if (row > 0).any() else 0.0)
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        p, r = self.precision(cls), self.recall(cls)
+        return 2 * p * r / (p + r) if (p + r) > 0 else 0.0
+
+    def matthews_correlation(self) -> float:
+        """Binary MCC from the confusion matrix."""
+        c = self.confusion
+        if c.shape != (2, 2):
+            raise ValueError("MCC defined for binary confusion only")
+        tn, fp, fn, tp = c[0, 0], c[0, 1], c[1, 0], c[1, 1]
+        denom = np.sqrt(float((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn)))
+        return float((tp * tn - fp * fn) / denom) if denom > 0 else 0.0
+
+    def stats(self) -> str:
+        lines = [
+            f"# examples: {self.count}",
+            f"Accuracy:  {self.accuracy():.4f}",
+            f"Precision: {self.precision():.4f}",
+            f"Recall:    {self.recall():.4f}",
+            f"F1:        {self.f1():.4f}",
+        ]
+        if self.top_n > 1:
+            lines.append(f"Top-{self.top_n} accuracy: {self.top_n_accuracy():.4f}")
+        lines.append("Confusion matrix (rows=actual):")
+        lines.append(str(self.confusion))
+        return "\n".join(lines)
+
+
+class EvaluationBinary:
+    """Per-output independent binary metrics (multi-label)."""
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+        self.tp = self.fp = self.tn = self.fn = None
+
+    def eval(self, labels, predictions, mask=None) -> None:
+        labels = np.asarray(labels)
+        preds = (np.asarray(predictions) >= self.threshold).astype(np.int64)
+        lab = (labels >= 0.5).astype(np.int64)
+        if mask is not None:
+            m = np.asarray(mask).astype(bool)
+        else:
+            m = np.ones_like(lab, bool)
+        tp = ((preds == 1) & (lab == 1) & m).sum(0)
+        fp = ((preds == 1) & (lab == 0) & m).sum(0)
+        tn = ((preds == 0) & (lab == 0) & m).sum(0)
+        fn = ((preds == 0) & (lab == 1) & m).sum(0)
+        if self.tp is None:
+            self.tp, self.fp, self.tn, self.fn = tp, fp, tn, fn
+        else:
+            self.tp += tp
+            self.fp += fp
+            self.tn += tn
+            self.fn += fn
+
+    def merge(self, other: "EvaluationBinary") -> "EvaluationBinary":
+        for attr in ("tp", "fp", "tn", "fn"):
+            mine, theirs = getattr(self, attr), getattr(other, attr)
+            setattr(self, attr, theirs if mine is None else mine + theirs)
+        return self
+
+    def accuracy(self, output: int) -> float:
+        tot = self.tp[output] + self.fp[output] + self.tn[output] + self.fn[output]
+        return float(self.tp[output] + self.tn[output]) / tot if tot else 0.0
+
+    def precision(self, output: int) -> float:
+        d = self.tp[output] + self.fp[output]
+        return float(self.tp[output]) / d if d else 0.0
+
+    def recall(self, output: int) -> float:
+        d = self.tp[output] + self.fn[output]
+        return float(self.tp[output]) / d if d else 0.0
+
+    def f1(self, output: int) -> float:
+        p, r = self.precision(output), self.recall(output)
+        return 2 * p * r / (p + r) if (p + r) > 0 else 0.0
+
+
+class ROC:
+    """Binary ROC/AUC + precision-recall, exact mode (threshold=0 analog of the
+    reference's exact AUC; thresholded mode via `num_thresholds`)."""
+
+    def __init__(self, num_thresholds: int = 0):
+        self.num_thresholds = num_thresholds
+        self._scores: List[np.ndarray] = []
+        self._labels: List[np.ndarray] = []
+
+    def eval(self, labels, predictions, mask=None) -> None:
+        labels = np.asarray(labels)
+        preds = np.asarray(predictions)
+        if labels.ndim == 2 and labels.shape[1] == 2:
+            labels = labels[:, 1]
+            preds = preds[:, 1]
+        self._labels.append(labels.ravel())
+        self._scores.append(preds.ravel())
+
+    def merge(self, other: "ROC") -> "ROC":
+        self._labels.extend(other._labels)
+        self._scores.extend(other._scores)
+        return self
+
+    def _collect(self):
+        return np.concatenate(self._labels), np.concatenate(self._scores)
+
+    def calculate_auc(self) -> float:
+        y, s = self._collect()
+        order = np.argsort(-s, kind="mergesort")
+        y = y[order]
+        tps = np.cumsum(y)
+        fps = np.cumsum(1 - y)
+        p, n = y.sum(), (1 - y).sum()
+        if p == 0 or n == 0:
+            return 0.0
+        tpr = np.concatenate([[0], tps / p])
+        fpr = np.concatenate([[0], fps / n])
+        return float(np.trapezoid(tpr, fpr))
+
+    def calculate_auprc(self) -> float:
+        y, s = self._collect()
+        order = np.argsort(-s, kind="mergesort")
+        y = y[order]
+        tps = np.cumsum(y)
+        precision = tps / np.arange(1, len(y) + 1)
+        recall = tps / max(y.sum(), 1)
+        return float(np.sum(np.diff(np.concatenate([[0], recall])) * precision))
+
+
+class ROCMultiClass:
+    """One-vs-all ROC per class."""
+
+    def __init__(self):
+        self._rocs: Dict[int, ROC] = {}
+
+    def eval(self, labels, predictions, mask=None) -> None:
+        labels = np.asarray(labels)
+        preds = np.asarray(predictions)
+        for c in range(labels.shape[1]):
+            self._rocs.setdefault(c, ROC()).eval(labels[:, c], preds[:, c])
+
+    def calculate_auc(self, cls: int) -> float:
+        return self._rocs[cls].calculate_auc()
+
+    def calculate_average_auc(self) -> float:
+        return float(np.mean([r.calculate_auc() for r in self._rocs.values()]))
+
+
+class RegressionEvaluation:
+    """Per-column MSE/MAE/RMSE/R²/correlation (reference RegressionEvaluation)."""
+
+    def __init__(self):
+        self.n = 0
+        self.sum_err2 = None
+        self.sum_abs = None
+        self.sum_label = None
+        self.sum_label2 = None
+        self.sum_pred = None
+        self.sum_pred2 = None
+        self.sum_lp = None
+
+    def eval(self, labels, predictions, mask=None) -> None:
+        l = np.asarray(labels, np.float64)
+        p = np.asarray(predictions, np.float64)
+        if l.ndim == 1:
+            l, p = l[:, None], p[:, None]
+        err = p - l
+        add = lambda cur, v: v if cur is None else cur + v
+        self.sum_err2 = add(self.sum_err2, (err ** 2).sum(0))
+        self.sum_abs = add(self.sum_abs, np.abs(err).sum(0))
+        self.sum_label = add(self.sum_label, l.sum(0))
+        self.sum_label2 = add(self.sum_label2, (l ** 2).sum(0))
+        self.sum_pred = add(self.sum_pred, p.sum(0))
+        self.sum_pred2 = add(self.sum_pred2, (p ** 2).sum(0))
+        self.sum_lp = add(self.sum_lp, (l * p).sum(0))
+        self.n += l.shape[0]
+
+    def merge(self, other: "RegressionEvaluation") -> "RegressionEvaluation":
+        for attr in ("sum_err2", "sum_abs", "sum_label", "sum_label2",
+                     "sum_pred", "sum_pred2", "sum_lp"):
+            mine, theirs = getattr(self, attr), getattr(other, attr)
+            setattr(self, attr, theirs if mine is None else mine + theirs)
+        self.n += other.n
+        return self
+
+    def mean_squared_error(self, col: int = 0) -> float:
+        return float(self.sum_err2[col] / self.n)
+
+    def mean_absolute_error(self, col: int = 0) -> float:
+        return float(self.sum_abs[col] / self.n)
+
+    def root_mean_squared_error(self, col: int = 0) -> float:
+        return float(np.sqrt(self.mean_squared_error(col)))
+
+    def r_squared(self, col: int = 0) -> float:
+        ss_tot = self.sum_label2[col] - self.sum_label[col] ** 2 / self.n
+        ss_res = self.sum_err2[col]
+        return float(1.0 - ss_res / ss_tot) if ss_tot > 0 else 0.0
+
+    def pearson_correlation(self, col: int = 0) -> float:
+        cov = self.sum_lp[col] - self.sum_label[col] * self.sum_pred[col] / self.n
+        vl = self.sum_label2[col] - self.sum_label[col] ** 2 / self.n
+        vp = self.sum_pred2[col] - self.sum_pred[col] ** 2 / self.n
+        d = np.sqrt(vl * vp)
+        return float(cov / d) if d > 0 else 0.0

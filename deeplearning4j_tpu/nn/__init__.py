@@ -1,0 +1,4 @@
+from .multilayer import MultiLayerNetwork
+from .conf.builder import NeuralNetConfiguration, MultiLayerConfiguration
+from .conf.inputs import InputType
+from .conf import layers

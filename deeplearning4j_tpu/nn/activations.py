@@ -1,0 +1,49 @@
+"""Activation resolution.
+
+Reference: nd4j-api ``org.nd4j.linalg.activations.Activation`` enum — the
+config-level names users write. Each resolves to a registered op
+(ops/transforms.py holds the math + the IActivation forward set).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..ops.registry import get_op
+
+# Activation enum name (reference spelling, lowercased) → op name
+_ACTIVATION_OPS = {
+    "relu": "relu",
+    "relu6": "relu6",
+    "leakyrelu": "leakyrelu",
+    "prelu": "prelu",
+    "rrelu": "leakyrelu",          # randomized leak: inference form
+    "thresholdedrelu": "thresholdedrelu",
+    "elu": "elu",
+    "selu": "selu",
+    "gelu": "gelu",
+    "mish": "mish",
+    "swish": "swish",
+    "sigmoid": "sigmoid",
+    "hardsigmoid": "hardsigmoid",
+    "tanh": "tanh",
+    "hardtanh": "hardtanh",
+    "rationaltanh": "rationaltanh",
+    "rectifiedtanh": "rectifiedtanh",
+    "softmax": "softmax",
+    "softplus": "softplus",
+    "softsign": "softsign",
+    "cube": "cube",
+    "identity": "identity",
+}
+
+
+def activation_fn(name: str) -> Callable:
+    name = name.lower()
+    if name not in _ACTIVATION_OPS:
+        raise ValueError(f"unknown activation {name!r}; known: {sorted(_ACTIVATION_OPS)}")
+    return get_op(_ACTIVATION_OPS[name]).fn
+
+
+def is_known(name: str) -> bool:
+    return name.lower() in _ACTIVATION_OPS

@@ -1,0 +1,3 @@
+from .builder import NeuralNetConfiguration, MultiLayerConfiguration, Builder, ListBuilder
+from .inputs import InputType
+from . import layers

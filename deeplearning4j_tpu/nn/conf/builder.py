@@ -1,0 +1,265 @@
+"""Network configuration builders.
+
+Reference: dl4j-nn ``org.deeplearning4j.nn.conf.NeuralNetConfiguration.Builder``
+→ ``.list()`` → ``MultiLayerConfiguration`` (SURVEY.md §2.3): global defaults
+(updater, weight init, activation, l1/l2, seed) cascade onto layers that don't
+set their own; ``setInputType`` walks the layer list inferring nIn and
+inserting preprocessors. Configs serialize to JSON and are the model file's
+topology section (ModelSerializer contract, §5.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from ...learning.schedules import ISchedule
+from ...learning.updaters import GradientUpdater, Sgd, _BY_NAME as _UPDATERS
+from ..losses import ILossFunction
+from . import layers as L
+from .inputs import (CNNFlatInput, CNNInput, FFInput, InputType, Preprocessor,
+                     RNNInput, cnn_to_ff, flat_to_cnn, rnn_to_ff)
+
+
+@dataclass
+class GlobalConf:
+    seed: int = 12345
+    updater: GradientUpdater = field(default_factory=lambda: Sgd(1e-1))
+    weight_init: str = "xavier"
+    activation: str = "identity"
+    l1: float = 0.0
+    l2: float = 0.0
+    dropout: float = 0.0
+    grad_normalization: Optional[str] = None      # clip modes
+    grad_norm_threshold: float = 1.0
+    dtype: str = "float32"
+
+
+class NeuralNetConfiguration:
+    @staticmethod
+    def builder() -> "Builder":
+        return Builder()
+
+
+class Builder:
+    def __init__(self) -> None:
+        self._conf = GlobalConf()
+
+    def seed(self, s: int) -> "Builder":
+        self._conf.seed = int(s)
+        return self
+
+    def updater(self, u: GradientUpdater) -> "Builder":
+        self._conf.updater = u
+        return self
+
+    def weight_init(self, w: str) -> "Builder":
+        self._conf.weight_init = w
+        return self
+
+    def activation(self, a: str) -> "Builder":
+        self._conf.activation = a
+        return self
+
+    def l1(self, v: float) -> "Builder":
+        self._conf.l1 = v
+        return self
+
+    def l2(self, v: float) -> "Builder":
+        self._conf.l2 = v
+        return self
+
+    def dropout(self, v: float) -> "Builder":
+        self._conf.dropout = v
+        return self
+
+    def gradient_normalization(self, mode: str, threshold: float = 1.0) -> "Builder":
+        self._conf.grad_normalization = mode
+        self._conf.grad_norm_threshold = threshold
+        return self
+
+    def data_type(self, dtype: str) -> "Builder":
+        self._conf.dtype = dtype
+        return self
+
+    def list(self) -> "ListBuilder":
+        return ListBuilder(self._conf)
+
+
+class ListBuilder:
+    def __init__(self, conf: GlobalConf) -> None:
+        self._conf = conf
+        self._layers: List[L.Layer] = []
+        self._input_type: Optional[InputType] = None
+
+    def layer(self, idx_or_layer, maybe_layer: Optional[L.Layer] = None) -> "ListBuilder":
+        layer = maybe_layer if maybe_layer is not None else idx_or_layer
+        self._layers.append(layer)
+        return self
+
+    def set_input_type(self, input_type: InputType) -> "ListBuilder":
+        self._input_type = input_type
+        return self
+
+    setInputType = set_input_type
+
+    def build(self) -> "MultiLayerConfiguration":
+        # cascade global defaults
+        for l in self._layers:
+            self._apply_defaults(l)
+        mlc = MultiLayerConfiguration(self._conf, self._layers)
+        if self._input_type is not None:
+            mlc.set_input_type(self._input_type)
+        return mlc
+
+    def _apply_defaults(self, l: L.Layer) -> None:
+        if l.activation is None and not isinstance(l, (L.OutputLayer, L.LossLayer)):
+            l.activation = self._conf.activation
+        if l.weight_init is None:
+            l.weight_init = self._conf.weight_init
+        if l.l1 is None:
+            l.l1 = self._conf.l1
+        if l.l2 is None:
+            l.l2 = self._conf.l2
+        if l.dropout is None:
+            l.dropout = self._conf.dropout
+        inner = getattr(l, "layer", None)
+        if isinstance(inner, L.Layer):
+            self._apply_defaults(inner)
+
+
+class MultiLayerConfiguration:
+    def __init__(self, global_conf: GlobalConf, layers: List[L.Layer]):
+        self.global_conf = global_conf
+        self.layers = layers
+        self.preprocessors: Dict[int, Preprocessor] = {}
+        self.input_type: Optional[InputType] = None
+        self.layer_output_types: List[InputType] = []
+
+    # --- shape inference + preprocessor insertion -----------------------
+    def set_input_type(self, input_type: InputType) -> None:
+        self.input_type = input_type
+        self.preprocessors = {}
+        self.layer_output_types = []
+        cur = input_type
+        for i, layer in enumerate(self.layers):
+            pre = self._preprocessor_for(cur, layer)
+            if pre is not None:
+                self.preprocessors[i] = pre
+                cur = pre.out_type
+            cur = layer.set_input_type(cur)
+            self.layer_output_types.append(cur)
+
+    @staticmethod
+    def _preprocessor_for(cur: InputType, layer: L.Layer) -> Optional[Preprocessor]:
+        ff_like = (L.DenseLayer, L.OutputLayer, L.ElementWiseMultiplicationLayer)
+        if isinstance(cur, CNNFlatInput):
+            return flat_to_cnn(cur)
+        if isinstance(cur, CNNInput) and isinstance(layer, ff_like) \
+                and not isinstance(layer, L.RnnOutputLayer):
+            return cnn_to_ff(cur)
+        if isinstance(cur, RNNInput) and isinstance(layer, L.DenseLayer) \
+                and not isinstance(layer, (L.OutputLayer,)):
+            return rnn_to_ff(cur)
+        return None
+
+    # --- serde -----------------------------------------------------------
+    def to_json(self) -> str:
+        return json.dumps({
+            "format_version": 1,
+            "global": _ser_obj(self.global_conf),
+            "layers": [_ser_obj(l) for l in self.layers],
+            "input_type": _ser_obj(self.input_type) if self.input_type else None,
+        }, indent=2)
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        d = json.loads(s)
+        gc = _deser_obj(d["global"])
+        layers = [_deser_obj(ld) for ld in d["layers"]]
+        mlc = MultiLayerConfiguration(gc, layers)
+        if d.get("input_type"):
+            mlc.set_input_type(_deser_obj(d["input_type"]))
+        return mlc
+
+
+# --- generic dataclass (de)serialization for configs -------------------------
+
+_CLASSES: Dict[str, type] = {}
+for _mod in (L,):
+    for _name in dir(_mod):
+        _obj = getattr(_mod, _name)
+        if isinstance(_obj, type) and dataclasses.is_dataclass(_obj):
+            _CLASSES[_name] = _obj
+_CLASSES["GlobalConf"] = GlobalConf
+from .inputs import FFInput as _FF, RNNInput as _RNN, CNNInput as _CNN, CNNFlatInput as _CNNF  # noqa: E402
+for _c in (_FF, _RNN, _CNN, _CNNF):
+    _CLASSES[_c.__name__] = _c
+from ...learning import schedules as _sched_mod  # noqa: E402
+for _name in dir(_sched_mod):
+    _obj = getattr(_sched_mod, _name)
+    if isinstance(_obj, type) and dataclasses.is_dataclass(_obj):
+        _CLASSES[_name] = _obj
+from ...learning import updaters as _upd_mod  # noqa: E402
+for _name in dir(_upd_mod):
+    _obj = getattr(_upd_mod, _name)
+    if isinstance(_obj, type) and dataclasses.is_dataclass(_obj):
+        _CLASSES[_name] = _obj
+from .. import losses as _loss_mod  # noqa: E402
+for _name in dir(_loss_mod):
+    _obj = getattr(_loss_mod, _name)
+    if isinstance(_obj, type) and issubclass(_obj, ILossFunction) and _obj is not ILossFunction:
+        _CLASSES[_name] = _obj
+
+
+def _ser_obj(obj: Any) -> Any:
+    if obj is None or isinstance(obj, (int, float, str, bool)):
+        return obj
+    if isinstance(obj, (list, tuple)):
+        return {"__tuple__": [_ser_obj(v) for v in obj]} if isinstance(obj, tuple) \
+            else [_ser_obj(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return {"__ndarray__": obj.tolist(), "dtype": str(obj.dtype)}
+    if isinstance(obj, ILossFunction):
+        return {"__class__": type(obj).__name__,
+                "fields": {k: _ser_obj(v) for k, v in obj.__dict__.items()}}
+    if dataclasses.is_dataclass(obj):
+        fields = {f.name: _ser_obj(getattr(obj, f.name))
+                  for f in dataclasses.fields(obj)}
+        return {"__class__": type(obj).__name__, "fields": fields}
+    if isinstance(obj, GradientUpdater):
+        return {"__class__": type(obj).__name__,
+                "fields": {k: _ser_obj(v) for k, v in obj.__dict__.items()}}
+    raise TypeError(f"cannot serialize config object {type(obj)}")
+
+
+def _deser_obj(d: Any) -> Any:
+    if d is None or isinstance(d, (int, float, str, bool)):
+        return d
+    if isinstance(d, list):
+        return [_deser_obj(v) for v in d]
+    if isinstance(d, dict):
+        if "__tuple__" in d:
+            return tuple(_deser_obj(v) for v in d["__tuple__"])
+        if "__ndarray__" in d:
+            return np.asarray(d["__ndarray__"], dtype=d["dtype"])
+        if "__class__" in d:
+            cls = _CLASSES[d["__class__"]]
+            fields = {k: _deser_obj(v) for k, v in d["fields"].items()}
+            if dataclasses.is_dataclass(cls):
+                known = {f.name for f in dataclasses.fields(cls)}
+                init_args = {k: v for k, v in fields.items() if k in known}
+                obj = cls(**init_args)
+                for k, v in fields.items():
+                    if k not in known:
+                        setattr(obj, k, v)
+                return obj
+            obj = cls.__new__(cls)
+            obj.__dict__.update(fields)
+            return obj
+        return {k: _deser_obj(v) for k, v in d.items()}
+    return d

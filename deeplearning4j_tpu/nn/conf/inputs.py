@@ -1,0 +1,105 @@
+"""Input type system + preprocessors.
+
+Reference: dl4j-nn ``org.deeplearning4j.nn.conf.inputs.InputType`` (FF / RNN /
+CNN / CNNFlat) and ``org.deeplearning4j.nn.conf.preprocessor.*``
+(CnnToFeedForwardPreProcessor etc.). ``setInputType`` on the builder walks the
+layer list, infers nIn for each layer, and inserts preprocessors at
+representation boundaries — same contract here.
+
+Data formats (TPU-first divergence, documented): CNN activations are NCHW like
+the reference; RNN activations are **[batch, time, size]** (time-major middle)
+rather than DL4J's [batch, size, time] — batch-leading time series map better
+onto lax.scan and keep the feature dim minor for the VPU. Masks are [batch,
+time].
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple
+
+import jax.numpy as jnp
+
+
+class InputType:
+    @staticmethod
+    def feed_forward(size: int) -> "FFInput":
+        return FFInput(size)
+
+    @staticmethod
+    def recurrent(size: int, timesteps: Optional[int] = None) -> "RNNInput":
+        return RNNInput(size, timesteps)
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "CNNInput":
+        return CNNInput(channels, height, width)
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int, channels: int) -> "CNNFlatInput":
+        return CNNFlatInput(channels, height, width)
+
+
+@dataclass(frozen=True)
+class FFInput(InputType):
+    size: int
+
+
+@dataclass(frozen=True)
+class RNNInput(InputType):
+    size: int
+    timesteps: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class CNNInput(InputType):
+    channels: int
+    height: int
+    width: int
+
+
+@dataclass(frozen=True)
+class CNNFlatInput(InputType):
+    channels: int
+    height: int
+    width: int
+
+
+@dataclass
+class Preprocessor:
+    """Shape adapter inserted between layers (InputPreProcessor analog)."""
+
+    name: str
+    fn: Callable
+    out_type: InputType
+
+    def __call__(self, x):
+        return self.fn(x)
+
+
+def cnn_to_ff(t: CNNInput) -> Preprocessor:
+    size = t.channels * t.height * t.width
+    return Preprocessor("CnnToFeedForward",
+                        lambda x: x.reshape(x.shape[0], -1), FFInput(size))
+
+
+def ff_to_cnn(t: FFInput, c: int, h: int, w: int) -> Preprocessor:
+    return Preprocessor("FeedForwardToCnn",
+                        lambda x: x.reshape(x.shape[0], c, h, w), CNNInput(c, h, w))
+
+
+def flat_to_cnn(t: CNNFlatInput) -> Preprocessor:
+    c, h, w = t.channels, t.height, t.width
+    return Preprocessor("CnnFlatToCnn",
+                        lambda x: x.reshape(x.shape[0], c, h, w), CNNInput(c, h, w))
+
+
+def rnn_to_ff(t: RNNInput) -> Preprocessor:
+    """[B, T, F] -> [B*T, F] (per-timestep dense application)."""
+    return Preprocessor("RnnToFeedForward",
+                        lambda x: x.reshape(-1, x.shape[-1]), FFInput(t.size))
+
+
+def ff_to_rnn(t: FFInput, timesteps: int) -> Preprocessor:
+    return Preprocessor("FeedForwardToRnn",
+                        lambda x: x.reshape(-1, timesteps, x.shape[-1]),
+                        RNNInput(t.size, timesteps))

@@ -1,0 +1,760 @@
+"""Layer configurations + their pure-function runtime.
+
+Reference: dl4j-nn ``org.deeplearning4j.nn.conf.layers.*`` (the ~60 config
+classes, SURVEY.md §2.3) merged with their runtime twins in
+``org.deeplearning4j.nn.layers.**``. The reference splits config (Jackson
+beans) from runtime (INDArray code); here each dataclass carries both: the
+config fields plus ``init_params`` / ``apply`` pure functions that trace into
+the one compiled train-step module. Param layouts follow the reference
+ParamInitializers: dense W=[nIn,nOut], conv W=[out,in,kH,kW] (OIHW),
+bias=[nOut].
+
+Every ``apply`` is functional: (params, x, state, training, rng) -> (y, state)
+where ``state`` carries batchnorm running stats (the only stateful layer).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.registry import get_op
+from ..activations import activation_fn
+from ..losses import ILossFunction, LossMCXENT, loss_from_name
+from ..weights import init_weights
+from .inputs import CNNInput, FFInput, InputType, RNNInput
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+@dataclass
+class Layer:
+    """Base layer config. Fields that default to None inherit the network's
+    global defaults (NeuralNetConfiguration.Builder contract)."""
+
+    name: Optional[str] = None
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    # Input dropout RATE (fraction dropped). None = inherit the builder's
+    # global dropout; 0.0 = explicitly disabled.
+    dropout: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+
+    # filled by the builder
+    n_in: Optional[int] = None
+
+    def set_input_type(self, input_type: InputType) -> InputType:
+        """Infer nIn from the incoming type; return this layer's output type."""
+        return input_type
+
+    def init_params(self, key: jax.Array, dtype=jnp.float32) -> Dict[str, jnp.ndarray]:
+        return {}
+
+    def init_state(self) -> Dict[str, jnp.ndarray]:
+        return {}
+
+    def apply(self, params, x, state, training: bool, rng):
+        raise NotImplementedError
+
+    def _maybe_dropout(self, x, training: bool, rng):
+        if training and self.dropout and self.dropout > 0.0:
+            return get_op("dropout").fn(x, rng, rate=self.dropout)
+        return x
+
+    @property
+    def has_params(self) -> bool:
+        return True
+
+
+@dataclass
+class DenseLayer(Layer):
+    """Reference conf.layers.DenseLayer → layers.feedforward.dense."""
+
+    n_out: int = 0
+    has_bias: bool = True
+
+    def set_input_type(self, input_type):
+        if isinstance(input_type, FFInput):
+            self.n_in = input_type.size
+        else:
+            raise ValueError(f"DenseLayer needs FF input, got {input_type}")
+        return FFInput(self.n_out)
+
+    def init_params(self, key, dtype=jnp.float32):
+        kw, _ = jax.random.split(key)
+        p = {"W": init_weights(kw, (self.n_in, self.n_out),
+                               self.weight_init or "xavier", dtype)}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,), dtype)
+        return p
+
+    def apply(self, params, x, state, training, rng):
+        x = self._maybe_dropout(x, training, rng)
+        out = x @ params["W"]
+        if self.has_bias:
+            out = out + params["b"]
+        return activation_fn(self.activation or "identity")(out), state
+
+
+@dataclass
+class ConvolutionLayer(Layer):
+    """Reference conf.layers.ConvolutionLayer (2D). W=[out,in,kH,kW]."""
+
+    n_out: int = 0
+    kernel_size: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Union[Tuple[int, int], str] = (0, 0)
+    dilation: Tuple[int, int] = (1, 1)
+    convolution_mode: str = "truncate"   # truncate | same (reference ConvolutionMode)
+    has_bias: bool = True
+
+    def _padding(self):
+        return "SAME" if self.convolution_mode.lower() == "same" else self.padding
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, CNNInput):
+            raise ValueError(f"ConvolutionLayer needs CNN input, got {input_type}")
+        self.n_in = input_type.channels
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        dh, dw = _pair(self.dilation)
+        if self.convolution_mode.lower() == "same":
+            oh = -(-input_type.height // sh)
+            ow = -(-input_type.width // sw)
+        else:
+            ph, pw = _pair(self.padding) if not isinstance(self.padding, str) else (0, 0)
+            eff_kh, eff_kw = (kh - 1) * dh + 1, (kw - 1) * dw + 1
+            oh = (input_type.height + 2 * ph - eff_kh) // sh + 1
+            ow = (input_type.width + 2 * pw - eff_kw) // sw + 1
+        return CNNInput(self.n_out, oh, ow)
+
+    def init_params(self, key, dtype=jnp.float32):
+        kh, kw = _pair(self.kernel_size)
+        p = {"W": init_weights(key, (self.n_out, self.n_in, kh, kw),
+                               self.weight_init or "xavier", dtype)}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,), dtype)
+        return p
+
+    def apply(self, params, x, state, training, rng):
+        x = self._maybe_dropout(x, training, rng)
+        out = get_op("conv2d").fn(x, params["W"], params.get("b"),
+                                  strides=_pair(self.stride), padding=self._padding(),
+                                  dilation=_pair(self.dilation))
+        return activation_fn(self.activation or "identity")(out), state
+
+
+@dataclass
+class Deconvolution2D(ConvolutionLayer):
+    """Reference conf.layers.Deconvolution2D. W=[in,out,kH,kW]."""
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, CNNInput):
+            raise ValueError("Deconvolution2D needs CNN input")
+        self.n_in = input_type.channels
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        if self.convolution_mode.lower() == "same":
+            oh, ow = input_type.height * sh, input_type.width * sw
+        else:
+            ph, pw = _pair(self.padding) if not isinstance(self.padding, str) else (0, 0)
+            oh = sh * (input_type.height - 1) + kh - 2 * ph
+            ow = sw * (input_type.width - 1) + kw - 2 * pw
+        return CNNInput(self.n_out, oh, ow)
+
+    def init_params(self, key, dtype=jnp.float32):
+        kh, kw = _pair(self.kernel_size)
+        p = {"W": init_weights(key, (self.n_in, self.n_out, kh, kw),
+                               self.weight_init or "xavier", dtype)}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,), dtype)
+        return p
+
+    def apply(self, params, x, state, training, rng):
+        x = self._maybe_dropout(x, training, rng)
+        out = get_op("deconv2d").fn(x, params["W"], params.get("b"),
+                                    strides=_pair(self.stride), padding=self._padding())
+        return activation_fn(self.activation or "identity")(out), state
+
+
+@dataclass
+class DepthwiseConvolution2D(ConvolutionLayer):
+    """Reference conf.layers.DepthwiseConvolution2D. W=[mult,C,kH,kW]."""
+
+    depth_multiplier: int = 1
+
+    def set_input_type(self, input_type):
+        out_type = ConvolutionLayer.set_input_type(self, input_type)
+        return CNNInput(self.n_in * self.depth_multiplier, out_type.height, out_type.width)
+
+    def init_params(self, key, dtype=jnp.float32):
+        kh, kw = _pair(self.kernel_size)
+        p = {"W": init_weights(key, (self.depth_multiplier, self.n_in, kh, kw),
+                               self.weight_init or "xavier", dtype)}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_in * self.depth_multiplier,), dtype)
+        return p
+
+    def apply(self, params, x, state, training, rng):
+        x = self._maybe_dropout(x, training, rng)
+        out = get_op("depthwise_conv2d").fn(x, params["W"], params.get("b"),
+                                            strides=_pair(self.stride),
+                                            padding=self._padding(),
+                                            dilation=_pair(self.dilation))
+        return activation_fn(self.activation or "identity")(out), state
+
+
+@dataclass
+class SeparableConvolution2D(ConvolutionLayer):
+    """Reference conf.layers.SeparableConvolution2D: depthwise + pointwise."""
+
+    depth_multiplier: int = 1
+
+    def init_params(self, key, dtype=jnp.float32):
+        kd, kp = jax.random.split(key)
+        kh, kw = _pair(self.kernel_size)
+        p = {
+            "dW": init_weights(kd, (self.depth_multiplier, self.n_in, kh, kw),
+                               self.weight_init or "xavier", dtype),
+            "pW": init_weights(kp, (self.n_out, self.n_in * self.depth_multiplier, 1, 1),
+                               self.weight_init or "xavier", dtype),
+        }
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,), dtype)
+        return p
+
+    def apply(self, params, x, state, training, rng):
+        x = self._maybe_dropout(x, training, rng)
+        out = get_op("sconv2d").fn(x, params["dW"], params["pW"], params.get("b"),
+                                   strides=_pair(self.stride), padding=self._padding())
+        return activation_fn(self.activation or "identity")(out), state
+
+
+@dataclass
+class SubsamplingLayer(Layer):
+    """Reference conf.layers.SubsamplingLayer (max/avg/pnorm pooling)."""
+
+    pooling_type: str = "max"
+    kernel_size: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (2, 2)
+    padding: Tuple[int, int] = (0, 0)
+    convolution_mode: str = "truncate"
+    pnorm: int = 2
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, CNNInput):
+            raise ValueError("SubsamplingLayer needs CNN input")
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        if self.convolution_mode.lower() == "same":
+            oh = -(-input_type.height // sh)
+            ow = -(-input_type.width // sw)
+        else:
+            ph, pw = _pair(self.padding)
+            oh = (input_type.height + 2 * ph - kh) // sh + 1
+            ow = (input_type.width + 2 * pw - kw) // sw + 1
+        return CNNInput(input_type.channels, oh, ow)
+
+    def apply(self, params, x, state, training, rng):
+        pad = "SAME" if self.convolution_mode.lower() == "same" else _pair(self.padding)
+        kind = self.pooling_type.lower()
+        if kind == "max":
+            out = get_op("maxpool2d").fn(x, _pair(self.kernel_size), _pair(self.stride), pad)
+        elif kind in ("avg", "average"):
+            out = get_op("avgpool2d").fn(x, _pair(self.kernel_size), _pair(self.stride), pad)
+        elif kind == "pnorm":
+            out = get_op("pnormpool2d").fn(x, _pair(self.kernel_size), _pair(self.stride),
+                                           pad, pnorm=self.pnorm)
+        else:
+            raise ValueError(f"unknown pooling type {self.pooling_type!r}")
+        return out, state
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
+class BatchNormalization(Layer):
+    """Reference conf.layers.BatchNormalization: per-channel normalization with
+    running-mean/var state (decay), trainable gamma/beta."""
+
+    decay: float = 0.9
+    eps: float = 1e-5
+    lock_gamma_beta: bool = False
+
+    def set_input_type(self, input_type):
+        if isinstance(input_type, CNNInput):
+            self.n_in = input_type.channels
+        elif isinstance(input_type, FFInput):
+            self.n_in = input_type.size
+        else:
+            raise ValueError("BatchNormalization needs FF or CNN input")
+        return input_type
+
+    def init_params(self, key, dtype=jnp.float32):
+        if self.lock_gamma_beta:
+            return {}
+        return {"gamma": jnp.ones((self.n_in,), dtype),
+                "beta": jnp.zeros((self.n_in,), dtype)}
+
+    def init_state(self):
+        return {"mean": jnp.zeros((self.n_in,), jnp.float32),
+                "var": jnp.ones((self.n_in,), jnp.float32)}
+
+    def apply(self, params, x, state, training, rng):
+        axes = (0, 2, 3) if x.ndim == 4 else (0,)
+        gamma = params.get("gamma")
+        beta = params.get("beta")
+        if training:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean.astype(jnp.float32),
+                "var": self.decay * state["var"] + (1 - self.decay) * var.astype(jnp.float32),
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        axis = 1 if x.ndim == 4 else -1
+        out = get_op("batchnorm").fn(x, mean.astype(x.dtype), var.astype(x.dtype),
+                                     gamma, beta, epsilon=self.eps, axis=axis)
+        return activation_fn(self.activation or "identity")(out), new_state
+
+
+@dataclass
+class LocalResponseNormalization(Layer):
+    n: int = 5
+    k: float = 2.0
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def apply(self, params, x, state, training, rng):
+        out = get_op("lrn").fn(x, depth=self.n, bias=self.k,
+                               alpha=self.alpha / self.n, beta=self.beta)
+        return out, state
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
+class DropoutLayer(Layer):
+    rate: float = 0.5
+
+    def apply(self, params, x, state, training, rng):
+        if training and self.rate > 0:
+            return get_op("dropout").fn(x, rng, rate=self.rate), state
+        return x, state
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
+class ActivationLayer(Layer):
+    def apply(self, params, x, state, training, rng):
+        return activation_fn(self.activation or "identity")(x), state
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
+class PReLULayer(Layer):
+    """Learned leak parameter, per-feature (reference PReLULayer)."""
+
+    def set_input_type(self, input_type):
+        if isinstance(input_type, FFInput):
+            self.n_in = input_type.size
+        elif isinstance(input_type, CNNInput):
+            self.n_in = input_type.channels
+        return input_type
+
+    def init_params(self, key, dtype=jnp.float32):
+        return {"alpha": jnp.zeros((self.n_in,), dtype)}
+
+    def apply(self, params, x, state, training, rng):
+        a = params["alpha"]
+        if x.ndim == 4:
+            a = a.reshape(1, -1, 1, 1)
+        return get_op("prelu").fn(x, a), state
+
+
+@dataclass
+class Upsampling2D(Layer):
+    size: Tuple[int, int] = (2, 2)
+
+    def set_input_type(self, input_type):
+        fh, fw = _pair(self.size)
+        return CNNInput(input_type.channels, input_type.height * fh, input_type.width * fw)
+
+    def apply(self, params, x, state, training, rng):
+        return get_op("upsampling2d").fn(x, factor=_pair(self.size)), state
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
+class ZeroPaddingLayer(Layer):
+    padding: Tuple[int, int, int, int] = (1, 1, 1, 1)  # top,bottom,left,right
+
+    def set_input_type(self, input_type):
+        t, b, l, r = self.padding
+        return CNNInput(input_type.channels, input_type.height + t + b,
+                        input_type.width + l + r)
+
+    def apply(self, params, x, state, training, rng):
+        t, b, l, r = self.padding
+        return jnp.pad(x, ((0, 0), (0, 0), (t, b), (l, r))), state
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
+class Cropping2D(Layer):
+    cropping: Tuple[int, int, int, int] = (0, 0, 0, 0)
+
+    def set_input_type(self, input_type):
+        t, b, l, r = self.cropping
+        return CNNInput(input_type.channels, input_type.height - t - b,
+                        input_type.width - l - r)
+
+    def apply(self, params, x, state, training, rng):
+        t, b, l, r = self.cropping
+        h, w = x.shape[2], x.shape[3]
+        return x[:, :, t:h - b, l:w - r], state
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
+class GlobalPoolingLayer(Layer):
+    """Reference conf.layers.GlobalPoolingLayer: pools CNN spatial dims or RNN
+    time dim (mask-aware) down to FF."""
+
+    pooling_type: str = "max"
+
+    def set_input_type(self, input_type):
+        if isinstance(input_type, CNNInput):
+            self._mode = "cnn"
+            return FFInput(input_type.channels)
+        if isinstance(input_type, RNNInput):
+            self._mode = "rnn"
+            return FFInput(input_type.size)
+        raise ValueError("GlobalPoolingLayer needs CNN or RNN input")
+
+    def apply(self, params, x, state, training, rng, mask=None):
+        kind = self.pooling_type.lower()
+        if x.ndim == 4:
+            axes = (2, 3)
+        else:  # [B, T, F]
+            axes = (1,)
+        if kind == "max":
+            out = jnp.max(x, axis=axes)
+        elif kind in ("avg", "average"):
+            if mask is not None and x.ndim == 3:
+                m = mask[..., None]
+                out = jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1e-9)
+            else:
+                out = jnp.mean(x, axis=axes)
+        elif kind == "sum":
+            out = jnp.sum(x, axis=axes)
+        elif kind == "pnorm":
+            out = jnp.sum(jnp.abs(x) ** 2, axis=axes) ** 0.5
+        else:
+            raise ValueError(f"unknown pooling {self.pooling_type!r}")
+        return out, state
+
+    @property
+    def has_params(self):
+        return False
+
+
+# --- recurrent ---------------------------------------------------------------
+
+
+@dataclass
+class LSTM(Layer):
+    """Reference conf.layers.LSTM (fused impl ≈ LSTMHelpers). Weight layout is
+    the fused [nIn+nOut, 4*nOut] IFOG gemm (documented divergence from the
+    reference's separate W/RW matrices — same math, one MXU matmul)."""
+
+    n_out: int = 0
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, RNNInput):
+            raise ValueError("LSTM needs RNN input [B, T, F]")
+        self.n_in = input_type.size
+        return RNNInput(self.n_out, input_type.timesteps)
+
+    def init_params(self, key, dtype=jnp.float32):
+        w = init_weights(key, (self.n_in + self.n_out, 4 * self.n_out),
+                         self.weight_init or "xavier", dtype)
+        b = jnp.zeros((4 * self.n_out,), dtype)
+        # forget-gate bias = 1 (reference forgetGateBiasInit default)
+        b = b.at[self.n_out:2 * self.n_out].set(1.0)
+        return {"W": w, "b": b}
+
+    def apply(self, params, x, state, training, rng):
+        x = self._maybe_dropout(x, training, rng)
+        ys, _ = get_op("lstm_layer").fn(x, params["W"], params["b"])
+        act = self.activation
+        if act and act.lower() not in ("tanh", "identity"):
+            ys = activation_fn(act)(ys)
+        return ys, state
+
+
+@dataclass
+class GravesLSTM(LSTM):
+    """Reference GravesLSTM (peepholes omitted — deprecated upstream; the
+    non-peephole path is identical to LSTM)."""
+
+
+@dataclass
+class SimpleRnn(Layer):
+    n_out: int = 0
+
+    def set_input_type(self, input_type):
+        self.n_in = input_type.size
+        return RNNInput(self.n_out, input_type.timesteps)
+
+    def init_params(self, key, dtype=jnp.float32):
+        k1, k2 = jax.random.split(key)
+        return {
+            "W": init_weights(k1, (self.n_in, self.n_out), self.weight_init or "xavier", dtype),
+            "RW": init_weights(k2, (self.n_out, self.n_out), self.weight_init or "xavier", dtype),
+            "b": jnp.zeros((self.n_out,), dtype),
+        }
+
+    def apply(self, params, x, state, training, rng):
+        x = self._maybe_dropout(x, training, rng)
+        ys, _ = get_op("simple_rnn_layer").fn(x, params["W"], params["RW"], params["b"])
+        return ys, state
+
+
+@dataclass
+class Bidirectional(Layer):
+    """Reference recurrent.Bidirectional wrapper: runs the wrapped recurrent
+    layer forward + on the time-reversed sequence, merges by mode."""
+
+    layer: Optional[Layer] = None
+    mode: str = "concat"     # concat | add | mul | average
+
+    def set_input_type(self, input_type):
+        out = self.layer.set_input_type(input_type)
+        if self.mode.lower() == "concat":
+            return RNNInput(out.size * 2, out.timesteps)
+        return out
+
+    def init_params(self, key, dtype=jnp.float32):
+        kf, kb = jax.random.split(key)
+        return {"fwd": self.layer.init_params(kf, dtype),
+                "bwd": self.layer.init_params(kb, dtype)}
+
+    def apply(self, params, x, state, training, rng):
+        fwd, _ = self.layer.apply(params["fwd"], x, {}, training, rng)
+        bwd, _ = self.layer.apply(params["bwd"], jnp.flip(x, axis=1), {}, training, rng)
+        bwd = jnp.flip(bwd, axis=1)
+        mode = self.mode.lower()
+        if mode == "concat":
+            out = jnp.concatenate([fwd, bwd], axis=-1)
+        elif mode == "add":
+            out = fwd + bwd
+        elif mode == "mul":
+            out = fwd * bwd
+        else:
+            out = 0.5 * (fwd + bwd)
+        return out, state
+
+
+@dataclass
+class LastTimeStep(Layer):
+    """Reference recurrent.LastTimeStep wrapper: RNN [B,T,F] → FF [B,F]."""
+
+    layer: Optional[Layer] = None
+
+    def set_input_type(self, input_type):
+        out = self.layer.set_input_type(input_type)
+        return FFInput(out.size)
+
+    def init_params(self, key, dtype=jnp.float32):
+        return self.layer.init_params(key, dtype)
+
+    def apply(self, params, x, state, training, rng):
+        ys, state = self.layer.apply(params, x, state, training, rng)
+        return ys[:, -1], state
+
+
+# --- embeddings --------------------------------------------------------------
+
+
+@dataclass
+class EmbeddingLayer(Layer):
+    """Reference conf.layers.EmbeddingLayer: int index [B] (or one-hot) → [B, nOut]."""
+
+    n_out: int = 0
+
+    def set_input_type(self, input_type):
+        self.n_in = input_type.size  # vocab size
+        return FFInput(self.n_out)
+
+    def init_params(self, key, dtype=jnp.float32):
+        return {"W": init_weights(key, (self.n_in, self.n_out),
+                                  self.weight_init or "xavier", dtype)}
+
+    def apply(self, params, x, state, training, rng):
+        if jnp.issubdtype(x.dtype, jnp.floating) and x.ndim == 2 and x.shape[-1] == self.n_in:
+            idx = jnp.argmax(x, axis=-1)  # one-hot form
+        else:
+            idx = x.astype(jnp.int32)
+            if idx.ndim == 2 and idx.shape[-1] == 1:
+                idx = idx[:, 0]
+        out = jnp.take(params["W"], idx, axis=0)
+        return activation_fn(self.activation or "identity")(out), state
+
+
+@dataclass
+class EmbeddingSequenceLayer(EmbeddingLayer):
+    """[B, T] int → RNN [B, T, nOut]."""
+
+    def set_input_type(self, input_type):
+        self.n_in = input_type.size
+        ts = getattr(input_type, "timesteps", None)
+        return RNNInput(self.n_out, ts)
+
+    def apply(self, params, x, state, training, rng):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 3 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        out = jnp.take(params["W"], idx, axis=0)
+        return activation_fn(self.activation or "identity")(out), state
+
+
+@dataclass
+class ElementWiseMultiplicationLayer(Layer):
+    """out = activation(w * x + b), elementwise (reference layer of same name)."""
+
+    def set_input_type(self, input_type):
+        self.n_in = input_type.size
+        return input_type
+
+    def init_params(self, key, dtype=jnp.float32):
+        return {"w": jnp.ones((self.n_in,), dtype), "b": jnp.zeros((self.n_in,), dtype)}
+
+    def apply(self, params, x, state, training, rng):
+        out = x * params["w"] + params["b"]
+        return activation_fn(self.activation or "identity")(out), state
+
+
+@dataclass
+class FrozenLayer(Layer):
+    """Reference FrozenLayer wrapper: parameters excluded from updates.
+    Implemented with stop_gradient — updater math never sees a gradient."""
+
+    layer: Optional[Layer] = None
+
+    def set_input_type(self, input_type):
+        return self.layer.set_input_type(input_type)
+
+    def init_params(self, key, dtype=jnp.float32):
+        return self.layer.init_params(key, dtype)
+
+    def init_state(self):
+        return self.layer.init_state()
+
+    def apply(self, params, x, state, training, rng):
+        frozen = jax.tree.map(jax.lax.stop_gradient, params)
+        return self.layer.apply(frozen, x, state, training, rng)
+
+    @property
+    def has_params(self):
+        return self.layer.has_params
+
+
+# --- output layers -----------------------------------------------------------
+
+
+@dataclass
+class OutputLayer(DenseLayer):
+    """Reference conf.layers.OutputLayer: dense + loss head."""
+
+    loss: Union[str, ILossFunction, None] = None
+
+    def __post_init__(self):
+        if self.loss is None:
+            self.loss = LossMCXENT()
+        elif isinstance(self.loss, str):
+            self.loss = loss_from_name(self.loss)
+        if self.activation is None:
+            self.activation = "softmax"
+
+    def pre_output(self, params, x):
+        out = x @ params["W"]
+        if self.has_bias:
+            out = out + params["b"]
+        return out
+
+    def apply(self, params, x, state, training, rng):
+        x = self._maybe_dropout(x, training, rng)
+        return activation_fn(self.activation)(self.pre_output(params, x)), state
+
+    def compute_score(self, params, x, labels, mask=None, average: bool = True):
+        pre = self.pre_output(params, x)
+        return self.loss.compute_score(labels, pre, self.activation, mask, average)
+
+
+@dataclass
+class RnnOutputLayer(OutputLayer):
+    """Per-timestep output head on [B, T, F] (reference RnnOutputLayer):
+    the dense W=[nIn,nOut] applies at every timestep (matmul broadcasts)."""
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, RNNInput):
+            raise ValueError(f"RnnOutputLayer needs RNN input, got {input_type}")
+        self.n_in = input_type.size
+        return RNNInput(self.n_out, input_type.timesteps)
+
+
+@dataclass
+class LossLayer(Layer):
+    """No-param loss head (reference conf.layers.LossLayer)."""
+
+    loss: Union[str, ILossFunction, None] = None
+
+    def __post_init__(self):
+        if self.loss is None:
+            self.loss = LossMCXENT()
+        elif isinstance(self.loss, str):
+            self.loss = loss_from_name(self.loss)
+        if self.activation is None:
+            self.activation = "identity"
+
+    def pre_output(self, params, x):
+        return x
+
+    def apply(self, params, x, state, training, rng):
+        return activation_fn(self.activation)(x), state
+
+    def compute_score(self, params, x, labels, mask=None, average: bool = True):
+        return self.loss.compute_score(labels, x, self.activation, mask, average)
+
+    @property
+    def has_params(self):
+        return False

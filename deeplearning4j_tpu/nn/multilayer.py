@@ -1,0 +1,343 @@
+"""MultiLayerNetwork — the north-star entry point.
+
+Reference: dl4j-nn ``org.deeplearning4j.nn.multilayer.MultiLayerNetwork``
+(~4k LoC; SURVEY.md §2.3, §3.1). API surface kept: ``init/fit/output/
+feed_forward/score/evaluate/params/save``; the execution model inverted for
+TPU: where the reference's fit loop makes ~100+ JNI crossings per iteration
+(per-op dispatch through NativeOpExecutioner), here the WHOLE training
+iteration — forward, loss, backward, updater — is one jit-compiled XLA module
+with donated buffers, executed once per minibatch (SURVEY.md §7.1.1).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import DataSet
+from ..ndarray.ndarray import NDArray
+from ..ndarray.rng import get_random
+from .conf.builder import MultiLayerConfiguration
+from .conf import layers as L
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers = conf.layers
+        self._params: List[Dict[str, jnp.ndarray]] = []
+        self._states: List[Dict[str, jnp.ndarray]] = []
+        self._updater_state = None
+        self._initialized = False
+        self._iteration = 0
+        self._epoch = 0
+        self._listeners: List[Any] = []
+        self._fit_step = None
+        self._infer_fn = None
+        self.score_value: float = float("nan")
+
+    # ------------------------------------------------------------------
+    def init(self, seed: Optional[int] = None) -> "MultiLayerNetwork":
+        if self.conf.input_type is None:
+            raise ValueError("configuration needs set_input_type(...) before init()")
+        key = jax.random.PRNGKey(seed if seed is not None else self.conf.global_conf.seed)
+        dtype = jnp.dtype(self.conf.global_conf.dtype)
+        self._params = []
+        self._states = []
+        for layer in self.layers:
+            key, sub = jax.random.split(key)
+            self._params.append(layer.init_params(sub, dtype) if layer.has_params else {})
+            self._states.append(layer.init_state())
+        self._initialized = True
+        return self
+
+    def set_listeners(self, *listeners) -> None:
+        self._listeners = list(listeners)
+
+    setListeners = set_listeners
+
+    # --- parameter access (flattened, reference params() contract) ------
+    def params(self) -> NDArray:
+        leaves = jax.tree.leaves(self._params)
+        if not leaves:
+            return NDArray(jnp.zeros((0,)))
+        return NDArray(jnp.concatenate([l.ravel() for l in leaves]))
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(self._params))
+
+    def set_params(self, flat: Union[NDArray, np.ndarray]) -> None:
+        vec = jnp.asarray(flat.value if isinstance(flat, NDArray) else flat)
+        leaves, treedef = jax.tree.flatten(self._params)
+        out, off = [], 0
+        for l in leaves:
+            n = int(np.prod(l.shape))
+            out.append(vec[off:off + n].reshape(l.shape).astype(l.dtype))
+            off += n
+        if off != vec.size:
+            raise ValueError(f"param vector length {vec.size} != model params {off}")
+        self._params = jax.tree.unflatten(treedef, out)
+        self._fit_step = None  # donated buffers were replaced
+
+    def param_table(self, layer_idx: int) -> Dict[str, NDArray]:
+        return {k: NDArray(v) for k, v in self._params[layer_idx].items()}
+
+    # --- forward ---------------------------------------------------------
+    def _forward(self, params, states, x, training: bool, rng):
+        """Single traced forward pass through preprocessors + layers."""
+        new_states = []
+        for i, layer in enumerate(self.layers):
+            pre = self.conf.preprocessors.get(i)
+            if pre is not None:
+                x = pre(x)
+            rng, sub = jax.random.split(rng)
+            x, st = layer.apply(params[i], x, states[i], training, sub)
+            new_states.append(st)
+        return x, new_states
+
+    def _forward_to_preout(self, params, states, x, training: bool, rng):
+        """Forward stopping BEFORE the output head's activation (for loss)."""
+        new_states = []
+        for i, layer in enumerate(self.layers[:-1]):
+            pre = self.conf.preprocessors.get(i)
+            if pre is not None:
+                x = pre(x)
+            rng, sub = jax.random.split(rng)
+            x, st = layer.apply(params[i], x, states[i], training, sub)
+            new_states.append(st)
+        i = len(self.layers) - 1
+        pre = self.conf.preprocessors.get(i)
+        if pre is not None:
+            x = pre(x)
+        # the output head's configured input dropout applies on this path too
+        rng, sub = jax.random.split(rng)
+        x = self.layers[i]._maybe_dropout(x, training, sub)
+        new_states.append(states[i])  # output head is stateless; keep list aligned
+        return x, new_states
+
+    def output(self, x, training: bool = False) -> NDArray:
+        """Inference forward (reference output()): one compiled module."""
+        self._check_init()
+        xv = jnp.asarray(x.value if isinstance(x, NDArray) else x)
+        if self._infer_fn is None:
+            def infer(params, states, xin, key):
+                out, _ = self._forward(params, states, xin, False, key)
+                return out
+
+            self._infer_fn = jax.jit(infer)
+        out = self._infer_fn(self._params, self._states, xv, get_random().next_key())
+        return NDArray(out)
+
+    def feed_forward(self, x, training: bool = False) -> List[NDArray]:
+        """All layer activations (reference feedForward)."""
+        self._check_init()
+        xv = jnp.asarray(x.value if isinstance(x, NDArray) else x)
+        acts = [NDArray(xv)]
+        rng = get_random().next_key()
+        cur = xv
+        for i, layer in enumerate(self.layers):
+            pre = self.conf.preprocessors.get(i)
+            if pre is not None:
+                cur = pre(cur)
+            rng, sub = jax.random.split(rng)
+            cur, _ = layer.apply(self._params[i], cur, self._states[i], training, sub)
+            acts.append(NDArray(cur))
+        return acts
+
+    # --- loss ------------------------------------------------------------
+    def _loss(self, params, states, x, labels, mask, training: bool, rng):
+        out_layer = self.layers[-1]
+        if not isinstance(out_layer, (L.OutputLayer, L.LossLayer)):
+            raise ValueError("last layer must be an OutputLayer/LossLayer to train")
+        pre, new_states = self._forward_to_preout(params, states, x, training, rng)
+        data_loss = out_layer.compute_score(params[-1], pre, labels, mask, average=True)
+        reg = 0.0
+        gc = self.conf.global_conf
+        for lp, layer in zip(params, self.layers):
+            l1 = layer.l1 if layer.l1 is not None else gc.l1
+            l2 = layer.l2 if layer.l2 is not None else gc.l2
+            for name, w in lp.items():
+                if name in ("b", "beta", "mean", "var"):
+                    continue  # biases/norm params excluded (reference default)
+                if l2:
+                    reg = reg + 0.5 * l2 * jnp.sum(jnp.square(w))
+                if l1:
+                    reg = reg + l1 * jnp.sum(jnp.abs(w))
+        return data_loss + reg, new_states
+
+    def score(self, dataset: DataSet, training: bool = False) -> float:
+        self._check_init()
+        x = jnp.asarray(dataset.features.value)
+        y = jnp.asarray(dataset.labels.value)
+        mask = jnp.asarray(dataset.labels_mask.value) if dataset.labels_mask is not None else None
+        loss, _ = self._loss(self._params, self._states, x, y, mask, training,
+                             get_random().next_key())
+        return float(loss)
+
+    def compute_gradient_and_score(self, dataset: DataSet):
+        """(gradients, score) — the GradientCheckUtil entry point."""
+        self._check_init()
+        x = jnp.asarray(dataset.features.value)
+        y = jnp.asarray(dataset.labels.value)
+        mask = jnp.asarray(dataset.labels_mask.value) if dataset.labels_mask is not None else None
+        key = jax.random.PRNGKey(0)
+
+        def loss_fn(params):
+            loss, _ = self._loss(params, self._states, x, y, mask, False, key)
+            return loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(self._params)
+        self.score_value = float(loss)
+        return grads, self.score_value
+
+    # --- training --------------------------------------------------------
+    def _build_fit_step(self):
+        gc = self.conf.global_conf
+        updater = gc.updater
+
+        def step(params, states, upd_state, x, y, mask, key, iteration):
+            def loss_fn(p):
+                loss, new_states = self._loss(p, states, x, y, mask, True, key)
+                return loss, new_states
+
+            (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            if gc.grad_normalization:
+                grads = _normalize_gradients(grads, gc.grad_normalization,
+                                             gc.grad_norm_threshold)
+            new_params, new_upd = updater.apply(grads, upd_state, params, iteration)
+            return new_params, new_states, new_upd, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None) -> None:
+        """The north-star loop (SURVEY.md §3.1): per minibatch, ONE compiled
+        train-step executes forward+backward+updater on device."""
+        self._check_init()
+        if self._updater_state is None:
+            self._updater_state = self.conf.global_conf.updater.init(self._params)
+        if self._fit_step is None:
+            self._fit_step = self._build_fit_step()
+
+        for _ in range(max(1, epochs)):
+            for ds in _iter_data(data, batch_size):
+                x = jnp.asarray(ds.features.value)
+                y = jnp.asarray(ds.labels.value)
+                mask = (jnp.asarray(ds.labels_mask.value)
+                        if ds.labels_mask is not None else None)
+                key = get_random().next_key()
+                (self._params, self._states, self._updater_state,
+                 loss) = self._fit_step(self._params, self._states,
+                                        self._updater_state, x, y, mask, key,
+                                        jnp.asarray(self._iteration))
+                self._iteration += 1
+                self.score_value = float(loss)
+                for lst in self._listeners:
+                    lst.iteration_done(self, self._iteration, self.score_value)
+            self._epoch += 1
+            for lst in self._listeners:
+                if hasattr(lst, "epoch_done"):
+                    lst.epoch_done(self, self._epoch)
+
+    # --- evaluation -------------------------------------------------------
+    def evaluate(self, data, batch_size: Optional[int] = None):
+        from ..eval.evaluation import Evaluation
+
+        ev = Evaluation()
+        for ds in _iter_data(data, batch_size):
+            out = self.output(ds.features)
+            ev.eval(ds.labels.to_numpy(), out.to_numpy(),
+                    ds.labels_mask.to_numpy() if ds.labels_mask is not None else None)
+        return ev
+
+    def evaluate_regression(self, data, batch_size: Optional[int] = None):
+        from ..eval.evaluation import RegressionEvaluation
+
+        ev = RegressionEvaluation()
+        for ds in _iter_data(data, batch_size):
+            out = self.output(ds.features)
+            ev.eval(ds.labels.to_numpy(), out.to_numpy())
+        return ev
+
+    # --- persistence ------------------------------------------------------
+    def save(self, path: str, save_updater: bool = False) -> None:
+        from ..util.model_serializer import write_model
+
+        write_model(self, path, save_updater)
+
+    @staticmethod
+    def load(path: str, load_updater: bool = False) -> "MultiLayerNetwork":
+        from ..util.model_serializer import restore_multi_layer_network
+
+        return restore_multi_layer_network(path, load_updater)
+
+    # --- misc -------------------------------------------------------------
+    def summary(self) -> str:
+        lines = [f"{'idx':<4}{'layer':<28}{'out type':<28}{'params':<10}"]
+        total = 0
+        for i, layer in enumerate(self.layers):
+            n = (sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self._params[i]))
+                 if self._initialized else 0)
+            total += n
+            ot = (self.conf.layer_output_types[i]
+                  if i < len(self.conf.layer_output_types) else "?")
+            lines.append(f"{i:<4}{type(layer).__name__:<28}{str(ot):<28}{n:<10}")
+        lines.append(f"Total params: {total}")
+        return "\n".join(lines)
+
+    def get_layer(self, idx: int) -> L.Layer:
+        return self.layers[idx]
+
+    def n_layers(self) -> int:
+        return len(self.layers)
+
+    def _check_init(self) -> None:
+        if not self._initialized:
+            raise ValueError("call init() first")
+
+    def clone(self) -> "MultiLayerNetwork":
+        import copy
+
+        net = MultiLayerNetwork(copy.deepcopy(self.conf))
+        net.init()
+        net._params = jax.tree.map(lambda a: a, self._params)
+        net._states = jax.tree.map(lambda a: a, self._states)
+        return net
+
+
+def _normalize_gradients(grads, mode: str, threshold: float):
+    mode = mode.lower()
+    if mode == "clipelementwiseabsolutevalue":
+        return jax.tree.map(lambda g: jnp.clip(g, -threshold, threshold), grads)
+    if mode == "clipl2pergradient":
+        def clip(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g)))
+            return jnp.where(n > threshold, g * (threshold / n), g)
+
+        return jax.tree.map(clip, grads)
+    if mode == "clipl2perparamtype" or mode == "renormalizel2perlayer":
+        leaves = jax.tree.leaves(grads)
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+        scale = jnp.minimum(1.0, threshold / jnp.maximum(gnorm, 1e-12))
+        return jax.tree.map(lambda g: g * scale, grads)
+    raise ValueError(f"unknown gradient normalization {mode!r}")
+
+
+def _iter_data(data, batch_size):
+    if hasattr(data, "reset") and hasattr(data, "__iter__"):
+        data.reset()
+        yield from data
+        return
+    if isinstance(data, DataSet):
+        if batch_size is None:
+            yield data
+        else:
+            yield from data.batch_by(batch_size)
+        return
+    if isinstance(data, tuple) and len(data) == 2:
+        yield from _iter_data(DataSet(data[0], data[1]), batch_size)
+        return
+    raise TypeError(f"cannot iterate data of type {type(data)}")

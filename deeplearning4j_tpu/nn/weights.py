@@ -1,0 +1,80 @@
+"""Weight initialization.
+
+Reference: dl4j-nn ``org.deeplearning4j.nn.weights.WeightInit`` (+ IWeightInit
+impls): XAVIER, XAVIER_UNIFORM, RELU (He), RELU_UNIFORM, LECUN_NORMAL,
+LECUN_UNIFORM, NORMAL, UNIFORM, SIGMOID_UNIFORM, ZERO, ONES, IDENTITY,
+VAR_SCALING_*. Fan computation follows the reference's ParamInitializer
+conventions (dense W=[nIn,nOut]; conv W=[out,in,kH,kW]).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _fans(shape: Sequence[int]) -> Tuple[float, float]:
+    shape = tuple(shape)
+    if len(shape) == 2:                      # dense [nIn, nOut]
+        return float(shape[0]), float(shape[1])
+    if len(shape) == 4:                      # conv OIHW [out, in, kh, kw]
+        rf = shape[2] * shape[3]
+        return float(shape[1] * rf), float(shape[0] * rf)
+    if len(shape) == 5:                      # conv3d OIDHW
+        rf = shape[2] * shape[3] * shape[4]
+        return float(shape[1] * rf), float(shape[0] * rf)
+    if len(shape) == 1:
+        return float(shape[0]), float(shape[0])
+    n = int(np.prod(shape))
+    return float(n), float(n)
+
+
+def init_weights(key: jax.Array, shape: Sequence[int], scheme: str = "xavier",
+                 dtype=jnp.float32, gain: float = 1.0) -> jnp.ndarray:
+    scheme = scheme.lower()
+    fan_in, fan_out = _fans(shape)
+    shape = tuple(shape)
+    if scheme == "zero":
+        return jnp.zeros(shape, dtype)
+    if scheme == "ones":
+        return jnp.ones(shape, dtype)
+    if scheme == "identity":
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError("IDENTITY init needs a square 2d shape")
+        return jnp.eye(shape[0], dtype=dtype)
+    if scheme == "xavier":
+        std = float(gain * np.sqrt(2.0 / (fan_in + fan_out)))
+        return jax.random.normal(key, shape, dtype) * std
+    if scheme == "xavier_uniform":
+        lim = float(gain * np.sqrt(6.0 / (fan_in + fan_out)))
+        return jax.random.uniform(key, shape, dtype, -lim, lim)
+    if scheme == "xavier_fan_in":
+        return jax.random.normal(key, shape, dtype) * float(gain * np.sqrt(1.0 / fan_in))
+    if scheme in ("relu", "he", "he_normal"):
+        return jax.random.normal(key, shape, dtype) * float(gain * np.sqrt(2.0 / fan_in))
+    if scheme in ("relu_uniform", "he_uniform"):
+        lim = float(gain * np.sqrt(6.0 / fan_in))
+        return jax.random.uniform(key, shape, dtype, -lim, lim)
+    if scheme == "lecun_normal":
+        return jax.random.normal(key, shape, dtype) * float(gain * np.sqrt(1.0 / fan_in))
+    if scheme == "lecun_uniform":
+        lim = float(gain * np.sqrt(3.0 / fan_in))
+        return jax.random.uniform(key, shape, dtype, -lim, lim)
+    if scheme == "normal":
+        return jax.random.normal(key, shape, dtype) * float(gain / np.sqrt(fan_in))
+    if scheme == "uniform":
+        lim = float(gain * np.sqrt(1.0 / fan_in))
+        return jax.random.uniform(key, shape, dtype, -lim, lim)
+    if scheme == "sigmoid_uniform":
+        lim = float(gain * 4.0 * np.sqrt(6.0 / (fan_in + fan_out)))
+        return jax.random.uniform(key, shape, dtype, -lim, lim)
+    if scheme == "var_scaling_normal_fan_in":
+        return jax.random.normal(key, shape, dtype) * float(gain * np.sqrt(1.0 / fan_in))
+    if scheme == "var_scaling_normal_fan_out":
+        return jax.random.normal(key, shape, dtype) * float(gain * np.sqrt(1.0 / fan_out))
+    if scheme == "var_scaling_normal_fan_avg":
+        return jax.random.normal(key, shape, dtype) * float(gain * np.sqrt(2.0 / (fan_in + fan_out)))
+    raise ValueError(f"unknown weight init {scheme!r}")

@@ -1,0 +1,3 @@
+from .listeners import (TrainingListener, ScoreIterationListener, PerformanceListener,
+                        EvaluativeListener, CheckpointListener, TimeIterationListener,
+                        CollectScoresIterationListener)

@@ -1,0 +1,149 @@
+"""Training listeners.
+
+Reference: dl4j-nn ``org.deeplearning4j.optimize.listeners.{
+ScoreIterationListener, PerformanceListener, EvaluativeListener,
+CheckpointListener, TimeIterationListener, CollectScoresIterationListener}``
+(SURVEY.md §2.3). The listener SPI is THE metrics bus (§5.5).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Any, List, Optional
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class TrainingListener:
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        pass
+
+    def epoch_done(self, model, epoch: int) -> None:
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    def __init__(self, print_iterations: int = 10):
+        self.print_iterations = max(1, print_iterations)
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.print_iterations == 0:
+            logger.info("Score at iteration %d is %s", iteration, score)
+
+
+class CollectScoresIterationListener(TrainingListener):
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[tuple] = []
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, score))
+
+
+class PerformanceListener(TrainingListener):
+    """Samples/sec + iteration latency (reference PerformanceListener)."""
+
+    def __init__(self, frequency: int = 10, report_batch: bool = True):
+        self.frequency = max(1, frequency)
+        self.report_batch = report_batch
+        self._last_time = None
+        self._last_iter = None
+        self.last_iterations_per_sec = 0.0
+
+    def iteration_done(self, model, iteration, score):
+        now = time.time()
+        if self._last_time is not None and iteration % self.frequency == 0:
+            dt = now - self._last_time
+            iters = iteration - self._last_iter
+            if dt > 0:
+                self.last_iterations_per_sec = iters / dt
+                logger.info("iteration %d: %.1f iter/s, score=%s",
+                            iteration, self.last_iterations_per_sec, score)
+            self._last_time = now
+            self._last_iter = iteration
+        elif self._last_time is None:
+            self._last_time = now
+            self._last_iter = iteration
+
+
+class TimeIterationListener(TrainingListener):
+    """ETA logging over an expected iteration count."""
+
+    def __init__(self, expected_iterations: int, frequency: int = 50):
+        self.expected = expected_iterations
+        self.frequency = max(1, frequency)
+        self.start = time.time()
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.frequency == 0 and iteration > 0:
+            elapsed = time.time() - self.start
+            remaining = elapsed / iteration * (self.expected - iteration)
+            logger.info("iteration %d/%d, ETA %.0fs", iteration, self.expected,
+                        max(0.0, remaining))
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodic holdout evaluation (reference EvaluativeListener)."""
+
+    def __init__(self, data, frequency: int = 100, metric: str = "accuracy"):
+        self.data = data
+        self.frequency = max(1, frequency)
+        self.metric = metric
+        self.history: List[tuple] = []
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.frequency == 0:
+            ev = model.evaluate(self.data)
+            value = getattr(ev, self.metric)()
+            self.history.append((iteration, value))
+            logger.info("eval at iteration %d: %s=%.4f", iteration, self.metric, value)
+
+
+class CheckpointListener(TrainingListener):
+    """Rolling checkpoints every N iterations/epochs (reference
+    CheckpointListener with keepLast retention + checkpoint.json index)."""
+
+    def __init__(self, directory: str, save_every_n_iterations: Optional[int] = None,
+                 save_every_n_epochs: Optional[int] = None, keep_last: int = 3):
+        self.dir = directory
+        self.every_iter = save_every_n_iterations
+        self.every_epoch = save_every_n_epochs
+        self.keep_last = keep_last
+        self.saved: List[str] = []
+        os.makedirs(directory, exist_ok=True)
+
+    def _save(self, model, tag: str) -> None:
+        path = os.path.join(self.dir, f"checkpoint_{tag}.zip")
+        model.save(path, save_updater=True)
+        self.saved.append(path)
+        while len(self.saved) > self.keep_last:
+            old = self.saved.pop(0)
+            if os.path.exists(old):
+                os.remove(old)
+        index = os.path.join(self.dir, "checkpoint.json")
+        import json
+
+        with open(index, "w") as f:
+            json.dump({"checkpoints": self.saved}, f)
+
+    def iteration_done(self, model, iteration, score):
+        if self.every_iter and iteration % self.every_iter == 0:
+            self._save(model, f"iter_{iteration}")
+
+    def epoch_done(self, model, epoch):
+        if self.every_epoch and epoch % self.every_epoch == 0:
+            self._save(model, f"epoch_{epoch}")
+
+    @staticmethod
+    def last_checkpoint(directory: str) -> Optional[str]:
+        import json
+
+        index = os.path.join(directory, "checkpoint.json")
+        if not os.path.exists(index):
+            return None
+        with open(index) as f:
+            saved = json.load(f)["checkpoints"]
+        return saved[-1] if saved else None

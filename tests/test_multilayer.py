@@ -1,0 +1,430 @@
+"""MultiLayerNetwork tests — the reference's MultiLayerTest / gradientcheck /
+regressiontest concerns (SURVEY.md §4.4), plus THE M3 exit criterion: LeNet on
+MNIST via a MultiLayerNetwork-shaped fit()."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.data import (DataSet, IrisDataSetIterator,
+                                     MnistDataSetIterator, NDArrayDataSetIterator,
+                                     NormalizerStandardize)
+from deeplearning4j_tpu.learning import Adam, Nesterovs, Sgd
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf import layers as L
+from gradcheck import check_gradients
+
+
+def mlp_conf(n_in=4, n_hidden=16, n_out=3, updater=None, **kwargs):
+    return (NeuralNetConfiguration.builder()
+            .seed(12345)
+            .updater(updater or Adam(learning_rate=0.01))
+            .activation("tanh")
+            .list()
+            .layer(L.DenseLayer(n_out=n_hidden))
+            .layer(L.OutputLayer(n_out=n_out, loss="mcxent", activation="softmax"))
+            .set_input_type(InputType.feed_forward(n_in))
+            .build())
+
+
+class TestBuilder:
+    def test_builder_defaults_cascade(self):
+        conf = mlp_conf()
+        assert conf.layers[0].activation == "tanh"
+        assert conf.layers[0].weight_init == "xavier"
+        assert conf.layers[1].activation == "softmax"  # OutputLayer keeps its own
+
+    def test_n_in_inference(self):
+        conf = mlp_conf(n_in=7, n_hidden=5)
+        assert conf.layers[0].n_in == 7
+        assert conf.layers[1].n_in == 5
+
+    def test_cnn_shape_inference_and_preprocessor(self):
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(L.ConvolutionLayer(n_out=6, kernel_size=(5, 5), stride=(1, 1)))
+                .layer(L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(L.DenseLayer(n_out=10, activation="relu"))
+                .layer(L.OutputLayer(n_out=3))
+                .set_input_type(InputType.convolutional(28, 28, 1))
+                .build())
+        # conv: 28-5+1=24; pool: 12 → dense preprocessor flattens 6*12*12
+        assert conf.layers[2].n_in == 6 * 12 * 12
+        assert 2 in conf.preprocessors  # CnnToFF inserted before the dense layer
+
+    def test_config_json_round_trip(self):
+        conf = mlp_conf()
+        s = conf.to_json()
+        back = type(conf).from_json(s)
+        assert len(back.layers) == 2
+        assert back.layers[0].n_out == 16
+        assert back.layers[0].n_in == 4
+        assert type(back.global_conf.updater).__name__ == "Adam"
+        assert back.global_conf.updater.learning_rate == 0.01
+
+
+class TestForward:
+    def test_init_and_output_shapes(self):
+        model = MultiLayerNetwork(mlp_conf()).init()
+        out = model.output(np.random.randn(5, 4).astype(np.float32))
+        assert out.shape == (5, 3)
+        np.testing.assert_allclose(out.to_numpy().sum(1), 1.0, atol=1e-5)  # softmax
+
+    def test_feed_forward_activations(self):
+        model = MultiLayerNetwork(mlp_conf()).init()
+        acts = model.feed_forward(np.random.randn(5, 4).astype(np.float32))
+        assert len(acts) == 3  # input + 2 layers
+        assert acts[1].shape == (5, 16)
+
+    def test_params_roundtrip(self):
+        model = MultiLayerNetwork(mlp_conf()).init()
+        flat = model.params()
+        assert flat.length() == model.num_params() == 4 * 16 + 16 + 16 * 3 + 3
+        model2 = MultiLayerNetwork(mlp_conf()).init()
+        model2.set_params(flat)
+        np.testing.assert_allclose(model2.params().to_numpy(), flat.to_numpy())
+
+    def test_summary(self):
+        model = MultiLayerNetwork(mlp_conf()).init()
+        s = model.summary()
+        assert "DenseLayer" in s and "Total params" in s
+
+
+class TestGradients:
+    def test_mlp_gradcheck(self):
+        """Backprop vs central differences through the layer API (fp64)."""
+        conf = (NeuralNetConfiguration.builder()
+                .seed(7).data_type("float64").activation("tanh")
+                .list()
+                .layer(L.DenseLayer(n_out=6))
+                .layer(L.OutputLayer(n_out=3, loss="mcxent", activation="softmax"))
+                .set_input_type(InputType.feed_forward(5))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(0)
+        ds = DataSet(rng.randn(4, 5), np.eye(3, dtype=np.float64)[rng.randint(0, 3, 4)])
+        grads, score = model.compute_gradient_and_score(ds)
+
+        flat_grads = {}
+        flat_params = {}
+        for i, lp in enumerate(model._params):
+            for k, v in lp.items():
+                flat_params[f"{i}:{k}"] = np.asarray(v, np.float64)
+                flat_grads[f"{i}:{k}"] = np.asarray(grads[i][k], np.float64)
+
+        def loss_fn(p):
+            saved = model._params
+            model._params = [
+                {k: jnp.asarray(p[f"{i}:{k}"]) for k in lp}
+                for i, lp in enumerate(saved)]
+            try:
+                return model.score(ds)
+            finally:
+                model._params = saved
+
+        check_gradients(loss_fn, flat_params, flat_grads, sample=32)
+
+    def test_cnn_gradcheck(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(3).data_type("float64").activation("tanh")
+                .list()
+                .layer(L.ConvolutionLayer(n_out=3, kernel_size=(3, 3)))
+                .layer(L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(L.OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+                .set_input_type(InputType.convolutional(8, 8, 2))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(1)
+        ds = DataSet(rng.randn(2, 2, 8, 8), np.eye(2, dtype=np.float64)[[0, 1]])
+        grads, _ = model.compute_gradient_and_score(ds)
+        flat_params = {f"{i}:{k}": np.asarray(v, np.float64)
+                       for i, lp in enumerate(model._params) for k, v in lp.items()}
+        flat_grads = {f"{i}:{k}": np.asarray(grads[i][k], np.float64)
+                      for i, lp in enumerate(model._params) for k in lp}
+
+        def loss_fn(p):
+            saved = model._params
+            model._params = [{k: jnp.asarray(p[f"{i}:{k}"]) for k in lp}
+                             for i, lp in enumerate(saved)]
+            try:
+                return model.score(ds)
+            finally:
+                model._params = saved
+
+        check_gradients(loss_fn, flat_params, flat_grads, sample=20)
+
+    def test_lstm_gradcheck(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(5).data_type("float64")
+                .list()
+                .layer(L.LSTM(n_out=4))
+                .layer(L.LastTimeStep(layer=L.LSTM(n_out=3)))
+                .layer(L.OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+                .set_input_type(InputType.recurrent(3, 6))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(2)
+        ds = DataSet(rng.randn(2, 6, 3), np.eye(2, dtype=np.float64)[[1, 0]])
+        grads, _ = model.compute_gradient_and_score(ds)
+        flat_params = {}
+        flat_grads = {}
+
+        def flatten(prefix, tree, out):
+            if isinstance(tree, dict):
+                for k, v in tree.items():
+                    flatten(f"{prefix}/{k}", v, out)
+            else:
+                out[prefix] = np.asarray(tree, np.float64)
+
+        for i, (lp, lg) in enumerate(zip(model._params, grads)):
+            flatten(str(i), lp, flat_params)
+            flatten(str(i), lg, flat_grads)
+
+        def unflatten(flat, template, prefix):
+            if isinstance(template, dict):
+                return {k: unflatten(flat, v, f"{prefix}/{k}") for k, v in template.items()}
+            return jnp.asarray(flat[prefix])
+
+        def loss_fn(p):
+            saved = model._params
+            model._params = [unflatten(p, lp, str(i)) for i, lp in enumerate(saved)]
+            try:
+                return model.score(ds)
+            finally:
+                model._params = saved
+
+        check_gradients(loss_fn, flat_params, flat_grads, sample=16)
+
+
+class TestTraining:
+    def test_iris_convergence(self):
+        it = IrisDataSetIterator(batch_size=50)
+        model = MultiLayerNetwork(mlp_conf(n_in=4, n_hidden=16, n_out=3,
+                                           updater=Adam(learning_rate=0.05))).init()
+        norm = NormalizerStandardize()
+        norm.fit(it)
+        it.set_pre_processor(norm)
+        model.fit(it, epochs=60)
+        ev = model.evaluate(it)
+        assert ev.accuracy() > 0.92, ev.stats()
+
+    def test_listeners_called(self):
+        from deeplearning4j_tpu.optimize import CollectScoresIterationListener
+
+        model = MultiLayerNetwork(mlp_conf()).init()
+        collector = CollectScoresIterationListener()
+        model.set_listeners(collector)
+        it = IrisDataSetIterator(batch_size=75)
+        model.fit(it, epochs=2)
+        assert len(collector.scores) == 4  # 2 batches x 2 epochs
+
+    def test_gradient_clipping_modes(self):
+        for mode in ("clipelementwiseabsolutevalue", "clipl2pergradient",
+                     "clipl2perparamtype"):
+            conf = (NeuralNetConfiguration.builder()
+                    .updater(Sgd(learning_rate=0.1))
+                    .gradient_normalization(mode, 0.5)
+                    .list()
+                    .layer(L.DenseLayer(n_out=8, activation="tanh"))
+                    .layer(L.OutputLayer(n_out=3))
+                    .set_input_type(InputType.feed_forward(4))
+                    .build())
+            model = MultiLayerNetwork(conf).init()
+            it = IrisDataSetIterator(batch_size=150)
+            model.fit(it, epochs=1)
+            assert np.isfinite(model.score_value)
+
+
+class TestSerialization:
+    def test_model_save_load_parity(self, tmp_path):
+        model = MultiLayerNetwork(mlp_conf()).init()
+        it = IrisDataSetIterator(batch_size=150)
+        model.fit(it, epochs=3)
+        x = np.random.RandomState(0).randn(7, 4).astype(np.float32)
+        expected = model.output(x).to_numpy()
+        path = str(tmp_path / "model.zip")
+        model.save(path, save_updater=True)
+        back = MultiLayerNetwork.load(path, load_updater=True)
+        np.testing.assert_allclose(back.output(x).to_numpy(), expected, atol=1e-6)
+        assert back._iteration == model._iteration
+        # resume training without error (updater state restored)
+        back.fit(it, epochs=1)
+
+    def test_checkpoint_listener(self, tmp_path):
+        from deeplearning4j_tpu.optimize import CheckpointListener
+
+        model = MultiLayerNetwork(mlp_conf()).init()
+        cl = CheckpointListener(str(tmp_path), save_every_n_iterations=1, keep_last=2)
+        model.set_listeners(cl)
+        model.fit(IrisDataSetIterator(batch_size=50), epochs=1)
+        assert len(cl.saved) == 2  # rolling retention
+        last = CheckpointListener.last_checkpoint(str(tmp_path))
+        assert last is not None
+        restored = MultiLayerNetwork.load(last)
+        assert restored.num_params() == model.num_params()
+
+
+class TestBatchNorm:
+    def test_running_stats_update_and_inference(self):
+        conf = (NeuralNetConfiguration.builder()
+                .updater(Sgd(learning_rate=0.01))
+                .list()
+                .layer(L.DenseLayer(n_out=8, activation="identity"))
+                .layer(L.BatchNormalization())
+                .layer(L.OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        st0 = np.asarray(model._states[1]["mean"]).copy()
+        model.fit(IrisDataSetIterator(batch_size=150), epochs=2)
+        st1 = np.asarray(model._states[1]["mean"])
+        assert not np.allclose(st0, st1)  # running stats moved
+        out = model.output(np.random.randn(3, 4).astype(np.float32))
+        assert out.shape == (3, 3)
+
+
+@pytest.mark.slow
+class TestLeNetMnist:
+    """M3 exit (SURVEY.md §7.2): LeNet via MultiLayerNetwork.fit() learns MNIST
+    (or its deterministic synthetic stand-in — no egress in CI)."""
+
+    def lenet_conf(self):
+        return (NeuralNetConfiguration.builder()
+                .seed(123)
+                .updater(Nesterovs(learning_rate=0.01, momentum=0.9))
+                .activation("relu")
+                .weight_init("xavier")
+                .list()
+                .layer(L.ConvolutionLayer(n_out=20, kernel_size=(5, 5), stride=(1, 1)))
+                .layer(L.SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+                .layer(L.ConvolutionLayer(n_out=50, kernel_size=(5, 5), stride=(1, 1)))
+                .layer(L.SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+                .layer(L.DenseLayer(n_out=500))
+                .layer(L.OutputLayer(n_out=10, loss="mcxent", activation="softmax"))
+                .set_input_type(InputType.convolutional(28, 28, 1))
+                .build())
+
+    def test_lenet_learns(self):
+        train = MnistDataSetIterator(batch_size=128, train=True, num_examples=4096,
+                                     flatten=False)
+        test = MnistDataSetIterator(batch_size=512, train=False, num_examples=1024,
+                                    flatten=False)
+        model = MultiLayerNetwork(self.lenet_conf()).init()
+        model.fit(train, epochs=3)
+        ev = model.evaluate(test)
+        # synthetic digits are easier than MNIST; real MNIST also clears 0.9 in 3 epochs
+        assert ev.accuracy() > 0.85, ev.stats()
+
+    def test_lenet_checkpoint_resume_parity(self, tmp_path):
+        train = MnistDataSetIterator(batch_size=256, train=True, num_examples=512,
+                                     flatten=False)
+        model = MultiLayerNetwork(self.lenet_conf()).init()
+        model.fit(train, epochs=1)
+        path = str(tmp_path / "lenet.zip")
+        model.save(path, save_updater=True)
+        x = train.features[:8]
+        expected = model.output(x).to_numpy()
+        back = MultiLayerNetwork.load(path, load_updater=True)
+        np.testing.assert_allclose(back.output(x).to_numpy(), expected, atol=1e-6)
+
+
+class TestReviewRegressions:
+    """Round-1 code-review findings on the nn layer."""
+
+    def test_rnn_output_layer_builds_and_trains(self):
+        conf = (NeuralNetConfiguration.builder()
+                .updater(Adam(learning_rate=0.05))
+                .list()
+                .layer(L.LSTM(n_out=8))
+                .layer(L.RnnOutputLayer(n_out=3, loss="mcxent", activation="softmax"))
+                .set_input_type(InputType.recurrent(4, 10))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(0)
+        x = rng.randn(6, 10, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, (6, 10))]
+        model.fit(DataSet(x, y), epochs=3)
+        out = model.output(x)
+        assert out.shape == (6, 10, 3)
+        np.testing.assert_allclose(out.to_numpy().sum(-1), 1.0, atol=1e-5)
+
+    def test_global_dropout_cascades(self):
+        conf = (NeuralNetConfiguration.builder()
+                .dropout(0.5)
+                .list()
+                .layer(L.DenseLayer(n_out=8))
+                .layer(L.OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        assert conf.layers[0].dropout == 0.5
+        assert conf.layers[1].dropout == 0.5
+        # explicit zero opts out
+        conf2 = (NeuralNetConfiguration.builder()
+                 .dropout(0.5)
+                 .list()
+                 .layer(L.DenseLayer(n_out=8, dropout=0.0))
+                 .layer(L.OutputLayer(n_out=3))
+                 .set_input_type(InputType.feed_forward(4))
+                 .build())
+        assert conf2.layers[0].dropout == 0.0
+
+    def test_evaluation_mask_2d(self):
+        from deeplearning4j_tpu.eval import Evaluation
+
+        ev = Evaluation()
+        labels = np.eye(3)[[0, 1, 2, 0]]
+        preds = np.eye(3)[[0, 1, 0, 1]]  # last two wrong
+        ev.eval(labels, preds, mask=np.array([1, 1, 0, 0]))
+        assert ev.count == 2
+        assert ev.accuracy() == 1.0
+
+    def test_fmeasure_loss_scale(self):
+        from deeplearning4j_tpu.nn.losses import LossFMeasure
+        import jax.numpy as jnp
+
+        lf = LossFMeasure()
+        labels = np.array([[1.0], [0.0], [1.0], [1.0]], np.float32)
+        logits = np.array([[3.0], [-3.0], [3.0], [-3.0]], np.float32)
+        avg = float(lf.compute_score(jnp.asarray(labels), jnp.asarray(logits),
+                                     "sigmoid", average=True))
+        per = np.asarray(lf.score_array(jnp.asarray(labels), jnp.asarray(logits),
+                                        "sigmoid"))
+        assert abs(avg - per[0]) < 1e-6  # mean of the broadcast == batch value
+
+    def test_minmax_per_column(self):
+        from deeplearning4j_tpu.data import NormalizerMinMaxScaler
+
+        feats = np.array([[0.0, 100.0], [1.0, 200.0], [0.5, 150.0]], np.float32)
+        ds = DataSet(feats, np.zeros((3, 1), np.float32))
+        n = NormalizerMinMaxScaler()
+        n.fit(ds)
+        n.transform(ds)
+        out = ds.features.to_numpy()
+        np.testing.assert_allclose(out.min(0), [0.0, 0.0], atol=1e-6)
+        np.testing.assert_allclose(out.max(0), [1.0, 1.0], atol=1e-6)
+
+    def test_serializer_coefficient_mismatch_raises(self, tmp_path):
+        import io
+        import zipfile
+
+        model = MultiLayerNetwork(mlp_conf()).init()
+        path = str(tmp_path / "m.zip")
+        model.save(path)
+        # rewrite with one coefficient dropped
+        with zipfile.ZipFile(path) as zf:
+            conf_json = zf.read("configuration.json")
+            coeffs = np.load(io.BytesIO(zf.read("coefficients.npz")))
+            states = zf.read("states.npz")
+            meta = zf.read("meta.json")
+        buf = io.BytesIO()
+        trimmed = {k: coeffs[k] for k in list(coeffs.files)[:-1]}
+        np.savez(buf, **trimmed)
+        with zipfile.ZipFile(path, "w") as zf:
+            zf.writestr("configuration.json", conf_json)
+            zf.writestr("coefficients.npz", buf.getvalue())
+            zf.writestr("states.npz", states)
+            zf.writestr("meta.json", meta)
+        with pytest.raises(ValueError, match="coefficient count mismatch"):
+            MultiLayerNetwork.load(path)
