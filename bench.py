@@ -25,6 +25,39 @@ BASELINES = {
 }
 # Measured 2026-07-29 on the build container CPU (see BASELINE.md):
 BASELINES["lenet_mnist_train"]["value"] = 1470.0
+# ResNet-50 training baseline: the north-star targets "match nd4j-cuda on
+# V100"; the reference publishes no numbers (SURVEY.md §6), so the planning
+# anchor from BASELINE.md is used: V100 fp32 ≈ 390 img/s.
+BASELINES["resnet50_imagenet_train"] = {"value": 390.0, "unit": "images/sec"}
+
+
+def bench_resnet50(steps: int, batch: int = 64, image_size: int = 224) -> dict:
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.data import DataSet
+    from deeplearning4j_tpu.models import ResNet50
+
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    model = ResNet50(num_classes=1000, image_size=image_size).init()
+    # bf16 compute on the MXU, fp32 master params
+    model.conf.global_conf.compute_dtype = "bfloat16"
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, 3, image_size, image_size).astype(np.float32)
+    y = np.eye(1000, dtype=np.float32)[rng.randint(0, 1000, batch)]
+    ds = DataSet(x, y)
+
+    model.fit(ds, epochs=1)  # warmup/compile
+    jax.block_until_ready(model._params)  # drain warmup before starting clock
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        model.fit(ds, epochs=1)
+    jax.block_until_ready(model._params)
+    dt = time.perf_counter() - t0
+    return {"metric": "resnet50_imagenet_train", "value": steps * batch / dt,
+            "unit": "images/sec"}
 
 
 def bench_lenet(steps: int) -> dict:
@@ -77,12 +110,15 @@ def bench_lenet(steps: int) -> dict:
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--config", default="lenet", choices=["lenet"])
-    parser.add_argument("--steps", type=int, default=64)
+    parser.add_argument("--config", default="resnet50", choices=["lenet", "resnet50"])
+    parser.add_argument("--steps", type=int, default=None)
+    parser.add_argument("--batch", type=int, default=64)
     args = parser.parse_args()
 
     if args.config == "lenet":
-        result = bench_lenet(args.steps)
+        result = bench_lenet(args.steps or 64)
+    else:
+        result = bench_resnet50(args.steps or 20, batch=args.batch)
 
     base = BASELINES.get(result["metric"], {}).get("value")
     result["vs_baseline"] = (result["value"] / base) if base else 1.0

@@ -1,0 +1,2 @@
+from .zoo import (AlexNet, Darknet19, LeNet, ResNet50, SimpleCNN, SqueezeNet,
+                  TextGenerationLSTM, UNet, VGG16, VGG19, ZooModel)

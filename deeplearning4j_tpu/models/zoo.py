@@ -1,0 +1,405 @@
+"""Model zoo.
+
+Reference: dl4j-zoo ``org.deeplearning4j.zoo.model.{LeNet, AlexNet, VGG16,
+VGG19, ResNet50, SqueezeNet, Darknet19, TinyYOLO, UNet, SimpleCNN,
+TextGenerationLSTM, ...}`` (SURVEY.md §2.3). Architectures follow the
+reference's published configurations; ``init_pretrained`` has no weight server
+in this environment (zero egress) and raises with instructions instead of
+silently downloading.
+
+All CNN zoo models use NCHW like the reference; ResNet-50 is the
+ComputationGraph flagship (north-star config 2).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+from ..learning.updaters import Adam, Nesterovs
+from ..nn.conf import layers as L
+from ..nn.conf.builder import NeuralNetConfiguration
+from ..nn.conf.inputs import InputType
+from ..nn.graph import (ComputationGraph, ComputationGraphConfiguration,
+                        ElementWiseVertex, MergeVertex)
+from ..nn.multilayer import MultiLayerNetwork
+
+
+class ZooModel:
+    """Base (reference org.deeplearning4j.zoo.ZooModel)."""
+
+    def init(self):
+        raise NotImplementedError
+
+    def init_pretrained(self, kind: str = "imagenet"):
+        raise RuntimeError(
+            f"{type(self).__name__}: pretrained weights unavailable — this "
+            "environment has no network egress. Train from scratch via init() "
+            "or load a local checkpoint with MultiLayerNetwork/"
+            "ComputationGraph.load().")
+
+    initPretrained = init_pretrained
+
+
+class LeNet(ZooModel):
+    """reference zoo.model.LeNet (MNIST)."""
+
+    def __init__(self, num_classes: int = 10, seed: int = 123):
+        self.num_classes = num_classes
+        self.seed = seed
+
+    def init(self) -> MultiLayerNetwork:
+        conf = (NeuralNetConfiguration.builder()
+                .seed(self.seed)
+                .updater(Nesterovs(learning_rate=0.01, momentum=0.9))
+                .activation("relu").weight_init("xavier")
+                .list()
+                .layer(L.ConvolutionLayer(n_out=20, kernel_size=(5, 5)))
+                .layer(L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(L.ConvolutionLayer(n_out=50, kernel_size=(5, 5)))
+                .layer(L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(L.DenseLayer(n_out=500))
+                .layer(L.OutputLayer(n_out=self.num_classes, loss="mcxent",
+                                     activation="softmax"))
+                .set_input_type(InputType.convolutional(28, 28, 1))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+
+class SimpleCNN(ZooModel):
+    """reference zoo.model.SimpleCNN."""
+
+    def __init__(self, num_classes: int = 10, input_shape=(3, 48, 48), seed: int = 123):
+        self.num_classes = num_classes
+        self.input_shape = input_shape
+        self.seed = seed
+
+    def init(self) -> MultiLayerNetwork:
+        c, h, w = self.input_shape
+        conf = (NeuralNetConfiguration.builder()
+                .seed(self.seed).updater(Adam(5e-4)).activation("relu")
+                .list()
+                .layer(L.ConvolutionLayer(n_out=16, kernel_size=(3, 3), padding=(1, 1)))
+                .layer(L.BatchNormalization())
+                .layer(L.ConvolutionLayer(n_out=16, kernel_size=(3, 3), padding=(1, 1)))
+                .layer(L.BatchNormalization())
+                .layer(L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(L.ConvolutionLayer(n_out=32, kernel_size=(3, 3), padding=(1, 1)))
+                .layer(L.BatchNormalization())
+                .layer(L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(L.DenseLayer(n_out=256))
+                .layer(L.DropoutLayer(rate=0.5))
+                .layer(L.OutputLayer(n_out=self.num_classes))
+                .set_input_type(InputType.convolutional(h, w, c))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+
+class AlexNet(ZooModel):
+    """reference zoo.model.AlexNet (single-tower variant)."""
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123):
+        self.num_classes = num_classes
+        self.seed = seed
+
+    def init(self) -> MultiLayerNetwork:
+        conf = (NeuralNetConfiguration.builder()
+                .seed(self.seed)
+                .updater(Nesterovs(learning_rate=1e-2, momentum=0.9))
+                .activation("relu").weight_init("relu")
+                .list()
+                .layer(L.ConvolutionLayer(n_out=96, kernel_size=(11, 11), stride=(4, 4)))
+                .layer(L.LocalResponseNormalization())
+                .layer(L.SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2)))
+                .layer(L.ConvolutionLayer(n_out=256, kernel_size=(5, 5), padding=(2, 2)))
+                .layer(L.LocalResponseNormalization())
+                .layer(L.SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2)))
+                .layer(L.ConvolutionLayer(n_out=384, kernel_size=(3, 3), padding=(1, 1)))
+                .layer(L.ConvolutionLayer(n_out=384, kernel_size=(3, 3), padding=(1, 1)))
+                .layer(L.ConvolutionLayer(n_out=256, kernel_size=(3, 3), padding=(1, 1)))
+                .layer(L.SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2)))
+                .layer(L.DenseLayer(n_out=4096, dropout=0.5))
+                .layer(L.DenseLayer(n_out=4096, dropout=0.5))
+                .layer(L.OutputLayer(n_out=self.num_classes))
+                .set_input_type(InputType.convolutional(227, 227, 3))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+
+class VGG16(ZooModel):
+    """reference zoo.model.VGG16."""
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123):
+        self.num_classes = num_classes
+        self.seed = seed
+
+    def _blocks(self) -> Sequence[Tuple[int, int]]:
+        return [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+
+    def init(self) -> MultiLayerNetwork:
+        lb = (NeuralNetConfiguration.builder()
+              .seed(self.seed)
+              .updater(Nesterovs(learning_rate=1e-2, momentum=0.9))
+              .activation("relu").weight_init("relu")
+              .list())
+        for n_convs, ch in self._blocks():
+            for _ in range(n_convs):
+                lb = lb.layer(L.ConvolutionLayer(n_out=ch, kernel_size=(3, 3),
+                                                 padding=(1, 1)))
+            lb = lb.layer(L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        conf = (lb.layer(L.DenseLayer(n_out=4096, dropout=0.5))
+                .layer(L.DenseLayer(n_out=4096, dropout=0.5))
+                .layer(L.OutputLayer(n_out=self.num_classes))
+                .set_input_type(InputType.convolutional(224, 224, 3))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+
+class VGG19(VGG16):
+    """reference zoo.model.VGG19."""
+
+    def _blocks(self):
+        return [(2, 64), (2, 128), (4, 256), (4, 512), (4, 512)]
+
+
+class ResNet50(ZooModel):
+    """reference zoo.model.ResNet50 — the north-star ComputationGraph config:
+    conv/identity bottleneck blocks with ElementWiseVertex(Add) residuals."""
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123,
+                 image_size: int = 224):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.image_size = image_size
+
+    def init(self) -> ComputationGraph:
+        gb = (ComputationGraphConfiguration
+              .graph_builder(NeuralNetConfiguration.builder()
+                             .seed(self.seed)
+                             .updater(Nesterovs(learning_rate=0.1, momentum=0.9))
+                             .activation("relu").weight_init("relu").l2(1e-4))
+              .add_inputs("input"))
+        # stem
+        gb.add_layer("stem_conv", L.ConvolutionLayer(
+            n_out=64, kernel_size=(7, 7), stride=(2, 2), padding=(3, 3),
+            has_bias=False, activation="identity"), "input")
+        gb.add_layer("stem_bn", L.BatchNormalization(activation="relu"), "stem_conv")
+        gb.add_layer("stem_pool", L.SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2), padding=(1, 1)), "stem_bn")
+
+        prev = "stem_pool"
+        stages = [(3, 64, 256, 1), (4, 128, 512, 2), (6, 256, 1024, 2),
+                  (3, 512, 2048, 2)]
+        for s, (blocks, mid, out_ch, first_stride) in enumerate(stages):
+            for b in range(blocks):
+                stride = first_stride if b == 0 else 1
+                name = f"s{s}b{b}"
+                # main path: 1x1 -> 3x3 -> 1x1 (bottleneck)
+                gb.add_layer(f"{name}_c1", L.ConvolutionLayer(
+                    n_out=mid, kernel_size=(1, 1), stride=(stride, stride),
+                    has_bias=False, activation="identity"), prev)
+                gb.add_layer(f"{name}_bn1", L.BatchNormalization(activation="relu"),
+                             f"{name}_c1")
+                gb.add_layer(f"{name}_c2", L.ConvolutionLayer(
+                    n_out=mid, kernel_size=(3, 3), padding=(1, 1),
+                    has_bias=False, activation="identity"), f"{name}_bn1")
+                gb.add_layer(f"{name}_bn2", L.BatchNormalization(activation="relu"),
+                             f"{name}_c2")
+                gb.add_layer(f"{name}_c3", L.ConvolutionLayer(
+                    n_out=out_ch, kernel_size=(1, 1), has_bias=False,
+                    activation="identity"), f"{name}_bn2")
+                gb.add_layer(f"{name}_bn3", L.BatchNormalization(activation="identity"),
+                             f"{name}_c3")
+                # shortcut
+                if b == 0:
+                    gb.add_layer(f"{name}_sc", L.ConvolutionLayer(
+                        n_out=out_ch, kernel_size=(1, 1), stride=(stride, stride),
+                        has_bias=False, activation="identity"), prev)
+                    gb.add_layer(f"{name}_scbn", L.BatchNormalization(
+                        activation="identity"), f"{name}_sc")
+                    shortcut = f"{name}_scbn"
+                else:
+                    shortcut = prev
+                gb.add_vertex(f"{name}_add", ElementWiseVertex(op="add"),
+                              f"{name}_bn3", shortcut)
+                gb.add_layer(f"{name}_relu", L.ActivationLayer(activation="relu"),
+                             f"{name}_add")
+                prev = f"{name}_relu"
+
+        gb.add_layer("avgpool", L.GlobalPoolingLayer(pooling_type="avg"), prev)
+        gb.add_layer("output", L.OutputLayer(n_out=self.num_classes, loss="mcxent",
+                                             activation="softmax"), "avgpool")
+        conf = (gb.set_outputs("output")
+                .set_input_types(InputType.convolutional(
+                    self.image_size, self.image_size, 3))
+                .build())
+        return ComputationGraph(conf).init()
+
+
+class SqueezeNet(ZooModel):
+    """reference zoo.model.SqueezeNet (fire modules via MergeVertex)."""
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123):
+        self.num_classes = num_classes
+        self.seed = seed
+
+    def init(self) -> ComputationGraph:
+        gb = (ComputationGraphConfiguration
+              .graph_builder(NeuralNetConfiguration.builder()
+                             .seed(self.seed).updater(Adam(1e-3))
+                             .activation("relu").weight_init("relu"))
+              .add_inputs("input"))
+        gb.add_layer("conv1", L.ConvolutionLayer(n_out=64, kernel_size=(3, 3),
+                                                 stride=(2, 2)), "input")
+        gb.add_layer("pool1", L.SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2)),
+                     "conv1")
+        prev = "pool1"
+
+        def fire(name, squeeze, expand, inp):
+            gb.add_layer(f"{name}_sq", L.ConvolutionLayer(
+                n_out=squeeze, kernel_size=(1, 1)), inp)
+            gb.add_layer(f"{name}_e1", L.ConvolutionLayer(
+                n_out=expand, kernel_size=(1, 1)), f"{name}_sq")
+            gb.add_layer(f"{name}_e3", L.ConvolutionLayer(
+                n_out=expand, kernel_size=(3, 3), padding=(1, 1)), f"{name}_sq")
+            gb.add_vertex(f"{name}_cat", MergeVertex(), f"{name}_e1", f"{name}_e3")
+            return f"{name}_cat"
+
+        prev = fire("fire2", 16, 64, prev)
+        prev = fire("fire3", 16, 64, prev)
+        gb.add_layer("pool3", L.SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2)), prev)
+        prev = fire("fire4", 32, 128, "pool3")
+        prev = fire("fire5", 32, 128, prev)
+        gb.add_layer("pool5", L.SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2)), prev)
+        prev = fire("fire6", 48, 192, "pool5")
+        prev = fire("fire7", 48, 192, prev)
+        prev = fire("fire8", 64, 256, prev)
+        prev = fire("fire9", 64, 256, prev)
+        gb.add_layer("drop", L.DropoutLayer(rate=0.5), prev)
+        gb.add_layer("conv10", L.ConvolutionLayer(n_out=self.num_classes,
+                                                  kernel_size=(1, 1)), "drop")
+        gb.add_layer("gap", L.GlobalPoolingLayer(pooling_type="avg"), "conv10")
+        gb.add_layer("output", L.LossLayer(loss="mcxent", activation="softmax"), "gap")
+        conf = (gb.set_outputs("output")
+                .set_input_types(InputType.convolutional(224, 224, 3)).build())
+        return ComputationGraph(conf).init()
+
+
+class Darknet19(ZooModel):
+    """reference zoo.model.Darknet19."""
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123, image_size: int = 224):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.image_size = image_size
+
+    def init(self) -> MultiLayerNetwork:
+        def conv_bn(lb, ch, k):
+            pad = (k // 2, k // 2) if k > 1 else (0, 0)
+            return (lb.layer(L.ConvolutionLayer(n_out=ch, kernel_size=(k, k),
+                                                padding=pad, has_bias=False,
+                                                activation="identity"))
+                    .layer(L.BatchNormalization(activation="leakyrelu")))
+
+        lb = (NeuralNetConfiguration.builder()
+              .seed(self.seed).updater(Nesterovs(1e-3, 0.9))
+              .weight_init("relu").list())
+        lb = conv_bn(lb, 32, 3)
+        lb = lb.layer(L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        lb = conv_bn(lb, 64, 3)
+        lb = lb.layer(L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        for chs in ([128, 64, 128], [256, 128, 256]):
+            for i, ch in enumerate(chs):
+                lb = conv_bn(lb, ch, 3 if i % 2 == 0 else 1)
+            lb = lb.layer(L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        for chs in ([512, 256, 512, 256, 512], [1024, 512, 1024, 512, 1024]):
+            for i, ch in enumerate(chs):
+                lb = conv_bn(lb, ch, 3 if i % 2 == 0 else 1)
+            if chs[0] == 512:
+                lb = lb.layer(L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        lb = lb.layer(L.ConvolutionLayer(n_out=self.num_classes, kernel_size=(1, 1)))
+        lb = lb.layer(L.GlobalPoolingLayer(pooling_type="avg"))
+        conf = (lb.layer(L.LossLayer(loss="mcxent", activation="softmax"))
+                .set_input_type(InputType.convolutional(self.image_size,
+                                                        self.image_size, 3))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+
+class UNet(ZooModel):
+    """reference zoo.model.UNet (segmentation; encoder-decoder with skip
+    merges)."""
+
+    def __init__(self, n_channels: int = 1, n_classes: int = 1, seed: int = 123,
+                 image_size: int = 128, base: int = 32):
+        self.n_channels = n_channels
+        self.n_classes = n_classes
+        self.seed = seed
+        self.image_size = image_size
+        self.base = base
+
+    def init(self) -> ComputationGraph:
+        gb = (ComputationGraphConfiguration
+              .graph_builder(NeuralNetConfiguration.builder()
+                             .seed(self.seed).updater(Adam(1e-4))
+                             .activation("relu").weight_init("relu"))
+              .add_inputs("input"))
+
+        def double_conv(name, ch, inp):
+            gb.add_layer(f"{name}_c1", L.ConvolutionLayer(
+                n_out=ch, kernel_size=(3, 3), padding=(1, 1)), inp)
+            gb.add_layer(f"{name}_c2", L.ConvolutionLayer(
+                n_out=ch, kernel_size=(3, 3), padding=(1, 1)), f"{name}_c1")
+            return f"{name}_c2"
+
+        b = self.base
+        d1 = double_conv("down1", b, "input")
+        gb.add_layer("pool1", L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)), d1)
+        d2 = double_conv("down2", b * 2, "pool1")
+        gb.add_layer("pool2", L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)), d2)
+        d3 = double_conv("down3", b * 4, "pool2")
+        gb.add_layer("pool3", L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)), d3)
+        mid = double_conv("mid", b * 8, "pool3")
+
+        gb.add_layer("up3", L.Deconvolution2D(n_out=b * 4, kernel_size=(2, 2),
+                                              stride=(2, 2)), mid)
+        gb.add_vertex("cat3", MergeVertex(), "up3", d3)
+        u3 = double_conv("upc3", b * 4, "cat3")
+        gb.add_layer("up2", L.Deconvolution2D(n_out=b * 2, kernel_size=(2, 2),
+                                              stride=(2, 2)), u3)
+        gb.add_vertex("cat2", MergeVertex(), "up2", d2)
+        u2 = double_conv("upc2", b * 2, "cat2")
+        gb.add_layer("up1", L.Deconvolution2D(n_out=b, kernel_size=(2, 2),
+                                              stride=(2, 2)), u2)
+        gb.add_vertex("cat1", MergeVertex(), "up1", d1)
+        u1 = double_conv("upc1", b, "cat1")
+        gb.add_layer("head", L.ConvolutionLayer(n_out=self.n_classes,
+                                                kernel_size=(1, 1),
+                                                activation="identity"), u1)
+        gb.add_layer("output", L.LossLayer(loss="binary_xent", activation="sigmoid"),
+                     "head")
+        conf = (gb.set_outputs("output")
+                .set_input_types(InputType.convolutional(
+                    self.image_size, self.image_size, self.n_channels))
+                .build())
+        return ComputationGraph(conf).init()
+
+
+class TextGenerationLSTM(ZooModel):
+    """reference zoo.model.TextGenerationLSTM (char-level LM)."""
+
+    def __init__(self, vocab_size: int, hidden: int = 256, seed: int = 123):
+        self.vocab_size = vocab_size
+        self.hidden = hidden
+        self.seed = seed
+
+    def init(self) -> MultiLayerNetwork:
+        conf = (NeuralNetConfiguration.builder()
+                .seed(self.seed).updater(Adam(2e-3))
+                .list()
+                .layer(L.LSTM(n_out=self.hidden))
+                .layer(L.LSTM(n_out=self.hidden))
+                .layer(L.RnnOutputLayer(n_out=self.vocab_size, loss="mcxent",
+                                        activation="softmax"))
+                .set_input_type(InputType.recurrent(self.vocab_size))
+                .build())
+        return MultiLayerNetwork(conf).init()

@@ -2,3 +2,6 @@ from .multilayer import MultiLayerNetwork
 from .conf.builder import NeuralNetConfiguration, MultiLayerConfiguration
 from .conf.inputs import InputType
 from .conf import layers
+from .graph import (ComputationGraph, ComputationGraphConfiguration, GraphBuilder,
+                    MergeVertex, ElementWiseVertex, SubsetVertex, ScaleVertex,
+                    ShiftVertex, L2NormalizeVertex, StackVertex, UnstackVertex)

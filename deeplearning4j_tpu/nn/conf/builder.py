@@ -36,7 +36,10 @@ class GlobalConf:
     dropout: float = 0.0
     grad_normalization: Optional[str] = None      # clip modes
     grad_norm_threshold: float = 1.0
-    dtype: str = "float32"
+    dtype: str = "float32"                # parameter storage dtype
+    # Mixed precision: forward/backward compute dtype (e.g. "bfloat16" for the
+    # MXU) while params stay in `dtype` and the loss reduces in float32.
+    compute_dtype: Optional[str] = None
 
 
 class NeuralNetConfiguration:
@@ -86,6 +89,11 @@ class Builder:
         self._conf.dtype = dtype
         return self
 
+    def compute_dtype(self, dtype: str) -> "Builder":
+        """bf16 compute with fp32 master params (TPU mixed precision)."""
+        self._conf.compute_dtype = dtype
+        return self
+
     def list(self) -> "ListBuilder":
         return ListBuilder(self._conf)
 
@@ -117,19 +125,25 @@ class ListBuilder:
         return mlc
 
     def _apply_defaults(self, l: L.Layer) -> None:
-        if l.activation is None and not isinstance(l, (L.OutputLayer, L.LossLayer)):
-            l.activation = self._conf.activation
-        if l.weight_init is None:
-            l.weight_init = self._conf.weight_init
-        if l.l1 is None:
-            l.l1 = self._conf.l1
-        if l.l2 is None:
-            l.l2 = self._conf.l2
-        if l.dropout is None:
-            l.dropout = self._conf.dropout
-        inner = getattr(l, "layer", None)
-        if isinstance(inner, L.Layer):
-            self._apply_defaults(inner)
+        apply_layer_defaults(l, self._conf)
+
+
+def apply_layer_defaults(l: L.Layer, gc: GlobalConf) -> None:
+    """Cascade global defaults onto a layer (shared by the list and graph
+    builders — reference NeuralNetConfiguration.Builder inheritance)."""
+    if l.activation is None and not isinstance(l, (L.OutputLayer, L.LossLayer)):
+        l.activation = gc.activation
+    if l.weight_init is None:
+        l.weight_init = gc.weight_init
+    if l.l1 is None:
+        l.l1 = gc.l1
+    if l.l2 is None:
+        l.l2 = gc.l2
+    if l.dropout is None:
+        l.dropout = gc.dropout
+    inner = getattr(l, "layer", None)
+    if isinstance(inner, L.Layer):
+        apply_layer_defaults(inner, gc)
 
 
 class MultiLayerConfiguration:

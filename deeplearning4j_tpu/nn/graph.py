@@ -1,0 +1,649 @@
+"""ComputationGraph — named-vertex DAG models (ResNet-50 et al).
+
+Reference: dl4j-nn ``org.deeplearning4j.nn.graph.ComputationGraph`` (~4.5k LoC)
++ ``conf.ComputationGraphConfiguration.GraphBuilder`` + vertex impls
+``nn.graph.vertex.impl.*`` (SURVEY.md §2.3, §3.2). The reference executes
+~2000 JNI-dispatched ops per ResNet-50 iteration; here the topologically-
+sorted vertex walk is traced ONCE and the whole iteration (fwd+bwd+updater)
+compiles to a single XLA module (SURVEY.md §7.1.1).
+
+Vertices: Merge, ElementWise (add/sub/mul/avg/max), Subset, Scale, Shift,
+L2Normalize, Stack, Unstack, Preprocessor — reference ``conf/graph/*``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import DataSet, MultiDataSet
+from ..ndarray.ndarray import NDArray
+from ..ndarray.rng import get_random
+from .conf import layers as L
+from .conf.builder import GlobalConf, MultiLayerConfiguration, _deser_obj, _ser_obj
+from .conf.inputs import CNNFlatInput, CNNInput, FFInput, InputType, RNNInput, cnn_to_ff, flat_to_cnn
+
+
+# --- graph vertices (reference conf/graph/*) ---------------------------------
+
+
+@dataclass
+class GraphVertex:
+    def output_type(self, *input_types: InputType) -> InputType:
+        return input_types[0]
+
+    def apply(self, *inputs):
+        raise NotImplementedError
+
+
+@dataclass
+class MergeVertex(GraphVertex):
+    """Concat along the feature/channel dim (reference MergeVertex)."""
+
+    def output_type(self, *ts):
+        t0 = ts[0]
+        if isinstance(t0, CNNInput):
+            return CNNInput(sum(t.channels for t in ts), t0.height, t0.width)
+        if isinstance(t0, FFInput):
+            return FFInput(sum(t.size for t in ts))
+        if isinstance(t0, RNNInput):
+            return RNNInput(sum(t.size for t in ts), t0.timesteps)
+        raise ValueError(f"cannot merge {ts}")
+
+    def apply(self, *inputs):
+        axis = 1 if inputs[0].ndim == 4 else -1
+        return jnp.concatenate(inputs, axis=axis)
+
+
+@dataclass
+class ElementWiseVertex(GraphVertex):
+    """reference ElementWiseVertex.Op: Add/Subtract/Product/Average/Max."""
+
+    op: str = "add"
+
+    def apply(self, *inputs):
+        op = self.op.lower()
+        if op == "add":
+            out = inputs[0]
+            for v in inputs[1:]:
+                out = out + v
+            return out
+        if op == "subtract":
+            if len(inputs) != 2:
+                raise ValueError(
+                    f"ElementWiseVertex(subtract) needs exactly 2 inputs, got {len(inputs)}")
+            return inputs[0] - inputs[1]
+        if op in ("product", "mul"):
+            out = inputs[0]
+            for v in inputs[1:]:
+                out = out * v
+            return out
+        if op in ("average", "avg"):
+            return sum(inputs) / len(inputs)
+        if op == "max":
+            out = inputs[0]
+            for v in inputs[1:]:
+                out = jnp.maximum(out, v)
+            return out
+        raise ValueError(f"unknown elementwise op {self.op!r}")
+
+
+@dataclass
+class SubsetVertex(GraphVertex):
+    """Feature-dim slice [from, to] inclusive (reference SubsetVertex)."""
+
+    from_idx: int = 0
+    to_idx: int = 0
+
+    def output_type(self, *ts):
+        n = self.to_idx - self.from_idx + 1
+        t = ts[0]
+        if isinstance(t, FFInput):
+            return FFInput(n)
+        if isinstance(t, CNNInput):
+            return CNNInput(n, t.height, t.width)
+        if isinstance(t, RNNInput):
+            return RNNInput(n, t.timesteps)
+        raise ValueError(f"subset of {t}")
+
+    def apply(self, *inputs):
+        x = inputs[0]
+        sl = slice(self.from_idx, self.to_idx + 1)
+        if x.ndim == 4:
+            return x[:, sl]
+        return x[..., sl]
+
+
+@dataclass
+class ScaleVertex(GraphVertex):
+    scale: float = 1.0
+
+    def apply(self, *inputs):
+        return inputs[0] * self.scale
+
+
+@dataclass
+class ShiftVertex(GraphVertex):
+    shift: float = 0.0
+
+    def apply(self, *inputs):
+        return inputs[0] + self.shift
+
+
+@dataclass
+class L2NormalizeVertex(GraphVertex):
+    eps: float = 1e-8
+
+    def apply(self, *inputs):
+        x = inputs[0]
+        norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=tuple(range(1, x.ndim)),
+                                keepdims=True))
+        return x / jnp.maximum(norm, self.eps)
+
+
+@dataclass
+class StackVertex(GraphVertex):
+    """Stack along batch dim (reference StackVertex)."""
+
+    def apply(self, *inputs):
+        return jnp.concatenate(inputs, axis=0)
+
+
+@dataclass
+class UnstackVertex(GraphVertex):
+    from_idx: int = 0
+    stack_size: int = 1
+
+    def apply(self, *inputs):
+        x = inputs[0]
+        n = x.shape[0] // self.stack_size
+        return x[self.from_idx * n:(self.from_idx + 1) * n]
+
+
+@dataclass
+class ReshapeVertex(GraphVertex):
+    shape: Tuple[int, ...] = ()
+
+    def apply(self, *inputs):
+        return inputs[0].reshape((inputs[0].shape[0],) + tuple(self.shape))
+
+
+# register vertex dataclasses with the config serde (builder._CLASSES)
+from .conf.builder import _CLASSES as _SERDE_CLASSES  # noqa: E402
+
+for _v in (GraphVertex, MergeVertex, ElementWiseVertex, SubsetVertex, ScaleVertex,
+           ShiftVertex, L2NormalizeVertex, StackVertex, UnstackVertex, ReshapeVertex):
+    _SERDE_CLASSES[_v.__name__] = _v
+
+
+# --- graph node wiring -------------------------------------------------------
+
+
+@dataclass
+class _Node:
+    name: str
+    kind: str                       # "input" | "layer" | "vertex"
+    layer: Optional[L.Layer] = None
+    vertex: Optional[GraphVertex] = None
+    inputs: List[str] = field(default_factory=list)
+    preprocessors: Dict[int, Any] = field(default_factory=dict)  # per-input adapters
+
+
+class ComputationGraphConfiguration:
+    def __init__(self, global_conf: GlobalConf):
+        self.global_conf = global_conf
+        self.network_inputs: List[str] = []
+        self.network_outputs: List[str] = []
+        self.nodes: Dict[str, _Node] = {}
+        self.order: List[str] = []
+        self.input_types: Dict[str, InputType] = {}
+        self.node_output_types: Dict[str, InputType] = {}
+
+    @staticmethod
+    def graph_builder(builder=None) -> "GraphBuilder":
+        from .conf.builder import Builder
+
+        b = builder._conf if builder is not None else GlobalConf()
+        return GraphBuilder(b)
+
+    # --- shape inference ------------------------------------------------
+    def set_input_types(self, *types: InputType) -> None:
+        assert len(types) == len(self.network_inputs), "one InputType per input"
+        self.input_types = dict(zip(self.network_inputs, types))
+        self.node_output_types = {}
+        for name in self.order:
+            node = self.nodes[name]
+            if node.kind == "input":
+                t = self.input_types[name]
+                if isinstance(t, CNNFlatInput):
+                    node.preprocessors[0] = flat_to_cnn(t)
+                    t = node.preprocessors[0].out_type
+                self.node_output_types[name] = t
+                continue
+            in_types = [self.node_output_types[i] for i in node.inputs]
+            if node.kind == "vertex":
+                self.node_output_types[name] = node.vertex.output_type(*in_types)
+                continue
+            # layer node: insert CNN→FF adapter when needed (reference
+            # automatic preprocessor insertion)
+            t = in_types[0]
+            ff_like = (L.DenseLayer, L.OutputLayer, L.ElementWiseMultiplicationLayer)
+            if isinstance(t, CNNInput) and isinstance(node.layer, ff_like) \
+                    and not isinstance(node.layer, L.RnnOutputLayer):
+                node.preprocessors[0] = cnn_to_ff(t)
+                t = node.preprocessors[0].out_type
+            self.node_output_types[name] = node.layer.set_input_type(t)
+
+    # --- serde -----------------------------------------------------------
+    def to_json(self) -> str:
+        import json
+
+        return json.dumps({
+            "format_version": 1,
+            "global": _ser_obj(self.global_conf),
+            "inputs": self.network_inputs,
+            "outputs": self.network_outputs,
+            "order": self.order,
+            "nodes": [
+                {"name": n.name, "kind": n.kind,
+                 "layer": _ser_obj(n.layer) if n.layer else None,
+                 "vertex": _ser_obj(n.vertex) if n.vertex else None,
+                 "inputs": n.inputs}
+                for n in (self.nodes[nm] for nm in self.order)
+            ],
+            "input_types": {k: _ser_obj(v) for k, v in self.input_types.items()},
+        }, indent=2)
+
+    @staticmethod
+    def from_json(s: str) -> "ComputationGraphConfiguration":
+        import json
+
+        d = json.loads(s)
+        conf = ComputationGraphConfiguration(_deser_obj(d["global"]))
+        conf.network_inputs = d["inputs"]
+        conf.network_outputs = d["outputs"]
+        for nd in d["nodes"]:
+            node = _Node(nd["name"], nd["kind"],
+                         _deser_obj(nd["layer"]) if nd["layer"] else None,
+                         _deser_obj(nd["vertex"]) if nd["vertex"] else None,
+                         nd["inputs"])
+            conf.nodes[node.name] = node
+            conf.order.append(node.name)
+        if d.get("input_types"):
+            conf.set_input_types(*[_deser_obj(v) for v in d["input_types"].values()])
+        return conf
+
+
+class GraphBuilder:
+    """reference ComputationGraphConfiguration.GraphBuilder."""
+
+    def __init__(self, global_conf: GlobalConf):
+        self._conf = ComputationGraphConfiguration(global_conf)
+
+    def add_inputs(self, *names: str) -> "GraphBuilder":
+        for n in names:
+            self._conf.network_inputs.append(n)
+            self._conf.nodes[n] = _Node(n, "input")
+            self._conf.order.append(n)
+        return self
+
+    addInputs = add_inputs
+
+    def add_layer(self, name: str, layer: L.Layer, *inputs: str) -> "GraphBuilder":
+        self._check_inputs(name, inputs)
+        layer.name = name
+        self._apply_defaults(layer)
+        self._conf.nodes[name] = _Node(name, "layer", layer=layer, inputs=list(inputs))
+        self._conf.order.append(name)
+        return self
+
+    addLayer = add_layer
+
+    def add_vertex(self, name: str, vertex: GraphVertex, *inputs: str) -> "GraphBuilder":
+        self._check_inputs(name, inputs)
+        self._conf.nodes[name] = _Node(name, "vertex", vertex=vertex, inputs=list(inputs))
+        self._conf.order.append(name)
+        return self
+
+    addVertex = add_vertex
+
+    def set_outputs(self, *names: str) -> "GraphBuilder":
+        self._conf.network_outputs = list(names)
+        return self
+
+    setOutputs = set_outputs
+
+    def set_input_types(self, *types: InputType) -> "GraphBuilder":
+        self._pending_types = types
+        return self
+
+    setInputTypes = set_input_types
+
+    def build(self) -> ComputationGraphConfiguration:
+        if not self._conf.network_outputs:
+            raise ValueError("set_outputs(...) required")
+        for out in self._conf.network_outputs:
+            if out not in self._conf.nodes:
+                raise ValueError(f"unknown output node {out!r}")
+        types = getattr(self, "_pending_types", None)
+        if types:
+            self._conf.set_input_types(*types)
+        return self._conf
+
+    def _check_inputs(self, name: str, inputs: Sequence[str]) -> None:
+        if name in self._conf.nodes:
+            raise ValueError(f"duplicate node name {name!r}")
+        if not inputs:
+            raise ValueError(f"node {name!r} needs at least one input")
+        for i in inputs:
+            if i not in self._conf.nodes:
+                raise ValueError(f"node {name!r}: unknown input {i!r} "
+                                 f"(declare nodes in topological order)")
+
+    def _apply_defaults(self, l: L.Layer) -> None:
+        from .conf.builder import apply_layer_defaults
+
+        apply_layer_defaults(l, self._conf.global_conf)
+
+
+class ComputationGraph:
+    """Runtime twin of the configuration (reference ComputationGraph)."""
+
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self._params: Dict[str, Dict[str, jnp.ndarray]] = {}
+        self._states: Dict[str, Dict[str, jnp.ndarray]] = {}
+        self._updater_state = None
+        self._initialized = False
+        self._iteration = 0
+        self._epoch = 0
+        self._listeners: List[Any] = []
+        self._fit_step = None
+        self._infer_fn = None
+        self._score_dev = None
+
+    @property
+    def score_value(self) -> float:
+        return float(self._score_dev) if self._score_dev is not None else float("nan")
+
+    @score_value.setter
+    def score_value(self, v) -> None:
+        self._score_dev = v
+
+    # ------------------------------------------------------------------
+    def init(self, seed: Optional[int] = None) -> "ComputationGraph":
+        if not self.conf.node_output_types:
+            raise ValueError("configuration needs set_input_types(...) before init()")
+        key = jax.random.PRNGKey(seed if seed is not None else self.conf.global_conf.seed)
+        dtype = jnp.dtype(self.conf.global_conf.dtype)
+        for name in self.conf.order:
+            node = self.conf.nodes[name]
+            if node.kind == "layer":
+                key, sub = jax.random.split(key)
+                self._params[name] = (node.layer.init_params(sub, dtype)
+                                      if node.layer.has_params else {})
+                self._states[name] = node.layer.init_state()
+        self._initialized = True
+        return self
+
+    def set_listeners(self, *listeners) -> None:
+        self._listeners = list(listeners)
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(self._params))
+
+    def params(self) -> NDArray:
+        leaves = jax.tree.leaves(self._params)
+        if not leaves:
+            return NDArray(jnp.zeros((0,)))
+        return NDArray(jnp.concatenate([l.ravel() for l in leaves]))
+
+    # --- forward ---------------------------------------------------------
+    def _forward(self, params, states, inputs: Dict[str, jnp.ndarray],
+                 training: bool, rng, to_preout: bool = False):
+        cd = self.conf.global_conf.compute_dtype
+        if cd:
+            ct = jnp.dtype(cd)
+            cast = lambda a: (a.astype(ct)
+                              if jnp.issubdtype(a.dtype, jnp.floating) else a)
+            params = jax.tree.map(cast, params)
+            inputs = {k: cast(v) for k, v in inputs.items()}
+        acts: Dict[str, jnp.ndarray] = {}
+        new_states = dict(states)
+        out_set = set(self.conf.network_outputs)
+        for name in self.conf.order:
+            node = self.conf.nodes[name]
+            if node.kind == "input":
+                x = inputs[name]
+                if 0 in node.preprocessors:
+                    x = node.preprocessors[0](x)
+                acts[name] = x
+                continue
+            ins = [acts[i] for i in node.inputs]
+            if node.kind == "vertex":
+                acts[name] = node.vertex.apply(*ins)
+                continue
+            x = ins[0]
+            if 0 in node.preprocessors:
+                x = node.preprocessors[0](x)
+            rng, sub = jax.random.split(rng)
+            if to_preout and name in out_set and isinstance(node.layer, (L.OutputLayer, L.LossLayer)):
+                x = node.layer._maybe_dropout(x, training, sub)
+                head_params = params.get(name, {})
+                if cd:
+                    # run the head matmul + downstream loss in fp32 (matches
+                    # the MultiLayerNetwork mixed-precision policy)
+                    f32 = lambda a: (a.astype(jnp.float32)
+                                     if jnp.issubdtype(a.dtype, jnp.floating) else a)
+                    head_params = jax.tree.map(f32, head_params)
+                    x = f32(x)
+                acts[name] = node.layer.pre_output(head_params, x)
+            else:
+                y, st = node.layer.apply(params.get(name, {}), x,
+                                         states.get(name, {}), training, sub)
+                acts[name] = y
+                if st:
+                    new_states[name] = st
+        return acts, new_states
+
+    def output(self, *inputs, training: bool = False) -> List[NDArray]:
+        self._check_init()
+        feed = self._bind_inputs(inputs)
+        if self._infer_fn is None:
+            def infer(params, states, ins, key, train: bool):
+                acts, _ = self._forward(params, states, ins, train, key)
+                return tuple(acts[o] for o in self.conf.network_outputs)
+
+            self._infer_fn = jax.jit(infer, static_argnames=("train",))
+        outs = self._infer_fn(self._params, self._states, feed,
+                              get_random().next_key(), train=training)
+        return [NDArray(o) for o in outs]
+
+    def _bind_inputs(self, inputs) -> Dict[str, jnp.ndarray]:
+        names = self.conf.network_inputs
+        if len(inputs) == 1 and isinstance(inputs[0], dict):
+            return {k: jnp.asarray(v.value if isinstance(v, NDArray) else v)
+                    for k, v in inputs[0].items()}
+        if len(inputs) != len(names):
+            raise ValueError(f"expected {len(names)} inputs {names}, got {len(inputs)}")
+        return {n: jnp.asarray(v.value if isinstance(v, NDArray) else v)
+                for n, v in zip(names, inputs)}
+
+    # --- loss ------------------------------------------------------------
+    def _loss(self, params, states, inputs, labels: Dict[str, jnp.ndarray],
+              masks, training, rng):
+        acts, new_states = self._forward(params, states, inputs, training, rng,
+                                         to_preout=True)
+        total = 0.0
+        for out_name in self.conf.network_outputs:
+            node = self.conf.nodes[out_name]
+            if not isinstance(node.layer, (L.OutputLayer, L.LossLayer)):
+                continue
+            pre = acts[out_name]
+            # under reduced-precision compute, reduce the loss in fp32; leave
+            # fp64 runs (gradient checks) untouched
+            if self.conf.global_conf.compute_dtype and \
+                    jnp.issubdtype(pre.dtype, jnp.floating):
+                pre = pre.astype(jnp.float32)
+            mask = masks.get(out_name) if masks else None
+            total = total + node.layer.loss.compute_score(
+                labels[out_name], pre, node.layer.activation, mask, average=True)
+        gc = self.conf.global_conf
+        reg = 0.0
+        for lname, lp in params.items():
+            layer = self.conf.nodes[lname].layer
+            l1 = layer.l1 if layer.l1 is not None else gc.l1
+            l2 = layer.l2 if layer.l2 is not None else gc.l2
+            for pname, w in lp.items():
+                if pname in ("b", "beta"):
+                    continue
+                if l2:
+                    reg = reg + 0.5 * l2 * jnp.sum(jnp.square(w))
+                if l1:
+                    reg = reg + l1 * jnp.sum(jnp.abs(w))
+        return total + reg, new_states
+
+    def score(self, ds: Union[DataSet, MultiDataSet], training: bool = False) -> float:
+        self._check_init()
+        inputs, labels, masks = self._bind_dataset(ds)
+        loss, _ = self._loss(self._params, self._states, inputs, labels, masks,
+                             training, get_random().next_key())
+        return float(loss)
+
+    def compute_gradient_and_score(self, ds):
+        self._check_init()
+        inputs, labels, masks = self._bind_dataset(ds)
+        key = jax.random.PRNGKey(0)
+
+        def loss_fn(params):
+            loss, _ = self._loss(params, self._states, inputs, labels, masks, False, key)
+            return loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(self._params)
+        self.score_value = float(loss)
+        return grads, self.score_value
+
+    def _bind_dataset(self, ds):
+        in_names = self.conf.network_inputs
+        out_names = [o for o in self.conf.network_outputs
+                     if isinstance(self.conf.nodes[o].layer, (L.OutputLayer, L.LossLayer))]
+        if isinstance(ds, MultiDataSet):
+            inputs = {n: jnp.asarray(f.value) for n, f in zip(in_names, ds.features)}
+            labels = {n: jnp.asarray(l.value) for n, l in zip(out_names, ds.labels)}
+            masks = {}
+            if ds.labels_masks:
+                masks = {n: jnp.asarray(m.value)
+                         for n, m in zip(out_names, ds.labels_masks) if m is not None}
+            return inputs, labels, masks
+        inputs = {in_names[0]: jnp.asarray(ds.features.value)}
+        labels = {out_names[0]: jnp.asarray(ds.labels.value)}
+        masks = {}
+        if ds.labels_mask is not None:
+            masks = {out_names[0]: jnp.asarray(ds.labels_mask.value)}
+        return inputs, labels, masks
+
+    # --- training --------------------------------------------------------
+    def _build_fit_step(self):
+        gc = self.conf.global_conf
+        updater = gc.updater
+
+        def step(params, states, upd_state, inputs, labels, masks, key, iteration):
+            def loss_fn(p):
+                loss, new_states = self._loss(p, states, inputs, labels, masks,
+                                              True, key)
+                return loss, new_states
+
+            (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            if gc.grad_normalization:
+                from .multilayer import _normalize_gradients
+
+                grads = _normalize_gradients(grads, gc.grad_normalization,
+                                             gc.grad_norm_threshold)
+            new_params, new_upd = updater.apply(grads, upd_state, params, iteration)
+            return new_params, new_states, new_upd, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def fit(self, data, epochs: int = 1) -> None:
+        self._check_init()
+        if self._updater_state is None:
+            self._updater_state = self.conf.global_conf.updater.init(self._params)
+        if self._fit_step is None:
+            self._fit_step = self._build_fit_step()
+        for _ in range(max(1, epochs)):
+            for ds in _iter_graph_data(data):
+                inputs, labels, masks = self._bind_dataset(ds)
+                key = get_random().next_key()
+                (self._params, self._states, self._updater_state, loss) = \
+                    self._fit_step(self._params, self._states, self._updater_state,
+                                   inputs, labels, masks, key,
+                                   jnp.asarray(self._iteration))
+                self._iteration += 1
+                # keep the loss on device: forcing float() here would sync the
+                # pipeline every step (costly through the TPU tunnel)
+                self._score_dev = loss
+                for lst in self._listeners:
+                    lst.iteration_done(self, self._iteration, self.score_value)
+            self._epoch += 1
+            for lst in self._listeners:
+                if hasattr(lst, "epoch_done"):
+                    lst.epoch_done(self, self._epoch)
+
+    def evaluate(self, data):
+        from ..eval.evaluation import Evaluation
+
+        ev = Evaluation()
+        for ds in _iter_graph_data(data):
+            if isinstance(ds, MultiDataSet):
+                out = self.output(*[f for f in ds.features])[0]
+                ev.eval(ds.labels[0].to_numpy(), out.to_numpy())
+            else:
+                out = self.output(ds.features)[0]
+                ev.eval(ds.labels.to_numpy(), out.to_numpy(),
+                        ds.labels_mask.to_numpy() if ds.labels_mask is not None else None)
+        return ev
+
+    # --- persistence ------------------------------------------------------
+    def save(self, path: str, save_updater: bool = False) -> None:
+        from ..util.model_serializer import write_model
+
+        write_model(self, path, save_updater)
+
+    @staticmethod
+    def load(path: str, load_updater: bool = False) -> "ComputationGraph":
+        from ..util.model_serializer import restore_computation_graph
+
+        return restore_computation_graph(path, load_updater)
+
+    def summary(self) -> str:
+        lines = [f"{'node':<28}{'kind':<10}{'out type':<34}{'params':<10}"]
+        total = 0
+        for name in self.conf.order:
+            node = self.conf.nodes[name]
+            n = (sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self._params.get(name, {})))
+                 if self._initialized else 0)
+            total += n
+            ot = self.conf.node_output_types.get(name, "?")
+            kind = node.kind if node.kind != "layer" else type(node.layer).__name__
+            lines.append(f"{name:<28}{kind[:24]:<10}{str(ot):<34}{n:<10}")
+        lines.append(f"Total params: {total}")
+        return "\n".join(lines)
+
+    def _check_init(self):
+        if not self._initialized:
+            raise ValueError("call init() first")
+
+
+def _iter_graph_data(data):
+    if hasattr(data, "reset") and hasattr(data, "__iter__"):
+        data.reset()
+        yield from data
+        return
+    if isinstance(data, (DataSet, MultiDataSet)):
+        yield data
+        return
+    raise TypeError(f"cannot iterate data of type {type(data)}")

@@ -38,7 +38,15 @@ class MultiLayerNetwork:
         self._listeners: List[Any] = []
         self._fit_step = None
         self._infer_fn = None
-        self.score_value: float = float("nan")
+        self._score_dev = None
+
+    @property
+    def score_value(self) -> float:
+        return float(self._score_dev) if self._score_dev is not None else float("nan")
+
+    @score_value.setter
+    def score_value(self, v) -> None:
+        self._score_dev = v
 
     # ------------------------------------------------------------------
     def init(self, seed: Optional[int] = None) -> "MultiLayerNetwork":
@@ -86,9 +94,20 @@ class MultiLayerNetwork:
     def param_table(self, layer_idx: int) -> Dict[str, NDArray]:
         return {k: NDArray(v) for k, v in self._params[layer_idx].items()}
 
+    def _cast_compute(self, params, x):
+        """Mixed precision: cast activations+params to the compute dtype (bf16
+        on TPU); grads flow back through the cast to fp32 master params."""
+        cd = self.conf.global_conf.compute_dtype
+        if not cd:
+            return params, x
+        ct = jnp.dtype(cd)
+        cast = lambda a: a.astype(ct) if jnp.issubdtype(a.dtype, jnp.floating) else a
+        return jax.tree.map(cast, params), cast(x)
+
     # --- forward ---------------------------------------------------------
     def _forward(self, params, states, x, training: bool, rng):
         """Single traced forward pass through preprocessors + layers."""
+        params, x = self._cast_compute(params, x)
         new_states = []
         for i, layer in enumerate(self.layers):
             pre = self.conf.preprocessors.get(i)
@@ -101,6 +120,7 @@ class MultiLayerNetwork:
 
     def _forward_to_preout(self, params, states, x, training: bool, rng):
         """Forward stopping BEFORE the output head's activation (for loss)."""
+        params, x = self._cast_compute(params, x)
         new_states = []
         for i, layer in enumerate(self.layers[:-1]):
             pre = self.conf.preprocessors.get(i)
@@ -154,7 +174,18 @@ class MultiLayerNetwork:
         if not isinstance(out_layer, (L.OutputLayer, L.LossLayer)):
             raise ValueError("last layer must be an OutputLayer/LossLayer to train")
         pre, new_states = self._forward_to_preout(params, states, x, training, rng)
-        data_loss = out_layer.compute_score(params[-1], pre, labels, mask, average=True)
+        # under reduced-precision compute, run the head + loss reduction in
+        # fp32; leave fp64 runs (gradient checks) untouched
+        if self.conf.global_conf.compute_dtype:
+            head_params = jax.tree.map(
+                lambda a: (a.astype(jnp.float32)
+                           if jnp.issubdtype(a.dtype, jnp.floating) else a),
+                params[-1])
+            if jnp.issubdtype(pre.dtype, jnp.floating):
+                pre = pre.astype(jnp.float32)
+        else:
+            head_params = params[-1]
+        data_loss = out_layer.compute_score(head_params, pre, labels, mask, average=True)
         reg = 0.0
         gc = self.conf.global_conf
         for lp, layer in zip(params, self.layers):
@@ -234,7 +265,8 @@ class MultiLayerNetwork:
                                         self._updater_state, x, y, mask, key,
                                         jnp.asarray(self._iteration))
                 self._iteration += 1
-                self.score_value = float(loss)
+                # device scalar; float() only on access (avoids per-step sync)
+                self._score_dev = loss
                 for lst in self._listeners:
                     lst.iteration_done(self, self._iteration, self.score_value)
             self._epoch += 1

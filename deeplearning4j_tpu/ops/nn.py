@@ -49,10 +49,11 @@ def conv2d(x, w, b=None, strides=(1, 1), padding=(0, 0), dilation=(1, 1),
         x.shape, w.shape,
         ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "OIHW", "NHWC"),
     )
+    # no preferred_element_type: the TPU MXU already accumulates bf16 inputs
+    # in fp32, and mixing it with AD breaks the transpose-conv dtype rule
     out = lax.conv_general_dilated(
         x, w, window_strides=(sh, sw), padding=_conv_padding(padding, w.shape[2:], (sh, sw)),
         rhs_dilation=(dh, dw), dimension_numbers=dn,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
     )
     if b is not None:
         bshape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)

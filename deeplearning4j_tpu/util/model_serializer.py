@@ -3,8 +3,9 @@
 Reference: dl4j-nn ``org.deeplearning4j.util.ModelSerializer`` (SURVEY.md
 §5.4): zip = configuration.json + coefficients.bin (flattened params) +
 updaterState.bin + optional normalizer.bin. Same inventory here with npz
-payloads; the JSON topology comes from MultiLayerConfiguration.to_json so a
-config round-trips independently of weights.
+payloads; one shared writer/restorer serves both MultiLayerNetwork and
+ComputationGraph (``writeModel/restoreMultiLayerNetwork/
+restoreComputationGraph`` contract).
 """
 
 from __future__ import annotations
@@ -19,70 +20,80 @@ import numpy as np
 
 _CONF_ENTRY = "configuration.json"
 _COEFF_ENTRY = "coefficients.npz"
+_STATES_ENTRY = "states.npz"
 _UPDATER_ENTRY = "updaterState.npz"
 _NORMALIZER_ENTRY = "normalizer.json"
 _META_ENTRY = "meta.json"
 
 
+def _savez_leaves(tree) -> bytes:
+    leaves, _ = jax.tree.flatten(tree)
+    buf = io.BytesIO()
+    np.savez(buf, **{str(i): np.asarray(l) for i, l in enumerate(leaves)})
+    return buf.getvalue()
+
+
+def _load_into_tree(data: bytes, template, what: str, cast_to_template: bool = False):
+    arrays = np.load(io.BytesIO(data))
+    leaves, treedef = jax.tree.flatten(template)
+    if len(arrays.files) != len(leaves):
+        raise ValueError(
+            f"{what} count mismatch: archive has {len(arrays.files)}, "
+            f"configuration implies {len(leaves)}")
+    restored = [np.asarray(arrays[str(i)]) for i in range(len(leaves))]
+    if cast_to_template:
+        restored = [r.astype(np.asarray(t).dtype) for r, t in zip(restored, leaves)]
+    return jax.tree.unflatten(treedef, restored)
+
+
 def write_model(model, path: str, save_updater: bool = False,
                 normalizer=None) -> None:
+    """Shared writer for MultiLayerNetwork and ComputationGraph."""
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
         zf.writestr(_CONF_ENTRY, model.conf.to_json())
-        leaves, _ = jax.tree.flatten(model._params)
-        buf = io.BytesIO()
-        np.savez(buf, **{str(i): np.asarray(l) for i, l in enumerate(leaves)})
-        zf.writestr(_COEFF_ENTRY, buf.getvalue())
-        # batchnorm running stats etc.
-        sleaves, _ = jax.tree.flatten(model._states)
-        sbuf = io.BytesIO()
-        np.savez(sbuf, **{str(i): np.asarray(l) for i, l in enumerate(sleaves)})
-        zf.writestr("states.npz", sbuf.getvalue())
+        zf.writestr(_COEFF_ENTRY, _savez_leaves(model._params))
+        zf.writestr(_STATES_ENTRY, _savez_leaves(model._states))
         zf.writestr(_META_ENTRY, json.dumps({
             "iteration": model._iteration, "epoch": model._epoch,
-            "format_version": 1,
+            "kind": type(model).__name__, "format_version": 1,
         }))
         if save_updater and model._updater_state is not None:
-            uleaves, _ = jax.tree.flatten(model._updater_state)
-            ubuf = io.BytesIO()
-            np.savez(ubuf, **{str(i): np.asarray(l) for i, l in enumerate(uleaves)})
-            zf.writestr(_UPDATER_ENTRY, ubuf.getvalue())
+            zf.writestr(_UPDATER_ENTRY, _savez_leaves(model._updater_state))
         if normalizer is not None:
             zf.writestr(_NORMALIZER_ENTRY, json.dumps(normalizer.to_json()))
+
+
+def _restore(path: str, model_cls, conf_cls, load_updater: bool):
+    with zipfile.ZipFile(path) as zf:
+        conf = conf_cls.from_json(zf.read(_CONF_ENTRY).decode())
+        model = model_cls(conf)
+        model.init()
+        model._params = _load_into_tree(zf.read(_COEFF_ENTRY), model._params,
+                                        "coefficient", cast_to_template=True)
+        if _STATES_ENTRY in zf.namelist():
+            model._states = _load_into_tree(zf.read(_STATES_ENTRY), model._states,
+                                            "state")
+        meta = json.loads(zf.read(_META_ENTRY))
+        model._iteration = meta.get("iteration", 0)
+        model._epoch = meta.get("epoch", 0)
+        if load_updater and _UPDATER_ENTRY in zf.namelist():
+            state0 = conf.global_conf.updater.init(model._params)
+            model._updater_state = _load_into_tree(zf.read(_UPDATER_ENTRY), state0,
+                                                   "updater state")
+    return model
 
 
 def restore_multi_layer_network(path: str, load_updater: bool = False):
     from ..nn.conf.builder import MultiLayerConfiguration
     from ..nn.multilayer import MultiLayerNetwork
 
-    with zipfile.ZipFile(path) as zf:
-        conf = MultiLayerConfiguration.from_json(zf.read(_CONF_ENTRY).decode())
-        model = MultiLayerNetwork(conf)
-        model.init()
-        coeffs = np.load(io.BytesIO(zf.read(_COEFF_ENTRY)))
-        leaves, treedef = jax.tree.flatten(model._params)
-        if len(coeffs.files) != len(leaves):
-            raise ValueError(
-                f"coefficient count mismatch: archive has {len(coeffs.files)}, "
-                f"configuration implies {len(leaves)}")
-        restored = [np.asarray(coeffs[str(i)]) for i in range(len(leaves))]
-        model._params = jax.tree.unflatten(
-            treedef, [l.astype(np.asarray(o).dtype) for l, o in zip(restored, leaves)])
-        if "states.npz" in zf.namelist():
-            states = np.load(io.BytesIO(zf.read("states.npz")))
-            sleaves, streedef = jax.tree.flatten(model._states)
-            model._states = jax.tree.unflatten(
-                streedef, [np.asarray(states[str(i)]) for i in range(len(sleaves))])
-        meta = json.loads(zf.read(_META_ENTRY))
-        model._iteration = meta.get("iteration", 0)
-        model._epoch = meta.get("epoch", 0)
-        if load_updater and _UPDATER_ENTRY in zf.namelist():
-            upd = model.conf.global_conf.updater
-            state0 = upd.init(model._params)
-            uleaves, utreedef = jax.tree.flatten(state0)
-            data = np.load(io.BytesIO(zf.read(_UPDATER_ENTRY)))
-            model._updater_state = jax.tree.unflatten(
-                utreedef, [np.asarray(data[str(i)]) for i in range(len(uleaves))])
-    return model
+    return _restore(path, MultiLayerNetwork, MultiLayerConfiguration, load_updater)
+
+
+def restore_computation_graph(path: str, load_updater: bool = False):
+    from ..nn.graph import ComputationGraph, ComputationGraphConfiguration
+
+    return _restore(path, ComputationGraph, ComputationGraphConfiguration, load_updater)
 
 
 def restore_normalizer(path: str):
