@@ -1,0 +1,9 @@
+from .mesh import make_mesh, replicated, data_sharded, shard_batch
+from .accumulator import (GradientsAccumulator, DenseAllReduceAccumulator,
+                          EncodedGradientsAccumulator, ThresholdAlgorithm,
+                          AdaptiveThresholdAlgorithm, FixedThresholdAlgorithm,
+                          TargetSparsityThresholdAlgorithm)
+from .wrapper import ParallelWrapper
+from .sharding import tp_param_specs, tp_shardings, apply_tp
+from .inference import ParallelInference
+from .distributed import SharedTrainingMaster, initialize, shutdown

@@ -1,0 +1,109 @@
+"""Multi-host distributed training — control plane + master API.
+
+Reference: dl4j-scaleout Spark masters + the Aeron parameter-server fabric
+(``SharedTrainingMaster``, ``ModelParameterServer``, ``MeshOrganizer``,
+``AeronUdpTransport``; SURVEY.md §2.4, §5.8). The TPU-native pivot:
+
+- data plane: XLA collectives over ICI/DCN compiled into the step — no
+  message library, no spanning-tree mesh, no encode/decode;
+- control plane (the role Aeron's handshake/heartbeat/mesh played):
+  the jax coordination service (``jax.distributed.initialize``);
+- elasticity: the async mesh's node-remap is replaced by checkpoint-restart
+  (orbax-style atomic checkpoints + resume; SURVEY.md §5.3) — XLA collectives
+  are synchronous, so a lost host means restart-from-step-N, and that path is
+  what ``SharedTrainingMaster.fit`` wires in via its CheckpointListener.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Bootstrap the multi-host control plane (jax coordination service).
+
+    Mirrors ``jax.distributed.initialize`` with env-var fallbacks
+    (DL4J_TPU_COORDINATOR / _NUM_PROCS / _PROC_ID), the analog of the
+    reference's VoidConfiguration(controllerAddress=...).
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get("DL4J_TPU_COORDINATOR")
+    if num_processes is None and "DL4J_TPU_NUM_PROCS" in os.environ:
+        num_processes = int(os.environ["DL4J_TPU_NUM_PROCS"])
+    if process_id is None and "DL4J_TPU_PROC_ID" in os.environ:
+        process_id = int(os.environ["DL4J_TPU_PROC_ID"])
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def shutdown() -> None:
+    import jax
+
+    jax.distributed.shutdown()
+
+
+class SharedTrainingMaster:
+    """Reference SharedTrainingMaster-shaped front for synchronous multi-host
+    SPMD: same builder surface (workers/batch sizes/threshold config accepted),
+    fit() delegates to a ParallelWrapper over ALL global devices, and a
+    checkpoint listener provides the restart-based fault story."""
+
+    class Builder:
+        def __init__(self, batch_size_per_worker: int = 32):
+            self._batch = batch_size_per_worker
+            self._workers_per_node: Optional[int] = None
+            self._checkpoint_dir: Optional[str] = None
+            self._checkpoint_every = 0
+
+        def workers_per_node(self, n: int) -> "SharedTrainingMaster.Builder":
+            self._workers_per_node = n
+            return self
+
+        def threshold_algorithm(self, alg) -> "SharedTrainingMaster.Builder":
+            self._threshold = alg  # recorded for parity; dense psum path (module doc)
+            return self
+
+        def checkpoint(self, directory: str, every_n_iterations: int
+                       ) -> "SharedTrainingMaster.Builder":
+            self._checkpoint_dir = directory
+            self._checkpoint_every = every_n_iterations
+            return self
+
+        def build(self) -> "SharedTrainingMaster":
+            return SharedTrainingMaster(self._batch, self._workers_per_node,
+                                        self._checkpoint_dir, self._checkpoint_every)
+
+    def __init__(self, batch_size_per_worker: int,
+                 workers_per_node: Optional[int],
+                 checkpoint_dir: Optional[str], checkpoint_every: int):
+        self.batch_size_per_worker = batch_size_per_worker
+        self.workers_per_node = workers_per_node
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = checkpoint_every
+
+    def fit(self, model, data, epochs: int = 1):
+        """Train `model` over all global devices; resumes from the latest
+        checkpoint in `checkpoint_dir` when one exists (kill-resume story)."""
+        import jax
+
+        from ..optimize.listeners import CheckpointListener
+        from .wrapper import ParallelWrapper
+
+        if self.checkpoint_dir:
+            last = CheckpointListener.last_checkpoint(self.checkpoint_dir)
+            if last is not None:
+                model = type(model).load(last, load_updater=True)
+        pw = (ParallelWrapper.Builder(model)
+              .workers(len(jax.devices()))
+              .training_mode("shared_gradients")
+              .build())
+        if self.checkpoint_dir and self.checkpoint_every:
+            pw.set_listeners(CheckpointListener(
+                self.checkpoint_dir, save_every_n_iterations=self.checkpoint_every))
+        pw.fit(data, epochs=epochs)
+        return model

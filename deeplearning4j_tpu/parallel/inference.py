@@ -1,0 +1,121 @@
+"""ParallelInference — request batching for serving.
+
+Reference: dl4j-scaleout ``org.deeplearning4j.parallelism.ParallelInference``
+(SURVEY.md §2.4, §3.7): requests queue up, a batching observer coalesces up to
+``batch_limit`` of them, a worker runs the model, results scatter back to
+futures. On TPU one jitted apply replaces the per-device replica pool — the
+chip is time-shared by the XLA queue — so the host-side micro-batcher is the
+part worth keeping.
+
+Modes (reference InferenceMode): SEQUENTIAL (run immediately, no batching),
+BATCHED (coalesce); INPLACE maps to SEQUENTIAL.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ..ndarray.ndarray import NDArray
+
+
+class ParallelInference:
+    class Builder:
+        def __init__(self, model):
+            self._model = model
+            self._mode = "batched"
+            self._batch_limit = 32
+            self._queue_limit = 64
+            self._max_wait_ms = 5.0
+
+        def inference_mode(self, mode: str) -> "ParallelInference.Builder":
+            self._mode = mode.lower()
+            return self
+
+        inferenceMode = inference_mode
+
+        def batch_limit(self, n: int) -> "ParallelInference.Builder":
+            self._batch_limit = n
+            return self
+
+        batchLimit = batch_limit
+
+        def queue_limit(self, n: int) -> "ParallelInference.Builder":
+            self._queue_limit = n
+            return self
+
+        def max_wait_ms(self, ms: float) -> "ParallelInference.Builder":
+            self._max_wait_ms = ms
+            return self
+
+        def build(self) -> "ParallelInference":
+            return ParallelInference(self._model, self._mode, self._batch_limit,
+                                     self._queue_limit, self._max_wait_ms)
+
+    def __init__(self, model, mode: str = "batched", batch_limit: int = 32,
+                 queue_limit: int = 64, max_wait_ms: float = 5.0):
+        self.model = model
+        self.mode = "sequential" if mode in ("sequential", "inplace") else "batched"
+        self.batch_limit = batch_limit
+        self.max_wait_s = max_wait_ms / 1000.0
+        self._queue: "queue.Queue" = queue.Queue(maxsize=queue_limit)
+        self._shutdown = False
+        self._worker: Optional[threading.Thread] = None
+        if self.mode == "batched":
+            self._worker = threading.Thread(target=self._drain, daemon=True)
+            self._worker.start()
+
+    # ------------------------------------------------------------------
+    def output(self, x) -> NDArray:
+        """Synchronous single-request API (reference output())."""
+        return self.output_async(x).result()
+
+    def output_async(self, x) -> Future:
+        arr = np.asarray(x.value if isinstance(x, NDArray) else x)
+        fut: Future = Future()
+        if self.mode == "sequential" or self._shutdown:
+            fut.set_result(self._run(arr))
+            return fut
+        self._queue.put((arr, fut))
+        return fut
+
+    def _run(self, batch: np.ndarray) -> NDArray:
+        out = self.model.output(batch)
+        return out[0] if isinstance(out, list) else out
+
+    def _drain(self) -> None:
+        while not self._shutdown:
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            batch = [first]
+            deadline = self.max_wait_s
+            while len(batch) < self.batch_limit:
+                try:
+                    batch.append(self._queue.get(timeout=deadline))
+                except queue.Empty:
+                    break
+            arrays = [b[0] for b in batch]
+            futures = [b[1] for b in batch]
+            sizes = [a.shape[0] for a in arrays]
+            try:
+                merged = np.concatenate(arrays, axis=0)
+                result = self._run(merged).to_numpy()
+                off = 0
+                for size, fut in zip(sizes, futures):
+                    fut.set_result(NDArray(result[off:off + size]))
+                    off += size
+            except Exception as e:  # scatter failure to every waiter
+                for fut in futures:
+                    if not fut.done():
+                        fut.set_exception(e)
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        if self._worker is not None:
+            self._worker.join(timeout=1.0)

@@ -1,0 +1,49 @@
+"""Device mesh utilities.
+
+TPU-native replacement for the reference's device topology handling
+(``CudaAffinityManager`` thread→device pinning, SURVEY.md §2.4): on TPU,
+topology is a ``jax.sharding.Mesh`` over ICI and replication/sharding is a
+compiler annotation, not a trainer-thread layout. Axis convention follows the
+scaling-book recipe: ``data`` (batch), ``model`` (tensor parallel).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(data: Optional[int] = None, model: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a (data, model) mesh. data=None uses all remaining devices."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if data is None:
+        if len(devs) % model:
+            raise ValueError(f"{len(devs)} devices not divisible by model={model}")
+        data = len(devs) // model
+    n = data * model
+    if n > len(devs):
+        raise ValueError(f"need {n} devices, have {len(devs)}")
+    arr = np.asarray(devs[:n]).reshape(data, model)
+    return Mesh(arr, ("data", "model"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_sharded(mesh: Mesh, batch_axis: int = 0) -> NamedSharding:
+    spec = [None] * (batch_axis + 1)
+    spec[batch_axis] = "data"
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_batch(mesh: Mesh, *arrays):
+    """Place arrays with the leading axis split over the data axis."""
+    sh = data_sharded(mesh)
+    out = tuple(jax.device_put(a, sh) for a in arrays)
+    return out if len(out) > 1 else out[0]

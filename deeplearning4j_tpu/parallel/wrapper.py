@@ -1,0 +1,170 @@
+"""ParallelWrapper — data-parallel training over a device mesh.
+
+Reference: dl4j-scaleout ``org.deeplearning4j.parallelism.ParallelWrapper``
+(+ ``trainer/{DefaultTrainer,SymmetricTrainer}``; SURVEY.md §2.4, §3.5).
+
+The reference clones the model per GPU, pins trainer threads to devices, and
+exchanges threshold-encoded gradients through host-RAM queues. On TPU this
+whole topology is ONE SPMD program: the train step runs under ``shard_map``
+over the mesh's ``data`` axis with the minibatch sharded and params
+replicated; the accumulator's ``reduce_gradients`` (a ``pmean`` over ICI for
+the default dense accumulator) is compiled into the step. Both reference
+training modes collapse to the synchronous collective:
+
+- SHARED_GRADIENTS → psum of gradients every step (exactly this program);
+- AVERAGING (params averaged every N iters) → mathematically subsumed by
+  per-step gradient averaging; accepted and treated as the same program
+  (documented divergence: no stale-average window exists to configure).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..data.dataset import DataSet
+from ..ndarray.rng import get_random
+from .accumulator import DenseAllReduceAccumulator, GradientsAccumulator
+from .mesh import make_mesh, shard_batch
+
+
+class ParallelWrapper:
+    class Builder:
+        def __init__(self, model):
+            self._model = model
+            self._workers: Optional[int] = None
+            self._mode = "shared_gradients"
+            self._accumulator: Optional[GradientsAccumulator] = None
+            self._prefetch = 2
+            self._averaging_frequency = 1
+
+        def workers(self, n: int) -> "ParallelWrapper.Builder":
+            self._workers = n
+            return self
+
+        def training_mode(self, mode: str) -> "ParallelWrapper.Builder":
+            mode = mode.lower()
+            if mode not in ("shared_gradients", "averaging"):
+                raise ValueError(f"unknown training mode {mode!r}")
+            self._mode = mode
+            return self
+
+        trainingMode = training_mode
+
+        def gradients_accumulator(self, acc: GradientsAccumulator) -> "ParallelWrapper.Builder":
+            self._accumulator = acc
+            return self
+
+        def averaging_frequency(self, n: int) -> "ParallelWrapper.Builder":
+            self._averaging_frequency = n  # accepted for parity; see module doc
+            return self
+
+        def prefetch_buffer(self, n: int) -> "ParallelWrapper.Builder":
+            self._prefetch = n
+            return self
+
+        def build(self) -> "ParallelWrapper":
+            return ParallelWrapper(self._model, self._workers, self._mode,
+                                   self._accumulator or DenseAllReduceAccumulator())
+
+    def __init__(self, model, workers: Optional[int], mode: str,
+                 accumulator: GradientsAccumulator):
+        self.model = model
+        n = workers or len(jax.devices())
+        self.mesh = make_mesh(data=n, model=1, devices=jax.devices()[:n])
+        self.workers_count = n
+        self.mode = mode
+        self.accumulator = accumulator
+        self._step = None
+        self._listeners: List[Any] = []
+
+    def set_listeners(self, *ls) -> None:
+        self._listeners = list(ls)
+
+    # ------------------------------------------------------------------
+    def _build_step(self):
+        model = self.model
+        updater = model.conf.global_conf.updater
+        acc = self.accumulator
+        axis = acc.axis_name
+        is_graph = hasattr(model, "conf") and hasattr(model.conf, "network_inputs")
+
+        def local_step(params, states, upd_state, x, y, key, it):
+            idx = jax.lax.axis_index(axis)
+            key = jax.random.fold_in(key, idx)
+
+            def loss_fn(p):
+                if is_graph:
+                    inputs = {model.conf.network_inputs[0]: x}
+                    out_name = model.conf.network_outputs[0]
+                    loss, new_states = model._loss(p, states, inputs,
+                                                   {out_name: y}, {}, True, key)
+                else:
+                    loss, new_states = model._loss(p, states, x, y, None, True, key)
+                return loss, new_states
+
+            (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            grads = acc.reduce_gradients(grads)
+            loss = jax.lax.pmean(loss, axis)
+            # keep batchnorm running stats consistent across shards
+            new_states = jax.tree.map(
+                lambda s: jax.lax.pmean(s, axis)
+                if jnp.issubdtype(s.dtype, jnp.floating) else s, new_states)
+            new_params, new_upd = updater.apply(grads, upd_state, params, it)
+            return new_params, new_states, new_upd, loss
+
+        sharded = shard_map(
+            local_step, mesh=self.mesh,
+            in_specs=(P(), P(), P(), P("data"), P("data"), P(), P()),
+            out_specs=(P(), P(), P(), P()),
+            check_rep=False)
+        return jax.jit(sharded, donate_argnums=(0, 1, 2))
+
+    def fit(self, data, epochs: int = 1) -> None:
+        model = self.model
+        model._check_init()
+        if model._updater_state is None:
+            model._updater_state = model.conf.global_conf.updater.init(model._params)
+        if self._step is None:
+            self._step = self._build_step()
+        n = self.workers_count
+        for _ in range(max(1, epochs)):
+            for ds in _iter(data):
+                x = np.asarray(ds.features.to_numpy())
+                y = np.asarray(ds.labels.to_numpy())
+                if x.shape[0] % n:
+                    pad = n - x.shape[0] % n  # pad by wrapping (keeps shapes static)
+                    x = np.concatenate([x, x[:pad]])
+                    y = np.concatenate([y, y[:pad]])
+                xs, ys = shard_batch(self.mesh, x, y)
+                key = get_random().next_key()
+                (model._params, model._states, model._updater_state, loss) = \
+                    self._step(model._params, model._states, model._updater_state,
+                               xs, ys, key, jnp.asarray(model._iteration))
+                model._iteration += 1
+                model._score_dev = loss
+                for lst in self._listeners:
+                    lst.iteration_done(model, model._iteration, model.score_value)
+
+    def shutdown(self) -> None:
+        self._step = None
+
+
+def _iter(data):
+    if hasattr(data, "reset") and hasattr(data, "__iter__"):
+        data.reset()
+        yield from data
+        return
+    if isinstance(data, DataSet):
+        yield data
+        return
+    if isinstance(data, tuple) and len(data) == 2:
+        yield DataSet(data[0], data[1])
+        return
+    raise TypeError(f"cannot iterate {type(data)}")

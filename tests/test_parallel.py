@@ -1,0 +1,177 @@
+"""Parallel/distributed tests on the 8-device virtual CPU mesh — the
+reference's ParallelWrapperTest/ParallelInferenceTest concerns (SURVEY.md
+§4.5) plus tensor-parallel sharding (absent in the reference; TPU-native
+addition)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.data import DataSet, IrisDataSetIterator
+from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.nn import InputType, MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.parallel import (DenseAllReduceAccumulator,
+                                         EncodedGradientsAccumulator,
+                                         ParallelInference, ParallelWrapper,
+                                         apply_tp, make_mesh, shard_batch,
+                                         tp_param_specs)
+
+
+def small_model(updater=None, seed=1):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).updater(updater or Adam(0.05)).activation("tanh")
+            .list()
+            .layer(L.DenseLayer(n_out=16))
+            .layer(L.OutputLayer(n_out=3))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestMesh:
+    def test_make_mesh_shapes(self):
+        m = make_mesh(data=8)
+        assert m.shape == {"data": 8, "model": 1}
+        m2 = make_mesh(data=4, model=2)
+        assert m2.shape == {"data": 4, "model": 2}
+        m3 = make_mesh(model=2)  # data inferred = 4
+        assert m3.shape == {"data": 4, "model": 2}
+
+    def test_too_many_devices_raises(self):
+        with pytest.raises(ValueError, match="need"):
+            make_mesh(data=99)
+
+    def test_shard_batch_places_on_mesh(self):
+        m = make_mesh(data=8)
+        x = np.zeros((16, 4), np.float32)
+        xs = shard_batch(m, x)
+        assert len(xs.sharding.device_set) == 8
+
+
+class TestParallelWrapper:
+    def test_dp_training_converges(self):
+        model = small_model()
+        pw = (ParallelWrapper.Builder(model)
+              .workers(8)
+              .training_mode("shared_gradients")
+              .build())
+        it = IrisDataSetIterator(batch_size=144)  # 144 = 8*18 per shard
+        pw.fit(it, epochs=40)
+        ev = model.evaluate(IrisDataSetIterator(batch_size=150))
+        assert ev.accuracy() > 0.9, ev.stats()
+
+    def test_dp_matches_single_device_math(self):
+        """Sync psum of shard gradients == single-device full-batch gradient:
+        one step on 8 shards must equal one step on 1 device (Sgd, no rng)."""
+        rng = np.random.RandomState(0)
+        x = rng.randn(32, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 32)]
+
+        m1 = small_model(updater=Sgd(0.1), seed=7)
+        m2 = small_model(updater=Sgd(0.1), seed=7)
+        np.testing.assert_allclose(np.asarray(m1._params[0]["W"]),
+                                   np.asarray(m2._params[0]["W"]))
+        m1.fit(DataSet(x, y))  # single device, full batch
+
+        pw = ParallelWrapper.Builder(m2).workers(8).build()
+        pw.fit(DataSet(x, y))  # 8-way sharded same batch
+        np.testing.assert_allclose(np.asarray(m1._params[0]["W"]),
+                                   np.asarray(m2._params[0]["W"]), atol=1e-5)
+
+    def test_uneven_batch_padded(self):
+        model = small_model()
+        pw = ParallelWrapper.Builder(model).workers(8).build()
+        x = np.random.randn(10, 4).astype(np.float32)  # not divisible by 8
+        y = np.eye(3, dtype=np.float32)[np.random.randint(0, 3, 10)]
+        pw.fit(DataSet(x, y))
+        assert np.isfinite(model.score_value)
+
+    def test_averaging_mode_accepted(self):
+        model = small_model()
+        pw = (ParallelWrapper.Builder(model).workers(4)
+              .training_mode("averaging").averaging_frequency(5).build())
+        pw.fit(IrisDataSetIterator(batch_size=148), epochs=1)
+        assert np.isfinite(model.score_value)
+
+    def test_encoded_accumulator_api_compat(self):
+        model = small_model()
+        acc = EncodedGradientsAccumulator(parties=8)
+        pw = (ParallelWrapper.Builder(model).workers(8)
+              .gradients_accumulator(acc).build())
+        pw.fit(IrisDataSetIterator(batch_size=144), epochs=2)
+        assert np.isfinite(model.score_value)
+        assert acc.threshold_algorithm is not None  # config carried
+
+    def test_bad_mode_rejected(self):
+        with pytest.raises(ValueError, match="unknown training mode"):
+            ParallelWrapper.Builder(small_model()).training_mode("async_chaos")
+
+
+class TestTensorParallel:
+    def test_tp_specs_shard_big_weights(self):
+        from jax.sharding import PartitionSpec as P
+
+        model = small_model()
+        mesh = make_mesh(data=4, model=2)
+        specs = jax.tree.leaves(
+            tp_param_specs(model._params, mesh),
+            is_leaf=lambda s: isinstance(s, P))
+        specs = [s for s in specs if isinstance(s, P)]
+        assert any(s == P(None, "model") for s in specs)  # dense W sharded
+
+    def test_tp_forward_matches_replicated(self):
+        model = small_model()
+        mesh = make_mesh(data=1, model=2, devices=jax.devices()[:2])
+        x = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+        expected = model.output(x).to_numpy()
+        model._params = apply_tp(model._params, mesh)
+        model._infer_fn = None  # retrace with sharded params
+        got = model.output(x).to_numpy()
+        np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+class TestParallelInference:
+    def test_sequential_mode(self):
+        model = small_model()
+        pi = (ParallelInference.Builder(model)
+              .inference_mode("sequential").build())
+        out = pi.output(np.zeros((2, 4), np.float32))
+        assert out.shape == (2, 3)
+        pi.shutdown()
+
+    def test_batched_mode_coalesces_and_scatters(self):
+        model = small_model()
+        pi = (ParallelInference.Builder(model)
+              .inference_mode("batched").batch_limit(8).max_wait_ms(50).build())
+        futs = [pi.output_async(np.full((1, 4), float(i), np.float32))
+                for i in range(6)]
+        outs = [f.result(timeout=10) for f in futs]
+        assert all(o.shape == (1, 3) for o in outs)
+        # results must match per-request sequential execution (scatter order)
+        for i, o in enumerate(outs):
+            direct = model.output(np.full((1, 4), float(i), np.float32)).to_numpy()
+            np.testing.assert_allclose(o.to_numpy(), direct, atol=1e-6)
+        pi.shutdown()
+
+
+class TestSharedTrainingMaster:
+    def test_fit_and_kill_resume(self, tmp_path):
+        """The §5.3 story: checkpoint, 'kill', resume from latest."""
+        from deeplearning4j_tpu.parallel import SharedTrainingMaster
+
+        model = small_model()
+        master = (SharedTrainingMaster.Builder(batch_size_per_worker=18)
+                  .checkpoint(str(tmp_path), every_n_iterations=1)
+                  .build())
+        master.fit(model, IrisDataSetIterator(batch_size=144), epochs=2)
+        from deeplearning4j_tpu.optimize import CheckpointListener
+
+        last = CheckpointListener.last_checkpoint(str(tmp_path))
+        assert last is not None
+        # simulate a fresh process resuming: master.fit loads the checkpoint
+        fresh = small_model(seed=99)  # different init — must be overwritten
+        resumed = master.fit(fresh, IrisDataSetIterator(batch_size=144), epochs=1)
+        assert resumed._iteration > 2  # continued counting from the checkpoint
