@@ -320,7 +320,18 @@ class SameDiff:
         else:
             name = name_or_value
         name = self._unique(name)
-        arr = np.asarray(value.value if isinstance(value, NDArray) else value)
+        raw = value.value if isinstance(value, NDArray) else value
+        # Bare Python scalars must not inherit the x64 default (under
+        # jax_enable_x64 np.asarray(2.0) is float64, silently promoting the
+        # whole graph); pin them to the framework defaults. Exact-type checks
+        # only: np.float64/np.float32 scalars keep their explicit dtype.
+        if type(raw) is float:
+            arr = np.asarray(raw, dtype=np.float32)
+        elif type(raw) is int:
+            arr = np.asarray(raw,
+                             dtype=np.int32 if -2**31 <= raw < 2**31 else np.int64)
+        else:
+            arr = np.asarray(raw)
         self._vars[name] = _Var(name, VariableType.CONSTANT, arr.shape,
                                 str(arr.dtype), arr)
         return SDVariable(self, name)
@@ -520,7 +531,9 @@ class SameDiff:
                 loss = fn(p, ph, key)[0]
                 reg = 0.0
                 if l2:
-                    reg = reg + l2 * sum(jnp.sum(jnp.square(w)) for w in p.values())
+                    # DL4J L2: score += 0.5*l2*||w||^2 (grad = l2*w) — matches
+                    # MultiLayerNetwork._loss
+                    reg = reg + 0.5 * l2 * sum(jnp.sum(jnp.square(w)) for w in p.values())
                 if l1:
                     reg = reg + l1 * sum(jnp.sum(jnp.abs(w)) for w in p.values())
                 return jnp.sum(loss) + reg

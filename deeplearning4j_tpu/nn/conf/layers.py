@@ -337,8 +337,10 @@ class LocalResponseNormalization(Layer):
     beta: float = 0.75
 
     def apply(self, params, x, state, training, rng):
+        # DL4J applies alpha directly to the squared-window sum (no /n Caffe
+        # rescale): out = x / (k + alpha*sum(x^2 over window))^beta
         out = get_op("lrn").fn(x, depth=self.n, bias=self.k,
-                               alpha=self.alpha / self.n, beta=self.beta)
+                               alpha=self.alpha, beta=self.beta)
         return out, state
 
     @property

@@ -584,10 +584,12 @@ class ComputationGraph:
                                    jnp.asarray(self._iteration))
                 self._iteration += 1
                 # keep the loss on device: forcing float() here would sync the
-                # pipeline every step (costly through the TPU tunnel)
+                # pipeline every step (costly through the TPU tunnel);
+                # listeners receive the device scalar and sync at their own
+                # print/collect boundaries
                 self._score_dev = loss
                 for lst in self._listeners:
-                    lst.iteration_done(self, self._iteration, self.score_value)
+                    lst.iteration_done(self, self._iteration, loss)
             self._epoch += 1
             for lst in self._listeners:
                 if hasattr(lst, "epoch_done"):

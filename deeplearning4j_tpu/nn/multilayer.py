@@ -265,10 +265,12 @@ class MultiLayerNetwork:
                                         self._updater_state, x, y, mask, key,
                                         jnp.asarray(self._iteration))
                 self._iteration += 1
-                # device scalar; float() only on access (avoids per-step sync)
+                # device scalar; float() only on access (avoids per-step sync).
+                # Listeners get the device scalar too and sync only at their
+                # own print/collect boundaries.
                 self._score_dev = loss
                 for lst in self._listeners:
-                    lst.iteration_done(self, self._iteration, self.score_value)
+                    lst.iteration_done(self, self._iteration, loss)
             self._epoch += 1
             for lst in self._listeners:
                 if hasattr(lst, "epoch_done"):

@@ -17,7 +17,13 @@ logger = logging.getLogger("deeplearning4j_tpu")
 
 
 class TrainingListener:
-    def iteration_done(self, model, iteration: int, score: float) -> None:
+    """SPI note: ``score`` arrives as a DEVICE scalar (jax array), not a
+    Python float — converting it (``float(score)``) forces a device sync, so
+    listeners must only do that at their own print/collect boundaries. This
+    keeps the hot loop fully async (reference: the listener bus must not tax
+    the hot loop, SURVEY.md §5.5)."""
+
+    def iteration_done(self, model, iteration: int, score) -> None:
         pass
 
     def epoch_done(self, model, epoch: int) -> None:
@@ -29,8 +35,10 @@ class ScoreIterationListener(TrainingListener):
         self.print_iterations = max(1, print_iterations)
 
     def iteration_done(self, model, iteration, score):
-        if iteration % self.print_iterations == 0:
-            logger.info("Score at iteration %d is %s", iteration, score)
+        # float(score) syncs the device — only pay for messages actually emitted
+        if (iteration % self.print_iterations == 0
+                and logger.isEnabledFor(logging.INFO)):
+            logger.info("Score at iteration %d is %s", iteration, float(score))
 
 
 class CollectScoresIterationListener(TrainingListener):
@@ -40,7 +48,7 @@ class CollectScoresIterationListener(TrainingListener):
 
     def iteration_done(self, model, iteration, score):
         if iteration % self.frequency == 0:
-            self.scores.append((iteration, score))
+            self.scores.append((iteration, float(score)))
 
 
 class PerformanceListener(TrainingListener):
@@ -60,8 +68,9 @@ class PerformanceListener(TrainingListener):
             iters = iteration - self._last_iter
             if dt > 0:
                 self.last_iterations_per_sec = iters / dt
-                logger.info("iteration %d: %.1f iter/s, score=%s",
-                            iteration, self.last_iterations_per_sec, score)
+                if logger.isEnabledFor(logging.INFO):
+                    logger.info("iteration %d: %.1f iter/s, score=%s", iteration,
+                                self.last_iterations_per_sec, float(score))
             self._last_time = now
             self._last_iter = iteration
         elif self._last_time is None:

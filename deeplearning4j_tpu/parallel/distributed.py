@@ -57,6 +57,7 @@ class SharedTrainingMaster:
         def __init__(self, batch_size_per_worker: int = 32):
             self._batch = batch_size_per_worker
             self._workers_per_node: Optional[int] = None
+            self._threshold: Optional[Any] = None
             self._checkpoint_dir: Optional[str] = None
             self._checkpoint_every = 0
 
@@ -65,7 +66,9 @@ class SharedTrainingMaster:
             return self
 
         def threshold_algorithm(self, alg) -> "SharedTrainingMaster.Builder":
-            self._threshold = alg  # recorded for parity; dense psum path (module doc)
+            # Recorded and forwarded to the accumulator for config parity;
+            # the exchange itself stays a dense psum (module doc / SURVEY §5.8)
+            self._threshold = alg
             return self
 
         def checkpoint(self, directory: str, every_n_iterations: int
@@ -76,32 +79,57 @@ class SharedTrainingMaster:
 
         def build(self) -> "SharedTrainingMaster":
             return SharedTrainingMaster(self._batch, self._workers_per_node,
-                                        self._checkpoint_dir, self._checkpoint_every)
+                                        self._checkpoint_dir,
+                                        self._checkpoint_every, self._threshold)
 
     def __init__(self, batch_size_per_worker: int,
                  workers_per_node: Optional[int],
-                 checkpoint_dir: Optional[str], checkpoint_every: int):
+                 checkpoint_dir: Optional[str], checkpoint_every: int,
+                 threshold_algorithm: Optional[Any] = None):
         self.batch_size_per_worker = batch_size_per_worker
         self.workers_per_node = workers_per_node
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = checkpoint_every
+        self.threshold_algorithm = threshold_algorithm
+
+    def workers(self) -> int:
+        """Global worker count. Single-process: workers_per_node bounds the
+        device count. Multi-process SPMD requires every host's devices in the
+        mesh, so a workers_per_node below local_device_count cannot be
+        honored there — raise rather than build a mesh that silently excludes
+        one host's devices."""
+        import jax
+
+        if self.workers_per_node is None:
+            return len(jax.devices())
+        if jax.process_count() > 1:
+            if self.workers_per_node < jax.local_device_count():
+                raise ValueError(
+                    "workers_per_node < local device count is not supported "
+                    "in multi-process SPMD (all addressable devices must "
+                    "participate in the mesh); unset workers_per_node or set "
+                    f"it to {jax.local_device_count()}")
+            return len(jax.devices())
+        return min(self.workers_per_node, jax.local_device_count())
 
     def fit(self, model, data, epochs: int = 1):
         """Train `model` over all global devices; resumes from the latest
         checkpoint in `checkpoint_dir` when one exists (kill-resume story)."""
-        import jax
-
         from ..optimize.listeners import CheckpointListener
+        from .accumulator import EncodedGradientsAccumulator
         from .wrapper import ParallelWrapper
 
         if self.checkpoint_dir:
             last = CheckpointListener.last_checkpoint(self.checkpoint_dir)
             if last is not None:
                 model = type(model).load(last, load_updater=True)
-        pw = (ParallelWrapper.Builder(model)
-              .workers(len(jax.devices()))
-              .training_mode("shared_gradients")
-              .build())
+        builder = (ParallelWrapper.Builder(model)
+                   .workers(self.workers())
+                   .training_mode("shared_gradients"))
+        if self.threshold_algorithm is not None:
+            builder.gradients_accumulator(
+                EncodedGradientsAccumulator(threshold_algorithm=self.threshold_algorithm))
+        pw = builder.build()
         if self.checkpoint_dir and self.checkpoint_every:
             pw.set_listeners(CheckpointListener(
                 self.checkpoint_dir, save_every_n_iterations=self.checkpoint_every))

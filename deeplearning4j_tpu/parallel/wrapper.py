@@ -95,7 +95,7 @@ class ParallelWrapper:
         axis = acc.axis_name
         is_graph = hasattr(model, "conf") and hasattr(model.conf, "network_inputs")
 
-        def local_step(params, states, upd_state, x, y, key, it):
+        def local_step(params, states, upd_state, x, y, mask, w, key, it):
             idx = jax.lax.axis_index(axis)
             key = jax.random.fold_in(key, idx)
 
@@ -104,9 +104,16 @@ class ParallelWrapper:
                     inputs = {model.conf.network_inputs[0]: x}
                     out_name = model.conf.network_outputs[0]
                     loss, new_states = model._loss(p, states, inputs,
-                                                   {out_name: y}, {}, True, key)
+                                                   {out_name: y}, {out_name: mask},
+                                                   True, key)
                 else:
-                    loss, new_states = model._loss(p, states, x, y, None, True, key)
+                    loss, new_states = model._loss(p, states, x, y, mask, True, key)
+                # The loss mean divides by the PADDED per-shard batch; rescale
+                # so remainder batches match the single-device semantics of
+                # mean-over-real-examples (w: 1=real, 0=pad). Grads scale too.
+                total = w.shape[0] * jax.lax.psum(1.0, axis)
+                real = jax.lax.psum(jnp.sum(w), axis)
+                loss = loss * total / jnp.maximum(real, 1.0)
                 return loss, new_states
 
             (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -121,7 +128,8 @@ class ParallelWrapper:
 
         sharded = shard_map(
             local_step, mesh=self.mesh,
-            in_specs=(P(), P(), P(), P("data"), P("data"), P(), P()),
+            in_specs=(P(), P(), P(), P("data"), P("data"), P("data"), P("data"),
+                      P(), P()),
             out_specs=(P(), P(), P(), P()),
             check_rep=False)
         return jax.jit(sharded, donate_argnums=(0, 1, 2))
@@ -138,19 +146,31 @@ class ParallelWrapper:
             for ds in _iter(data):
                 x = np.asarray(ds.features.to_numpy())
                 y = np.asarray(ds.labels.to_numpy())
+                mask = (np.asarray(ds.labels_mask.to_numpy(), np.float32)
+                        if ds.labels_mask is not None
+                        else np.ones((x.shape[0],), np.float32))
+                w = np.ones((x.shape[0],), np.float32)
                 if x.shape[0] % n:
-                    pad = n - x.shape[0] % n  # pad by wrapping (keeps shapes static)
+                    # pad by wrapping REAL rows (keeps BatchNorm batch stats
+                    # sane — zero rows would pollute them) but zero their
+                    # loss-mask and example-weight so padded rows contribute
+                    # nothing to loss/gradients and the loss renormalizes to
+                    # mean-over-real-examples (see local_step)
+                    pad = n - x.shape[0] % n
                     x = np.concatenate([x, x[:pad]])
                     y = np.concatenate([y, y[:pad]])
-                xs, ys = shard_batch(self.mesh, x, y)
+                    mask = np.concatenate(
+                        [mask, np.zeros((pad,) + mask.shape[1:], mask.dtype)])
+                    w = np.concatenate([w, np.zeros((pad,), np.float32)])
+                xs, ys, ms, ws = shard_batch(self.mesh, x, y, mask, w)
                 key = get_random().next_key()
                 (model._params, model._states, model._updater_state, loss) = \
                     self._step(model._params, model._states, model._updater_state,
-                               xs, ys, key, jnp.asarray(model._iteration))
+                               xs, ys, ms, ws, key, jnp.asarray(model._iteration))
                 model._iteration += 1
                 model._score_dev = loss
                 for lst in self._listeners:
-                    lst.iteration_done(model, model._iteration, model.score_value)
+                    lst.iteration_done(model, model._iteration, loss)
 
     def shutdown(self) -> None:
         self._step = None

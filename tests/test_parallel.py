@@ -89,6 +89,25 @@ class TestParallelWrapper:
         pw.fit(DataSet(x, y))
         assert np.isfinite(model.score_value)
 
+    def test_uneven_batch_matches_single_device_math(self):
+        """Remainder batches must not rescale the gradient: wrap-padded rows
+        are masked out and the loss renormalizes to mean-over-real-examples,
+        so one 8-way step on 10 examples == one single-device step on 10."""
+        rng = np.random.RandomState(3)
+        x = rng.randn(10, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 10)]
+
+        m1 = small_model(updater=Sgd(0.1), seed=7)
+        m2 = small_model(updater=Sgd(0.1), seed=7)
+        m1.fit(DataSet(x, y))  # single device, real rows only
+
+        pw = ParallelWrapper.Builder(m2).workers(8).build()
+        pw.fit(DataSet(x, y))  # sharded: 10 real + 6 wrap-padded masked rows
+        np.testing.assert_allclose(np.asarray(m1._params[0]["W"]),
+                                   np.asarray(m2._params[0]["W"]), atol=1e-5)
+        np.testing.assert_allclose(float(m1._score_dev), float(m2._score_dev),
+                                   atol=1e-5)
+
     def test_averaging_mode_accepted(self):
         model = small_model()
         pw = (ParallelWrapper.Builder(model).workers(4)
