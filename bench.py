@@ -33,6 +33,9 @@ BASELINES = {
     # North star: "match nd4j-cuda on V100"; the reference publishes no numbers
     # (SURVEY.md §6), so the planning anchor is V100 fp32 ResNet-50 ~390 img/s.
     "resnet50_imagenet_train": {"value": 390.0, "unit": "images/sec"},
+    # Planning anchor (not reference-derived): V100 BERT-base fine-tune at
+    # seq 128 ~ 100 samples/sec in contemporary frameworks.
+    "bert_base_finetune": {"value": 100.0, "unit": "samples/sec"},
 }
 
 # Published bf16 peak per chip, TFLOP/s. v5e: 197 (v5p: 459; v4: 275). The
@@ -164,6 +167,72 @@ def bench_resnet50(steps: int, batch: int = 64, image_size: int = 224,
          "listener": with_listener})
 
 
+def bench_bert(steps: int, batch: int = 8, seq: int = 128) -> dict:
+    """North-star config 3: BERT-base imported from a frozen TF GraphDef,
+    fine-tune step (forward+backward+Adam over all 110M params) timed."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+    from deeplearning4j_tpu.imports import import_frozen_tf
+    from deeplearning4j_tpu.imports.tf_fixtures import (build_bert_frozen_graph,
+                                                        make_bert_batch)
+    from deeplearning4j_tpu.learning import Adam
+
+    hidden, vocab, n_classes = 768, 30522, 3
+    gd, in_names, n_params = build_bert_frozen_graph(batch=batch, seq=seq,
+                                                     hidden=hidden, vocab=vocab)
+    sd = import_frozen_tf(gd)
+    sd.convert_to_variables()
+    pooled = sd.get_variable(sd.tf_outputs[0])
+    w = sd.var("cls_w", shape=(hidden, n_classes), init="xavier")
+    b = sd.var("cls_b", shape=(n_classes,), init="zeros")
+    logits = pooled.mmul(w).add(b).rename("logits")
+    sd.placeholder("labels", shape=(batch, n_classes))
+    sd.ops.softmax_cross_entropy(logits, sd.get_variable("labels"), name="loss")
+    sd.set_loss_variables("loss")
+    tc = TrainingConfig(updater=Adam(2e-5), loss_name="loss")
+    sd.set_training_config(tc)
+
+    ids, types, mask, y = make_bert_batch(batch, seq, vocab, n_classes)
+    ph = {k: jnp.asarray(v) for k, v in
+          {**dict(zip(in_names, (ids, types, mask))), "labels": y}.items()}
+    params = sd._params()
+    upd = tc.updater.init(params)
+    step = sd._train_step_fn("loss", tuple(sd.placeholders()))
+
+    state = {"params": params, "upd": upd, "loss": None}
+
+    def run_step():
+        state["params"], state["upd"], state["loss"] = step(
+            state["params"], state["upd"], ph, jax.random.PRNGKey(0),
+            jnp.asarray(0))
+
+    times = _timed_steps(run_step, lambda: float(state["loss"]),
+                         warmup=2, steps=steps)
+    assert np.isfinite(float(state["loss"])), "non-finite BERT loss"
+
+    flops = None
+    try:
+        lowered = step.lower(state["params"], state["upd"], ph,
+                             jax.random.PRNGKey(0), jnp.asarray(0))
+        cost = lowered.cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        f = cost.get("flops")
+        flops = float(f) if f and f > 0 else None
+    except Exception:
+        pass
+    res = _summarize("bert_base_finetune", times, batch, flops,
+                     jax.devices()[0].platform,
+                     {"seq_len": seq, "dtype": "fp32",
+                      "model_params": n_params,
+                      "data": "synthetic ids/mask (frozen graph built with "
+                              "local TF at random init; no egress)"})
+    res["unit"] = "samples/sec"
+    return res
+
+
 def bench_lenet(steps: int, with_listener: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
@@ -220,9 +289,11 @@ def bench_lenet(steps: int, with_listener: bool = False) -> dict:
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--config", default="resnet50", choices=["lenet", "resnet50"])
+    parser.add_argument("--config", default="resnet50",
+                        choices=["lenet", "resnet50", "bert"])
     parser.add_argument("--steps", type=int, default=None)
-    parser.add_argument("--batch", type=int, default=64)
+    parser.add_argument("--batch", type=int, default=None,
+                        help="per-config default: resnet50=64, bert=8")
     parser.add_argument("--with-listener", action="store_true",
                         help="attach a ScoreIterationListener during the timed "
                              "run (validates the listener bus does not tax the "
@@ -232,8 +303,10 @@ def main() -> None:
     steps = args.steps or 30
     if args.config == "lenet":
         result = bench_lenet(steps, with_listener=args.with_listener)
+    elif args.config == "bert":
+        result = bench_bert(steps, batch=args.batch or 8)
     else:
-        result = bench_resnet50(steps, batch=args.batch,
+        result = bench_resnet50(steps, batch=args.batch or 64,
                                 with_listener=args.with_listener)
 
     base = BASELINES.get(result["metric"], {}).get("value")

@@ -341,6 +341,32 @@ class SameDiff:
             raise KeyError(f"no variable {name!r}")
         return SDVariable(self, name)
 
+    def convert_to_variables(self, names: Optional[Sequence[str]] = None,
+                             min_size: int = 2) -> List[str]:
+        """Promote CONSTANT vars to trainable VARIABLEs (reference
+        ``SameDiff.convertToVariables``). Frozen TF graphs import every weight
+        as a constant; fine-tuning (the BERT north-star flow, SURVEY.md §3.4)
+        promotes them back. Default: all float constants with >= min_size
+        elements (scalars/axis vectors stay constant)."""
+        promoted = []
+        targets = set(names) if names is not None else None
+        for n, v in self._vars.items():
+            if v.vtype != VariableType.CONSTANT:
+                continue
+            if targets is not None:
+                if n not in targets:
+                    continue
+            else:
+                val = np.asarray(v.value)
+                if val.size < min_size or not np.issubdtype(val.dtype, np.floating):
+                    continue
+            v.vtype = VariableType.VARIABLE
+            promoted.append(n)
+        self._fn_cache.clear()
+        return promoted
+
+    convertToVariables = convert_to_variables
+
     def variables(self) -> List[str]:
         return [n for n, v in self._vars.items() if v.vtype == VariableType.VARIABLE]
 
@@ -565,13 +591,18 @@ class SameDiff:
         loss_name = self._training_config.loss_name or self._require_loss()
 
         phs = self.placeholders()
+        dict_batches = isinstance(data, dict) or (
+            isinstance(data, list) and data and isinstance(data[0], dict))
         if feature_placeholder is None and label_placeholder is None:
-            if len(phs) == 2:
+            if dict_batches:
+                pass  # batches carry their own {placeholder: array} binding
+            elif len(phs) == 2:
                 feature_placeholder, label_placeholder = phs[0], phs[1]
             elif len(phs) == 1:
                 feature_placeholder = phs[0]
             else:
-                raise ValueError("ambiguous placeholders; name them explicitly")
+                raise ValueError("ambiguous placeholders; name them explicitly "
+                                 "or feed dict batches {placeholder: array}")
         elif feature_placeholder is None:
             remaining = [p for p in phs if p != label_placeholder]
             if len(remaining) != 1:
@@ -591,9 +622,15 @@ class SameDiff:
         for epoch in range(epochs):
             epoch_losses = []
             for ds in _iter_batches(data, batch_size):
-                ph = {feature_placeholder: jnp.asarray(ds.features.value)}
-                if label_placeholder is not None and ds.labels is not None:
-                    ph[label_placeholder] = jnp.asarray(ds.labels.value)
+                if isinstance(ds, dict):
+                    # multi-input binding (e.g. imported BERT: ids/types/mask
+                    # + labels): batches are {placeholder_name: array}
+                    ph = {k: jnp.asarray(v.value if isinstance(v, NDArray) else v)
+                          for k, v in ds.items()}
+                else:
+                    ph = {feature_placeholder: jnp.asarray(ds.features.value)}
+                    if label_placeholder is not None and ds.labels is not None:
+                        ph[label_placeholder] = jnp.asarray(ds.labels.value)
                 key = get_random().next_key()
                 params, state, loss = step(params, state, ph, key,
                                            jnp.asarray(self._iteration))
@@ -794,6 +831,12 @@ def _iter_batches(data, batch_size):
     """Accept DataSetIterator-like, DataSet, or (features, labels) tuple."""
     from ..data.dataset import DataSet
 
+    if isinstance(data, dict):
+        yield data  # one multi-input batch: {placeholder_name: array}
+        return
+    if isinstance(data, list) and data and isinstance(data[0], dict):
+        yield from data
+        return
     if hasattr(data, "reset") and hasattr(data, "__iter__"):
         data.reset()
         yield from data

@@ -1,0 +1,15 @@
+"""Model import — TF frozen GraphDef → SameDiff; Keras h5 → layer API.
+
+Reference: nd4j ``samediff-import-{api,tensorflow}`` + legacy
+``org.nd4j.imports.graphmapper.tf.TFGraphMapper`` and dl4j
+``org.deeplearning4j.nn.modelimport.keras.KerasModelImport``
+(SURVEY.md §2.1, §2.3, §3.4).
+"""
+
+from .tf_graph_mapper import (TFGraphMapper, UnsupportedTFOpError,
+                              import_frozen_tf, supported_tf_ops, tf_op)
+
+__all__ = [
+    "TFGraphMapper", "UnsupportedTFOpError", "import_frozen_tf",
+    "supported_tf_ops", "tf_op",
+]

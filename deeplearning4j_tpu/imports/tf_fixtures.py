@@ -1,0 +1,133 @@
+"""Golden-fixture builders: construct reference TF graphs locally.
+
+No egress is available, so north-star import fixtures (BERT-base) are built
+with the locally installed TF at randomly initialized weights and frozen to
+GraphDefs — the graph TOPOLOGY is exactly what the canonical BERT encoder
+emits (embedding lookups + additive position/type embeddings, LayerNorm as
+Mean/SquaredDifference/Rsqrt, multi-head attention as Reshape/Transpose/
+BatchMatMul/Softmax with additive mask bias, erf-GELU FFN, pooler), which is
+what import conformance is about; trained weight VALUES are irrelevant to the
+importer. Reference flow: SURVEY.md §3.4 (TFGraphTestZooModels BERT case).
+
+TF is an import-time dependency of this module only.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+def build_bert_frozen_graph(batch: int = 4, seq: int = 128, hidden: int = 768,
+                            layers: int = 12, heads: int = 12,
+                            intermediate: int = 3072, vocab: int = 30522,
+                            type_vocab: int = 2, max_pos: int = 512,
+                            seed: int = 0):
+    """BERT encoder (base config by default) → frozen GraphDef.
+
+    Returns (graph_def, input_names, n_params). Inputs:
+    input_ids, token_type_ids, input_mask — all [batch, seq] int32. Output:
+    pooled [batch, hidden] (tanh pooler over [CLS], the fine-tune surface).
+    """
+    import tensorflow as tf
+    from tensorflow.python.framework.convert_to_constants import \
+        convert_variables_to_constants_v2
+
+    rng = np.random.RandomState(seed)
+    std = 0.02
+
+    def W(*shape):
+        return tf.constant(rng.normal(0.0, std, shape).astype(np.float32))
+
+    def zeros(*shape):
+        return tf.constant(np.zeros(shape, np.float32))
+
+    def ones(*shape):
+        return tf.constant(np.ones(shape, np.float32))
+
+    word_emb = W(vocab, hidden)
+    type_emb = W(type_vocab, hidden)
+    pos_emb = W(max_pos, hidden)
+    p: Dict[str, Tuple] = {}
+    for i in range(layers):
+        p[f"l{i}"] = dict(
+            q=(W(hidden, hidden), zeros(hidden)),
+            k=(W(hidden, hidden), zeros(hidden)),
+            v=(W(hidden, hidden), zeros(hidden)),
+            o=(W(hidden, hidden), zeros(hidden)),
+            ln1=(ones(hidden), zeros(hidden)),
+            up=(W(hidden, intermediate), zeros(intermediate)),
+            down=(W(intermediate, hidden), zeros(hidden)),
+            ln2=(ones(hidden), zeros(hidden)),
+        )
+    emb_ln = (ones(hidden), zeros(hidden))
+    pool_w, pool_b = W(hidden, hidden), zeros(hidden)
+    head_dim = hidden // heads
+
+    def layer_norm(x, gamma, beta):
+        mu = tf.reduce_mean(x, axis=-1, keepdims=True)
+        var = tf.reduce_mean(tf.math.squared_difference(x, mu), axis=-1,
+                             keepdims=True)
+        return (x - mu) * tf.math.rsqrt(var + 1e-12) * gamma + beta
+
+    def gelu(x):
+        return 0.5 * x * (1.0 + tf.math.erf(x / tf.sqrt(2.0)))
+
+    def dense(x, wb):
+        w, b = wb
+        return tf.matmul(x, w) + b
+
+    def split_heads(x):
+        x = tf.reshape(x, [batch, seq, heads, head_dim])
+        return tf.transpose(x, [0, 2, 1, 3])
+
+    @tf.function
+    def bert(input_ids, token_type_ids, input_mask):
+        x = (tf.gather(word_emb, input_ids)
+             + tf.gather(type_emb, token_type_ids)
+             + pos_emb[:seq])
+        x = layer_norm(x, *emb_ln)
+        # additive attention bias from the padding mask
+        bias = (1.0 - tf.cast(tf.reshape(input_mask, [batch, 1, 1, seq]),
+                              tf.float32)) * -10000.0
+        for i in range(layers):
+            lp = p[f"l{i}"]
+            q = split_heads(dense(x, lp["q"]))
+            k = split_heads(dense(x, lp["k"]))
+            v = split_heads(dense(x, lp["v"]))
+            scores = tf.matmul(q, k, transpose_b=True) / float(np.sqrt(head_dim))
+            probs = tf.nn.softmax(scores + bias)
+            ctxv = tf.matmul(probs, v)
+            ctxv = tf.reshape(tf.transpose(ctxv, [0, 2, 1, 3]),
+                              [batch, seq, hidden])
+            x = layer_norm(x + dense(ctxv, lp["o"]), *lp["ln1"])
+            h = gelu(dense(x, lp["up"]))
+            x = layer_norm(x + dense(h, lp["down"]), *lp["ln2"])
+        cls = x[:, 0]
+        pooled = tf.tanh(tf.matmul(cls, pool_w) + pool_b)
+        return pooled
+
+    specs = [tf.TensorSpec([batch, seq], tf.int32, name=n)
+             for n in ("input_ids", "token_type_ids", "input_mask")]
+    cf = bert.get_concrete_function(*specs)
+    frozen = convert_variables_to_constants_v2(cf)
+    gd = frozen.graph.as_graph_def()
+    in_names = [t.name.split(":")[0] for t in frozen.inputs]
+    n_params = (vocab + type_vocab + max_pos) * hidden + layers * (
+        4 * (hidden * hidden + hidden) + 2 * 2 * hidden
+        + hidden * intermediate + intermediate + intermediate * hidden + hidden
+    ) + 2 * hidden + hidden * hidden + hidden
+    return gd, in_names, n_params
+
+
+def make_bert_batch(batch: int, seq: int, vocab: int, num_classes: int,
+                    seed: int = 0):
+    """Synthetic fine-tune minibatch: ids/types/mask + one-hot labels."""
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, vocab, (batch, seq)).astype(np.int32)
+    types = np.zeros((batch, seq), np.int32)
+    mask = np.ones((batch, seq), np.int32)
+    labels = np.eye(num_classes, dtype=np.float32)[
+        rng.randint(0, num_classes, batch)]
+    return ids, types, mask, labels

@@ -1,0 +1,956 @@
+"""TF frozen-GraphDef import → SameDiff.
+
+Reference: nd4j-api ``org/nd4j/imports/graphmapper/tf/TFGraphMapper.java``
+(legacy direct mapper) and the Kotlin ``samediff-import-tensorflow``
+(``ImportGraph.kt`` + ``MappingProcess`` rule tables) — SURVEY.md §2.1, §3.4.
+
+Design (idiomatic rebuild, not a translation):
+
+- **Table-driven**: one small mapper per TF op name (the ``@tf_op`` registry =
+  the reference's ``ImportClassMapping``/``OpMappingRegistry``), each emitting
+  ops from this package's registry into a ``SameDiff`` graph. The whole
+  imported graph then lowers to ONE jitted XLA module like any other SameDiff
+  graph — there is no separate "imported graph" execution engine.
+- **Structural-argument folding**: XLA needs static shapes/axes/permutations,
+  but TF graphs compute them with tensor subgraphs (``Shape`` →
+  ``StridedSlice`` → ``Pack`` → ``Reshape``). Nodes whose inputs are all
+  static are folded to numpy constants at import time, and ``Shape`` resolves
+  through jax ``eval_shape`` over the partially-built graph, so those
+  subgraphs disappear instead of defeating the compiler.
+- TF protos are parsed with the locally installed tensorflow (import-time
+  dependency only — execution never touches TF).
+
+Conformance: ``tests/test_tf_import.py`` generates golden graphs with the
+local TF (SURVEY.md §4.3 harness shape: freeze → import → execute → compare
+within per-op tolerance).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..autodiff.samediff import SameDiff, SDVariable
+
+_TF_OPS: Dict[str, Callable] = {}
+
+
+class UnsupportedTFOpError(NotImplementedError):
+    def __init__(self, op: str, node_name: str):
+        super().__init__(
+            f"TF op {op!r} (node {node_name!r}) has no mapper; register one "
+            f"with @tf_op({op!r}) in deeplearning4j_tpu/imports/tf_graph_mapper.py")
+        self.op = op
+
+
+def tf_op(*names: str):
+    """Register a mapper for one or more TF op names (the MappingProcess
+    analog: mapper(ctx) -> SDVariable | tuple[SDVariable, ...])."""
+
+    def deco(fn):
+        for n in names:
+            _TF_OPS[n] = fn
+        return fn
+
+    return deco
+
+
+def supported_tf_ops() -> List[str]:
+    return sorted(_TF_OPS)
+
+
+# --------------------------------------------------------------------------
+# attr / proto helpers (lazy TF import)
+
+
+def _tf():
+    import tensorflow as tf  # local install; import-time only
+
+    return tf
+
+
+def _np_dtype(tf_enum: int):
+    return _tf().dtypes.as_dtype(tf_enum).as_numpy_dtype
+
+
+def _make_ndarray(tensor_proto) -> np.ndarray:
+    from tensorflow.python.framework import tensor_util
+
+    return np.asarray(tensor_util.MakeNdarray(tensor_proto))
+
+
+class _Ctx:
+    """Per-node mapper context: typed attr access, resolved inputs, static
+    values, and shape inference over the partially built graph."""
+
+    def __init__(self, imp: "_Importer", node):
+        self.imp = imp
+        self.node = node
+        self.sd = imp.sd
+        self.name = node.name
+        self.data_inputs = [i for i in node.input if not i.startswith("^")]
+
+    # --- attrs ---------------------------------------------------------
+    def attr(self, name: str, default=None):
+        if name not in self.node.attr:
+            return default
+        a = self.node.attr[name]
+        kind = a.WhichOneof("value")
+        if kind == "i":
+            return int(a.i)
+        if kind == "f":
+            return float(a.f)
+        if kind == "b":
+            return bool(a.b)
+        if kind == "s":
+            return a.s.decode()
+        if kind == "type":
+            return np.dtype(_np_dtype(a.type))
+        if kind == "shape":
+            return [d.size if d.size >= 0 else None for d in a.shape.dim]
+        if kind == "list":
+            lst = a.list
+            for field in ("i", "f", "b", "s", "type"):
+                vals = getattr(lst, field)
+                if len(vals):
+                    if field == "s":
+                        return [v.decode() for v in vals]
+                    if field == "type":
+                        return [np.dtype(_np_dtype(v)) for v in vals]
+                    return list(vals)
+            return []
+        if kind == "tensor":
+            return _make_ndarray(a.tensor)
+        return default
+
+    # --- inputs --------------------------------------------------------
+    def n_in(self) -> int:
+        return len(self.data_inputs)
+
+    def var(self, i: int) -> SDVariable:
+        return self.imp.resolve_var(self.data_inputs[i])
+
+    def vars(self, start: int = 0, end: Optional[int] = None) -> List[SDVariable]:
+        return [self.imp.resolve_var(t)
+                for t in self.data_inputs[start:end]]
+
+    def static(self, i: int) -> np.ndarray:
+        """Static (import-time) value of input i — must come from a constant
+        or folded subgraph (standard table-driven-importer requirement for
+        structural args: shapes, axes, permutations)."""
+        t = self.data_inputs[i]
+        v = self.imp.static_value(t)
+        if v is None:
+            raise ValueError(
+                f"input {i} ({t!r}) of node {self.name!r} ({self.node.op}) "
+                "must be statically resolvable (constant/shape subgraph); "
+                "dynamic values are not supported for structural arguments "
+                "under XLA's static-shape model")
+        return v
+
+    def static_or_none(self, i: int) -> Optional[np.ndarray]:
+        if i >= self.n_in():
+            return None
+        return self.imp.static_value(self.data_inputs[i])
+
+    def shape_of_input(self, i: int) -> Tuple[int, ...]:
+        return self.imp.infer_shape(self.data_inputs[i])
+
+    def emit(self, op_name: str, inputs: Sequence[Any], n_outputs=None, **kw):
+        return self.sd._add_op(op_name, list(inputs), name=self.name,
+                               n_outputs=n_outputs, **kw)
+
+
+# --------------------------------------------------------------------------
+
+
+class _Importer:
+    def __init__(self, graph_def, input_shapes: Optional[Dict[str, Sequence[int]]] = None):
+        self.gd = graph_def
+        self.sd = SameDiff.create()
+        self.input_shapes = dict(input_shapes or {})
+        self._env: Dict[str, SDVariable] = {}       # tf tensor name -> SDVariable
+        self._static: Dict[str, np.ndarray] = {}    # tf tensor name -> ndarray
+        self._shape_cache: Dict[str, Tuple[int, ...]] = {}
+        self.placeholders: List[str] = []
+        self.outputs: List[str] = []
+
+    # --- name plumbing --------------------------------------------------
+    @staticmethod
+    def _canon(tensor_name: str) -> str:
+        return tensor_name if ":" in tensor_name else tensor_name + ":0"
+
+    def _bind(self, node_name: str, outs) -> None:
+        if isinstance(outs, SDVariable):
+            outs = (outs,)
+        for i, v in enumerate(outs):
+            self._env[f"{node_name}:{i}"] = v
+
+    def resolve_var(self, tensor_name: str) -> SDVariable:
+        key = self._canon(tensor_name)
+        if key in self._env:
+            return self._env[key]
+        # a folded static that was never materialized as a graph constant
+        sval = self._static.get(key)
+        if sval is not None:
+            v = self.sd.constant(key.replace(":", "_"), sval)
+            self._env[key] = v
+            return v
+        raise KeyError(f"unresolved TF tensor {tensor_name!r}")
+
+    def static_value(self, tensor_name: str) -> Optional[np.ndarray]:
+        return self._static.get(self._canon(tensor_name))
+
+    def set_static(self, node_name: str, value: np.ndarray, out_index: int = 0):
+        self._static[f"{node_name}:{out_index}"] = np.asarray(value)
+
+    # --- shape inference over the partial graph -------------------------
+    def infer_shape(self, tensor_name: str) -> Tuple[int, ...]:
+        import jax
+
+        key = self._canon(tensor_name)
+        if key in self._shape_cache:
+            return self._shape_cache[key]
+        var = self.resolve_var(key)
+        vinfo = self.sd._vars[var.name]
+        if vinfo.shape is not None and all(d is not None for d in vinfo.shape):
+            shp = tuple(int(d) for d in vinfo.shape)
+            self._shape_cache[key] = shp
+            return shp
+        fn = self.sd._make_fn((var.name,), training=False)
+        params = {n: jax.ShapeDtypeStruct(np.asarray(v.value).shape,
+                                          np.asarray(v.value).dtype)
+                  for n, v in self.sd._vars.items()
+                  if v.vtype == "VARIABLE"}
+        ph = {}
+        for n in self.sd.placeholders():
+            pshape = self.sd._vars[n].shape
+            if pshape is None or any(d is None for d in pshape):
+                raise ValueError(
+                    f"cannot infer shape of {tensor_name!r}: placeholder "
+                    f"{n!r} has unknown dims — pass input_shapes={{...}} to "
+                    "the importer")
+            pdt = np.dtype(self.sd._vars[n].dtype)
+            ph[n] = jax.ShapeDtypeStruct(tuple(pshape), pdt)
+        key_struct = jax.ShapeDtypeStruct((2,), np.uint32)
+        out = jax.eval_shape(fn, params, ph, key_struct)
+        shp = tuple(int(d) for d in out[0].shape)
+        self._shape_cache[key] = shp
+        return shp
+
+    # --- main loop ------------------------------------------------------
+    def run(self) -> SameDiff:
+        order = _topo_order(self.gd.node)
+        consumed: Dict[str, int] = {}
+        for node in self.gd.node:
+            for t in node.input:
+                if not t.startswith("^"):
+                    consumed[self._canon(t)] = consumed.get(self._canon(t), 0) + 1
+
+        for node in order:
+            opn = node.op
+            if opn in ("NoOp", "Assert", "CheckNumerics"):
+                continue
+            if opn == "Const":
+                val = _make_ndarray(node.attr["value"].tensor)
+                self.set_static(node.name, val)
+                # materialized lazily in resolve_var only when consumed as a
+                # tensor — structural consts never enter the graph
+                continue
+            if opn in ("Placeholder", "PlaceholderWithDefault"):
+                self._import_placeholder(node)
+                continue
+            if opn == "IteratorGetNext":
+                self._import_iterator_get_next(node)
+                continue
+            ctx = _Ctx(self, node)
+            folder = _FOLDERS.get(opn)
+            if folder is not None:
+                statics = [self.static_value(t) for t in ctx.data_inputs]
+                if all(s is not None for s in statics):
+                    try:
+                        res = folder(ctx, statics)
+                    except Exception:
+                        res = None
+                    if res is not None:
+                        if not isinstance(res, (list, tuple)):
+                            res = (res,)
+                        for i, r in enumerate(res):
+                            self.set_static(node.name, r, i)
+                        continue
+            if opn == "Shape":
+                shp = self.infer_shape(ctx.data_inputs[0])
+                self.set_static(node.name, np.asarray(
+                    shp, dtype=ctx.attr("out_type", np.dtype(np.int32))))
+                continue
+            mapper = _TF_OPS.get(opn)
+            if mapper is None:
+                raise UnsupportedTFOpError(opn, node.name)
+            outs = mapper(ctx)
+            if outs is not None:
+                self._bind(node.name, outs)
+
+        # graph outputs: tensors nobody consumes
+        for node in self.gd.node:
+            key = f"{node.name}:0"
+            if key in self._env and consumed.get(key, 0) == 0:
+                self.outputs.append(self._env[key].name)
+        return self.sd
+
+    def _import_placeholder(self, node) -> None:
+        dtype = node.attr["dtype"].type
+        shape = None
+        if "shape" in node.attr:
+            shape = [d.size if d.size >= 0 else None
+                     for d in node.attr["shape"].shape.dim]
+        if node.name in self.input_shapes:
+            shape = list(self.input_shapes[node.name])
+        v = self.sd.placeholder(node.name, shape=shape,
+                                dtype=np.dtype(_np_dtype(dtype)).name)
+        self._bind(node.name, v)
+        self.placeholders.append(v.name)
+
+    def _import_iterator_get_next(self, node) -> None:
+        """BERT-style input nodes (SURVEY.md §3.4): each output becomes a
+        placeholder named <node>:i so the dataset binds positionally."""
+        dtypes = self.attr_list_types(node, "output_types")
+        shapes = self.attr_list_shapes(node, "output_shapes")
+        outs = []
+        for i, dt in enumerate(dtypes):
+            shape = shapes[i] if i < len(shapes) else None
+            name = node.name if i == 0 else f"{node.name}_{i}"
+            if name in self.input_shapes:
+                shape = list(self.input_shapes[name])
+            v = self.sd.placeholder(name, shape=shape, dtype=np.dtype(dt).name)
+            self.placeholders.append(v.name)
+            outs.append(v)
+        self._bind(node.name, tuple(outs))
+
+    @staticmethod
+    def attr_list_types(node, name):
+        if name not in node.attr:
+            return []
+        return [np.dtype(_np_dtype(t)) for t in node.attr[name].list.type]
+
+    @staticmethod
+    def attr_list_shapes(node, name):
+        if name not in node.attr:
+            return []
+        return [[d.size if d.size >= 0 else None for d in s.dim]
+                for s in node.attr[name].list.shape]
+
+
+def _topo_order(nodes) -> List[Any]:
+    """Kahn's algorithm (iterative — deep op chains would blow Python's
+    recursion limit under a DFS)."""
+    from collections import deque
+
+    by_name = {n.name: n for n in nodes}
+    indeg: Dict[str, int] = {}
+    dependents: Dict[str, List[str]] = {}
+    for n in nodes:
+        deps = {t[1:] if t.startswith("^") else t.split(":")[0]
+                for t in n.input}
+        deps = [d for d in deps if d in by_name]
+        indeg[n.name] = len(deps)
+        for d in deps:
+            dependents.setdefault(d, []).append(n.name)
+    queue = deque(n.name for n in nodes if indeg[n.name] == 0)
+    order: List[Any] = []
+    while queue:
+        nm = queue.popleft()
+        order.append(by_name[nm])
+        for m in dependents.get(nm, ()):
+            indeg[m] -= 1
+            if indeg[m] == 0:
+                queue.append(m)
+    if len(order) != len(nodes):
+        stuck = [n for n, d in indeg.items() if d > 0][:5]
+        raise ValueError(f"graph has a cycle (frozen graphs are acyclic); "
+                         f"unresolved: {stuck}")
+    return order
+
+
+# --------------------------------------------------------------------------
+# numpy folding of structural subgraphs
+
+
+def _strided_slice_spec(ctx: _Ctx, begin, end, strides):
+    begin = np.asarray(begin).tolist()
+    end = np.asarray(end).tolist()
+    strides = (np.asarray(strides).tolist() if strides is not None
+               else [1] * len(begin))
+    bm = ctx.attr("begin_mask", 0)
+    em = ctx.attr("end_mask", 0)
+    ellipsis = ctx.attr("ellipsis_mask", 0)
+    new_axis = ctx.attr("new_axis_mask", 0)
+    shrink = ctx.attr("shrink_axis_mask", 0)
+    spec = []
+    for i in range(len(begin)):
+        if ellipsis & (1 << i):
+            spec.append(Ellipsis)
+        elif new_axis & (1 << i):
+            spec.append(None)
+        elif shrink & (1 << i):
+            spec.append(int(begin[i]))
+        else:
+            b = None if bm & (1 << i) else int(begin[i])
+            e = None if em & (1 << i) else int(end[i])
+            spec.append(slice(b, e, int(strides[i])))
+    return tuple(spec)
+
+
+_FOLDERS: Dict[str, Callable] = {
+    "Identity": lambda ctx, s: s[0],
+    "Add": lambda ctx, s: s[0] + s[1],
+    "AddV2": lambda ctx, s: s[0] + s[1],
+    "Sub": lambda ctx, s: s[0] - s[1],
+    "Mul": lambda ctx, s: s[0] * s[1],
+    "RealDiv": lambda ctx, s: s[0] / s[1],
+    "FloorDiv": lambda ctx, s: s[0] // s[1],
+    "FloorMod": lambda ctx, s: np.mod(s[0], s[1]),
+    "Maximum": lambda ctx, s: np.maximum(s[0], s[1]),
+    "Minimum": lambda ctx, s: np.minimum(s[0], s[1]),
+    "Neg": lambda ctx, s: -s[0],
+    "Cast": lambda ctx, s: s[0].astype(_np_dtype(ctx.node.attr["DstT"].type)),
+    "Pack": lambda ctx, s: np.stack(s, axis=ctx.attr("axis", 0)),
+    "Unpack": lambda ctx, s: [np.squeeze(a, ctx.attr("axis", 0)) for a in
+                              np.split(s[0], s[0].shape[ctx.attr("axis", 0)],
+                                       ctx.attr("axis", 0))],
+    "ConcatV2": lambda ctx, s: np.concatenate(s[:-1], axis=int(s[-1])),
+    "ExpandDims": lambda ctx, s: np.expand_dims(s[0], int(s[1])),
+    "Squeeze": lambda ctx, s: np.squeeze(
+        s[0], tuple(ctx.attr("squeeze_dims", []) or ctx.attr("axis", []))
+        or None),
+    "Reshape": lambda ctx, s: np.reshape(s[0], np.asarray(s[1]).tolist()),
+    "Transpose": lambda ctx, s: np.transpose(s[0], np.asarray(s[1]).tolist()),
+    "Range": lambda ctx, s: np.arange(int(s[0]), int(s[1]), int(s[2])),
+    "GatherV2": lambda ctx, s: np.take(s[0], s[1].astype(np.int64),
+                                       axis=int(s[2]) if len(s) > 2 else 0),
+    "StridedSlice": lambda ctx, s: s[0][_strided_slice_spec(ctx, s[1], s[2], s[3])],
+    "Slice": lambda ctx, s: s[0][tuple(
+        slice(int(b), int(b) + int(sz) if int(sz) >= 0 else None)
+        for b, sz in zip(np.asarray(s[1]).tolist(), np.asarray(s[2]).tolist()))],
+    "Prod": lambda ctx, s: np.prod(s[0], axis=tuple(np.atleast_1d(s[1]).tolist())
+                                   if len(s) > 1 else None,
+                                   keepdims=ctx.attr("keep_dims", False)),
+    "Sum": lambda ctx, s: np.sum(s[0], axis=tuple(np.atleast_1d(s[1]).tolist())
+                                 if len(s) > 1 else None,
+                                 keepdims=ctx.attr("keep_dims", False)),
+    "Fill": lambda ctx, s: np.full(np.asarray(s[0]).tolist(), s[1]),
+    "ZerosLike": lambda ctx, s: np.zeros_like(s[0]),
+    "OnesLike": lambda ctx, s: np.ones_like(s[0]),
+}
+
+
+# --------------------------------------------------------------------------
+# mappers — elementwise
+
+
+def _binary(op_name):
+    def m(ctx: _Ctx):
+        return ctx.emit(op_name, [ctx.var(0), ctx.var(1)])
+
+    return m
+
+
+_BINARY = {
+    "Add": "add", "AddV2": "add", "Sub": "subtract", "Mul": "multiply",
+    "RealDiv": "divide", "Div": "divide", "FloorDiv": "floordiv",
+    "FloorMod": "floormod", "Maximum": "maximum", "Minimum": "minimum",
+    "Pow": "pow", "SquaredDifference": "squaredsubtract",
+    "TruncateDiv": "truncatediv", "Atan2": "atan2",
+    "Equal": "equals", "NotEqual": "not_equals", "Greater": "greater",
+    "GreaterEqual": "greater_equal", "Less": "less", "LessEqual": "less_equal",
+    "LogicalAnd": "boolean_and", "LogicalOr": "boolean_or",
+}
+for _tf_name, _our in _BINARY.items():
+    tf_op(_tf_name)(_binary(_our))
+
+
+def _unary(op_name, **fixed_kw):
+    def m(ctx: _Ctx):
+        return ctx.emit(op_name, [ctx.var(0)], **fixed_kw)
+
+    return m
+
+
+_UNARY = {
+    "Abs": "abs", "Neg": "neg", "Exp": "exp", "Log": "log", "Log1p": "log1p",
+    "Sqrt": "sqrt", "Rsqrt": "rsqrt", "Square": "square", "Sign": "sign",
+    "Floor": "floor", "Ceil": "ceil", "Round": "round", "Rint": "rint",
+    "Sin": "sin", "Cos": "cos", "Tan": "tan", "Asin": "asin", "Acos": "acos",
+    "Atan": "atan", "Sinh": "sinh", "Cosh": "cosh", "Tanh": "tanh",
+    "Asinh": "asinh", "Acosh": "acosh", "Atanh": "atanh",
+    "Erf": "erf", "Erfc": "erfc", "Sigmoid": "sigmoid", "Relu": "relu",
+    "Relu6": "relu6", "Selu": "selu", "Softplus": "softplus",
+    "Softsign": "softsign", "Reciprocal": "reciprocal", "LogicalNot": "boolean_not",
+    "IsNan": "isnan", "IsInf": "isinf", "IsFinite": "isfinite",
+    "Expm1": "expm1",
+}
+for _tf_name, _our in _UNARY.items():
+    tf_op(_tf_name)(_unary(_our))
+
+
+@tf_op("Elu")
+def _elu(ctx):
+    return ctx.emit("elu", [ctx.var(0)])
+
+
+@tf_op("LeakyRelu")
+def _leaky_relu(ctx):
+    return ctx.emit("leakyrelu", [ctx.var(0)], alpha=ctx.attr("alpha", 0.2))
+
+
+@tf_op("Identity", "StopGradient", "PreventGradient", "Snapshot", "EnsureShape")
+def _identity(ctx):
+    return ctx.emit("identity", [ctx.var(0)])
+
+
+@tf_op("IdentityN")
+def _identity_n(ctx):
+    return tuple(ctx.emit("identity", [v]) for v in ctx.vars())
+
+
+@tf_op("Cast")
+def _cast(ctx):
+    dst = np.dtype(_np_dtype(ctx.node.attr["DstT"].type))
+    return ctx.emit("cast", [ctx.var(0)], dtype=dst.name)
+
+
+@tf_op("Select", "SelectV2")
+def _select(ctx):
+    return ctx.emit("select", [ctx.var(0), ctx.var(1), ctx.var(2)])
+
+
+@tf_op("ClipByValue")
+def _clip_by_value(ctx):
+    return ctx.emit("clip_by_value", [ctx.var(0)],
+                    clip_min=float(ctx.static(1)), clip_max=float(ctx.static(2)))
+
+
+# --------------------------------------------------------------------------
+# mappers — reductions
+
+_REDUCE = {"Sum": "reduce_sum", "Mean": "reduce_mean", "Max": "reduce_max",
+           "Min": "reduce_min", "Prod": "reduce_prod", "All": "all", "Any": "any"}
+
+
+def _reduction(op_name):
+    def m(ctx: _Ctx):
+        dims = ctx.static_or_none(1)
+        dims = tuple(np.atleast_1d(dims).tolist()) if dims is not None else None
+        return ctx.emit(op_name, [ctx.var(0)], dims=dims,
+                        keep_dims=ctx.attr("keep_dims", False))
+
+    return m
+
+
+for _tf_name, _our in _REDUCE.items():
+    tf_op(_tf_name)(_reduction(_our))
+
+
+@tf_op("ArgMax")
+def _argmax(ctx):
+    dim = int(ctx.static(1)) if ctx.n_in() > 1 else 0
+    out = ctx.emit("argmax", [ctx.var(0)], dims=(dim,))
+    odt = ctx.attr("output_type")
+    if odt is not None and np.dtype(odt) != np.int32:
+        out = ctx.sd._add_op("cast", [out], dtype=np.dtype(odt).name)
+    return out
+
+
+@tf_op("ArgMin")
+def _argmin(ctx):
+    dim = int(ctx.static(1)) if ctx.n_in() > 1 else 0
+    return ctx.emit("argmin", [ctx.var(0)], dims=(dim,))
+
+
+# --------------------------------------------------------------------------
+# mappers — shape / indexing
+
+
+@tf_op("Reshape")
+def _reshape(ctx):
+    shape = np.asarray(ctx.static(1)).tolist()
+    if any(d == -1 for d in shape):
+        in_shape = ctx.shape_of_input(0)
+        known = int(np.prod([d for d in shape if d != -1]))
+        total = int(np.prod(in_shape))
+        shape = [total // max(known, 1) if d == -1 else d for d in shape]
+    return ctx.emit("reshape", [ctx.var(0), tuple(int(d) for d in shape)])
+
+
+@tf_op("Transpose")
+def _transpose(ctx):
+    perm = tuple(int(d) for d in np.asarray(ctx.static(1)).tolist())
+    return ctx.emit("permute", [ctx.var(0), perm])
+
+
+@tf_op("ExpandDims")
+def _expand_dims(ctx):
+    return ctx.emit("expand_dims", [ctx.var(0)], axis=int(ctx.static(1)))
+
+
+@tf_op("Squeeze")
+def _squeeze(ctx):
+    dims = ctx.attr("squeeze_dims", []) or ctx.attr("axis", [])
+    return ctx.emit("squeeze", [ctx.var(0)],
+                    axis=tuple(int(d) for d in dims) if dims else None)
+
+
+@tf_op("ConcatV2")
+def _concat(ctx):
+    axis = int(ctx.static(ctx.n_in() - 1))
+    return ctx.emit("concat", ctx.vars(0, ctx.n_in() - 1), axis=axis)
+
+
+@tf_op("Pack")
+def _pack(ctx):
+    return ctx.emit("stack", ctx.vars(), axis=ctx.attr("axis", 0))
+
+
+@tf_op("Unpack")
+def _unpack(ctx):
+    num = ctx.attr("num")
+    return ctx.emit("unstack", [ctx.var(0)], axis=ctx.attr("axis", 0),
+                    n_outputs=num)
+
+
+@tf_op("Split")
+def _split(ctx):
+    num = ctx.attr("num_split")
+    axis = int(ctx.static(0))
+    return ctx.emit("split", [ctx.var(1)], num_split=num, axis=axis,
+                    n_outputs=num)
+
+
+@tf_op("SplitV")
+def _split_v(ctx):
+    sizes = tuple(int(s) for s in np.asarray(ctx.static(1)).tolist())
+    axis = int(ctx.static(2))
+    return ctx.emit("split_v", [ctx.var(0)], sizes=sizes, axis=axis,
+                    n_outputs=len(sizes))
+
+
+@tf_op("Slice")
+def _slice(ctx):
+    begin = tuple(int(b) for b in np.asarray(ctx.static(1)).tolist())
+    sizes = np.asarray(ctx.static(2)).tolist()
+    in_shape = ctx.shape_of_input(0)
+    sizes = tuple(int(in_shape[i] - begin[i]) if s == -1 else int(s)
+                  for i, s in enumerate(sizes))
+    return ctx.emit("slice", [ctx.var(0), begin, sizes])
+
+
+@tf_op("StridedSlice")
+def _strided_slice(ctx):
+    import jax.numpy as jnp
+
+    spec = _strided_slice_spec(ctx, ctx.static(1), ctx.static(2), ctx.static(3))
+    x = ctx.var(0)
+    # lower via a custom pick: reuse the registry's strided_slice when the
+    # spec is plain slices; otherwise apply numpy-style indexing in one op
+    return ctx.sd._add_op("tf_strided_slice", [x], name=ctx.name, spec=spec)
+
+
+@tf_op("Tile")
+def _tile(ctx):
+    reps = tuple(int(r) for r in np.asarray(ctx.static(1)).tolist())
+    return ctx.emit("tile", [ctx.var(0), reps])
+
+
+@tf_op("GatherV2", "Gather")
+def _gather(ctx):
+    axis = int(ctx.static(2)) if ctx.n_in() > 2 else 0
+    return ctx.emit("gather", [ctx.var(0), ctx.var(1)], axis=axis)
+
+
+@tf_op("GatherNd")
+def _gather_nd(ctx):
+    return ctx.emit("gather_nd", [ctx.var(0), ctx.var(1)])
+
+
+@tf_op("Pad", "PadV2")
+def _pad(ctx):
+    paddings = tuple(tuple(int(v) for v in row)
+                     for row in np.asarray(ctx.static(1)).tolist())
+    cval = float(ctx.static(2)) if ctx.n_in() > 2 else 0.0
+    return ctx.emit("pad", [ctx.var(0), paddings], constant_value=cval)
+
+
+@tf_op("MirrorPad")
+def _mirror_pad(ctx):
+    paddings = tuple(tuple(int(v) for v in row)
+                     for row in np.asarray(ctx.static(1)).tolist())
+    mode = ctx.attr("mode", "REFLECT").lower()
+    return ctx.emit("pad", [ctx.var(0), paddings], mode=mode)
+
+
+@tf_op("BroadcastTo")
+def _broadcast_to(ctx):
+    shape = tuple(int(d) for d in np.asarray(ctx.static(1)).tolist())
+    return ctx.emit("broadcast_to", [ctx.var(0), shape])
+
+
+@tf_op("Fill")
+def _fill(ctx):
+    shape = tuple(int(d) for d in np.asarray(ctx.static(0)).tolist())
+    return ctx.emit("fill", [shape, ctx.var(1)])
+
+
+@tf_op("Range")
+def _range(ctx):
+    return ctx.emit("range", [ctx.var(0), ctx.var(1), ctx.var(2)])
+
+
+@tf_op("ZerosLike")
+def _zeros_like(ctx):
+    return ctx.emit("zeros_as", [ctx.var(0)])
+
+
+@tf_op("OnesLike")
+def _ones_like(ctx):
+    return ctx.emit("ones_as", [ctx.var(0)])
+
+
+@tf_op("Size")
+def _size(ctx):
+    return ctx.emit("size", [ctx.var(0)])
+
+
+@tf_op("Rank")
+def _rank(ctx):
+    return ctx.emit("rank", [ctx.var(0)])
+
+
+@tf_op("ReverseV2")
+def _reverse(ctx):
+    dims = tuple(int(d) for d in np.atleast_1d(ctx.static(1)).tolist())
+    return ctx.emit("reverse", [ctx.var(0), dims])
+
+
+@tf_op("OneHot")
+def _one_hot(ctx):
+    depth = int(ctx.static(1))
+    on = float(ctx.static(2)) if ctx.n_in() > 2 else 1.0
+    off = float(ctx.static(3)) if ctx.n_in() > 3 else 0.0
+    return ctx.emit("one_hot", [ctx.var(0)], depth=depth, on_value=on,
+                    off_value=off, axis=ctx.attr("axis", -1))
+
+
+@tf_op("Cumsum")
+def _cumsum(ctx):
+    return ctx.emit("cumsum", [ctx.var(0)], axis=int(ctx.static(1)),
+                    exclusive=ctx.attr("exclusive", False),
+                    reverse=ctx.attr("reverse", False))
+
+
+@tf_op("Where")
+def _where(ctx):
+    if ctx.n_in() == 1:
+        raise UnsupportedTFOpError(
+            "Where(cond) single-arg", ctx.name)  # dynamic output shape
+    return ctx.emit("where", [ctx.var(0), ctx.var(1), ctx.var(2)])
+
+
+# --------------------------------------------------------------------------
+# mappers — linear algebra / NN
+
+
+@tf_op("MatMul")
+def _matmul(ctx):
+    return ctx.emit("matmul", [ctx.var(0), ctx.var(1)],
+                    transpose_x=ctx.attr("transpose_a", False),
+                    transpose_y=ctx.attr("transpose_b", False))
+
+
+@tf_op("BatchMatMul", "BatchMatMulV2", "BatchMatMulV3")
+def _batch_matmul(ctx):
+    return ctx.emit("batched_gemm", [ctx.var(0), ctx.var(1)],
+                    transpose_x=ctx.attr("adj_x", False),
+                    transpose_y=ctx.attr("adj_y", False))
+
+
+@tf_op("Einsum")
+def _einsum(ctx):
+    eq = ctx.attr("equation")
+    return ctx.sd._add_op("einsum", ctx.vars(), name=ctx.name, equation=eq)
+
+
+@tf_op("BiasAdd")
+def _bias_add(ctx):
+    fmt = ctx.attr("data_format", "NHWC")
+    if fmt == "NCHW":
+        return ctx.emit("bias_add", [ctx.var(0), ctx.var(1)], data_format="NCHW")
+    return ctx.emit("add", [ctx.var(0), ctx.var(1)])  # broadcast on last axis
+
+
+@tf_op("Softmax")
+def _softmax(ctx):
+    return ctx.emit("softmax", [ctx.var(0)], axis=-1)
+
+
+@tf_op("LogSoftmax")
+def _log_softmax(ctx):
+    return ctx.emit("log_softmax", [ctx.var(0)], axis=-1)
+
+
+@tf_op("L2Loss")
+def _l2_loss(ctx):
+    x = ctx.var(0)
+    sq = ctx.sd._add_op("square", [x])
+    s = ctx.sd._add_op("reduce_sum", [sq])
+    return ctx.emit("multiply", [s, 0.5])
+
+
+def _tf_conv_args(ctx, rank=2):
+    fmt = ctx.attr("data_format", "NHWC")
+    strides = ctx.attr("strides", [1] * (rank + 2))
+    dilations = ctx.attr("dilations", [1] * (rank + 2))
+    if fmt.startswith("NC"):
+        s = strides[2:2 + rank]
+        d = dilations[2:2 + rank]
+    else:
+        s = strides[1:1 + rank]
+        d = dilations[1:1 + rank]
+    padding = ctx.attr("padding", "VALID")
+    if padding == "EXPLICIT":
+        raise UnsupportedTFOpError("Conv EXPLICIT padding", ctx.name)
+    return fmt, tuple(s), tuple(d), padding
+
+
+@tf_op("Conv2D")
+def _conv2d(ctx):
+    fmt, s, d, pad = _tf_conv_args(ctx)
+    w = ctx.var(1)
+    # TF kernel HWIO -> reference OIHW
+    w_oihw = ctx.sd._add_op("permute", [w, (3, 2, 0, 1)])
+    return ctx.emit("conv2d", [ctx.var(0), w_oihw], strides=s, padding=pad,
+                    dilation=d, data_format="NCHW" if fmt == "NCHW" else "NHWC")
+
+
+@tf_op("DepthwiseConv2dNative")
+def _depthwise_conv2d(ctx):
+    fmt, s, d, pad = _tf_conv_args(ctx)
+    w = ctx.var(1)
+    # TF kernel [kH,kW,C,mult] -> reference [mult,C,kH,kW]
+    w_r = ctx.sd._add_op("permute", [w, (3, 2, 0, 1)])
+    return ctx.emit("depthwise_conv2d", [ctx.var(0), w_r], strides=s,
+                    padding=pad, dilation=d,
+                    data_format="NCHW" if fmt == "NCHW" else "NHWC")
+
+
+def _tf_pool_args(ctx):
+    fmt = ctx.attr("data_format", "NHWC")
+    ks = ctx.attr("ksize", [1, 1, 1, 1])
+    st = ctx.attr("strides", [1, 1, 1, 1])
+    if fmt.startswith("NC"):
+        k, s = ks[2:4], st[2:4]
+    else:
+        k, s = ks[1:3], st[1:3]
+    return fmt, tuple(k), tuple(s), ctx.attr("padding", "VALID")
+
+
+@tf_op("MaxPool")
+def _max_pool(ctx):
+    fmt, k, s, pad = _tf_pool_args(ctx)
+    return ctx.emit("maxpool2d", [ctx.var(0)], kernel=k, strides=s, padding=pad,
+                    data_format="NCHW" if fmt == "NCHW" else "NHWC")
+
+
+@tf_op("AvgPool")
+def _avg_pool(ctx):
+    fmt, k, s, pad = _tf_pool_args(ctx)
+    return ctx.emit("avgpool2d", [ctx.var(0)], kernel=k, strides=s, padding=pad,
+                    data_format="NCHW" if fmt == "NCHW" else "NHWC")
+
+
+@tf_op("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3")
+def _fused_batch_norm(ctx):
+    if ctx.attr("is_training", True):
+        raise UnsupportedTFOpError(
+            "FusedBatchNorm(is_training=True) — freeze the graph for "
+            "inference first", ctx.name)
+    fmt = ctx.attr("data_format", "NHWC")
+    x, gamma, beta, mean, var = (ctx.var(0), ctx.var(1), ctx.var(2),
+                                 ctx.var(3), ctx.var(4))
+    out = ctx.emit("batchnorm", [x, mean, var, gamma, beta],
+                   epsilon=ctx.attr("epsilon", 1e-3),
+                   axis=1 if fmt == "NCHW" else -1)
+    # V3 emits 6 outputs; only y (index 0) is consumed in frozen graphs
+    return (out, mean, var, mean, var, mean)
+
+
+@tf_op("MatrixDiag", "MatrixDiagPart")
+def _matrix_diag(ctx):
+    table = {"MatrixDiag": "matrix_diag", "MatrixDiagPart": "matrix_diag_part"}
+    return ctx.emit(table[ctx.node.op], [ctx.var(0)])
+
+
+@tf_op("TopKV2")
+def _top_k(ctx):
+    k = int(ctx.static(1))
+    return ctx.emit("top_k", [ctx.var(0)], k=k, sorted=ctx.attr("sorted", True),
+                    n_outputs=2)
+
+
+@tf_op("SparseSoftmaxCrossEntropyWithLogits")
+def _sparse_softmax_ce(ctx):
+    # TF returns PER-EXAMPLE losses (plus a backprop tensor frozen graphs
+    # never consume); the registry op reduces, so compose it unreduced
+    logits, labels = ctx.var(0), ctx.var(1)
+    logp = ctx.sd._add_op("log_softmax", [logits], axis=-1)
+    lbl_oh = ctx.sd._add_op("one_hot", [labels],
+                            depth=int(ctx.shape_of_input(0)[-1]))
+    picked = ctx.sd._add_op("multiply", [logp, lbl_oh])
+    per = ctx.sd._add_op("reduce_sum", [picked], dims=(-1,))
+    return ctx.emit("neg", [per])
+
+
+# --------------------------------------------------------------------------
+# public API
+
+
+class TFGraphMapper:
+    """Reference-shaped entry (``TFGraphMapper.importGraph``)."""
+
+    @staticmethod
+    def import_graph(graph, input_shapes: Optional[Dict[str, Sequence[int]]] = None
+                     ) -> SameDiff:
+        gd = _as_graph_def(graph)
+        imp = _Importer(gd, input_shapes)
+        sd = imp.run()
+        sd.tf_placeholders = list(imp.placeholders)
+        sd.tf_outputs = list(imp.outputs)
+        return sd
+
+    importGraph = import_graph
+
+
+def import_frozen_tf(path_or_graphdef,
+                     input_shapes: Optional[Dict[str, Sequence[int]]] = None
+                     ) -> SameDiff:
+    """Reference ``SameDiff.importFrozenTF``: frozen GraphDef (.pb path, bytes,
+    or proto) → SameDiff graph executable/trainable on TPU."""
+    return TFGraphMapper.import_graph(path_or_graphdef, input_shapes)
+
+
+def _as_graph_def(graph):
+    from tensorflow.core.framework import graph_pb2
+
+    if isinstance(graph, graph_pb2.GraphDef):
+        return graph
+    if isinstance(graph, (str,)):
+        gd = graph_pb2.GraphDef()
+        with open(graph, "rb") as f:
+            gd.ParseFromString(f.read())
+        return gd
+    if isinstance(graph, bytes):
+        gd = graph_pb2.GraphDef()
+        gd.ParseFromString(graph)
+        return gd
+    if hasattr(graph, "as_graph_def"):
+        return graph.as_graph_def()
+    raise TypeError(f"cannot interpret {type(graph)} as a GraphDef")

@@ -54,8 +54,13 @@ def split(x, num_split: int, axis: int = 0):
 
 @op("split_v", "shape")
 def split_v(x, sizes: Sequence[int], axis: int = 0):
-    idx = list(jnp.cumsum(jnp.asarray(sizes))[:-1])
-    return tuple(jnp.split(x, [int(i) for i in idx], axis=axis))
+    # split points stay Python ints: jnp math here would become tracers under
+    # jit and jnp.split needs static indices
+    idx, acc = [], 0
+    for s in list(sizes)[:-1]:
+        acc += int(s)
+        idx.append(acc)
+    return tuple(jnp.split(x, idx, axis=axis))
 
 
 @op("stack", "shape")

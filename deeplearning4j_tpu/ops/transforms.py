@@ -333,3 +333,29 @@ def softmax(x, axis: int = -1):
 @op("log_softmax", "activation")
 def log_softmax(x, axis: int = -1):
     return jax.nn.log_softmax(x, axis=axis)
+
+
+@op("cast", "datatype")
+def cast(x, dtype="float32"):
+    """Dtype cast (reference DataTypes family / TF Cast import target)."""
+    return jnp.asarray(x).astype(jnp.dtype(dtype))
+
+
+@op("stop_gradient", "transform")
+def stop_gradient(x):
+    return lax.stop_gradient(x)
+
+
+@op("einsum", "linalg")
+def einsum(*xs, equation: str):
+    """General tensor contraction (TF Einsum import target) — XLA lowers
+    contractions straight onto the MXU."""
+    return jnp.einsum(equation, *xs)
+
+
+@op("tf_strided_slice", "shape")
+def tf_strided_slice(x, spec=None):
+    """TF StridedSlice semantics: a pre-resolved numpy-style index spec
+    (slices / ints / None / Ellipsis) computed at import time from the TF
+    begin/end/stride masks (imports/tf_graph_mapper.py)."""
+    return x[tuple(spec)]

@@ -896,6 +896,34 @@ class TestBitwise:
         assert int(got) == 2
 
 
+class TestDatatypeAndImportOps:
+    """Ops added for the TF-import path (M6)."""
+
+    def test_cast(self):
+        x = r(3, 4) * 5
+        check("cast", x.astype(np.int32), x, dtype="int32")
+        check("cast", x.astype(np.int32).astype(np.float32),
+              x.astype(np.int32), dtype="float32")
+
+    def test_stop_gradient(self):
+        x = r(3, 4)
+        check("stop_gradient", x, x)
+        g = jax.grad(lambda a: jnp.sum(exec_op("stop_gradient", a) * a))(
+            jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(g), x, atol=1e-6)
+
+    def test_einsum(self):
+        a, b = r(2, 3, 4), r(2, 4, 5, seed=1)
+        check("einsum", np.einsum("bij,bjk->bik", a, b), a, b,
+              equation="bij,bjk->bik")
+
+    def test_tf_strided_slice(self):
+        x = r(4, 6, 3)
+        check("tf_strided_slice", x[1:3, ::2, 1], x,
+              spec=(slice(1, 3), slice(None, None, 2), 1))
+        check("tf_strided_slice", x[0], x, spec=(0,))
+
+
 class TestCoverageLedger:
     """The reference's coverage-ledger gate: every registered op must be
     exercised by this suite or explicitly listed as pending with a reason."""

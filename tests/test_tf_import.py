@@ -1,0 +1,389 @@
+"""TF-import conformance suite (golden-file harness).
+
+Reference: nd4j ``org.nd4j.imports.tfgraphs.TFGraphTestAllSameDiff`` — a
+data-driven harness over tiny frozen TF graphs with recorded input/output
+tensors (SURVEY.md §4.3). The upstream test resources aren't reachable here
+(no egress), so goldens are GENERATED with the locally installed TF 2.21 at
+test time: build a tf.function → freeze to GraphDef → import with
+``import_frozen_tf`` → execute the SameDiff module → compare against TF's
+eager output within per-op tolerance. Same harness shape, no network.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.imports import import_frozen_tf  # noqa: E402
+
+F32 = np.float32
+rng = np.random.RandomState(7)
+
+
+def _freeze(fn, specs):
+    from tensorflow.python.framework.convert_to_constants import \
+        convert_variables_to_constants_v2
+
+    cf = tf.function(fn).get_concrete_function(*specs)
+    frozen = convert_variables_to_constants_v2(cf)
+    gd = frozen.graph.as_graph_def()
+    in_names = [t.name.split(":")[0] for t in frozen.inputs]
+    return gd, in_names
+
+
+def check(fn, inputs, atol=1e-5, rtol=1e-5):
+    """Freeze fn over `inputs`, import, execute, compare to TF eager."""
+    specs = [tf.TensorSpec(np.shape(a), tf.as_dtype(np.asarray(a).dtype))
+             for a in inputs]
+    expected = fn(*[tf.constant(a) for a in inputs])
+    gd, in_names = _freeze(fn, specs)
+    sd = import_frozen_tf(gd)
+    assert sd.tf_outputs, "importer found no graph outputs"
+    ph = dict(zip(in_names, inputs))
+    out = sd.output(ph, sd.tf_outputs[:1])[sd.tf_outputs[0]].to_numpy()
+    np.testing.assert_allclose(out, np.asarray(expected), atol=atol, rtol=rtol,
+                               err_msg=f"{fn}")
+
+
+def A(*shape, dtype=F32, lo=-2.0, hi=2.0):
+    return (rng.uniform(lo, hi, shape)).astype(dtype)
+
+
+def P(*shape):  # strictly positive
+    return (rng.uniform(0.1, 2.0, shape)).astype(F32)
+
+
+class TestElementwise:
+    """One conformance case per TF elementwise op."""
+
+    @pytest.mark.parametrize("tfop", [
+        tf.math.add, tf.math.subtract, tf.math.multiply, tf.math.divide,
+        tf.math.maximum, tf.math.minimum, tf.math.squared_difference,
+        tf.math.atan2,
+    ])
+    def test_binary(self, tfop):
+        check(lambda a, b: tfop(a, b), [A(3, 4), A(3, 4)])
+
+    def test_binary_broadcast(self):
+        check(lambda a, b: tf.math.add(a, b), [A(3, 4), A(4)])
+        check(lambda a, b: tf.math.multiply(a, b), [A(2, 3, 4), A(3, 1)])
+
+    def test_pow(self):
+        check(lambda a, b: tf.math.pow(a, b), [P(3, 3), A(3, 3)], atol=1e-4)
+
+    def test_floordiv_floormod(self):
+        a, b = A(4, 4, lo=1, hi=9), P(4, 4)
+        check(lambda x, y: tf.math.floordiv(x, y), [a, b])
+        check(lambda x, y: tf.math.floormod(x, y), [a, b], atol=1e-4)
+
+    @pytest.mark.parametrize("tfop", [
+        tf.math.abs, tf.math.negative, tf.math.exp, tf.math.sign,
+        tf.math.floor, tf.math.ceil, tf.math.rint, tf.math.square,
+        tf.math.sin, tf.math.cos, tf.math.tan, tf.math.sinh, tf.math.cosh,
+        tf.math.tanh, tf.math.asinh, tf.math.atan, tf.math.erf, tf.math.erfc,
+        tf.math.sigmoid, tf.math.softplus, tf.math.reciprocal, tf.math.expm1,
+    ])
+    def test_unary(self, tfop):
+        check(lambda a: tfop(a), [A(3, 5)], atol=1e-5)
+
+    @pytest.mark.parametrize("tfop", [tf.math.log, tf.math.log1p, tf.math.sqrt,
+                                      tf.math.rsqrt])
+    def test_unary_positive_domain(self, tfop):
+        check(lambda a: tfop(a), [P(3, 5)])
+
+    @pytest.mark.parametrize("tfop", [tf.math.asin, tf.math.acos,
+                                      tf.math.atanh])
+    def test_unary_unit_domain(self, tfop):
+        check(lambda a: tfop(a), [A(3, 5, lo=-0.9, hi=0.9)], atol=1e-5)
+
+    def test_acosh(self):
+        check(lambda a: tf.math.acosh(a), [A(3, 5, lo=1.1, hi=3.0)])
+
+    @pytest.mark.parametrize("tfop", [tf.nn.relu, tf.nn.relu6, tf.nn.elu,
+                                      tf.nn.selu, tf.nn.softsign])
+    def test_activations(self, tfop):
+        check(lambda a: tfop(a), [A(4, 6)])
+
+    def test_leaky_relu(self):
+        check(lambda a: tf.nn.leaky_relu(a, alpha=0.3), [A(4, 6)])
+
+    def test_clip_by_value(self):
+        check(lambda a: tf.clip_by_value(a, -0.5, 0.5), [A(4, 6)])
+
+    def test_comparisons_and_logical(self):
+        a, b = A(3, 4), A(3, 4)
+        check(lambda x, y: tf.cast(tf.math.equal(x, y), tf.float32), [a, a])
+        check(lambda x, y: tf.cast(tf.math.greater(x, y), tf.float32), [a, b])
+        check(lambda x, y: tf.cast(tf.math.less_equal(x, y), tf.float32), [a, b])
+        check(lambda x, y: tf.cast(
+            tf.logical_and(x > 0.0, y > 0.0), tf.float32), [a, b])
+        check(lambda x: tf.cast(tf.logical_not(x > 0.0), tf.float32), [a])
+
+    def test_select(self):
+        check(lambda c, x, y: tf.where(c > 0.0, x, y), [A(3, 4), A(3, 4), A(3, 4)])
+
+    def test_cast_chain(self):
+        check(lambda a: tf.cast(tf.cast(a, tf.int32), tf.float32),
+              [A(3, 4, lo=0, hi=9)])
+
+    def test_is_finite(self):
+        check(lambda a: tf.cast(tf.math.is_finite(a), tf.float32), [A(3, 4)])
+
+
+class TestReductions:
+    @pytest.mark.parametrize("tfop,ours_tol", [
+        (tf.reduce_sum, 1e-5), (tf.reduce_mean, 1e-6), (tf.reduce_max, 0),
+        (tf.reduce_min, 0), (tf.reduce_prod, 1e-5),
+    ])
+    def test_axis_variants(self, tfop, ours_tol):
+        x = A(3, 4, 5)
+        check(lambda a: tfop(a), [x], atol=1e-5)
+        check(lambda a: tfop(a, axis=1), [x], atol=1e-5)
+        check(lambda a: tfop(a, axis=[0, 2], keepdims=True), [x], atol=1e-5)
+
+    def test_argmax_argmin(self):
+        x = A(4, 7)
+        check(lambda a: tf.cast(tf.argmax(a, axis=1), tf.float32), [x])
+        check(lambda a: tf.cast(tf.argmin(a, axis=0), tf.float32), [x])
+
+    def test_all_any(self):
+        x = A(3, 4)
+        check(lambda a: tf.cast(tf.reduce_all(a > 0.0, axis=1), tf.float32), [x])
+        check(lambda a: tf.cast(tf.reduce_any(a > 0.0, axis=0), tf.float32), [x])
+
+    def test_l2_loss(self):
+        check(lambda a: tf.nn.l2_loss(a), [A(5, 3)], atol=1e-5)
+
+    def test_cumsum(self):
+        x = A(3, 6)
+        check(lambda a: tf.cumsum(a, axis=1), [x])
+        check(lambda a: tf.cumsum(a, axis=0, exclusive=True), [x])
+        check(lambda a: tf.cumsum(a, axis=1, reverse=True), [x])
+
+
+class TestShape:
+    def test_reshape_static_and_inferred(self):
+        x = A(2, 3, 4)
+        check(lambda a: tf.reshape(a, [6, 4]), [x])
+        check(lambda a: tf.reshape(a, [-1, 4]), [x])
+        check(lambda a: tf.reshape(a, [2, -1]), [x])
+
+    def test_reshape_via_shape_subgraph(self):
+        # the classic dynamic-looking pattern: Shape -> StridedSlice -> Pack
+        def fn(a):
+            s = tf.shape(a)
+            return tf.reshape(a, tf.stack([s[0], s[1] * s[2]]))
+
+        check(fn, [A(2, 3, 4)])
+
+    def test_transpose(self):
+        check(lambda a: tf.transpose(a, [1, 0]), [A(3, 4)])
+        check(lambda a: tf.transpose(a, [0, 2, 1, 3]), [A(2, 3, 4, 5)])
+
+    def test_expand_squeeze(self):
+        check(lambda a: tf.expand_dims(a, 1), [A(3, 4)])
+        check(lambda a: tf.squeeze(a, axis=1), [A(3, 1, 4)])
+        check(lambda a: tf.squeeze(a), [A(3, 1, 4, 1)])
+
+    def test_concat_stack_unstack(self):
+        check(lambda a, b: tf.concat([a, b], axis=1), [A(3, 2), A(3, 5)])
+        check(lambda a, b: tf.stack([a, b], axis=0), [A(3, 4), A(3, 4)])
+        check(lambda a: tf.add_n(tf.unstack(a, axis=1)) if False else
+              sum(tf.unstack(a, axis=1)), [A(3, 4)])
+
+    def test_split(self):
+        check(lambda a: tf.concat(tf.split(a, 3, axis=1)[::-1], axis=1),
+              [A(2, 9)])
+        check(lambda a: tf.concat(tf.split(a, [2, 3, 4], axis=1)[::-1], axis=1),
+              [A(2, 9)])
+
+    def test_slice_strided_slice(self):
+        x = A(4, 6, 3)
+        check(lambda a: tf.slice(a, [1, 2, 0], [2, 3, -1]), [x])
+        check(lambda a: a[1:3, ::2, 1], [x])
+        check(lambda a: a[:, -2:], [x])
+        check(lambda a: a[0], [x])
+
+    def test_tile(self):
+        check(lambda a: tf.tile(a, [2, 3]), [A(2, 3)])
+
+    def test_pad(self):
+        x = A(3, 4)
+        check(lambda a: tf.pad(a, [[1, 2], [0, 1]]), [x])
+        check(lambda a: tf.pad(a, [[1, 1], [2, 2]], constant_values=1.5), [x])
+        check(lambda a: tf.pad(a, [[1, 1], [1, 1]], mode="REFLECT"), [x])
+
+    def test_gather(self):
+        idx = np.array([2, 0, 1, 2], np.int32)
+        check(lambda a, i: tf.gather(a, i), [A(4, 5), idx])
+        check(lambda a, i: tf.gather(a, i, axis=1), [A(3, 4), idx[:2]])
+
+    def test_gather_nd(self):
+        idx = np.array([[0, 1], [2, 0]], np.int32)
+        check(lambda a, i: tf.gather_nd(a, i), [A(3, 4), idx])
+
+    def test_fill_zeros_ones_like(self):
+        x = A(3, 4)
+        check(lambda a: a + tf.zeros_like(a) + tf.ones_like(a), [x])
+        check(lambda a: a * tf.fill([3, 4], 2.0), [x])
+
+    def test_broadcast_to(self):
+        check(lambda a: tf.broadcast_to(a, [3, 4]) * 1.0, [A(4)])
+
+    def test_range(self):
+        check(lambda a: a + tf.cast(tf.range(0, 4, 1), tf.float32), [A(3, 4)])
+
+    def test_one_hot(self):
+        idx = np.array([0, 2, 1], np.int32)
+        check(lambda i: tf.one_hot(i, 4), [idx])
+        check(lambda i: tf.one_hot(i, 4, on_value=2.0, off_value=-1.0), [idx])
+
+    def test_reverse(self):
+        check(lambda a: tf.reverse(a, axis=[1]), [A(3, 4)])
+
+    def test_shape_size_rank_as_values(self):
+        def fn(a):
+            return (tf.cast(tf.size(a), tf.float32)
+                    + tf.cast(tf.rank(a), tf.float32) + tf.reduce_sum(a))
+
+        check(fn, [A(3, 4)])
+
+
+class TestLinalgNN:
+    def test_matmul(self):
+        check(lambda a, b: tf.matmul(a, b), [A(3, 4), A(4, 5)], atol=1e-5)
+        check(lambda a, b: tf.matmul(a, b, transpose_a=True), [A(4, 3), A(4, 5)],
+              atol=1e-5)
+        check(lambda a, b: tf.matmul(a, b, transpose_b=True), [A(3, 4), A(5, 4)],
+              atol=1e-5)
+
+    def test_batch_matmul(self):
+        check(lambda a, b: tf.matmul(a, b), [A(2, 3, 4), A(2, 4, 5)], atol=1e-5)
+        check(lambda a, b: tf.matmul(a, b, adjoint_b=True),
+              [A(2, 4, 3, 5), A(2, 4, 6, 5)], atol=1e-4)
+
+    def test_einsum(self):
+        check(lambda a, b: tf.einsum("bij,bjk->bik", a, b),
+              [A(2, 3, 4), A(2, 4, 5)], atol=1e-5)
+
+    def test_bias_add(self):
+        check(lambda a, b: tf.nn.bias_add(a, b), [A(3, 4), A(4)])
+
+    def test_softmax_logsoftmax(self):
+        check(lambda a: tf.nn.softmax(a), [A(3, 7)], atol=1e-6)
+        check(lambda a: tf.nn.log_softmax(a), [A(3, 7)], atol=1e-5)
+
+    def test_conv2d_same_valid(self):
+        x = A(2, 8, 8, 3)  # NHWC
+        w = A(3, 3, 3, 5)  # HWIO
+        check(lambda a, k: tf.nn.conv2d(a, k, strides=1, padding="VALID"),
+              [x, w], atol=1e-4)
+        check(lambda a, k: tf.nn.conv2d(a, k, strides=2, padding="SAME"),
+              [x, w], atol=1e-4)
+
+    def test_depthwise_conv2d(self):
+        x = A(2, 8, 8, 3)
+        w = A(3, 3, 3, 2)  # [kh, kw, C, mult]
+        check(lambda a, k: tf.nn.depthwise_conv2d(
+            a, k, strides=[1, 1, 1, 1], padding="VALID"), [x, w], atol=1e-4)
+
+    def test_pooling(self):
+        x = A(2, 8, 8, 3)
+        check(lambda a: tf.nn.max_pool2d(a, 2, 2, "VALID"), [x])
+        check(lambda a: tf.nn.avg_pool2d(a, 2, 2, "VALID"), [x], atol=1e-5)
+
+    def test_fused_batch_norm_inference(self):
+        x = A(2, 4, 4, 3)
+        gamma, beta = P(3), A(3)
+        mean, var = A(3), P(3)
+
+        def fn(a):
+            y, _, _ = tf.compat.v1.nn.fused_batch_norm(
+                a, gamma, beta, mean=mean, variance=var, is_training=False)
+            return y
+
+        check(fn, [x], atol=1e-4)
+
+    def test_top_k_values(self):
+        def fn(a):
+            vals, _ = tf.math.top_k(a, k=3)
+            return vals
+
+        check(fn, [A(4, 8)])
+
+    def test_layer_norm_pattern(self):
+        """The composed LayerNorm subgraph BERT emits (Mean/SquaredDifference/
+        Rsqrt) — exercises the whole pattern end to end."""
+        g, b = P(6), A(6)
+
+        def fn(x):
+            mu = tf.reduce_mean(x, axis=-1, keepdims=True)
+            var = tf.reduce_mean(tf.math.squared_difference(x, mu), axis=-1,
+                                 keepdims=True)
+            return (x - mu) * tf.math.rsqrt(var + 1e-6) * g + b
+
+        check(fn, [A(3, 5, 6)], atol=1e-5)
+
+    def test_gelu_pattern(self):
+        def fn(x):
+            return 0.5 * x * (1.0 + tf.math.erf(x / tf.sqrt(2.0)))
+
+        check(fn, [A(3, 6)], atol=1e-5)
+
+    def test_attention_pattern(self):
+        """Scaled-dot-product attention as BERT emits it (BatchMatMul +
+        Softmax + masking via additive bias)."""
+        def fn(q, k, v, m):
+            scores = tf.matmul(q, k, transpose_b=True) / 8.0
+            scores += (1.0 - m) * -10000.0
+            return tf.matmul(tf.nn.softmax(scores), v)
+
+        B, H, T, D = 2, 2, 5, 4
+        mask = np.ones((B, 1, 1, T), F32)
+        mask[0, :, :, 3:] = 0
+        check(fn, [A(B, H, T, D), A(B, H, T, D), A(B, H, T, D), mask],
+              atol=1e-5)
+
+    def test_embedding_pattern(self):
+        table = A(11, 6)
+        ids = np.array([[1, 3, 5], [0, 2, 10]], np.int32)
+        check(lambda i: tf.gather(table, i), [ids])
+
+    def test_sparse_softmax_cross_entropy(self):
+        logits = A(4, 7)
+        labels = np.array([1, 0, 6, 3], np.int32)
+        check(lambda lg, lb: tf.nn.sparse_softmax_cross_entropy_with_logits(
+            labels=lb, logits=lg), [logits, labels], atol=1e-5)
+
+
+class TestGraphLevel:
+    def test_mlp_forward(self):
+        w1, b1, w2, b2 = A(10, 16), A(16), A(16, 3), A(3)
+
+        def fn(x):
+            h = tf.nn.relu(tf.matmul(x, w1) + b1)
+            return tf.nn.softmax(tf.matmul(h, w2) + b2)
+
+        check(fn, [A(4, 10)], atol=1e-5)
+
+    def test_cnn_forward(self):
+        w = A(3, 3, 1, 4)
+
+        def fn(x):
+            h = tf.nn.relu(tf.nn.conv2d(x, w, strides=1, padding="SAME"))
+            h = tf.nn.max_pool2d(h, 2, 2, "VALID")
+            return tf.reduce_mean(h, axis=[1, 2])
+
+        check(fn, [A(2, 8, 8, 1)], atol=1e-4)
+
+    def test_multi_placeholder(self):
+        check(lambda a, b, c: (a + b) * c - tf.reduce_sum(b),
+              [A(3, 4), A(3, 4), A(3, 4)])
+
+    def test_supported_ops_inventory(self):
+        """The table must stay >= 100 mapped TF ops (VERDICT round-1 #3)."""
+        from deeplearning4j_tpu.imports import supported_tf_ops
+
+        assert len(supported_tf_ops()) >= 100, supported_tf_ops()
