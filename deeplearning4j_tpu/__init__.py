@@ -14,10 +14,11 @@ Layer map (≈ SURVEY.md §1):
   data/       datasets, iterators, readers, normalizers (ref: datavec, dl4j-data)
   parallel/   SPMD mesh wrapper, ParallelWrapper analog (ref: dl4j-scaleout)
   models/     model zoo                                 (ref: dl4j-zoo)
-  nlp/        Word2Vec family                           (ref: dl4j-nlp)
-  imports/    Keras h5 / TF GraphDef import             (ref: dl4j-modelimport)
+  imports/    TF frozen-GraphDef → SameDiff, Keras h5   (ref: dl4j-modelimport,
+              → MultiLayerNetwork                        samediff-import)
   eval/       Evaluation / ROC / RegressionEvaluation   (ref: nd4j evaluation)
-  optimize/   listeners, early stopping                 (ref: dl4j optimize)
+  optimize/   training listeners                        (ref: dl4j optimize)
+  nlp/        Word2Vec family                           (ref: dl4j-nlp) [building]
 """
 
 import jax as _jax

@@ -6,10 +6,12 @@ Reference: nd4j ``samediff-import-{api,tensorflow}`` + legacy
 (SURVEY.md §2.1, §2.3, §3.4).
 """
 
+from .keras_import import KerasModelImport, UnsupportedKerasLayerError
 from .tf_graph_mapper import (TFGraphMapper, UnsupportedTFOpError,
                               import_frozen_tf, supported_tf_ops, tf_op)
 
 __all__ = [
     "TFGraphMapper", "UnsupportedTFOpError", "import_frozen_tf",
-    "supported_tf_ops", "tf_op",
+    "supported_tf_ops", "tf_op", "KerasModelImport",
+    "UnsupportedKerasLayerError",
 ]

@@ -1,0 +1,471 @@
+"""Keras h5 import → MultiLayerNetwork.
+
+Reference: dl4j-modelimport ``org.deeplearning4j.nn.modelimport.keras.
+KerasModelImport`` / ``KerasSequentialModel`` + the ~60 ``KerasLayer``
+mapping classes (SURVEY.md §2.3). This rebuild maps the common Sequential
+surface; the h5 container is read with h5py (the reference wraps HDF5 via
+JavaCPP ``Hdf5Archive``).
+
+Layout conversions (the part the reference spends KerasLayer subclasses on):
+
+- Keras is channels_last (NHWC); the network body here is NCHW. The imported
+  model keeps Keras's INPUT contract (NHWC arrays in) via a transpose
+  preprocessor at layer 0, weights are transposed once at import
+  (HWIO→OIHW), and the first post-``Flatten`` Dense kernel's rows are
+  permuted from HWC-flat to CHW-flat order so activations match exactly.
+- Keras LSTM gates are ordered i,f,c,o in two matrices (kernel + recurrent);
+  the fused layout here is one ``[nIn+nOut, 4*nOut]`` matrix in i,f,o,g
+  order — stacked and column-permuted at import.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..nn.conf import layers as L
+from ..nn.conf.builder import NeuralNetConfiguration
+from ..nn.conf.inputs import CNNInput, InputType, Preprocessor
+from ..nn.multilayer import MultiLayerNetwork
+
+_ACTIVATIONS = {
+    "linear": "identity", "relu": "relu", "relu6": "relu6",
+    "softmax": "softmax", "sigmoid": "sigmoid", "tanh": "tanh",
+    # Keras gelu defaults to approximate=False (erf form)
+    "gelu": "gelu_exact", "elu": "elu", "selu": "selu", "softplus": "softplus",
+    "softsign": "softsign", "swish": "swish", "silu": "swish",
+    "leaky_relu": "leakyrelu", "hard_sigmoid": "hardsigmoid", "mish": "mish",
+    "exponential": "exp",
+}
+
+
+class UnsupportedKerasLayerError(NotImplementedError):
+    def __init__(self, class_name: str, detail: str = ""):
+        super().__init__(
+            f"Keras layer {class_name!r} is not mapped yet"
+            + (f" ({detail})" if detail else ""))
+
+
+def _act(name: Optional[str]) -> str:
+    if name is None:
+        return "identity"
+    if name not in _ACTIVATIONS:
+        raise UnsupportedKerasLayerError("activation", name)
+    return _ACTIVATIONS[name]
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (list, tuple)):
+        return int(v[0]), int(v[1])
+    return int(v), int(v)
+
+
+class KerasModelImport:
+    """Reference-shaped entry points."""
+
+    @staticmethod
+    def import_keras_sequential_model_and_weights(h5_path: str) -> MultiLayerNetwork:
+        return _import_sequential(h5_path)
+
+    # reference spelling
+    importKerasSequentialModelAndWeights = import_keras_sequential_model_and_weights
+
+    @staticmethod
+    def import_keras_model_and_weights(h5_path: str) -> MultiLayerNetwork:
+        """Functional-model entry; Sequential topologies are handled, true
+        multi-branch graphs are not mapped yet."""
+        return _import_sequential(h5_path)
+
+    importKerasModelAndWeights = import_keras_model_and_weights
+
+
+def _read_h5(h5_path: str):
+    import h5py
+
+    f = h5py.File(h5_path, "r")
+    cfg = json.loads(f.attrs["model_config"])
+    return f, cfg
+
+
+def _layer_weights(f, layer_name: str) -> List[np.ndarray]:
+    """Ordered weights via the layer group's weight_names attr (stable across
+    Keras 2/3 nesting schemes). Weight-BEARING mappers must check for []
+    and refuse — silently keeping random init would "import" a wrong model."""
+    mw = f["model_weights"]
+    if layer_name not in mw:
+        return []
+    grp = mw[layer_name]
+    if "weight_names" not in grp.attrs:
+        # fall back to collecting datasets in group order
+        out: List[np.ndarray] = []
+
+        def collect(g):
+            import h5py
+
+            for k in g:
+                item = g[k]
+                if isinstance(item, h5py.Dataset):
+                    out.append(np.asarray(item))
+                else:
+                    collect(item)
+
+        collect(grp)
+        return out
+    names = [n.decode() if isinstance(n, bytes) else str(n)
+             for n in grp.attrs["weight_names"]]
+    out = []
+    for n in names:
+        node = grp[n] if n in grp else f["model_weights"][n]
+        out.append(np.asarray(node))
+    return out
+
+
+def _require_weights(ws: List[np.ndarray], cls: str, name: str) -> None:
+    if not ws:
+        raise ValueError(
+            f"no weights found in h5 for layer {name!r} ({cls}); refusing to "
+            "import with random initialization")
+
+
+def _import_sequential(h5_path: str) -> MultiLayerNetwork:
+    f, cfg = _read_h5(h5_path)
+    try:
+        if cfg["class_name"] not in ("Sequential",):
+            raise UnsupportedKerasLayerError(
+                cfg["class_name"],
+                "only Sequential topologies are mapped; use the TF frozen-"
+                "GraphDef importer (import_frozen_tf) for arbitrary graphs")
+        kl_list = cfg["config"]["layers"]
+
+        builder = _SequentialBuilder()
+        for kl in kl_list:
+            builder.add(kl, f)
+        return builder.finish()
+    finally:
+        f.close()
+
+
+class _SequentialBuilder:
+    def __init__(self):
+        self.layers: List[L.Layer] = []
+        self.weights: List[Optional[Callable]] = []  # per our-layer: params setter
+        self.input_type: Optional[InputType] = None
+        self.input_is_nhwc = False
+        self.flatten_pending = False      # saw Flatten; next Dense needs row permute
+        self.flatten_shape: Optional[Tuple[int, int, int]] = None  # (C, H, W)
+        self.cur_cnn: Optional[Tuple[int, int, int]] = None        # (C, H, W)
+        self.pending_activation: Optional[str] = None
+
+    # -- input bookkeeping ------------------------------------------------
+    def _set_input(self, batch_shape):
+        dims = list(batch_shape[1:])
+        if len(dims) == 3:  # NHWC
+            h, w, c = dims
+            self.input_type = InputType.convolutional(h, w, c)
+            self.input_is_nhwc = True
+            self.cur_cnn = (c, h, w)
+        elif len(dims) == 2:
+            t, feat = dims
+            self.input_type = InputType.recurrent(feat, t)
+        elif len(dims) == 1:
+            self.input_type = InputType.feed_forward(dims[0])
+        else:
+            raise UnsupportedKerasLayerError("InputLayer", f"rank {len(dims)}")
+
+    def _update_cnn_shape(self, layer: L.Layer):
+        """Track (C, H, W) through conv/pool layers for the Flatten permute."""
+        if self.cur_cnn is None:
+            return
+        if not isinstance(layer, (L.ConvolutionLayer, L.SubsamplingLayer,
+                                  L.BatchNormalization, L.DropoutLayer,
+                                  L.ActivationLayer)):
+            self.cur_cnn = None  # left CNN space (Dense/GlobalPool/...)
+            return
+        if isinstance(layer, (L.BatchNormalization, L.DropoutLayer,
+                              L.ActivationLayer)):
+            return  # shape-preserving
+        t = layer.set_input_type(CNNInput(*self.cur_cnn))
+        if isinstance(t, CNNInput):
+            self.cur_cnn = (t.channels, t.height, t.width)
+        else:
+            self.cur_cnn = None
+
+    # -- per-layer mapping ------------------------------------------------
+    def add(self, kl: Dict[str, Any], f) -> None:
+        cls = kl["class_name"]
+        c = kl.get("config", {})
+        name = c.get("name", cls)
+        ws = _layer_weights(f, name)
+
+        if cls == "InputLayer":
+            self._set_input(c.get("batch_shape") or c.get("batch_input_shape"))
+            return
+        if self.input_type is None and (c.get("batch_input_shape")
+                                        or c.get("batch_shape")):
+            # Keras-2-era h5: no InputLayer entry, the first real layer
+            # carries batch_input_shape
+            self._set_input(c.get("batch_input_shape") or c.get("batch_shape"))
+        if cls in ("Flatten",):
+            self.flatten_pending = True
+            self.flatten_shape = self.cur_cnn
+            return
+        if cls == "Dropout":
+            self.layers.append(L.DropoutLayer(rate=float(c["rate"])))
+            self.weights.append(None)
+            return
+        if cls in ("Activation", "ReLU", "LeakyReLU", "Softmax", "ELU"):
+            act = {"ReLU": "relu", "Softmax": "softmax", "ELU": "elu"}.get(cls)
+            if cls == "LeakyReLU":
+                # Keras layer default slope is 0.3 (op default is 0.01)
+                slope = float(c.get("negative_slope", c.get("alpha", 0.3)))
+                self.layers.append(L.ActivationLayer(activation="leakyrelu",
+                                                     alpha=slope))
+            elif cls == "ELU":
+                self.layers.append(L.ActivationLayer(
+                    activation="elu", alpha=float(c.get("alpha", 1.0))))
+            else:
+                self.layers.append(L.ActivationLayer(
+                    activation=act or _act(c.get("activation"))))
+            self.weights.append(None)
+            return
+
+        handler = getattr(self, f"_map_{cls}", None)
+        if handler is None:
+            raise UnsupportedKerasLayerError(cls)
+        handler(c, ws)
+
+    def _push(self, layer: L.Layer, setter: Optional[Callable]):
+        self._update_cnn_shape(layer)
+        self.layers.append(layer)
+        self.weights.append(setter)
+
+    def _map_Dense(self, c, ws):
+        _require_weights(ws, 'Dense', c.get('name', '?'))
+        units = int(c["units"])
+        act = _act(c.get("activation"))
+        use_bias = bool(c.get("use_bias", True))
+        kernel = ws[0] if ws else None
+        bias = ws[1] if use_bias and len(ws) > 1 else None
+        if self.flatten_pending and self.flatten_shape is not None and kernel is not None:
+            C, H, W = self.flatten_shape
+            # keras flattens NHWC → rows in HWC order; the body here flattens
+            # NCHW → CHW order. Permute rows once so activations match.
+            perm = np.arange(H * W * C).reshape(H, W, C).transpose(2, 0, 1).ravel()
+            kernel = kernel[perm]
+        self.flatten_pending = False
+
+        if act == "softmax":
+            layer = L.OutputLayer(n_out=units, activation="softmax",
+                                  loss="mcxent", has_bias=use_bias)
+        else:
+            layer = L.DenseLayer(n_out=units, activation=act, has_bias=use_bias)
+
+        def setter(params):
+            params["W"] = np.asarray(kernel)
+            if bias is not None:
+                params["b"] = np.asarray(bias)
+
+        self._push(layer, setter if kernel is not None else None)
+
+    def _map_Conv2D(self, c, ws):
+        _require_weights(ws, 'Conv2D', c.get('name', '?'))
+        if c.get("data_format", "channels_last") != "channels_last":
+            raise UnsupportedKerasLayerError("Conv2D", "channels_first h5")
+        layer = L.ConvolutionLayer(
+            n_out=int(c["filters"]), kernel_size=_pair(c["kernel_size"]),
+            stride=_pair(c.get("strides", 1)),
+            dilation=_pair(c.get("dilation_rate", 1)),
+            convolution_mode="same" if c.get("padding") == "same" else "truncate",
+            activation=_act(c.get("activation")),
+            has_bias=bool(c.get("use_bias", True)))
+        kernel = ws[0].transpose(3, 2, 0, 1) if ws else None  # HWIO→OIHW
+        bias = ws[1] if len(ws) > 1 else None
+
+        def setter(params):
+            params["W"] = kernel
+            if bias is not None:
+                params["b"] = bias
+
+        self._push(layer, setter if kernel is not None else None)
+
+    def _map_DepthwiseConv2D(self, c, ws):
+        _require_weights(ws, 'DepthwiseConv2D', c.get('name', '?'))
+        layer = L.DepthwiseConvolution2D(
+            n_out=0, kernel_size=_pair(c["kernel_size"]),
+            stride=_pair(c.get("strides", 1)),
+            depth_multiplier=int(c.get("depth_multiplier", 1)),
+            convolution_mode="same" if c.get("padding") == "same" else "truncate",
+            activation=_act(c.get("activation")),
+            has_bias=bool(c.get("use_bias", True)))
+        kernel = ws[0].transpose(3, 2, 0, 1) if ws else None  # [kh,kw,C,m]→[m,C,kh,kw]
+        bias = ws[1] if len(ws) > 1 else None
+
+        def setter(params):
+            params["W"] = kernel
+            if bias is not None:
+                params["b"] = bias
+
+        self._push(layer, setter if kernel is not None else None)
+
+    def _pool(self, c, kind):
+        return L.SubsamplingLayer(
+            pooling_type=kind, kernel_size=_pair(c.get("pool_size", 2)),
+            stride=_pair(c.get("strides") or c.get("pool_size", 2)),
+            convolution_mode="same" if c.get("padding") == "same" else "truncate")
+
+    def _map_MaxPooling2D(self, c, ws):
+        self._push(self._pool(c, "max"), None)
+
+    def _map_AveragePooling2D(self, c, ws):
+        self._push(self._pool(c, "avg"), None)
+
+    def _map_GlobalAveragePooling2D(self, c, ws):
+        self._push(L.GlobalPoolingLayer(pooling_type="avg"), None)
+
+    def _map_GlobalMaxPooling2D(self, c, ws):
+        self._push(L.GlobalPoolingLayer(pooling_type="max"), None)
+
+    def _map_BatchNormalization(self, c, ws):
+        layer = L.BatchNormalization(decay=float(c.get("momentum", 0.99)),
+                                     eps=float(c.get("epsilon", 1e-3)))
+        gamma, beta, mean, var = (ws + [None] * 4)[:4]
+
+        def setter(params, state):
+            if gamma is not None:
+                params["gamma"] = gamma
+            if beta is not None:
+                params["beta"] = beta
+            if mean is not None:
+                state["mean"] = mean
+            if var is not None:
+                state["var"] = var
+
+        setter.wants_state = True
+        self._push(layer, setter)
+
+    def _map_Embedding(self, c, ws):
+        _require_weights(ws, 'Embedding', c.get('name', '?'))
+        layer = L.EmbeddingSequenceLayer(n_out=int(c["output_dim"]))
+        # our layer reads vocab from input_type.size; keras models declare the
+        # sequence input as [T] ints and carry input_dim in the layer config —
+        # rewrite the network input type to recurrent(vocab, timesteps=T)
+        from ..nn.conf.inputs import FFInput, RNNInput
+
+        if isinstance(self.input_type, FFInput) and not self.layers:
+            self.input_type = InputType.recurrent(int(c["input_dim"]),
+                                                  self.input_type.size)
+        elif isinstance(self.input_type, RNNInput) and not self.layers:
+            self.input_type = InputType.recurrent(int(c["input_dim"]),
+                                                  self.input_type.timesteps)
+        table = ws[0] if ws else None
+
+        def setter(params):
+            params["W"] = table
+
+        self._push(layer, setter if table is not None else None)
+
+    def _map_LSTM(self, c, ws):
+        _require_weights(ws, 'LSTM', c.get('name', '?'))
+        if not c.get("return_sequences", False):
+            raise UnsupportedKerasLayerError(
+                "LSTM", "return_sequences=False (add GlobalPooling or use "
+                "return_sequences=True)")
+        units = int(c["units"])
+        layer = L.LSTM(n_out=units)
+        if ws:
+            kernel, recurrent, bias = (ws + [None] * 3)[:3]
+            # keras gates i,f,c,o → fused i,f,o,g column order
+            def remap_cols(m):
+                i, fgate, g, o = np.split(m, 4, axis=-1)
+                return np.concatenate([i, fgate, o, g], axis=-1)
+
+            w = remap_cols(np.concatenate([kernel, recurrent], axis=0))
+            b = remap_cols(bias[None, :])[0] if bias is not None else None
+
+            def setter(params):
+                params["W"] = w
+                if b is not None:
+                    params["b"] = b
+
+            self._push(layer, setter)
+        else:
+            self._push(layer, None)
+
+    def _map_SimpleRNN(self, c, ws):
+        _require_weights(ws, 'SimpleRNN', c.get('name', '?'))
+        if not c.get("return_sequences", False):
+            raise UnsupportedKerasLayerError("SimpleRNN",
+                                             "return_sequences=False")
+        layer = L.SimpleRnn(n_out=int(c["units"]),
+                            activation=_act(c.get("activation", "tanh")))
+        if ws:
+            kernel, recurrent, bias = (ws + [None] * 3)[:3]
+
+            def setter(params):
+                params["W"] = kernel
+                params["RW"] = recurrent
+                if bias is not None:
+                    params["b"] = bias
+
+            self._push(layer, setter)
+        else:
+            self._push(layer, None)
+
+    # -- assembly ---------------------------------------------------------
+    def finish(self) -> MultiLayerNetwork:
+        if self.input_type is None:
+            raise ValueError("model has no InputLayer / batch_shape")
+        if not self.layers:
+            raise ValueError("no layers imported")
+        lb = NeuralNetConfiguration.builder().list()
+        for layer in self.layers:
+            lb.layer(layer)
+        conf = lb.set_input_type(self.input_type).build()
+
+        if self.input_is_nhwc:
+            # keep Keras's NHWC input contract: transpose once on entry, then
+            # run the NCHW body (weights were already transposed to OIHW)
+            existing = conf.preprocessors.get(0)
+            nhwc = Preprocessor("NhwcToNchw",
+                                lambda x: x.transpose(0, 3, 1, 2),
+                                conf.layer_output_types[0]
+                                if conf.layer_output_types else None)
+            if existing is not None:
+                conf.preprocessors[0] = Preprocessor(
+                    f"NhwcToNchw+{existing.name}",
+                    lambda x: existing(nhwc(x)), existing.out_type)
+            else:
+                conf.preprocessors[0] = nhwc
+
+        model = MultiLayerNetwork(conf).init()
+        for i, setter in enumerate(self.weights):
+            if setter is None:
+                continue
+            params = {k: np.asarray(v) for k, v in model._params[i].items()}
+            if getattr(setter, "wants_state", False):
+                state = {k: np.asarray(v) for k, v in model._states[i].items()}
+                setter(params, state)
+                for k, v in model._states[i].items():
+                    expect = np.asarray(v).shape
+                    got = np.asarray(state[k]).shape
+                    if expect != got:
+                        raise ValueError(
+                            f"layer {i} state {k!r}: shape {got} != {expect}")
+                model._states[i] = {k: np.asarray(v, dtype=np.float32)
+                                    for k, v in state.items()}
+            else:
+                setter(params)
+            for k, v in model._params[i].items():
+                expect = np.asarray(v).shape
+                got = np.asarray(params[k]).shape
+                if expect != got:
+                    raise ValueError(
+                        f"layer {i} param {k!r}: imported shape {got} != "
+                        f"initialized shape {expect}")
+            import jax.numpy as jnp
+
+            model._params[i] = {k: jnp.asarray(np.asarray(v, dtype=np.float32))
+                                for k, v in params.items()}
+        return model

@@ -539,8 +539,12 @@ _REDUCE = {"Sum": "reduce_sum", "Mean": "reduce_mean", "Max": "reduce_max",
 
 def _reduction(op_name):
     def m(ctx: _Ctx):
-        dims = ctx.static_or_none(1)
-        dims = tuple(np.atleast_1d(dims).tolist()) if dims is not None else None
+        if ctx.n_in() > 1:
+            # structural arg: must resolve statically — a silent fall-through
+            # to all-axes reduction would produce wrong shapes without error
+            dims = tuple(np.atleast_1d(ctx.static(1)).tolist())
+        else:
+            dims = None
         return ctx.emit(op_name, [ctx.var(0)], dims=dims,
                         keep_dims=ctx.attr("keep_dims", False))
 
@@ -564,7 +568,11 @@ def _argmax(ctx):
 @tf_op("ArgMin")
 def _argmin(ctx):
     dim = int(ctx.static(1)) if ctx.n_in() > 1 else 0
-    return ctx.emit("argmin", [ctx.var(0)], dims=(dim,))
+    out = ctx.emit("argmin", [ctx.var(0)], dims=(dim,))
+    odt = ctx.attr("output_type")
+    if odt is not None and np.dtype(odt) != np.int32:
+        out = ctx.sd._add_op("cast", [out], dtype=np.dtype(odt).name)
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -644,15 +652,27 @@ def _slice(ctx):
     return ctx.emit("slice", [ctx.var(0), begin, sizes])
 
 
+def _encode_slice_spec(spec) -> List[List]:
+    """numpy index spec → JSON-safe encoding (SameDiff graphs must
+    serialize; slice/Ellipsis objects are not JSON types)."""
+    out: List[List] = []
+    for s in spec:
+        if isinstance(s, slice):
+            out.append(["slice", s.start, s.stop, s.step])
+        elif s is None:
+            out.append(["newaxis"])
+        elif s is Ellipsis:
+            out.append(["ellipsis"])
+        else:
+            out.append(["idx", int(s)])
+    return out
+
+
 @tf_op("StridedSlice")
 def _strided_slice(ctx):
-    import jax.numpy as jnp
-
     spec = _strided_slice_spec(ctx, ctx.static(1), ctx.static(2), ctx.static(3))
-    x = ctx.var(0)
-    # lower via a custom pick: reuse the registry's strided_slice when the
-    # spec is plain slices; otherwise apply numpy-style indexing in one op
-    return ctx.sd._add_op("tf_strided_slice", [x], name=ctx.name, spec=spec)
+    return ctx.sd._add_op("tf_strided_slice", [ctx.var(0)], name=ctx.name,
+                          spec=_encode_slice_spec(spec))
 
 
 @tf_op("Tile")
@@ -663,6 +683,8 @@ def _tile(ctx):
 
 @tf_op("GatherV2", "Gather")
 def _gather(ctx):
+    if ctx.attr("batch_dims", 0):
+        raise UnsupportedTFOpError("GatherV2(batch_dims>0)", ctx.name)
     axis = int(ctx.static(2)) if ctx.n_in() > 2 else 0
     return ctx.emit("gather", [ctx.var(0), ctx.var(1)], axis=axis)
 

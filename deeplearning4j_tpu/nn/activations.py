@@ -22,6 +22,8 @@ _ACTIVATION_OPS = {
     "elu": "elu",
     "selu": "selu",
     "gelu": "gelu",
+    "gelu_exact": "gelu_exact",    # erf form (Keras/TF default)
+    "exp": "exp",
     "mish": "mish",
     "swish": "swish",
     "sigmoid": "sigmoid",

@@ -364,8 +364,15 @@ class DropoutLayer(Layer):
 
 @dataclass
 class ActivationLayer(Layer):
+    # Optional slope/shape parameter (reference ActivationLReLU/ELU take one);
+    # forwarded to ops that accept an alpha (leakyrelu, elu).
+    alpha: Optional[float] = None
+
     def apply(self, params, x, state, training, rng):
-        return activation_fn(self.activation or "identity")(x), state
+        act = (self.activation or "identity").lower()
+        if self.alpha is not None and act in ("leakyrelu", "elu"):
+            return get_op(act).fn(x, alpha=self.alpha), state
+        return activation_fn(act)(x), state
 
     @property
     def has_params(self):

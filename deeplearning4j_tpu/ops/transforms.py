@@ -355,7 +355,22 @@ def einsum(*xs, equation: str):
 
 @op("tf_strided_slice", "shape")
 def tf_strided_slice(x, spec=None):
-    """TF StridedSlice semantics: a pre-resolved numpy-style index spec
-    (slices / ints / None / Ellipsis) computed at import time from the TF
-    begin/end/stride masks (imports/tf_graph_mapper.py)."""
-    return x[tuple(spec)]
+    """TF StridedSlice semantics. ``spec`` is a JSON-safe encoding (so
+    SameDiff graphs serialize) of a numpy-style index, computed at import
+    time from the TF begin/end/stride masks (imports/tf_graph_mapper.py):
+    each entry is ["slice", b, e, s] | ["idx", i] | ["newaxis"] |
+    ["ellipsis"]."""
+    idx = []
+    for ent in spec:
+        kind = ent[0]
+        if kind == "slice":
+            idx.append(slice(ent[1], ent[2], ent[3]))
+        elif kind == "idx":
+            idx.append(int(ent[1]))
+        elif kind == "newaxis":
+            idx.append(None)
+        elif kind == "ellipsis":
+            idx.append(Ellipsis)
+        else:
+            raise ValueError(f"bad strided-slice spec entry {ent!r}")
+    return x[tuple(idx)]

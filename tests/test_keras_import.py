@@ -1,0 +1,160 @@
+"""Keras h5 import conformance (KerasModelEndToEndTest analog).
+
+Reference harness shape: dl4j-modelimport ``KerasModelEndToEndTest`` — h5
+fixtures with stored activations, import → forward → compare (SURVEY.md
+§4.4). Fixtures are generated with the local Keras (TF 2.21) at test time,
+saved to h5, imported, and checked for prediction parity on random inputs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+from tensorflow import keras  # noqa: E402
+
+from deeplearning4j_tpu.imports import (KerasModelImport,  # noqa: E402
+                                        UnsupportedKerasLayerError)
+
+rng = np.random.RandomState(11)
+
+
+def roundtrip(model, x, tmp_path, atol=1e-4):
+    path = str(tmp_path / "model.h5")
+    model.save(path)
+    expected = model.predict(x, verbose=0)
+    ours = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    got = ours.output(x.astype(np.float32)).to_numpy()
+    np.testing.assert_allclose(got, expected, atol=atol, rtol=1e-3)
+    return ours
+
+
+class TestKerasSequentialImport:
+    def test_mlp(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((20,)),
+            keras.layers.Dense(32, activation="relu"),
+            keras.layers.Dense(16, activation="tanh"),
+            keras.layers.Dense(5, activation="softmax"),
+        ])
+        roundtrip(m, rng.randn(8, 20).astype(np.float32), tmp_path)
+
+    def test_mlp_activation_variants(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((12,)),
+            keras.layers.Dense(16, activation="gelu"),
+            keras.layers.Dense(16, activation="selu"),
+            keras.layers.Dense(16, activation="softplus"),
+            keras.layers.Dense(16),
+            keras.layers.LeakyReLU(),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        roundtrip(m, rng.randn(4, 12).astype(np.float32), tmp_path)
+
+    def test_cnn_with_flatten_permute(self, tmp_path):
+        """The NHWC→NCHW + Flatten row-permute path: must match exactly."""
+        m = keras.Sequential([
+            keras.layers.Input((10, 10, 3)),
+            keras.layers.Conv2D(8, 3, activation="relu", padding="same"),
+            keras.layers.MaxPooling2D(2),
+            keras.layers.Conv2D(4, 3, activation="relu", padding="valid"),
+            keras.layers.Flatten(),
+            keras.layers.Dense(6, activation="softmax"),
+        ])
+        roundtrip(m, rng.randn(3, 10, 10, 3).astype(np.float32), tmp_path)
+
+    def test_cnn_strides_dilation_avgpool(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((12, 12, 2)),
+            keras.layers.Conv2D(4, 3, strides=2, padding="same"),
+            keras.layers.AveragePooling2D(2),
+            keras.layers.Flatten(),
+            keras.layers.Dense(3),
+        ])
+        roundtrip(m, rng.randn(2, 12, 12, 2).astype(np.float32), tmp_path)
+
+    def test_depthwise_conv(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((8, 8, 3)),
+            keras.layers.DepthwiseConv2D(3, depth_multiplier=2,
+                                         activation="relu"),
+            keras.layers.GlobalAveragePooling2D(),
+            keras.layers.Dense(4, activation="softmax"),
+        ])
+        roundtrip(m, rng.randn(2, 8, 8, 3).astype(np.float32), tmp_path)
+
+    def test_batchnorm_inference(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((8, 8, 3)),
+            keras.layers.Conv2D(4, 3),
+            keras.layers.BatchNormalization(),
+            keras.layers.ReLU(),
+            keras.layers.GlobalMaxPooling2D(),
+            keras.layers.Dense(2),
+        ])
+        # fit one step so BN moving stats are non-trivial
+        x = rng.randn(16, 8, 8, 3).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)]
+        m.compile(optimizer="sgd", loss="mse")
+        m.fit(x, y, epochs=1, verbose=0)
+        roundtrip(m, x[:4], tmp_path, atol=2e-4)
+
+    def test_dropout_inference_identity(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((10,)),
+            keras.layers.Dense(8, activation="relu"),
+            keras.layers.Dropout(0.5),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        roundtrip(m, rng.randn(4, 10).astype(np.float32), tmp_path)
+
+    def test_embedding_lstm(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((7,)),
+            keras.layers.Embedding(50, 12),
+            keras.layers.LSTM(9, return_sequences=True),
+        ])
+        path = str(tmp_path / "m.h5")
+        m.save(path)
+        x = rng.randint(0, 50, (3, 7)).astype(np.float32)
+        expected = m.predict(x, verbose=0)
+        ours = KerasModelImport.import_keras_sequential_model_and_weights(path)
+        got = ours.output(x).to_numpy()
+        np.testing.assert_allclose(got, expected, atol=2e-4, rtol=1e-3)
+
+    def test_simple_rnn(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((5, 6)),
+            keras.layers.SimpleRNN(4, return_sequences=True),
+        ])
+        roundtrip(m, rng.randn(2, 5, 6).astype(np.float32), tmp_path, atol=2e-4)
+
+    def test_imported_model_trains(self, tmp_path):
+        """Fine-tune path: imported net must train with our fit()."""
+        from deeplearning4j_tpu.data import DataSet
+
+        m = keras.Sequential([
+            keras.layers.Input((10,)),
+            keras.layers.Dense(16, activation="relu"),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        path = str(tmp_path / "m.h5")
+        m.save(path)
+        ours = KerasModelImport.import_keras_sequential_model_and_weights(path)
+        x = rng.randn(32, 10).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 32)]
+        ds = DataSet(x, y)
+        before = ours.score(ds)
+        ours.fit(ds, epochs=30)
+        assert ours.score(ds) < before * 0.7
+
+    def test_unsupported_layer_raises_cleanly(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((4, 4)),
+            keras.layers.GRU(3, return_sequences=True),
+        ])
+        path = str(tmp_path / "m.h5")
+        m.save(path)
+        with pytest.raises(UnsupportedKerasLayerError, match="GRU"):
+            KerasModelImport.import_keras_sequential_model_and_weights(path)

@@ -920,8 +920,10 @@ class TestDatatypeAndImportOps:
     def test_tf_strided_slice(self):
         x = r(4, 6, 3)
         check("tf_strided_slice", x[1:3, ::2, 1], x,
-              spec=(slice(1, 3), slice(None, None, 2), 1))
-        check("tf_strided_slice", x[0], x, spec=(0,))
+              spec=[["slice", 1, 3, 1], ["slice", None, None, 2], ["idx", 1]])
+        check("tf_strided_slice", x[0], x, spec=[["idx", 0]])
+        check("tf_strided_slice", x[..., None, 0], x,
+              spec=[["ellipsis"], ["newaxis"], ["idx", 0]])
 
 
 class TestCoverageLedger:
