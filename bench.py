@@ -287,10 +287,47 @@ def bench_lenet(steps: int, with_listener: bool = False) -> dict:
          "listener": with_listener})
 
 
+def bench_word2vec(steps: int) -> dict:
+    """North-star config 4: Word2Vec skip-gram + negative sampling over a
+    synthetic zipfian corpus; throughput = corpus words consumed / sec
+    end-to-end (host pair-generation + fused device rounds), the number the
+    reference logs at INFO during SequenceVectors.fit (SURVEY §3.6).
+    ``steps`` scales the corpus: steps * 1000 sentences of 20 words."""
+    import jax
+
+    from deeplearning4j_tpu.nlp import Word2Vec
+
+    rng = np.random.default_rng(123)
+    vocab_size, n_sent, sent_len = 10_000, steps * 1000, 20
+    p = 1.0 / np.arange(1, vocab_size + 1)
+    p /= p.sum()
+    words = np.array([f"w{i}" for i in range(vocab_size)])
+    sents = [" ".join(words[rng.choice(vocab_size, size=sent_len, p=p)])
+             for _ in range(n_sent)]
+
+    w2v = Word2Vec(min_word_frequency=5, layer_size=100, window=5,
+                   negative=5, sampling=1e-3, epochs=1, batch_size=8192,
+                   seed=42)
+    w2v.set_sentence_iterator(sents)
+    w2v.fit()
+    return {
+        "metric": "word2vec_skipgram_train",
+        "value": w2v.words_per_sec,
+        "unit": "words/sec",
+        "platform": jax.devices()[0].platform,
+        "vocab": len(w2v.vocab),
+        "corpus_words": n_sent * sent_len,
+        "pairs_per_sec": round(w2v.pairs_per_sec),
+        "layer_size": 100, "negative": 5, "window": 5,
+        "data": "synthetic zipfian corpus (host RAM)",
+        "final_loss": round(w2v.last_loss, 4),
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", default="resnet50",
-                        choices=["lenet", "resnet50", "bert"])
+                        choices=["lenet", "resnet50", "bert", "word2vec"])
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--batch", type=int, default=None,
                         help="per-config default: resnet50=64, bert=8")
@@ -305,6 +342,8 @@ def main() -> None:
         result = bench_lenet(steps, with_listener=args.with_listener)
     elif args.config == "bert":
         result = bench_bert(steps, batch=args.batch or 8)
+    elif args.config == "word2vec":
+        result = bench_word2vec(steps)
     else:
         result = bench_resnet50(steps, batch=args.batch or 64,
                                 with_listener=args.with_listener)

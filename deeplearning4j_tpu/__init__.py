@@ -18,7 +18,7 @@ Layer map (≈ SURVEY.md §1):
               → MultiLayerNetwork                        samediff-import)
   eval/       Evaluation / ROC / RegressionEvaluation   (ref: nd4j evaluation)
   optimize/   training listeners                        (ref: dl4j optimize)
-  nlp/        Word2Vec family                           (ref: dl4j-nlp) [building]
+  nlp/        Word2Vec / ParagraphVectors / vocab / serde (ref: dl4j-nlp)
 """
 
 import jax as _jax

@@ -238,6 +238,21 @@ class _SequentialBuilder:
 
     def _push(self, layer: L.Layer, setter: Optional[Callable]):
         self._update_cnn_shape(layer)
+        # Keras's activation="leaky_relu" kwarg means
+        # keras.activations.leaky_relu with negative_slope=0.2; body layers
+        # apply activations without an alpha channel (op default 0.01), so
+        # split the activation into an explicit ActivationLayer that carries
+        # the slope. (The standalone LeakyReLU LAYER defaults to 0.3 and is
+        # handled in its own branch.)
+        if (getattr(layer, "activation", None) == "leakyrelu"
+                and not isinstance(layer, L.ActivationLayer)):
+            layer.activation = "identity"
+            self.layers.append(layer)
+            self.weights.append(setter)
+            self.layers.append(L.ActivationLayer(activation="leakyrelu",
+                                                 alpha=0.2))
+            self.weights.append(None)
+            return
         self.layers.append(layer)
         self.weights.append(setter)
 
@@ -246,9 +261,9 @@ class _SequentialBuilder:
         units = int(c["units"])
         act = _act(c.get("activation"))
         use_bias = bool(c.get("use_bias", True))
-        kernel = ws[0] if ws else None
+        kernel = ws[0]
         bias = ws[1] if use_bias and len(ws) > 1 else None
-        if self.flatten_pending and self.flatten_shape is not None and kernel is not None:
+        if self.flatten_pending and self.flatten_shape is not None:
             C, H, W = self.flatten_shape
             # keras flattens NHWC → rows in HWC order; the body here flattens
             # NCHW → CHW order. Permute rows once so activations match.
@@ -267,7 +282,7 @@ class _SequentialBuilder:
             if bias is not None:
                 params["b"] = np.asarray(bias)
 
-        self._push(layer, setter if kernel is not None else None)
+        self._push(layer, setter)
 
     def _map_Conv2D(self, c, ws):
         _require_weights(ws, 'Conv2D', c.get('name', '?'))
@@ -288,7 +303,7 @@ class _SequentialBuilder:
             if bias is not None:
                 params["b"] = bias
 
-        self._push(layer, setter if kernel is not None else None)
+        self._push(layer, setter)
 
     def _map_DepthwiseConv2D(self, c, ws):
         _require_weights(ws, 'DepthwiseConv2D', c.get('name', '?'))
@@ -307,7 +322,7 @@ class _SequentialBuilder:
             if bias is not None:
                 params["b"] = bias
 
-        self._push(layer, setter if kernel is not None else None)
+        self._push(layer, setter)
 
     def _pool(self, c, kind):
         return L.SubsamplingLayer(
@@ -328,19 +343,33 @@ class _SequentialBuilder:
         self._push(L.GlobalPoolingLayer(pooling_type="max"), None)
 
     def _map_BatchNormalization(self, c, ws):
+        _require_weights(ws, 'BatchNormalization', c.get('name', '?'))
         layer = L.BatchNormalization(decay=float(c.get("momentum", 0.99)),
                                      eps=float(c.get("epsilon", 1e-3)))
-        gamma, beta, mean, var = (ws + [None] * 4)[:4]
+        # Keras stores only the enabled tensors, in order: [gamma?][beta?]
+        # [moving_mean, moving_variance] — positional unpacking without the
+        # scale/center flags would misassign them (all are shape [C], so
+        # shape validation cannot catch it).
+        scale = bool(c.get("scale", True))
+        center = bool(c.get("center", True))
+        expected = int(scale) + int(center) + 2
+        if len(ws) != expected:
+            raise UnsupportedKerasLayerError(
+                "BatchNormalization",
+                f"{c.get('name', '?')}: expected {expected} weight tensors "
+                f"for scale={scale}, center={center}; got {len(ws)}")
+        it = iter(ws)
+        gamma = next(it) if scale else None
+        beta = next(it) if center else None
+        mean, var = next(it), next(it)
 
         def setter(params, state):
             if gamma is not None:
                 params["gamma"] = gamma
             if beta is not None:
                 params["beta"] = beta
-            if mean is not None:
-                state["mean"] = mean
-            if var is not None:
-                state["var"] = var
+            state["mean"] = mean
+            state["var"] = var
 
         setter.wants_state = True
         self._push(layer, setter)
@@ -359,12 +388,12 @@ class _SequentialBuilder:
         elif isinstance(self.input_type, RNNInput) and not self.layers:
             self.input_type = InputType.recurrent(int(c["input_dim"]),
                                                   self.input_type.timesteps)
-        table = ws[0] if ws else None
+        table = ws[0]
 
         def setter(params):
             params["W"] = table
 
-        self._push(layer, setter if table is not None else None)
+        self._push(layer, setter)
 
     def _map_LSTM(self, c, ws):
         _require_weights(ws, 'LSTM', c.get('name', '?'))
@@ -374,24 +403,22 @@ class _SequentialBuilder:
                 "return_sequences=True)")
         units = int(c["units"])
         layer = L.LSTM(n_out=units)
-        if ws:
-            kernel, recurrent, bias = (ws + [None] * 3)[:3]
-            # keras gates i,f,c,o → fused i,f,o,g column order
-            def remap_cols(m):
-                i, fgate, g, o = np.split(m, 4, axis=-1)
-                return np.concatenate([i, fgate, o, g], axis=-1)
+        kernel, recurrent, bias = (ws + [None] * 3)[:3]
 
-            w = remap_cols(np.concatenate([kernel, recurrent], axis=0))
-            b = remap_cols(bias[None, :])[0] if bias is not None else None
+        # keras gates i,f,c,o → fused i,f,o,g column order
+        def remap_cols(m):
+            i, fgate, g, o = np.split(m, 4, axis=-1)
+            return np.concatenate([i, fgate, o, g], axis=-1)
 
-            def setter(params):
-                params["W"] = w
-                if b is not None:
-                    params["b"] = b
+        w = remap_cols(np.concatenate([kernel, recurrent], axis=0))
+        b = remap_cols(bias[None, :])[0] if bias is not None else None
 
-            self._push(layer, setter)
-        else:
-            self._push(layer, None)
+        def setter(params):
+            params["W"] = w
+            if b is not None:
+                params["b"] = b
+
+        self._push(layer, setter)
 
     def _map_SimpleRNN(self, c, ws):
         _require_weights(ws, 'SimpleRNN', c.get('name', '?'))
@@ -400,18 +427,15 @@ class _SequentialBuilder:
                                              "return_sequences=False")
         layer = L.SimpleRnn(n_out=int(c["units"]),
                             activation=_act(c.get("activation", "tanh")))
-        if ws:
-            kernel, recurrent, bias = (ws + [None] * 3)[:3]
+        kernel, recurrent, bias = (ws + [None] * 3)[:3]
 
-            def setter(params):
-                params["W"] = kernel
-                params["RW"] = recurrent
-                if bias is not None:
-                    params["b"] = bias
+        def setter(params):
+            params["W"] = kernel
+            params["RW"] = recurrent
+            if bias is not None:
+                params["b"] = bias
 
-            self._push(layer, setter)
-        else:
-            self._push(layer, None)
+        self._push(layer, setter)
 
     # -- assembly ---------------------------------------------------------
     def finish(self) -> MultiLayerNetwork:

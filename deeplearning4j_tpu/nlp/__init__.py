@@ -1,0 +1,36 @@
+"""NLP stack: Word2Vec family (reference: deeplearning4j-nlp, SURVEY §2.3/§3.6).
+
+- ``text``               tokenizers + sentence iterators (TokenizerFactory SPI)
+- ``vocab``              VocabCache/VocabConstructor, Huffman, unigram table
+- ``lookup_table``       InMemoryLookupTable (syn0/syn1/syn1neg)
+- ``word2vec``           SequenceVectors engine + Word2Vec builder front
+- ``paragraph_vectors``  ParagraphVectors: PV-DM / PV-DBOW + infer_vector
+- ``serializer``         WordVectorSerializer: txt / Google-bin / model zip
+
+The fused skip-gram/CBOW device rounds live in ``ops/embeddings.py`` (the
+TPU analog of libnd4j's sg_cb kernels).
+"""
+
+from .lookup_table import InMemoryLookupTable
+from .paragraph_vectors import ParagraphVectors
+from .serializer import (read_word2vec_model, read_word_vectors,
+                         write_word2vec_model, write_word_vectors)
+from .text import (CollectionSentenceIterator, CommonPreprocessor,
+                   DefaultTokenizerFactory, FileSentenceIterator,
+                   LabelAwareIterator, LineSentenceIterator,
+                   NGramTokenizerFactory, SentenceIterator, Tokenizer,
+                   TokenizerFactory)
+from .vocab import (VocabCache, VocabConstructor, VocabWord, build_huffman,
+                    huffman_arrays, subsample_keep_probs, unigram_table)
+from .word2vec import SequenceVectors, Word2Vec, WordVectors
+
+__all__ = [
+    "CollectionSentenceIterator", "CommonPreprocessor",
+    "DefaultTokenizerFactory", "FileSentenceIterator", "InMemoryLookupTable",
+    "LabelAwareIterator", "LineSentenceIterator", "NGramTokenizerFactory",
+    "ParagraphVectors", "SentenceIterator", "SequenceVectors", "Tokenizer",
+    "TokenizerFactory", "VocabCache", "VocabConstructor", "VocabWord",
+    "Word2Vec", "WordVectors", "build_huffman", "huffman_arrays",
+    "read_word2vec_model", "read_word_vectors", "subsample_keep_probs",
+    "unigram_table", "write_word2vec_model", "write_word_vectors",
+]

@@ -1,0 +1,224 @@
+"""ParagraphVectors (doc2vec): PV-DM and PV-DBOW.
+
+Rebuild of the reference's ``models/paragraphvectors/ParagraphVectors`` with
+its two sequence-learning algorithms (reference:
+``models/embeddings/learning/impl/sequence/{DM,DBOW}.java``):
+
+- **PV-DBOW** (``DBOW``): the document's label vector is the *input* row and
+  every word of the document is a prediction target — exactly the skip-gram
+  round with the label id as "center", so it reuses the fused ``skipgram``
+  op unchanged.
+- **PV-DM** (``DM``): the label vector joins the context-window average that
+  predicts the center word — the CBOW round with one extra always-on context
+  column carrying the label id.
+
+Labels live in the SAME vocab/syn0 table as words (the reference adds them
+as special VocabWords exempt from frequency pruning); ``infer_vector`` runs
+gradient steps on a fresh row with frozen word/output tables, matching the
+reference's inference-vector mode of the fused kernels (libnd4j sg_cb
+``infVector`` path) — here it is simply ``jax.grad`` wrt the one vector.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from .text import DefaultTokenizerFactory, LabelAwareIterator, TokenizerFactory
+from .word2vec import SequenceVectors
+
+
+class ParagraphVectors(SequenceVectors):
+    class Builder:
+        def __init__(self) -> None:
+            self._kw = {}
+            self._iter: Optional[LabelAwareIterator] = None
+            self._tok: TokenizerFactory = DefaultTokenizerFactory()
+
+        def min_word_frequency(self, v): self._kw["min_word_frequency"] = v; return self
+        def iterations(self, v): self._kw["iterations"] = v; return self
+        def epochs(self, v): self._kw["epochs"] = v; return self
+        def layer_size(self, v): self._kw["layer_size"] = v; return self
+        def seed(self, v): self._kw["seed"] = v; return self
+        def window_size(self, v): self._kw["window"] = v; return self
+        def learning_rate(self, v): self._kw["learning_rate"] = v; return self
+        def min_learning_rate(self, v): self._kw["min_learning_rate"] = v; return self
+        def negative_sample(self, v): self._kw["negative"] = int(v); return self
+        def sampling(self, v): self._kw["sampling"] = v; return self
+        def batch_size(self, v): self._kw["batch_size"] = v; return self
+
+        def sequence_learning_algorithm(self, name: str):
+            self._kw["dm"] = "dm" in name.lower() and "dbow" not in name.lower()
+            return self
+
+        def dm(self, flag: bool):
+            self._kw["dm"] = flag
+            return self
+
+        def train_word_vectors(self, flag: bool):
+            self._kw["train_word_vectors"] = flag
+            return self
+
+        def iterate(self, it: LabelAwareIterator):
+            self._iter = it
+            return self
+
+        def tokenizer_factory(self, tf: TokenizerFactory):
+            self._tok = tf
+            return self
+
+        def build(self) -> "ParagraphVectors":
+            pv = ParagraphVectors(**self._kw)
+            pv._doc_iter = self._iter
+            pv._tokenizer = self._tok
+            return pv
+
+    @staticmethod
+    def builder() -> "ParagraphVectors.Builder":
+        return ParagraphVectors.Builder()
+
+    def __init__(self, dm: bool = False, train_word_vectors: bool = True,
+                 **kw):
+        self.dm = dm
+        # DL4J's ParagraphVectors trains element (word) vectors alongside
+        # sequence vectors by default (trainElementsRepresentation=true);
+        # in DBOW mode that means interleaved plain skip-gram pairs.
+        self.train_word_vectors = train_word_vectors
+        kw.setdefault("algorithm", "cbow" if dm else "skipgram")
+        super().__init__(**kw)
+        self._doc_iter: Optional[LabelAwareIterator] = None
+        self._tokenizer: TokenizerFactory = DefaultTokenizerFactory()
+        self._label_ids: List[int] = []
+
+    # -- training ---------------------------------------------------------
+    def fit(self) -> None:
+        assert self._doc_iter is not None, "no corpus: call iterate() first"
+        labels = self._doc_iter.labels
+        docs_tokens = [self._tokenizer.create(s).get_tokens()
+                       for s in self._doc_iter]
+        self._special_tokens = labels
+        self.build_vocab(iter(docs_tokens))
+        self._label_ids = [self.vocab.index_of(l) for l in labels]
+        # Encode per-doc (not via _encode_corpus) to keep label alignment
+        # when a doc ends up empty after vocab pruning.
+        corpus = []
+        doc_labels = []
+        for lbl, toks in zip(self._label_ids, docs_tokens):
+            ids = [self.vocab.index_of(t) for t in toks]
+            ids = np.asarray([i for i in ids if i >= 0], dtype=np.int32)
+            if ids.size:
+                corpus.append(ids)
+                doc_labels.append(lbl)
+
+        total = sum(len(s) for s in corpus) * self.epochs * self.iterations
+
+        def stream(rng, keep):
+            # Yields (corpus_words_consumed, *batch_payload) — the word
+            # count drives the engine's LR schedule.
+            for lbl, ids in zip(doc_labels, corpus):
+                if self.dm:
+                    wins = self._sentence_windows(ids, rng, keep)
+                    if wins is None:
+                        continue
+                    c, ctx, cmask = wins
+                    lbl_col = np.full((c.size, 1), lbl, dtype=np.int32)
+                    ctx = np.concatenate([ctx, lbl_col], axis=1)
+                    cmask = np.concatenate(
+                        [cmask, np.ones((c.size, 1), np.float32)], axis=1)
+                    yield ids.size, c, ctx, cmask
+                else:
+                    # PV-DBOW: label id predicts every (kept) word.
+                    kept = ids[rng.random(ids.size) < keep[ids]] \
+                        if self.sampling > 0 else ids
+                    if kept.size == 0:
+                        continue
+                    centers = np.full(kept.size, lbl, dtype=np.int32)
+                    if self.train_word_vectors:
+                        pairs = self._sentence_pairs(ids, rng, keep)
+                        if pairs is not None:
+                            centers = np.concatenate([centers, pairs[0]])
+                            kept = np.concatenate([kept, pairs[1]])
+                    yield ids.size, centers, kept
+
+        self._train_encoded(corpus, stream_factory=stream, total_words=total)
+
+    # -- queries ----------------------------------------------------------
+    def get_paragraph_vector(self, label: str) -> np.ndarray:
+        return self.get_word_vector(label)
+
+    def nearest_labels(self, vec_or_label, top_n: int = 5) -> List[str]:
+        vec = (self.get_word_vector(vec_or_label)
+               if isinstance(vec_or_label, str)
+               else np.asarray(vec_or_label, np.float32))
+        labels = set(self._label_ids)
+        w = self.lookup_table.normalized()
+        v = vec / max(np.linalg.norm(vec), 1e-12)
+        sims = w @ v
+        order = [i for i in np.argsort(-sims) if int(i) in labels]
+        return [self.vocab.word_for(int(i)) for i in order[:top_n]]
+
+    def infer_vector(self, text: str, steps: int = 50,
+                     learning_rate: float = 0.025) -> np.ndarray:
+        """Fit a vector for unseen text against FROZEN tables (reference:
+        ParagraphVectors.inferVector → sg_cb inference-vector mode)."""
+        import jax
+        import jax.numpy as jnp
+
+        from .vocab import unigram_table
+
+        tokens = self._tokenizer.create(text).get_tokens()
+        ids = np.asarray([i for i in (self.vocab.index_of(t) for t in tokens)
+                          if i >= 0], dtype=np.int32)
+        d = self.layer_size
+        rng = np.random.default_rng(self.seed)
+        vec = ((rng.random(d) - 0.5) / d).astype(np.float32)
+        if ids.size == 0:
+            return vec
+        syn1 = jnp.asarray(self.lookup_table.syn1 if self.use_hs
+                           else self.lookup_table.syn1neg)
+        syn0 = jnp.asarray(self.lookup_table.syn0)
+        cdf = unigram_table(self.vocab)
+        V, K = len(self.vocab), max(self.negative, 1)
+
+        if self.use_hs:
+            from .vocab import huffman_arrays
+            codes, points, mask = huffman_arrays(self.vocab)
+
+            def loss_fn(v, tgt_ids):
+                u = syn1[points[tgt_ids]]          # [N, L, D]
+                m = jnp.asarray(mask[tgt_ids])
+                labels = (1.0 - jnp.asarray(codes[tgt_ids],
+                                            dtype=v.dtype)) * m
+                logits = jnp.einsum("d,nld->nl", v, u)
+                sig = jax.nn.sigmoid(logits)
+                eps = 1e-7
+                xe = -(labels * jnp.log(sig + eps)
+                       + (1 - labels) * jnp.log(1 - sig + eps)) * m
+                return xe.sum() / jnp.maximum(m.sum(), 1.0)
+
+            grad = jax.jit(jax.grad(loss_fn))
+            v = jnp.asarray(vec)
+            for step in range(steps):
+                lr = learning_rate * (1 - step / steps)
+                v = v - lr * grad(v, jnp.asarray(ids))
+            return np.asarray(v)
+
+        def loss_fn(v, tgt, lab, ctxmean):
+            u = syn1[tgt]                          # [N, K+1, D]
+            h = v if not self.dm else (v + ctxmean) / 2.0
+            logits = jnp.einsum("d,nkd->nk", h, u)
+            sig = jax.nn.sigmoid(logits)
+            eps = 1e-7
+            xe = -(lab * jnp.log(sig + eps)
+                   + (1 - lab) * jnp.log(1 - sig + eps))
+            return xe.mean()
+
+        grad = jax.jit(jax.grad(loss_fn))
+        v = jnp.asarray(vec)
+        ctxmean = jnp.mean(syn0[ids], axis=0)
+        for step in range(steps):
+            lr = learning_rate * (1 - step / steps)
+            tgt, lab = self._neg_targets(ids, rng, cdf, V, K)
+            v = v - lr * grad(v, jnp.asarray(tgt), jnp.asarray(lab), ctxmean)
+        return np.asarray(v)

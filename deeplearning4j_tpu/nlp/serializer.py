@@ -1,0 +1,158 @@
+"""WordVectorSerializer: interchange formats for word vectors.
+
+Rebuild of the reference's
+``loader/WordVectorSerializer`` covering the two interchange formats every
+word2vec toolchain speaks:
+
+- **text** ("Google txt" / glove-style): optional ``V D`` header line, then
+  one ``word f1 f2 ... fD`` line per word;
+- **binary** (Google ``word2vec.c`` bin): ``V D\\n`` ASCII header, then per
+  word ``word<space>`` followed by D little-endian float32s.
+
+plus ``write_word2vec_model``/``read_word2vec_model``: a zip container with
+the full training state (vocab counts, syn0/syn1/syn1neg, config) so a fit
+can be resumed — the role of the reference's ``writeWord2VecModel`` zip
+(syn0.txt/syn1.txt/codes.txt/huffman.txt/config.json).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from pathlib import Path
+from typing import Union
+
+import numpy as np
+
+from .lookup_table import InMemoryLookupTable
+from .vocab import VocabCache, VocabWord, build_huffman
+from .word2vec import Word2Vec, WordVectors
+
+PathLike = Union[str, Path]
+
+_FORMAT_VERSION = 1
+
+
+# -- flat vector formats --------------------------------------------------
+
+def write_word_vectors(model: WordVectors, path: PathLike,
+                       binary: bool = False, header: bool = True) -> None:
+    syn0 = np.asarray(model.lookup_table.syn0, dtype=np.float32)
+    words = model.vocab.words()
+    if binary:
+        with open(path, "wb") as f:
+            f.write(f"{len(words)} {syn0.shape[1]}\n".encode())
+            for i, w in enumerate(words):
+                f.write(w.encode("utf-8") + b" ")
+                f.write(syn0[i].tobytes())
+                f.write(b"\n")
+    else:
+        with open(path, "w", encoding="utf-8") as f:
+            if header:
+                f.write(f"{len(words)} {syn0.shape[1]}\n")
+            for i, w in enumerate(words):
+                vec = " ".join(f"{x:.6g}" for x in syn0[i])
+                f.write(f"{w} {vec}\n")
+
+
+def read_word_vectors(path: PathLike, binary: bool = False) -> WordVectors:
+    if binary:
+        with open(path, "rb") as f:
+            header = f.readline().decode().split()
+            V, D = int(header[0]), int(header[1])
+            vocab = VocabCache()
+            syn0 = np.zeros((V, D), dtype=np.float32)
+            for i in range(V):
+                chars = []
+                while True:
+                    ch = f.read(1)
+                    if ch == b" " or ch == b"":
+                        break
+                    if ch != b"\n":
+                        chars.append(ch)
+                word = b"".join(chars).decode("utf-8")
+                syn0[i] = np.frombuffer(f.read(4 * D), dtype="<f4")
+                nl = f.read(1)
+                if nl not in (b"\n", b""):
+                    f.seek(-1, io.SEEK_CUR)
+                vocab.add(VocabWord(word, 1))
+    else:
+        with open(path, "r", encoding="utf-8") as f:
+            lines = [ln.rstrip("\n") for ln in f if ln.strip()]
+        first = lines[0].split()
+        if len(first) == 2 and all(tok.isdigit() for tok in first):
+            V, D = int(first[0]), int(first[1])
+            lines = lines[1:]
+        else:
+            V, D = len(lines), len(first) - 1
+        vocab = VocabCache()
+        syn0 = np.zeros((V, D), dtype=np.float32)
+        for i, ln in enumerate(lines):
+            parts = ln.split(" ")
+            vocab.add(VocabWord(parts[0], 1))
+            syn0[i] = np.asarray(parts[1:], dtype=np.float32)
+    table = InMemoryLookupTable(len(vocab), syn0.shape[1])
+    table.syn0 = syn0
+    return WordVectors(vocab, table)
+
+
+# -- full-model zip container ---------------------------------------------
+
+def write_word2vec_model(model: Word2Vec, path: PathLike) -> None:
+    config = {
+        "format_version": _FORMAT_VERSION,
+        "layer_size": model.layer_size,
+        "window": model.window,
+        "learning_rate": model.learning_rate,
+        "min_learning_rate": model.min_learning_rate,
+        "negative": model.negative,
+        "use_hierarchic_softmax": model.use_hs,
+        "sampling": model.sampling,
+        "min_word_frequency": model.min_word_frequency,
+        "iterations": model.iterations,
+        "epochs": model.epochs,
+        "batch_size": model.batch_size,
+        "seed": model.seed,
+        "algorithm": model.algorithm,
+    }
+    vocab_rows = [{"word": model.vocab.entry_at(i).word,
+                   "count": model.vocab.entry_at(i).count}
+                  for i in range(len(model.vocab))]
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("config.json", json.dumps(config))
+        z.writestr("vocab.json", json.dumps(vocab_rows))
+        arrays = {"syn0": np.asarray(model.lookup_table.syn0)}
+        if model.lookup_table.syn1 is not None:
+            arrays["syn1"] = np.asarray(model.lookup_table.syn1)
+        if model.lookup_table.syn1neg is not None:
+            arrays["syn1neg"] = np.asarray(model.lookup_table.syn1neg)
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        z.writestr("tables.npz", buf.getvalue())
+
+
+def read_word2vec_model(path: PathLike) -> Word2Vec:
+    with zipfile.ZipFile(path, "r") as z:
+        config = json.loads(z.read("config.json"))
+        version = config.pop("format_version", None)
+        if version != _FORMAT_VERSION:
+            raise ValueError(
+                f"unsupported word2vec model format version {version!r} "
+                f"(supported: {_FORMAT_VERSION})")
+        vocab_rows = json.loads(z.read("vocab.json"))
+        npz = np.load(io.BytesIO(z.read("tables.npz")))
+        model = Word2Vec(**config)
+        vocab = VocabCache()
+        for row in vocab_rows:
+            vocab.add(VocabWord(row["word"], row["count"]))
+        model.vocab = vocab
+        if model.use_hs:
+            build_huffman(model.vocab)
+        table = InMemoryLookupTable(len(vocab), config["layer_size"],
+                                    seed=config["seed"])
+        table.syn0 = npz["syn0"]
+        table.syn1 = npz["syn1"] if "syn1" in npz else None
+        table.syn1neg = npz["syn1neg"] if "syn1neg" in npz else None
+        model.lookup_table = table
+        return model

@@ -1,0 +1,170 @@
+"""Tokenization + sentence iteration SPI.
+
+TPU rebuild of the reference's text-pipeline SPIs (reference layout:
+deeplearning4j-nlp ``text/tokenization/tokenizer`` and
+``text/sentenceiterator`` — ``TokenizerFactory``, ``DefaultTokenizer``,
+``CommonPreprocessor``, ``SentenceIterator`` / ``LineSentenceIterator`` /
+``CollectionSentenceIterator``). These run on host (pure Python) — they feed
+the vectorized pair-generation stage, which feeds the jitted device step; the
+per-token work is trivial and never belongs on the accelerator.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence
+
+
+class TokenPreProcess:
+    """SPI: normalize a single token (reference: TokenPreProcess)."""
+
+    def pre_process(self, token: str) -> str:
+        raise NotImplementedError
+
+
+class CommonPreprocessor(TokenPreProcess):
+    """Lowercase + strip punctuation/digits (reference: CommonPreprocessor)."""
+
+    _PUNCT = re.compile(r"[\d\.:,\"'\(\)\[\]|/?!;]+")
+
+    def pre_process(self, token: str) -> str:
+        return self._PUNCT.sub("", token).lower()
+
+
+class LowCasePreProcessor(TokenPreProcess):
+    def pre_process(self, token: str) -> str:
+        return token.lower()
+
+
+class Tokenizer:
+    """One sentence → token stream (reference: Tokenizer interface)."""
+
+    def __init__(self, tokens: List[str],
+                 pre_processor: Optional[TokenPreProcess] = None):
+        self._tokens = tokens
+        self._pre = pre_processor
+
+    def get_tokens(self) -> List[str]:
+        if self._pre is None:
+            return list(self._tokens)
+        out = [self._pre.pre_process(t) for t in self._tokens]
+        return [t for t in out if t]
+
+    def count_tokens(self) -> int:
+        return len(self.get_tokens())
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.get_tokens())
+
+
+class TokenizerFactory:
+    """SPI: sentence → Tokenizer (reference: TokenizerFactory)."""
+
+    def __init__(self) -> None:
+        self._pre: Optional[TokenPreProcess] = None
+
+    def set_token_pre_processor(self, pre: TokenPreProcess) -> None:
+        self._pre = pre
+
+    def create(self, sentence: str) -> Tokenizer:
+        raise NotImplementedError
+
+
+class DefaultTokenizerFactory(TokenizerFactory):
+    """Whitespace split (reference: DefaultTokenizerFactory wraps a
+    StringTokenizer over whitespace)."""
+
+    def create(self, sentence: str) -> Tokenizer:
+        return Tokenizer(sentence.split(), self._pre)
+
+
+class NGramTokenizerFactory(TokenizerFactory):
+    """Emit all n-grams for n in [min_n, max_n] joined by spaces
+    (reference: NGramTokenizerFactory)."""
+
+    def __init__(self, min_n: int, max_n: int):
+        super().__init__()
+        self.min_n, self.max_n = min_n, max_n
+
+    def create(self, sentence: str) -> Tokenizer:
+        base = Tokenizer(sentence.split(), self._pre).get_tokens()
+        grams: List[str] = []
+        for n in range(self.min_n, self.max_n + 1):
+            for i in range(len(base) - n + 1):
+                grams.append(" ".join(base[i:i + n]))
+        return Tokenizer(grams, None)
+
+
+class SentenceIterator:
+    """SPI: stream of sentences, restartable (reference: SentenceIterator).
+
+    Subclasses implement ``__iter__``; ``reset()`` restarts the stream so the
+    vocab-construction pass and each training epoch can re-scan the corpus.
+    """
+
+    def __iter__(self) -> Iterator[str]:
+        raise NotImplementedError
+
+    def reset(self) -> None:  # default: __iter__ builds a fresh iterator
+        pass
+
+
+class CollectionSentenceIterator(SentenceIterator):
+    def __init__(self, sentences: Sequence[str]):
+        self._sentences = list(sentences)
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._sentences)
+
+
+class LineSentenceIterator(SentenceIterator):
+    """One sentence per line from a text file (reference:
+    LineSentenceIterator / BasicLineIterator)."""
+
+    def __init__(self, path: str | Path):
+        self._path = Path(path)
+
+    def __iter__(self) -> Iterator[str]:
+        with open(self._path, "r", encoding="utf-8", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield line
+
+
+class FileSentenceIterator(SentenceIterator):
+    """All files under a directory, one sentence per line (reference:
+    FileSentenceIterator)."""
+
+    def __init__(self, root: str | Path):
+        self._root = Path(root)
+
+    def __iter__(self) -> Iterator[str]:
+        files = sorted(p for p in self._root.rglob("*") if p.is_file())
+        for p in files:
+            yield from LineSentenceIterator(p)
+
+
+class LabelAwareIterator(SentenceIterator):
+    """Sentence stream with a document label per sentence, for
+    ParagraphVectors (reference: LabelAwareSentenceIterator /
+    LabelsSource)."""
+
+    def __init__(self, sentences: Sequence[str],
+                 labels: Optional[Sequence[str]] = None):
+        if labels is not None and len(labels) != len(sentences):
+            raise ValueError("labels and sentences must align")
+        self._sentences = list(sentences)
+        self._labels = (list(labels) if labels is not None
+                        else [f"DOC_{i}" for i in range(len(sentences))])
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._sentences)
+
+    def labeled(self) -> Iterator[tuple]:
+        return iter(zip(self._labels, self._sentences))
+
+    @property
+    def labels(self) -> List[str]:
+        return list(self._labels)

@@ -119,4 +119,5 @@ def _ensure_loaded() -> None:
         loss,
         image,
         bitwise,
+        embeddings,
     )

@@ -100,6 +100,43 @@ class TestKerasSequentialImport:
         m.fit(x, y, epochs=1, verbose=0)
         roundtrip(m, x[:4], tmp_path, atol=2e-4)
 
+    def test_batchnorm_scale_center_false(self, tmp_path):
+        """Keras stores only the ENABLED BN tensors; positional unpacking
+        without the scale/center flags misassigns them (all shape [C])."""
+        m = keras.Sequential([
+            keras.layers.Input((8, 8, 3)),
+            keras.layers.Conv2D(4, 3),
+            keras.layers.BatchNormalization(scale=False),
+            keras.layers.GlobalMaxPooling2D(),
+            keras.layers.Dense(2),
+        ])
+        x = rng.randn(16, 8, 8, 3).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)]
+        m.compile(optimizer="sgd", loss="mse")
+        m.fit(x, y, epochs=1, verbose=0)
+        roundtrip(m, x[:4], tmp_path, atol=2e-4)
+
+        m2 = keras.Sequential([
+            keras.layers.Input((10,)),
+            keras.layers.BatchNormalization(center=False),
+            keras.layers.Dense(3),
+        ])
+        m2.compile(optimizer="sgd", loss="mse")
+        x2 = rng.randn(16, 10).astype(np.float32)
+        m2.fit(x2, rng.randn(16, 3).astype(np.float32), epochs=1, verbose=0)
+        roundtrip(m2, x2[:4], tmp_path, atol=2e-4)
+
+    def test_dense_leaky_relu_activation_kwarg_slope(self, tmp_path):
+        """activation="leaky_relu" means keras.activations.leaky_relu with
+        negative_slope=0.2 — not the op default 0.01."""
+        m = keras.Sequential([
+            keras.layers.Input((12,)),
+            keras.layers.Dense(16, activation="leaky_relu"),
+            keras.layers.Dense(3),
+        ])
+        # negative pre-activations are where the slope shows
+        roundtrip(m, (rng.randn(6, 12) * 3).astype(np.float32), tmp_path)
+
     def test_dropout_inference_identity(self, tmp_path):
         m = keras.Sequential([
             keras.layers.Input((10,)),

@@ -1,0 +1,432 @@
+"""NLP stack tests: vocab/Huffman, fused rounds, Word2Vec/ParagraphVectors,
+serializer round-trips (reference test model: deeplearning4j-nlp
+Word2VecTests / ParagraphVectorsTest — similarity structure after training,
+nearest-word queries, serde round-trips)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (CollectionSentenceIterator,
+                                    CommonPreprocessor,
+                                    DefaultTokenizerFactory,
+                                    LabelAwareIterator, NGramTokenizerFactory,
+                                    ParagraphVectors, VocabConstructor,
+                                    Word2Vec, build_huffman, huffman_arrays,
+                                    read_word2vec_model, read_word_vectors,
+                                    subsample_keep_probs, unigram_table,
+                                    write_word2vec_model, write_word_vectors)
+from deeplearning4j_tpu.ops.registry import exec_op
+
+
+# ---------------------------------------------------------------- corpora
+def _cluster_corpus(n_sent=1500, sent_len=12, seed=0):
+    rng = np.random.default_rng(seed)
+    A = [f"a{i}" for i in range(50)]
+    B = [f"b{i}" for i in range(50)]
+    return [" ".join(rng.choice(A if rng.random() < .5 else B, size=sent_len))
+            for _ in range(n_sent)]
+
+
+def _cluster_docs(n_docs=80, doc_len=30, seed=0, zipf=False):
+    rng = np.random.default_rng(seed)
+    A = [f"a{i}" for i in range(50)]
+    B = [f"b{i}" for i in range(50)]
+    p = None
+    if zipf:  # natural-text-like frequency skew (faster CBOW bootstrap)
+        p = 1.0 / np.arange(1, 51)
+        p /= p.sum()
+    docs = [" ".join(rng.choice(A if i % 2 == 0 else B, size=doc_len, p=p))
+            for i in range(n_docs)]
+    return docs, [f"DOC_{i}" for i in range(n_docs)]
+
+
+def _mean_sim(model, pairs):
+    return float(np.mean([model.similarity(a, b) for a, b in pairs]))
+
+
+# ------------------------------------------------------------ tokenization
+class TestText:
+    def test_default_tokenizer(self):
+        tf = DefaultTokenizerFactory()
+        assert tf.create("Hello  world foo").get_tokens() == \
+            ["Hello", "world", "foo"]
+
+    def test_common_preprocessor(self):
+        tf = DefaultTokenizerFactory()
+        tf.set_token_pre_processor(CommonPreprocessor())
+        assert tf.create("Hello, World! 42 (test)").get_tokens() == \
+            ["hello", "world", "test"]
+
+    def test_ngram_tokenizer(self):
+        tf = NGramTokenizerFactory(1, 2)
+        toks = tf.create("a b c").get_tokens()
+        assert toks == ["a", "b", "c", "a b", "b c"]
+
+
+# ------------------------------------------------------------------ vocab
+class TestVocab:
+    def test_prune_and_sort(self):
+        stream = [["x"] * 10 + ["y"] * 3 + ["z"]]
+        cache = VocabConstructor(min_word_frequency=2).build(iter(stream))
+        assert "z" not in cache
+        assert cache.index_of("x") == 0 and cache.index_of("y") == 1
+        assert cache.entry("x").count == 10
+        assert len(cache) == 2
+
+    def test_special_tokens_exempt_from_pruning(self):
+        cache = VocabConstructor(2, special_tokens=["LBL"]).build(
+            iter([["w"] * 5]))
+        assert cache.index_of("LBL") == 0 and "w" in cache
+
+    def test_huffman_prefix_free_and_length_ordering(self):
+        stream = [[w for w, c in
+                   [("a", 40), ("b", 20), ("c", 10), ("d", 5), ("e", 2)]
+                   for _ in range(c)]]
+        cache = VocabConstructor(1).build(iter(stream))
+        build_huffman(cache)
+        codes = {cache.entry_at(i).word:
+                 "".join(map(str, cache.entry_at(i).code))
+                 for i in range(len(cache))}
+        # prefix-free
+        vals = list(codes.values())
+        for i, ci in enumerate(vals):
+            for j, cj in enumerate(vals):
+                if i != j:
+                    assert not cj.startswith(ci)
+        # most frequent word gets the (weakly) shortest code
+        assert len(codes["a"]) == min(len(c) for c in codes.values())
+        # points index syn1 rows: in [0, vocab-1)
+        for i in range(len(cache)):
+            vw = cache.entry_at(i)
+            assert len(vw.points) == len(vw.code)
+            assert all(0 <= p < len(cache) - 1 for p in vw.points)
+
+    def test_huffman_arrays_padding(self):
+        cache = VocabConstructor(1).build(iter([["a"] * 8 + ["b"] * 4 +
+                                                ["c"] * 2 + ["d"]]))
+        build_huffman(cache)
+        codes, points, mask = huffman_arrays(cache)
+        assert codes.shape == points.shape == mask.shape
+        for i in range(len(cache)):
+            k = len(cache.entry_at(i).code)
+            assert mask[i, :k].all() and not mask[i, k:].any()
+
+    def test_unigram_table_power_law(self):
+        cache = VocabConstructor(1).build(iter([["a"] * 81 + ["b"]]))
+        cdf = unigram_table(cache, power=0.75)
+        # P(a) = 81^.75 / (81^.75 + 1) = 27/28
+        np.testing.assert_allclose(cdf, [27 / 28, 1.0], rtol=1e-12)
+
+    def test_subsample_keep_probs(self):
+        cache = VocabConstructor(1).build(iter([["a"] * 99 + ["b"]]))
+        keep = subsample_keep_probs(cache, sampling=1e-3)
+        # canonical formula: sqrt(t/f) + t/f with f = 99/100
+        f = 0.99
+        expected_a = np.sqrt(1e-3 / f) + 1e-3 / f
+        np.testing.assert_allclose(keep[cache.index_of("a")], expected_a,
+                                   rtol=1e-9)
+        fb = 0.01
+        expected_b = np.sqrt(1e-3 / fb) + 1e-3 / fb
+        np.testing.assert_allclose(keep[cache.index_of("b")], expected_b,
+                                   rtol=1e-9)
+        # frequent words are dropped more aggressively than rare ones
+        assert keep[cache.index_of("a")] < keep[cache.index_of("b")]
+
+
+# --------------------------------------------------------- fused round ops
+class TestEmbeddingOps:
+    def test_skipgram_round_golden(self):
+        """Hand-computed single pair, syn1 from zeros: first update writes
+        ±0.5*lr*h into the positive/negative output rows."""
+        syn0 = np.eye(4, 3, dtype=np.float32)
+        syn1 = np.zeros((4, 3), np.float32)
+        s0, s1, loss = exec_op(
+            "skipgram", syn0, syn1,
+            np.array([0], np.int32), np.array([[1, 2]], np.int32),
+            np.array([[1.0, 0.0]], np.float32), np.float32(1.0),
+            np.ones(1, np.float32))
+        np.testing.assert_allclose(np.asarray(s1)[1], [0.5, 0, 0], atol=1e-6)
+        np.testing.assert_allclose(np.asarray(s1)[2], [-0.5, 0, 0], atol=1e-6)
+        # h got zero gradient (u rows were zero); loss = -log(sigmoid(0)) avg
+        np.testing.assert_allclose(np.asarray(s0)[0], [1, 0, 0], atol=1e-6)
+        np.testing.assert_allclose(float(loss), -np.log(0.5), rtol=1e-5)
+
+    def test_skipgram_duplicate_indices_sum(self):
+        """Two pairs hitting the same center row must SUM their updates
+        (scatter-add semantics = the reference's sequential axpy)."""
+        syn0 = np.ones((3, 2), np.float32)
+        syn1 = np.full((3, 2), 0.5, np.float32)
+        centers = np.array([0, 0], np.int32)
+        targets = np.array([[1], [1]], np.int32)
+        labels = np.ones((2, 1), np.float32)
+        s0, _, _ = exec_op("skipgram", syn0, syn1, centers, targets, labels,
+                           np.float32(0.1), np.ones(2, np.float32))
+        # g = (1 - sigmoid(1)) * .1 per pair; grad_h = g*u; two pairs sum
+        g = (1 - 1 / (1 + np.exp(-1.0))) * 0.1
+        np.testing.assert_allclose(np.asarray(s0)[0], 1 + 2 * g * 0.5,
+                                   rtol=1e-5)
+
+    def test_pair_mask_zeroes_padded(self):
+        syn0 = np.ones((3, 2), np.float32)
+        syn1 = np.ones((3, 2), np.float32)
+        s0, s1, _ = exec_op(
+            "skipgram", syn0, syn1, np.array([0], np.int32),
+            np.array([[1]], np.int32), np.ones((1, 1), np.float32),
+            np.float32(1.0), np.zeros(1, np.float32))
+        np.testing.assert_array_equal(np.asarray(s0), syn0)
+        np.testing.assert_array_equal(np.asarray(s1), syn1)
+
+    def test_skipgram_hs_labels_are_one_minus_code(self):
+        """With code=0 the HS label is 1 (positive update on the inner
+        node); with code=1 it is 0."""
+        syn0 = np.eye(2, 2, dtype=np.float32)
+        syn1 = np.zeros((2, 2), np.float32)
+        for code, sign in ((0, +1.0), (1, -1.0)):
+            _, s1, _ = exec_op(
+                "skipgram_hs", syn0, syn1, np.array([0], np.int32),
+                np.array([[0]], np.int32),
+                np.array([[code]], np.int32),
+                np.ones((1, 1), np.float32), np.float32(1.0),
+                np.ones(1, np.float32))
+            np.testing.assert_allclose(np.asarray(s1)[0],
+                                       [sign * 0.5, 0], atol=1e-6)
+
+    def test_cbow_context_mean_and_exact_grad(self):
+        """h = mean of real context rows; each context row receives
+        grad_h / |window| (documented divergence from word2vec.c)."""
+        syn0 = np.stack([np.array([1, 0], np.float32),
+                         np.array([0, 1], np.float32),
+                         np.array([0, 0], np.float32)])
+        syn1 = np.stack([np.array([1, 1], np.float32)] * 3)
+        ctx = np.array([[0, 1]], np.int32)
+        cmask = np.ones((1, 2), np.float32)
+        tgt = np.array([[2]], np.int32)
+        lab = np.ones((1, 1), np.float32)
+        s0, s1, _ = exec_op("cbow", syn0, syn1, ctx, cmask, tgt, lab,
+                            np.float32(1.0), np.ones(1, np.float32))
+        # h = [.5,.5]; logit = h·[1,1] = 1; g = 1-sigmoid(1)
+        g = 1 - 1 / (1 + np.exp(-1.0))
+        grad_h = g * np.array([1, 1])
+        np.testing.assert_allclose(np.asarray(s0)[0],
+                                   [1, 0] + grad_h / 2, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(s1)[2],
+                                   [1, 1] + g * np.array([.5, .5]), rtol=1e-5)
+
+    def test_cbow_hs_golden(self):
+        """CBOW + hierarchical softmax: context mean vs the center word's
+        Huffman path, label = 1 - code."""
+        syn0 = np.stack([np.array([1, 0], np.float32),
+                         np.array([0, 1], np.float32),
+                         np.array([0, 0], np.float32)])
+        syn1 = np.stack([np.array([1, 1], np.float32)] * 3)
+        s0, s1, loss = exec_op(
+            "cbow_hs", syn0, syn1,
+            np.array([[0, 1]], np.int32), np.ones((1, 2), np.float32),
+            np.array([[0]], np.int32),      # points: inner node 0
+            np.array([[0]], np.int32),      # code 0 -> label 1
+            np.ones((1, 1), np.float32), np.float32(1.0),
+            np.ones(1, np.float32))
+        g = 1 - 1 / (1 + np.exp(-1.0))      # h=[.5,.5], logit=1, label=1
+        np.testing.assert_allclose(np.asarray(s1)[0],
+                                   1 + g * np.array([.5, .5]), rtol=1e-5)
+        grad_h = g * np.array([1, 1])
+        np.testing.assert_allclose(np.asarray(s0)[0], [1, 0] + grad_h / 2,
+                                   rtol=1e-5)
+        assert np.isfinite(float(loss))
+
+    def test_logit_clamp_keeps_updates_finite(self):
+        """MAX_EXP=6 clamp (reference expTable range): huge logits must not
+        produce inf/nan."""
+        syn0 = np.full((2, 4), 100.0, np.float32)
+        syn1 = np.full((2, 4), 100.0, np.float32)
+        s0, s1, loss = exec_op(
+            "skipgram", syn0, syn1, np.array([0], np.int32),
+            np.array([[1]], np.int32), np.zeros((1, 1), np.float32),
+            np.float32(0.025), np.ones(1, np.float32))
+        assert np.isfinite(np.asarray(s0)).all()
+        assert np.isfinite(float(loss))
+
+
+# ------------------------------------------------------------- end-to-end
+class TestWord2Vec:
+    def test_skipgram_ns_learns_cluster_structure(self):
+        w = (Word2Vec.builder().min_word_frequency(5).layer_size(32).seed(42)
+             .window_size(3).negative_sample(5).epochs(3).batch_size(256)
+             .iterate(CollectionSentenceIterator(_cluster_corpus()))
+             .build())
+        w.fit()
+        same = _mean_sim(w, [("a0", f"a{i}") for i in range(1, 6)])
+        diff = _mean_sim(w, [("a0", f"b{i}") for i in range(5)])
+        assert same > diff + 0.4, (same, diff)
+        assert w.words_per_sec > 0
+        near = w.words_nearest("a0", 10)
+        assert sum(n.startswith("a") for n in near) >= 8
+
+    def test_hierarchical_softmax_learns(self):
+        w = Word2Vec(min_word_frequency=5, layer_size=24, negative=0,
+                     use_hierarchic_softmax=True, epochs=3, batch_size=256,
+                     seed=1)
+        w.set_sentence_iterator(_cluster_corpus(1000))
+        w.fit()
+        same = _mean_sim(w, [("a0", f"a{i}") for i in range(1, 6)])
+        diff = _mean_sim(w, [("a0", f"b{i}") for i in range(5)])
+        assert same > diff + 0.4, (same, diff)
+
+    def test_cbow_learns(self):
+        w = Word2Vec(min_word_frequency=5, layer_size=24, negative=5,
+                     algorithm="cbow", epochs=10, batch_size=256, seed=2)
+        w.set_sentence_iterator(_cluster_corpus(1000))
+        w.fit()
+        same = _mean_sim(w, [("a0", f"a{i}") for i in range(1, 6)])
+        diff = _mean_sim(w, [("a0", f"b{i}") for i in range(5)])
+        assert same > diff + 0.4, (same, diff)
+
+    def test_subsampling_and_iterations_run(self):
+        w = Word2Vec(min_word_frequency=2, layer_size=16, negative=3,
+                     sampling=1e-2, iterations=2, epochs=2, batch_size=128,
+                     seed=3)
+        w.set_sentence_iterator(_cluster_corpus(200, sent_len=8))
+        w.fit()
+        assert np.isfinite(w.last_loss)
+
+    def test_analogy_accuracy_api(self):
+        w = Word2Vec(min_word_frequency=1, layer_size=8, negative=2,
+                     epochs=1, batch_size=64, seed=4)
+        w.set_sentence_iterator(_cluster_corpus(50, sent_len=6))
+        w.fit()
+        acc = w.accuracy([("a0", "a1", "a2", "a3"),
+                          ("zz", "a0", "a1", "a2")])  # 2nd skipped (OOV)
+        assert 0.0 <= acc <= 1.0
+
+    def test_empty_vocab_raises(self):
+        w = Word2Vec(min_word_frequency=100, layer_size=8)
+        w.set_sentence_iterator(["one two three"])
+        with pytest.raises(ValueError, match="empty vocabulary"):
+            w.fit()
+
+
+class TestSerializer:
+    def _small_model(self):
+        w = Word2Vec(min_word_frequency=1, layer_size=12, negative=3,
+                     epochs=1, batch_size=64, seed=5)
+        w.set_sentence_iterator(_cluster_corpus(60, sent_len=6))
+        w.fit()
+        return w
+
+    def test_text_roundtrip(self, tmp_path):
+        w = self._small_model()
+        for header in (True, False):
+            p = tmp_path / f"vec_{header}.txt"
+            write_word_vectors(w, p, binary=False, header=header)
+            r = read_word_vectors(p, binary=False)
+            assert r.vocab.words() == w.vocab.words()
+            np.testing.assert_allclose(r.get_word_vector("a0"),
+                                       w.get_word_vector("a0"),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_binary_roundtrip(self, tmp_path):
+        w = self._small_model()
+        p = tmp_path / "vec.bin"
+        write_word_vectors(w, p, binary=True)
+        r = read_word_vectors(p, binary=True)
+        assert r.vocab.words() == w.vocab.words()
+        np.testing.assert_allclose(r.get_word_vector_matrix(),
+                                   w.get_word_vector_matrix(), atol=0)
+
+    def test_model_zip_roundtrip_resumes_queries(self, tmp_path):
+        w = self._small_model()
+        p = tmp_path / "w2v.zip"
+        write_word2vec_model(w, p)
+        m = read_word2vec_model(p)
+        assert m.layer_size == w.layer_size
+        assert m.vocab.words() == w.vocab.words()
+        assert m.vocab.entry("a0").count == w.vocab.entry("a0").count
+        np.testing.assert_array_equal(m.lookup_table.syn0,
+                                      np.asarray(w.lookup_table.syn0))
+        np.testing.assert_array_equal(m.lookup_table.syn1neg,
+                                      np.asarray(w.lookup_table.syn1neg))
+        assert abs(m.similarity("a0", "a1") - w.similarity("a0", "a1")) < 1e-6
+
+    def test_model_zip_resume_training(self, tmp_path):
+        """read_word2vec_model + fit must CONTINUE from the restored tables
+        (not rebuild vocab / reset weights)."""
+        w = self._small_model()
+        p = tmp_path / "w2v.zip"
+        write_word2vec_model(w, p)
+        m = read_word2vec_model(p)
+        restored = np.array(m.lookup_table.syn0)
+        m.set_sentence_iterator(_cluster_corpus(60, sent_len=6))
+        m.fit()
+        assert m.vocab.words() == w.vocab.words()  # vocab preserved
+        assert not np.array_equal(np.asarray(m.lookup_table.syn0), restored)
+        # resumed training moved weights from the restored point, not from a
+        # fresh init: a fresh fit from scratch lands elsewhere
+        fresh = self._small_model()
+        assert not np.array_equal(np.asarray(m.lookup_table.syn0),
+                                  np.asarray(fresh.lookup_table.syn0))
+
+    def test_version_gate(self, tmp_path):
+        import json
+        import zipfile
+        w = self._small_model()
+        p = tmp_path / "w2v.zip"
+        write_word2vec_model(w, p)
+        bad = tmp_path / "bad.zip"
+        with zipfile.ZipFile(p) as zin, \
+                zipfile.ZipFile(bad, "w") as zout:
+            for name in zin.namelist():
+                data = zin.read(name)
+                if name == "config.json":
+                    cfg = json.loads(data)
+                    cfg["format_version"] = 999
+                    data = json.dumps(cfg).encode()
+                zout.writestr(name, data)
+        with pytest.raises(ValueError, match="format version"):
+            read_word2vec_model(bad)
+
+
+class TestParagraphVectors:
+    def test_dbow_separates_doc_clusters(self):
+        docs, labels = _cluster_docs()
+        pv = (ParagraphVectors.builder().min_word_frequency(1).layer_size(24)
+              .epochs(10).negative_sample(5).batch_size(256).seed(3)
+              .iterate(LabelAwareIterator(docs, labels)).build())
+        pv.fit()
+        same = _mean_sim(pv, [("DOC_0", f"DOC_{i}") for i in (2, 4, 6, 8)])
+        diff = _mean_sim(pv, [("DOC_0", f"DOC_{i}") for i in (1, 3, 5, 7)])
+        assert same > diff + 0.3, (same, diff)
+
+    def test_dbow_infer_vector_lands_in_right_cluster(self):
+        rng = np.random.default_rng(7)
+        docs, labels = _cluster_docs()
+        pv = (ParagraphVectors.builder().min_word_frequency(1).layer_size(24)
+              .epochs(10).negative_sample(5).batch_size(256).seed(3)
+              .iterate(LabelAwareIterator(docs, labels)).build())
+        pv.fit()
+        text = " ".join(f"a{i}" for i in rng.integers(0, 50, size=25))
+        v = pv.infer_vector(text)
+        near = pv.nearest_labels(v, 5)
+        even_hits = sum(int(l.split("_")[1]) % 2 == 0 for l in near)
+        assert even_hits >= 4, near
+
+    def test_dm_separates_doc_clusters(self):
+        docs, labels = _cluster_docs(zipf=True)
+        pv = (ParagraphVectors.builder().min_word_frequency(1).layer_size(24)
+              .epochs(20).negative_sample(5).batch_size(128).seed(3).dm(True)
+              .learning_rate(0.05)
+              .iterate(LabelAwareIterator(docs, labels)).build())
+        pv.fit()
+        same = _mean_sim(pv, [("DOC_0", f"DOC_{i}") for i in (2, 4, 6, 8)])
+        diff = _mean_sim(pv, [("DOC_0", f"DOC_{i}") for i in (1, 3, 5, 7)])
+        assert same > diff + 0.2, (same, diff)
+
+    def test_get_paragraph_vector(self):
+        docs, labels = _cluster_docs(20, 10)
+        pv = (ParagraphVectors.builder().min_word_frequency(1).layer_size(8)
+              .epochs(1).negative_sample(2).batch_size(64).seed(3)
+              .iterate(LabelAwareIterator(docs, labels)).build())
+        pv.fit()
+        assert pv.get_paragraph_vector("DOC_0").shape == (8,)
