@@ -3,3 +3,14 @@ from .iterators import (DataSetIterator, NDArrayDataSetIterator, ExistingDataSet
                         MultipleEpochsIterator, MnistDataSetIterator, IrisDataSetIterator)
 from .normalizers import (NormalizerStandardize, NormalizerMinMaxScaler,
                           ImagePreProcessingScaler, normalizer_from_json)
+from .records import (RecordReader, SequenceRecordReader, CSVRecordReader,
+                      CSVSequenceRecordReader, LineRecordReader,
+                      CollectionRecordReader, InputSplit, FileSplit,
+                      CollectionInputSplit)
+from .schema import Schema, TransformProcess, ColumnType
+from .image import (ImageRecordReader, ImageTransform, ResizeImageTransform,
+                    FlipImageTransform, CropImageTransform,
+                    RotateImageTransform, PipelineImageTransform)
+from .record_iterator import (RecordReaderDataSetIterator,
+                              SequenceRecordReaderDataSetIterator,
+                              AsyncDataSetIterator)

@@ -1,0 +1,371 @@
+"""DataVec ETL layer tests: record readers, Schema/TransformProcess,
+ImageRecordReader, RecordReader→DataSet iterators, async prefetch
+(reference test model: datavec-api CSVRecordReaderTest /
+TransformProcessTest, dl4j RecordReaderDataSetiteratorTest)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import (AsyncDataSetIterator,
+                                     CollectionInputSplit,
+                                     CollectionRecordReader, CSVRecordReader,
+                                     CSVSequenceRecordReader, DataSet,
+                                     ExistingDataSetIterator, FileSplit,
+                                     ImageRecordReader, LineRecordReader,
+                                     PipelineImageTransform,
+                                     RecordReaderDataSetIterator,
+                                     ResizeImageTransform, CropImageTransform,
+                                     FlipImageTransform, Schema,
+                                     SequenceRecordReaderDataSetIterator,
+                                     TransformProcess)
+
+
+# ---------------------------------------------------------------- readers
+class TestRecordReaders:
+    def test_csv_reader_skips_header(self, tmp_path):
+        p = tmp_path / "data.csv"
+        p.write_text("a,b,c\n1,2,3\n4,5,6\n")
+        rr = CSVRecordReader(skip_num_lines=1)
+        rr.initialize(FileSplit(p))
+        assert list(rr) == [["1", "2", "3"], ["4", "5", "6"]]
+
+    def test_csv_reader_quoting_and_delimiter(self, tmp_path):
+        p = tmp_path / "d.csv"
+        p.write_text('1;"x;y";3\n')
+        rr = CSVRecordReader(delimiter=";")
+        rr.initialize(FileSplit(p))
+        assert list(rr) == [["1", "x;y", "3"]]
+
+    def test_file_split_extension_filter_sorted(self, tmp_path):
+        (tmp_path / "b.csv").write_text("2\n")
+        (tmp_path / "a.csv").write_text("1\n")
+        (tmp_path / "c.txt").write_text("nope\n")
+        split = FileSplit(tmp_path, allowed_extensions=[".csv"])
+        assert [p.name for p in split.locations()] == ["a.csv", "b.csv"]
+        rr = LineRecordReader()
+        rr.initialize(split)
+        assert list(rr) == [["1"], ["2"]]
+
+    def test_reader_reset_restarts(self, tmp_path):
+        p = tmp_path / "x.csv"
+        p.write_text("1\n2\n")
+        rr = LineRecordReader()
+        rr.initialize(FileSplit(p))
+        assert len(list(rr)) == 2
+        assert len(list(rr)) == 2  # __iter__ resets
+
+    def test_csv_sequence_reader_one_file_per_sequence(self, tmp_path):
+        (tmp_path / "s0.csv").write_text("1,0\n2,0\n3,1\n")
+        (tmp_path / "s1.csv").write_text("4,1\n5,0\n")
+        rr = CSVSequenceRecordReader()
+        rr.initialize(FileSplit(tmp_path))
+        seqs = list(rr.sequences())
+        assert [len(s) for s in seqs] == [3, 2]
+        assert seqs[0][0] == ["1", "0"]
+
+
+# ------------------------------------------------------ schema/transforms
+class TestTransformProcess:
+    def _schema(self):
+        return (Schema.builder()
+                .add_column_string("name")
+                .add_column_categorical("color", ["red", "green", "blue"])
+                .add_column_double("width")
+                .add_column_integer("count")
+                .build())
+
+    def test_build_time_validation_unknown_column(self):
+        with pytest.raises(KeyError, match="no column"):
+            TransformProcess.builder(self._schema()).remove_columns("nope")
+
+    def test_build_time_validation_wrong_type(self):
+        with pytest.raises(ValueError, match="not categorical"):
+            self._schema().categorical_states("width")
+
+    def test_remove_and_onehot_and_math(self):
+        tp = (TransformProcess.builder(self._schema())
+              .remove_columns("name")
+              .categorical_to_one_hot("color")
+              .double_math_op("width", "multiply", 2.0)
+              .build())
+        out = tp.execute([["thing", "green", "1.5", 7]])
+        assert out == [[0, 1, 0, 3.0, 7]]
+        assert tp.final_schema().column_names() == \
+            ["color[red]", "color[green]", "color[blue]", "width", "count"]
+
+    def test_categorical_to_integer(self):
+        tp = (TransformProcess.builder(self._schema())
+              .categorical_to_integer("color")
+              .build())
+        assert tp.transform(["x", "blue", "0", 0])[1] == 2
+
+    def test_string_to_categorical_rejects_unknown_state(self):
+        schema = Schema.builder().add_column_string("s").build()
+        tp = (TransformProcess.builder(schema)
+              .string_to_categorical("s", ["a", "b"])
+              .build())
+        with pytest.raises(ValueError, match="not a declared state"):
+            tp.execute([["c"]])
+
+    def test_filter_invalid_values(self):
+        schema = Schema.builder().add_column_double("v").build()
+        tp = (TransformProcess.builder(schema)
+              .filter_invalid_values("v")
+              .build())
+        out = tp.execute([["1.0"], ["nan"], ["oops"], ["2.5"]])
+        assert out == [["1.0"], ["2.5"]]
+
+    def test_filter_predicate_and_minmax(self):
+        schema = Schema.builder().add_column_double("v").build()
+        tp = (TransformProcess.builder(schema)
+              .filter(lambda r: float(r[0]) >= 0)
+              .min_max_normalize("v", 0.0, 10.0)
+              .build())
+        assert tp.execute([["-1"], ["5"]]) == [[0.5]]
+
+    def test_rename_reorder_duplicate(self):
+        schema = (Schema.builder().add_column_double("a")
+                  .add_column_double("b").build())
+        tp = (TransformProcess.builder(schema)
+              .rename_column("a", "alpha")
+              .duplicate_column("b", "b2")
+              .reorder_columns("b", "alpha", "b2")
+              .build())
+        assert tp.execute([[1.0, 2.0]]) == [[2.0, 1.0, 2.0]]
+        assert tp.final_schema().column_names() == ["b", "alpha", "b2"]
+
+    def test_schema_json_roundtrip(self):
+        s = self._schema()
+        assert Schema.from_json(s.to_json()) == s
+
+    def test_record_width_mismatch_raises(self):
+        tp = TransformProcess.builder(self._schema()).build()
+        with pytest.raises(ValueError, match="record width"):
+            tp.execute([["too", "short"]])
+
+
+# ------------------------------------------------------------- iterators
+class TestRecordReaderDataSetIterator:
+    def test_classification_onehot(self, tmp_path):
+        p = tmp_path / "d.csv"
+        p.write_text("1.0,2.0,0\n3.0,4.0,2\n5.0,6.0,1\n")
+        rr = CSVRecordReader()
+        rr.initialize(FileSplit(p))
+        it = RecordReaderDataSetIterator(rr, batch_size=2, label_index=2,
+                                         num_classes=3)
+        batches = list(it)
+        assert [b.num_examples() for b in batches] == [2, 1]
+        np.testing.assert_array_equal(batches[0].features.to_numpy(),
+                                      [[1, 2], [3, 4]])
+        np.testing.assert_array_equal(batches[0].labels.to_numpy(),
+                                      [[1, 0, 0], [0, 0, 1]])
+
+    def test_regression_multi_label_columns(self):
+        rr = CollectionRecordReader([[1, 2, 10, 20], [3, 4, 30, 40]])
+        it = RecordReaderDataSetIterator(rr, batch_size=2, label_index=2,
+                                         label_index_to=3, regression=True)
+        ds = next(iter(it))
+        np.testing.assert_array_equal(ds.features.to_numpy(), [[1, 2], [3, 4]])
+        np.testing.assert_array_equal(ds.labels.to_numpy(),
+                                      [[10, 20], [30, 40]])
+
+    def test_label_out_of_range_raises(self):
+        rr = CollectionRecordReader([[1.0, 5]])
+        it = RecordReaderDataSetIterator(rr, batch_size=1, label_index=1,
+                                         num_classes=3)
+        with pytest.raises(ValueError, match="label index out of range"):
+            next(iter(it))
+
+    def test_transform_then_iterate(self, tmp_path):
+        """The reference's canonical CSV→TransformProcess→iterator→fit
+        flow (iris-shaped)."""
+        p = tmp_path / "iris.csv"
+        p.write_text("5.1,3.5,setosa\n7.0,3.2,versicolor\n6.3,3.3,virginica\n")
+        rr = CSVRecordReader()
+        rr.initialize(FileSplit(p))
+        schema = (Schema.builder().add_column_double("sl")
+                  .add_column_double("sw")
+                  .add_column_string("species").build())
+        tp = (TransformProcess.builder(schema)
+              .string_to_categorical("species",
+                                     ["setosa", "versicolor", "virginica"])
+              .categorical_to_integer("species")
+              .build())
+        out = tp.execute(iter(rr))
+        it = RecordReaderDataSetIterator(CollectionRecordReader(out),
+                                         batch_size=3, label_index=2,
+                                         num_classes=3)
+        ds = next(iter(it))
+        assert ds.features.shape == (3, 2)
+        np.testing.assert_array_equal(np.argmax(ds.labels.to_numpy(), 1),
+                                      [0, 1, 2])
+
+
+class TestSequenceIterator:
+    def test_padding_and_masks(self, tmp_path):
+        (tmp_path / "s0.csv").write_text("1,0\n2,0\n3,1\n")
+        (tmp_path / "s1.csv").write_text("4,1\n5,0\n")
+        rr = CSVSequenceRecordReader()
+        rr.initialize(FileSplit(tmp_path))
+        it = SequenceRecordReaderDataSetIterator(rr, batch_size=2,
+                                                 label_index=1,
+                                                 num_classes=2)
+        ds = next(iter(it))
+        assert ds.features.shape == (2, 3, 1)    # [N, T, F], padded to T=3
+        assert ds.labels.shape == (2, 3, 2)
+        np.testing.assert_array_equal(ds.labels_mask.to_numpy(),
+                                      [[1, 1, 1], [1, 1, 0]])
+        np.testing.assert_array_equal(ds.features.to_numpy()[1, :, 0],
+                                      [4, 5, 0])
+        # labels one-hot at real steps only (t=2 of seq 0 has label 1)
+        np.testing.assert_array_equal(ds.labels.to_numpy()[0, 2], [0, 1])
+
+
+# ----------------------------------------------------------------- image
+class TestImageRecordReader:
+    def _write_images(self, tmp_path, n_per_class=3, size=12):
+        from PIL import Image
+
+        rng = np.random.default_rng(0)
+        for cls in ("cats", "dogs"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(n_per_class):
+                arr = rng.integers(0, 255, size=(size, size, 3),
+                                   dtype=np.uint8)
+                Image.fromarray(arr).save(d / f"{i}.png")
+
+    def test_labels_from_parent_dir_nchw_scaled(self, tmp_path):
+        self._write_images(tmp_path)
+        rr = ImageRecordReader(height=8, width=8, channels=3)
+        rr.initialize(FileSplit(tmp_path, allowed_extensions=[".png"]))
+        assert rr.labels == ["cats", "dogs"]
+        recs = list(rr)
+        assert len(recs) == 6
+        img, label = recs[0]
+        assert img.shape == (3, 8, 8) and img.dtype == np.float32
+        assert 0.0 <= img.min() and img.max() <= 1.0
+        assert label == 0
+
+    def test_image_iterator_batches(self, tmp_path):
+        self._write_images(tmp_path)
+        rr = ImageRecordReader(height=8, width=8, channels=3)
+        rr.initialize(FileSplit(tmp_path, allowed_extensions=[".png"]))
+        it = RecordReaderDataSetIterator(rr, batch_size=4, label_index=1,
+                                         num_classes=2)
+        batches = list(it)
+        assert batches[0].features.shape == (4, 3, 8, 8)
+        assert batches[0].labels.shape == (4, 2)
+
+    def test_transforms(self, tmp_path):
+        rng = np.random.default_rng(1)
+        img = rng.integers(0, 255, size=(16, 16, 3), dtype=np.uint8)
+        out = ResizeImageTransform(8, 8)(img, rng)
+        assert out.shape == (8, 8, 3)
+        out = CropImageTransform(10, 10)(img, rng)
+        assert out.shape == (10, 10, 3)
+        flipped = FlipImageTransform(p=1.0)(img, rng)
+        np.testing.assert_array_equal(flipped, img[:, ::-1])
+        pipe = PipelineImageTransform([CropImageTransform(12, 12),
+                                       ResizeImageTransform(6, 6)])
+        assert pipe(img, rng).shape == (6, 6, 3)
+
+    def test_grayscale_channels(self, tmp_path):
+        self._write_images(tmp_path, n_per_class=1)
+        rr = ImageRecordReader(height=8, width=8, channels=1)
+        rr.initialize(FileSplit(tmp_path, allowed_extensions=[".png"]))
+        img, _ = next(iter(rr))
+        assert img.shape == (1, 8, 8)
+
+
+# ----------------------------------------------------------------- async
+class TestAsyncIterator:
+    def test_same_batches_as_base(self):
+        data = [DataSet(np.full((2, 3), i, np.float32),
+                        np.eye(2, dtype=np.float32)) for i in range(5)]
+        base = ExistingDataSetIterator(data)
+        out = list(AsyncDataSetIterator(base, queue_size=2,
+                                        device_prefetch=False))
+        assert len(out) == 5
+        for i, ds in enumerate(out):
+            np.testing.assert_array_equal(np.asarray(ds.features.value),
+                                          np.full((2, 3), i))
+
+    def test_device_prefetch_stages_arrays(self):
+        import jax
+
+        data = [DataSet(np.ones((2, 2), np.float32),
+                        np.eye(2, dtype=np.float32))]
+        out = list(AsyncDataSetIterator(ExistingDataSetIterator(data),
+                                        device_prefetch=True))
+        assert isinstance(out[0].features.value, jax.Array)
+
+    def test_overlaps_production_with_consumption(self):
+        produced = []
+
+        class SlowIter(ExistingDataSetIterator):
+            def __iter__(self):
+                for i, ds in enumerate(super().__iter__()):
+                    time.sleep(0.05)
+                    produced.append(i)
+                    yield ds
+
+        data = [DataSet(np.zeros((1, 1), np.float32), None)
+                for _ in range(4)]
+        it = AsyncDataSetIterator(SlowIter(data), queue_size=4,
+                                  device_prefetch=False)
+        gen = iter(it)
+        next(gen)
+        time.sleep(0.25)
+        # while the consumer sat idle, the worker kept producing
+        assert len(produced) == 4
+        assert len(list(gen)) == 3
+
+    def test_worker_exception_propagates(self):
+        class Boom(ExistingDataSetIterator):
+            def __iter__(self):
+                yield DataSet(np.zeros((1, 1), np.float32), None)
+                raise RuntimeError("reader failed")
+
+        it = AsyncDataSetIterator(Boom([]), device_prefetch=False)
+        with pytest.raises(RuntimeError, match="reader failed"):
+            list(it)
+
+    def test_training_through_async_pipeline(self, tmp_path):
+        """End-to-end: CSV on disk → reader → async prefetch → fit."""
+        from deeplearning4j_tpu.learning import Sgd
+        from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf import layers as L
+
+        rng = np.random.default_rng(0)
+        rows = []
+        for _ in range(64):
+            x = rng.normal(size=2)
+            rows.append(f"{x[0]},{x[1]},{int(x.sum() > 0)}")
+        p = tmp_path / "train.csv"
+        p.write_text("\n".join(rows) + "\n")
+        rr = CSVRecordReader()
+        rr.initialize(FileSplit(p))
+        it = AsyncDataSetIterator(
+            RecordReaderDataSetIterator(rr, batch_size=16, label_index=2,
+                                        num_classes=2))
+        conf = (NeuralNetConfiguration.builder().seed(1)
+                .updater(Sgd(learning_rate=0.5)).list()
+                .layer(L.DenseLayer(n_in=2, n_out=8, activation="tanh"))
+                .layer(L.OutputLayer(n_out=2, loss="mcxent",
+                                     activation="softmax"))
+                .set_input_type(InputType.feed_forward(2))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        first = last = None
+        for _ in range(20):
+            for ds in it:
+                model.fit(ds, epochs=1)
+                last = float(model.score_value)
+                if first is None:
+                    first = last
+        assert last < first
