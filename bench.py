@@ -20,6 +20,7 @@ Usage: python bench.py [--config lenet|resnet50] [--steps N] [--with-listener]
 
 import argparse
 import json
+import os
 import statistics
 import sys
 import time
@@ -287,6 +288,82 @@ def bench_lenet(steps: int, with_listener: bool = False) -> dict:
          "listener": with_listener})
 
 
+def bench_resnet50_disk(steps: int, batch: int = 64,
+                        image_size: int = 224) -> dict:
+    """ResNet-50 training fed from JPEG FILES ON DISK through the full ETL
+    path — ImageRecordReader (parallel decode) → RecordReaderDataSetIterator
+    → AsyncDataSetIterator (device prefetch) → fit. The number the VERDICT
+    asked for: sustained throughput facing a real input pipeline, not
+    device-resident arrays. Dataset: synthetic JPEGs generated once into a
+    cache dir (no egress; decode cost is what matters, not content)."""
+    import tempfile
+    from pathlib import Path
+
+    import jax
+
+    from deeplearning4j_tpu.data import (AsyncDataSetIterator, FileSplit,
+                                         ImageRecordReader,
+                                         RecordReaderDataSetIterator)
+    from deeplearning4j_tpu.models import ResNet50
+
+    n_images = (max(steps, 10) + 2) * batch   # +warmup batch headroom
+    cache = Path(tempfile.gettempdir()) / \
+        f"d4t_bench_jpegs_{image_size}_{n_images}"
+    if not cache.exists() or len(list(cache.rglob("*.jpg"))) < n_images:
+        from PIL import Image
+
+        rng = np.random.default_rng(0)
+        for cls in range(10):
+            (cache / f"class_{cls:02d}").mkdir(parents=True, exist_ok=True)
+        for i in range(n_images):
+            d = cache / f"class_{i % 10:02d}"
+            p = d / f"{i:06d}.jpg"
+            if not p.exists():
+                arr = rng.integers(0, 255, (image_size, image_size, 3),
+                                   dtype=np.uint8)
+                Image.fromarray(arr).save(p, quality=85)
+
+    model = ResNet50(num_classes=1000, image_size=image_size).init()
+    model.conf.global_conf.compute_dtype = "bfloat16"
+
+    rr = ImageRecordReader(height=image_size, width=image_size, channels=3,
+                           workers=os.cpu_count() or 8)
+    rr.initialize(FileSplit(cache, allowed_extensions=[".jpg"]))
+    base = RecordReaderDataSetIterator(rr, batch_size=batch, label_index=1,
+                                       num_classes=1000)
+    it = AsyncDataSetIterator(base, queue_size=8, device_prefetch=True)
+
+    # ONE generator for warmup + timing: a second iter(it) would spawn a
+    # second worker thread racing the first over the shared reader state
+    gen = iter(it)
+    first = next(gen)
+    model.fit(first, epochs=1)     # warmup: compile the step
+    float(model._score_dev)
+
+    t0 = time.perf_counter()
+    n = 0
+    for ds in gen:
+        if n >= steps:
+            break
+        model.fit(ds, epochs=1)
+        n += 1
+    float(model._score_dev)        # value fence: consume the chained loss
+    dt = time.perf_counter() - t0
+    gen.close()                    # shut the prefetch worker down
+    return {
+        "metric": "resnet50_imagenet_train_diskpipe",
+        "value": n * batch / dt,
+        "unit": "images/sec",
+        "steps_timed": n, "batch": batch,
+        "platform": jax.devices()[0].platform,
+        "image_size": image_size,
+        "dtype": "bf16 compute / fp32 params",
+        "decode_workers": rr.workers,
+        "data": f"{n_images} synthetic JPEGs on disk -> ImageRecordReader -> "
+                "async device prefetch",
+    }
+
+
 def bench_word2vec(steps: int) -> dict:
     """North-star config 4: Word2Vec skip-gram + negative sampling over a
     synthetic zipfian corpus; throughput = corpus words consumed / sec
@@ -327,7 +404,8 @@ def bench_word2vec(steps: int) -> dict:
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", default="resnet50",
-                        choices=["lenet", "resnet50", "bert", "word2vec"])
+                        choices=["lenet", "resnet50", "bert", "word2vec",
+                                 "resnet50-disk"])
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--batch", type=int, default=None,
                         help="per-config default: resnet50=64, bert=8")
@@ -344,6 +422,8 @@ def main() -> None:
         result = bench_bert(steps, batch=args.batch or 8)
     elif args.config == "word2vec":
         result = bench_word2vec(steps)
+    elif args.config == "resnet50-disk":
+        result = bench_resnet50_disk(steps, batch=args.batch or 64)
     else:
         result = bench_resnet50(steps, batch=args.batch or 64,
                                 with_listener=args.with_listener)

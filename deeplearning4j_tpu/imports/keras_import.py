@@ -245,7 +245,7 @@ class _SequentialBuilder:
         # the slope. (The standalone LeakyReLU LAYER defaults to 0.3 and is
         # handled in its own branch.)
         if (getattr(layer, "activation", None) == "leakyrelu"
-                and not isinstance(layer, L.ActivationLayer)):
+                and isinstance(layer, (L.DenseLayer, L.ConvolutionLayer))):
             layer.activation = "identity"
             self.layers.append(layer)
             self.weights.append(setter)

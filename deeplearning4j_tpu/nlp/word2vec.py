@@ -116,7 +116,19 @@ class SequenceVectors(WordVectors):
                  seed: int = 42, algorithm: str = "skipgram",
                  workers: int = 1,
                  special_tokens: Sequence[str] = ()):
-        if not use_hierarchic_softmax and negative <= 0:
+        if use_hierarchic_softmax:
+            # DOCUMENTED DIVERGENCE: the reference can train HS and negative
+            # sampling simultaneously; this engine trains exactly one output
+            # path per fit. Silent dropping would serialize an untrained
+            # syn1neg as if it were state — refuse instead.
+            if negative == 5:      # the constructor default
+                negative = 0
+            elif negative > 0:
+                raise ValueError(
+                    "combined hierarchical-softmax + negative-sampling "
+                    "training is not implemented; set negative=0 with "
+                    "use_hierarchic_softmax=True (or disable HS)")
+        elif negative <= 0:
             raise ValueError("need negative sampling (negative>0) or "
                              "use_hierarchic_softmax=True")
         self.layer_size = layer_size

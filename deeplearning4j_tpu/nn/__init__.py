@@ -5,3 +5,5 @@ from .conf import layers
 from .graph import (ComputationGraph, ComputationGraphConfiguration, GraphBuilder,
                     MergeVertex, ElementWiseVertex, SubsetVertex, ScaleVertex,
                     ShiftVertex, L2NormalizeVertex, StackVertex, UnstackVertex)
+from .transfer import (TransferLearning, TransferLearningHelper,
+                       FineTuneConfiguration)

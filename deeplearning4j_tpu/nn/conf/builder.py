@@ -103,6 +103,9 @@ class ListBuilder:
         self._conf = conf
         self._layers: List[L.Layer] = []
         self._input_type: Optional[InputType] = None
+        self._backprop_type = "Standard"
+        self._tbptt_fwd = 20
+        self._tbptt_back = 20
 
     def layer(self, idx_or_layer, maybe_layer: Optional[L.Layer] = None) -> "ListBuilder":
         layer = maybe_layer if maybe_layer is not None else idx_or_layer
@@ -115,11 +118,43 @@ class ListBuilder:
 
     setInputType = set_input_type
 
+    # -- truncated BPTT (reference: MultiLayerConfiguration.Builder
+    # backpropType/tBPTTForwardLength/tBPTTBackwardLength) ---------------
+    def backprop_type(self, bp: str) -> "ListBuilder":
+        if bp not in ("Standard", "TruncatedBPTT"):
+            raise ValueError("backprop_type must be Standard|TruncatedBPTT")
+        self._backprop_type = bp
+        return self
+
+    def tbptt_fwd_length(self, k: int) -> "ListBuilder":
+        self._tbptt_fwd = int(k)
+        return self
+
+    def tbptt_back_length(self, k: int) -> "ListBuilder":
+        self._tbptt_back = int(k)
+        return self
+
+    def tbptt_length(self, k: int) -> "ListBuilder":
+        return self.tbptt_fwd_length(k).tbptt_back_length(k)
+
     def build(self) -> "MultiLayerConfiguration":
+        if self._backprop_type == "TruncatedBPTT" \
+                and self._tbptt_fwd != self._tbptt_back:
+            # DOCUMENTED DIVERGENCE: the reference supports back < fwd
+            # (gradients truncated deeper than the forward segment); here one
+            # lax.scan segment is both, so unequal lengths would silently do
+            # something else — refuse rather than imply support.
+            raise ValueError(
+                "tbptt_fwd_length must equal tbptt_back_length (use "
+                "tbptt_length(k)); unequal truncation windows are not "
+                "supported")
         # cascade global defaults
         for l in self._layers:
             self._apply_defaults(l)
         mlc = MultiLayerConfiguration(self._conf, self._layers)
+        mlc.backprop_type = self._backprop_type
+        mlc.tbptt_fwd_length = self._tbptt_fwd
+        mlc.tbptt_back_length = self._tbptt_back
         if self._input_type is not None:
             mlc.set_input_type(self._input_type)
         return mlc
@@ -153,6 +188,9 @@ class MultiLayerConfiguration:
         self.preprocessors: Dict[int, Preprocessor] = {}
         self.input_type: Optional[InputType] = None
         self.layer_output_types: List[InputType] = []
+        self.backprop_type = "Standard"
+        self.tbptt_fwd_length = 20
+        self.tbptt_back_length = 20
 
     # --- shape inference + preprocessor insertion -----------------------
     def set_input_type(self, input_type: InputType) -> None:
@@ -188,6 +226,9 @@ class MultiLayerConfiguration:
             "global": _ser_obj(self.global_conf),
             "layers": [_ser_obj(l) for l in self.layers],
             "input_type": _ser_obj(self.input_type) if self.input_type else None,
+            "backprop_type": self.backprop_type,
+            "tbptt_fwd_length": self.tbptt_fwd_length,
+            "tbptt_back_length": self.tbptt_back_length,
         }, indent=2)
 
     @staticmethod
@@ -196,6 +237,9 @@ class MultiLayerConfiguration:
         gc = _deser_obj(d["global"])
         layers = [_deser_obj(ld) for ld in d["layers"]]
         mlc = MultiLayerConfiguration(gc, layers)
+        mlc.backprop_type = d.get("backprop_type", "Standard")
+        mlc.tbptt_fwd_length = d.get("tbptt_fwd_length", 20)
+        mlc.tbptt_back_length = d.get("tbptt_back_length", 20)
         if d.get("input_type"):
             mlc.set_input_type(_deser_obj(d["input_type"]))
         return mlc

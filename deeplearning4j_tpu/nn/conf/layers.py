@@ -72,6 +72,29 @@ class Layer:
     def has_params(self) -> bool:
         return True
 
+    # -- per-timestep feature masking (reference: Layer.setMaskArray /
+    # feedForwardMaskArray; SURVEY §5.7 masking row) --------------------
+    def apply_masked(self, params, x, state, training, rng, fmask):
+        """Forward with a [B, T] feature mask (1 = real step). Default:
+        mask-oblivious layers ignore it; recurrent/attention layers
+        override to zero padded steps / mask attention keys."""
+        return self.apply(params, x, state, training, rng)
+
+    # -- streaming/truncated-BPTT state (reference: BaseRecurrentLayer
+    # stateMap / tBpttStateMap) -----------------------------------------
+    def is_rnn(self) -> bool:
+        return False
+
+    def init_rnn_state(self, batch: int, dtype=jnp.float32):
+        """Zero carry for apply_rnn; None for stateless layers."""
+        return None
+
+    def apply_rnn(self, params, x, rnn_state, state, training, rng):
+        """Forward one time chunk from an explicit recurrent carry.
+        Returns (y, new_rnn_state, new_state)."""
+        y, st = self.apply(params, x, state, training, rng)
+        return y, rnn_state, st
+
 
 @dataclass
 class DenseLayer(Layer):
@@ -491,6 +514,26 @@ class GlobalPoolingLayer(Layer):
             raise ValueError(f"unknown pooling {self.pooling_type!r}")
         return out, state
 
+    def apply_masked(self, params, x, state, training, rng, fmask):
+        """Mask-aware time pooling (reference: masked GlobalPoolingLayer):
+        padded steps are excluded from max/avg/sum."""
+        if x.ndim != 3:
+            return self.apply(params, x, state, training, rng)
+        kind = self.pooling_type.lower()
+        m = fmask[..., None].astype(x.dtype)
+        if kind == "max":
+            neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+            out = jnp.max(jnp.where(m > 0, x, neg), axis=1)
+        elif kind in ("avg", "average"):
+            out = jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1e-9)
+        elif kind == "sum":
+            out = jnp.sum(x * m, axis=1)
+        elif kind == "pnorm":
+            out = jnp.sum(jnp.abs(x * m) ** 2, axis=1) ** 0.5
+        else:
+            raise ValueError(f"unknown pooling {self.pooling_type!r}")
+        return out, state
+
     @property
     def has_params(self):
         return False
@@ -529,6 +572,27 @@ class LSTM(Layer):
             ys = activation_fn(act)(ys)
         return ys, state
 
+    def apply_masked(self, params, x, state, training, rng, fmask):
+        y, st = self.apply(params, x, state, training, rng)
+        return y * fmask[:, :, None].astype(y.dtype), st
+
+    def is_rnn(self):
+        return True
+
+    def init_rnn_state(self, batch, dtype=jnp.float32):
+        z = jnp.zeros((batch, self.n_out), dtype)
+        return (z, z)
+
+    def apply_rnn(self, params, x, rnn_state, state, training, rng):
+        x = self._maybe_dropout(x, training, rng)
+        h0, c0 = rnn_state
+        ys, (h, c) = get_op("lstm_layer").fn(x, params["W"], params["b"],
+                                             h0=h0, c0=c0)
+        act = self.activation
+        if act and act.lower() not in ("tanh", "identity"):
+            ys = activation_fn(act)(ys)
+        return ys, (h, c), state
+
 
 @dataclass
 class GravesLSTM(LSTM):
@@ -554,8 +618,27 @@ class SimpleRnn(Layer):
 
     def apply(self, params, x, state, training, rng):
         x = self._maybe_dropout(x, training, rng)
-        ys, _ = get_op("simple_rnn_layer").fn(x, params["W"], params["RW"], params["b"])
+        ys, _ = get_op("simple_rnn_layer").fn(
+            x, params["W"], params["RW"], params["b"],
+            activation=activation_fn(self.activation or "tanh"))
         return ys, state
+
+    def apply_masked(self, params, x, state, training, rng, fmask):
+        y, st = self.apply(params, x, state, training, rng)
+        return y * fmask[:, :, None].astype(y.dtype), st
+
+    def is_rnn(self):
+        return True
+
+    def init_rnn_state(self, batch, dtype=jnp.float32):
+        return jnp.zeros((batch, self.n_out), dtype)
+
+    def apply_rnn(self, params, x, rnn_state, state, training, rng):
+        x = self._maybe_dropout(x, training, rng)
+        ys, h = get_op("simple_rnn_layer").fn(
+            x, params["W"], params["RW"], params["b"], h0=rnn_state,
+            activation=activation_fn(self.activation or "tanh"))
+        return ys, h, state
 
 
 @dataclass
@@ -591,6 +674,176 @@ class Bidirectional(Layer):
         else:
             out = 0.5 * (fwd + bwd)
         return out, state
+
+
+@dataclass
+class SelfAttentionLayer(Layer):
+    """Reference conf.layers.SelfAttentionLayer → libnd4j
+    multi_head_dot_product_attention with Q=K=V=input.
+
+    ``project_input=True`` learns Wq/Wk/Wv/Wo projections (required when
+    n_heads > 1); otherwise raw single-head dot-product attention over the
+    input and n_out must equal n_in. Input/output [B, T, F]; a feature mask
+    masks attention KEYS, so padded timesteps receive no attention weight.
+    """
+
+    n_out: int = 0
+    n_heads: int = 1
+    head_size: Optional[int] = None
+    project_input: bool = True
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, RNNInput):
+            raise ValueError("SelfAttentionLayer needs RNN input [B, T, F]")
+        self.n_in = input_type.size
+        if not self.project_input:
+            if self.n_heads != 1:
+                raise ValueError("project_input=False requires n_heads=1")
+            self.n_out = self.n_in
+        return RNNInput(self.n_out, input_type.timesteps)
+
+    def _hs(self) -> int:
+        return self.head_size or self.n_out // self.n_heads
+
+    def init_params(self, key, dtype=jnp.float32):
+        if not self.project_input:
+            return {}
+        hs = self._hs()
+        ks = jax.random.split(key, 4)
+        wi = self.weight_init or "xavier"
+        return {
+            "Wq": init_weights(ks[0], (self.n_in, self.n_heads * hs), wi, dtype),
+            "Wk": init_weights(ks[1], (self.n_in, self.n_heads * hs), wi, dtype),
+            "Wv": init_weights(ks[2], (self.n_in, self.n_heads * hs), wi, dtype),
+            "Wo": init_weights(ks[3], (self.n_heads * hs, self.n_out), wi, dtype),
+        }
+
+    def _attend(self, params, q, kv, fmask):
+        if self.project_input:
+            return get_op("multi_head_dot_product_attention").fn(
+                q, kv, kv, params["Wq"], params["Wk"], params["Wv"],
+                params["Wo"], num_heads=self.n_heads, mask=fmask)
+        m = fmask[:, None, :] if fmask is not None else None
+        return get_op("dot_product_attention").fn(q, kv, kv, mask=m)
+
+    def apply(self, params, x, state, training, rng):
+        x = self._maybe_dropout(x, training, rng)
+        return self._attend(params, x, x, None), state
+
+    def apply_masked(self, params, x, state, training, rng, fmask):
+        x = self._maybe_dropout(x, training, rng)
+        y = self._attend(params, x, x, fmask)
+        return y * fmask[:, :, None].astype(y.dtype), state
+
+    @property
+    def has_params(self):
+        return self.project_input
+
+
+@dataclass
+class LearnedSelfAttentionLayer(SelfAttentionLayer):
+    """Reference conf.layers.LearnedSelfAttentionLayer: n_queries LEARNED
+    query vectors attend over the sequence — output is a fixed-length
+    [B, n_queries, n_out] regardless of input length (the attention-pooling
+    trick the reference uses ahead of feed-forward heads)."""
+
+    n_queries: int = 1
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, RNNInput):
+            raise ValueError("LearnedSelfAttentionLayer needs RNN input")
+        self.n_in = input_type.size
+        if not self.project_input:
+            if self.n_heads != 1:
+                raise ValueError("project_input=False requires n_heads=1")
+            self.n_out = self.n_in
+        return RNNInput(self.n_out, self.n_queries)
+
+    def init_params(self, key, dtype=jnp.float32):
+        kq, key = jax.random.split(key)
+        p = super().init_params(key, dtype)
+        p["Q"] = init_weights(kq, (self.n_queries, self.n_in),
+                              self.weight_init or "xavier", dtype)
+        return p
+
+    def apply(self, params, x, state, training, rng):
+        x = self._maybe_dropout(x, training, rng)
+        q = jnp.broadcast_to(params["Q"][None],
+                             (x.shape[0],) + params["Q"].shape)
+        return self._attend(params, q, x, None), state
+
+    def apply_masked(self, params, x, state, training, rng, fmask):
+        x = self._maybe_dropout(x, training, rng)
+        q = jnp.broadcast_to(params["Q"][None],
+                             (x.shape[0],) + params["Q"].shape)
+        # keys masked; output timesteps are the learned queries (all real)
+        return self._attend(params, q, x, fmask), state
+
+    @property
+    def has_params(self):
+        return True
+
+
+@dataclass
+class RecurrentAttentionLayer(Layer):
+    """Reference conf.layers.RecurrentAttentionLayer: per timestep,
+    y_t = activation(Wx·x_t + Wr·a_t + b) where a_t is multi-head attention
+    queried by the previous output y_{t-1} over the whole input sequence.
+    The reference defines this via a SameDiff per-step loop; here the step
+    is a ``lax.scan`` whose attention logits against the full sequence are
+    one batched matmul per step."""
+
+    n_out: int = 0
+    n_heads: int = 1
+    head_size: Optional[int] = None
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, RNNInput):
+            raise ValueError("RecurrentAttentionLayer needs RNN input")
+        self.n_in = input_type.size
+        return RNNInput(self.n_out, input_type.timesteps)
+
+    def _hs(self) -> int:
+        return self.head_size or self.n_out // self.n_heads
+
+    def init_params(self, key, dtype=jnp.float32):
+        hs = self._hs()
+        ks = jax.random.split(key, 6)
+        wi = self.weight_init or "xavier"
+        return {
+            "Wx": init_weights(ks[0], (self.n_in, self.n_out), wi, dtype),
+            "Wr": init_weights(ks[1], (self.n_out, self.n_out), wi, dtype),
+            "b": jnp.zeros((self.n_out,), dtype),
+            "Wq": init_weights(ks[2], (self.n_out, self.n_heads * hs), wi, dtype),
+            "Wk": init_weights(ks[3], (self.n_in, self.n_heads * hs), wi, dtype),
+            "Wv": init_weights(ks[4], (self.n_in, self.n_heads * hs), wi, dtype),
+            "Wo": init_weights(ks[5], (self.n_heads * hs, self.n_out), wi, dtype),
+        }
+
+    def _run(self, params, x, fmask):
+        act = activation_fn(self.activation or "tanh")
+        mha = get_op("multi_head_dot_product_attention").fn
+        xT = jnp.swapaxes(x, 0, 1)                     # [T, B, F]
+        y0 = jnp.zeros((x.shape[0], self.n_out), x.dtype)
+
+        def step(y_prev, xt):
+            a = mha(y_prev[:, None, :], x, x, params["Wq"], params["Wk"],
+                    params["Wv"], params["Wo"], num_heads=self.n_heads,
+                    mask=fmask)[:, 0]
+            y = act(xt @ params["Wx"] + a @ params["Wr"] + params["b"])
+            return y, y
+
+        _, ys = jax.lax.scan(step, y0, xT)
+        return jnp.swapaxes(ys, 0, 1)
+
+    def apply(self, params, x, state, training, rng):
+        x = self._maybe_dropout(x, training, rng)
+        return self._run(params, x, None), state
+
+    def apply_masked(self, params, x, state, training, rng, fmask):
+        x = self._maybe_dropout(x, training, rng)
+        y = self._run(params, x, fmask)
+        return y * fmask[:, :, None].astype(y.dtype), state
 
 
 @dataclass
@@ -691,6 +944,20 @@ class FrozenLayer(Layer):
     def apply(self, params, x, state, training, rng):
         frozen = jax.tree.map(jax.lax.stop_gradient, params)
         return self.layer.apply(frozen, x, state, training, rng)
+
+    def apply_masked(self, params, x, state, training, rng, fmask):
+        frozen = jax.tree.map(jax.lax.stop_gradient, params)
+        return self.layer.apply_masked(frozen, x, state, training, rng, fmask)
+
+    def is_rnn(self):
+        return self.layer.is_rnn()
+
+    def init_rnn_state(self, batch, dtype=jnp.float32):
+        return self.layer.init_rnn_state(batch, dtype)
+
+    def apply_rnn(self, params, x, rnn_state, state, training, rng):
+        frozen = jax.tree.map(jax.lax.stop_gradient, params)
+        return self.layer.apply_rnn(frozen, x, rnn_state, state, training, rng)
 
     @property
     def has_params(self):

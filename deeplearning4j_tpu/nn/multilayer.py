@@ -37,8 +37,10 @@ class MultiLayerNetwork:
         self._epoch = 0
         self._listeners: List[Any] = []
         self._fit_step = None
+        self._tbptt_step = None
         self._infer_fn = None
         self._score_dev = None
+        self._rnn_state_map = None
 
     @property
     def score_value(self) -> float:
@@ -105,7 +107,14 @@ class MultiLayerNetwork:
         return jax.tree.map(cast, params), cast(x)
 
     # --- forward ---------------------------------------------------------
-    def _forward(self, params, states, x, training: bool, rng):
+    def _apply_layer(self, layer, lp, x, st, training, rng, fmask):
+        """One layer forward, routing through apply_masked when a
+        per-timestep feature mask is present (SURVEY §5.7)."""
+        if fmask is not None:
+            return layer.apply_masked(lp, x, st, training, rng, fmask)
+        return layer.apply(lp, x, st, training, rng)
+
+    def _forward(self, params, states, x, training: bool, rng, fmask=None):
         """Single traced forward pass through preprocessors + layers."""
         params, x = self._cast_compute(params, x)
         new_states = []
@@ -114,20 +123,37 @@ class MultiLayerNetwork:
             if pre is not None:
                 x = pre(x)
             rng, sub = jax.random.split(rng)
-            x, st = layer.apply(params[i], x, states[i], training, sub)
+            x, st = self._apply_layer(layer, params[i], x, states[i],
+                                      training, sub, fmask)
             new_states.append(st)
         return x, new_states
 
-    def _forward_to_preout(self, params, states, x, training: bool, rng):
-        """Forward stopping BEFORE the output head's activation (for loss)."""
+    def _forward_to_preout(self, params, states, x, training: bool, rng,
+                           fmask=None, rnn_states=None):
+        """Forward stopping BEFORE the output head's activation (for loss).
+
+        ``rnn_states`` (TBPTT): explicit recurrent carries per layer; when
+        given, recurrent layers start from them and the new carries are
+        returned as a third element."""
         params, x = self._cast_compute(params, x)
         new_states = []
+        new_rnn = [] if rnn_states is not None else None
         for i, layer in enumerate(self.layers[:-1]):
             pre = self.conf.preprocessors.get(i)
             if pre is not None:
                 x = pre(x)
             rng, sub = jax.random.split(rng)
-            x, st = layer.apply(params[i], x, states[i], training, sub)
+            if rnn_states is not None and layer.is_rnn():
+                x, r, st = layer.apply_rnn(params[i], x, rnn_states[i],
+                                           states[i], training, sub)
+                if fmask is not None:
+                    x = x * fmask[:, :, None].astype(x.dtype)
+                new_rnn.append(r)
+            else:
+                x, st = self._apply_layer(layer, params[i], x, states[i],
+                                          training, sub, fmask)
+                if rnn_states is not None:
+                    new_rnn.append(rnn_states[i])
             new_states.append(st)
         i = len(self.layers) - 1
         pre = self.conf.preprocessors.get(i)
@@ -137,19 +163,27 @@ class MultiLayerNetwork:
         rng, sub = jax.random.split(rng)
         x = self.layers[i]._maybe_dropout(x, training, sub)
         new_states.append(states[i])  # output head is stateless; keep list aligned
+        if rnn_states is not None:
+            new_rnn.append(None)
+            return x, new_states, new_rnn
         return x, new_states
 
-    def output(self, x, training: bool = False) -> NDArray:
-        """Inference forward (reference output()): one compiled module."""
+    def output(self, x, training: bool = False, fmask=None) -> NDArray:
+        """Inference forward (reference output()): one compiled module.
+        ``fmask`` [B, T]: per-timestep feature mask for sequence inputs."""
         self._check_init()
         xv = jnp.asarray(x.value if isinstance(x, NDArray) else x)
+        if fmask is not None:
+            fmask = jnp.asarray(fmask.value if isinstance(fmask, NDArray)
+                                else fmask)
         if self._infer_fn is None:
-            def infer(params, states, xin, key):
-                out, _ = self._forward(params, states, xin, False, key)
+            def infer(params, states, xin, key, fm=None):
+                out, _ = self._forward(params, states, xin, False, key, fm)
                 return out
 
             self._infer_fn = jax.jit(infer)
-        out = self._infer_fn(self._params, self._states, xv, get_random().next_key())
+        out = self._infer_fn(self._params, self._states, xv,
+                             get_random().next_key(), fmask)
         return NDArray(out)
 
     def feed_forward(self, x, training: bool = False) -> List[NDArray]:
@@ -169,11 +203,18 @@ class MultiLayerNetwork:
         return acts
 
     # --- loss ------------------------------------------------------------
-    def _loss(self, params, states, x, labels, mask, training: bool, rng):
+    def _loss(self, params, states, x, labels, mask, training: bool, rng,
+              fmask=None, rnn_states=None):
         out_layer = self.layers[-1]
         if not isinstance(out_layer, (L.OutputLayer, L.LossLayer)):
             raise ValueError("last layer must be an OutputLayer/LossLayer to train")
-        pre, new_states = self._forward_to_preout(params, states, x, training, rng)
+        if rnn_states is not None:
+            pre, new_states, new_rnn = self._forward_to_preout(
+                params, states, x, training, rng, fmask, rnn_states)
+        else:
+            pre, new_states = self._forward_to_preout(params, states, x,
+                                                      training, rng, fmask)
+            new_rnn = None
         # under reduced-precision compute, run the head + loss reduction in
         # fp32; leave fp64 runs (gradient checks) untouched
         if self.conf.global_conf.compute_dtype:
@@ -189,6 +230,8 @@ class MultiLayerNetwork:
         reg = 0.0
         gc = self.conf.global_conf
         for lp, layer in zip(params, self.layers):
+            if isinstance(layer, L.FrozenLayer):
+                continue  # frozen params take no updates, incl. weight decay
             l1 = layer.l1 if layer.l1 is not None else gc.l1
             l2 = layer.l2 if layer.l2 is not None else gc.l2
             for name, w in lp.items():
@@ -198,6 +241,8 @@ class MultiLayerNetwork:
                     reg = reg + 0.5 * l2 * jnp.sum(jnp.square(w))
                 if l1:
                     reg = reg + l1 * jnp.sum(jnp.abs(w))
+        if new_rnn is not None:
+            return data_loss + reg, (new_states, new_rnn)
         return data_loss + reg, new_states
 
     def score(self, dataset: DataSet, training: bool = False) -> float:
@@ -205,8 +250,10 @@ class MultiLayerNetwork:
         x = jnp.asarray(dataset.features.value)
         y = jnp.asarray(dataset.labels.value)
         mask = jnp.asarray(dataset.labels_mask.value) if dataset.labels_mask is not None else None
+        fmask = (jnp.asarray(dataset.features_mask.value)
+                 if dataset.features_mask is not None else None)
         loss, _ = self._loss(self._params, self._states, x, y, mask, training,
-                             get_random().next_key())
+                             get_random().next_key(), fmask)
         return float(loss)
 
     def compute_gradient_and_score(self, dataset: DataSet):
@@ -215,10 +262,13 @@ class MultiLayerNetwork:
         x = jnp.asarray(dataset.features.value)
         y = jnp.asarray(dataset.labels.value)
         mask = jnp.asarray(dataset.labels_mask.value) if dataset.labels_mask is not None else None
+        fmask = (jnp.asarray(dataset.features_mask.value)
+                 if dataset.features_mask is not None else None)
         key = jax.random.PRNGKey(0)
 
         def loss_fn(params):
-            loss, _ = self._loss(params, self._states, x, y, mask, False, key)
+            loss, _ = self._loss(params, self._states, x, y, mask, False, key,
+                                 fmask)
             return loss
 
         loss, grads = jax.value_and_grad(loss_fn)(self._params)
@@ -226,13 +276,20 @@ class MultiLayerNetwork:
         return grads, self.score_value
 
     # --- training --------------------------------------------------------
+    def _frozen_indices(self):
+        return [i for i, l in enumerate(self.layers)
+                if isinstance(l, L.FrozenLayer)]
+
     def _build_fit_step(self):
         gc = self.conf.global_conf
         updater = gc.updater
+        frozen = self._frozen_indices()
 
-        def step(params, states, upd_state, x, y, mask, key, iteration):
+        def step(params, states, upd_state, x, y, mask, key, iteration,
+                 fmask=None):
             def loss_fn(p):
-                loss, new_states = self._loss(p, states, x, y, mask, True, key)
+                loss, new_states = self._loss(p, states, x, y, mask, True,
+                                              key, fmask)
                 return loss, new_states
 
             (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -240,7 +297,40 @@ class MultiLayerNetwork:
                 grads = _normalize_gradients(grads, gc.grad_normalization,
                                              gc.grad_norm_threshold)
             new_params, new_upd = updater.apply(grads, upd_state, params, iteration)
+            for i in frozen:
+                # stop_gradient already zeroes their grads; restoring the
+                # original tensors also shields them from stateful-updater
+                # side effects (weight decay, momentum drift)
+                new_params[i] = params[i]
             return new_params, new_states, new_upd, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _build_tbptt_step(self):
+        """TBPTT segment step (reference: MultiLayerNetwork
+        truncatedBPTTGradient / rnnActivateUsingStoredState): gradients flow
+        within the segment only — the incoming recurrent carries are jit
+        inputs, so backprop truncates at the segment boundary by
+        construction."""
+        gc = self.conf.global_conf
+        updater = gc.updater
+        frozen = self._frozen_indices()
+
+        def step(params, states, upd_state, rnn_states, x, y, mask, key,
+                 iteration, fmask=None):
+            def loss_fn(p):
+                loss, aux = self._loss(p, states, x, y, mask, True, key,
+                                       fmask, rnn_states)
+                return loss, aux
+
+            (loss, (new_states, new_rnn)), grads =                 jax.value_and_grad(loss_fn, has_aux=True)(params)
+            if gc.grad_normalization:
+                grads = _normalize_gradients(grads, gc.grad_normalization,
+                                             gc.grad_norm_threshold)
+            new_params, new_upd = updater.apply(grads, upd_state, params, iteration)
+            for i in frozen:
+                new_params[i] = params[i]
+            return new_params, new_states, new_upd, new_rnn, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
@@ -253,17 +343,24 @@ class MultiLayerNetwork:
         if self._fit_step is None:
             self._fit_step = self._build_fit_step()
 
+        tbptt = self.conf.backprop_type == "TruncatedBPTT"
         for _ in range(max(1, epochs)):
             for ds in _iter_data(data, batch_size):
                 x = jnp.asarray(ds.features.value)
                 y = jnp.asarray(ds.labels.value)
                 mask = (jnp.asarray(ds.labels_mask.value)
                         if ds.labels_mask is not None else None)
+                fmask = (jnp.asarray(ds.features_mask.value)
+                         if ds.features_mask is not None else None)
                 key = get_random().next_key()
-                (self._params, self._states, self._updater_state,
-                 loss) = self._fit_step(self._params, self._states,
-                                        self._updater_state, x, y, mask, key,
-                                        jnp.asarray(self._iteration))
+                if tbptt and x.ndim == 3:
+                    loss = self._fit_tbptt(x, y, mask, fmask, key)
+                else:
+                    (self._params, self._states, self._updater_state,
+                     loss) = self._fit_step(self._params, self._states,
+                                            self._updater_state, x, y, mask,
+                                            key, jnp.asarray(self._iteration),
+                                            fmask)
                 self._iteration += 1
                 # device scalar; float() only on access (avoids per-step sync).
                 # Listeners get the device scalar too and sync only at their
@@ -276,13 +373,75 @@ class MultiLayerNetwork:
                 if hasattr(lst, "epoch_done"):
                     lst.epoch_done(self, self._epoch)
 
+    def _fit_tbptt(self, x, y, mask, fmask, key):
+        """Split [B, T, F] into tbptt_fwd_length segments, carrying recurrent
+        state across segments (gradient truncates at each boundary)."""
+        if self._tbptt_step is None:
+            self._tbptt_step = self._build_tbptt_step()
+        k = self.conf.tbptt_fwd_length
+        T = x.shape[1]
+        dtype = jnp.dtype(self.conf.global_conf.compute_dtype
+                          or self.conf.global_conf.dtype)
+        rnn = [l.init_rnn_state(x.shape[0], dtype) if l.is_rnn() else None
+               for l in self.layers]
+        loss = None
+        for s0 in range(0, T, k):
+            seg = slice(s0, min(s0 + k, T))
+            key, sub = jax.random.split(key)
+            (self._params, self._states, self._updater_state, rnn,
+             loss) = self._tbptt_step(
+                self._params, self._states, self._updater_state, rnn,
+                x[:, seg], y[:, seg] if y.ndim == 3 else y,
+                mask[:, seg] if mask is not None and mask.ndim >= 2 else mask,
+                sub, jnp.asarray(self._iteration),
+                fmask[:, seg] if fmask is not None else None)
+        return loss
+
+    # --- streaming inference (reference: MultiLayerNetwork.rnnTimeStep
+    # with its per-layer stateMap) ----------------------------------------
+    def rnn_time_step(self, x) -> NDArray:
+        """Forward [B, T, F] (or [B, F] for one step) continuing from the
+        stored recurrent state; updates the stored state."""
+        self._check_init()
+        xv = jnp.asarray(x.value if isinstance(x, NDArray) else x)
+        if xv.ndim == 2:
+            xv = xv[:, None, :]
+        dtype = jnp.dtype(self.conf.global_conf.dtype)
+        if self._rnn_state_map is None:
+            self._rnn_state_map = [
+                l.init_rnn_state(xv.shape[0], dtype) if l.is_rnn() else None
+                for l in self.layers]
+        cur = xv
+        rng = get_random().next_key()
+        for i, layer in enumerate(self.layers):
+            pre = self.conf.preprocessors.get(i)
+            if pre is not None:
+                cur = pre(cur)
+            rng, sub = jax.random.split(rng)
+            if layer.is_rnn():
+                cur, r, _ = layer.apply_rnn(self._params[i], cur,
+                                            self._rnn_state_map[i],
+                                            self._states[i], False, sub)
+                self._rnn_state_map[i] = r
+            else:
+                cur, _ = layer.apply(self._params[i], cur, self._states[i],
+                                     False, sub)
+        return NDArray(cur)
+
+    rnnTimeStep = rnn_time_step
+
+    def rnn_clear_previous_state(self) -> None:
+        self._rnn_state_map = None
+
+    rnnClearPreviousState = rnn_clear_previous_state
+
     # --- evaluation -------------------------------------------------------
     def evaluate(self, data, batch_size: Optional[int] = None):
         from ..eval.evaluation import Evaluation
 
         ev = Evaluation()
         for ds in _iter_data(data, batch_size):
-            out = self.output(ds.features)
+            out = self.output(ds.features, fmask=ds.features_mask)
             ev.eval(ds.labels.to_numpy(), out.to_numpy(),
                     ds.labels_mask.to_numpy() if ds.labels_mask is not None else None)
         return ev

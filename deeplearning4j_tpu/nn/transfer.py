@@ -1,0 +1,263 @@
+"""Transfer learning: fine-tune / freeze / re-head an existing network.
+
+Reference: dl4j-nn ``org.deeplearning4j.nn.transferlearning.
+{TransferLearning, FineTuneConfiguration, TransferLearningHelper}``
+(SURVEY.md §2.3): take a trained ``MultiLayerNetwork``, freeze the feature
+extractor, swap/replace the head, override training hyper-parameters, and
+keep every compatible weight.
+
+TPU shape: frozen layers are wrapped in ``FrozenLayer`` — ``stop_gradient``
+inside the ONE compiled train step, plus a post-updater restore, so frozen
+params take exactly zero update (including weight decay) with no second
+execution path. ``TransferLearningHelper`` gets the same shortcut the
+reference uses: featurize once through the frozen bottom, then fit only the
+unfrozen top on cached activations.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import DataSet
+from .conf import layers as L
+from .conf.builder import (GlobalConf, MultiLayerConfiguration,
+                           apply_layer_defaults)
+from .multilayer import MultiLayerNetwork
+
+
+class FineTuneConfiguration:
+    """Hyper-parameter overrides applied to the copied network's global
+    conf (reference: FineTuneConfiguration.Builder)."""
+
+    class Builder:
+        def __init__(self) -> None:
+            self._over = {}
+
+        def updater(self, u):
+            self._over["updater"] = u
+            return self
+
+        def seed(self, s: int):
+            self._over["seed"] = s
+            return self
+
+        def l1(self, v: float):
+            self._over["l1"] = v
+            return self
+
+        def l2(self, v: float):
+            self._over["l2"] = v
+            return self
+
+        def dropout(self, v: float):
+            self._over["dropout"] = v
+            return self
+
+        def activation(self, a: str):
+            self._over["activation"] = a
+            return self
+
+        def build(self) -> "FineTuneConfiguration":
+            return FineTuneConfiguration(self._over)
+
+    @staticmethod
+    def builder() -> "FineTuneConfiguration.Builder":
+        return FineTuneConfiguration.Builder()
+
+    def __init__(self, overrides: dict):
+        self.overrides = dict(overrides)
+
+    def apply_to(self, gc: GlobalConf) -> None:
+        for k, v in self.overrides.items():
+            setattr(gc, k, v)
+
+
+def _unwrap(layer: L.Layer) -> L.Layer:
+    return layer.layer if isinstance(layer, L.FrozenLayer) else layer
+
+
+class TransferLearning:
+    class Builder:
+        def __init__(self, model: MultiLayerNetwork):
+            model._check_init()
+            self._src = model
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._freeze_until: Optional[int] = None
+            self._n_out_replace = {}          # idx -> (n_out, weight_init)
+            self._remove_from = None          # keep layers [0, remove_from)
+            self._added: List[L.Layer] = []
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        def set_feature_extractor(self, layer_idx: int):
+            """Freeze layers 0..layer_idx inclusive (reference
+            setFeatureExtractor)."""
+            self._freeze_until = layer_idx
+            return self
+
+        def n_out_replace(self, layer_idx: int, n_out: int,
+                          weight_init: str = "xavier"):
+            """Change a layer's n_out and re-init it (+ the next layer's
+            n_in re-infers; reference nOutReplace)."""
+            self._n_out_replace[layer_idx] = (n_out, weight_init)
+            return self
+
+        def remove_output_layer(self):
+            return self.remove_layers_from_output(1)
+
+        def remove_layers_from_output(self, n: int):
+            cur = self._remove_from if self._remove_from is not None \
+                else len(self._src.layers)
+            self._remove_from = max(0, cur - n)
+            return self
+
+        def add_layer(self, layer: L.Layer):
+            self._added.append(layer)
+            return self
+
+        addLayer = add_layer
+
+        def build(self) -> MultiLayerNetwork:
+            src = self._src
+            keep_until = self._remove_from if self._remove_from is not None \
+                else len(src.layers)
+            new_layers: List[L.Layer] = []
+            reinit_idx = set()                 # new-net indices needing fresh params
+            for i, layer in enumerate(src.layers[:keep_until]):
+                lcopy = copy.deepcopy(_unwrap(layer))
+                if i in self._n_out_replace:
+                    n_out, wi = self._n_out_replace[i]
+                    if not hasattr(lcopy, "n_out"):
+                        raise ValueError(
+                            f"layer {i} ({type(lcopy).__name__}) has no n_out")
+                    lcopy.n_out = n_out
+                    lcopy.weight_init = wi
+                    reinit_idx.add(i)
+                    if i + 1 < keep_until:
+                        reinit_idx.add(i + 1)  # its n_in changes
+                if self._freeze_until is not None and i <= self._freeze_until:
+                    if i in reinit_idx:
+                        raise ValueError(
+                            f"layer {i} is both frozen and re-initialized")
+                    lcopy = L.FrozenLayer(layer=lcopy)
+                new_layers.append(lcopy)
+
+            gc = copy.deepcopy(src.conf.global_conf)
+            if self._fine_tune is not None:
+                self._fine_tune.apply_to(gc)
+            for layer in self._added:
+                apply_layer_defaults(layer, gc)
+                new_layers.append(layer)
+                reinit_idx.add(len(new_layers) - 1)
+
+            conf = MultiLayerConfiguration(gc, new_layers)
+            conf.backprop_type = src.conf.backprop_type
+            conf.tbptt_fwd_length = src.conf.tbptt_fwd_length
+            conf.tbptt_back_length = src.conf.tbptt_back_length
+            # n_in re-inference must start clean: deep-copied layers carry
+            # their old n_in, which set_input_type overwrites in order
+            conf.set_input_type(src.conf.input_type)
+            net = MultiLayerNetwork(conf).init(gc.seed)
+
+            # carry over weights for kept, un-reinitialized layers
+            for i in range(min(keep_until, len(new_layers))):
+                if i in reinit_idx:
+                    continue
+                src_p = src._params[i]
+                dst_p = net._params[i]
+                if {k: v.shape for k, v in src_p.items()} != \
+                        {k: v.shape for k, v in dst_p.items()}:
+                    raise ValueError(
+                        f"layer {i} shape mismatch carrying weights over: "
+                        f"{ {k: v.shape for k, v in src_p.items()} } vs "
+                        f"{ {k: v.shape for k, v in dst_p.items()} }")
+                net._params[i] = jax.tree.map(lambda a: a, src_p)
+                net._states[i] = jax.tree.map(lambda a: a, src._states[i])
+            return net
+
+    @staticmethod
+    def builder(model: MultiLayerNetwork) -> "TransferLearning.Builder":
+        return TransferLearning.Builder(model)
+
+
+class TransferLearningHelper:
+    """Featurize-once training for frozen-bottom networks (reference:
+    TransferLearningHelper.featurize / fitFeaturized)."""
+
+    def __init__(self, model: MultiLayerNetwork,
+                 frozen_until: Optional[int] = None):
+        model._check_init()
+        if frozen_until is None:
+            frozen = [i for i, l in enumerate(model.layers)
+                      if isinstance(l, L.FrozenLayer)]
+            if not frozen:
+                raise ValueError("model has no FrozenLayer layers; pass "
+                                 "frozen_until explicitly")
+            frozen_until = max(frozen)
+        self.frozen_until = frozen_until
+        self.model = model
+        self._featurize_fn = None
+
+    def featurize(self, ds: DataSet) -> DataSet:
+        """Run the frozen bottom once; result feeds fit_featurized."""
+        import jax
+
+        model = self.model
+        if self._featurize_fn is None:
+            def bottom(params, states, x, key):
+                params, x = model._cast_compute(params, x)
+                for i, layer in enumerate(
+                        model.layers[:self.frozen_until + 1]):
+                    pre = model.conf.preprocessors.get(i)
+                    if pre is not None:
+                        x = pre(x)
+                    key, sub = jax.random.split(key)
+                    x, _ = layer.apply(params[i], x, states[i], False, sub)
+                return x
+
+            self._featurize_fn = jax.jit(bottom)
+        feats = self._featurize_fn(model._params, model._states,
+                                   jnp.asarray(ds.features.value),
+                                   jax.random.PRNGKey(0))
+        return DataSet(np.asarray(feats), ds.labels,
+                       labels_mask=ds.labels_mask)
+
+    def fit_featurized(self, ds: DataSet, epochs: int = 1) -> None:
+        """Train ONLY the unfrozen top on featurized data (reference
+        fitFeaturized builds the same headless sub-network)."""
+        top = self._top_net()
+        top.fit(ds, epochs=epochs)
+        # write trained top params back into the full model
+        for j, i in enumerate(range(self.frozen_until + 1,
+                                    len(self.model.layers))):
+            self.model._params[i] = top._params[j]
+            self.model._states[i] = top._states[j]
+        self.model._fit_step = None
+        self.model._infer_fn = None
+
+    def _top_net(self) -> MultiLayerNetwork:
+        model = self.model
+        if getattr(self, "_top", None) is None:
+            gc = copy.deepcopy(model.conf.global_conf)
+            top_layers = [copy.deepcopy(_unwrap(l))
+                          for l in model.layers[self.frozen_until + 1:]]
+            conf = MultiLayerConfiguration(gc, top_layers)
+            conf.set_input_type(
+                model.conf.layer_output_types[self.frozen_until])
+            net = MultiLayerNetwork(conf).init(gc.seed)
+            for j, i in enumerate(range(self.frozen_until + 1,
+                                        len(model.layers))):
+                net._params[j] = jax.tree.map(lambda a: a, model._params[i])
+                net._states[j] = jax.tree.map(lambda a: a, model._states[i])
+            self._top = net
+        return self._top
+
+    def unfrozen_mln(self) -> MultiLayerNetwork:
+        return self._top_net()

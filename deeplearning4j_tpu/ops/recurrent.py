@@ -94,8 +94,11 @@ def gru_layer(x, w_ru, w_c, b_ru, b_c, h0=None, time_major: bool = False):
 
 
 @op("simple_rnn_layer", "recurrent")
-def simple_rnn_layer(x, w, rw, b, h0=None, time_major: bool = False):
-    """SimpleRnn: h_t = tanh(x_t W + h_{t-1} R + b)."""
+def simple_rnn_layer(x, w, rw, b, h0=None, time_major: bool = False,
+                     activation=jnp.tanh):
+    """SimpleRnn: h_t = act(x_t W + h_{t-1} R + b); act defaults to tanh
+    (the reference's SimpleRnn applies its CONFIGURED activation inside the
+    recurrence, so the layer passes it through)."""
     if not time_major:
         x = jnp.swapaxes(x, 0, 1)
     t, bsz, _ = x.shape
@@ -103,7 +106,7 @@ def simple_rnn_layer(x, w, rw, b, h0=None, time_major: bool = False):
     h = h0 if h0 is not None else jnp.zeros((bsz, n_out), dtype=x.dtype)
 
     def step(h, xt):
-        h = jnp.tanh(xt @ w + h @ rw + b)
+        h = activation(xt @ w + h @ rw + b)
         return h, h
 
     h_t, ys = lax.scan(step, h, x)

@@ -1,3 +1,10 @@
 from .listeners import (TrainingListener, ScoreIterationListener, PerformanceListener,
                         EvaluativeListener, CheckpointListener, TimeIterationListener,
                         CollectScoresIterationListener)
+from .earlystopping import (EarlyStoppingConfiguration, EarlyStoppingResult,
+                            EarlyStoppingTrainer, MaxEpochsTerminationCondition,
+                            ScoreImprovementEpochTerminationCondition,
+                            MaxScoreIterationTerminationCondition,
+                            MaxTimeIterationTerminationCondition,
+                            DataSetLossCalculator, InMemoryModelSaver,
+                            LocalFileModelSaver)
