@@ -17,7 +17,8 @@ Layer map (≈ SURVEY.md §1):
   imports/    TF frozen-GraphDef → SameDiff, Keras h5   (ref: dl4j-modelimport,
               → MultiLayerNetwork                        samediff-import)
   eval/       Evaluation / ROC / RegressionEvaluation   (ref: nd4j evaluation)
-  optimize/   training listeners                        (ref: dl4j optimize)
+  optimize/   training listeners, early stopping        (ref: dl4j optimize,
+                                                         dl4j earlystopping)
   nlp/        Word2Vec / ParagraphVectors / vocab / serde (ref: dl4j-nlp)
 """
 

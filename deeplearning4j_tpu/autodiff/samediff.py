@@ -43,7 +43,8 @@ from ..learning.schedules import ISchedule
 from ..learning.updaters import Adam, GradientUpdater
 from ..ops.registry import all_ops, get_op
 
-_FORMAT_VERSION = 1
+# v2: control-flow nodes ("control" key) + scope-prefixed npz array keys
+_FORMAT_VERSION = 2
 
 
 class VariableType:
@@ -77,6 +78,12 @@ class _Node:
     # entries (shape tuples, axis ints) stay Python values so they remain
     # jit-static; None means every positional is a variable (legacy).
     arg_spec: Optional[List[Tuple[str, Any]]] = None
+    # Structured control flow (op_name "__cond__"/"__while__"): nested
+    # SameDiff graphs per branch + their placeholder/output name lists.
+    subgraphs: Optional[Dict[str, "SameDiff"]] = None
+    sub_inputs: Optional[Dict[str, List[str]]] = None
+    sub_outputs: Optional[Dict[str, List[str]]] = None
+    max_iters: Optional[int] = None
 
 
 class SDVariable:
@@ -408,6 +415,96 @@ class SameDiff:
         outs = tuple(SDVariable(self, o) for o in out_names)
         return outs if n_out > 1 else outs[0]
 
+    # --- structured control flow (reference: SameDiff.ifCond/whileLoop;
+    # the TF1 Enter/Exit/Merge frame machinery of AbstractSession is NOT
+    # reproduced — XLA requires structured control flow, so these lower to
+    # lax.cond / lax.while_loop / lax.scan) -------------------------------
+    def _build_branch(self, fn: Callable, n_args: int, tag: str):
+        """Trace a branch body into a NESTED SameDiff whose placeholders are
+        the branch arguments. Branch bodies see ONLY their operands (pass
+        outer variables explicitly) — a closure over outer graph variables
+        raises inside the body when it touches an unknown name."""
+        sub = SameDiff()
+        phs = [sub.placeholder(f"{tag}_arg{i}") for i in range(n_args)]
+        out = fn(sub, *phs)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        for o in outs:
+            if o.sd is not sub:
+                raise ValueError(
+                    f"{tag} body must return variables built in its own "
+                    "scope (got one from the outer graph)")
+        return sub, [p.name for p in phs], [o.name for o in outs]
+
+    def _add_control(self, kind: str, inputs: List[SDVariable],
+                     subgraphs, sub_inputs, sub_outputs, n_out: int,
+                     name: Optional[str], max_iters: Optional[int] = None):
+        nid = len(self._nodes)
+        base = name or kind.strip("_")
+        out_names = [self._unique(base if i == 0 else f"{base}:{i}")
+                     for i in range(n_out)]
+        node = _Node(nid, kind, [v.name for v in inputs], {}, out_names,
+                     n_out, subgraphs=subgraphs, sub_inputs=sub_inputs,
+                     sub_outputs=sub_outputs, max_iters=max_iters)
+        self._nodes.append(node)
+        for i, out in enumerate(out_names):
+            self._vars[out] = _Var(out, VariableType.ARRAY, producer=nid,
+                                   out_index=i)
+        self._fn_cache.clear()
+        outs = tuple(SDVariable(self, o) for o in out_names)
+        return outs if n_out > 1 else outs[0]
+
+    def cond(self, pred: SDVariable, true_fn: Callable, false_fn: Callable,
+             *operands: SDVariable, name: Optional[str] = None):
+        """``lax.cond`` over two traced branch bodies.
+
+        ``true_fn(sub_sd, *args)`` / ``false_fn(sub_sd, *args)`` build their
+        result from the given operands; both must return the same number of
+        outputs. Differentiable — a graph containing ``cond`` trains.
+        """
+        pred = self._lift(pred)
+        ops = [self._lift(o) for o in operands]
+        sub_t, in_t, out_t = self._build_branch(true_fn, len(ops), "true")
+        sub_f, in_f, out_f = self._build_branch(false_fn, len(ops), "false")
+        if len(out_t) != len(out_f):
+            raise ValueError(
+                f"branches return different arity: {len(out_t)} vs "
+                f"{len(out_f)}")
+        return self._add_control(
+            "__cond__", [pred] + ops,
+            {"true": sub_t, "false": sub_f},
+            {"true": in_t, "false": in_f},
+            {"true": out_t, "false": out_f}, len(out_t), name)
+
+    ifCond = cond
+
+    def while_loop(self, cond_fn: Callable, body_fn: Callable,
+                   *loop_vars: SDVariable, max_iters: Optional[int] = None,
+                   name: Optional[str] = None):
+        """``lax.while_loop`` over traced cond/body graphs.
+
+        ``cond_fn(sub_sd, *vars) -> scalar bool``; ``body_fn(sub_sd, *vars)``
+        returns the updated loop vars (same arity). Unbounded loops are
+        forward-only (XLA's while has no reverse-mode rule); pass
+        ``max_iters`` to lower to a masked ``lax.scan`` of fixed length,
+        which IS differentiable and therefore trainable.
+        """
+        ops = [self._lift(v) for v in loop_vars]
+        sub_c, in_c, out_c = self._build_branch(cond_fn, len(ops), "cond")
+        if len(out_c) != 1:
+            raise ValueError("cond_fn must return exactly one scalar")
+        sub_b, in_b, out_b = self._build_branch(body_fn, len(ops), "body")
+        if len(out_b) != len(ops):
+            raise ValueError(
+                f"body_fn must return {len(ops)} loop vars, got {len(out_b)}")
+        return self._add_control(
+            "__while__", ops,
+            {"cond": sub_c, "body": sub_b},
+            {"cond": in_c, "body": in_b},
+            {"cond": out_c, "body": out_b}, len(ops), name,
+            max_iters=max_iters)
+
+    whileLoop = while_loop
+
     # --- lowering: DAG → one jax function -------------------------------
     def _topo_for(self, outputs: Sequence[str]) -> List[_Node]:
         needed: List[_Node] = []
@@ -444,6 +541,15 @@ class SameDiff:
             env.update(placeholders)
             key = rng_key
             for node in nodes:
+                if node.op_name in ("__cond__", "__while__"):
+                    key, sub = jax.random.split(key)
+                    res = _lower_control(node, env, training, sub)
+                    if node.n_outputs > 1:
+                        for out_name, r in zip(node.outputs, res):
+                            env[out_name] = r
+                    else:
+                        env[node.outputs[0]] = res[0]
+                    continue
                 desc = get_op(node.op_name)
                 if node.arg_spec is not None:
                     args = [env[v] if kind == "v" else v
@@ -660,27 +766,14 @@ class SameDiff:
         container is a versioned zip with the same content inventory:
         variables, op graph, training config, optional updater state.
         """
-        graph = {
-            "format_version": _FORMAT_VERSION,
-            "variables": [
-                {"name": v.name, "type": v.vtype, "shape": v.shape,
-                 "dtype": v.dtype, "producer": v.producer, "out_index": v.out_index}
-                for v in self._vars.values()
-            ],
-            "nodes": [
-                {"id": n.id, "op": n.op_name, "inputs": n.inputs,
-                 "kwargs": _jsonify(n.kwargs), "outputs": n.outputs,
-                 "n_outputs": n.n_outputs,
-                 "arg_spec": [[k, _jsonify({"v": v})["v"]] for k, v in n.arg_spec]
-                 if n.arg_spec is not None else None}
-                for n in self._nodes
-            ],
+        arrays: Dict[str, np.ndarray] = {}
+        graph = self._graph_dict(arrays, "")
+        graph.update({
             "loss_var": self._loss_var,
             "iteration": self._iteration,
             "epoch": self._epoch,
             "training_config": self._training_config.to_json() if self._training_config else None,
-        }
-        arrays = {n: v.value for n, v in self._vars.items() if v.value is not None}
+        })
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
             zf.writestr("graph.json", json.dumps(graph))
             buf = io.BytesIO()
@@ -692,28 +785,89 @@ class SameDiff:
                 np.savez(buf2, **{str(i): np.asarray(a) for i, a in enumerate(flat)})
                 zf.writestr("updater.npz", buf2.getvalue())
 
+    def _graph_dict(self, arrays: Dict[str, np.ndarray],
+                    prefix: str) -> Dict[str, Any]:
+        """JSON-able graph structure; arrays collected into ``arrays`` under
+        ``prefix`` (nested control-flow subgraphs recurse with a deeper
+        prefix so one flat npz holds every scope's tensors)."""
+        for n, v in self._vars.items():
+            if v.value is not None:
+                arrays[prefix + n] = v.value
+        nodes = []
+        for n in self._nodes:
+            d = {"id": n.id, "op": n.op_name, "inputs": n.inputs,
+                 "kwargs": _jsonify(n.kwargs), "outputs": n.outputs,
+                 "n_outputs": n.n_outputs,
+                 "arg_spec": [[k, _jsonify({"v": v})["v"]] for k, v in n.arg_spec]
+                 if n.arg_spec is not None else None}
+            if n.subgraphs is not None:
+                d["control"] = {
+                    "max_iters": n.max_iters,
+                    "sub_inputs": n.sub_inputs,
+                    "sub_outputs": n.sub_outputs,
+                    "branches": {
+                        tag: sub._graph_dict(arrays,
+                                             f"{prefix}n{n.id}.{tag}/")
+                        for tag, sub in n.subgraphs.items()},
+                }
+            nodes.append(d)
+        return {
+            "format_version": _FORMAT_VERSION,
+            "variables": [
+                {"name": v.name, "type": v.vtype, "shape": v.shape,
+                 "dtype": v.dtype, "producer": v.producer, "out_index": v.out_index}
+                for v in self._vars.values()
+            ],
+            "nodes": nodes,
+        }
+
+    @staticmethod
+    def _from_graph_dict(graph: Dict[str, Any], arrays,
+                         prefix: str) -> "SameDiff":
+        sd = SameDiff()
+        for v in graph["variables"]:
+            pname = prefix + v["name"]
+            sd._vars[v["name"]] = _Var(
+                v["name"], v["type"],
+                tuple(v["shape"]) if v["shape"] else None, v["dtype"],
+                arrays[pname] if pname in arrays else None,
+                v["producer"], v["out_index"])
+        for n in graph["nodes"]:
+            spec = n.get("arg_spec")
+            ctl = n.get("control")
+            # JSON turns kwarg tuples into lists; ops normalize internally.
+            needs_rng = False
+            if not n["op"].startswith("__"):
+                # recompute exactly as _add_op does — the flag is derived
+                # state, so serializing it would just invite skew
+                desc = get_op(n["op"])
+                needs_rng = desc.family == "random" or n["op"] in (
+                    "dropout", "alpha_dropout", "gaussian_dropout",
+                    "gaussian_noise")
+            node = _Node(
+                n["id"], n["op"], n["inputs"], n["kwargs"],
+                n["outputs"], n["n_outputs"], needs_rng=needs_rng,
+                arg_spec=[(k, tuple(v) if isinstance(v, list) and k == "s" else v)
+                          for k, v in spec] if spec is not None else None)
+            if ctl is not None:
+                node.max_iters = ctl.get("max_iters")
+                node.sub_inputs = ctl["sub_inputs"]
+                node.sub_outputs = ctl["sub_outputs"]
+                node.subgraphs = {
+                    tag: SameDiff._from_graph_dict(
+                        sub, arrays, f"{prefix}n{n['id']}.{tag}/")
+                    for tag, sub in ctl["branches"].items()}
+            sd._nodes.append(node)
+        return sd
+
     @staticmethod
     def load(path: str) -> "SameDiff":
-        sd = SameDiff()
         with zipfile.ZipFile(path) as zf:
             graph = json.loads(zf.read("graph.json"))
             arrays = np.load(io.BytesIO(zf.read("vars.npz")))
             if graph["format_version"] > _FORMAT_VERSION:
                 raise ValueError("file written by a newer format version")
-            for v in graph["variables"]:
-                sd._vars[v["name"]] = _Var(
-                    v["name"], v["type"],
-                    tuple(v["shape"]) if v["shape"] else None, v["dtype"],
-                    arrays[v["name"]] if v["name"] in arrays else None,
-                    v["producer"], v["out_index"])
-            for n in graph["nodes"]:
-                spec = n.get("arg_spec")
-                # JSON turns kwarg tuples into lists; ops normalize internally.
-                sd._nodes.append(_Node(
-                    n["id"], n["op"], n["inputs"], n["kwargs"],
-                    n["outputs"], n["n_outputs"],
-                    arg_spec=[(k, tuple(v) if isinstance(v, list) and k == "s" else v)
-                              for k, v in spec] if spec is not None else None))
+            sd = SameDiff._from_graph_dict(graph, arrays, "")
             sd._loss_var = graph.get("loss_var")
             sd._iteration = graph.get("iteration", 0)
             sd._epoch = graph.get("epoch", 0)
@@ -790,6 +944,68 @@ _N_OUTPUTS = {
 
 # train-only stochastic ops that become identity at inference
 _TRAIN_ONLY_IDENTITY = {"dropout", "alpha_dropout", "gaussian_dropout", "gaussian_noise"}
+
+
+def _lower_control(node: "_Node", env: Dict[str, Any], training: bool, key):
+    """Lower a __cond__/__while__ node to lax control flow. Branch bodies
+    are nested SameDiff graphs executed via their own _make_fn — the whole
+    construct still traces into the ONE enclosing XLA module."""
+    from jax import lax
+
+    def branch_fn(tag: str):
+        sub = node.subgraphs[tag]
+        outs = tuple(node.sub_outputs[tag])
+        fn = sub._make_fn(outs, training)
+        names = node.sub_inputs[tag]
+
+        def run(args, k):
+            return fn(sub._params(), dict(zip(names, args)), k)
+
+        return run
+
+    if node.op_name == "__cond__":
+        pred = env[node.inputs[0]]
+        args = tuple(env[n] for n in node.inputs[1:])
+        tb, fb = branch_fn("true"), branch_fn("false")
+        return lax.cond(jnp.asarray(pred).astype(bool).reshape(()),
+                        lambda a: tb(a, key), lambda a: fb(a, key), args)
+
+    # __while__ — the rng key rides the loop carry and splits per iteration
+    # so random ops in the body draw FRESH values each step
+    cond_run = branch_fn("cond")
+    body_run = branch_fn("body")
+    args = tuple(env[n] for n in node.inputs)
+
+    def cond_scalar(vs, k):
+        return jnp.asarray(cond_run(vs, k)[0]).astype(bool).reshape(())
+
+    if node.max_iters is None:
+        # exact while semantics; forward-only (no reverse-mode rule in XLA)
+        def wcond(carry):
+            vs, k = carry
+            return cond_scalar(vs, k)
+
+        def wbody(carry):
+            vs, k = carry
+            k, sub = jax.random.split(k)
+            return body_run(vs, sub), k
+
+        final, _ = lax.while_loop(wcond, wbody, (args, key))
+        return final
+
+    # bounded, DIFFERENTIABLE form: fixed-length scan, iterations after the
+    # condition first fails hold their values (masked update)
+    def scan_step(carry, _):
+        vs, k = carry
+        k, sub = jax.random.split(k)
+        go = cond_scalar(vs, sub)
+        new_vs = body_run(vs, sub)
+        held = tuple(jnp.where(go, nv, v) for v, nv in zip(vs, new_vs))
+        return (held, k), None
+
+    (final, _), _ = lax.scan(scan_step, (args, key), None,
+                             length=node.max_iters)
+    return final
 
 
 def _initialize(shape: Tuple[int, ...], init: str, dtype: str) -> np.ndarray:

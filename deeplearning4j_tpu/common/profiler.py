@@ -1,0 +1,100 @@
+"""OpProfiler-shaped profiling front (SURVEY §5.1).
+
+Reference: nd4j ``OpProfiler`` (per-op timing aggregation, NAN_PANIC mode)
+and ``PerformanceTracker`` (bandwidth numbers). On this stack the per-op
+dimension lives inside XLA, so the device-side story is a trace: ``start()``/
+``stop()`` (or ``with trace(logdir)``) drive ``jax.profiler`` and produce a
+TensorBoard-loadable trace of every kernel. The host-side section API
+(``time_section``) aggregates wall times by name — the analog of the
+reference's per-op counters for the Python orchestration layer.
+
+NAN_PANIC itself is ``Environment.get().set_check_nan(True)`` →
+``jax_debug_nans`` (§5.1's named toggle).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Optional
+
+
+class OpProfiler:
+    _instance: Optional["OpProfiler"] = None
+    _lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._trace_dir: Optional[str] = None
+        self._sections: Dict[str, Dict[str, float]] = {}
+
+    @classmethod
+    def get(cls) -> "OpProfiler":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    # --- device trace (jax.profiler → TensorBoard trace viewer) ---------
+    def start(self, logdir: str) -> None:
+        import jax
+
+        if self._trace_dir is not None:
+            raise RuntimeError("profiler already tracing")
+        jax.profiler.start_trace(logdir)
+        self._trace_dir = logdir
+        from .environment import Environment
+
+        Environment.get().set_profiling(True)
+
+    def stop(self) -> None:
+        import jax
+
+        if self._trace_dir is None:
+            return
+        jax.profiler.stop_trace()
+        self._trace_dir = None
+        from .environment import Environment
+
+        Environment.get().set_profiling(False)
+
+    @contextlib.contextmanager
+    def trace(self, logdir: str):
+        self.start(logdir)
+        try:
+            yield self
+        finally:
+            self.stop()
+
+    # --- host-side section counters (OpProfiler counter analog) ---------
+    @contextlib.contextmanager
+    def time_section(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            s = self._sections.setdefault(
+                name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+            s["count"] += 1
+            s["total_s"] += dt
+            s["max_s"] = max(s["max_s"], dt)
+
+    def get_statistics(self) -> Dict[str, Dict[str, float]]:
+        return {k: dict(v) for k, v in self._sections.items()}
+
+    def print_statistics(self) -> str:
+        lines = [f"{'section':<32}{'count':>8}{'total ms':>12}"
+                 f"{'mean ms':>12}{'max ms':>12}"]
+        for name, s in sorted(self._sections.items(),
+                              key=lambda kv: -kv[1]["total_s"]):
+            mean = s["total_s"] / max(s["count"], 1)
+            lines.append(f"{name:<32}{s['count']:>8}"
+                         f"{s['total_s'] * 1e3:>12.2f}"
+                         f"{mean * 1e3:>12.2f}{s['max_s'] * 1e3:>12.2f}")
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+    def reset(self) -> None:
+        self._sections.clear()

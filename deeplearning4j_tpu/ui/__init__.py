@@ -1,0 +1,18 @@
+"""Training UI / metrics bus (reference: deeplearning4j-ui, SURVEY §5.5).
+
+The reference ships a Play webserver fed by StatsListener→StatsStorage; the
+TPU stack's dashboard is TensorBoard — ``StatsListener`` routes the same
+metrics into event files (``TensorBoardStatsStorage``), an in-memory store
+for programmatic queries, or JSONL. Device-side kernel traces come from
+``common.profiler.OpProfiler`` (jax.profiler → TensorBoard trace viewer).
+"""
+
+from .stats import (FileStatsStorage, InMemoryStatsStorage, StatsListener,
+                    StatsStorage, TensorBoardStatsStorage)
+from .tensorboard import TensorBoardEventWriter, read_scalar_events
+
+__all__ = [
+    "FileStatsStorage", "InMemoryStatsStorage", "StatsListener",
+    "StatsStorage", "TensorBoardStatsStorage", "TensorBoardEventWriter",
+    "read_scalar_events",
+]

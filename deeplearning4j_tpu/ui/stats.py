@@ -1,0 +1,139 @@
+"""StatsListener → StatsStorage: the training metrics bus.
+
+Reference: deeplearning4j-ui ``org.deeplearning4j.ui.model.stats.StatsListener``
+→ ``StatsStorage`` (InMemoryStatsStorage / FileStatsStorage) → Play UI
+(SURVEY.md §2.3 Training UI row, §5.5). The reference streams score, update:
+parameter ratios, per-layer param/gradient/update histograms, memory and
+timing into a storage SPI the UI polls.
+
+TPU shape: the listener receives the DEVICE loss scalar from the fit loop
+(multilayer.py contract — listeners must not force a per-iteration sync) and
+reads it back only every ``collect_every_n`` iterations, batching one device
+sync with the (host-side) param-norm computation. Storage backends:
+in-memory (queryable), JSONL file, and TensorBoard event files — the
+dashboard story is "point TensorBoard at the logdir" instead of the
+reference's bundled Play webserver.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..optimize.listeners import TrainingListener
+from .tensorboard import TensorBoardEventWriter
+
+
+class StatsStorage:
+    """SPI (reference: StatsStorage / StatsStorageRouter)."""
+
+    def put_scalar(self, session: str, tag: str, step: int,
+                   value: float) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryStatsStorage(StatsStorage):
+    def __init__(self) -> None:
+        self.records: List[Dict[str, Any]] = []
+
+    def put_scalar(self, session, tag, step, value):
+        self.records.append({"session": session, "tag": tag, "step": step,
+                             "value": float(value), "time": time.time()})
+
+    # -- queries (reference: StatsStorage.getAllUpdatesAfter etc.) -------
+    def tags(self) -> List[str]:
+        return sorted({r["tag"] for r in self.records})
+
+    def series(self, tag: str) -> List[tuple]:
+        return [(r["step"], r["value"]) for r in self.records
+                if r["tag"] == tag]
+
+
+class FileStatsStorage(StatsStorage):
+    """Append-only JSONL (reference: FileStatsStorage's MapDB file)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "a")
+
+    def put_scalar(self, session, tag, step, value):
+        self._f.write(json.dumps({"session": session, "tag": tag,
+                                  "step": step, "value": float(value),
+                                  "time": time.time()}) + "\n")
+
+    def close(self):
+        self._f.close()
+
+    @staticmethod
+    def read(path: str) -> List[Dict[str, Any]]:
+        with open(path) as f:
+            return [json.loads(l) for l in f if l.strip()]
+
+
+class TensorBoardStatsStorage(StatsStorage):
+    """Scalars as TensorBoard events — `tensorboard --logdir` IS the
+    training UI (SURVEY §5.5's named equivalent)."""
+
+    def __init__(self, logdir: str):
+        self._writer = TensorBoardEventWriter(logdir)
+
+    def put_scalar(self, session, tag, step, value):
+        self._writer.add_scalar(f"{session}/{tag}" if session else tag,
+                                value, step)
+        self._writer.flush()
+
+    def close(self):
+        self._writer.close()
+
+
+class StatsListener(TrainingListener):
+    """Collect score + per-layer parameter/update statistics every N
+    iterations into a StatsStorage (reference: StatsListener with its
+    reportingFrequency)."""
+
+    def __init__(self, storage: StatsStorage, collect_every_n: int = 10,
+                 session_id: str = "", collect_param_norms: bool = True,
+                 collect_timing: bool = True):
+        self.storage = storage
+        self.every = max(1, collect_every_n)
+        self.session = session_id
+        self.collect_param_norms = collect_param_norms
+        self.collect_timing = collect_timing
+        self._last_time: Optional[float] = None
+
+    def iteration_done(self, model, iteration: int, score) -> None:
+        if iteration % self.every:
+            return
+        # ONE device sync per collection window, not per iteration
+        self.storage.put_scalar(self.session, "score", iteration,
+                                float(score))
+        if self.collect_timing:
+            now = time.perf_counter()
+            if self._last_time is not None:
+                per_iter = (now - self._last_time) / self.every
+                self.storage.put_scalar(self.session, "iteration_ms",
+                                        iteration, per_iter * 1e3)
+            self._last_time = now
+        if self.collect_param_norms:
+            params = getattr(model, "_params", None)
+            # MultiLayerNetwork keeps a per-layer param list; SameDiff's
+            # _params is a METHOD returning {name: array} — support both
+            if callable(params):
+                params = [params()]
+            if not isinstance(params, (list, tuple)):
+                params = []
+            for i, lp in enumerate(params):
+                for name, w in lp.items():
+                    arr = np.asarray(w)
+                    self.storage.put_scalar(
+                        self.session, f"param_mean_magnitude/{i}_{name}",
+                        iteration, float(np.mean(np.abs(arr))))
+
+    def epoch_done(self, model, epoch: int) -> None:
+        self.storage.put_scalar(self.session, "epoch", epoch, epoch)

@@ -1,0 +1,226 @@
+"""TensorBoard event-file writer — no tensorflow import required.
+
+SURVEY §5.5 names TensorBoard events as the TPU-stack equivalent of the
+reference's Training UI wire (StatsListener → StatsStorage → Play UI). This
+module writes scalar summaries in the standard ``tfevents`` TFRecord format
+(public, stable format: length-prefixed records with masked CRC32C, protobuf
+``Event``/``Summary`` payloads hand-encoded below — only the three scalar
+fields are needed, so a protobuf dependency would be overkill and a
+tensorflow import costs ~10 s of startup).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+from typing import Optional
+
+# --- CRC32C (Castagnoli), table-driven --------------------------------------
+
+def _build_crc_table():
+    poly = 0x82F63B78
+    table = []
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        table.append(crc)
+    return table
+
+
+# built eagerly at import: a lazy build racing across writer threads could
+# interleave appends and corrupt every CRC for the process lifetime
+_CRC_TABLE = _build_crc_table()
+
+
+def _crc32c(data: bytes) -> int:
+    table = _CRC_TABLE
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+# --- minimal protobuf encoding ----------------------------------------------
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field_bytes(num: int, payload: bytes) -> bytes:
+    return _varint((num << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _field_double(num: int, v: float) -> bytes:
+    return _varint((num << 3) | 1) + struct.pack("<d", v)
+
+
+def _field_float(num: int, v: float) -> bytes:
+    return _varint((num << 3) | 5) + struct.pack("<f", v)
+
+
+def _field_varint(num: int, v: int) -> bytes:
+    return _varint(num << 3) + _varint(v)
+
+
+def _event(wall_time: float, step: Optional[int] = None,
+           file_version: Optional[str] = None,
+           summary: Optional[bytes] = None) -> bytes:
+    out = _field_double(1, wall_time)
+    if step is not None:
+        out += _field_varint(2, step)
+    if file_version is not None:
+        out += _field_bytes(3, file_version.encode())
+    if summary is not None:
+        out += _field_bytes(5, summary)
+    return out
+
+
+def _scalar_summary(tag: str, value: float) -> bytes:
+    val = _field_bytes(1, tag.encode()) + _field_float(2, float(value))
+    return _field_bytes(1, val)
+
+
+class TensorBoardEventWriter:
+    """Append scalar events to a ``tfevents`` file under ``logdir``
+    (one file per writer, standard naming so TensorBoard discovers it)."""
+
+    def __init__(self, logdir: str):
+        os.makedirs(logdir, exist_ok=True)
+        fname = (f"events.out.tfevents.{int(time.time())}."
+                 f"{socket.gethostname()}")
+        self.path = os.path.join(logdir, fname)
+        self._f = open(self.path, "ab")
+        self._write_record(_event(time.time(),
+                                  file_version="brain.Event:2"))
+
+    def _write_record(self, payload: bytes) -> None:
+        header = struct.pack("<Q", len(payload))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(payload)
+        self._f.write(struct.pack("<I", _masked_crc(payload)))
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        self._write_record(_event(time.time(), step=step,
+                                  summary=_scalar_summary(tag, value)))
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        self.flush()
+        self._f.close()
+
+
+def read_scalar_events(path: str):
+    """Parse a tfevents file back into [(step, tag, value)] — used by tests
+    to prove the files are well-formed (record framing + CRCs verified)."""
+    out = []
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                break
+            (length,) = struct.unpack("<Q", header)
+            (hcrc,) = struct.unpack("<I", f.read(4))
+            if hcrc != _masked_crc(header):
+                raise ValueError("corrupt header CRC")
+            payload = f.read(length)
+            (pcrc,) = struct.unpack("<I", f.read(4))
+            if pcrc != _masked_crc(payload):
+                raise ValueError("corrupt payload CRC")
+            out.extend(_parse_event(payload))
+    return out
+
+
+def _read_varint(buf: bytes, i: int):
+    shift = n = 0
+    while True:
+        b = buf[i]
+        i += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, i
+        shift += 7
+
+
+def _parse_event(buf: bytes):
+    i = 0
+    step = 0
+    values = []
+    while i < len(buf):
+        key, i = _read_varint(buf, i)
+        num, wire = key >> 3, key & 7
+        if wire == 1:
+            i += 8
+        elif wire == 5:
+            i += 4
+        elif wire == 0:
+            v, i = _read_varint(buf, i)
+            if num == 2:
+                step = v
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            chunk = buf[i:i + ln]
+            i += ln
+            if num == 5:  # summary
+                values.extend(_parse_summary(chunk))
+    return [(step, tag, val) for tag, val in values]
+
+
+def _parse_summary(buf: bytes):
+    i = 0
+    out = []
+    while i < len(buf):
+        key, i = _read_varint(buf, i)
+        num, wire = key >> 3, key & 7
+        if wire == 2:
+            ln, i = _read_varint(buf, i)
+            if num == 1:  # Value
+                out.append(_parse_value(buf[i:i + ln]))
+            i += ln
+        elif wire == 5:
+            i += 4
+        elif wire == 1:
+            i += 8
+        else:
+            _, i = _read_varint(buf, i)
+    return out
+
+
+def _parse_value(buf: bytes):
+    i = 0
+    tag, val = "", float("nan")
+    while i < len(buf):
+        key, i = _read_varint(buf, i)
+        num, wire = key >> 3, key & 7
+        if wire == 2:
+            ln, i = _read_varint(buf, i)
+            if num == 1:
+                tag = buf[i:i + ln].decode()
+            i += ln
+        elif wire == 5:
+            if num == 2:
+                (val,) = struct.unpack("<f", buf[i:i + 4])
+            i += 4
+        elif wire == 1:
+            i += 8
+        else:
+            _, i = _read_varint(buf, i)
+    return tag, val
