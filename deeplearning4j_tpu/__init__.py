@@ -20,6 +20,9 @@ Layer map (≈ SURVEY.md §1):
   optimize/   training listeners, early stopping        (ref: dl4j optimize,
                                                          dl4j earlystopping)
   nlp/        Word2Vec / ParagraphVectors / vocab / serde (ref: dl4j-nlp)
+  rl/         DQN / replay / policies / MDP envs        (ref: rl4j)
+  ui/         StatsListener -> TensorBoard events       (ref: dl4j-ui)
+  native/     C++ host-ETL hot loops via ctypes         (ref: libnd4j CPU helpers)
 """
 
 import jax as _jax

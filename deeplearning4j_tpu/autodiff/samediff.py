@@ -979,16 +979,25 @@ def _lower_control(node: "_Node", env: Dict[str, Any], training: bool, key):
     def cond_scalar(vs, k):
         return jnp.asarray(cond_run(vs, k)[0]).astype(bool).reshape(())
 
+    # rng scheme (IDENTICAL for both lowerings so bounded and unbounded
+    # runs are statistically equivalent): per iteration, the carried key
+    # derives DISTINCT cond and body streams, then advances
+    def iter_keys(k):
+        kc = jax.random.fold_in(k, 1)
+        kb = jax.random.fold_in(k, 2)
+        return kc, kb, jax.random.fold_in(k, 0)
+
     if node.max_iters is None:
         # exact while semantics; forward-only (no reverse-mode rule in XLA)
         def wcond(carry):
             vs, k = carry
-            return cond_scalar(vs, k)
+            kc, _, _ = iter_keys(k)
+            return cond_scalar(vs, kc)
 
         def wbody(carry):
             vs, k = carry
-            k, sub = jax.random.split(k)
-            return body_run(vs, sub), k
+            _, kb, k_next = iter_keys(k)
+            return body_run(vs, kb), k_next
 
         final, _ = lax.while_loop(wcond, wbody, (args, key))
         return final
@@ -997,11 +1006,11 @@ def _lower_control(node: "_Node", env: Dict[str, Any], training: bool, key):
     # condition first fails hold their values (masked update)
     def scan_step(carry, _):
         vs, k = carry
-        k, sub = jax.random.split(k)
-        go = cond_scalar(vs, sub)
-        new_vs = body_run(vs, sub)
+        kc, kb, k_next = iter_keys(k)
+        go = cond_scalar(vs, kc)
+        new_vs = body_run(vs, kb)
         held = tuple(jnp.where(go, nv, v) for v, nv in zip(vs, new_vs))
-        return (held, k), None
+        return (held, k_next), None
 
     (final, _), _ = lax.scan(scan_step, (args, key), None,
                              length=node.max_iters)

@@ -1,1 +1,2 @@
-from .evaluation import Evaluation, EvaluationBinary, ROC, ROCMultiClass, RegressionEvaluation
+from .evaluation import (Evaluation, EvaluationBinary, EvaluationCalibration,
+                         ROC, ROCBinary, ROCMultiClass, RegressionEvaluation)

@@ -1,2 +1,3 @@
-from .zoo import (AlexNet, Darknet19, LeNet, ResNet50, SimpleCNN, SqueezeNet,
-                  TextGenerationLSTM, UNet, VGG16, VGG19, ZooModel)
+from .zoo import (AlexNet, Darknet19, InceptionResNetV1, LeNet, ResNet50,
+                  SimpleCNN, SqueezeNet, TextGenerationLSTM, TinyYOLO, UNet,
+                  VGG16, VGG19, Xception, YOLO2, ZooModel)

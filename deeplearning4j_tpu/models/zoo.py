@@ -403,3 +403,304 @@ class TextGenerationLSTM(ZooModel):
                 .set_input_type(InputType.recurrent(self.vocab_size))
                 .build())
         return MultiLayerNetwork(conf).init()
+
+
+class TinyYOLO(ZooModel):
+    """reference zoo.model.TinyYOLO: darknet-tiny conv/bn/leaky backbone +
+    YOLOv2 detection head (reference anchors, VOC-style defaults)."""
+
+    ANCHORS = ((1.08, 1.19), (3.42, 4.41), (6.63, 11.38), (9.42, 5.11),
+               (16.62, 10.52))
+
+    def __init__(self, num_classes: int = 20, seed: int = 123,
+                 image_size: int = 416):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.image_size = image_size
+
+    def init(self) -> MultiLayerNetwork:
+        def conv_bn(lb, ch):
+            return (lb.layer(L.ConvolutionLayer(
+                        n_out=ch, kernel_size=(3, 3), padding=(1, 1),
+                        has_bias=False, activation="identity"))
+                    .layer(L.BatchNormalization(activation="leakyrelu")))
+
+        lb = (NeuralNetConfiguration.builder()
+              .seed(self.seed).updater(Adam(1e-3)).weight_init("relu")
+              .list())
+        for i, ch in enumerate((16, 32, 64, 128, 256, 512)):
+            lb = conv_bn(lb, ch)
+            stride = (2, 2) if i < 5 else (1, 1)
+            lb = lb.layer(L.SubsamplingLayer(kernel_size=(2, 2),
+                                             stride=stride,
+                                             padding=(0, 0) if i < 5
+                                             else (1, 1)))
+        lb = conv_bn(lb, 1024)
+        lb = conv_bn(lb, 1024)
+        lb = lb.layer(L.ConvolutionLayer(
+            n_out=len(self.ANCHORS) * (5 + self.num_classes),
+            kernel_size=(1, 1), activation="identity"))
+        conf = (lb.layer(L.Yolo2OutputLayer(anchors=self.ANCHORS))
+                .set_input_type(InputType.convolutional(
+                    self.image_size, self.image_size, 3))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+
+class YOLO2(ZooModel):
+    """reference zoo.model.YOLO2: Darknet-19 backbone + the passthrough
+    (reorg) route — SpaceToDepth on the high-res feature map concatenated
+    with the deep path (MergeVertex) — + YOLOv2 head."""
+
+    ANCHORS = ((0.57273, 0.677385), (1.87446, 2.06253), (3.33843, 5.47434),
+               (7.88282, 3.52778), (9.77052, 9.16828))
+
+    def __init__(self, num_classes: int = 80, seed: int = 123,
+                 image_size: int = 416):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.image_size = image_size
+
+    def init(self) -> ComputationGraph:
+        gb = (ComputationGraphConfiguration
+              .graph_builder(NeuralNetConfiguration.builder()
+                             .seed(self.seed).updater(Adam(1e-3))
+                             .weight_init("relu"))
+              .add_inputs("input"))
+        idx = [0]
+
+        def conv_bn(name_in, ch, k):
+            i = idx[0]
+            idx[0] += 1
+            pad = (k // 2, k // 2) if k > 1 else (0, 0)
+            gb.add_layer(f"conv{i}", L.ConvolutionLayer(
+                n_out=ch, kernel_size=(k, k), padding=pad, has_bias=False,
+                activation="identity"), name_in)
+            gb.add_layer(f"bn{i}", L.BatchNormalization(
+                activation="leakyrelu"), f"conv{i}")
+            return f"bn{i}"
+
+        def pool(name_in):
+            i = idx[0]
+            idx[0] += 1
+            gb.add_layer(f"pool{i}", L.SubsamplingLayer(
+                kernel_size=(2, 2), stride=(2, 2)), name_in)
+            return f"pool{i}"
+
+        prev = conv_bn("input", 32, 3)
+        prev = pool(prev)
+        prev = conv_bn(prev, 64, 3)
+        prev = pool(prev)
+        for chs in ([128, 64, 128], [256, 128, 256]):
+            for j, ch in enumerate(chs):
+                prev = conv_bn(prev, ch, 3 if j % 2 == 0 else 1)
+            prev = pool(prev)
+        for j, ch in enumerate([512, 256, 512, 256, 512]):
+            prev = conv_bn(prev, ch, 3 if j % 2 == 0 else 1)
+        route = prev                       # 26x26x512 passthrough source
+        prev = pool(prev)
+        for j, ch in enumerate([1024, 512, 1024, 512, 1024]):
+            prev = conv_bn(prev, ch, 3 if j % 2 == 0 else 1)
+        prev = conv_bn(prev, 1024, 3)
+        prev = conv_bn(prev, 1024, 3)
+        # passthrough: reorg the 26x26 map to 13x13 and concat
+        gb.add_layer("reorg", L.SpaceToDepthLayer(block_size=2), route)
+        gb.add_vertex("route_cat", MergeVertex(), "reorg", prev)
+        prev = conv_bn("route_cat", 1024, 3)
+        gb.add_layer("head", L.ConvolutionLayer(
+            n_out=len(self.ANCHORS) * (5 + self.num_classes),
+            kernel_size=(1, 1), activation="identity"), prev)
+        gb.add_layer("yolo", L.Yolo2OutputLayer(anchors=self.ANCHORS),
+                     "head")
+        conf = (gb.set_outputs("yolo")
+                .set_input_types(InputType.convolutional(
+                    self.image_size, self.image_size, 3))
+                .build())
+        return ComputationGraph(conf).init()
+
+
+class Xception(ZooModel):
+    """reference zoo.model.Xception: entry/middle/exit flows of separable
+    convolutions with conv-projection residuals (ElementWiseVertex add)."""
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123,
+                 image_size: int = 299):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.image_size = image_size
+
+    def init(self) -> ComputationGraph:
+        gb = (ComputationGraphConfiguration
+              .graph_builder(NeuralNetConfiguration.builder()
+                             .seed(self.seed).updater(Adam(1e-3))
+                             .activation("relu").weight_init("relu"))
+              .add_inputs("input"))
+        n = [0]
+
+        def sep_bn(name_in, ch, act="relu"):
+            i = n[0]
+            n[0] += 1
+            gb.add_layer(f"sep{i}", L.SeparableConvolution2D(
+                n_out=ch, kernel_size=(3, 3), convolution_mode="same",
+                has_bias=False, activation="identity"), name_in)
+            gb.add_layer(f"sbn{i}", L.BatchNormalization(activation=act),
+                         f"sep{i}")
+            return f"sbn{i}"
+
+        def conv_bn(name_in, ch, k, stride, act="relu"):
+            i = n[0]
+            n[0] += 1
+            gb.add_layer(f"cv{i}", L.ConvolutionLayer(
+                n_out=ch, kernel_size=(k, k), stride=(stride, stride),
+                convolution_mode="same", has_bias=False,
+                activation="identity"), name_in)
+            gb.add_layer(f"cbn{i}", L.BatchNormalization(activation=act),
+                         f"cv{i}")
+            return f"cbn{i}"
+
+        def maxpool(name_in):
+            i = n[0]
+            n[0] += 1
+            gb.add_layer(f"mp{i}", L.SubsamplingLayer(
+                kernel_size=(3, 3), stride=(2, 2), padding=(1, 1)), name_in)
+            return f"mp{i}"
+
+        # entry flow
+        prev = conv_bn("input", 32, 3, 2)
+        prev = conv_bn(prev, 64, 3, 1)
+        for ch in (128, 256, 728):
+            res = conv_bn(prev, ch, 1, 2, act="identity")
+            x = sep_bn(prev, ch)
+            x = sep_bn(x, ch, act="identity")
+            x = maxpool(x)
+            i = n[0]
+            n[0] += 1
+            gb.add_vertex(f"add{i}", ElementWiseVertex("add"), x, res)
+            prev = f"add{i}"
+        # middle flow: 8 blocks of 3 separable convs + identity residual
+        for _ in range(8):
+            x = prev
+            for _ in range(3):
+                x = sep_bn(x, 728)
+            i = n[0]
+            n[0] += 1
+            gb.add_vertex(f"add{i}", ElementWiseVertex("add"), x, prev)
+            prev = f"add{i}"
+        # exit flow
+        res = conv_bn(prev, 1024, 1, 2, act="identity")
+        x = sep_bn(prev, 728)
+        x = sep_bn(x, 1024, act="identity")
+        x = maxpool(x)
+        i = n[0]
+        n[0] += 1
+        gb.add_vertex(f"add{i}", ElementWiseVertex("add"), x, res)
+        prev = sep_bn(f"add{i}", 1536)
+        prev = sep_bn(prev, 2048)
+        gb.add_layer("gap", L.GlobalPoolingLayer(pooling_type="avg"), prev)
+        gb.add_layer("out", L.OutputLayer(n_out=self.num_classes,
+                                          loss="mcxent",
+                                          activation="softmax"), "gap")
+        conf = (gb.set_outputs("out")
+                .set_input_types(InputType.convolutional(
+                    self.image_size, self.image_size, 3))
+                .build())
+        return ComputationGraph(conf).init()
+
+
+class InceptionResNetV1(ZooModel):
+    """reference zoo.model.InceptionResNetV1 (FaceNetNN4-era): stem +
+    5x inception-resnet-A + reduction-A + 10x block-B + reduction-B +
+    5x block-C, residual branches merged by concat then 1x1-projected and
+    added back (ElementWiseVertex)."""
+
+    def __init__(self, num_classes: int = 128, seed: int = 123,
+                 image_size: int = 160):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.image_size = image_size
+
+    def init(self) -> ComputationGraph:
+        gb = (ComputationGraphConfiguration
+              .graph_builder(NeuralNetConfiguration.builder()
+                             .seed(self.seed).updater(Adam(1e-3))
+                             .activation("relu").weight_init("relu"))
+              .add_inputs("input"))
+        n = [0]
+
+        def conv(name_in, ch, k, stride=1, same=True, act="relu"):
+            i = n[0]
+            n[0] += 1
+            gb.add_layer(f"c{i}", L.ConvolutionLayer(
+                n_out=ch, kernel_size=(k, k), stride=(stride, stride),
+                convolution_mode="same" if same else "truncate",
+                has_bias=False, activation="identity"), name_in)
+            gb.add_layer(f"b{i}", L.BatchNormalization(activation=act),
+                         f"c{i}")
+            return f"b{i}"
+
+        def resnet_block(prev, branches, proj_ch):
+            """concat(branches) → 1x1 proj → add residual → relu."""
+            i = n[0]
+            n[0] += 1
+            gb.add_vertex(f"cat{i}", MergeVertex(), *branches)
+            gb.add_layer(f"proj{i}", L.ConvolutionLayer(
+                n_out=proj_ch, kernel_size=(1, 1),
+                activation="identity"), f"cat{i}")
+            gb.add_vertex(f"radd{i}", ElementWiseVertex("add"),
+                          f"proj{i}", prev)
+            gb.add_layer(f"ract{i}", L.ActivationLayer(activation="relu"),
+                         f"radd{i}")
+            return f"ract{i}"
+
+        # stem (simplified faithful widths)
+        prev = conv("input", 32, 3, stride=2)
+        prev = conv(prev, 32, 3)
+        prev = conv(prev, 64, 3)
+        gb.add_layer("stem_pool", L.SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2), padding=(1, 1)), prev)
+        prev = conv("stem_pool", 80, 1)
+        prev = conv(prev, 192, 3)
+        prev = conv(prev, 256, 3, stride=2)
+
+        # 5x inception-resnet-A (channels 256)
+        for _ in range(5):
+            b1 = conv(prev, 32, 1)
+            b2 = conv(conv(prev, 32, 1), 32, 3)
+            b3 = conv(conv(conv(prev, 32, 1), 32, 3), 32, 3)
+            prev = resnet_block(prev, (b1, b2, b3), 256)
+        # reduction-A → 896 channels
+        ra1 = conv(prev, 384, 3, stride=2)
+        ra2 = conv(conv(conv(prev, 192, 1), 192, 3), 256, 3, stride=2)
+        gb.add_layer("redA_pool", L.SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2), padding=(1, 1)), prev)
+        gb.add_vertex("redA", MergeVertex(), ra1, ra2, "redA_pool")
+        prev = "redA"
+        # 10x inception-resnet-B (channels 896)
+        for _ in range(10):
+            b1 = conv(prev, 128, 1)
+            b2 = conv(conv(prev, 128, 1), 128, 7)
+            prev = resnet_block(prev, (b1, b2), 896)
+        # reduction-B → 1792 channels
+        rb1 = conv(conv(prev, 256, 1), 384, 3, stride=2)
+        rb2 = conv(conv(prev, 256, 1), 256, 3, stride=2)
+        rb3 = conv(conv(conv(prev, 256, 1), 256, 3), 256, 3, stride=2)
+        gb.add_layer("redB_pool", L.SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2), padding=(1, 1)), prev)
+        gb.add_vertex("redB", MergeVertex(), rb1, rb2, rb3, "redB_pool")
+        prev = "redB"
+        # 5x inception-resnet-C (channels 1792)
+        for _ in range(5):
+            b1 = conv(prev, 192, 1)
+            b2 = conv(conv(prev, 192, 1), 192, 3)
+            prev = resnet_block(prev, (b1, b2), 1792)
+
+        gb.add_layer("gap", L.GlobalPoolingLayer(pooling_type="avg"), prev)
+        gb.add_layer("bottleneck", L.DenseLayer(
+            n_out=self.num_classes, activation="identity"), "gap")
+        gb.add_layer("out", L.LossLayer(loss="mcxent",
+                                        activation="softmax"), "bottleneck")
+        conf = (gb.set_outputs("out")
+                .set_input_types(InputType.convolutional(
+                    self.image_size, self.image_size, 3))
+                .build())
+        return ComputationGraph(conf).init()

@@ -1,0 +1,113 @@
+"""Native host-runtime helpers (libdatavec_native, C++ via ctypes).
+
+SURVEY §7.1.2's stance — "native where the reference is native" — applied to
+the ONE place host CPU still sits on the training path in this architecture:
+ETL loops feeding the device (the reference's equivalents live in libnd4j's
+CPU helpers and DataVec's native image loaders). The device compute path is
+XLA; these helpers accelerate corpus scanning / pair generation.
+
+Build-on-first-use: compiled with g++ into the package dir, loaded with
+ctypes (no pybind11 in this image). Every caller MUST tolerate
+``available() == False`` and fall back to the numpy path — toolchain absence
+degrades performance, never correctness.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "datavec_native.cpp")
+_SO = os.path.join(_HERE, "libdatavec_native.so")
+
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
+             "-o", _SO],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def _load():
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_SO) or \
+            os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+        if not _build():
+            return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    lib.sg_pairs.restype = ctypes.c_int64
+    lib.sg_pairs.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64]
+    lib.tokenize_spans.restype = ctypes.c_int64
+    lib.tokenize_spans.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def sg_pairs(ids: np.ndarray, offsets: np.ndarray, window: int,
+             keep: Optional[np.ndarray], seed: int
+             ) -> Tuple[np.ndarray, np.ndarray]:
+    """Skip-gram (center, context) pairs for a corpus chunk — the word2vec
+    host hot loop in one native call. ids int32 concatenated sentences;
+    offsets int64 [n_sent+1]."""
+    lib = _load()
+    assert lib is not None, "native library unavailable; guard with available()"
+    ids = np.ascontiguousarray(ids, dtype=np.int32)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    cap = int(2 * window * max(ids.size, 1))
+    centers = np.empty(cap, dtype=np.int32)
+    contexts = np.empty(cap, dtype=np.int32)
+    keep_ptr = None
+    if keep is not None:
+        keep = np.ascontiguousarray(keep, dtype=np.float64)
+        keep_ptr = keep.ctypes.data_as(ctypes.c_void_p)
+    n = lib.sg_pairs(
+        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(offsets) - 1, window, keep_ptr, seed,
+        centers.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        contexts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), cap)
+    return centers[:n], contexts[:n]
+
+
+def tokenize(text: str):
+    """Whitespace tokens of a (possibly huge) string in one native pass."""
+    lib = _load()
+    assert lib is not None, "native library unavailable; guard with available()"
+    raw = text.encode("utf-8")
+    cap = max(len(raw) // 2 + 1, 16)
+    starts = np.empty(cap, dtype=np.int64)
+    lens = np.empty(cap, dtype=np.int64)
+    n = lib.tokenize_spans(
+        raw, len(raw),
+        starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), cap)
+    return [raw[starts[i]:starts[i] + lens[i]].decode("utf-8")
+            for i in range(n)]

@@ -404,11 +404,31 @@ class SequenceVectors(WordVectors):
                     wins = self._sentence_windows(ids, rng, keep)
                     if wins is not None:
                         yield (ids.size,) + wins
-            else:
-                for ids in corpus:
-                    pairs = self._sentence_pairs(ids, rng, keep)
-                    if pairs is not None:
-                        yield (ids.size,) + pairs
+                return
+            # skip-gram pair generation: one native call per sentence chunk
+            # (libdatavec_native, SURVEY §7.1.2 "native where the reference
+            # is native") with the numpy per-sentence path as fallback
+            from .. import native
+
+            if native.available():
+                CHUNK = 2048
+                keep_arr = keep if self.sampling > 0 else None
+                for s0 in range(0, len(corpus), CHUNK):
+                    chunk = corpus[s0:s0 + CHUNK]
+                    offsets = np.zeros(len(chunk) + 1, np.int64)
+                    np.cumsum([c.size for c in chunk], out=offsets[1:])
+                    flat = np.concatenate(chunk) if chunk else \
+                        np.empty(0, np.int32)
+                    c, x = native.sg_pairs(
+                        flat, offsets, self.window, keep_arr,
+                        int(rng.integers(1, 2 ** 63 - 1)))
+                    if c.size:
+                        yield int(offsets[-1]), c, x
+                return
+            for ids in corpus:
+                pairs = self._sentence_pairs(ids, rng, keep)
+                if pairs is not None:
+                    yield (ids.size,) + pairs
 
         if stream_factory is None:
             stream_factory = default_stream

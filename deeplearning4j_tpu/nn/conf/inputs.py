@@ -38,6 +38,11 @@ class InputType:
     def convolutional_flat(height: int, width: int, channels: int) -> "CNNFlatInput":
         return CNNFlatInput(channels, height, width)
 
+    @staticmethod
+    def convolutional_3d(depth: int, height: int, width: int,
+                         channels: int) -> "CNN3DInput":
+        return CNN3DInput(channels, depth, height, width)
+
 
 @dataclass(frozen=True)
 class FFInput(InputType):
@@ -53,6 +58,16 @@ class RNNInput(InputType):
 @dataclass(frozen=True)
 class CNNInput(InputType):
     channels: int
+    height: int
+    width: int
+
+
+@dataclass(frozen=True)
+class CNN3DInput(InputType):
+    """5-D volumetric input [B, C, D, H, W] (reference InputType.InputTypeConvolutional3D)."""
+
+    channels: int
+    depth: int
     height: int
     width: int
 

@@ -49,6 +49,12 @@ class Layer:
 
     # filled by the builder
     n_in: Optional[int] = None
+    # post-update weight projections (reference api.layers.constraint.*;
+    # applied by the fit step after the updater)
+    constraints: Optional[list] = None
+    # training-time param perturbation (reference conf.weightnoise.*;
+    # applied by the network before apply())
+    weight_noise: Optional[Any] = None
 
     def set_input_type(self, input_type: InputType) -> InputType:
         """Infer nIn from the incoming type; return this layer's output type."""
@@ -1034,3 +1040,7 @@ class LossLayer(Layer):
     @property
     def has_params(self):
         return False
+
+
+# extended families (1D/3D convs, capsules, VAE, YOLO, constraints, ...)
+from .layers_ext import *  # noqa: E402,F401,F403

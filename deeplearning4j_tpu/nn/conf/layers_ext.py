@@ -1,0 +1,1050 @@
+"""Extended layer families: 1D/3D conv stacks, locally-connected, capsules,
+VAE, YOLOv2 head, center loss, spatial reshapes, dropout variants,
+constraints + weight noise.
+
+Reference: the remainder of dl4j-nn ``org.deeplearning4j.nn.conf.layers.*``
+flagged missing by the round-1 verdict (SURVEY.md §2.3 conf-layer row):
+``Convolution1D/3D + Subsampling/Upsampling/ZeroPadding/Cropping 1D/3D``,
+``LocallyConnected1D/2D``, ``SpaceToDepthLayer/SpaceToBatchLayer``,
+``RepeatVector``, ``TimeDistributed``, ``Alpha/GaussianDropout``,
+``GaussianNoise``, ``variational.VariationalAutoencoder``,
+``CenterLossOutputLayer``, ``CapsuleLayer/PrimaryCapsules/
+CapsuleStrengthLayer``, ``objdetect.Yolo2OutputLayer``, plus the
+``constraint.*`` and ``weightnoise.*`` SPIs.
+
+Layout conventions match the main layer module: 1D sequence layers ride the
+RNN layout [B, T, F] (the reference's Conv1D also consumes recurrent input),
+3D layers are NCDHW via ``CNN3DInput``. Imported star-wise at the bottom of
+``layers.py`` so every class is reachable as ``conf.layers.X``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.registry import get_op
+from ..activations import activation_fn
+from ..losses import ILossFunction, LossMCXENT, loss_from_name
+from ..weights import init_weights
+from .inputs import CNN3DInput, CNNInput, FFInput, InputType, RNNInput
+from .layers import (ActivationLayer, DenseLayer, Layer, OutputLayer, _pair)
+
+
+def _triple(v):
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+# =========================================================================
+# 1D convolution family (on [B, T, F] sequence input, reference Conv1D
+# consumes recurrent input the same way)
+# =========================================================================
+
+@dataclass
+class Convolution1DLayer(Layer):
+    """Reference conf.layers.Convolution1DLayer. W=[out, in, k]."""
+
+    n_out: int = 0
+    kernel_size: int = 3
+    stride: int = 1
+    padding: int = 0
+    dilation: int = 1
+    convolution_mode: str = "truncate"
+    has_bias: bool = True
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, RNNInput):
+            raise ValueError("Convolution1DLayer needs RNN input [B, T, F]")
+        self.n_in = input_type.size
+        t = input_type.timesteps
+        if t is not None:
+            if self.convolution_mode.lower() == "same":
+                t = -(-t // self.stride)
+            else:
+                eff_k = (self.kernel_size - 1) * self.dilation + 1
+                t = (t + 2 * self.padding - eff_k) // self.stride + 1
+        return RNNInput(self.n_out, t)
+
+    def init_params(self, key, dtype=jnp.float32):
+        p = {"W": init_weights(key, (self.n_out, self.n_in, self.kernel_size),
+                               self.weight_init or "xavier", dtype)}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,), dtype)
+        return p
+
+    def apply(self, params, x, state, training, rng):
+        x = self._maybe_dropout(x, training, rng)
+        pad = ("SAME" if self.convolution_mode.lower() == "same"
+               else self.padding)
+        out = get_op("conv1d").fn(jnp.swapaxes(x, 1, 2), params["W"],
+                                  params.get("b"), stride=self.stride,
+                                  padding=pad, dilation=self.dilation)
+        out = jnp.swapaxes(out, 1, 2)
+        return activation_fn(self.activation or "identity")(out), state
+
+
+@dataclass
+class Subsampling1DLayer(Layer):
+    """Reference Subsampling1DLayer: max/avg pooling along time."""
+
+    kernel_size: int = 2
+    stride: int = 2
+    padding: int = 0
+    pooling_type: str = "max"
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, RNNInput):
+            raise ValueError("Subsampling1DLayer needs RNN input")
+        self.n_in = input_type.size
+        t = input_type.timesteps
+        if t is not None:
+            t = (t + 2 * self.padding - self.kernel_size) // self.stride + 1
+        return RNNInput(self.n_in, t)
+
+    def apply(self, params, x, state, training, rng):
+        xc = jnp.swapaxes(x, 1, 2)[..., None]       # [B, F, T, 1]
+        op = "maxpool2d" if self.pooling_type.lower() == "max" else "avgpool2d"
+        out = get_op(op).fn(xc, kernel=(self.kernel_size, 1),
+                            strides=(self.stride, 1),
+                            padding=(self.padding, 0))
+        return jnp.swapaxes(out[..., 0], 1, 2), state
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
+class Upsampling1D(Layer):
+    """Repeat each timestep ``size`` times (reference Upsampling1D)."""
+
+    size: int = 2
+
+    def set_input_type(self, input_type):
+        self.n_in = input_type.size
+        t = input_type.timesteps
+        return RNNInput(self.n_in, t * self.size if t else None)
+
+    def apply(self, params, x, state, training, rng):
+        return jnp.repeat(x, self.size, axis=1), state
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
+class ZeroPadding1DLayer(Layer):
+    padding: Tuple[int, int] = (1, 1)
+
+    def set_input_type(self, input_type):
+        self.n_in = input_type.size
+        t = input_type.timesteps
+        p = _pair(self.padding)
+        return RNNInput(self.n_in, t + p[0] + p[1] if t else None)
+
+    def apply(self, params, x, state, training, rng):
+        p = _pair(self.padding)
+        return jnp.pad(x, ((0, 0), (p[0], p[1]), (0, 0))), state
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
+class Cropping1D(Layer):
+    cropping: Tuple[int, int] = (1, 1)
+
+    def set_input_type(self, input_type):
+        self.n_in = input_type.size
+        t = input_type.timesteps
+        c = _pair(self.cropping)
+        return RNNInput(self.n_in, t - c[0] - c[1] if t else None)
+
+    def apply(self, params, x, state, training, rng):
+        c = _pair(self.cropping)
+        return x[:, c[0]:x.shape[1] - c[1]], state
+
+    @property
+    def has_params(self):
+        return False
+
+
+# =========================================================================
+# 3D convolution family (NCDHW)
+# =========================================================================
+
+@dataclass
+class Convolution3DLayer(Layer):
+    """Reference conf.layers.Convolution3D. W=[out, in, kD, kH, kW]."""
+
+    n_out: int = 0
+    kernel_size: Tuple[int, int, int] = (3, 3, 3)
+    stride: Tuple[int, int, int] = (1, 1, 1)
+    padding: Tuple[int, int, int] = (0, 0, 0)
+    dilation: Tuple[int, int, int] = (1, 1, 1)
+    convolution_mode: str = "truncate"
+    has_bias: bool = True
+
+    def _dims(self, d, h, w):
+        k = _triple(self.kernel_size)
+        s = _triple(self.stride)
+        if self.convolution_mode.lower() == "same":
+            return tuple(-(-v // sv) for v, sv in zip((d, h, w), s))
+        p = _triple(self.padding)
+        dil = _triple(self.dilation)
+        out = []
+        for v, kv, sv, pv, dv in zip((d, h, w), k, s, p, dil):
+            eff = (kv - 1) * dv + 1
+            out.append((v + 2 * pv - eff) // sv + 1)
+        return tuple(out)
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, CNN3DInput):
+            raise ValueError("Convolution3DLayer needs CNN3D input (use "
+                             "InputType.convolutional_3d)")
+        self.n_in = input_type.channels
+        d, h, w = self._dims(input_type.depth, input_type.height,
+                             input_type.width)
+        return CNN3DInput(self.n_out, d, h, w)
+
+    def init_params(self, key, dtype=jnp.float32):
+        k = _triple(self.kernel_size)
+        p = {"W": init_weights(key, (self.n_out, self.n_in) + k,
+                               self.weight_init or "xavier", dtype)}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,), dtype)
+        return p
+
+    def apply(self, params, x, state, training, rng):
+        x = self._maybe_dropout(x, training, rng)
+        pad = ("SAME" if self.convolution_mode.lower() == "same"
+               else _triple(self.padding))
+        out = get_op("conv3d").fn(x, params["W"], params.get("b"),
+                                  strides=_triple(self.stride), padding=pad,
+                                  dilation=_triple(self.dilation))
+        return activation_fn(self.activation or "identity")(out), state
+
+
+@dataclass
+class Subsampling3DLayer(Layer):
+    kernel_size: Tuple[int, int, int] = (2, 2, 2)
+    stride: Tuple[int, int, int] = (2, 2, 2)
+    padding: Tuple[int, int, int] = (0, 0, 0)
+    pooling_type: str = "max"
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, CNN3DInput):
+            raise ValueError("Subsampling3DLayer needs CNN3D input")
+        self.n_in = input_type.channels
+        k, s, p = (_triple(self.kernel_size), _triple(self.stride),
+                   _triple(self.padding))
+        dims = tuple((v + 2 * pv - kv) // sv + 1 for v, kv, sv, pv in
+                     zip((input_type.depth, input_type.height,
+                          input_type.width), k, s, p))
+        return CNN3DInput(self.n_in, *dims)
+
+    def apply(self, params, x, state, training, rng):
+        op = "maxpool3d" if self.pooling_type.lower() == "max" else "avgpool3d"
+        out = get_op(op).fn(x, kernel=_triple(self.kernel_size),
+                            strides=_triple(self.stride),
+                            padding=_triple(self.padding))
+        return out, state
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
+class Upsampling3D(Layer):
+    size: Tuple[int, int, int] = (2, 2, 2)
+
+    def set_input_type(self, input_type):
+        self.n_in = input_type.channels
+        s = _triple(self.size)
+        return CNN3DInput(self.n_in, input_type.depth * s[0],
+                          input_type.height * s[1], input_type.width * s[2])
+
+    def apply(self, params, x, state, training, rng):
+        out = get_op("upsampling3d").fn(x, factor=_triple(self.size))
+        return out, state
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
+class ZeroPadding3DLayer(Layer):
+    padding: Tuple[int, int, int] = (1, 1, 1)
+
+    def set_input_type(self, input_type):
+        self.n_in = input_type.channels
+        p = _triple(self.padding)
+        return CNN3DInput(self.n_in, input_type.depth + 2 * p[0],
+                          input_type.height + 2 * p[1],
+                          input_type.width + 2 * p[2])
+
+    def apply(self, params, x, state, training, rng):
+        p = _triple(self.padding)
+        return jnp.pad(x, ((0, 0), (0, 0), (p[0],) * 2, (p[1],) * 2,
+                           (p[2],) * 2)), state
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
+class Cropping3D(Layer):
+    cropping: Tuple[int, int, int] = (1, 1, 1)
+
+    def set_input_type(self, input_type):
+        self.n_in = input_type.channels
+        c = _triple(self.cropping)
+        return CNN3DInput(self.n_in, input_type.depth - 2 * c[0],
+                          input_type.height - 2 * c[1],
+                          input_type.width - 2 * c[2])
+
+    def apply(self, params, x, state, training, rng):
+        c = _triple(self.cropping)
+        return x[:, :, c[0]:x.shape[2] - c[0], c[1]:x.shape[3] - c[1],
+                 c[2]:x.shape[4] - c[2]], state
+
+    @property
+    def has_params(self):
+        return False
+
+
+# =========================================================================
+# Locally connected (unshared conv weights)
+# =========================================================================
+
+@dataclass
+class LocallyConnected2D(Layer):
+    """Reference conf.layers.LocallyConnected2D: convolution arithmetic with
+    a SEPARATE kernel per output position. Lowered to
+    ``conv_general_dilated_patches`` (one im2col) + a per-position einsum —
+    a single large batched matmul on the MXU instead of the reference's
+    per-position GEMM loop."""
+
+    n_out: int = 0
+    kernel_size: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    has_bias: bool = True
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, CNNInput):
+            raise ValueError("LocallyConnected2D needs CNN input")
+        self.n_in = input_type.channels
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        self._oh = (input_type.height - kh) // sh + 1
+        self._ow = (input_type.width - kw) // sw + 1
+        return CNNInput(self.n_out, self._oh, self._ow)
+
+    def init_params(self, key, dtype=jnp.float32):
+        kh, kw = _pair(self.kernel_size)
+        patch = self.n_in * kh * kw
+        kw_, kb_ = jax.random.split(key)
+        # fan-in-correct init per position
+        w = init_weights(kw_, (self._oh * self._ow, patch, self.n_out),
+                         self.weight_init or "xavier", dtype)
+        p = {"W": w}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out, self._oh, self._ow), dtype)
+        return p
+
+    def apply(self, params, x, state, training, rng):
+        x = self._maybe_dropout(x, training, rng)
+        patches = jax.lax.conv_general_dilated_patches(
+            x, _pair(self.kernel_size), _pair(self.stride),
+            padding="VALID")                         # [B, C*kh*kw, oh, ow]
+        b, p, oh, ow = patches.shape
+        flat = patches.reshape(b, p, oh * ow)
+        out = jnp.einsum("bpl,lpo->bol", flat, params["W"])
+        out = out.reshape(b, self.n_out, oh, ow)
+        if self.has_bias:
+            out = out + params["b"][None]
+        return activation_fn(self.activation or "identity")(out), state
+
+
+@dataclass
+class LocallyConnected1D(Layer):
+    """Reference LocallyConnected1D on [B, T, F]."""
+
+    n_out: int = 0
+    kernel_size: int = 3
+    stride: int = 1
+    has_bias: bool = True
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, RNNInput):
+            raise ValueError("LocallyConnected1D needs RNN input")
+        self.n_in = input_type.size
+        t = input_type.timesteps
+        if t is None:
+            raise ValueError("LocallyConnected1D needs a known sequence "
+                             "length (unshared weights are per-position)")
+        self._ot = (t - self.kernel_size) // self.stride + 1
+        return RNNInput(self.n_out, self._ot)
+
+    def init_params(self, key, dtype=jnp.float32):
+        patch = self.n_in * self.kernel_size
+        p = {"W": init_weights(key, (self._ot, patch, self.n_out),
+                               self.weight_init or "xavier", dtype)}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self._ot, self.n_out), dtype)
+        return p
+
+    def apply(self, params, x, state, training, rng):
+        x = self._maybe_dropout(x, training, rng)
+        xc = jnp.swapaxes(x, 1, 2)[..., None]       # [B, F, T, 1]
+        patches = jax.lax.conv_general_dilated_patches(
+            xc, (self.kernel_size, 1), (self.stride, 1), padding="VALID")
+        b, p, ot, _ = patches.shape
+        flat = patches.reshape(b, p, ot)
+        out = jnp.einsum("bpl,lpo->blo", flat, params["W"])
+        if self.has_bias:
+            out = out + params["b"][None]
+        return activation_fn(self.activation or "identity")(out), state
+
+
+# =========================================================================
+# Spatial reshapes + sequence utility layers
+# =========================================================================
+
+@dataclass
+class SpaceToDepthLayer(Layer):
+    """Reference SpaceToDepthLayer (block rearrangement, zero FLOPs)."""
+
+    block_size: int = 2
+
+    def set_input_type(self, input_type):
+        self.n_in = input_type.channels
+        b = self.block_size
+        return CNNInput(self.n_in * b * b, input_type.height // b,
+                        input_type.width // b)
+
+    def apply(self, params, x, state, training, rng):
+        return get_op("space_to_depth").fn(x, block_size=self.block_size,
+                                           data_format="NCHW"), state
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
+class SpaceToBatchLayer(Layer):
+    """Reference SpaceToBatchLayer (NCHW shell over the NHWC op)."""
+
+    block_size: int = 2
+
+    def set_input_type(self, input_type):
+        self.n_in = input_type.channels
+        b = self.block_size
+        return CNNInput(self.n_in, input_type.height // b,
+                        input_type.width // b)
+
+    def apply(self, params, x, state, training, rng):
+        b = self.block_size
+        nhwc = jnp.transpose(x, (0, 2, 3, 1))
+        out = get_op("space_to_batch").fn(nhwc, (b, b), ((0, 0), (0, 0)))
+        return jnp.transpose(out, (0, 3, 1, 2)), state
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
+class RepeatVector(Layer):
+    """[B, F] → [B, n, F] (reference RepeatVector)."""
+
+    n: int = 1
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, FFInput):
+            raise ValueError("RepeatVector needs FF input")
+        self.n_in = input_type.size
+        return RNNInput(self.n_in, self.n)
+
+    def apply(self, params, x, state, training, rng):
+        return jnp.repeat(x[:, None, :], self.n, axis=1), state
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
+class TimeDistributed(Layer):
+    """Apply a feed-forward layer independently at every timestep
+    (reference recurrent.TimeDistributed wrapper)."""
+
+    layer: Optional[Layer] = None
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, RNNInput):
+            raise ValueError("TimeDistributed needs RNN input")
+        inner_out = self.layer.set_input_type(FFInput(input_type.size))
+        self.n_in = input_type.size
+        return RNNInput(inner_out.size, input_type.timesteps)
+
+    def init_params(self, key, dtype=jnp.float32):
+        return self.layer.init_params(key, dtype)
+
+    def init_state(self):
+        return self.layer.init_state()
+
+    def apply(self, params, x, state, training, rng):
+        b, t, f = x.shape
+        flat = x.reshape(b * t, f)
+        out, st = self.layer.apply(params, flat, state, training, rng)
+        return out.reshape(b, t, -1), st
+
+    @property
+    def has_params(self):
+        return self.layer.has_params
+
+
+# =========================================================================
+# Dropout variants (ops already registered; train-only, identity at infer)
+# =========================================================================
+
+@dataclass
+class AlphaDropoutLayer(Layer):
+    """SELU-preserving dropout (reference AlphaDropout)."""
+
+    rate: float = 0.5
+
+    def apply(self, params, x, state, training, rng):
+        if training and self.rate > 0:
+            return get_op("alpha_dropout").fn(x, rng, rate=self.rate), state
+        return x, state
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
+class GaussianDropoutLayer(Layer):
+    """Multiplicative N(1, rate/(1-rate)) noise (reference GaussianDropout)."""
+
+    rate: float = 0.5
+
+    def apply(self, params, x, state, training, rng):
+        if training and self.rate > 0:
+            return get_op("gaussian_dropout").fn(x, rng, rate=self.rate), state
+        return x, state
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
+class GaussianNoiseLayer(Layer):
+    """Additive N(0, stddev) noise during training (reference GaussianNoise)."""
+
+    stddev: float = 0.1
+
+    def apply(self, params, x, state, training, rng):
+        if training and self.stddev > 0:
+            return get_op("gaussian_noise").fn(x, rng,
+                                               stddev=self.stddev), state
+        return x, state
+
+    @property
+    def has_params(self):
+        return False
+
+
+# =========================================================================
+# Parameter constraints + weight noise (reference: api.layers.constraint.*,
+# conf.weightnoise.*)
+# =========================================================================
+
+class ParamConstraint:
+    """Projection applied to weights AFTER each update (reference
+    BaseConstraint.applyConstraint)."""
+
+    def apply(self, w):
+        raise NotImplementedError
+
+
+class MaxNormConstraint(ParamConstraint):
+    def __init__(self, max_norm: float, axis: int = 0):
+        self.max_norm = max_norm
+        self.axis = axis
+
+    def apply(self, w):
+        norms = jnp.sqrt(jnp.sum(jnp.square(w), axis=self.axis,
+                                 keepdims=True))
+        scale = jnp.minimum(1.0, self.max_norm / jnp.maximum(norms, 1e-12))
+        return w * scale
+
+
+class MinMaxNormConstraint(ParamConstraint):
+    def __init__(self, min_norm: float, max_norm: float, axis: int = 0):
+        self.min_norm, self.max_norm, self.axis = min_norm, max_norm, axis
+
+    def apply(self, w):
+        norms = jnp.sqrt(jnp.sum(jnp.square(w), axis=self.axis,
+                                 keepdims=True))
+        clipped = jnp.clip(norms, self.min_norm, self.max_norm)
+        return w * clipped / jnp.maximum(norms, 1e-12)
+
+
+class NonNegativeConstraint(ParamConstraint):
+    def apply(self, w):
+        return jnp.maximum(w, 0.0)
+
+
+class UnitNormConstraint(ParamConstraint):
+    def __init__(self, axis: int = 0):
+        self.axis = axis
+
+    def apply(self, w):
+        norms = jnp.sqrt(jnp.sum(jnp.square(w), axis=self.axis,
+                                 keepdims=True))
+        return w / jnp.maximum(norms, 1e-12)
+
+
+class IWeightNoise:
+    """Perturb a layer's params during TRAINING forward passes (reference
+    conf.weightnoise.IWeightNoise; applied by the network before
+    layer.apply, so every layer type supports it without code)."""
+
+    def apply(self, params: Dict[str, Any], rng, training: bool):
+        raise NotImplementedError
+
+
+class DropConnect(IWeightNoise):
+    """Randomly zero weights with probability p (reference DropConnect)."""
+
+    def __init__(self, weight_retain_prob: float = 0.5,
+                 apply_to_biases: bool = False):
+        self.p = weight_retain_prob
+        self.apply_to_biases = apply_to_biases
+
+    def apply(self, params, rng, training):
+        if not training:
+            return params
+        out = {}
+        for k, w in params.items():
+            if k == "b" and not self.apply_to_biases:
+                out[k] = w
+                continue
+            rng, sub = jax.random.split(rng)
+            keep = jax.random.bernoulli(sub, self.p, w.shape)
+            out[k] = jnp.where(keep, w / self.p, 0.0)
+        return out
+
+
+class WeightNoise(IWeightNoise):
+    """Additive/multiplicative gaussian weight noise (reference
+    WeightNoise)."""
+
+    def __init__(self, mean: float = 0.0, stddev: float = 0.1,
+                 additive: bool = True):
+        self.mean, self.stddev, self.additive = mean, stddev, additive
+
+    def apply(self, params, rng, training):
+        if not training:
+            return params
+        out = {}
+        for k, w in params.items():
+            if k == "b":
+                out[k] = w
+                continue
+            rng, sub = jax.random.split(rng)
+            noise = self.mean + self.stddev * \
+                jax.random.normal(sub, w.shape, dtype=w.dtype)
+            out[k] = w + noise if self.additive else w * noise
+        return out
+
+
+# =========================================================================
+# Variational autoencoder (reference conf.layers.variational.*)
+# =========================================================================
+
+@dataclass
+class VariationalAutoencoder(Layer):
+    """Reference variational.VariationalAutoencoder: encoder MLP →
+    (mean, logvar) of q(z|x) → decoder MLP → reconstruction distribution.
+
+    Supervised forward (``apply``) returns the posterior MEAN activations —
+    exactly what the reference's activate() feeds downstream layers. The
+    unsupervised objective (negative ELBO, ``pretrain_loss``) drives
+    ``MultiLayerNetwork.pretrain`` (reference layerwise pretraining path).
+    Reconstruction distributions: "gaussian" (diagonal, reference
+    GaussianReconstructionDistribution) or "bernoulli".
+    """
+
+    n_out: int = 0                                   # size of z
+    encoder_layer_sizes: Tuple[int, ...] = (64,)
+    decoder_layer_sizes: Tuple[int, ...] = (64,)
+    reconstruction_distribution: str = "gaussian"
+    num_samples: int = 1
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, FFInput):
+            raise ValueError("VariationalAutoencoder needs FF input")
+        self.n_in = input_type.size
+        return FFInput(self.n_out)
+
+    def init_params(self, key, dtype=jnp.float32):
+        wi = self.weight_init or "xavier"
+        p: Dict[str, jnp.ndarray] = {}
+        sizes = (self.n_in,) + tuple(self.encoder_layer_sizes)
+        for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+            key, sub = jax.random.split(key)
+            p[f"eW{i}"] = init_weights(sub, (a, b), wi, dtype)
+            p[f"eb{i}"] = jnp.zeros((b,), dtype)
+        key, k1, k2 = jax.random.split(key, 3)
+        p["meanW"] = init_weights(k1, (sizes[-1], self.n_out), wi, dtype)
+        p["meanb"] = jnp.zeros((self.n_out,), dtype)
+        p["lvW"] = init_weights(k2, (sizes[-1], self.n_out), wi, dtype)
+        p["lvb"] = jnp.zeros((self.n_out,), dtype)
+        dsizes = (self.n_out,) + tuple(self.decoder_layer_sizes)
+        for i, (a, b) in enumerate(zip(dsizes[:-1], dsizes[1:])):
+            key, sub = jax.random.split(key)
+            p[f"dW{i}"] = init_weights(sub, (a, b), wi, dtype)
+            p[f"db{i}"] = jnp.zeros((b,), dtype)
+        out_w = (2 * self.n_in
+                 if self.reconstruction_distribution == "gaussian"
+                 else self.n_in)
+        key, sub = jax.random.split(key)
+        p["rW"] = init_weights(sub, (dsizes[-1], out_w), wi, dtype)
+        p["rb"] = jnp.zeros((out_w,), dtype)
+        return p
+
+    def _encode(self, params, x):
+        act = activation_fn(self.activation or "tanh")
+        h = x
+        for i in range(len(self.encoder_layer_sizes)):
+            h = act(h @ params[f"eW{i}"] + params[f"eb{i}"])
+        mean = h @ params["meanW"] + params["meanb"]
+        logvar = h @ params["lvW"] + params["lvb"]
+        return mean, logvar
+
+    def _decode(self, params, z):
+        act = activation_fn(self.activation or "tanh")
+        h = z
+        for i in range(len(self.decoder_layer_sizes)):
+            h = act(h @ params[f"dW{i}"] + params[f"db{i}"])
+        return h @ params["rW"] + params["rb"]
+
+    def apply(self, params, x, state, training, rng):
+        x = self._maybe_dropout(x, training, rng)
+        mean, _ = self._encode(params, x)
+        return mean, state
+
+    def is_pretrain_layer(self) -> bool:
+        return True
+
+    def pretrain_loss(self, params, x, rng):
+        """Negative ELBO, averaged over the batch (and num_samples z
+        draws): reconstruction log-likelihood + KL(q(z|x) || N(0, I))."""
+        mean, logvar = self._encode(params, x)
+        kl = 0.5 * jnp.sum(jnp.exp(logvar) + mean ** 2 - 1.0 - logvar,
+                           axis=1)
+        recon = 0.0
+        for _ in range(self.num_samples):
+            rng, sub = jax.random.split(rng)
+            eps = jax.random.normal(sub, mean.shape, dtype=mean.dtype)
+            z = mean + jnp.exp(0.5 * logvar) * eps
+            out = self._decode(params, z)
+            if self.reconstruction_distribution == "gaussian":
+                rmean, rlogvar = jnp.split(out, 2, axis=1)
+                ll = -0.5 * jnp.sum(
+                    rlogvar + (x - rmean) ** 2 / jnp.exp(rlogvar)
+                    + jnp.log(2 * jnp.pi), axis=1)
+            else:  # bernoulli logits
+                ll = -jnp.sum(
+                    jnp.maximum(out, 0) - out * x
+                    + jnp.log1p(jnp.exp(-jnp.abs(out))), axis=1)
+            recon = recon + ll
+        recon = recon / self.num_samples
+        return jnp.mean(kl - recon)
+
+    def reconstruction_error(self, params, x, rng):
+        """Deterministic (mean-z) reconstruction error for scoring."""
+        mean, _ = self._encode(params, x)
+        out = self._decode(params, mean)
+        if self.reconstruction_distribution == "gaussian":
+            rmean, _ = jnp.split(out, 2, axis=1)
+        else:
+            rmean = jax.nn.sigmoid(out)
+        return jnp.mean(jnp.sum((x - rmean) ** 2, axis=1))
+
+
+# =========================================================================
+# Center loss output (reference CenterLossOutputLayer)
+# =========================================================================
+
+@dataclass
+class CenterLossOutputLayer(OutputLayer):
+    """Softmax-CE + lambda/2 * ||features - center_{y}||².
+
+    DOCUMENTED DIVERGENCE: the reference updates class centers with a
+    dedicated alpha moving average outside the optimizer; here the centers
+    are ordinary parameters trained by the same gradient step (the gradient
+    of the center term is alpha*(c_y - f) — the same direction, scheduled by
+    the optimizer instead of a fixed alpha)."""
+
+    alpha: float = 0.05          # accepted for config parity
+    lambda_: float = 0.5
+
+    def init_params(self, key, dtype=jnp.float32):
+        p = super().init_params(key, dtype)
+        p["centers"] = jnp.zeros((self.n_out, self.n_in), dtype)
+        return p
+
+    def compute_score(self, params, x, labels, mask=None, average=True):
+        base = self.loss.compute_score(labels, self.pre_output(params, x),
+                                       self.activation, mask, average)
+        centers_batch = labels @ params["centers"]     # [B, n_in]
+        center_term = 0.5 * self.lambda_ * jnp.sum(
+            (x - centers_batch) ** 2, axis=1)
+        if mask is not None:
+            center_term = center_term * mask.reshape(center_term.shape)
+        return base + (jnp.mean(center_term) if average
+                       else jnp.sum(center_term))
+
+
+# =========================================================================
+# Capsule network trio (reference CapsuleLayer / PrimaryCapsules /
+# CapsuleStrengthLayer)
+# =========================================================================
+
+def _squash(s, axis=-1):
+    n2 = jnp.sum(jnp.square(s), axis=axis, keepdims=True)
+    return (n2 / (1.0 + n2)) * s / jnp.sqrt(n2 + 1e-9)
+
+
+@dataclass
+class PrimaryCapsules(Layer):
+    """Conv → capsule reshape → squash (reference PrimaryCapsules)."""
+
+    capsules: int = 0               # derived if 0
+    capsule_dimensions: int = 8
+    channels: int = 32
+    kernel_size: Tuple[int, int] = (9, 9)
+    stride: Tuple[int, int] = (2, 2)
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, CNNInput):
+            raise ValueError("PrimaryCapsules needs CNN input")
+        self.n_in = input_type.channels
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        oh = (input_type.height - kh) // sh + 1
+        ow = (input_type.width - kw) // sw + 1
+        self.capsules = self.channels * oh * ow
+        return RNNInput(self.capsule_dimensions, self.capsules)
+
+    def init_params(self, key, dtype=jnp.float32):
+        kh, kw = _pair(self.kernel_size)
+        n_out = self.channels * self.capsule_dimensions
+        return {"W": init_weights(key, (n_out, self.n_in, kh, kw),
+                                  self.weight_init or "xavier", dtype),
+                "b": jnp.zeros((n_out,), dtype)}
+
+    def apply(self, params, x, state, training, rng):
+        out = get_op("conv2d").fn(x, params["W"], params["b"],
+                                  strides=_pair(self.stride),
+                                  padding=(0, 0))
+        b = out.shape[0]
+        caps = out.reshape(b, self.capsule_dimensions, -1)
+        caps = jnp.swapaxes(caps, 1, 2)            # [B, caps, capsDim]
+        return _squash(caps), state
+
+
+@dataclass
+class CapsuleLayer(Layer):
+    """Dynamic-routing capsule layer (reference CapsuleLayer). The routing
+    loop is a fixed small iteration count — unrolled at trace time, all
+    matmuls batched on the MXU."""
+
+    capsules: int = 10
+    capsule_dimensions: int = 16
+    routings: int = 3
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, RNNInput):
+            raise ValueError("CapsuleLayer needs capsule input "
+                             "[B, inCaps, inDim]")
+        self._in_caps = input_type.timesteps
+        self.n_in = input_type.size
+        if self._in_caps is None:
+            raise ValueError("CapsuleLayer needs a known capsule count")
+        return RNNInput(self.capsule_dimensions, self.capsules)
+
+    def init_params(self, key, dtype=jnp.float32):
+        return {"W": init_weights(
+            key, (self._in_caps, self.capsules,
+                  self.capsule_dimensions, self.n_in),
+            self.weight_init or "xavier", dtype)}
+
+    def apply(self, params, x, state, training, rng):
+        # u_hat[b,i,j,d] = W[i,j,d,:] · x[b,i,:]
+        u_hat = jnp.einsum("ijdc,bic->bijd", params["W"], x)
+        b_logits = jnp.zeros(u_hat.shape[:3], u_hat.dtype)
+        v = None
+        for r in range(self.routings):
+            c = jax.nn.softmax(b_logits, axis=2)           # over out caps
+            s = jnp.einsum("bij,bijd->bjd", c, u_hat)
+            v = _squash(s)
+            if r < self.routings - 1:
+                b_logits = b_logits + jnp.einsum("bijd,bjd->bij", u_hat, v)
+        return v, state
+
+
+@dataclass
+class CapsuleStrengthLayer(Layer):
+    """Capsule lengths [B, caps, dim] → [B, caps] (reference
+    CapsuleStrengthLayer — the classification read-out)."""
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, RNNInput):
+            raise ValueError("CapsuleStrengthLayer needs capsule input")
+        self.n_in = input_type.size
+        return FFInput(input_type.timesteps)
+
+    def apply(self, params, x, state, training, rng):
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=-1) + 1e-9), state
+
+    @property
+    def has_params(self):
+        return False
+
+
+# =========================================================================
+# YOLOv2 detection head (reference objdetect.Yolo2OutputLayer)
+# =========================================================================
+
+@dataclass
+class Yolo2OutputLayer(Layer):
+    """YOLOv2 composite detection loss (reference Yolo2OutputLayer).
+
+    Input: [B, A*(5+C), H, W] raw activations (A = len(anchors)).
+    Labels (reference label format): [B, 4+C, H, W] — per grid cell the
+    ground-truth box corners (x1, y1, x2, y2, in GRID units) followed by the
+    one-hot class; cells with an all-zero class vector contain no object.
+
+    Loss terms follow the paper/reference: lambda_coord on xy + sqrt-wh of
+    the responsible anchor (best IoU), objectness toward IoU for
+    responsible anchors, lambda_noobj on everything else, softmax-CE on the
+    class distribution of object cells.
+    """
+
+    anchors: Tuple[Tuple[float, float], ...] = ((1.0, 1.0),)
+    lambda_coord: float = 5.0
+    lambda_no_obj: float = 0.5
+    loss: Union[str, ILossFunction, None] = None
+
+    def __post_init__(self):
+        self.anchors = tuple(tuple(map(float, a)) for a in self.anchors)
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, CNNInput):
+            raise ValueError("Yolo2OutputLayer needs CNN input")
+        self.n_in = input_type.channels
+        a = len(self.anchors)
+        if input_type.channels % a:
+            raise ValueError(
+                f"channels {input_type.channels} not divisible by "
+                f"{a} anchors")
+        self._n_classes = input_type.channels // a - 5
+        if self._n_classes < 0:
+            raise ValueError("channels must be anchors*(5+classes)")
+        self._grid = (input_type.height, input_type.width)
+        return input_type
+
+    def _split(self, x):
+        b, _, h, w = x.shape
+        a, c = len(self.anchors), self._n_classes
+        x = x.reshape(b, a, 5 + c, h, w)
+        txy = jax.nn.sigmoid(x[:, :, 0:2])
+        twh = x[:, :, 2:4]
+        conf = jax.nn.sigmoid(x[:, :, 4])
+        cls = x[:, :, 5:]
+        return txy, twh, conf, cls
+
+    def apply(self, params, x, state, training, rng):
+        return x, state
+
+    @property
+    def has_params(self):
+        return False
+
+    def compute_score(self, params, x, labels, mask=None, average=True):
+        txy, twh, conf, cls_logits = self._split(x)
+        b, a, _, h, w = txy.shape
+        anchors = jnp.asarray(self.anchors, dtype=x.dtype)   # [A, 2]
+        gy, gx = jnp.meshgrid(jnp.arange(h, dtype=x.dtype),
+                              jnp.arange(w, dtype=x.dtype), indexing="ij")
+        # predicted boxes in grid units
+        px = gx[None, None] + txy[:, :, 0]
+        py = gy[None, None] + txy[:, :, 1]
+        pw = anchors[None, :, 0, None, None] * jnp.exp(twh[:, :, 0])
+        ph = anchors[None, :, 1, None, None] * jnp.exp(twh[:, :, 1])
+
+        gt_x1, gt_y1 = labels[:, 0], labels[:, 1]
+        gt_x2, gt_y2 = labels[:, 2], labels[:, 3]
+        gt_cls = labels[:, 4:]
+        obj = (jnp.sum(gt_cls, axis=1) > 0).astype(x.dtype)  # [B, H, W]
+        gw = gt_x2 - gt_x1
+        gh = gt_y2 - gt_y1
+        gcx = 0.5 * (gt_x1 + gt_x2)
+        gcy = 0.5 * (gt_y1 + gt_y2)
+
+        # IoU of each anchor's predicted box with the cell's gt box
+        ix1 = jnp.maximum(px - pw / 2, gt_x1[:, None])
+        iy1 = jnp.maximum(py - ph / 2, gt_y1[:, None])
+        ix2 = jnp.minimum(px + pw / 2, gt_x2[:, None])
+        iy2 = jnp.minimum(py + ph / 2, gt_y2[:, None])
+        inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+        union = pw * ph + (gw * gh)[:, None] - inter
+        iou = inter / jnp.maximum(union, 1e-9)               # [B, A, H, W]
+
+        best = jnp.argmax(iou, axis=1)                       # [B, H, W]
+        resp = jax.nn.one_hot(best, a, dtype=x.dtype) \
+            .transpose(0, 3, 1, 2) * obj[:, None]            # [B, A, H, W]
+
+        # coordinate loss (xy within cell + sqrt wh), responsible only
+        tx = gcx - gx[None]
+        ty = gcy - gy[None]
+        xy_l = (txy[:, :, 0] - tx[:, None]) ** 2 + \
+               (txy[:, :, 1] - ty[:, None]) ** 2
+        wh_l = (jnp.sqrt(jnp.maximum(pw, 1e-9))
+                - jnp.sqrt(jnp.maximum(gw, 1e-9))[:, None]) ** 2 + \
+               (jnp.sqrt(jnp.maximum(ph, 1e-9))
+                - jnp.sqrt(jnp.maximum(gh, 1e-9))[:, None]) ** 2
+        coord = self.lambda_coord * jnp.sum(resp * (xy_l + wh_l),
+                                            axis=(1, 2, 3))
+
+        # objectness: responsible → IoU target; others → 0
+        obj_l = jnp.sum(resp * (conf - jax.lax.stop_gradient(iou)) ** 2,
+                        axis=(1, 2, 3))
+        noobj_l = self.lambda_no_obj * jnp.sum(
+            (1.0 - resp) * conf ** 2, axis=(1, 2, 3))
+
+        # classification: softmax-CE over classes at object cells,
+        # responsible anchor
+        logp = jax.nn.log_softmax(cls_logits, axis=2)
+        ce = -jnp.sum(gt_cls[:, None] * logp, axis=2)        # [B, A, H, W]
+        cls_l = jnp.sum(resp * ce, axis=(1, 2, 3))
+
+        total = coord + obj_l + noobj_l + cls_l              # [B]
+        return jnp.mean(total) if average else jnp.sum(total)

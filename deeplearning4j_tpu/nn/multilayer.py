@@ -110,6 +110,9 @@ class MultiLayerNetwork:
     def _apply_layer(self, layer, lp, x, st, training, rng, fmask):
         """One layer forward, routing through apply_masked when a
         per-timestep feature mask is present (SURVEY §5.7)."""
+        if layer.weight_noise is not None:
+            rng, sub = jax.random.split(rng)
+            lp = layer.weight_noise.apply(lp, sub, training)
         if fmask is not None:
             return layer.apply_masked(lp, x, st, training, rng, fmask)
         return layer.apply(lp, x, st, training, rng)
@@ -206,8 +209,9 @@ class MultiLayerNetwork:
     def _loss(self, params, states, x, labels, mask, training: bool, rng,
               fmask=None, rnn_states=None):
         out_layer = self.layers[-1]
-        if not isinstance(out_layer, (L.OutputLayer, L.LossLayer)):
-            raise ValueError("last layer must be an OutputLayer/LossLayer to train")
+        if not hasattr(out_layer, "compute_score"):
+            raise ValueError("last layer must be a loss head (OutputLayer/"
+                             "LossLayer/Yolo2OutputLayer/...) to train")
         if rnn_states is not None:
             pre, new_states, new_rnn = self._forward_to_preout(
                 params, states, x, training, rng, fmask, rnn_states)
@@ -302,9 +306,28 @@ class MultiLayerNetwork:
                 # original tensors also shields them from stateful-updater
                 # side effects (weight decay, momentum drift)
                 new_params[i] = params[i]
+            new_params = self._apply_constraints(new_params)
             return new_params, new_states, new_upd, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _apply_constraints(self, params):
+        """Project weights after each update (reference BaseConstraint —
+        applied to weight params, biases/norm params excluded)."""
+        out = params
+        for i, layer in enumerate(self.layers):
+            cs = getattr(layer, "constraints", None)
+            if not cs:
+                continue
+            lp = dict(out[i])
+            for name, w in lp.items():
+                if name in ("b", "beta", "gamma", "mean", "var", "centers"):
+                    continue
+                for c in cs:
+                    w = c.apply(w)
+                lp[name] = w
+            out[i] = lp
+        return out
 
     def _build_tbptt_step(self):
         """TBPTT segment step (reference: MultiLayerNetwork
@@ -330,6 +353,7 @@ class MultiLayerNetwork:
             new_params, new_upd = updater.apply(grads, upd_state, params, iteration)
             for i in frozen:
                 new_params[i] = params[i]
+            new_params = self._apply_constraints(new_params)
             return new_params, new_states, new_upd, new_rnn, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
@@ -372,6 +396,56 @@ class MultiLayerNetwork:
             for lst in self._listeners:
                 if hasattr(lst, "epoch_done"):
                     lst.epoch_done(self, self._epoch)
+
+    def pretrain(self, data, epochs: int = 1) -> None:
+        """Layerwise unsupervised pretraining (reference:
+        MultiLayerNetwork.pretrain(DataSetIterator) over pretrainable
+        layers — here the VariationalAutoencoder's negative ELBO). Each
+        pretrainable layer is optimized on the inference-mode activations
+        of the layers below it, with a fresh instance of the configured
+        updater."""
+        self._check_init()
+        updater = self.conf.global_conf.updater
+        for idx, layer in enumerate(self.layers):
+            if not getattr(layer, "is_pretrain_layer", lambda: False)():
+                continue
+
+            def below(params, x, key, idx=idx):
+                for i, ll in enumerate(self.layers[:idx]):
+                    pre = self.conf.preprocessors.get(i)
+                    if pre is not None:
+                        x = pre(x)
+                    key, sub = jax.random.split(key)
+                    x, _ = ll.apply(params[i], x, self._states[i], False, sub)
+                pre = self.conf.preprocessors.get(idx)
+                return pre(x) if pre is not None else x
+
+            def step(lp, upd_state, params, x, key, it, idx=idx,
+                     layer=layer):
+                feats = below(params, x, key)
+
+                def loss_fn(p):
+                    return layer.pretrain_loss(p, feats, key)
+
+                loss, grads = jax.value_and_grad(loss_fn)(lp)
+                new_lp, new_upd = updater.apply(grads, upd_state, lp, it)
+                return new_lp, new_upd, loss
+
+            step = jax.jit(step, donate_argnums=(0, 1))
+            lp = self._params[idx]
+            upd_state = updater.init(lp)
+            it = 0
+            for _ in range(max(1, epochs)):
+                for ds in _iter_data(data, None):
+                    x = jnp.asarray(ds.features.value)
+                    lp, upd_state, loss = step(
+                        lp, upd_state, self._params, x,
+                        get_random().next_key(), jnp.asarray(it))
+                    it += 1
+                    self._score_dev = loss
+            self._params[idx] = lp
+            self._fit_step = None
+            self._infer_fn = None
 
     def _fit_tbptt(self, x, y, mask, fmask, key):
         """Split [B, T, F] into tbptt_fwd_length segments, carrying recurrent
@@ -496,8 +570,11 @@ class MultiLayerNetwork:
 
         net = MultiLayerNetwork(copy.deepcopy(self.conf))
         net.init()
-        net._params = jax.tree.map(lambda a: a, self._params)
-        net._states = jax.tree.map(lambda a: a, self._states)
+        # REAL buffer copies (jnp.array), not aliases: the source's fit
+        # step donates its param buffers, which would invalidate an
+        # aliasing clone the next time the source trains
+        net._params = jax.tree.map(jnp.array, self._params)
+        net._states = jax.tree.map(jnp.array, self._states)
         return net
 
 

@@ -178,8 +178,10 @@ class TransferLearning:
                         f"layer {i} shape mismatch carrying weights over: "
                         f"{ {k: v.shape for k, v in src_p.items()} } vs "
                         f"{ {k: v.shape for k, v in dst_p.items()} }")
-                net._params[i] = jax.tree.map(lambda a: a, src_p)
-                net._states[i] = jax.tree.map(lambda a: a, src._states[i])
+                # real copies — the source model's donating fit step must
+                # not invalidate the transferred net's buffers
+                net._params[i] = jax.tree.map(jnp.array, src_p)
+                net._states[i] = jax.tree.map(jnp.array, src._states[i])
             return net
 
     @staticmethod
@@ -254,8 +256,8 @@ class TransferLearningHelper:
             net = MultiLayerNetwork(conf).init(gc.seed)
             for j, i in enumerate(range(self.frozen_until + 1,
                                         len(model.layers))):
-                net._params[j] = jax.tree.map(lambda a: a, model._params[i])
-                net._states[j] = jax.tree.map(lambda a: a, model._states[i])
+                net._params[j] = jax.tree.map(jnp.array, model._params[i])
+                net._states[j] = jax.tree.map(jnp.array, model._states[i])
             self._top = net
         return self._top
 

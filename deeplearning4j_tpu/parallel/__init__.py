@@ -7,3 +7,4 @@ from .wrapper import ParallelWrapper
 from .sharding import tp_param_specs, tp_shardings, apply_tp
 from .inference import ParallelInference
 from .distributed import SharedTrainingMaster, initialize, shutdown
+from .ring_attention import ring_attention, ring_self_attention

@@ -1,0 +1,13 @@
+"""Reinforcement learning (reference: rl4j, SURVEY §2.3 row 26).
+
+- ``mdp``  MDP SPI + CartPole / GridWorld environments
+- ``dqn``  QLearningDiscreteDense, ExpReplay, EpsGreedy, DQNPolicy
+"""
+
+from .dqn import (DQNPolicy, EpsGreedy, ExpReplay, QLConfiguration,
+                  QLearningDiscreteDense)
+from .mdp import MDP, CartPole, DiscreteSpace, GridWorld, ObservationSpace
+
+__all__ = ["CartPole", "DQNPolicy", "DiscreteSpace", "EpsGreedy",
+           "ExpReplay", "GridWorld", "MDP", "ObservationSpace",
+           "QLConfiguration", "QLearningDiscreteDense"]

@@ -51,6 +51,8 @@ def _masked_crc(data: bytes) -> int:
 # --- minimal protobuf encoding ----------------------------------------------
 
 def _varint(n: int) -> bytes:
+    if n < 0:
+        raise ValueError(f"protobuf varint fields here are unsigned; got {n}")
     out = bytearray()
     while True:
         b = n & 0x7F

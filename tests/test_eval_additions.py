@@ -1,0 +1,187 @@
+"""Round-2 eval additions: ROC thresholded/spill mode, ROCBinary,
+EvaluationCalibration (reference: nd4j evaluation.classification.*;
+round-1 VERDICT weak #8 + missing EvaluationCalibration/ROCBinary)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval import (EvaluationCalibration, ROC, ROCBinary)
+
+
+class TestROCThresholded:
+    def _data(self, n=2000, seed=0):
+        rng = np.random.RandomState(seed)
+        y = rng.randint(0, 2, n)
+        # informative scores: positives skew high
+        s = np.clip(rng.rand(n) * 0.6 + y * 0.4 * rng.rand(n), 0, 1)
+        return y, s
+
+    def test_thresholded_auc_close_to_exact(self):
+        y, s = self._data()
+        exact = ROC()
+        exact.eval(y, s)
+        binned = ROC(num_thresholds=200)
+        binned.eval(y, s)
+        assert abs(exact.calculate_auc() - binned.calculate_auc()) < 0.01
+        assert abs(exact.calculate_auprc() - binned.calculate_auprc()) < 0.02
+
+    def test_exact_mode_spills_to_bounded_memory(self):
+        roc = ROC(max_exact_examples=1000)
+        y, s = self._data(n=600)
+        roc.eval(y, s)
+        assert not roc.spilled
+        auc_before = roc.calculate_auc()
+        roc.eval(y, s)          # crosses the limit
+        assert roc.spilled
+        assert not roc._labels  # raw pairs released
+        assert abs(roc.calculate_auc() - auc_before) < 0.01
+
+    def test_merge_mixed_modes(self):
+        y, s = self._data(n=500)
+        a = ROC(num_thresholds=200)
+        a.eval(y, s)
+        b = ROC()               # exact
+        b.eval(y, s)
+        a.merge(b)
+        ref = ROC(num_thresholds=200)
+        ref.eval(np.concatenate([y, y]), np.concatenate([s, s]))
+        assert abs(a.calculate_auc() - ref.calculate_auc()) < 1e-9
+
+    def test_perfect_separation_auc_one(self):
+        roc = ROC(num_thresholds=100)
+        roc.eval(np.array([0, 0, 1, 1]), np.array([0.1, 0.2, 0.8, 0.9]))
+        assert roc.calculate_auc() > 0.99
+
+
+class TestROCBinary:
+    def test_per_label_auc(self):
+        rng = np.random.RandomState(0)
+        n = 500
+        y = rng.randint(0, 2, (n, 3))
+        s = np.clip(rng.rand(n, 3) * 0.5 + y * 0.5 * rng.rand(n, 3), 0, 1)
+        s[:, 2] = rng.rand(n)      # label 2: uninformative
+        rb = ROCBinary()
+        rb.eval(y, s)
+        assert rb.num_labels() == 3
+        assert rb.calculate_auc(0) > 0.8
+        assert abs(rb.calculate_auc(2) - 0.5) < 0.1
+        avg = rb.calculate_average_auc()
+        assert rb.calculate_auc(2) < avg < rb.calculate_auc(0)
+
+    def test_mask_excludes_rows(self):
+        rb = ROCBinary()
+        y = np.array([[1], [0], [1], [0]])
+        s = np.array([[0.9], [0.1], [0.2], [0.8]])
+        mask = np.array([[1], [1], [0], [0]])   # keep only the correct pair
+        rb.eval(y, s, mask)
+        assert rb.calculate_auc(0) == 1.0
+
+    def test_merge(self):
+        y = np.array([[1], [0]])
+        s = np.array([[0.9], [0.1]])
+        a, b = ROCBinary(), ROCBinary()
+        a.eval(y, s)
+        b.eval(1 - y, s)
+        a.merge(b)
+        assert abs(a.calculate_auc(0) - 0.5) < 1e-9
+
+
+class TestEvaluationCalibration:
+    def test_well_calibrated_low_ece(self):
+        rng = np.random.RandomState(0)
+        n = 20000
+        p = rng.rand(n)
+        y = (rng.rand(n) < p).astype(float)   # perfectly calibrated
+        ec = EvaluationCalibration(reliability_bins=10)
+        ec.eval(np.stack([1 - y, y], 1), np.stack([1 - p, p], 1))
+        assert ec.expected_calibration_error(1) < 0.03
+
+    def test_overconfident_high_ece(self):
+        rng = np.random.RandomState(1)
+        n = 5000
+        p = np.full(n, 0.95)
+        y = (rng.rand(n) < 0.6).astype(float)  # claims 95%, delivers 60%
+        ec = EvaluationCalibration()
+        ec.eval(y[:, None], p[:, None])
+        assert ec.expected_calibration_error(0) > 0.25
+
+    def test_reliability_info_and_histogram(self):
+        ec = EvaluationCalibration(reliability_bins=4, histogram_bins=4)
+        y = np.array([[1.0], [0.0], [1.0], [1.0]])
+        p = np.array([[0.9], [0.1], [0.85], [0.3]])
+        ec.eval(y, p)
+        mean_p, frac, counts = ec.get_reliability_info(0)
+        assert counts.sum() == 4
+        assert counts[3] == 2          # two preds in [0.75, 1)
+        np.testing.assert_allclose(frac[3], 1.0)
+        np.testing.assert_allclose(mean_p[3], (0.9 + 0.85) / 2)
+        hist = ec.get_probability_histogram(0)
+        assert hist.sum() == 4
+
+    def test_merge(self):
+        y = np.array([[1.0], [0.0]])
+        p = np.array([[0.8], [0.2]])
+        a, b = EvaluationCalibration(), EvaluationCalibration()
+        a.eval(y, p)
+        b.eval(y, p)
+        a.merge(b)
+        _, _, counts = a.get_reliability_info(0)
+        assert counts.sum() == 4
+
+
+class TestMergeRegressions:
+    """Merge must adopt peer bin counts and never alias source state
+    (review findings)."""
+
+    def test_exact_merge_into_nonstandard_bins(self):
+        y = np.array([0, 1, 0, 1]); s = np.array([0.1, 0.9, 0.3, 0.7])
+        a = ROC()                      # exact
+        a.eval(y, s)
+        b = ROC(num_thresholds=4)
+        b.eval(y, s)
+        a.merge(b)                     # a adopts 4 bins
+        assert a.num_thresholds == 4
+        ref = ROC(num_thresholds=4)
+        ref.eval(np.tile(y, 2), np.tile(s, 2))
+        assert a.calculate_auc() == pytest.approx(ref.calculate_auc())
+
+    def test_binned_merge_exact_peer_not_mutated(self):
+        y = np.array([0, 1]); s = np.array([0.2, 0.8])
+        a = ROC(num_thresholds=8)
+        a.eval(y, s)
+        b = ROC()
+        b.eval(y, s)
+        a.merge(b)
+        assert not b.spilled and b._labels   # peer untouched
+        assert b.calculate_auc() == 1.0
+
+    def test_rocbinary_merge_does_not_alias_source(self):
+        y = np.array([[1], [0]]); s = np.array([[0.9], [0.1]])
+        a, b = ROCBinary(), ROCBinary()
+        b.eval(y, s)
+        a.merge(b)
+        before = b.calculate_auc(0)
+        a.eval(1 - y, s)               # must not leak into b
+        assert b.calculate_auc(0) == before
+
+    def test_calibration_merge_does_not_alias_source(self):
+        y = np.array([[1.0]]); p = np.array([[0.8]])
+        src = EvaluationCalibration()
+        src.eval(y, p)
+        merged = EvaluationCalibration()
+        merged.merge(src)
+        merged.merge(src)              # in-place += on the adopted arrays
+        _, _, src_counts = src.get_reliability_info(0)
+        assert src_counts.sum() == 1   # source unchanged
+        _, _, m_counts = merged.get_reliability_info(0)
+        assert m_counts.sum() == 2
+
+    def test_calibration_2d_mask(self):
+        ec = EvaluationCalibration(reliability_bins=4)
+        y = np.array([[1.0, 0.0], [0.0, 1.0]])
+        p = np.array([[0.9, 0.1], [0.2, 0.8]])
+        mask = np.array([[1, 0], [1, 0]])   # only column 0 rows counted
+        ec.eval(y, p, mask)
+        _, _, c0 = ec.get_reliability_info(0)
+        _, _, c1 = ec.get_reliability_info(1)
+        assert c0.sum() == 2 and c1.sum() == 0

@@ -329,3 +329,60 @@ class TestVertexSerde:
         x = np.random.RandomState(0).randn(2, 3, 32, 32).astype(np.float32)
         np.testing.assert_allclose(back.output(x)[0].to_numpy(),
                                    g.output(x)[0].to_numpy(), atol=1e-5)
+
+
+class TestZooAdditions:
+    """Round-2 zoo additions (round-1 VERDICT partial #24): TinyYOLO, YOLO2,
+    Xception, InceptionResNetV1 — build, forward-shape, and one train step."""
+
+    def test_tiny_yolo_builds_and_steps(self):
+        from deeplearning4j_tpu.models import TinyYOLO
+
+        m = TinyYOLO(num_classes=4, image_size=64).init()
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 64, 64).astype(np.float32)
+        out = m.output(x)
+        assert out.shape == (2, 5 * (5 + 4), 3, 3)   # 5 anchors, 3x3 grid
+        lab = np.zeros((2, 4 + 4, 3, 3), np.float32)
+        lab[:, 0, 1, 1] = 0.8
+        lab[:, 1, 1, 1] = 0.8
+        lab[:, 2, 1, 1] = 1.6
+        lab[:, 3, 1, 1] = 1.6
+        lab[:, 4, 1, 1] = 1.0
+        m.fit(DataSet(x, lab), epochs=1)
+        assert np.isfinite(float(m.score_value))
+
+    def test_yolo2_passthrough_graph(self):
+        from deeplearning4j_tpu.models import YOLO2
+
+        g = YOLO2(num_classes=4, image_size=64).init()
+        x = np.random.RandomState(0).randn(1, 3, 64, 64).astype(np.float32)
+        outs = g.output({"input": x})
+        # 64/32 = 2x2 grid after 5 pools; 5 anchors * (5+4) = 45 channels
+        assert outs[0].shape == (1, 45, 2, 2)
+        # passthrough exists: a SpaceToDepth layer feeds a MergeVertex
+        from deeplearning4j_tpu.nn.conf.layers import SpaceToDepthLayer
+
+        assert any(isinstance(getattr(n, "layer", None), SpaceToDepthLayer)
+                   for n in g.conf.nodes.values())
+
+    def test_xception_builds_and_forwards(self):
+        from deeplearning4j_tpu.models import Xception
+
+        g = Xception(num_classes=10, image_size=96).init()
+        x = np.random.RandomState(1).randn(1, 3, 96, 96).astype(np.float32)
+        assert g.output({"input": x})[0].shape == (1, 10)
+        # separable-conv based: most conv params are separable pairs
+        from deeplearning4j_tpu.nn.conf.layers import SeparableConvolution2D
+
+        n_sep = sum(isinstance(getattr(n, "layer", None),
+                               SeparableConvolution2D)
+                    for n in g.conf.nodes.values())
+        assert n_sep >= 30   # 2*3 entry + 24 middle + 2 exit + 2 tail
+
+    def test_inception_resnet_v1_builds_and_forwards(self):
+        from deeplearning4j_tpu.models import InceptionResNetV1
+
+        g = InceptionResNetV1(num_classes=16, image_size=96).init()
+        x = np.random.RandomState(2).randn(1, 3, 96, 96).astype(np.float32)
+        assert g.output({"input": x})[0].shape == (1, 16)

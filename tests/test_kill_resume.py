@@ -10,6 +10,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+REPO_ROOT = str(Path(__file__).resolve().parents[1])
+
 import numpy as np
 
 _WORKER = r"""
@@ -74,13 +76,13 @@ def test_kill_and_resume_continues_from_checkpoint(tmp_path):
     env.setdefault("JAX_PLATFORMS", "cpu")
     # the worker script lives in tmp; python prepends the SCRIPT dir (not
     # cwd) to sys.path, so point it at the repo explicitly
-    env["PYTHONPATH"] = "/root/repo" + (
+    env["PYTHONPATH"] = REPO_ROOT + (
         os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
 
     # phase 1: train, die hard at iteration 12
     p1 = subprocess.run([sys.executable, str(script), str(ckpt), str(log),
                          "fresh"], env=env, capture_output=True, text=True,
-                        timeout=300, cwd="/root/repo")
+                        timeout=300, cwd=REPO_ROOT)
     assert p1.returncode == 137, p1.stderr[-2000:]
     rows1 = [json.loads(l) for l in log.read_text().splitlines()]
     assert rows1[-1]["iteration"] == 12
@@ -91,7 +93,7 @@ def test_kill_and_resume_continues_from_checkpoint(tmp_path):
     # phase 2: relaunch, resume, finish
     p2 = subprocess.run([sys.executable, str(script), str(ckpt), str(log),
                          "resume"], env=env, capture_output=True, text=True,
-                        timeout=300, cwd="/root/repo")
+                        timeout=300, cwd=REPO_ROOT)
     assert p2.returncode == 0, p2.stderr[-2000:]
     assert "DONE 30" in p2.stdout
 

@@ -1,0 +1,461 @@
+"""Extended layer family tests: 1D/3D conv stacks, locally connected,
+capsules, VAE (+ pretrain), YOLOv2 head, center loss, spatial reshapes,
+dropout variants, constraints, weight noise (reference test model: dl4j
+ConvolutionLayerTest/Convolution3DTest/CapsNetMNISTTest/TestVAE/
+YoloGradientCheckTests + constraint tests)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.data import DataSet
+from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf import layers as L
+
+from gradcheck import check_gradients
+
+
+def _gradcheck_model(model, ds, sample=16):
+    grads, _ = model.compute_gradient_and_score(ds)
+    flat_grads, flat_params = {}, {}
+    for i, lp in enumerate(model._params):
+        for k, v in lp.items():
+            flat_params[f"{i}:{k}"] = np.asarray(v, np.float64)
+            flat_grads[f"{i}:{k}"] = np.asarray(grads[i][k], np.float64)
+
+    def loss_fn(p):
+        saved = model._params
+        model._params = [
+            {k: jnp.asarray(p[f"{i}:{k}"]) for k in lp}
+            for i, lp in enumerate(saved)]
+        try:
+            return model.score(ds)
+        finally:
+            model._params = saved
+
+    check_gradients(loss_fn, flat_params, flat_grads, sample=sample)
+
+
+def _build(input_type, *layers, dtype="float64", updater=None):
+    b = (NeuralNetConfiguration.builder().seed(3).data_type(dtype)
+         .activation("tanh")
+         .updater(updater or Sgd(learning_rate=0.1)).list())
+    for l in layers:
+        b = b.layer(l)
+    return MultiLayerNetwork(
+        b.set_input_type(input_type).build()).init()
+
+
+# ---------------------------------------------------------------- 1D convs
+class TestConv1DFamily:
+    def test_conv1d_shapes_and_gradcheck(self):
+        model = _build(
+            InputType.recurrent(4, 10),
+            L.Convolution1DLayer(n_out=6, kernel_size=3),
+            L.Subsampling1DLayer(kernel_size=2, stride=2),
+            L.GlobalPoolingLayer(pooling_type="avg"),
+            L.OutputLayer(n_out=3, loss="mcxent", activation="softmax"))
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 10, 4)
+        acts = model.feed_forward(x)
+        assert acts[1].shape == (2, 8, 6)    # T: 10-3+1
+        assert acts[2].shape == (2, 4, 6)    # pooled
+        ds = DataSet(x, np.eye(3)[rng.randint(0, 3, 2)])
+        _gradcheck_model(model, ds)
+
+    def test_conv1d_matches_manual_convolution(self):
+        layer = L.Convolution1DLayer(n_out=1, kernel_size=2, n_in=1,
+                                     activation="identity")
+        w = jnp.asarray(np.array([[[1.0, 2.0]]]))    # [O=1, I=1, K=2]
+        x = jnp.asarray(np.arange(5, dtype=np.float64).reshape(1, 5, 1))
+        out, _ = layer.apply({"W": w, "b": jnp.zeros(1)}, x, {}, False, None)
+        # cross-correlation (no kernel flip): out[t] = 1*x[t] + 2*x[t+1]
+        np.testing.assert_allclose(np.asarray(out)[0, :, 0],
+                                   [0 + 2 * 1, 1 + 2 * 2, 2 + 2 * 3,
+                                    3 + 2 * 4])
+
+    def test_pad_crop_upsample_1d(self):
+        model = _build(
+            InputType.recurrent(2, 6),
+            L.ZeroPadding1DLayer(padding=(1, 2)),
+            L.Cropping1D(cropping=(2, 1)),
+            L.Upsampling1D(size=2),
+            L.GlobalPoolingLayer(pooling_type="avg"),
+            L.OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 6, 2)
+        acts = model.feed_forward(x)
+        assert acts[1].shape == (2, 9, 2)
+        assert acts[2].shape == (2, 6, 2)
+        assert acts[3].shape == (2, 12, 2)
+        np.testing.assert_allclose(np.asarray(acts[3].value)[:, 0],
+                                   np.asarray(acts[3].value)[:, 1])
+
+
+# ---------------------------------------------------------------- 3D convs
+class TestConv3DFamily:
+    def test_conv3d_stack_shapes_and_gradcheck(self):
+        model = _build(
+            InputType.convolutional_3d(6, 6, 6, 2),
+            L.Convolution3DLayer(n_out=3, kernel_size=(3, 3, 3)),
+            L.Subsampling3DLayer(kernel_size=(2, 2, 2), stride=(2, 2, 2)),
+            L.FlattenToFF() if hasattr(L, "FlattenToFF") else
+            L.GlobalPooling3D() if hasattr(L, "GlobalPooling3D") else
+            _Flatten3D(),
+            L.OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 2, 6, 6, 6)
+        acts = model.feed_forward(x)
+        assert acts[1].shape == (2, 3, 4, 4, 4)
+        assert acts[2].shape == (2, 3, 2, 2, 2)
+        ds = DataSet(x, np.eye(2)[rng.randint(0, 2, 2)])
+        _gradcheck_model(model, ds)
+
+    def test_pad_crop_upsample_3d(self):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(1, 2, 4, 4, 4))
+        pad = L.ZeroPadding3DLayer(padding=(1, 0, 2))
+        out, _ = pad.apply({}, x, {}, False, None)
+        assert out.shape == (1, 2, 6, 4, 8)
+        crop = L.Cropping3D(cropping=(1, 1, 1))
+        out, _ = crop.apply({}, x, {}, False, None)
+        assert out.shape == (1, 2, 2, 2, 2)
+        up = L.Upsampling3D(size=(2, 1, 2))
+        out, _ = up.apply({}, x, {}, False, None)
+        assert out.shape == (1, 2, 8, 4, 8)
+
+
+class _Flatten3D(L.Layer):
+    """Test-local NCDHW → FF flatten."""
+
+    def set_input_type(self, input_type):
+        self.n_in = (input_type.channels * input_type.depth
+                     * input_type.height * input_type.width)
+        from deeplearning4j_tpu.nn.conf.inputs import FFInput
+
+        return FFInput(self.n_in)
+
+    def init_params(self, key, dtype=jnp.float64):
+        return {}
+
+    def apply(self, params, x, state, training, rng):
+        return x.reshape(x.shape[0], -1), state
+
+    @property
+    def has_params(self):
+        return False
+
+
+# -------------------------------------------------------- locally connected
+class TestLocallyConnected:
+    def test_lc2d_differs_per_position_and_gradchecks(self):
+        model = _build(
+            InputType.convolutional(6, 6, 1),
+            L.LocallyConnected2D(n_out=2, kernel_size=(3, 3),
+                                 stride=(3, 3)),
+            L.GlobalPoolingLayer(pooling_type="avg"),
+            L.OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 1, 6, 6)
+        acts = model.feed_forward(x)
+        assert acts[1].shape == (2, 2, 2, 2)
+        # unshared weights: same patch content at different positions
+        # yields different outputs
+        x_same = np.zeros((1, 1, 6, 6))
+        x_same[0, 0, :3, :3] = 1.0
+        x_same[0, 0, 3:, 3:] = 1.0
+        out = np.asarray(model.feed_forward(x_same)[1].value)
+        assert not np.allclose(out[0, :, 0, 0], out[0, :, 1, 1])
+        ds = DataSet(x, np.eye(2)[rng.randint(0, 2, 2)])
+        _gradcheck_model(model, ds)
+
+    def test_lc1d_shapes_and_gradcheck(self):
+        model = _build(
+            InputType.recurrent(3, 8),
+            L.LocallyConnected1D(n_out=4, kernel_size=3, stride=1),
+            L.GlobalPoolingLayer(pooling_type="avg"),
+            L.OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 8, 3)
+        assert model.feed_forward(x)[1].shape == (2, 6, 4)
+        ds = DataSet(x, np.eye(2)[rng.randint(0, 2, 2)])
+        _gradcheck_model(model, ds)
+
+
+# ------------------------------------------------- reshapes + seq utilities
+class TestReshapesAndSeq:
+    def test_space_to_depth_layer(self):
+        model = _build(
+            InputType.convolutional(4, 4, 2),
+            L.SpaceToDepthLayer(block_size=2),
+            L.GlobalPoolingLayer(pooling_type="avg"),
+            L.OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+        x = np.random.RandomState(0).randn(2, 2, 4, 4)
+        assert model.feed_forward(x)[1].shape == (2, 8, 2, 2)
+
+    def test_repeat_vector_and_time_distributed(self):
+        model = _build(
+            InputType.feed_forward(3),
+            L.RepeatVector(n=4),
+            L.TimeDistributed(layer=L.DenseLayer(n_out=5)),
+            L.GlobalPoolingLayer(pooling_type="avg"),
+            L.OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3)
+        acts = model.feed_forward(x)
+        assert acts[1].shape == (2, 4, 3)
+        assert acts[2].shape == (2, 4, 5)
+        # identical timesteps in → identical out per step
+        a2 = np.asarray(acts[2].value)
+        np.testing.assert_allclose(a2[:, 0], a2[:, 3], rtol=1e-6)
+        ds = DataSet(x, np.eye(2)[rng.randint(0, 2, 2)])
+        _gradcheck_model(model, ds)
+
+
+# -------------------------------------------------------- dropout variants
+class TestDropoutVariants:
+    def _one(self, layer):
+        model = _build(InputType.feed_forward(6), layer,
+                       L.OutputLayer(n_out=2, loss="mcxent",
+                                     activation="softmax"),
+                       dtype="float32")
+        x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+        # inference: identity
+        a_inf = np.asarray(model.feed_forward(x, training=False)[1].value)
+        np.testing.assert_allclose(a_inf, x, rtol=1e-6)
+        # training: perturbs
+        a_tr = np.asarray(model.feed_forward(x, training=True)[1].value)
+        assert not np.allclose(a_tr, x)
+
+    def test_alpha_dropout(self):
+        self._one(L.AlphaDropoutLayer(rate=0.5))
+
+    def test_gaussian_dropout(self):
+        self._one(L.GaussianDropoutLayer(rate=0.5))
+
+    def test_gaussian_noise(self):
+        self._one(L.GaussianNoiseLayer(stddev=0.5))
+
+
+# --------------------------------------------- constraints + weight noise
+class TestConstraintsAndNoise:
+    def test_max_norm_constraint_enforced_after_updates(self):
+        layer = L.DenseLayer(n_out=8, constraints=[L.MaxNormConstraint(1.0)])
+        model = _build(InputType.feed_forward(4), layer,
+                       L.OutputLayer(n_out=2, loss="mcxent",
+                                     activation="softmax"),
+                       dtype="float32", updater=Sgd(learning_rate=2.0))
+        rng = np.random.RandomState(0)
+        ds = DataSet(rng.randn(16, 4).astype(np.float32),
+                     np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)])
+        model.fit(ds, epochs=10)
+        norms = np.linalg.norm(np.asarray(model._params[0]["W"]), axis=0)
+        assert (norms <= 1.0 + 1e-5).all(), norms
+
+    def test_non_negative_constraint(self):
+        layer = L.DenseLayer(n_out=8,
+                             constraints=[L.NonNegativeConstraint()])
+        model = _build(InputType.feed_forward(4), layer,
+                       L.OutputLayer(n_out=2, loss="mcxent",
+                                     activation="softmax"),
+                       dtype="float32", updater=Sgd(learning_rate=0.5))
+        rng = np.random.RandomState(1)
+        ds = DataSet(rng.randn(16, 4).astype(np.float32),
+                     np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)])
+        model.fit(ds, epochs=5)
+        assert (np.asarray(model._params[0]["W"]) >= 0).all()
+
+    def test_unit_norm_constraint(self):
+        c = L.UnitNormConstraint()
+        w = jnp.asarray(np.random.RandomState(0).randn(5, 3))
+        out = np.asarray(c.apply(w))
+        np.testing.assert_allclose(np.linalg.norm(out, axis=0), 1.0,
+                                   rtol=1e-6)
+
+    def test_drop_connect_trains_and_inference_deterministic(self):
+        layer = L.DenseLayer(n_out=8, weight_noise=L.DropConnect(0.5))
+        model = _build(InputType.feed_forward(4), layer,
+                       L.OutputLayer(n_out=2, loss="mcxent",
+                                     activation="softmax"),
+                       dtype="float32", updater=Sgd(learning_rate=0.3))
+        rng = np.random.RandomState(2)
+        x = rng.randn(8, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 8)]
+        model.fit(DataSet(x, y), epochs=5)
+        o1 = model.output(x).to_numpy()
+        o2 = model.output(x).to_numpy()
+        np.testing.assert_allclose(o1, o2)   # no noise at inference
+
+    def test_weight_noise_additive(self):
+        noise = L.WeightNoise(stddev=0.5, additive=True)
+        import jax
+
+        params = {"W": jnp.ones((4, 4)), "b": jnp.zeros(4)}
+        out = noise.apply(params, jax.random.PRNGKey(0), True)
+        assert not np.allclose(np.asarray(out["W"]), 1.0)
+        np.testing.assert_allclose(np.asarray(out["b"]), 0.0)  # bias skipped
+        same = noise.apply(params, jax.random.PRNGKey(0), False)
+        np.testing.assert_allclose(np.asarray(same["W"]), 1.0)
+
+
+# -------------------------------------------------------------------- VAE
+class TestVAE:
+    def test_supervised_forward_is_posterior_mean(self):
+        model = _build(
+            InputType.feed_forward(6),
+            L.VariationalAutoencoder(n_out=3, encoder_layer_sizes=(8,),
+                                     decoder_layer_sizes=(8,)),
+            L.OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+        x = np.random.RandomState(0).randn(4, 6)
+        assert model.feed_forward(x)[1].shape == (4, 3)
+
+    def test_pretrain_improves_elbo_and_reconstruction(self):
+        import jax
+
+        model = _build(
+            InputType.feed_forward(6),
+            L.VariationalAutoencoder(n_out=3, encoder_layer_sizes=(16,),
+                                     decoder_layer_sizes=(16,)),
+            L.OutputLayer(n_out=2, loss="mcxent", activation="softmax"),
+            dtype="float32", updater=Adam(learning_rate=0.01))
+        rng = np.random.RandomState(0)
+        # structured data: 2 clusters in 6-D
+        centers = rng.randn(2, 6) * 2
+        x = (centers[rng.randint(0, 2, 128)]
+             + rng.randn(128, 6) * 0.3).astype(np.float32)
+        ds = DataSet(x, np.zeros((128, 2), np.float32))
+        vae = model.layers[0]
+        key = jax.random.PRNGKey(0)
+        before = float(vae.pretrain_loss(model._params[0],
+                                         jnp.asarray(x), key))
+        model.pretrain(ds, epochs=60)
+        after = float(vae.pretrain_loss(model._params[0],
+                                        jnp.asarray(x), key))
+        assert after < before * 0.8, (before, after)
+        rec = float(vae.reconstruction_error(model._params[0],
+                                             jnp.asarray(x), key))
+        assert np.isfinite(rec)
+
+    def test_vae_gradcheck_supervised_path(self):
+        model = _build(
+            InputType.feed_forward(4),
+            L.VariationalAutoencoder(n_out=2, encoder_layer_sizes=(5,),
+                                     decoder_layer_sizes=(5,)),
+            L.OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+        rng = np.random.RandomState(0)
+        ds = DataSet(rng.randn(3, 4), np.eye(2)[rng.randint(0, 2, 3)])
+        # decoder params get zero grads on the supervised path — check only
+        # encoder + head coords via the standard harness (zero-vs-zero passes)
+        _gradcheck_model(model, ds, sample=12)
+
+
+# -------------------------------------------------------------- center loss
+class TestCenterLoss:
+    def test_center_loss_pulls_features_toward_centers(self):
+        model = _build(
+            InputType.feed_forward(4),
+            L.DenseLayer(n_out=6),
+            L.CenterLossOutputLayer(n_out=3, loss="mcxent",
+                                    activation="softmax", lambda_=0.5),
+            dtype="float32", updater=Sgd(learning_rate=0.1))
+        rng = np.random.RandomState(0)
+        x = rng.randn(32, 4).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 32)]
+        ds = DataSet(x, y)
+        first = None
+        for _ in range(40):
+            model.fit(ds, epochs=1)
+            if first is None:
+                first = float(model.score_value)
+        assert float(model.score_value) < first
+        # centers moved off their zero init
+        assert np.abs(np.asarray(model._params[1]["centers"])).sum() > 0
+
+    def test_center_loss_gradcheck(self):
+        model = _build(
+            InputType.feed_forward(3),
+            L.CenterLossOutputLayer(n_out=2, loss="mcxent",
+                                    activation="softmax", lambda_=0.3))
+        rng = np.random.RandomState(1)
+        ds = DataSet(rng.randn(4, 3), np.eye(2)[rng.randint(0, 2, 4)])
+        _gradcheck_model(model, ds)
+
+
+# ---------------------------------------------------------------- capsules
+class TestCapsules:
+    def _capsnet(self):
+        return _build(
+            InputType.convolutional(12, 12, 1),
+            L.ConvolutionLayer(n_out=8, kernel_size=(5, 5)),
+            L.PrimaryCapsules(capsule_dimensions=4, channels=2,
+                              kernel_size=(5, 5), stride=(2, 2)),
+            L.CapsuleLayer(capsules=3, capsule_dimensions=6, routings=2),
+            L.CapsuleStrengthLayer(),
+            L.LossLayer(loss="mcxent", activation="softmax"),
+            dtype="float32", updater=Adam(learning_rate=0.005))
+
+    def test_shapes(self):
+        model = self._capsnet()
+        x = np.random.RandomState(0).randn(2, 1, 12, 12).astype(np.float32)
+        acts = model.feed_forward(x)
+        assert acts[2].shape == (2, 8, 4)    # 2ch * 2*2 spatial, dim 4
+        assert acts[3].shape == (2, 3, 6)
+        assert acts[4].shape == (2, 3)
+        # capsule outputs are squashed: norms < 1
+        assert (np.asarray(acts[4].value) < 1.0).all()
+
+    def test_capsnet_trains(self):
+        model = self._capsnet()
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 1, 12, 12).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)]
+        ds = DataSet(x, y)
+        first = None
+        for _ in range(30):
+            model.fit(ds, epochs=1)
+            if first is None:
+                first = float(model.score_value)
+        assert float(model.score_value) < first
+
+
+# -------------------------------------------------------------------- YOLO
+class TestYolo2:
+    def _model(self, anchors=((1.0, 1.0), (2.0, 2.0))):
+        n_ch = len(anchors) * (5 + 2)      # 2 classes
+        return _build(
+            InputType.convolutional(4, 4, 3),
+            L.ConvolutionLayer(n_out=n_ch, kernel_size=(1, 1),
+                               activation="identity"),
+            L.Yolo2OutputLayer(anchors=anchors),
+            dtype="float32", updater=Adam(learning_rate=0.01))
+
+    def _labels(self, b=2, h=4, w=4, c=2):
+        """One object per sample in cell (1,1): box + one-hot class."""
+        lab = np.zeros((b, 4 + c, h, w), np.float32)
+        lab[:, 0, 1, 1] = 1.0   # x1
+        lab[:, 1, 1, 1] = 1.0   # y1
+        lab[:, 2, 1, 1] = 2.0   # x2
+        lab[:, 3, 1, 1] = 2.0   # y2
+        lab[:, 4, 1, 1] = 1.0   # class 0
+        return lab
+
+    def test_loss_finite_and_trains(self):
+        model = self._model()
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 4, 4).astype(np.float32)
+        ds = DataSet(x, self._labels())
+        first = None
+        for _ in range(30):
+            model.fit(ds, epochs=1)
+            if first is None:
+                first = float(model.score_value)
+        assert np.isfinite(float(model.score_value))
+        assert float(model.score_value) < first
+
+    def test_channel_mismatch_raises(self):
+        with pytest.raises(ValueError, match="anchors"):
+            _build(InputType.convolutional(4, 4, 3),
+                   L.ConvolutionLayer(n_out=13, kernel_size=(1, 1)),
+                   L.Yolo2OutputLayer(anchors=((1, 1), (2, 2))))

@@ -1,0 +1,76 @@
+"""libdatavec_native tests (C++ host-runtime helpers via ctypes; SURVEY
+§7.1.2 — native where the reference is native, numpy fallback mandatory)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native
+
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable "
+                                       "(numpy fallback covers correctness)")
+
+
+class TestSgPairs:
+    def test_pairs_stay_within_sentences(self):
+        ids = np.array([1, 2, 3, 4, 5, 6, 7, 8], np.int32)
+        offsets = np.array([0, 5, 8], np.int64)
+        c, x = native.sg_pairs(ids, offsets, window=3, keep=None, seed=1)
+        assert len(c) > 0
+        for cc, xx in zip(c, x):
+            assert (cc <= 5) == (xx <= 5)    # never crosses the boundary
+            assert cc != xx or True
+
+    def test_window_bound_respected(self):
+        ids = np.arange(1, 21, dtype=np.int32)
+        offsets = np.array([0, 20], np.int64)
+        c, x = native.sg_pairs(ids, offsets, window=2, keep=None, seed=7)
+        # consecutive ints: |center - context| <= window always
+        assert (np.abs(c.astype(int) - x.astype(int)) <= 2).all()
+
+    def test_pair_count_matches_numpy_statistics(self):
+        """Same corpus, native vs numpy reduced-window pair counts agree
+        statistically (both draw b ~ U[1, window])."""
+        from deeplearning4j_tpu.nlp import Word2Vec
+
+        rng = np.random.default_rng(0)
+        corpus = [rng.integers(0, 100, size=20).astype(np.int32)
+                  for _ in range(500)]
+        offsets = np.zeros(len(corpus) + 1, np.int64)
+        np.cumsum([s.size for s in corpus], out=offsets[1:])
+        c, _ = native.sg_pairs(np.concatenate(corpus), offsets, 5, None, 3)
+        w = Word2Vec(min_word_frequency=1, layer_size=4)
+        rng2 = np.random.default_rng(0)
+        keep = np.ones(100)
+        tot = sum(w._sentence_pairs(s, rng2, keep)[0].size for s in corpus)
+        assert abs(len(c) - tot) / tot < 0.05   # within 5%
+
+    def test_subsampling_drops_frequent_words(self):
+        ids = np.zeros(1000, np.int32)          # all the same word
+        offsets = np.array([0, 1000], np.int64)
+        keep = np.array([0.1])
+        c, _ = native.sg_pairs(ids, offsets, 5, keep, seed=5)
+        full, _ = native.sg_pairs(ids, offsets, 5, None, seed=5)
+        assert len(c) < len(full) * 0.15
+
+    def test_determinism_per_seed(self):
+        ids = np.arange(50, dtype=np.int32)
+        offsets = np.array([0, 50], np.int64)
+        a = native.sg_pairs(ids, offsets, 4, None, seed=9)
+        b = native.sg_pairs(ids, offsets, 4, None, seed=9)
+        np.testing.assert_array_equal(a[0], b[0])
+        np.testing.assert_array_equal(a[1], b[1])
+        d = native.sg_pairs(ids, offsets, 4, None, seed=10)
+        assert not np.array_equal(a[0], d[0]) or \
+            not np.array_equal(a[1], d[1])
+
+
+class TestTokenize:
+    def test_whitespace_variants(self):
+        assert native.tokenize("a  b\tc\nd\r\ne") == \
+            ["a", "b", "c", "d", "e"]
+
+    def test_empty_and_unicode(self):
+        assert native.tokenize("   ") == []
+        assert native.tokenize("héllo wörld") == ["héllo", "wörld"]
