@@ -408,7 +408,7 @@ def main() -> None:
                                  "resnet50-disk"])
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--batch", type=int, default=None,
-                        help="per-config default: resnet50=64, bert=8")
+                        help="per-config default: resnet50=128, bert=8")
     parser.add_argument("--with-listener", action="store_true",
                         help="attach a ScoreIterationListener during the timed "
                              "run (validates the listener bus does not tax the "
@@ -425,7 +425,7 @@ def main() -> None:
     elif args.config == "resnet50-disk":
         result = bench_resnet50_disk(steps, batch=args.batch or 64)
     else:
-        result = bench_resnet50(steps, batch=args.batch or 64,
+        result = bench_resnet50(steps, batch=args.batch or 128,
                                 with_listener=args.with_listener)
 
     base = BASELINES.get(result["metric"], {}).get("value")
