@@ -7,11 +7,12 @@ Reference: nd4j ``samediff-import-{api,tensorflow}`` + legacy
 """
 
 from .keras_import import KerasModelImport, UnsupportedKerasLayerError
+from .keras_graph_import import import_functional
 from .tf_graph_mapper import (TFGraphMapper, UnsupportedTFOpError,
                               import_frozen_tf, supported_tf_ops, tf_op)
 
 __all__ = [
     "TFGraphMapper", "UnsupportedTFOpError", "import_frozen_tf",
     "supported_tf_ops", "tf_op", "KerasModelImport",
-    "UnsupportedKerasLayerError",
+    "UnsupportedKerasLayerError", "import_functional",
 ]

@@ -73,10 +73,19 @@ class KerasModelImport:
     importKerasSequentialModelAndWeights = import_keras_sequential_model_and_weights
 
     @staticmethod
-    def import_keras_model_and_weights(h5_path: str) -> MultiLayerNetwork:
-        """Functional-model entry; Sequential topologies are handled, true
-        multi-branch graphs are not mapped yet."""
-        return _import_sequential(h5_path)
+    def import_keras_model_and_weights(h5_path: str):
+        """Functional/Model entry: Sequential topologies produce a
+        MultiLayerNetwork, functional DAGs a ComputationGraph (reference:
+        importKerasModelAndWeights returns either)."""
+        f, cfg = _read_h5(h5_path)
+        try:
+            if cfg["class_name"] == "Sequential":
+                return _import_sequential_parsed(f, cfg)
+            from .keras_graph_import import import_functional_parsed
+
+            return import_functional_parsed(f, cfg)
+        finally:
+            f.close()
 
     importKerasModelAndWeights = import_keras_model_and_weights
 
@@ -132,19 +141,22 @@ def _require_weights(ws: List[np.ndarray], cls: str, name: str) -> None:
 def _import_sequential(h5_path: str) -> MultiLayerNetwork:
     f, cfg = _read_h5(h5_path)
     try:
-        if cfg["class_name"] not in ("Sequential",):
-            raise UnsupportedKerasLayerError(
-                cfg["class_name"],
-                "only Sequential topologies are mapped; use the TF frozen-"
-                "GraphDef importer (import_frozen_tf) for arbitrary graphs")
-        kl_list = cfg["config"]["layers"]
-
-        builder = _SequentialBuilder()
-        for kl in kl_list:
-            builder.add(kl, f)
-        return builder.finish()
+        return _import_sequential_parsed(f, cfg)
     finally:
         f.close()
+
+
+def _import_sequential_parsed(f, cfg) -> MultiLayerNetwork:
+    if cfg["class_name"] not in ("Sequential",):
+        raise UnsupportedKerasLayerError(
+            cfg["class_name"],
+            "only Sequential topologies are mapped here; functional DAGs go "
+            "through import_functional, arbitrary TF graphs through "
+            "import_frozen_tf")
+    builder = _SequentialBuilder()
+    for kl in cfg["config"]["layers"]:
+        builder.add(kl, f)
+    return builder.finish()
 
 
 class _SequentialBuilder:

@@ -23,17 +23,34 @@ import numpy as np
 
 _HERE = os.path.dirname(__file__)
 _SRC = os.path.join(_HERE, "datavec_native.cpp")
-_SO = os.path.join(_HERE, "libdatavec_native.so")
 
 _lib = None
 _tried = False
 
 
+# Sanitizer build flavor (SURVEY §5.2: ASAN/UBSAN flavors for native code,
+# the analog of libnd4j's SD_SANITIZE CMake toggle). Set
+# DL4J_TPU_NATIVE_SANITIZE=address|undefined BEFORE first use; the
+# sanitized .so needs the matching runtime preloaded in the host process
+# (LD_PRELOAD=$(g++ -print-file-name=libasan.so)) — see
+# tests/test_native.py::TestSanitizerFlavor for the harness.
+_SANITIZE = os.environ.get("DL4J_TPU_NATIVE_SANITIZE", "")
+
+
+def _so_path() -> str:
+    return os.path.join(
+        _HERE, f"libdatavec_native{'_' + _SANITIZE if _SANITIZE else ''}.so")
+
+
 def _build() -> bool:
+    flags = ["-O3"]
+    if _SANITIZE:
+        flags = ["-O1", "-g", f"-fsanitize={_SANITIZE}",
+                 "-fno-omit-frame-pointer"]
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
-             "-o", _SO],
+            ["g++", *flags, "-shared", "-fPIC", "-std=c++17", _SRC,
+             "-o", _so_path()],
             check=True, capture_output=True, timeout=120)
         return True
     except Exception:
@@ -45,12 +62,13 @@ def _load():
     if _tried:
         return _lib
     _tried = True
-    if not os.path.exists(_SO) or \
-            os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+    so = _so_path()
+    if not os.path.exists(so) or \
+            os.path.getmtime(so) < os.path.getmtime(_SRC):
         if not _build():
             return None
     try:
-        lib = ctypes.CDLL(_SO)
+        lib = ctypes.CDLL(so)
     except OSError:
         return None
     lib.sg_pairs.restype = ctypes.c_int64

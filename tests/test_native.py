@@ -74,3 +74,83 @@ class TestTokenize:
     def test_empty_and_unicode(self):
         assert native.tokenize("   ") == []
         assert native.tokenize("héllo wörld") == ["héllo", "wörld"]
+
+
+class TestSanitizerFlavor:
+    """SURVEY §5.2 analog of libnd4j's sanitizer build flavor: compile the
+    native lib with -fsanitize=address and exercise it in a subprocess with
+    the ASAN runtime preloaded — memory errors in the C++ hot loops fail
+    this test instead of corrupting training."""
+
+    def test_asan_flavor_runs_clean(self, tmp_path):
+        import os
+        import subprocess
+        import sys
+        from pathlib import Path
+
+        asan = subprocess.run(["g++", "-print-file-name=libasan.so"],
+                              capture_output=True, text=True).stdout.strip()
+        if not asan or not Path(asan).exists():
+            pytest.skip("libasan not available")
+        repo = str(Path(__file__).resolve().parents[1])
+        code = (
+            "import numpy as np\n"
+            "from deeplearning4j_tpu import native\n"
+            "assert native.available(), 'sanitized build failed'\n"
+            "ids = np.random.default_rng(0).integers(0, 100, 5000)"
+            ".astype(np.int32)\n"
+            "offsets = np.arange(0, 5001, 20, dtype=np.int64)\n"
+            "keep = np.full(100, 0.8)\n"
+            "c, x = native.sg_pairs(ids, offsets, 5, keep, 7)\n"
+            "assert len(c) > 0\n"
+            "assert native.tokenize('a b  c') == ['a', 'b', 'c']\n"
+            "print('ASAN-CLEAN')\n")
+        env = dict(os.environ)
+        env["DL4J_TPU_NATIVE_SANITIZE"] = "address"
+        env["LD_PRELOAD"] = asan
+        env["ASAN_OPTIONS"] = "detect_leaks=0"  # python itself 'leaks'
+        env["PYTHONPATH"] = repo
+        p = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, timeout=240,
+                           cwd=repo)
+        assert p.returncode == 0, p.stderr[-3000:]
+        assert "ASAN-CLEAN" in p.stdout
+        assert "AddressSanitizer" not in p.stderr
+
+
+class TestCollectiveDeterminism:
+    """SURVEY §5.2: 'keep the jax CPU-backend determinism tests as the
+    sanitizer for collective code' — same inputs, bitwise-identical psum
+    results across runs on the 8-device mesh."""
+
+    def test_psum_bitwise_deterministic(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        mesh = Mesh(np.array(jax.devices()), ("d",))
+        x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+
+        def f(x):
+            return jax.lax.psum(jnp.sin(x) * 1.000001, "d")
+
+        fn = jax.jit(shard_map(f, mesh=mesh, in_specs=P("d"),
+                               out_specs=P("d")))
+        a = np.asarray(fn(x))
+        b = np.asarray(fn(x))
+        np.testing.assert_array_equal(a, b)
+
+    def test_ring_attention_deterministic(self):
+        import jax
+        from jax.sharding import Mesh
+
+        from deeplearning4j_tpu.parallel import ring_self_attention
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 16, 4).astype(np.float32)
+        w = [rng.randn(4, 4).astype(np.float32) for _ in range(4)]
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+        a = np.asarray(ring_self_attention(x, *w, 1, mesh, "data"))
+        b = np.asarray(ring_self_attention(x, *w, 1, mesh, "data"))
+        np.testing.assert_array_equal(a, b)
