@@ -724,9 +724,34 @@ class SameDiff:
         step = self._train_step_fn(loss_name, tuple(phs))
         history = History()
         listeners = listeners or []
+        # The jitted step donates its params/state inputs. If a step fails
+        # after dispatch (OOM, NaN panic, Ctrl-C), whatever self._vars /
+        # self._updater_state reference may already be deleted; the finally
+        # block below restores the entry values so the model object stays
+        # usable for recovery save/inspection (training progress since the
+        # last successful fit/checkpoint is lost — same semantic as the
+        # reference crashing mid-fit).
+        entry_vals = {n: self._vars[n].value for n in params}
+        try:
+            return self._fit_loop(step, data, batch_size, epochs,
+                                  feature_placeholder, label_placeholder,
+                                  params, state, history, listeners)
+        except BaseException:
+            def _dead(a):
+                return hasattr(a, "is_deleted") and a.is_deleted()
 
+            for n, v0 in entry_vals.items():
+                if _dead(self._vars[n].value):
+                    self._vars[n].value = v0
+            if self._updater_state is not None and any(
+                    _dead(l) for l in jax.tree.leaves(self._updater_state)):
+                self._updater_state = None  # momenta restart on next fit
+            raise
+
+    def _fit_loop(self, step, data, batch_size, epochs, feature_placeholder,
+                  label_placeholder, params, state, history, listeners):
         for epoch in range(epochs):
-            epoch_losses = []
+            loss_sum, n_batches = None, 0
             for ds in _iter_batches(data, batch_size):
                 if isinstance(ds, dict):
                     # multi-input binding (e.g. imported BERT: ids/types/mask
@@ -741,16 +766,34 @@ class SameDiff:
                 params, state, loss = step(params, state, ph, key,
                                            jnp.asarray(self._iteration))
                 self._iteration += 1
-                loss_val = float(loss)
-                epoch_losses.append(loss_val)
+                # device scalar all the way down: listeners receive it un-synced
+                # and decide when to read (the multilayer/ui.stats contract);
+                # fit itself syncs ONCE per epoch below via a running on-device
+                # sum (O(1) memory, no variadic stack). The reference's
+                # TrainingSession also floats per step — that cost is invisible
+                # over JNI but serializes every step through the TPU relay here.
+                loss_sum = loss if loss_sum is None else loss_sum + loss
+                n_batches += 1
+                if listeners:
+                    # a listener may checkpoint THIS model mid-fit (e.g.
+                    # CheckpointListener): expose the live post-step buffers.
+                    # Reference assignment only — no host sync; the returned
+                    # arrays are fresh (the donated ones were the inputs), so
+                    # a save here serializes valid, current state.
+                    for n, v in params.items():
+                        self._vars[n].value = v
+                    self._updater_state = state
                 for lst in listeners:
-                    lst.iteration_done(self, self._iteration, loss_val)
+                    lst.iteration_done(self, self._iteration, loss)
             self._epoch += 1
-            if not epoch_losses:
+            if loss_sum is None:
                 raise ValueError(
                     "training data yielded no batches this epoch (exhausted "
                     "iterator or empty dataset)")
-            history.add_epoch(self._epoch, float(np.mean(epoch_losses)))
+            history.add_epoch(self._epoch, float(loss_sum) / n_batches)
+            for lst in listeners:
+                if hasattr(lst, "epoch_done"):
+                    lst.epoch_done(self, self._epoch)
         # write trained values back into the graph (stateful shell)
         for n, val in params.items():
             self._vars[n].value = np.asarray(val)
@@ -758,13 +801,18 @@ class SameDiff:
         return history
 
     # --- serialization ---------------------------------------------------
-    def save(self, path: str, save_updater_state: bool = False) -> None:
+    def save(self, path: str, save_updater: bool = False,
+             save_updater_state: bool = False) -> None:
         """Zip container: graph.json + vars.npz (+ updater.npz).
 
         The reference serializes FlatBuffers (FlatGraph) readable by its C++
         executor; the schema is not reproducible here (SURVEY.md §0), so the
         container is a versioned zip with the same content inventory:
         variables, op graph, training config, optional updater state.
+
+        ``save_updater`` is the listener-SPI spelling (matches
+        MultiLayerNetwork/ComputationGraph.save, used by CheckpointListener);
+        ``save_updater_state`` is the original SameDiff spelling — either works.
         """
         arrays: Dict[str, np.ndarray] = {}
         graph = self._graph_dict(arrays, "")
@@ -779,11 +827,9 @@ class SameDiff:
             buf = io.BytesIO()
             np.savez(buf, **arrays)
             zf.writestr("vars.npz", buf.getvalue())
-            if save_updater_state and self._updater_state is not None:
-                flat, _ = jax.tree.flatten(self._updater_state)
-                buf2 = io.BytesIO()
-                np.savez(buf2, **{str(i): np.asarray(a) for i, a in enumerate(flat)})
-                zf.writestr("updater.npz", buf2.getvalue())
+            if (save_updater or save_updater_state) and self._updater_state is not None:
+                from ..util.model_serializer import _savez_leaves
+                zf.writestr("updater.npz", _savez_leaves(self._updater_state))
 
     def _graph_dict(self, arrays: Dict[str, np.ndarray],
                     prefix: str) -> Dict[str, Any]:
@@ -874,6 +920,14 @@ class SameDiff:
             tc = graph.get("training_config")
             if tc:
                 sd._training_config = TrainingConfig.from_json(tc)
+            if "updater.npz" in zf.namelist() and sd._training_config is not None:
+                # rebuild the state treedef from updater.init over the loaded
+                # params (the model_serializer._restore pattern — works for any
+                # pytree an updater returns, no schema file needed)
+                from ..util.model_serializer import _load_into_tree
+                template = sd._training_config.updater.init(sd._params())
+                sd._updater_state = _load_into_tree(
+                    zf.read("updater.npz"), template, "updater state")
         return sd
 
     # --- structured control flow (documented divergence from TF1 frames) --

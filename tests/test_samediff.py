@@ -222,6 +222,61 @@ class TestTraining:
         preds = sd.output({"x": features}, ["logits"])["logits"].to_numpy()
         assert (preds.argmax(1) == labels.argmax(1)).all()
 
+    def test_fit_passes_device_scalar_to_listeners(self):
+        """sd.fit must not host-sync per iteration: listeners receive the raw
+        device scalar (the multilayer/ui.stats §5.5 contract) and fit itself
+        floats only at the epoch boundary."""
+        import jax
+
+        sd = SameDiff.create()
+        w = sd.var("w", init=np.array([2.0], np.float32))
+        x = sd.placeholder("x", shape=(None, 1))
+        loss = sd.math.reduce_sum((x * w) * (x * w)).rename("loss")
+        sd.set_training_config(TrainingConfig(updater=Sgd(learning_rate=0.01),
+                                              loss_name="loss"))
+
+        seen = []
+
+        class Recorder:
+            def iteration_done(self, model, iteration, score):
+                seen.append(score)
+
+        ds = DataSet(np.ones((2, 1), np.float32), np.zeros((2, 1), np.float32))
+        sd.fit(ds, epochs=6, listeners=[Recorder()],
+               label_placeholder=None, feature_placeholder="x")
+        assert len(seen) == 6
+        for s in seen:
+            assert isinstance(s, jax.Array), type(s)
+            assert not isinstance(s, float)
+
+    def test_midfit_checkpoint_saves_trained_state(self, tmp_path):
+        """A CheckpointListener firing mid-fit must serialize the CURRENT
+        trained params + updater state, not the values frozen at fit() entry
+        (and must not touch donated buffers)."""
+        from deeplearning4j_tpu.optimize.listeners import CheckpointListener
+
+        sd = SameDiff.create()
+        w = sd.var("w", init=np.array([2.0], np.float32))
+        x = sd.placeholder("x", shape=(None, 1))
+        loss = sd.math.reduce_sum((x * w) * (x * w)).rename("loss")
+        sd.set_training_config(TrainingConfig(updater=Adam(learning_rate=0.1),
+                                              loss_name="loss"))
+        ds = DataSet(np.ones((2, 1), np.float32), np.zeros((2, 1), np.float32))
+        ckpt = CheckpointListener(str(tmp_path), save_every_n_epochs=1,
+                                  keep_last=100)
+        sd.fit(ds, epochs=5, listeners=[ckpt],
+               label_placeholder=None, feature_placeholder="x")
+        assert len(ckpt.saved) == 5
+        # epoch-1 checkpoint must already have moved off the init value ...
+        first = SameDiff.load(ckpt.saved[0])
+        assert abs(float(first._vars["w"].value[0]) - 2.0) > 1e-4
+        # ... and carry non-empty updater state (Adam momenta)
+        assert first._updater_state is not None
+        # the final checkpoint matches the final in-memory weights
+        last = SameDiff.load(ckpt.saved[-1])
+        np.testing.assert_allclose(np.asarray(last._vars["w"].value),
+                                   np.asarray(sd._vars["w"].value), rtol=1e-6)
+
     def test_l2_regularization_shrinks_weights(self):
         sd = SameDiff.create()
         w = sd.var("w", init=np.full((4,), 5.0, np.float32))
