@@ -19,7 +19,9 @@ from ..ndarray.rng import get_random
 def _nd(x) -> Optional[NDArray]:
     if x is None or isinstance(x, NDArray):
         return x
-    return NDArray(np.asarray(x))
+    # hand the value straight to NDArray (its constructor does jnp.asarray):
+    # np.asarray here would force a device->host readback for jax-array input
+    return NDArray(x)
 
 
 class DataSet:

@@ -339,22 +339,26 @@ class BatchNormalization(Layer):
                 "var": jnp.ones((self.n_in,), jnp.float32)}
 
     def apply(self, params, x, state, training, rng):
-        axes = (0, 2, 3) if x.ndim == 4 else (0,)
         gamma = params.get("gamma")
         beta = params.get("beta")
+        axis = 1 if x.ndim == 4 else -1
         if training:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            # fused training form: single-pass statistics + hand VJP (the
+            # autodiff of the naive form costs extra full passes over the
+            # activations — measured ~10% of a ResNet-50 step on v5e)
+            out, mean, var = get_op("batchnorm_train").fn(
+                x, gamma, beta, epsilon=self.eps, axis=axis,
+                pivot=state["mean"])
             new_state = {
-                "mean": self.decay * state["mean"] + (1 - self.decay) * mean.astype(jnp.float32),
-                "var": self.decay * state["var"] + (1 - self.decay) * var.astype(jnp.float32),
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1 - self.decay) * var,
             }
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
-        axis = 1 if x.ndim == 4 else -1
-        out = get_op("batchnorm").fn(x, mean.astype(x.dtype), var.astype(x.dtype),
-                                     gamma, beta, epsilon=self.eps, axis=axis)
+            out = get_op("batchnorm").fn(x, mean.astype(x.dtype),
+                                         var.astype(x.dtype),
+                                         gamma, beta, epsilon=self.eps, axis=axis)
         return activation_fn(self.activation or "identity")(out), new_state
 
 

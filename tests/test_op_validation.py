@@ -496,6 +496,98 @@ class TestNN:
         expected = expected * gamma[None, :, None, None] + beta[None, :, None, None]
         check("batchnorm", expected, x, mean, var, gamma, beta, atol=1e-4)
 
+    def test_batchnorm_train(self):
+        """Fused training-form BN: forward matches the naive composition and
+        the hand-written VJP matches autodiff of the naive form."""
+        import jax
+        import jax.numpy as jnp
+
+        x = r(4, 3, 5, 5)
+        gamma, beta = r(3, seed=1), r(3, seed=2)
+        out, mean, var = exec_op("batchnorm_train", x, gamma, beta,
+                                 epsilon=1e-5, axis=1)
+        exp_mean = x.mean(axis=(0, 2, 3))
+        exp_var = x.var(axis=(0, 2, 3))
+        np.testing.assert_allclose(np.asarray(mean), exp_mean, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(var), exp_var, atol=1e-4)
+        expected = (x - exp_mean[None, :, None, None]) / np.sqrt(
+            exp_var[None, :, None, None] + 1e-5)
+        expected = expected * gamma[None, :, None, None] + beta[None, :, None, None]
+        np.testing.assert_allclose(np.asarray(out), expected, atol=1e-4)
+
+        # 2D (feedforward) shape, channel axis -1
+        x2 = r(8, 6, seed=3)
+        out2, m2, v2 = exec_op("batchnorm_train", x2, None, None, axis=-1)
+        np.testing.assert_allclose(np.asarray(m2), x2.mean(0), atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(out2),
+            (x2 - x2.mean(0)) / np.sqrt(x2.var(0) + 1e-5), atol=1e-4)
+
+        # hand VJP vs autodiff of the naive composition (full BN gradient,
+        # including the mean/var -> x paths)
+        from deeplearning4j_tpu.ops import get_op
+
+        def fused_loss(p):
+            o, _, _ = get_op("batchnorm_train").fn(
+                jnp.asarray(x), p["g"], p["b"], epsilon=1e-5, axis=1)
+            return jnp.sum(o * jnp.asarray(wts))
+
+        def naive_loss(p):
+            xx = jnp.asarray(x)
+            m = jnp.mean(xx, axis=(0, 2, 3))
+            v = jnp.var(xx, axis=(0, 2, 3))
+            o = (xx - m[None, :, None, None]) * jax.lax.rsqrt(
+                v[None, :, None, None] + 1e-5)
+            o = o * p["g"][None, :, None, None] + p["b"][None, :, None, None]
+            return jnp.sum(o * jnp.asarray(wts))
+
+        wts = r(4, 3, 5, 5, seed=7)
+        p0 = {"g": jnp.asarray(gamma), "b": jnp.asarray(beta)}
+        g_fused = jax.grad(fused_loss)(p0)
+        g_naive = jax.grad(naive_loss)(p0)
+        np.testing.assert_allclose(np.asarray(g_fused["g"]),
+                                   np.asarray(g_naive["g"]), atol=1e-3)
+        np.testing.assert_allclose(np.asarray(g_fused["b"]),
+                                   np.asarray(g_naive["b"]), atol=1e-3)
+
+        def fused_loss_x(xx):
+            o, _, _ = get_op("batchnorm_train").fn(
+                xx, p0["g"], p0["b"], epsilon=1e-5, axis=1)
+            return jnp.sum(o * jnp.asarray(wts))
+
+        def naive_loss_x(xx):
+            m = jnp.mean(xx, axis=(0, 2, 3))
+            v = jnp.var(xx, axis=(0, 2, 3))
+            o = (xx - m[None, :, None, None]) * jax.lax.rsqrt(
+                v[None, :, None, None] + 1e-5)
+            o = o * p0["g"][None, :, None, None] + p0["b"][None, :, None, None]
+            return jnp.sum(o * jnp.asarray(wts))
+
+        gx_fused = jax.grad(fused_loss_x)(jnp.asarray(x))
+        gx_naive = jax.grad(naive_loss_x)(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(gx_fused), np.asarray(gx_naive),
+                                   atol=1e-3)
+
+    def test_batchnorm_train_large_mean_no_cancellation(self):
+        """With a pivot near the channel mean (the BN layer passes its
+        running mean), the single-pass E[d^2]-E[d]^2 variance stays accurate
+        for |mean| >> std inputs where the unpivoted fp32 form cancels
+        catastrophically (mean=1e3, std=0.1: error ~6x the true variance)."""
+        rng = np.random.RandomState(0)
+        x = (1000.0 + 0.1 * rng.randn(16, 4, 8, 8)).astype(np.float32)
+        pivot = np.full(4, 1000.0, np.float32)
+        _, mean, var = exec_op("batchnorm_train", x, None, None, axis=1,
+                               pivot=pivot)
+        true_var = x.astype(np.float64).var(axis=(0, 2, 3))
+        np.testing.assert_allclose(np.asarray(mean),
+                                   x.astype(np.float64).mean(axis=(0, 2, 3)),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(var), true_var, rtol=2e-2)
+        # without a pivot the op must still produce finite (clamped) output
+        out0, _, var0 = exec_op("batchnorm_train", x, None, None, axis=1)
+        assert np.isfinite(np.asarray(out0)).all()
+        assert (np.asarray(var0) >= 0).all()
+
     def test_layer_norm(self):
         x = r(4, 10)
         mean = x.mean(-1, keepdims=True)

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Word2Vec table-update lowering shootout at large vocab (round-3 item 2).
+
+Measures, fence-free (rep differencing), the per-round cost of updating a
+[V, D] table with B*(1+K) gradient rows:
+
+  dense    one-hot bf16 MXU matmul accumulated into f32 (current ≤32k path)
+  scatter  Array.at[idx].add with duplicates (current >32k path)
+  sorted   sort idx + in-round segment dedupe, then unique-indices scatter
+
+Usage: python tools/w2v_update_bench.py --vocab 100000
+"""
+import argparse
+import json
+import statistics
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def timed(fn, args, reps_lo=4, reps_hi=12):
+    """Fence-free per-call time: difference chained rep counts."""
+
+    def chain(n):
+        jfn = jax.jit(lambda t, i, g, n=n: _chain(fn, t, i, g, n))
+        out = jfn(*args)
+        _ = float(jnp.sum(out[:64].astype(jnp.float32)))  # warm + fence
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            out = jfn(*args)
+            _ = float(jnp.sum(out[:64].astype(jnp.float32)))
+            times.append(time.perf_counter() - t0)
+        return statistics.median(times)
+
+    t_lo, t_hi = chain(reps_lo), chain(reps_hi)
+    return max((t_hi - t_lo) / (reps_hi - reps_lo), 1e-9)
+
+
+def _chain(fn, table, idx, grads, n):
+    for i in range(n):
+        # rotate indices so reps aren't folded away
+        table = fn(table, (idx + i) % table.shape[0], grads)
+    return table
+
+
+def upd_dense(table, idx, grads):
+    onehot = jax.nn.one_hot(idx, table.shape[0], dtype=jnp.bfloat16)
+    return table + jnp.einsum("nv,nd->vd", onehot, grads.astype(jnp.bfloat16),
+                              preferred_element_type=table.dtype)
+
+
+def upd_scatter(table, idx, grads):
+    return table.at[idx].add(grads)
+
+
+def upd_sorted(table, idx, grads):
+    """Sort rows, combine duplicate indices with a segment-style pass, then
+    scatter with unique_indices=True (duplicates carry zero after combine)."""
+    order = jnp.argsort(idx)
+    si = idx[order]
+    sg = grads[order]
+    # suffix-cumsum trick: cumsum rows, take boundary differences => the sum
+    # of each equal-index run lands on the run's LAST row
+    cs = jnp.cumsum(sg, axis=0)
+    is_last = jnp.concatenate([si[1:] != si[:-1], jnp.array([True])])
+    # propagate previous run-boundary cumsum forward via cummax over masked
+    # boundary positions
+    bmark = jnp.where(is_last, jnp.arange(si.shape[0]), -1)
+    prev_boundary = jnp.concatenate(
+        [jnp.full((1,), -1, bmark.dtype),
+         jax.lax.cummax(bmark)[:-1]])
+    prev_cs = jnp.where(prev_boundary[:, None] >= 0,
+                        cs[jnp.maximum(prev_boundary, 0)], 0)
+    combined = jnp.where(is_last[:, None], cs - prev_cs, 0)
+    # route duplicates (non-last rows) to a scratch row = V (table padded)
+    tgt = jnp.where(is_last, si, table.shape[0])
+    padded = jnp.concatenate([table, jnp.zeros((1, table.shape[1]),
+                                               table.dtype)])
+    padded = padded.at[tgt].add(combined, unique_indices=True)
+    return padded[:-1]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--vocab", type=int, default=100_000)
+    ap.add_argument("--dim", type=int, default=100)
+    ap.add_argument("--rows", type=int, default=8192 * 6)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    table = jnp.asarray(rng.randn(args.vocab, args.dim).astype(np.float32))
+    # zipf-flavored duplicates like real negative sampling
+    idx = jnp.asarray((rng.zipf(1.3, args.rows) % args.vocab).astype(np.int32))
+    grads = jnp.asarray(rng.randn(args.rows, args.dim).astype(np.float32) * 1e-3)
+
+    out = {"vocab": args.vocab, "dim": args.dim, "rows": args.rows}
+    for name, fn in [("dense", upd_dense), ("scatter", upd_scatter),
+                     ("sorted", upd_sorted)]:
+        try:
+            t = timed(fn, (table, idx, grads))
+            out[name + "_ms"] = round(t * 1e3, 3)
+            out[name + "_rows_per_sec"] = round(args.rows / t)
+        except Exception as e:
+            out[name + "_error"] = str(e)[:120]
+    # correctness cross-check on small data
+    st = jnp.zeros((50, 4))
+    si = jnp.asarray(np.array([1, 3, 1, 49, 3, 3], np.int32))
+    sg = jnp.asarray(np.arange(24, dtype=np.float32).reshape(6, 4))
+    ref = np.zeros((50, 4), np.float32)
+    for i, g in zip(np.asarray(si), np.asarray(sg)):
+        ref[i] += g
+    got = np.asarray(upd_sorted(st, si, sg))
+    out["sorted_correct"] = bool(np.allclose(got, ref, atol=1e-5))
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
