@@ -374,7 +374,10 @@ def bench_word2vec(steps: int) -> dict:
     synthetic zipfian corpus; throughput = corpus words consumed / sec
     end-to-end (host pair-generation + fused device rounds), the number the
     reference logs at INFO during SequenceVectors.fit (SURVEY §3.6).
-    ``steps`` scales the corpus: steps * 1000 sentences of 20 words."""
+    ``steps`` scales the corpus: steps * 1000 sentences of 20 words.
+    The word2vec default is 200 (a 4M-word corpus): throughput on this
+    config is steady-state-dominated the way the reference's INFO number
+    is; tiny corpora mostly measure per-process trace/executable-load."""
     import jax
 
     from deeplearning4j_tpu.nlp import Word2Vec
@@ -384,13 +387,20 @@ def bench_word2vec(steps: int) -> dict:
     p = 1.0 / np.arange(1, vocab_size + 1)
     p /= p.sum()
     words = np.array([f"w{i}" for i in range(vocab_size)])
-    sents = [" ".join(words[rng.choice(vocab_size, size=sent_len, p=p)])
-             for _ in range(n_sent)]
+    ids = rng.choice(vocab_size, size=(n_sent, sent_len), p=p)
+    sents = [" ".join(row) for row in words[ids]]
 
     w2v = Word2Vec(min_word_frequency=5, layer_size=100, window=5,
                    negative=5, sampling=1e-3, epochs=1, batch_size=8192,
                    seed=42)
     w2v.set_sentence_iterator(sents)
+    # Same methodology as the lenet/resnet/bert benches: compile excluded,
+    # steady state timed. fit() #1 builds vocab + traces/compiles the block
+    # and trains once (cold, recorded); fit() #2 reuses the compiled block
+    # (resume semantics) — its words/sec is uploads + pair derivation +
+    # device rounds + final value-fence, none of it compilation.
+    w2v.fit()
+    cold = w2v.words_per_sec
     w2v.fit()
     return {
         "metric": "word2vec_skipgram_train",
@@ -400,6 +410,7 @@ def bench_word2vec(steps: int) -> dict:
         "vocab": len(w2v.vocab),
         "corpus_words": n_sent * sent_len,
         "pairs_per_sec": round(w2v.pairs_per_sec),
+        "cold_words_per_sec": round(cold),
         "layer_size": 100, "negative": 5, "window": 5,
         "data": "synthetic zipfian corpus (host RAM)",
         "final_loss": round(w2v.last_loss, 4),
@@ -407,6 +418,14 @@ def bench_word2vec(steps: int) -> dict:
 
 
 def main() -> None:
+    # Persistent executable cache: compile each bench module once per
+    # MACHINE, not once per process (the reference ships pre-built libnd4j
+    # kernels; this is the XLA analog). First-ever run still pays the
+    # compile; every later run loads the serialized executable.
+    from deeplearning4j_tpu.common.environment import enable_compilation_cache
+    enable_compilation_cache(os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), ".jax_cache"))
+
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", default="resnet50",
                         choices=["lenet", "resnet50", "bert", "word2vec",
@@ -428,7 +447,7 @@ def main() -> None:
         # relay-latency-bound and understated the hardware ~3×
         result = bench_bert(steps, batch=args.batch or 32)
     elif args.config == "word2vec":
-        result = bench_word2vec(steps)
+        result = bench_word2vec(args.steps or 200)
     elif args.config == "resnet50-disk":
         result = bench_resnet50_disk(steps, batch=args.batch or 64)
     else:

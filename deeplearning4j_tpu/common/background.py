@@ -1,0 +1,73 @@
+"""Background-thread producer/consumer helpers.
+
+``prefetch_iter`` is the generic core of the overlap pattern
+``AsyncDataSetIterator`` uses for ETL (reference:
+``AsyncDataSetIterator``'s blocking queue): run a generator on a worker
+thread, hand items to the consumer through a bounded queue, propagate
+exceptions, and never leave the worker blocked if the consumer abandons
+the iteration. Word2Vec uses it to overlap host pair-generation with
+device training rounds (reference analog: the 20-thread
+``SequenceVectors`` fit loop keeps the JNI kernels fed; here ONE producer
+thread keeps the XLA dispatch queue fed).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, List, TypeVar
+
+T = TypeVar("T")
+
+_END = object()
+
+
+def prefetch_iter(source: Iterable[T], maxsize: int = 8) -> Iterator[T]:
+    """Yield items of ``source``, produced on a background thread through
+    a bounded queue of ``maxsize`` items.
+
+    Exceptions raised by ``source`` re-raise at the consuming site after
+    already-produced items drain. Abandoning the returned iterator
+    (``break`` / GC) releases the worker.
+    """
+    q: "queue.Queue" = queue.Queue(maxsize=maxsize)
+    stop = threading.Event()
+    err: List[BaseException] = []
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for item in source:
+                if stop.is_set() or not _put(item):
+                    return
+        except BaseException as e:
+            err.append(e)
+        finally:
+            _put(_END)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            yield item
+        if err:
+            raise err[0]
+    finally:
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+        t.join(timeout=5.0)

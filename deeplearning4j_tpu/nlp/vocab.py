@@ -176,6 +176,27 @@ def unigram_table(cache: VocabCache, power: float = 0.75) -> np.ndarray:
     return np.cumsum(probs)
 
 
+def unigram_int_table(cache: VocabCache, power: float = 0.75,
+                      size: int = 1 << 20) -> np.ndarray:
+    """Power-of-two int32 negative-sampling table: word i occupies a number
+    of slots proportional to f_i^power (reference: InMemoryLookupTable's
+    1e8-entry table; sized 2^20 here so a device draw is
+    ``random_bits & (size-1)`` + one gather — measured ~20× cheaper per
+    round than searchsorted over the exact CDF on TPU, see BASELINE.md
+    round-3 Word2Vec audit). Words with probability < 1/size get no slot —
+    the same truncation the reference's finite table applies."""
+    assert size & (size - 1) == 0, "size must be a power of two"
+    counts = cache.counts().astype(np.float64)
+    probs = counts ** power
+    probs /= probs.sum()
+    alloc = np.floor(probs * size).astype(np.int64)
+    shortfall = size - alloc.sum()
+    if shortfall > 0:   # largest-remainder apportionment
+        frac = probs * size - alloc
+        alloc[np.argsort(-frac)[:shortfall]] += 1
+    return np.repeat(np.arange(len(counts), dtype=np.int32), alloc)
+
+
 def subsample_keep_probs(cache: VocabCache, sampling: float) -> np.ndarray:
     """Per-word keep probability for frequent-word subsampling (the canonical
     word2vec formula the reference applies in SkipGram.learnSequence:

@@ -6,14 +6,21 @@ Java worker threads each dispatching one fused ``SkipGramRound`` JNI kernel
 per (center, context) pair. The TPU rebuild keeps the same statistical
 procedure — frequency-pruned vocab, frequent-word subsampling, per-position
 reduced window, unigram^0.75 negative sampling or Huffman hierarchical
-softmax, linear LR decay — but restructures the hot loop hardware-first:
+softmax, linear LR decay — but restructures the hot loop hardware-first
+(BASELINE.md "Word2Vec audit" records the measurements behind each choice):
 
-- host side generates training pairs VECTORIZED per sentence (numpy), and
-  buffers them into fixed-size batches (static shapes → one compiled
-  executable for the whole run);
-- device side runs ONE jitted fused round per batch (``ops/embeddings.py``)
-  with ``syn0``/``syn1`` donated, so tables live on device for the entire
-  fit and nothing transfers but the (tiny) index batches;
+- DEFAULT skip-gram path (``_train_windowed``): the compacted corpus is
+  uploaded ONCE and lives on device; every scanned round derives its
+  windows, draws negatives from a device-resident unigram table, and
+  scatter-updates only the sampled table rows. Host→device traffic is ~2
+  bytes per corpus word — sized for the measured 5–10 MB/s relay link.
+- custom streams (ParagraphVectors) and CBOW use the host pair pipeline:
+  vectorized/native pair generation buffered into fixed-size uint16
+  column blocks, staged to device from a producer thread
+  (``common/background.prefetch_iter``) so upload overlaps execution;
+- both paths run ONE jitted ``lax.scan`` block per dispatch
+  (``ops/embeddings.py`` fused rounds, tables donated) and compile exactly
+  ONE block shape per fit;
 - the reference's ``workers`` thread knob is accepted and recorded but
   parallelism comes from batching on the MXU, not host threads.
 
@@ -32,7 +39,8 @@ from .lookup_table import InMemoryLookupTable
 from .text import (CollectionSentenceIterator, DefaultTokenizerFactory,
                    SentenceIterator, TokenizerFactory)
 from .vocab import (VocabCache, VocabConstructor, build_huffman,
-                    huffman_arrays, subsample_keep_probs, unigram_table)
+                    huffman_arrays, subsample_keep_probs, unigram_int_table,
+                    unigram_table)
 
 
 class WordVectors:
@@ -217,18 +225,50 @@ class SequenceVectors(WordVectors):
     # loop runs a lax.scan over up to this many rounds per call (measured
     # ~3× throughput vs one-round-per-dispatch at B=8192).
     MAX_BLOCK_ROUNDS = 64
+    # A whole fit compiles exactly ONE block shape: mid-fit flushes emit
+    # only full blocks (remainders carry forward), and the single final
+    # tail is mask-padded up to a full block (≤63 no-op rounds ≈ 75 ms of
+    # device time). Round-3 finding: the earlier pow2 tail splitting
+    # compiled up to 7 shapes at ~4–15 s EACH on TPU — compilation, not
+    # compute, dominated the entire fit.
 
-    def _make_block(self, hs_dev=None, cdf_dev=None):
+    # Corpus device buffers are padded to this multiple so distinct corpus
+    # sizes reuse a handful of compiled shapes.
+    CORPUS_BUCKET = 1 << 16
+
+    @property
+    def _window_centers(self) -> int:
+        """Centers per device-windowed round, sized so one round trains
+        ~batch_size (center, context) slots. batch_size stays the
+        stability knob it is on the host path: per-round updates into one
+        table row scale with examples-per-round, and a tiny vocab with a
+        huge round diverges (observed: NaN at 10k slots/round over a
+        12-word vocab)."""
+        return max(1, self.batch_size // (2 * self.window))
+
+    def _make_block(self, hs_dev=None, ntable_dev=None):
         """Jitted (syn0, syn1, cols, key) -> (syn0', syn1', mean_loss)
-        running a ``lax.scan`` of fused rounds; ``cols`` arrays carry a
-        leading rounds axis and hold ONLY word indices + lr/mask — for HS
-        configs each round gathers its Huffman paths from device-resident
-        tables (``hs_dev``), for NS configs each round draws its negatives
-        on device from the device-resident unigram CDF (``cdf_dev``) with
-        jax threefry streams. The latter is a DOCUMENTED divergence from
-        the reference's host-side PCG sampling (SURVEY declares statistical,
-        not bitwise, RNG parity): it removes both the host sampling stage
-        and 2/3 of the per-block host→device traffic."""
+        running a ``lax.scan`` of fused rounds.
+
+        The column format is sized for the measured transport, not for
+        convenience (round-3 relay audit, BASELINE.md: host→device moves
+        5–10 MB/s, so bytes-on-the-wire IS the throughput):
+
+        - word indices travel as uint16 whenever the vocab fits (cast to
+          int32 on device);
+        - the per-pair float mask became a per-round valid-pair COUNT,
+          expanded to a mask on device with one iota compare;
+        - NS negatives never travel at all: the whole block's draws happen
+          on device in ONE bulk gather from a 2^20-slot unigram^0.75 int
+          table (``unigram_int_table`` — the reference's own table design)
+          before the scan. Bulk ``random_bits`` + gather replaced the
+          per-round searchsorted that was 65% of round-2's device profile.
+        - HS configs gather Huffman paths from device-resident tables
+          (``hs_dev``) by word index, as before.
+
+        RNG divergence from the reference's host-side PCG sampling is
+        DOCUMENTED (SURVEY declares statistical, not bitwise, parity).
+        """
         import functools
 
         import jax
@@ -237,62 +277,295 @@ class SequenceVectors(WordVectors):
 
         from ..ops import embeddings as E
 
-        # Table-update lowering: MXU one-hot matmul for small vocabs,
-        # scatter-add for large (see ops/embeddings.py module docstring).
+        # Table-update lowering: scatter-add everywhere (round-3 shootout,
+        # ops/embeddings.py module docstring).
         dense = len(self.vocab) <= E.DENSE_UPDATE_MAX_ROWS
         is_cbow = self.algorithm == "cbow"
         use_hs = self.use_hs
-        V, K = len(self.vocab), self.negative
+        V, K, B = len(self.vocab), self.negative, self.batch_size
         if use_hs:
             points_d, codes_d, mask_d = hs_dev
+        else:
+            lab = jnp.zeros((B, 1 + K), jnp.float32).at[:, 0].set(1.0)
 
-        def draw_targets(key, pos):
-            """[B, 1+K] device-sampled targets (col 0 = positive) +
-            labels; collisions with the positive shifted by one (same
-            shift the host path uses)."""
-            negs = jnp.searchsorted(cdf_dev, jax.random.uniform(
-                key, (pos.shape[0], K), dtype=cdf_dev.dtype))
-            negs = jnp.where(negs == pos[:, None], (negs + 1) % V,
-                             negs).astype(jnp.int32)
-            tgt = jnp.concatenate([pos[:, None], negs], axis=1)
-            lab = jnp.zeros(tgt.shape, jnp.float32).at[:, 0].set(1.0)
-            return tgt, lab
+        def pm_of(nv):
+            return (lax.broadcasted_iota(jnp.int32, (B,), 0)
+                    < nv).astype(jnp.float32)
 
         def body(carry, inp):
-            s0, s1, key = carry
-            key, sub = jax.random.split(key)
+            s0, s1 = carry
             if is_cbow and use_hs:
-                ctx, cm, c, lr, pm = inp
-                s0, s1, loss = E.cbow_hs(s0, s1, ctx, cm, points_d[c],
-                                         codes_d[c], mask_d[c], lr, pm,
-                                         dense=dense)
+                ctx, cm, c, nv, lr = inp
+                c = c.astype(jnp.int32)
+                s0, s1, loss = E.cbow_hs(
+                    s0, s1, ctx.astype(jnp.int32), cm.astype(jnp.float32),
+                    points_d[c], codes_d[c], mask_d[c], lr, pm_of(nv),
+                    dense=dense)
             elif is_cbow:
-                ctx, cm, c, lr, pm = inp
-                tgt, lab = draw_targets(sub, c)
-                s0, s1, loss = E.cbow(s0, s1, ctx, cm, tgt, lab, lr, pm,
-                                      dense=dense)
+                ctx, cm, tgt, nv, lr = inp
+                s0, s1, loss = E.cbow(
+                    s0, s1, ctx.astype(jnp.int32), cm.astype(jnp.float32),
+                    tgt, lab, lr, pm_of(nv), dense=dense)
             elif use_hs:
-                c, x, lr, pm = inp
-                s0, s1, loss = E.skipgram_hs(s0, s1, c, points_d[x],
-                                             codes_d[x], mask_d[x], lr, pm,
-                                             dense=dense)
+                c, x, nv, lr = inp
+                x = x.astype(jnp.int32)
+                s0, s1, loss = E.skipgram_hs(
+                    s0, s1, c.astype(jnp.int32), points_d[x], codes_d[x],
+                    mask_d[x], lr, pm_of(nv), dense=dense)
             else:
-                c, x, lr, pm = inp
-                tgt, lab = draw_targets(sub, x)
-                s0, s1, loss = E.skipgram(s0, s1, c, tgt, lab, lr, pm,
-                                          dense=dense)
-            return (s0, s1, key), loss
+                c, tgt, nv, lr = inp
+                s0, s1, loss = E.skipgram(
+                    s0, s1, c.astype(jnp.int32), tgt, lab, lr, pm_of(nv),
+                    dense=dense)
+            return (s0, s1), (loss, nv.astype(jnp.float32))
+
+        def bulk_targets(key, pos3):
+            """[R, B, 1+K] int32 targets for the whole block (col 0 =
+            positive); collisions with the positive shifted by one (same
+            shift the host path uses)."""
+            T = ntable_dev.shape[0]
+            bits = jax.random.bits(key, pos3.shape + (K,), jnp.uint32)
+            negs = ntable_dev[(bits & (T - 1)).astype(jnp.int32)]
+            negs = jnp.where(negs == pos3[..., None], (negs + 1) % V, negs)
+            return jnp.concatenate([pos3[..., None], negs], axis=-1)
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def block(syn0, syn1, cols, key):
-            (syn0, syn1, _), losses = lax.scan(body, (syn0, syn1, key), cols)
-            return syn0, syn1, losses.mean()
+        def block(syn0, syn1, cols, key, blk_id):
+            # fold_in runs INSIDE the jit: eager jax.random.fold_in is a
+            # chain of tiny dispatches, each paying ~95 ms of relay latency
+            # (round-3 measurement) — hoisting it makes the whole block one
+            # dispatch again.
+            key = jax.random.fold_in(key, blk_id)
+            if use_hs:
+                xs = cols
+            elif is_cbow:
+                ctx3, cm3, c3, nv3, lr3 = cols
+                tgt3 = bulk_targets(key, c3.astype(jnp.int32))
+                xs = (ctx3, cm3, tgt3, nv3, lr3)
+            else:
+                c3, x3, nv3, lr3 = cols
+                tgt3 = bulk_targets(key, x3.astype(jnp.int32))
+                xs = (c3, tgt3, nv3, lr3)
+            (syn0, syn1), (losses, ns) = lax.scan(body, (syn0, syn1), xs)
+            # pair-weighted mean: mask-padded rounds carry zero weight, so
+            # the monitored loss tracks training regardless of padding
+            return (syn0, syn1,
+                    (losses * ns).sum() / jnp.maximum(ns.sum(), 1.0))
 
         return block
 
-    @staticmethod
-    def _pow2_floor(n: int) -> int:
-        return 1 << (n.bit_length() - 1)
+    def _make_window_block(self, hs_dev=None, ntable_dev=None):
+        """Device-windowed skip-gram block: the corpus lives ON DEVICE and
+        each round derives its training pairs there.
+
+        Jitted ``(syn0, syn1, ids, sent, n_valid, cols, key, blk_id) ->
+        (syn0', syn1', mean_loss, n_pairs)`` where ``ids``/``sent`` are the
+        (subsampled, compacted) flat corpus and its sentence-id map —
+        uploaded once per epoch, ~2–6 bytes/word — and ``cols`` is just
+        ``(p0s [R] int32, lr3 [R] float32)``: per-ROUND host traffic is 8
+        bytes. This removes the pair-index upload entirely (round-3 relay
+        audit: 5–10 MB/s host→device made ~4 bytes/pair the throughput
+        ceiling of the fit).
+
+        Pair derivation per round, all on device: positions
+        ``p = p0 + iota(centers_per_round)``; reduced window ``b ~ U[1, W]``
+        per center (word2vec.c semantics); candidate slots ``p + off`` for
+        ``off ∈ ±[1, W]`` become (center, context) training pairs masked by
+        corpus bounds, sentence boundary (``sent`` equality), and ``b``.
+        Invalid slots train with pair_mask 0 — padded MXU work instead of
+        host branching. Frequent-word subsampling stays on the HOST
+        (compaction before upload) so window spans match the reference's
+        post-subsampling stream exactly.
+        """
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..ops import embeddings as E
+
+        is_hs = self.use_hs
+        V, K, W = len(self.vocab), self.negative, self.window
+        B_C = self._window_centers
+        B = B_C * 2 * W
+        if is_hs:
+            points_d, codes_d, mask_d = hs_dev
+        else:
+            lab = jnp.zeros((B, 1 + K), jnp.float32).at[:, 0].set(1.0)
+        offs = jnp.asarray(np.concatenate([np.arange(-W, 0),
+                                           np.arange(1, W + 1)]), jnp.int32)
+
+        def body(carry, inp):
+            s0, s1, ids, sent, n_valid, key = carry
+            if is_hs:
+                p0, lr = inp
+            else:
+                p0, lr, negs = inp
+            key, kb = jax.random.split(key)
+            p = p0 + lax.broadcasted_iota(jnp.int32, (B_C,), 0)
+            pc = jnp.clip(p, 0, ids.shape[0] - 1)
+            c_ids = ids[pc].astype(jnp.int32)
+            b = jax.random.randint(kb, (B_C,), 1, W + 1)
+            q = p[:, None] + offs[None, :]                      # [B_C, 2W]
+            qc = jnp.clip(q, 0, ids.shape[0] - 1)
+            x_ids = ids[qc].astype(jnp.int32)
+            valid = ((q >= 0) & (q < n_valid) & (p < n_valid)[:, None]
+                     & (jnp.abs(offs)[None, :] <= b[:, None])
+                     & (sent[qc] == sent[pc][:, None]))
+            centers = jnp.broadcast_to(c_ids[:, None],
+                                       (B_C, 2 * W)).reshape(B)
+            ctx = x_ids.reshape(B)
+            pm = valid.reshape(B).astype(jnp.float32)
+            if is_hs:
+                s0, s1, loss = E.skipgram_hs(
+                    s0, s1, centers, points_d[ctx], codes_d[ctx],
+                    mask_d[ctx], lr, pm, dense=False)
+            else:
+                negs = jnp.where(negs == ctx[:, None], (negs + 1) % V, negs)
+                tgt = jnp.concatenate([ctx[:, None], negs], axis=1)
+                s0, s1, loss = E.skipgram(s0, s1, centers, tgt, lab, lr, pm,
+                                          dense=False)
+            return (s0, s1, ids, sent, n_valid, key), (loss, pm.sum())
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def block(syn0, syn1, ids, sent, n_valid, cols, key, blk_id):
+            p0s, lr3 = cols
+            key = jax.random.fold_in(key, blk_id)
+            if is_hs:
+                xs = (p0s, lr3)
+            else:
+                T = ntable_dev.shape[0]
+                kneg, key = jax.random.split(key)
+                bits = jax.random.bits(kneg, (p0s.shape[0], B, K),
+                                       jnp.uint32)
+                negs3 = ntable_dev[(bits & (T - 1)).astype(jnp.int32)]
+                xs = (p0s, lr3, negs3)
+            (syn0, syn1, _, _, _, _), (losses, np_) = lax.scan(
+                body, (syn0, syn1, ids, sent, n_valid, key), xs)
+            # pair-weighted mean (empty/padded rounds carry zero weight)
+            return (syn0, syn1,
+                    (losses * np_).sum() / jnp.maximum(np_.sum(), 1.0),
+                    np_.sum())
+
+        return block
+
+    def _block_for(self, tag: str, make: Callable, *extra):
+        """Shared block-function cache: rebuild (re-trace) only when the
+        config/vocab the closure captures actually changed. ``make``
+        receives ``(hs_dev, ntable_dev)`` device tables."""
+        import jax.numpy as jnp
+
+        key = (tag, len(self.vocab), int(self.vocab.counts().sum()),
+               self.negative, self.algorithm, self.use_hs) + extra
+        if getattr(self, "_block_cache_key", None) != key:
+            hs_dev = ntable_dev = None
+            if self.use_hs:
+                hs_codes, hs_points, hs_mask = huffman_arrays(self.vocab)
+                hs_dev = (jnp.asarray(hs_points), jnp.asarray(hs_codes),
+                          jnp.asarray(hs_mask))
+            else:
+                ntable_dev = jnp.asarray(unigram_int_table(self.vocab))
+            self._block_fn = make(hs_dev, ntable_dev)
+            self._block_cache_key = key
+        return self._block_fn
+
+    def _train_windowed(self, corpus: List[np.ndarray],
+                        total_words: Optional[int] = None) -> None:
+        """Skip-gram fit with device-resident corpus (see
+        ``_make_window_block``). Statistical procedure matches
+        ``_train_encoded``: host subsampling+compaction per epoch, reduced
+        windows, NS from the unigram^0.75 table or HS Huffman paths,
+        linear LR decay by corpus-words consumed."""
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(self.seed)
+        keep = subsample_keep_probs(self.vocab, self.sampling)
+        V = len(self.vocab)
+        B_C, R = self._window_centers, self.MAX_BLOCK_ROUNDS
+        raw_words = sum(len(s) for s in corpus)
+        if total_words is None:
+            total_words = raw_words * self.epochs * self.iterations
+
+        block = self._block_for("win", self._make_window_block,
+                                self.window, self._window_centers)
+
+        flat = (np.concatenate(corpus) if corpus
+                else np.empty(0, np.int32)).astype(np.int32)
+        lens = np.array([c.size for c in corpus], dtype=np.int64)
+        sent_full = np.repeat(np.arange(len(corpus), dtype=np.int32), lens)
+        idx_dt = np.uint16 if V <= (1 << 16) else np.int32
+        sent_dt = (np.uint16 if len(corpus) < (1 << 16) - 1 else np.int32)
+
+        base_key = jax.random.PRNGKey(self.seed)
+        syn0 = jnp.asarray(self.lookup_table.syn0)
+        syn1 = jnp.asarray(self.lookup_table.syn1 if self.use_hs
+                           else self.lookup_table.syn1neg)
+        losses, pair_counts = [], []
+        n_blocks = 0
+        words_seen = 0
+        t0 = time.perf_counter()
+
+        def upload(ids_np, sent_np):
+            n = ids_np.size
+            npad = -(-max(n, 1) // self.CORPUS_BUCKET) * self.CORPUS_BUCKET
+            # pad sent with -1-style sentinel (max value) so boundary
+            # checks fail; ids pad value is irrelevant under the mask
+            return (jax.device_put(
+                        np.pad(ids_np.astype(idx_dt), (0, npad - n))),
+                    jax.device_put(
+                        np.pad(sent_np.astype(sent_dt), (0, npad - n),
+                               constant_values=np.iinfo(sent_dt).max)),
+                    np.int32(n))
+
+        if self.sampling <= 0:
+            # no subsampling => the corpus is identical every epoch; upload
+            # once (the relay link is the scarce resource, BASELINE.md)
+            static_bufs = upload(flat, sent_full)
+
+        span = B_C * R               # positions per block
+        for _epoch in range(self.epochs):
+            if self.sampling > 0:
+                m = rng.random(flat.size) < keep[flat]
+                ids_dev, sent_dev, n_valid = upload(flat[m], sent_full[m])
+            else:
+                ids_dev, sent_dev, n_valid = static_bufs
+            n = int(n_valid)
+            for _it in range(self.iterations):
+                it_base = words_seen
+                for p0 in range(0, n, span):
+                    p0s = (p0 + np.arange(R, dtype=np.int32) * B_C)
+                    # LR decays by raw corpus words consumed; compacted
+                    # position p maps to ~p/n of this epoch-pass's words
+                    frac = ((it_base
+                             + p0s.astype(np.float64) / max(n, 1)
+                             * raw_words) / max(total_words, 1))
+                    lr3 = np.maximum(
+                        self.learning_rate * (1.0 - np.minimum(frac, 1.0)),
+                        self.min_learning_rate).astype(np.float32)
+                    syn0, syn1, loss, np_ = block(
+                        syn0, syn1, ids_dev, sent_dev, n_valid,
+                        (p0s, lr3), base_key, np.int32(n_blocks))
+                    n_blocks += 1
+                    losses.append(loss)
+                    pair_counts.append(np_)
+                words_seen += raw_words
+        # VALUE fence (see _train_encoded): read back results that depend
+        # on the full chain, once.
+        last = (np.asarray(jnp.stack(losses[-50:])) if losses
+                else np.zeros(1, np.float32))
+        pairs_seen = (float(np.asarray(jnp.stack(pair_counts)).sum())
+                      if pair_counts else 0.0)
+        dt = time.perf_counter() - t0
+        self.words_per_sec = words_seen / max(dt, 1e-9)
+        self.pairs_per_sec = pairs_seen / max(dt, 1e-9)
+        self.last_loss = float(last.mean()) if losses else 0.0
+        self.lookup_table.syn0 = np.asarray(syn0)
+        if self.use_hs:
+            self.lookup_table.syn1 = np.asarray(syn1)
+        else:
+            self.lookup_table.syn1neg = np.asarray(syn1)
 
     def _train_encoded(self, corpus: List[np.ndarray],
                        stream_factory: Optional[Callable] = None,
@@ -303,22 +576,24 @@ class SequenceVectors(WordVectors):
         generation — it must yield ``(centers, contexts)`` tuples for
         skip-gram configs or ``(centers, ctx, cmask)`` for CBOW configs.
         ParagraphVectors uses this to inject doc-label ids into the stream.
+
+        Plain skip-gram fits (no custom stream) use the device-windowed
+        path (``_train_windowed``) — corpus resident on device, pairs
+        derived there. Custom streams and CBOW use the host pair pipeline
+        below (native ``sg_pairs`` C++ producer + background staging).
+        ``device_corpus=False`` on the instance forces the host path.
         """
         import jax.numpy as jnp
 
         import jax
 
+        if (stream_factory is None and self.algorithm == "skipgram"
+                and getattr(self, "device_corpus", True)):
+            return self._train_windowed(corpus, total_words)
+
         rng = np.random.default_rng(self.seed)
         keep = subsample_keep_probs(self.vocab, self.sampling)
-        hs_dev = cdf_dev = None
-        if self.use_hs:
-            hs_codes, hs_points, hs_mask = huffman_arrays(self.vocab)
-            hs_dev = (jnp.asarray(hs_points), jnp.asarray(hs_codes),
-                      jnp.asarray(hs_mask))
-        else:
-            cdf_dev = jnp.asarray(unigram_table(self.vocab),
-                                  dtype=jnp.float32)
-        block = self._make_block(hs_dev, cdf_dev)
+        block = self._block_for("host", self._make_block, self.batch_size)
         base_key = jax.random.PRNGKey(self.seed)
         n_blocks = 0
         V = len(self.vocab)
@@ -343,60 +618,60 @@ class SequenceVectors(WordVectors):
             return np.float32(max(self.learning_rate * (1 - frac),
                                   self.min_learning_rate))
 
-        def _rounds(npairs):
-            """Pad-to-B bookkeeping shared by both flushes."""
-            pad = (-npairs) % B
-            pm = np.ones(npairs + pad, dtype=np.float32)
-            pm[npairs:] = 0.0
-            return pad, pm, (npairs + pad) // B
+        # uint16 indices on the wire whenever the vocab fits (the relay
+        # moves 5-10 MB/s; bytes ARE throughput — see _make_block).
+        idx_dt = np.uint16 if V <= (1 << 16) else np.int32
 
-        def _dispatch(cols_fn, R):
-            """Run R rounds as pow2-sized scanned blocks (bounded set of
-            compiled shapes)."""
-            nonlocal syn0, syn1, n_blocks
-            r = 0
-            while r < R:
-                nb = min(self.MAX_BLOCK_ROUNDS, self._pow2_floor(R - r))
-                key = jax.random.fold_in(base_key, n_blocks)
-                n_blocks += 1
-                syn0, syn1, loss = block(syn0, syn1, cols_fn(r, nb), key)
-                losses.append(loss)   # device scalar; no sync in the loop
-                r += nb
+        def _rounds(npairs):
+            """Pad-to-a-multiple-of-a-full-block bookkeeping shared by
+            both flushes. Padded pairs are masked out on DEVICE from the
+            per-round valid count ``nv``."""
+            pad = (-npairs) % (B * self.MAX_BLOCK_ROUNDS)
+            R = (npairs + pad) // B
+            nv = np.minimum(np.maximum(npairs - np.arange(R) * B, 0),
+                            B).astype(np.int32)
+            return pad, nv, R
+
+        def _blocks(R):
+            """Split R rounds (a multiple of MAX_BLOCK_ROUNDS) into
+            full-sized scanned blocks — ONE compiled shape per fit."""
+            for r in range(0, R, self.MAX_BLOCK_ROUNDS):
+                yield r, self.MAX_BLOCK_ROUNDS
+
+        def _stage(cols):
+            """Upload a block's columns from the PRODUCER thread so H2D
+            transfer overlaps the consumer's device dispatches."""
+            return tuple(jax.device_put(a) for a in cols)
 
         def flush_sg(centers, contexts):
             nonlocal pairs_seen
             npairs = centers.size
-            pad, pm, R = _rounds(npairs)
-            c3 = np.pad(centers, (0, pad)).reshape(R, B)
-            x3 = np.pad(contexts, (0, pad)).reshape(R, B)
-            pm3 = pm.reshape(R, B)
+            pad, nv, R = _rounds(npairs)
+            c3 = np.pad(centers.astype(idx_dt), (0, pad)).reshape(R, B)
+            x3 = np.pad(contexts.astype(idx_dt), (0, pad)).reshape(R, B)
             lr = _lr()
-
-            def cols_fn(r, nb):
-                sl = slice(r, r + nb)
-                return (c3[sl], x3[sl], np.full(nb, lr, np.float32), pm3[sl])
-
-            _dispatch(cols_fn, R)
             pairs_seen += npairs
+            for r, nb in _blocks(R):
+                sl = slice(r, r + nb)
+                yield _stage((c3[sl], x3[sl], nv[sl],
+                              np.full(nb, lr, np.float32)))
 
         def flush_cbow(centers, ctx, cmask):
             nonlocal pairs_seen
             npairs = centers.size
-            pad, pm, R = _rounds(npairs)
+            pad, nv, R = _rounds(npairs)
             W = ctx.shape[1]
-            c3 = np.pad(centers, (0, pad)).reshape(R, B)
-            ctx3 = np.pad(ctx, ((0, pad), (0, 0))).reshape(R, B, W)
-            cm3 = np.pad(cmask, ((0, pad), (0, 0))).reshape(R, B, W)
-            pm3 = pm.reshape(R, B)
+            c3 = np.pad(centers.astype(idx_dt), (0, pad)).reshape(R, B)
+            ctx3 = np.pad(ctx.astype(idx_dt),
+                          ((0, pad), (0, 0))).reshape(R, B, W)
+            cm3 = np.pad(cmask.astype(np.uint8),
+                         ((0, pad), (0, 0))).reshape(R, B, W)
             lr = _lr()
-
-            def cols_fn(r, nb):
-                sl = slice(r, r + nb)
-                return (ctx3[sl], cm3[sl], c3[sl],
-                        np.full(nb, lr, np.float32), pm3[sl])
-
-            _dispatch(cols_fn, R)
             pairs_seen += npairs
+            for r, nb in _blocks(R):
+                sl = slice(r, r + nb)
+                yield _stage((ctx3[sl], cm3[sl], c3[sl], nv[sl],
+                              np.full(nb, lr, np.float32)))
 
         def default_stream(rng, keep):
             if is_cbow:
@@ -433,48 +708,83 @@ class SequenceVectors(WordVectors):
         if stream_factory is None:
             stream_factory = default_stream
 
-        for _epoch in range(self.epochs):
+        def work_items():
+            """Producer generator: pair generation + batching + padding on
+            the host, yielding ready column blocks. Runs on a background
+            thread (``prefetch_iter``) so pair-gen for flush N+1 overlaps
+            the device executing flush N — the TPU analog of the
+            reference's N worker threads keeping the JNI kernels fed."""
+            nonlocal words_seen
+            # Mid-fit flushes emit only FULL MAX_BLOCK_ROUNDS blocks and
+            # carry the remainder pairs forward (even across epochs): tail
+            # blocks pay upload fixed-costs out of proportion to their
+            # size, so exactly one padded tail runs — at the very end.
+            chunk = self.MAX_BLOCK_ROUNDS * B
             if is_cbow:
                 buf = []
                 buffered = 0
-                for item in stream_factory(rng, keep):
-                    nwords, wins = item[0], item[1:]
-                    words_seen += nwords * self.iterations
-                    for _ in range(self.iterations):
-                        buf.append(wins)
-                        buffered += wins[0].size
-                    if buffered >= 64 * B:
-                        flush_cbow(np.concatenate([w[0] for w in buf]),
-                                   np.concatenate([w[1] for w in buf]),
-                                   np.concatenate([w[2] for w in buf]))
-                        buf, buffered = [], 0
-                if buf:
-                    flush_cbow(np.concatenate([w[0] for w in buf]),
-                               np.concatenate([w[1] for w in buf]),
-                               np.concatenate([w[2] for w in buf]))
+                for _epoch in range(self.epochs):
+                    for item in stream_factory(rng, keep):
+                        nwords, wins = item[0], item[1:]
+                        words_seen += nwords * self.iterations
+                        for _ in range(self.iterations):
+                            buf.append(wins)
+                            buffered += wins[0].size
+                        if buffered >= chunk:
+                            c, ctx, cm = (np.concatenate([w[i] for w in buf])
+                                          for i in range(3))
+                            n_full = (c.shape[0] // chunk) * chunk
+                            yield from flush_cbow(c[:n_full], ctx[:n_full],
+                                                  cm[:n_full])
+                            buf = [(c[n_full:], ctx[n_full:], cm[n_full:])]
+                            buffered = c.shape[0] - n_full
+                if buffered:
+                    yield from flush_cbow(
+                        np.concatenate([w[0] for w in buf]),
+                        np.concatenate([w[1] for w in buf]),
+                        np.concatenate([w[2] for w in buf]))
             else:
                 buf_c: List[np.ndarray] = []
                 buf_x: List[np.ndarray] = []
                 buffered = 0
-                for item in stream_factory(rng, keep):
-                    nwords, pairs = item[0], item[1:]
-                    words_seen += nwords * self.iterations
-                    for _ in range(self.iterations):
-                        buf_c.append(pairs[0])
-                        buf_x.append(pairs[1])
-                        buffered += pairs[0].size
-                    if buffered >= 64 * B:
-                        flush_sg(np.concatenate(buf_c), np.concatenate(buf_x))
-                        buf_c, buf_x, buffered = [], [], 0
+                for _epoch in range(self.epochs):
+                    for item in stream_factory(rng, keep):
+                        nwords, pairs = item[0], item[1:]
+                        words_seen += nwords * self.iterations
+                        for _ in range(self.iterations):
+                            buf_c.append(pairs[0])
+                            buf_x.append(pairs[1])
+                            buffered += pairs[0].size
+                        if buffered >= chunk:
+                            c = np.concatenate(buf_c)
+                            x = np.concatenate(buf_x)
+                            n_full = (c.size // chunk) * chunk
+                            yield from flush_sg(c[:n_full], x[:n_full])
+                            buf_c, buf_x = [c[n_full:]], [x[n_full:]]
+                            buffered = c.size - n_full
                 if buffered:
-                    flush_sg(np.concatenate(buf_c), np.concatenate(buf_x))
+                    yield from flush_sg(np.concatenate(buf_c),
+                                        np.concatenate(buf_x))
 
-        syn0.block_until_ready()
+        from ..common.background import prefetch_iter
+
+        for cols in prefetch_iter(work_items(), maxsize=8):
+            syn0, syn1, loss = block(syn0, syn1, cols, base_key,
+                                     np.int32(n_blocks))
+            n_blocks += 1
+            losses.append(loss)   # device scalar; no sync in the loop
+
+        # VALUE fence: through the TPU relay block_until_ready returns
+        # before device work completes (BASELINE.md round-2 methodology
+        # note); reading back a value that depends on the whole chain is
+        # the honest barrier. One stacked readback also replaces the 50
+        # per-scalar syncs the loss average used to pay.
+        last = (np.asarray(jnp.stack(losses[-50:])) if losses
+                else np.zeros(1, np.float32))
         dt = time.perf_counter() - t0
         self.words_per_sec = words_seen / max(dt, 1e-9)
         self.pairs_per_sec = pairs_seen / max(dt, 1e-9)
-        self.last_loss = float(np.mean([float(l) for l in losses[-50:]])) \
-            if losses else 0.0
+        self.last_loss = float(last.mean()) if losses else 0.0
         self.lookup_table.syn0 = np.asarray(syn0)
         if self.use_hs:
             self.lookup_table.syn1 = np.asarray(syn1)
@@ -526,7 +836,9 @@ class Word2Vec(SequenceVectors):
                 "cbow" if "cbow" in name.lower() else "skipgram"
             return self
 
-        def iterate(self, it: SentenceIterator):
+        def iterate(self, it):
+            if isinstance(it, (list, tuple)):
+                it = CollectionSentenceIterator(it)
             self._iter = it
             return self
 

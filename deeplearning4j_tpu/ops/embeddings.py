@@ -13,17 +13,19 @@ the tables. All rounds return ``(syn0', syn1', loss)``; callers jit with
 
 Table accumulation has two lowerings, selected by the static ``dense`` flag:
 
-- ``dense=False``: XLA scatter-add (``Array.at[idx].add``) — deterministic,
-  sums duplicate indices exactly like the reference's serialized per-pair
-  axpy. But TPU scatter throughput is per-row serialized (~100–200k
-  rows/sec measured through this relay), so it loses badly at batch sizes.
+- ``dense=False`` (the production path): XLA scatter-add
+  (``Array.at[idx].add``) — deterministic, sums duplicate indices exactly
+  like the reference's serialized per-pair axpy, and touches ONLY the
+  sampled rows (the reference sg_cb's O(batch·D) shape). Round-3
+  re-measurement with value-fenced rep-differencing
+  (``tools/w2v_update_bench.py`` on v5e): 326M rows/s at V=10k, 74M rows/s
+  at V=100k — the earlier "per-row serialized ~100–200k rows/s" claim was
+  a broken-fence artifact of the round-1 methodology.
 - ``dense=True``: the update becomes ``onehot(idx)ᵀ @ grads`` — a bf16 MXU
-  matmul accumulated into the f32 table (``preferred_element_type``),
-  measured 4–6× faster at vocab ≤ ~32k. One-hot traffic is O(batch·V)
-  bytes, so callers should fall back to scatter for very large vocabs;
-  ``SequenceVectors`` auto-selects. Gradients pass through bf16 (~3
-  significant digits) — word2vec is robust to far coarser noise than that
-  (the reference itself computes sigmoid through a 512-entry lookup table).
+  matmul accumulated into the f32 table. O(batch·V) one-hot HBM traffic
+  makes it 8–16× SLOWER than scatter at every vocab measured (9.9k–100k);
+  kept for MXU experiments and as a numerical cross-check in tests, never
+  auto-selected.
 """
 
 from __future__ import annotations
@@ -33,9 +35,11 @@ import jax.numpy as jnp
 
 from .registry import op
 
-# Above this table height the dense one-hot update's O(batch·V) HBM traffic
-# loses to scatter; chosen from v5e measurements at D=100, B=8192.
-DENSE_UPDATE_MAX_ROWS = 32768
+# Vocab threshold below which SequenceVectors picks the dense one-hot MXU
+# update. Round-3 measurement (module docstring) shows scatter wins at every
+# size, so the threshold is 0 = never dense; the knob survives so the
+# shootout in tools/w2v_update_bench.py can keep regression-checking it.
+DENSE_UPDATE_MAX_ROWS = 0
 
 
 def _table_add(table, idx, grads, dense: bool):

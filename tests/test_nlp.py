@@ -430,3 +430,82 @@ class TestParagraphVectors:
               .iterate(LabelAwareIterator(docs, labels)).build())
         pv.fit()
         assert pv.get_paragraph_vector("DOC_0").shape == (8,)
+
+
+class TestLargeVocabScaling:
+    """Round-3 verdict item 2: the table update must not scale with V.
+
+    The proof is structural, not a timing race: the training round's jaxpr
+    must contain no vocab-sized dense contraction (the old one-hot MXU
+    update materialized an O(batch·V) operand); only gathers/scatters over
+    the sampled rows may touch the [V, D] tables."""
+
+    def _round_jaxpr(self, V):
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.ops import embeddings as E
+
+        B, D, K = 256, 32, 5
+        syn0 = jnp.zeros((V, D))
+        syn1 = jnp.zeros((V, D))
+        c = jnp.zeros((B,), jnp.int32)
+        tgt = jnp.zeros((B, 1 + K), jnp.int32)
+        lab = jnp.zeros((B, 1 + K), jnp.float32)
+        pm = jnp.ones((B,), jnp.float32)
+        return jax.make_jaxpr(
+            lambda *a: E.skipgram(*a, dense=False))(
+                syn0, syn1, c, tgt, lab, jnp.float32(0.025), pm)
+
+    def test_no_vocab_sized_contraction_at_100k_vocab(self):
+        V = 100_000
+        jaxpr = self._round_jaxpr(V)
+        prims = set()
+        for eqn in jaxpr.jaxpr.eqns:
+            prims.add(eqn.primitive.name)
+            if eqn.primitive.name == "dot_general":
+                for var in eqn.invars:
+                    shape = getattr(var.aval, "shape", ())
+                    assert V not in shape, (
+                        "dense vocab-sized contraction in the round: "
+                        f"{eqn}")
+        # the sparse update path must actually be scatter-add
+        assert "scatter-add" in prims or "scatter_add" in prims, prims
+
+    def test_100k_vocab_round_updates_only_sampled_rows(self):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.ops import embeddings as E
+
+        V, D, K = 100_000, 16, 3
+        rs = np.random.RandomState(0)
+        syn0 = jnp.asarray(rs.randn(V, D).astype(np.float32))
+        syn1 = jnp.asarray(rs.randn(V, D).astype(np.float32))
+        c = jnp.asarray(np.array([7, 99_998], np.int32))
+        tgt = jnp.asarray(np.array([[3, 50_000, 11, 70_001],
+                                    [99_999, 5, 60_000, 2]], np.int32))
+        lab = jnp.zeros((2, 1 + K), jnp.float32).at[:, 0].set(1.0)
+        pm = jnp.ones((2,), jnp.float32)
+        s0, s1, loss = E.skipgram(syn0, syn1, c, tgt, lab,
+                                  jnp.float32(0.025), pm, dense=False)
+        d0 = np.flatnonzero(np.abs(np.asarray(s0 - syn0)).sum(axis=1))
+        d1 = np.flatnonzero(np.abs(np.asarray(s1 - syn1)).sum(axis=1))
+        assert set(d0) <= {7, 99_998}
+        assert set(d1) <= {3, 50_000, 11, 70_001, 99_999, 5, 60_000, 2}
+        assert np.isfinite(float(loss))
+
+    def test_windowed_fit_at_large_vocab_smoke(self):
+        # end-to-end device-corpus fit over a >65,536-word vocab: takes the
+        # int32 index path (idx dtype flips off uint16 above 2^16)
+        from deeplearning4j_tpu.nlp import Word2Vec
+
+        V = 70_020
+        sents = [" ".join(f"w{j}" for j in range(i, i + 30))
+                 for i in range(0, V, 30)]
+        w = Word2Vec(min_word_frequency=1, layer_size=8, negative=2,
+                     epochs=1, batch_size=128, seed=1)
+        w.set_sentence_iterator(sents)
+        w.fit()
+        assert len(w.vocab) > (1 << 16)
+        assert np.isfinite(w.lookup_table.syn0).all()
+        assert np.isfinite(w.last_loss)
