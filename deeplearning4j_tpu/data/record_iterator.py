@@ -214,46 +214,10 @@ class AsyncDataSetIterator(DataSetIterator):
         return out
 
     def __iter__(self) -> Iterator[DataSet]:
-        q: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
-        stop = threading.Event()
-        err: List[BaseException] = []
+        from ..common.background import prefetch_iter
 
-        def _put(item) -> bool:
-            # bounded put that aborts when the consumer went away, so an
-            # abandoned generator cannot leave the worker blocked forever
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
-
-        def worker():
-            try:
-                for ds in self.base:
-                    if stop.is_set() or not _put(self._stage(ds)):
-                        return
-            except BaseException as e:  # surfaced on the consumer side
-                err.append(e)
-            finally:
-                _put(self._END)
-
-        t = threading.Thread(target=worker, daemon=True)
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is self._END:
-                    break
-                yield item
-            if err:
-                raise err[0]
-        finally:
-            stop.set()
-            try:
-                while True:
-                    q.get_nowait()
-            except queue.Empty:
-                pass
-            t.join(timeout=5.0)
+        # staging (device_put) runs on the worker thread so H2D transfer
+        # overlaps the consumer's step; the queue/shutdown/exception
+        # machinery is the shared prefetch_iter helper
+        yield from prefetch_iter((self._stage(ds) for ds in self.base),
+                                 maxsize=self.queue_size)

@@ -1,6 +1,7 @@
-"""Model import — TF frozen GraphDef → SameDiff; Keras h5 → layer API.
+"""Model import — TF frozen GraphDef → SameDiff; ONNX → SameDiff; Keras h5
+→ layer API.
 
-Reference: nd4j ``samediff-import-{api,tensorflow}`` + legacy
+Reference: nd4j ``samediff-import-{api,tensorflow,onnx}`` + legacy
 ``org.nd4j.imports.graphmapper.tf.TFGraphMapper`` and dl4j
 ``org.deeplearning4j.nn.modelimport.keras.KerasModelImport``
 (SURVEY.md §2.1, §2.3, §3.4).
@@ -8,6 +9,8 @@ Reference: nd4j ``samediff-import-{api,tensorflow}`` + legacy
 
 from .keras_import import KerasModelImport, UnsupportedKerasLayerError
 from .keras_graph_import import import_functional
+from .onnx_import import (OnnxFrameworkImporter, UnsupportedOnnxOpError,
+                          import_onnx, onnx_op, supported_onnx_ops)
 from .tf_graph_mapper import (TFGraphMapper, UnsupportedTFOpError,
                               import_frozen_tf, supported_tf_ops, tf_op)
 
@@ -15,4 +18,6 @@ __all__ = [
     "TFGraphMapper", "UnsupportedTFOpError", "import_frozen_tf",
     "supported_tf_ops", "tf_op", "KerasModelImport",
     "UnsupportedKerasLayerError", "import_functional",
+    "OnnxFrameworkImporter", "UnsupportedOnnxOpError", "import_onnx",
+    "onnx_op", "supported_onnx_ops",
 ]

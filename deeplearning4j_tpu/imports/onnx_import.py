@@ -1,0 +1,1071 @@
+"""ONNX model import → SameDiff.
+
+Reference: ``nd4j/samediff-import/samediff-import-onnx`` (Kotlin
+``OnnxFrameworkImporter`` + ``OnnxMappingProcess`` rule tables) and the
+``nd4j-onnxruntime`` interop module — SURVEY.md §2.1.
+
+Architecture: the same table-driven design as ``tf_graph_mapper.py`` (round-2
+importer), instantiated over the ONNX IR instead of TF GraphDef:
+
+- one small mapper per ONNX op_type (the ``@onnx_op`` registry =
+  ``OnnxOpMappingRegistry``), each emitting this package's registry ops into
+  a ``SameDiff`` graph that lowers to ONE jitted XLA module;
+- **structural-argument folding**: ONNX computes shapes/axes with tensor
+  subgraphs too (``Shape`` → ``Gather`` → ``Unsqueeze`` → ``Concat`` →
+  ``Reshape``); nodes whose inputs are all static fold to numpy constants at
+  import time and ``Shape`` resolves through jax ``eval_shape``, so those
+  subgraphs never reach the compiler;
+- graph ``initializer`` tensors import as CONSTANT variables;
+  ``SameDiff.convert_to_variables`` then makes any subset trainable — the
+  same fine-tune flow the BERT/TF path uses;
+- opset differences (attribute-vs-input ``axes``, ``Clip`` min/max inputs,
+  ``Split`` sizes) are handled per-mapper via ``ctx.opset``.
+
+The ONNX IR protos are compiled locally from the vendored ``onnx_ir.proto``
+(the ``onnx`` pip package is not in this image; the schema is public and
+stable). Conformance: ``tests/test_onnx_import.py`` builds ONNX graphs with
+``tests/onnx_testlib.py`` and checks against torch.nn.functional semantics
+(torch implements the ONNX operator contracts these mappers target).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..autodiff.samediff import SameDiff, SDVariable
+from . import onnx_ir_pb2 as OIR
+
+_ONNX_OPS: Dict[str, Callable] = {}
+
+
+class UnsupportedOnnxOpError(NotImplementedError):
+    def __init__(self, op: str, node_name: str):
+        super().__init__(
+            f"ONNX op {op!r} (node {node_name!r}) has no mapper; register "
+            f"one with @onnx_op({op!r}) in "
+            "deeplearning4j_tpu/imports/onnx_import.py")
+        self.op = op
+
+
+def onnx_op(*names: str):
+    """Register a mapper for one or more ONNX op_types
+    (mapper(ctx) -> SDVariable | tuple[SDVariable, ...])."""
+
+    def deco(fn):
+        for n in names:
+            _ONNX_OPS[n] = fn
+        return fn
+
+    return deco
+
+
+def supported_onnx_ops() -> List[str]:
+    return sorted(_ONNX_OPS)
+
+
+# --------------------------------------------------------------------------
+# TensorProto → numpy
+
+_DT = OIR.TensorProto
+_NP_OF_DT = {
+    _DT.FLOAT: np.float32, _DT.UINT8: np.uint8, _DT.INT8: np.int8,
+    _DT.UINT16: np.uint16, _DT.INT16: np.int16, _DT.INT32: np.int32,
+    _DT.INT64: np.int64, _DT.BOOL: np.bool_, _DT.FLOAT16: np.float16,
+    _DT.DOUBLE: np.float64, _DT.UINT32: np.uint32, _DT.UINT64: np.uint64,
+}
+
+
+def tensor_to_numpy(t: "OIR.TensorProto") -> np.ndarray:
+    if t.data_type == _DT.BFLOAT16:
+        import jax.numpy as jnp
+
+        raw = np.frombuffer(t.raw_data, dtype=np.uint16) if t.raw_data else \
+            np.asarray(list(t.int32_data), dtype=np.uint16)
+        return raw.view(jnp.bfloat16).reshape(tuple(t.dims))
+    if t.data_type not in _NP_OF_DT:
+        raise ValueError(f"unsupported ONNX tensor dtype {t.data_type}")
+    dt = np.dtype(_NP_OF_DT[t.data_type])
+    shape = tuple(t.dims)
+    if t.raw_data:
+        return np.frombuffer(t.raw_data, dtype=dt).reshape(shape).copy()
+    if t.data_type == _DT.FLOAT16:
+        # spec: fp16 without raw_data lives in int32_data as uint16 BIT
+        # PATTERNS — reinterpret, never value-cast
+        bits = np.asarray(list(t.int32_data), dtype=np.uint16)
+        return bits.view(np.float16).reshape(shape)
+    field = {
+        _DT.FLOAT: t.float_data, _DT.DOUBLE: t.double_data,
+        _DT.INT64: t.int64_data, _DT.UINT64: t.uint64_data,
+    }.get(t.data_type, t.int32_data)
+    return np.asarray(list(field), dtype=dt).reshape(shape)
+
+
+def numpy_to_tensor(a: np.ndarray, name: str = "") -> "OIR.TensorProto":
+    """Inverse of ``tensor_to_numpy`` (used by the test builder and the
+    model writer)."""
+    a = np.asarray(a)
+    rev = {np.dtype(v): k for k, v in _NP_OF_DT.items()}
+    if a.dtype not in rev:
+        raise ValueError(f"unsupported numpy dtype {a.dtype}")
+    t = OIR.TensorProto(name=name, data_type=rev[a.dtype],
+                        dims=list(a.shape), raw_data=a.tobytes())
+    return t
+
+
+# --------------------------------------------------------------------------
+
+
+class _Ctx:
+    """Per-node mapper context (attr access, resolved inputs, static
+    values, shape inference) — the `_Ctx` shape from tf_graph_mapper."""
+
+    def __init__(self, imp: "_Importer", node: "OIR.NodeProto"):
+        self.imp = imp
+        self.node = node
+        self.sd = imp.sd
+        self.name = node.name or (node.output[0] if node.output else "?")
+        # ONNX marks omitted optional inputs with ""
+        self.data_inputs = list(node.input)
+        self.opset = imp.opset
+
+    # --- attrs ---------------------------------------------------------
+    def attr(self, name: str, default=None):
+        for a in self.node.attribute:
+            if a.name != name:
+                continue
+            T = OIR.AttributeProto
+            if a.type == T.FLOAT:
+                return float(a.f)
+            if a.type == T.INT:
+                return int(a.i)
+            if a.type == T.STRING:
+                return a.s.decode()
+            if a.type == T.TENSOR:
+                return tensor_to_numpy(a.t)
+            if a.type == T.FLOATS:
+                return [float(v) for v in a.floats]
+            if a.type == T.INTS:
+                return [int(v) for v in a.ints]
+            if a.type == T.STRINGS:
+                return [v.decode() for v in a.strings]
+            raise ValueError(f"attr {name!r}: unsupported type {a.type}")
+        return default
+
+    # --- inputs --------------------------------------------------------
+    def n_in(self) -> int:
+        return len(self.data_inputs)
+
+    def has_input(self, i: int) -> bool:
+        return i < len(self.data_inputs) and self.data_inputs[i] != ""
+
+    def var(self, i: int) -> SDVariable:
+        return self.imp.resolve_var(self.data_inputs[i])
+
+    def var_or_none(self, i: int) -> Optional[SDVariable]:
+        return self.var(i) if self.has_input(i) else None
+
+    def vars(self, start: int = 0, end: Optional[int] = None):
+        return [self.imp.resolve_var(t)
+                for t in self.data_inputs[start:end] if t != ""]
+
+    def static(self, i: int) -> np.ndarray:
+        t = self.data_inputs[i]
+        v = self.imp.static_value(t)
+        if v is None:
+            raise ValueError(
+                f"input {i} ({t!r}) of node {self.name!r} "
+                f"({self.node.op_type}) must be statically resolvable "
+                "(initializer/constant/folded subgraph); dynamic values are "
+                "not supported for structural arguments under XLA's "
+                "static-shape model")
+        return v
+
+    def static_or_none(self, i: int) -> Optional[np.ndarray]:
+        if not self.has_input(i):
+            return None
+        return self.imp.static_value(self.data_inputs[i])
+
+    def axes_arg(self, attr_name: str = "axes", input_idx: int = 1,
+                 default=None):
+        """opset≥13 moved several ``axes`` from attribute to input; accept
+        both."""
+        v = self.attr(attr_name)
+        if v is not None:
+            return [int(a) for a in v]
+        s = self.static_or_none(input_idx)
+        if s is not None:
+            return [int(a) for a in np.atleast_1d(s)]
+        return default
+
+    def shape_of_input(self, i: int) -> Tuple[int, ...]:
+        return self.imp.infer_shape(self.data_inputs[i])
+
+    def emit(self, op_name: str, inputs: Sequence[Any], n_outputs=None, **kw):
+        return self.sd._add_op(op_name, list(inputs),
+                               name=self.name.replace(":", "_"),
+                               n_outputs=n_outputs, **kw)
+
+
+# --------------------------------------------------------------------------
+
+
+class _Importer:
+    def __init__(self, model: "OIR.ModelProto",
+                 input_shapes: Optional[Dict[str, Sequence[int]]] = None):
+        self.model = model
+        self.g = model.graph
+        self.sd = SameDiff.create()
+        self.input_shapes = dict(input_shapes or {})
+        self.opset = 13
+        for osi in model.opset_import:
+            if osi.domain in ("", "ai.onnx"):
+                self.opset = int(osi.version)
+        self._env: Dict[str, SDVariable] = {}
+        self._static: Dict[str, np.ndarray] = {}
+        self._shape_cache: Dict[str, Tuple[int, ...]] = {}
+        self.placeholders: List[str] = []
+        self.outputs: List[str] = []
+
+    # --- name plumbing --------------------------------------------------
+    def _bind(self, node: "OIR.NodeProto", outs) -> None:
+        if isinstance(outs, SDVariable):
+            outs = (outs,)
+        for tname, v in zip(node.output, outs):
+            if tname:
+                self._env[tname] = v
+
+    def resolve_var(self, tensor_name: str) -> SDVariable:
+        if tensor_name in self._env:
+            return self._env[tensor_name]
+        sval = self._static.get(tensor_name)
+        if sval is not None:
+            v = self.sd.constant(_safe(tensor_name), sval)
+            self._env[tensor_name] = v
+            return v
+        raise KeyError(f"unresolved ONNX tensor {tensor_name!r}")
+
+    def static_value(self, tensor_name: str) -> Optional[np.ndarray]:
+        return self._static.get(tensor_name)
+
+    # --- shape inference over the partial graph -------------------------
+    def infer_shape(self, tensor_name: str) -> Tuple[int, ...]:
+        import jax
+
+        if tensor_name in self._shape_cache:
+            return self._shape_cache[tensor_name]
+        sval = self._static.get(tensor_name)
+        if sval is not None:
+            return tuple(np.asarray(sval).shape)
+        var = self.resolve_var(tensor_name)
+        vinfo = self.sd._vars[var.name]
+        if vinfo.shape is not None and all(d is not None for d in vinfo.shape):
+            shp = tuple(int(d) for d in vinfo.shape)
+            self._shape_cache[tensor_name] = shp
+            return shp
+        fn = self.sd._make_fn((var.name,), training=False)
+        params = {n: jax.ShapeDtypeStruct(np.asarray(v.value).shape,
+                                          np.asarray(v.value).dtype)
+                  for n, v in self.sd._vars.items()
+                  if v.vtype == "VARIABLE"}
+        ph = {}
+        for n in self.sd.placeholders():
+            pshape = self.sd._vars[n].shape
+            if pshape is None or any(d is None for d in pshape):
+                raise ValueError(
+                    f"cannot infer shape of {tensor_name!r}: placeholder "
+                    f"{n!r} has unknown dims — pass input_shapes={{...}} to "
+                    "the importer")
+            pdt = np.dtype(self.sd._vars[n].dtype)
+            ph[n] = jax.ShapeDtypeStruct(tuple(pshape), pdt)
+        key_struct = jax.ShapeDtypeStruct((2,), np.uint32)
+        out = jax.eval_shape(fn, params, ph, key_struct)
+        shp = tuple(int(d) for d in out[0].shape)
+        self._shape_cache[tensor_name] = shp
+        return shp
+
+    # --- main loop ------------------------------------------------------
+    def run(self) -> SameDiff:
+        # initializers → static pool (materialized as graph constants only
+        # when consumed as tensors, exactly like TF Const nodes)
+        init_names = set()
+        for t in self.g.initializer:
+            self._static[t.name] = tensor_to_numpy(t)
+            init_names.add(t.name)
+
+        for vi in self.g.input:
+            if vi.name in init_names:
+                continue
+            self._import_placeholder(vi)
+
+        for node in self.g.node:
+            opn = node.op_type
+            if opn == "Constant":
+                val = self._constant_value(node)
+                self._static[node.output[0]] = val
+                continue
+            ctx = _Ctx(self, node)
+            if opn == "Shape":
+                shp = np.asarray(self.infer_shape(node.input[0]), np.int64)
+                start = ctx.attr("start", 0) or 0
+                end = ctx.attr("end")
+                shp = shp[start:end if end is not None else len(shp)]
+                self._static[node.output[0]] = shp
+                continue
+            if opn == "Size":
+                shp = self.infer_shape(node.input[0])
+                self._static[node.output[0]] = np.asarray(
+                    int(np.prod(shp, dtype=np.int64)), np.int64)
+                continue
+            folder = _FOLDERS.get(opn)
+            if folder is not None:
+                statics = [self._static.get(t) if t else None
+                           for t in node.input]
+                if all(t == "" or s is not None
+                       for t, s in zip(node.input, statics)):
+                    try:
+                        res = folder(ctx, statics)
+                    except Exception:
+                        res = None
+                    if res is not None:
+                        if not isinstance(res, (list, tuple)):
+                            res = (res,)
+                        for tname, r in zip(node.output, res):
+                            self._static[tname] = np.asarray(r)
+                        continue
+            mapper = _ONNX_OPS.get(opn)
+            if mapper is None:
+                raise UnsupportedOnnxOpError(opn, ctx.name)
+            outs = mapper(ctx)
+            if outs is not None:
+                self._bind(node, outs)
+
+        for vi in self.g.output:
+            if vi.name in self._env:
+                self.outputs.append(self._env[vi.name].name)
+            elif vi.name in self._static:
+                self.outputs.append(self.resolve_var(vi.name).name)
+        return self.sd
+
+    def _import_placeholder(self, vi: "OIR.ValueInfoProto") -> None:
+        tt = vi.type.tensor_type
+        shape: Optional[List[Optional[int]]] = None
+        if tt.HasField("shape"):
+            shape = []
+            for d in tt.shape.dim:
+                if d.WhichOneof("value") == "dim_value":
+                    shape.append(int(d.dim_value))
+                else:
+                    shape.append(None)
+        if vi.name in self.input_shapes:
+            shape = list(self.input_shapes[vi.name])
+        dt = np.dtype(_NP_OF_DT.get(tt.elem_type, np.float32))
+        v = self.sd.placeholder(_safe(vi.name), shape=shape, dtype=dt.name)
+        self._env[vi.name] = v
+        self.placeholders.append(v.name)
+
+    @staticmethod
+    def _constant_value(node: "OIR.NodeProto") -> np.ndarray:
+        for a in node.attribute:
+            if a.name == "value":
+                return tensor_to_numpy(a.t)
+            if a.name == "value_float":
+                return np.asarray(a.f, np.float32)
+            if a.name == "value_int":
+                return np.asarray(a.i, np.int64)
+            if a.name == "value_floats":
+                return np.asarray(list(a.floats), np.float32)
+            if a.name == "value_ints":
+                return np.asarray(list(a.ints), np.int64)
+        raise ValueError(f"Constant node {node.name!r} without value")
+
+
+def _safe(name: str) -> str:
+    return name.replace(":", "_").replace("/", "_").replace(".", "_")
+
+
+# --------------------------------------------------------------------------
+# static folders (structural subgraph evaluation, numpy semantics)
+
+
+def _fold_slice(ctx, s):
+    starts = ctx.attr("starts") or np.atleast_1d(s[1]).tolist()
+    ends = ctx.attr("ends") or np.atleast_1d(s[2]).tolist()
+    axes = ctx.axes_arg("axes", 3, list(range(len(starts))))
+    steps = ([1] * len(starts) if ctx.n_in() < 5 or s[4] is None
+             else np.atleast_1d(s[4]).tolist())
+    sl = [slice(None)] * np.ndim(s[0])
+    for a, st, en, sp in zip(axes, starts, ends, steps):
+        sl[a] = slice(int(st), int(en), int(sp))
+    return np.asarray(s[0])[tuple(sl)]
+
+
+_FOLDERS: Dict[str, Callable] = {
+    "Cast": lambda ctx, s: np.asarray(s[0]).astype(
+        _NP_OF_DT[ctx.attr("to", _DT.FLOAT)]),
+    "Gather": lambda ctx, s: np.take(s[0], np.asarray(s[1], np.int64),
+                                     axis=ctx.attr("axis", 0)),
+    "Concat": lambda ctx, s: np.concatenate(
+        [np.atleast_1d(v) for v in s], axis=ctx.attr("axis", 0)),
+    "Unsqueeze": lambda ctx, s: np.expand_dims(
+        s[0], tuple(ctx.axes_arg("axes", 1))),
+    "Squeeze": lambda ctx, s: np.squeeze(
+        s[0], tuple(ctx.axes_arg("axes", 1, default=None) or ())) \
+        if ctx.axes_arg("axes", 1, default=None) else np.squeeze(s[0]),
+    "Slice": _fold_slice,
+    "Add": lambda ctx, s: np.add(s[0], s[1]),
+    "Sub": lambda ctx, s: np.subtract(s[0], s[1]),
+    "Mul": lambda ctx, s: np.multiply(s[0], s[1]),
+    "Div": lambda ctx, s: (np.floor_divide(s[0], s[1])
+                           if np.issubdtype(np.asarray(s[0]).dtype,
+                                            np.integer)
+                           else np.divide(s[0], s[1])),
+    "Reshape": lambda ctx, s: _np_reshape_onnx(s[0], s[1]),
+    "Transpose": lambda ctx, s: np.transpose(
+        s[0], ctx.attr("perm") or None),
+    "Range": lambda ctx, s: np.arange(
+        np.asarray(s[0]).item(), np.asarray(s[1]).item(),
+        np.asarray(s[2]).item()).astype(np.asarray(s[0]).dtype),
+    "ConstantOfShape": lambda ctx, s: np.full(
+        np.asarray(s[0], np.int64).tolist(),
+        ctx.attr("value", np.zeros(1, np.float32))[0]),
+    "ReduceProd": lambda ctx, s: np.prod(
+        s[0], axis=tuple(ctx.axes_arg("axes", 1, None) or ()) or None,
+        keepdims=bool(ctx.attr("keepdims", 1))),
+    "Identity": lambda ctx, s: np.asarray(s[0]),
+    "Equal": lambda ctx, s: np.equal(s[0], s[1]),
+    "Where": lambda ctx, s: np.where(s[0], s[1], s[2]),
+    "Expand": lambda ctx, s: np.broadcast_to(
+        s[0], np.broadcast_shapes(np.shape(s[0]),
+                                  tuple(np.asarray(s[1], np.int64)))),
+}
+
+
+def _np_reshape_onnx(x, shape):
+    x = np.asarray(x)
+    shape = [int(d) for d in np.asarray(shape, np.int64)]
+    # ONNX: 0 = copy input dim (unless allowzero), -1 = infer
+    shape = [x.shape[i] if d == 0 else d for i, d in enumerate(shape)]
+    return x.reshape(shape)
+
+
+# --------------------------------------------------------------------------
+# mappers — elementwise
+
+
+def _binary(op_name):
+    def m(ctx: _Ctx):
+        return ctx.emit(op_name, [ctx.var(0), ctx.var(1)])
+
+    return m
+
+
+_BINARY = {
+    "Add": "add", "Sub": "subtract", "Mul": "multiply", "Div": "divide",
+    "Pow": "pow", "Mod": "floormod",
+    "Equal": "equals", "Greater": "greater", "GreaterOrEqual": "greater_equal",
+    "Less": "less", "LessOrEqual": "less_equal",
+    "And": "boolean_and", "Or": "boolean_or", "Xor": "boolean_xor",
+}
+for _onnx_name, _our in _BINARY.items():
+    onnx_op(_onnx_name)(_binary(_our))
+
+
+def _unary(op_name, **fixed_kw):
+    def m(ctx: _Ctx):
+        return ctx.emit(op_name, [ctx.var(0)], **fixed_kw)
+
+    return m
+
+
+_UNARY = {
+    "Abs": "abs", "Neg": "neg", "Exp": "exp", "Log": "log", "Sqrt": "sqrt",
+    "Reciprocal": "reciprocal", "Floor": "floor", "Ceil": "ceil",
+    "Round": "round", "Sign": "sign", "Sin": "sin", "Cos": "cos",
+    "Tan": "tan", "Asin": "asin", "Acos": "acos", "Atan": "atan",
+    "Sinh": "sinh", "Cosh": "cosh", "Tanh": "tanh", "Asinh": "asinh",
+    "Acosh": "acosh", "Atanh": "atanh", "Erf": "erf", "Sigmoid": "sigmoid",
+    "Relu": "relu", "Softplus": "softplus", "Softsign": "softsign",
+    "Not": "boolean_not", "Identity": "identity", "Mish": "mish",
+    "IsNaN": "isnan", "IsInf": "isinf",
+}
+for _onnx_name, _our in _UNARY.items():
+    onnx_op(_onnx_name)(_unary(_our))
+
+
+@onnx_op("LeakyRelu")
+def _leaky_relu(ctx):
+    return ctx.emit("leakyrelu", [ctx.var(0)], alpha=ctx.attr("alpha", 0.01))
+
+
+@onnx_op("Elu")
+def _elu(ctx):
+    a = ctx.attr("alpha", 1.0)
+    out = ctx.emit("elu", [ctx.var(0)])
+    if a != 1.0:
+        # ONNX Elu scales only the negative branch
+        x = ctx.var(0)
+        neg = ctx.sd._add_op("minimum", [x, 0.0])
+        em1 = ctx.sd._add_op("expm1", [neg])
+        pos = ctx.sd._add_op("relu", [x])
+        scaled = ctx.sd._add_op("multiply", [em1, float(a)])
+        return ctx.sd._add_op("add", [pos, scaled], name=ctx.name + "_elu")
+    return out
+
+
+@onnx_op("Selu")
+def _selu(ctx):
+    return ctx.emit("selu", [ctx.var(0)])
+
+
+@onnx_op("PRelu")
+def _prelu(ctx):
+    return ctx.emit("prelu", [ctx.var(0), ctx.var(1)])
+
+
+@onnx_op("ThresholdedRelu")
+def _thresholded_relu(ctx):
+    return ctx.emit("thresholdedrelu", [ctx.var(0)],
+                    theta=ctx.attr("alpha", 1.0))
+
+
+@onnx_op("HardSigmoid")
+def _hard_sigmoid(ctx):
+    a, b = ctx.attr("alpha", 0.2), ctx.attr("beta", 0.5)
+    x = ctx.var(0)
+    lin = ctx.sd._add_op("add", [ctx.sd._add_op("multiply", [x, float(a)]),
+                                 float(b)])
+    return ctx.emit("clip_by_value", [lin], clip_min=0.0, clip_max=1.0)
+
+
+@onnx_op("Gelu")
+def _gelu(ctx):
+    approx = ctx.attr("approximate", "none")
+    return ctx.emit("gelu" if approx == "tanh" else "gelu_exact",
+                    [ctx.var(0)])
+
+
+@onnx_op("Clip")
+def _clip(ctx):
+    if ctx.opset >= 11:
+        # distinguish "input omitted" (unbounded) from "present but
+        # dynamic" (ctx.static raises the actionable error)
+        lo = float(ctx.static(1)) if ctx.has_input(1) else -np.inf
+        hi = float(ctx.static(2)) if ctx.has_input(2) else np.inf
+    else:
+        lo = float(ctx.attr("min", -np.inf))
+        hi = float(ctx.attr("max", np.inf))
+    return ctx.emit("clip_by_value", [ctx.var(0)], clip_min=lo, clip_max=hi)
+
+
+@onnx_op("Cast")
+def _cast(ctx):
+    dst = np.dtype(_NP_OF_DT[ctx.attr("to")])
+    return ctx.emit("cast", [ctx.var(0)], dtype=dst.name)
+
+
+@onnx_op("Where")
+def _where(ctx):
+    return ctx.emit("select", [ctx.var(0), ctx.var(1), ctx.var(2)])
+
+
+def _variadic(op_name, fold2):
+    """ONNX Min/Max/Sum/Mean take N inputs; reduce pairwise."""
+
+    def m(ctx: _Ctx):
+        vs = ctx.vars()
+        out = vs[0]
+        for v in vs[1:]:
+            out = ctx.sd._add_op(fold2, [out, v])
+        if op_name == "Mean":
+            out = ctx.sd._add_op("divide", [out, float(len(vs))])
+        return ctx.sd._add_op("identity", [out], name=ctx.name + "_out")
+
+    return m
+
+
+onnx_op("Min")(_variadic("Min", "minimum"))
+onnx_op("Max")(_variadic("Max", "maximum"))
+onnx_op("Sum")(_variadic("Sum", "add"))
+onnx_op("Mean")(_variadic("Mean", "add"))
+
+
+# --------------------------------------------------------------------------
+# mappers — reductions
+
+_REDUCE = {"ReduceSum": "reduce_sum", "ReduceMean": "reduce_mean",
+           "ReduceMax": "reduce_max", "ReduceMin": "reduce_min",
+           "ReduceProd": "reduce_prod", "ReduceL1": "reduce_norm1",
+           "ReduceL2": "reduce_norm2", "ReduceLogSumExp": "reduce_logsumexp"}
+
+
+def _reduction(op_name):
+    def m(ctx: _Ctx):
+        axes = ctx.axes_arg("axes", 1, default=None)
+        keep = bool(ctx.attr("keepdims", 1))
+        if axes is None and ctx.attr("noop_with_empty_axes", 0):
+            return ctx.emit("identity", [ctx.var(0)])
+        return ctx.emit(op_name, [ctx.var(0)],
+                        dims=tuple(axes) if axes is not None else None,
+                        keep_dims=keep)
+
+    return m
+
+
+for _onnx_name, _our in _REDUCE.items():
+    onnx_op(_onnx_name)(_reduction(_our))
+
+
+@onnx_op("ArgMax")
+def _argmax(ctx):
+    out = ctx.emit("argmax", [ctx.var(0)], dims=(ctx.attr("axis", 0),),
+                   keep_dims=bool(ctx.attr("keepdims", 1)))
+    return ctx.sd._add_op("cast", [out], dtype="int64", name=ctx.name + "_i64")
+
+
+@onnx_op("ArgMin")
+def _argmin(ctx):
+    out = ctx.emit("argmin", [ctx.var(0)], dims=(ctx.attr("axis", 0),),
+                   keep_dims=bool(ctx.attr("keepdims", 1)))
+    return ctx.sd._add_op("cast", [out], dtype="int64", name=ctx.name + "_i64")
+
+
+@onnx_op("CumSum")
+def _cumsum(ctx):
+    axis = int(ctx.static(1))
+    return ctx.emit("cumsum", [ctx.var(0)], axis=axis,
+                    exclusive=bool(ctx.attr("exclusive", 0)),
+                    reverse=bool(ctx.attr("reverse", 0)))
+
+
+@onnx_op("TopK")
+def _topk(ctx):
+    k = int(np.atleast_1d(ctx.static(1))[0])
+    vals, idx = ctx.emit("top_k", [ctx.var(0)], k=k,
+                         sorted=bool(ctx.attr("sorted", 1)), n_outputs=2)
+    idx64 = ctx.sd._add_op("cast", [idx], dtype="int64",
+                           name=ctx.name + "_i64")
+    return (vals, idx64)
+
+
+# --------------------------------------------------------------------------
+# mappers — shape/structure
+
+
+@onnx_op("Reshape")
+def _reshape(ctx):
+    shape = [int(d) for d in np.asarray(ctx.static(1), np.int64)]
+    in_shape = ctx.shape_of_input(0)
+    shape = [in_shape[i] if d == 0 else d for i, d in enumerate(shape)]
+    return ctx.emit("reshape", [ctx.var(0)], shape=tuple(shape))
+
+
+@onnx_op("Transpose")
+def _transpose(ctx):
+    perm = ctx.attr("perm")
+    if perm is None:
+        perm = list(range(len(ctx.shape_of_input(0))))[::-1]
+    return ctx.emit("permute", [ctx.var(0)], dims=tuple(perm))
+
+
+@onnx_op("Concat")
+def _concat(ctx):
+    return ctx.sd._add_op("concat", ctx.vars(), name=_safe(ctx.name),
+                          axis=ctx.attr("axis", 0))
+
+
+@onnx_op("Split")
+def _split(ctx):
+    axis = ctx.attr("axis", 0)
+    sizes = ctx.attr("split")
+    if sizes is None and ctx.has_input(1):
+        sizes = [int(v) for v in np.atleast_1d(ctx.static(1))]
+    n_out = len(ctx.node.output)
+    if sizes is None:
+        return ctx.emit("split", [ctx.var(0)], num_split=n_out, axis=axis,
+                        n_outputs=n_out)
+    return ctx.emit("split_v", [ctx.var(0)], sizes=tuple(sizes), axis=axis,
+                    n_outputs=n_out)
+
+
+@onnx_op("Squeeze")
+def _squeeze(ctx):
+    axes = ctx.axes_arg("axes", 1, default=None)
+    return ctx.emit("squeeze", [ctx.var(0)],
+                    axis=tuple(axes) if axes else None)
+
+
+@onnx_op("Unsqueeze")
+def _unsqueeze(ctx):
+    axes = sorted(ctx.axes_arg("axes", 1))
+    v = ctx.var(0)
+    for i, a in enumerate(axes):
+        v = ctx.sd._add_op("expand_dims", [v], axis=int(a),
+                           name=f"{_safe(ctx.name)}_u{i}")
+    return v
+
+
+@onnx_op("Flatten")
+def _flatten(ctx):
+    shp = ctx.shape_of_input(0)
+    axis = ctx.attr("axis", 1) % max(len(shp), 1) if shp else 0
+    lead = int(np.prod(shp[:axis], dtype=np.int64)) if axis > 0 else 1
+    return ctx.emit("reshape", [ctx.var(0)], shape=(lead, -1))
+
+
+@onnx_op("Gather")
+def _gather(ctx):
+    idx = ctx.static_or_none(1)
+    if idx is not None:
+        return ctx.emit("gather", [ctx.var(0), idx.astype(np.int32)],
+                        axis=ctx.attr("axis", 0))
+    return ctx.emit("gather", [ctx.var(0), ctx.var(1)],
+                    axis=ctx.attr("axis", 0))
+
+
+@onnx_op("GatherND")
+def _gather_nd(ctx):
+    if ctx.attr("batch_dims", 0):
+        raise UnsupportedOnnxOpError("GatherND(batch_dims>0)", ctx.name)
+    return ctx.emit("gather_nd", [ctx.var(0), ctx.var(1)])
+
+
+@onnx_op("Slice")
+def _slice(ctx):
+    if ctx.opset >= 10:
+        starts = [int(v) for v in np.atleast_1d(ctx.static(1))]
+        ends = [int(v) for v in np.atleast_1d(ctx.static(2))]
+        axes = ctx.axes_arg("axes", 3, list(range(len(starts))))
+        steps = ([1] * len(starts) if not ctx.has_input(4)
+                 else [int(v) for v in np.atleast_1d(ctx.static(4))])
+    else:
+        starts = ctx.attr("starts")
+        ends = ctx.attr("ends")
+        axes = ctx.attr("axes", list(range(len(starts))))
+        steps = [1] * len(starts)
+    shp = ctx.shape_of_input(0)
+    begin = [0] * len(shp)
+    end = [int(d) for d in shp]
+    stride = [1] * len(shp)
+    for a, st, en, sp in zip(axes, starts, ends, steps):
+        a = a % len(shp)
+        d = shp[a]
+        st = max(st + d, 0) if st < 0 else min(st, d)
+        en = max(en + d, -1) if en < 0 else min(en, d)
+        begin[a], end[a], stride[a] = st, en, sp
+    return ctx.emit("strided_slice", [ctx.var(0)], begin=tuple(begin),
+                    end=tuple(end), strides=tuple(stride))
+
+
+@onnx_op("Expand")
+def _expand(ctx):
+    target = [int(d) for d in np.asarray(ctx.static(1), np.int64)]
+    in_shape = ctx.shape_of_input(0)
+    shape = list(np.broadcast_shapes(tuple(in_shape), tuple(target)))
+    return ctx.emit("broadcast_to", [ctx.var(0)], shape=tuple(shape))
+
+
+@onnx_op("Tile")
+def _tile(ctx):
+    reps = [int(v) for v in np.asarray(ctx.static(1), np.int64)]
+    return ctx.emit("tile", [ctx.var(0)], reps=tuple(reps))
+
+
+@onnx_op("Pad")
+def _pad(ctx):
+    mode = ctx.attr("mode", "constant")
+    if ctx.opset >= 11:
+        pads = [int(v) for v in np.atleast_1d(ctx.static(1))]
+        cval = ctx.static_or_none(2)
+        cval = float(np.atleast_1d(cval)[0]) if cval is not None else 0.0
+    else:
+        pads = ctx.attr("pads")
+        cval = ctx.attr("value", 0.0)
+    n = len(pads) // 2
+    paddings = tuple((pads[i], pads[n + i]) for i in range(n))
+    mode_map = {"constant": "constant", "reflect": "reflect",
+                "edge": "edge"}
+    if mode not in mode_map:
+        raise UnsupportedOnnxOpError(f"Pad(mode={mode})", ctx.name)
+    return ctx.emit("pad", [ctx.var(0)], paddings=paddings,
+                    mode=mode_map[mode], constant_value=cval)
+
+
+@onnx_op("Range")
+def _range(ctx):
+    return ctx.emit("range", [float(np.atleast_1d(ctx.static(0))[0]),
+                              float(np.atleast_1d(ctx.static(1))[0]),
+                              float(np.atleast_1d(ctx.static(2))[0])])
+
+
+@onnx_op("OneHot")
+def _one_hot(ctx):
+    depth = int(np.atleast_1d(ctx.static(1))[0])
+    values = ctx.static_or_none(2)
+    off, on = (0.0, 1.0) if values is None else (float(values[0]),
+                                                float(values[1]))
+    return ctx.emit("one_hot", [ctx.var(0)], depth=depth, on_value=on,
+                    off_value=off, axis=ctx.attr("axis", -1))
+
+
+@onnx_op("Dropout")
+def _dropout(ctx):
+    # inference import: identity (mask output unused in frozen inference
+    # graphs; training uses this framework's own dropout)
+    return ctx.emit("identity", [ctx.var(0)])
+
+
+# --------------------------------------------------------------------------
+# mappers — linear algebra / NN
+
+
+@onnx_op("MatMul")
+def _matmul(ctx):
+    a_shape = ctx.shape_of_input(0)
+    b_shape = ctx.shape_of_input(1)
+    if len(a_shape) > 2 or len(b_shape) > 2:
+        return ctx.emit("batched_gemm", [ctx.var(0), ctx.var(1)])
+    return ctx.emit("matmul", [ctx.var(0), ctx.var(1)])
+
+
+@onnx_op("Gemm")
+def _gemm(ctx):
+    alpha = ctx.attr("alpha", 1.0)
+    beta = ctx.attr("beta", 1.0)
+    out = ctx.sd._add_op("matmul", [ctx.var(0), ctx.var(1)],
+                         transpose_x=bool(ctx.attr("transA", 0)),
+                         transpose_y=bool(ctx.attr("transB", 0)))
+    if alpha != 1.0:
+        out = ctx.sd._add_op("multiply", [out, float(alpha)])
+    if ctx.has_input(2):
+        c = ctx.var(2)
+        if beta != 1.0:
+            c = ctx.sd._add_op("multiply", [c, float(beta)])
+        out = ctx.sd._add_op("add", [out, c])
+    return ctx.sd._add_op("identity", [out], name=_safe(ctx.name) + "_out")
+
+
+@onnx_op("Einsum")
+def _einsum(ctx):
+    return ctx.sd._add_op("einsum", ctx.vars(), name=_safe(ctx.name),
+                          equation=ctx.attr("equation"))
+
+
+@onnx_op("Softmax")
+def _softmax(ctx):
+    if ctx.opset >= 13:
+        return ctx.emit("softmax", [ctx.var(0)], axis=ctx.attr("axis", -1))
+    # opset<13: softmax over the flattened trailing dims [axis:]
+    shp = ctx.shape_of_input(0)
+    axis = ctx.attr("axis", 1) % max(len(shp), 1) if shp else 0
+    lead = int(np.prod(shp[:axis], dtype=np.int64)) if axis > 0 else 1
+    flat = ctx.sd._add_op("reshape", [ctx.var(0)], shape=(lead, -1))
+    sm = ctx.sd._add_op("softmax", [flat], axis=-1)
+    return ctx.emit("reshape", [sm], shape=tuple(shp))
+
+
+@onnx_op("LogSoftmax")
+def _log_softmax(ctx):
+    if ctx.opset >= 13:
+        return ctx.emit("log_softmax", [ctx.var(0)],
+                        axis=ctx.attr("axis", -1))
+    shp = ctx.shape_of_input(0)
+    axis = ctx.attr("axis", 1) % max(len(shp), 1) if shp else 0
+    lead = int(np.prod(shp[:axis], dtype=np.int64)) if axis > 0 else 1
+    flat = ctx.sd._add_op("reshape", [ctx.var(0)], shape=(lead, -1))
+    sm = ctx.sd._add_op("log_softmax", [flat], axis=-1)
+    return ctx.emit("reshape", [sm], shape=tuple(shp))
+
+
+def _conv_pads(ctx, rank=2, kernel=None, strides=None, dilations=None):
+    """Resolve ONNX padding to (symmetric_pads, explicit_begin_end): one of
+    the two is None. ``symmetric_pads`` may also be the string "SAME"."""
+    auto = ctx.attr("auto_pad", "NOTSET")
+    if auto == "SAME_UPPER":
+        return "SAME", None       # XLA "SAME" IS SAME_UPPER
+    if auto == "SAME_LOWER":
+        # extra padding pixel goes at the BEGINNING — compute explicit pads
+        shp = ctx.shape_of_input(0)[2:]
+        strides = strides or (1,) * rank
+        dilations = dilations or (1,) * rank
+        begin, end = [], []
+        for i in range(rank):
+            eff = (kernel[i] - 1) * dilations[i] + 1
+            out = -(-shp[i] // strides[i])
+            total = max((out - 1) * strides[i] + eff - shp[i], 0)
+            b = total - total // 2
+            begin.append(b)
+            end.append(total - b)
+        if begin == end:
+            return tuple(begin), None
+        return None, (begin, end)
+    if auto == "VALID":
+        return (0,) * rank, None
+    pads = ctx.attr("pads", [0] * (2 * rank))
+    begin, end = pads[:rank], pads[rank:]
+    if list(begin) == list(end):
+        return tuple(begin), None
+    return None, (begin, end)
+
+
+@onnx_op("Conv")
+def _conv(ctx):
+    shp = ctx.shape_of_input(0)
+    rank = len(shp) - 2
+    if rank != 2:
+        raise UnsupportedOnnxOpError(f"Conv rank {rank}", ctx.name)
+    strides = tuple(ctx.attr("strides", [1] * rank))
+    dil = tuple(ctx.attr("dilations", [1] * rank))
+    groups = ctx.attr("group", 1)
+    kernel = tuple(ctx.attr("kernel_shape")
+                   or ctx.shape_of_input(1)[2:])
+    pad_sym, pad_explicit = _conv_pads(ctx, rank, kernel, strides, dil)
+    x = ctx.var(0)
+    if pad_explicit is not None:
+        begin, end = pad_explicit
+        paddings = ((0, 0), (0, 0)) + tuple(
+            (int(b), int(e)) for b, e in zip(begin, end))
+        x = ctx.sd._add_op("pad", [x], paddings=paddings)
+        pad_sym = (0,) * rank
+    b = ctx.var_or_none(2)
+    args = [x, ctx.var(1)] + ([b] if b is not None else [])
+    return ctx.emit("conv2d", args, strides=strides, padding=pad_sym,
+                    dilation=dil, data_format="NCHW", groups=int(groups))
+
+
+def _pool_mapper(kind):
+    def m(ctx: _Ctx):
+        k = tuple(ctx.attr("kernel_shape"))
+        if len(k) != 2:
+            raise UnsupportedOnnxOpError(f"{kind} rank {len(k)}", ctx.name)
+        s = tuple(ctx.attr("strides", [1] * len(k)))
+        pad_sym, pad_explicit = _conv_pads(ctx, len(k), k, s)
+        x = ctx.var(0)
+        if pad_explicit is not None:
+            begin, end = pad_explicit
+            paddings = ((0, 0), (0, 0)) + tuple(
+                (int(b), int(e)) for b, e in zip(begin, end))
+            fill = 0.0 if kind == "avgpool2d" else -np.inf
+            x = ctx.sd._add_op("pad", [x], paddings=paddings,
+                               constant_value=fill)
+            pad_sym = (0,) * len(k)
+        if kind == "avgpool2d" and any(pad_sym) \
+                and not ctx.attr("count_include_pad", 0):
+            raise UnsupportedOnnxOpError(
+                "AveragePool(count_include_pad=0 with nonzero pads)",
+                ctx.name)
+        return ctx.emit(kind, [x], kernel=k, strides=s, padding=pad_sym,
+                        data_format="NCHW")
+
+    return m
+
+
+onnx_op("MaxPool")(_pool_mapper("maxpool2d"))
+onnx_op("AveragePool")(_pool_mapper("avgpool2d"))
+
+
+@onnx_op("GlobalAveragePool")
+def _global_avg_pool(ctx):
+    pooled = ctx.sd._add_op("global_avgpool", [ctx.var(0)],
+                            data_format="NCHW")
+    shp = ctx.shape_of_input(0)
+    # ONNX keeps spatial dims as 1s
+    return ctx.emit("reshape", [pooled],
+                    shape=tuple(shp[:2]) + (1,) * (len(shp) - 2))
+
+
+@onnx_op("GlobalMaxPool")
+def _global_max_pool(ctx):
+    shp = ctx.shape_of_input(0)
+    red = ctx.sd._add_op("reduce_max", [ctx.var(0)],
+                         dims=tuple(range(2, len(shp))), keep_dims=True)
+    return ctx.emit("identity", [red])
+
+
+@onnx_op("BatchNormalization")
+def _batch_norm(ctx):
+    if ctx.attr("training_mode", 0):
+        raise UnsupportedOnnxOpError(
+            "BatchNormalization(training_mode=1) — export for inference; "
+            "training uses this framework's own BatchNormalization layer",
+            ctx.name)
+    x, gamma, beta, mean, var = (ctx.var(0), ctx.var(1), ctx.var(2),
+                                 ctx.var(3), ctx.var(4))
+    return ctx.emit("batchnorm", [x, mean, var, gamma, beta],
+                    epsilon=ctx.attr("epsilon", 1e-5), axis=1)
+
+
+@onnx_op("InstanceNormalization")
+def _instance_norm(ctx):
+    x = ctx.var(0)
+    shp = ctx.shape_of_input(0)
+    axes = tuple(range(2, len(shp)))
+    eps = ctx.attr("epsilon", 1e-5)
+    mean = ctx.sd._add_op("reduce_mean", [x], dims=axes, keep_dims=True)
+    var = ctx.sd._add_op("reduce_variance", [x], dims=axes, keep_dims=True,
+                         bias_corrected=False)
+    xm = ctx.sd._add_op("subtract", [x, mean])
+    denom = ctx.sd._add_op("sqrt", [ctx.sd._add_op("add", [var, float(eps)])])
+    normed = ctx.sd._add_op("divide", [xm, denom])
+    cshape = (1, int(shp[1])) + (1,) * (len(shp) - 2)
+    g = ctx.sd._add_op("reshape", [ctx.var(1)], shape=cshape)
+    b = ctx.sd._add_op("reshape", [ctx.var(2)], shape=cshape)
+    return ctx.emit("add", [ctx.sd._add_op("multiply", [normed, g]), b])
+
+
+@onnx_op("LayerNormalization")
+def _layer_norm(ctx):
+    axis = ctx.attr("axis", -1)
+    eps = ctx.attr("epsilon", 1e-5)
+    shp = ctx.shape_of_input(0)
+    if axis % len(shp) != len(shp) - 1:
+        raise UnsupportedOnnxOpError(
+            f"LayerNormalization(axis={axis}, rank={len(shp)}) — only the "
+            "last axis is supported", ctx.name)
+    b = ctx.var_or_none(2)
+    args = [ctx.var(0), ctx.var(1)] + ([b] if b is not None else [])
+    return ctx.emit("layer_norm", args, axis=-1, epsilon=eps)
+
+
+# --------------------------------------------------------------------------
+# public API
+
+
+class OnnxFrameworkImporter:
+    """Reference-shaped entry (``OnnxFrameworkImporter.runImport``)."""
+
+    @staticmethod
+    def run_import(path_or_model,
+                   input_shapes: Optional[Dict[str, Sequence[int]]] = None
+                   ) -> SameDiff:
+        model = _as_model(path_or_model)
+        imp = _Importer(model, input_shapes)
+        sd = imp.run()
+        sd.onnx_placeholders = list(imp.placeholders)
+        sd.onnx_outputs = list(imp.outputs)
+        return sd
+
+    runImport = run_import
+
+
+def _as_model(src) -> "OIR.ModelProto":
+    if isinstance(src, OIR.ModelProto):
+        return src
+    if isinstance(src, (bytes, bytearray)):
+        m = OIR.ModelProto()
+        m.ParseFromString(bytes(src))
+        return m
+    with open(src, "rb") as f:
+        m = OIR.ModelProto()
+        m.ParseFromString(f.read())
+        return m
+
+
+def import_onnx(path_or_model,
+                input_shapes: Optional[Dict[str, Sequence[int]]] = None
+                ) -> SameDiff:
+    """ONNX ModelProto (.onnx path, bytes, or proto) → SameDiff graph
+    executable/trainable on TPU (reference: ``SameDiff`` +
+    ``OnnxFrameworkImporter``)."""
+    return OnnxFrameworkImporter.run_import(path_or_model, input_shapes)

@@ -23,6 +23,26 @@ Design (idiomatic rebuild, not a translation):
 Conformance: ``tests/test_tf_import.py`` generates golden graphs with the
 local TF (SURVEY.md §4.3 harness shape: freeze → import → execute → compare
 within per-op tolerance).
+
+Supported TF surface (round-3 statement of scope): FROZEN inference
+GraphDefs over the ~90 registered op names (``supported_tf_ops()``) — the
+closure covering MLPs, CNNs (Conv2D/DepthwiseConv2d/pooling/FusedBatchNorm
+inference), and transformer encoders (BERT-base end-to-end, benched).
+Deliberately OUT of scope, erroring with actionable messages rather than
+importing wrong:
+
+- ``FusedBatchNorm(is_training=True)`` — freeze for inference first;
+  training uses this framework's own BatchNormalization layer (importing
+  TF's training-mode statistics contract would duplicate it with subtly
+  different EMA semantics);
+- ``GatherV2(batch_dims>0)`` and ``Conv2D(padding=EXPLICIT)`` — not
+  emitted by frozen classifier/encoder graphs;
+- TF2 control flow (``StatelessWhile``/``If``): frozen inference graphs
+  constant-fold these away; build control flow natively with
+  ``SameDiff.cond``/``while_loop``;
+- resource variables/queues/datasets other than ``IteratorGetNext`` (which
+  maps to placeholders);
+- string/ragged dtypes (no XLA representation).
 """
 
 from __future__ import annotations

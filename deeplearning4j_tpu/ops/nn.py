@@ -42,8 +42,12 @@ def _conv_padding(padding, kernel, strides, dilation=(1, 1)):
 
 @op("conv2d", "nn")
 def conv2d(x, w, b=None, strides=(1, 1), padding=(0, 0), dilation=(1, 1),
-           data_format: str = "NCHW"):
-    """2D convolution. x: NCHW or NHWC; w: OIHW (reference layout)."""
+           data_format: str = "NCHW", groups: int = 1):
+    """2D convolution. x: NCHW or NHWC; w: OIHW (reference layout).
+
+    ``groups`` maps to XLA's ``feature_group_count`` (ONNX Conv ``group``
+    semantics: w is [O, I/groups, kH, kW], output channels blocked by
+    group)."""
     sh, sw = _pair(strides)
     dh, dw = _pair(dilation)
     dn = lax.conv_dimension_numbers(
@@ -55,6 +59,7 @@ def conv2d(x, w, b=None, strides=(1, 1), padding=(0, 0), dilation=(1, 1),
     out = lax.conv_general_dilated(
         x, w, window_strides=(sh, sw), padding=_conv_padding(padding, w.shape[2:], (sh, sw)),
         rhs_dilation=(dh, dw), dimension_numbers=dn,
+        feature_group_count=int(groups),
     )
     if b is not None:
         bshape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)

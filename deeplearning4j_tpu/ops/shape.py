@@ -99,6 +99,8 @@ def pad(x, paddings, mode: str = "constant", constant_value: float = 0.0):
         return jnp.pad(x, pads, mode="reflect")
     if mode == "symmetric":
         return jnp.pad(x, pads, mode="symmetric")
+    if mode == "edge":   # replicate boundary value (ONNX Pad mode="edge")
+        return jnp.pad(x, pads, mode="edge")
     raise ValueError(f"unknown pad mode {mode!r}")
 
 

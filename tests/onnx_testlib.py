@@ -1,0 +1,118 @@
+"""Tiny ONNX model builder for the import conformance suite.
+
+The ``onnx`` pip package is not in this image, so test graphs are built
+directly on the vendored IR protos (``deeplearning4j_tpu/imports/
+onnx_ir.proto``) — the same role onnx.helper.make_* plays upstream.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.imports import onnx_ir_pb2 as OIR
+from deeplearning4j_tpu.imports.onnx_import import numpy_to_tensor
+
+_NP_TO_DT = {
+    np.dtype(np.float32): OIR.TensorProto.FLOAT,
+    np.dtype(np.float64): OIR.TensorProto.DOUBLE,
+    np.dtype(np.int32): OIR.TensorProto.INT32,
+    np.dtype(np.int64): OIR.TensorProto.INT64,
+    np.dtype(np.bool_): OIR.TensorProto.BOOL,
+    np.dtype(np.float16): OIR.TensorProto.FLOAT16,
+}
+
+
+def make_node(op_type: str, inputs: Sequence[str], outputs: Sequence[str],
+              name: Optional[str] = None, **attrs) -> "OIR.NodeProto":
+    n = OIR.NodeProto(op_type=op_type, input=list(inputs),
+                      output=list(outputs),
+                      name=name or f"{op_type}_{outputs[0]}")
+    T = OIR.AttributeProto
+    for k, v in attrs.items():
+        a = n.attribute.add()
+        a.name = k
+        if isinstance(v, bool):
+            a.type, a.i = T.INT, int(v)
+        elif isinstance(v, (int, np.integer)):
+            a.type, a.i = T.INT, int(v)
+        elif isinstance(v, (float, np.floating)):
+            a.type, a.f = T.FLOAT, float(v)
+        elif isinstance(v, str):
+            a.type, a.s = T.STRING, v.encode()
+        elif isinstance(v, np.ndarray):
+            a.type = T.TENSOR
+            a.t.CopyFrom(numpy_to_tensor(v))
+        elif isinstance(v, (list, tuple)):
+            if len(v) and isinstance(v[0], (float, np.floating)):
+                a.type = T.FLOATS
+                a.floats.extend(float(x) for x in v)
+            elif len(v) and isinstance(v[0], str):
+                a.type = T.STRINGS
+                a.strings.extend(x.encode() for x in v)
+            else:
+                a.type = T.INTS
+                a.ints.extend(int(x) for x in v)
+        else:
+            raise TypeError(f"attr {k}: unsupported {type(v)}")
+    return n
+
+
+def _value_info(name: str, shape: Sequence[Optional[int]],
+                dtype=np.float32) -> "OIR.ValueInfoProto":
+    vi = OIR.ValueInfoProto(name=name)
+    tt = vi.type.tensor_type
+    tt.elem_type = _NP_TO_DT[np.dtype(dtype)]
+    for d in shape:
+        dim = tt.shape.dim.add()
+        if d is not None:
+            dim.dim_value = int(d)
+        else:
+            dim.dim_param = "N"
+    return vi
+
+
+def make_model(nodes: Sequence["OIR.NodeProto"],
+               inputs: Sequence[Tuple[str, Sequence[Optional[int]]]] = (),
+               outputs: Sequence[str] = (),
+               initializers: Optional[Dict[str, np.ndarray]] = None,
+               opset: int = 17,
+               input_dtypes: Optional[Dict[str, np.dtype]] = None
+               ) -> "OIR.ModelProto":
+    m = OIR.ModelProto(ir_version=8, producer_name="d4t-test")
+    osi = m.opset_import.add()
+    osi.domain = ""
+    osi.version = opset
+    g = m.graph
+    g.name = "test_graph"
+    dts = input_dtypes or {}
+    for name, shape in inputs:
+        g.input.append(_value_info(name, shape, dts.get(name, np.float32)))
+    for name in outputs:
+        g.output.append(OIR.ValueInfoProto(name=name))
+    for name, arr in (initializers or {}).items():
+        g.initializer.append(numpy_to_tensor(np.asarray(arr), name))
+        # spec-conformant exporters may also list initializers as inputs
+    for n in nodes:
+        g.node.append(n)
+    return m
+
+
+def run_model(model: "OIR.ModelProto",
+              feeds: Dict[str, np.ndarray],
+              n_outputs: int = 1) -> List[np.ndarray]:
+    """Import + execute, returning the graph outputs as numpy arrays."""
+    from deeplearning4j_tpu.imports.onnx_import import import_onnx
+
+    sd = import_onnx(model)
+    assert sd.onnx_outputs, "importer found no graph outputs"
+    names = sd.onnx_outputs[:n_outputs]
+    out = sd.output({k: np.asarray(v) for k, v in feeds.items()}, names)
+    return [out[n].to_numpy() for n in names]
+
+
+def check_model(model, feeds, expected, atol=1e-5, rtol=1e-5):
+    got = run_model(model, feeds, n_outputs=1)[0]
+    np.testing.assert_allclose(got, np.asarray(expected), atol=atol,
+                               rtol=rtol)

@@ -1,0 +1,116 @@
+"""Format-stability (regressiontest) suite: frozen fixtures must load
+forever.
+
+Reference: deeplearning4j-core ``regressiontest`` package (SURVEY.md §4.4,
+§7.3.8) — serialized models from released format versions are committed
+under ``tests/resources/serde/`` and every later revision must keep loading
+them with bit-compatible semantics. The fixtures are APPEND-ONLY (see the
+README there): when one of these tests fails, the LOAD PATH regressed — fix
+the loader or add a migration, never the fixture.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+RES = os.path.join(os.path.dirname(__file__), "resources", "serde", "v1")
+
+
+def _p(name: str) -> str:
+    path = os.path.join(RES, name)
+    assert os.path.exists(path), (
+        f"frozen fixture {name} missing — fixtures are committed, never "
+        "generated at test time")
+    return path
+
+
+class TestV1Fixtures:
+    def test_manifest_records_versions(self):
+        with open(_p("manifest.json")) as f:
+            man = json.load(f)
+        assert man["generated_with"]["model_serializer_format"] == 1
+        assert man["generated_with"]["samediff_format"] == 2
+        assert man["generated_with"]["word2vec_format"] == 1
+        assert "append-only" in man["policy"]
+
+    def test_multilayer_network_loads_and_predicts(self):
+        from deeplearning4j_tpu.nn import MultiLayerNetwork
+
+        model = MultiLayerNetwork.load(_p("mln.zip"), load_updater=True)
+        exp = np.load(_p("mln_expected.npz"))
+        got = model.output(exp["probe"]).to_numpy()
+        np.testing.assert_allclose(got, exp["output"], atol=1e-5)
+
+    def test_multilayer_network_updater_state_restored(self):
+        from deeplearning4j_tpu.nn import MultiLayerNetwork
+
+        model = MultiLayerNetwork.load(_p("mln.zip"), load_updater=True)
+        # the fixture was fit for 3 epochs with Adam before saving: restored
+        # moments must be populated, not re-initialized
+        st = model._updater_state
+        assert st is not None
+        leaves = [np.asarray(v) for v in _leaves(st)]
+        assert any(np.abs(a).sum() > 0 for a in leaves)
+
+    def test_computation_graph_loads_and_predicts(self):
+        from deeplearning4j_tpu.nn import ComputationGraph
+
+        model = ComputationGraph.load(_p("cg.zip"), load_updater=True)
+        exp = np.load(_p("cg_expected.npz"))
+        got = model.output(exp["probe"])[0].to_numpy()
+        np.testing.assert_allclose(got, exp["output"], atol=1e-5)
+
+    def test_samediff_loads_and_predicts(self):
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        sd = SameDiff.load(_p("samediff.sdz"))
+        exp = np.load(_p("samediff_expected.npz"))
+        got = sd.output({"x": exp["probe"]}, ["out"])["out"].to_numpy()
+        np.testing.assert_allclose(got, exp["output"], atol=1e-5)
+
+    def test_samediff_control_flow_loads_and_runs_both_paths(self):
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        sd = SameDiff.load(_p("samediff_controlflow.sdz"))
+        exp = np.load(_p("samediff_controlflow_expected.npz"))
+        got_pos = sd.output({"x": exp["pos"]}, ["final"])["final"].to_numpy()
+        got_neg = sd.output({"x": exp["neg"]}, ["final"])["final"].to_numpy()
+        np.testing.assert_allclose(got_pos, exp["out_pos"], atol=1e-5)
+        np.testing.assert_allclose(got_neg, exp["out_neg"], atol=1e-5)
+
+    def test_word2vec_model_container_loads(self):
+        from deeplearning4j_tpu.nlp import read_word2vec_model
+
+        w = read_word2vec_model(_p("word2vec_model.zip"))
+        exp = np.load(_p("word2vec_expected.npz"), allow_pickle=False)
+        for word, vec in zip(exp["words"], exp["vectors"]):
+            np.testing.assert_allclose(w.get_word_vector(str(word)), vec,
+                                       atol=1e-6)
+
+    @pytest.mark.parametrize("fname,binary", [("vectors.txt", False),
+                                              ("vectors.bin", True)])
+    def test_word_vector_files_load(self, fname, binary):
+        from deeplearning4j_tpu.nlp import read_word_vectors
+
+        wv = read_word_vectors(_p(fname), binary=binary)
+        exp = np.load(_p("word2vec_expected.npz"))
+        # text vectors are decimal-printed: ~6 significant digits
+        atol = 1e-6 if binary else 1e-4
+        for word, vec in zip(exp["words"], exp["vectors"]):
+            np.testing.assert_allclose(wv.get_word_vector(str(word)), vec,
+                                       atol=atol)
+
+
+def _leaves(tree):
+    if isinstance(tree, dict):
+        for v in tree.values():
+            yield from _leaves(v)
+    elif isinstance(tree, (list, tuple)):
+        for v in tree:
+            yield from _leaves(v)
+    else:
+        yield tree

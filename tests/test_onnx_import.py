@@ -1,0 +1,760 @@
+"""ONNX-import conformance suite.
+
+Reference: nd4j ``samediff-import-onnx`` test resources (data-driven op-level
+graphs) — SURVEY.md §2.1, §4.3. The upstream onnx runtime/package isn't in
+this image, so graphs are built on the vendored IR (tests/onnx_testlib.py)
+and goldens come from torch.nn.functional / numpy, which implement the ONNX
+operator contracts these mappers target.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+from onnx_testlib import check_model, make_model, make_node, run_model
+
+F32 = np.float32
+rng = np.random.RandomState(11)
+
+
+def A(*shape, dtype=F32, lo=-2.0, hi=2.0):
+    return rng.uniform(lo, hi, shape).astype(dtype)
+
+
+def P(*shape):
+    return rng.uniform(0.1, 2.0, shape).astype(F32)
+
+
+def _unary_model(op, shape=(3, 4), opset=17, **attrs):
+    return make_model([make_node(op, ["x"], ["y"], **attrs)],
+                      inputs=[("x", shape)], outputs=["y"], opset=opset)
+
+
+class TestElementwise:
+    @pytest.mark.parametrize("op,fn", [
+        ("Add", np.add), ("Sub", np.subtract), ("Mul", np.multiply),
+        ("Div", np.divide),
+    ])
+    def test_binary(self, op, fn):
+        m = make_model([make_node(op, ["a", "b"], ["y"])],
+                       inputs=[("a", (3, 4)), ("b", (3, 4))], outputs=["y"])
+        a, b = A(3, 4), P(3, 4)
+        check_model(m, {"a": a, "b": b}, fn(a, b))
+
+    def test_broadcast(self):
+        m = make_model([make_node("Add", ["a", "b"], ["y"])],
+                       inputs=[("a", (2, 3, 4)), ("b", (4,))], outputs=["y"])
+        a, b = A(2, 3, 4), A(4)
+        check_model(m, {"a": a, "b": b}, a + b)
+
+    def test_pow(self):
+        m = make_model([make_node("Pow", ["a", "b"], ["y"])],
+                       inputs=[("a", (3, 3)), ("b", (3, 3))], outputs=["y"])
+        a, b = P(3, 3), A(3, 3)
+        check_model(m, {"a": a, "b": b}, np.power(a, b), atol=1e-4)
+
+    @pytest.mark.parametrize("op,fn", [
+        ("Equal", np.equal), ("Greater", np.greater), ("Less", np.less),
+        ("GreaterOrEqual", np.greater_equal), ("LessOrEqual", np.less_equal),
+    ])
+    def test_compare(self, op, fn):
+        m = make_model([make_node(op, ["a", "b"], ["y"])],
+                       inputs=[("a", (4, 4)), ("b", (4, 4))], outputs=["y"])
+        a = rng.randint(0, 3, (4, 4)).astype(F32)
+        b = rng.randint(0, 3, (4, 4)).astype(F32)
+        check_model(m, {"a": a, "b": b}, fn(a, b))
+
+    @pytest.mark.parametrize("op,fn", [
+        ("Abs", np.abs), ("Neg", np.negative), ("Exp", np.exp),
+        ("Floor", np.floor), ("Ceil", np.ceil), ("Tanh", np.tanh),
+        ("Sin", np.sin), ("Cos", np.cos), ("Sign", np.sign),
+    ])
+    def test_unary(self, op, fn):
+        x = A(3, 4)
+        check_model(_unary_model(op), {"x": x}, fn(x))
+
+    @pytest.mark.parametrize("op,fn", [
+        ("Log", np.log), ("Sqrt", np.sqrt),
+        ("Reciprocal", lambda v: 1.0 / v),
+    ])
+    def test_unary_positive(self, op, fn):
+        x = P(3, 4)
+        check_model(_unary_model(op), {"x": x}, fn(x))
+
+    def test_variadic_sum_mean_min_max(self):
+        a, b, c = A(2, 3), A(2, 3), A(2, 3)
+        for op, expect in [("Sum", a + b + c), ("Mean", (a + b + c) / 3),
+                           ("Min", np.minimum(np.minimum(a, b), c)),
+                           ("Max", np.maximum(np.maximum(a, b), c))]:
+            m = make_model([make_node(op, ["a", "b", "c"], ["y"])],
+                           inputs=[("a", (2, 3)), ("b", (2, 3)),
+                                   ("c", (2, 3))], outputs=["y"])
+            check_model(m, {"a": a, "b": b, "c": c}, expect)
+
+    def test_where(self):
+        m = make_model([make_node("Where", ["c", "a", "b"], ["y"])],
+                       inputs=[("c", (3, 3)), ("a", (3, 3)), ("b", (3, 3))],
+                       outputs=["y"], input_dtypes={"c": np.bool_})
+        c = rng.rand(3, 3) > 0.5
+        a, b = A(3, 3), A(3, 3)
+        check_model(m, {"c": c, "a": a, "b": b}, np.where(c, a, b))
+
+    def test_cast(self):
+        from deeplearning4j_tpu.imports.onnx_ir_pb2 import TensorProto
+        m = _unary_model("Cast", to=TensorProto.INT32)
+        x = A(3, 4, lo=0, hi=5)
+        got = run_model(m, {"x": x})[0]
+        assert got.dtype == np.int32
+        np.testing.assert_array_equal(got, x.astype(np.int32))
+
+
+class TestActivations:
+    def test_relu_sigmoid_softplus(self):
+        x = A(4, 5)
+        check_model(_unary_model("Relu"), {"x": x}, np.maximum(x, 0))
+        check_model(_unary_model("Sigmoid"), {"x": x},
+                    TF.sigmoid(torch.from_numpy(x)).numpy(), atol=1e-6)
+        check_model(_unary_model("Softplus"), {"x": x},
+                    TF.softplus(torch.from_numpy(x)).numpy(), atol=1e-6)
+
+    def test_leaky_relu(self):
+        x = A(4, 5)
+        check_model(_unary_model("LeakyRelu", alpha=0.1), {"x": x},
+                    TF.leaky_relu(torch.from_numpy(x), 0.1).numpy())
+
+    def test_elu_alpha(self):
+        x = A(4, 5)
+        check_model(_unary_model("Elu", alpha=0.7), {"x": x},
+                    TF.elu(torch.from_numpy(x), alpha=0.7).numpy(),
+                    atol=1e-6)
+
+    def test_selu(self):
+        x = A(4, 5)
+        check_model(_unary_model("Selu"), {"x": x},
+                    TF.selu(torch.from_numpy(x)).numpy(), atol=1e-6)
+
+    def test_prelu(self):
+        x, slope = A(3, 4), P(4)
+        m = make_model([make_node("PRelu", ["x", "s"], ["y"])],
+                       inputs=[("x", (3, 4)), ("s", (4,))], outputs=["y"])
+        expected = np.where(x > 0, x, x * slope)
+        check_model(m, {"x": x, "s": slope}, expected)
+
+    def test_hard_sigmoid(self):
+        x = A(4, 5)
+        check_model(_unary_model("HardSigmoid", alpha=0.2, beta=0.5),
+                    {"x": x}, np.clip(0.2 * x + 0.5, 0, 1))
+
+    def test_gelu(self):
+        x = A(4, 5)
+        check_model(_unary_model("Gelu", opset=20), {"x": x},
+                    TF.gelu(torch.from_numpy(x)).numpy(), atol=1e-5)
+        check_model(_unary_model("Gelu", opset=20, approximate="tanh"),
+                    {"x": x},
+                    TF.gelu(torch.from_numpy(x), approximate="tanh").numpy(),
+                    atol=1e-5)
+
+    def test_clip_opset11_inputs(self):
+        x = A(3, 4)
+        lo, hi = np.float32(-0.5), np.float32(0.8)
+        m = make_model(
+            [make_node("Clip", ["x", "lo", "hi"], ["y"])],
+            inputs=[("x", (3, 4))], outputs=["y"],
+            initializers={"lo": lo, "hi": hi})
+        check_model(m, {"x": x}, np.clip(x, -0.5, 0.8))
+
+    def test_clip_opset6_attrs(self):
+        x = A(3, 4)
+        m = _unary_model("Clip", opset=6, min=-0.5, max=0.8)
+        check_model(m, {"x": x}, np.clip(x, -0.5, 0.8))
+
+    def test_softmax_opset13(self):
+        x = A(3, 4, 5)
+        check_model(_unary_model("Softmax", shape=(3, 4, 5), axis=-1),
+                    {"x": x},
+                    TF.softmax(torch.from_numpy(x), dim=-1).numpy(),
+                    atol=1e-6)
+
+    def test_softmax_opset11_flatten_semantics(self):
+        x = A(2, 3, 4)
+        m = _unary_model("Softmax", shape=(2, 3, 4), opset=11, axis=1)
+        flat = x.reshape(2, 12)
+        e = np.exp(flat - flat.max(-1, keepdims=True))
+        expected = (e / e.sum(-1, keepdims=True)).reshape(2, 3, 4)
+        check_model(m, {"x": x}, expected, atol=1e-6)
+
+    def test_log_softmax(self):
+        x = A(3, 6)
+        check_model(_unary_model("LogSoftmax", shape=(3, 6), axis=-1),
+                    {"x": x},
+                    TF.log_softmax(torch.from_numpy(x), dim=-1).numpy(),
+                    atol=1e-6)
+
+
+class TestReductions:
+    @pytest.mark.parametrize("op,fn", [
+        ("ReduceSum", np.sum), ("ReduceMean", np.mean),
+        ("ReduceMax", np.max), ("ReduceMin", np.min),
+        ("ReduceProd", np.prod),
+    ])
+    def test_reduce_axes_attr(self, op, fn):
+        x = A(2, 3, 4)
+        m = _unary_model(op, shape=(2, 3, 4), opset=11, axes=[1],
+                         keepdims=0)
+        check_model(m, {"x": x}, fn(x, axis=1), atol=1e-5)
+
+    def test_reduce_sum_axes_input_opset13(self):
+        x = A(2, 3, 4)
+        m = make_model(
+            [make_node("ReduceSum", ["x", "ax"], ["y"], keepdims=1)],
+            inputs=[("x", (2, 3, 4))], outputs=["y"],
+            initializers={"ax": np.asarray([0, 2], np.int64)})
+        check_model(m, {"x": x}, x.sum(axis=(0, 2), keepdims=True))
+
+    def test_reduce_all_axes(self):
+        x = A(2, 3)
+        m = _unary_model("ReduceMean", shape=(2, 3), keepdims=0)
+        check_model(m, {"x": x}, x.mean())
+
+    def test_reduce_l2(self):
+        x = A(3, 4)
+        m = _unary_model("ReduceL2", shape=(3, 4), opset=11, axes=[1],
+                         keepdims=0)
+        check_model(m, {"x": x}, np.sqrt((x * x).sum(1)), atol=1e-5)
+
+    def test_argmax(self):
+        x = A(3, 5)
+        m = _unary_model("ArgMax", shape=(3, 5), axis=1, keepdims=0)
+        got = run_model(m, {"x": x})[0]
+        assert got.dtype == np.int64
+        np.testing.assert_array_equal(got, x.argmax(1))
+
+    def test_cumsum(self):
+        x = A(3, 4)
+        m = make_model([make_node("CumSum", ["x", "ax"], ["y"])],
+                       inputs=[("x", (3, 4))], outputs=["y"],
+                       initializers={"ax": np.asarray(1, np.int64)})
+        check_model(m, {"x": x}, np.cumsum(x, 1), atol=1e-5)
+
+    def test_topk(self):
+        x = A(3, 8)
+        m = make_model([make_node("TopK", ["x", "k"], ["v", "i"], axis=-1)],
+                       inputs=[("x", (3, 8))], outputs=["v", "i"],
+                       initializers={"k": np.asarray([4], np.int64)})
+        v, i = run_model(m, {"x": x}, n_outputs=2)
+        tv, ti = torch.topk(torch.from_numpy(x), 4)
+        np.testing.assert_allclose(v, tv.numpy(), atol=1e-6)
+        np.testing.assert_array_equal(i, ti.numpy())
+
+
+class TestShapeOps:
+    def test_reshape_with_zero_and_minus_one(self):
+        x = A(2, 3, 4)
+        m = make_model([make_node("Reshape", ["x", "s"], ["y"])],
+                       inputs=[("x", (2, 3, 4))], outputs=["y"],
+                       initializers={"s": np.asarray([0, -1], np.int64)})
+        check_model(m, {"x": x}, x.reshape(2, 12))
+
+    def test_transpose(self):
+        x = A(2, 3, 4)
+        m = _unary_model("Transpose", shape=(2, 3, 4), perm=[2, 0, 1])
+        check_model(m, {"x": x}, x.transpose(2, 0, 1))
+
+    def test_transpose_default_reverses(self):
+        x = A(2, 3, 4)
+        m = _unary_model("Transpose", shape=(2, 3, 4))
+        check_model(m, {"x": x}, x.transpose(2, 1, 0))
+
+    def test_concat(self):
+        a, b = A(2, 3), A(2, 5)
+        m = make_model([make_node("Concat", ["a", "b"], ["y"], axis=1)],
+                       inputs=[("a", (2, 3)), ("b", (2, 5))], outputs=["y"])
+        check_model(m, {"a": a, "b": b}, np.concatenate([a, b], 1))
+
+    def test_split_equal(self):
+        x = A(2, 6)
+        m = make_model([make_node("Split", ["x"], ["a", "b", "c"], axis=1)],
+                       inputs=[("x", (2, 6))], outputs=["a", "b", "c"])
+        outs = run_model(m, {"x": x}, n_outputs=3)
+        for got, exp in zip(outs, np.split(x, 3, 1)):
+            np.testing.assert_allclose(got, exp)
+
+    def test_split_sizes_input(self):
+        x = A(2, 7)
+        m = make_model(
+            [make_node("Split", ["x", "sz"], ["a", "b"], axis=1)],
+            inputs=[("x", (2, 7))], outputs=["a", "b"],
+            initializers={"sz": np.asarray([3, 4], np.int64)})
+        outs = run_model(m, {"x": x}, n_outputs=2)
+        np.testing.assert_allclose(outs[0], x[:, :3])
+        np.testing.assert_allclose(outs[1], x[:, 3:])
+
+    def test_squeeze_unsqueeze_opset13_input_axes(self):
+        x = A(2, 1, 3)
+        m = make_model([make_node("Squeeze", ["x", "ax"], ["y"])],
+                       inputs=[("x", (2, 1, 3))], outputs=["y"],
+                       initializers={"ax": np.asarray([1], np.int64)})
+        check_model(m, {"x": x}, x.squeeze(1))
+        m = make_model([make_node("Unsqueeze", ["x", "ax"], ["y"])],
+                       inputs=[("x", (2, 1, 3))], outputs=["y"],
+                       initializers={"ax": np.asarray([0, 3], np.int64)})
+        check_model(m, {"x": x}, x[None, :, :, None, :].reshape(1, 2, 1, 1, 3))
+
+    def test_flatten(self):
+        x = A(2, 3, 4, 5)
+        m = _unary_model("Flatten", shape=(2, 3, 4, 5), axis=2)
+        check_model(m, {"x": x}, x.reshape(6, 20))
+
+    def test_gather_dynamic_indices(self):
+        x = A(5, 4)
+        m = make_model([make_node("Gather", ["x", "i"], ["y"], axis=0)],
+                       inputs=[("x", (5, 4)), ("i", (3,))], outputs=["y"],
+                       input_dtypes={"i": np.int32})
+        idx = np.asarray([4, 0, 2], np.int32)
+        check_model(m, {"x": x, "i": idx}, x[idx])
+
+    def test_slice_opset10(self):
+        x = A(4, 6, 8)
+        m = make_model(
+            [make_node("Slice", ["x", "st", "en", "ax", "sp"], ["y"])],
+            inputs=[("x", (4, 6, 8))], outputs=["y"],
+            initializers={"st": np.asarray([1, -4], np.int64),
+                          "en": np.asarray([3, 1000], np.int64),
+                          "ax": np.asarray([0, 2], np.int64),
+                          "sp": np.asarray([1, 2], np.int64)})
+        check_model(m, {"x": x}, x[1:3, :, -4::2])
+
+    def test_expand(self):
+        x = A(1, 3)
+        m = make_model([make_node("Expand", ["x", "s"], ["y"])],
+                       inputs=[("x", (1, 3))], outputs=["y"],
+                       initializers={"s": np.asarray([4, 3], np.int64)})
+        check_model(m, {"x": x}, np.broadcast_to(x, (4, 3)))
+
+    def test_tile(self):
+        x = A(2, 3)
+        m = make_model([make_node("Tile", ["x", "r"], ["y"])],
+                       inputs=[("x", (2, 3))], outputs=["y"],
+                       initializers={"r": np.asarray([2, 2], np.int64)})
+        check_model(m, {"x": x}, np.tile(x, (2, 2)))
+
+    @pytest.mark.parametrize("mode,npmode", [
+        ("constant", "constant"), ("reflect", "reflect"), ("edge", "edge")])
+    def test_pad(self, mode, npmode):
+        x = A(3, 4)
+        m = make_model(
+            [make_node("Pad", ["x", "p"], ["y"], mode=mode)],
+            inputs=[("x", (3, 4))], outputs=["y"],
+            initializers={"p": np.asarray([1, 0, 1, 2], np.int64)})
+        expected = np.pad(x, ((1, 1), (0, 2)), mode=npmode)
+        check_model(m, {"x": x}, expected)
+
+    def test_one_hot(self):
+        idx = np.asarray([0, 2, 1], np.int32)
+        m = make_model(
+            [make_node("OneHot", ["i", "d", "v"], ["y"], axis=-1)],
+            inputs=[("i", (3,))], outputs=["y"],
+            input_dtypes={"i": np.int32},
+            initializers={"d": np.asarray(4, np.int64),
+                          "v": np.asarray([0.0, 1.0], np.float32)})
+        check_model(m, {"i": idx}, np.eye(4, dtype=F32)[idx])
+
+    def test_dropout_is_identity(self):
+        x = A(3, 4)
+        check_model(_unary_model("Dropout"), {"x": x}, x)
+
+    def test_shape_fold_through_reshape(self):
+        """Shape→Gather→Concat→Reshape structural chain folds away
+        (the dynamic-flatten idiom every exporter emits)."""
+        x = A(2, 3, 4)
+        nodes = [
+            make_node("Shape", ["x"], ["shp"]),
+            make_node("Gather", ["shp", "zero"], ["d0"], axis=0),
+            make_node("Unsqueeze", ["d0", "ax0"], ["d0u"]),
+            make_node("Concat", ["d0u", "minus1"], ["newshape"], axis=0),
+            make_node("Reshape", ["x", "newshape"], ["y"]),
+        ]
+        m = make_model(
+            nodes, inputs=[("x", (2, 3, 4))], outputs=["y"],
+            initializers={"zero": np.asarray(0, np.int64),
+                          "ax0": np.asarray([0], np.int64),
+                          "minus1": np.asarray([-1], np.int64)})
+        check_model(m, {"x": x}, x.reshape(2, 12))
+
+
+class TestNN:
+    def test_matmul_2d(self):
+        a, b = A(3, 4), A(4, 5)
+        m = make_model([make_node("MatMul", ["a", "b"], ["y"])],
+                       inputs=[("a", (3, 4)), ("b", (4, 5))], outputs=["y"])
+        check_model(m, {"a": a, "b": b}, a @ b, atol=1e-5)
+
+    def test_matmul_batched(self):
+        a, b = A(2, 3, 4), A(2, 4, 5)
+        m = make_model([make_node("MatMul", ["a", "b"], ["y"])],
+                       inputs=[("a", (2, 3, 4)), ("b", (2, 4, 5))],
+                       outputs=["y"])
+        check_model(m, {"a": a, "b": b}, a @ b, atol=1e-5)
+
+    def test_gemm_full(self):
+        a, b, c = A(4, 3), A(4, 5), A(5)
+        m = make_model(
+            [make_node("Gemm", ["a", "b", "c"], ["y"], alpha=0.5, beta=2.0,
+                       transA=1)],
+            inputs=[("a", (4, 3)), ("b", (4, 5))], outputs=["y"],
+            initializers={"c": c})
+        check_model(m, {"a": a, "b": b}, 0.5 * (a.T @ b) + 2.0 * c,
+                    atol=1e-5)
+
+    def _conv_expected(self, x, w, b=None, stride=1, padding=0, dilation=1,
+                       groups=1):
+        return TF.conv2d(torch.from_numpy(x), torch.from_numpy(w),
+                         torch.from_numpy(b) if b is not None else None,
+                         stride=stride, padding=padding, dilation=dilation,
+                         groups=groups).numpy()
+
+    def test_conv_basic_bias(self):
+        x, w, b = A(2, 3, 8, 8), A(5, 3, 3, 3), A(5)
+        m = make_model(
+            [make_node("Conv", ["x", "w", "b"], ["y"], kernel_shape=[3, 3])],
+            inputs=[("x", (2, 3, 8, 8))], outputs=["y"],
+            initializers={"w": w, "b": b})
+        check_model(m, {"x": x}, self._conv_expected(x, w, b), atol=1e-4)
+
+    def test_conv_stride_pad(self):
+        x, w = A(1, 3, 9, 9), A(4, 3, 3, 3)
+        m = make_model(
+            [make_node("Conv", ["x", "w"], ["y"], kernel_shape=[3, 3],
+                       strides=[2, 2], pads=[1, 1, 1, 1])],
+            inputs=[("x", (1, 3, 9, 9))], outputs=["y"],
+            initializers={"w": w})
+        check_model(m, {"x": x},
+                    self._conv_expected(x, w, stride=2, padding=1),
+                    atol=1e-4)
+
+    def test_conv_asymmetric_pads(self):
+        x, w = A(1, 2, 7, 7), A(3, 2, 3, 3)
+        m = make_model(
+            [make_node("Conv", ["x", "w"], ["y"], kernel_shape=[3, 3],
+                       pads=[0, 1, 1, 2])],
+            inputs=[("x", (1, 2, 7, 7))], outputs=["y"],
+            initializers={"w": w})
+        xp = np.pad(x, ((0, 0), (0, 0), (0, 1), (1, 2)))
+        check_model(m, {"x": x}, self._conv_expected(xp, w), atol=1e-4)
+
+    def test_conv_dilated(self):
+        x, w = A(1, 2, 10, 10), A(3, 2, 3, 3)
+        m = make_model(
+            [make_node("Conv", ["x", "w"], ["y"], kernel_shape=[3, 3],
+                       dilations=[2, 2])],
+            inputs=[("x", (1, 2, 10, 10))], outputs=["y"],
+            initializers={"w": w})
+        check_model(m, {"x": x}, self._conv_expected(x, w, dilation=2),
+                    atol=1e-4)
+
+    def test_conv_groups(self):
+        x, w = A(1, 4, 8, 8), A(6, 2, 3, 3)
+        m = make_model(
+            [make_node("Conv", ["x", "w"], ["y"], kernel_shape=[3, 3],
+                       group=2)],
+            inputs=[("x", (1, 4, 8, 8))], outputs=["y"],
+            initializers={"w": w})
+        check_model(m, {"x": x}, self._conv_expected(x, w, groups=2),
+                    atol=1e-4)
+
+    def test_conv_depthwise(self):
+        x, w = A(1, 4, 8, 8), A(4, 1, 3, 3)
+        m = make_model(
+            [make_node("Conv", ["x", "w"], ["y"], kernel_shape=[3, 3],
+                       group=4)],
+            inputs=[("x", (1, 4, 8, 8))], outputs=["y"],
+            initializers={"w": w})
+        check_model(m, {"x": x}, self._conv_expected(x, w, groups=4),
+                    atol=1e-4)
+
+    def test_maxpool(self):
+        x = A(2, 3, 8, 8)
+        m = make_model(
+            [make_node("MaxPool", ["x"], ["y"], kernel_shape=[2, 2],
+                       strides=[2, 2])],
+            inputs=[("x", (2, 3, 8, 8))], outputs=["y"])
+        check_model(m, {"x": x},
+                    TF.max_pool2d(torch.from_numpy(x), 2, 2).numpy())
+
+    def test_maxpool_pads(self):
+        x = A(1, 2, 7, 7)
+        m = make_model(
+            [make_node("MaxPool", ["x"], ["y"], kernel_shape=[3, 3],
+                       strides=[2, 2], pads=[1, 1, 1, 1])],
+            inputs=[("x", (1, 2, 7, 7))], outputs=["y"])
+        check_model(m, {"x": x},
+                    TF.max_pool2d(torch.from_numpy(x), 3, 2, 1).numpy())
+
+    def test_avgpool(self):
+        x = A(2, 3, 8, 8)
+        m = make_model(
+            [make_node("AveragePool", ["x"], ["y"], kernel_shape=[2, 2],
+                       strides=[2, 2])],
+            inputs=[("x", (2, 3, 8, 8))], outputs=["y"])
+        check_model(m, {"x": x},
+                    TF.avg_pool2d(torch.from_numpy(x), 2, 2).numpy(),
+                    atol=1e-5)
+
+    def test_avgpool_pads_include(self):
+        x = A(1, 2, 6, 6)
+        m = make_model(
+            [make_node("AveragePool", ["x"], ["y"], kernel_shape=[3, 3],
+                       strides=[3, 3], pads=[1, 1, 1, 1],
+                       count_include_pad=1)],
+            inputs=[("x", (1, 2, 6, 6))], outputs=["y"])
+        check_model(m, {"x": x},
+                    TF.avg_pool2d(torch.from_numpy(x), 3, 3, 1,
+                                  count_include_pad=True).numpy(),
+                    atol=1e-5)
+
+    def test_global_average_pool(self):
+        x = A(2, 3, 5, 7)
+        m = make_model([make_node("GlobalAveragePool", ["x"], ["y"])],
+                       inputs=[("x", (2, 3, 5, 7))], outputs=["y"])
+        check_model(m, {"x": x}, x.mean((2, 3), keepdims=True), atol=1e-5)
+
+    def test_global_max_pool(self):
+        x = A(2, 3, 5, 7)
+        m = make_model([make_node("GlobalMaxPool", ["x"], ["y"])],
+                       inputs=[("x", (2, 3, 5, 7))], outputs=["y"])
+        check_model(m, {"x": x}, x.max((2, 3), keepdims=True))
+
+    def test_batch_norm_inference(self):
+        x = A(2, 4, 5, 5)
+        gamma, beta = P(4), A(4)
+        mean, var = A(4, lo=-0.5, hi=0.5), P(4)
+        m = make_model(
+            [make_node("BatchNormalization",
+                       ["x", "g", "b", "m", "v"], ["y"], epsilon=1e-4)],
+            inputs=[("x", (2, 4, 5, 5))], outputs=["y"],
+            initializers={"g": gamma, "b": beta, "m": mean, "v": var})
+        expected = TF.batch_norm(
+            torch.from_numpy(x), torch.from_numpy(mean),
+            torch.from_numpy(var), torch.from_numpy(gamma),
+            torch.from_numpy(beta), training=False, eps=1e-4).numpy()
+        check_model(m, {"x": x}, expected, atol=1e-4)
+
+    def test_instance_norm(self):
+        x = A(2, 3, 6, 6)
+        gamma, beta = P(3), A(3)
+        m = make_model(
+            [make_node("InstanceNormalization", ["x", "g", "b"], ["y"],
+                       epsilon=1e-5)],
+            inputs=[("x", (2, 3, 6, 6))], outputs=["y"],
+            initializers={"g": gamma, "b": beta})
+        expected = TF.instance_norm(
+            torch.from_numpy(x), weight=torch.from_numpy(gamma),
+            bias=torch.from_numpy(beta), eps=1e-5).numpy()
+        check_model(m, {"x": x}, expected, atol=1e-4)
+
+    def test_layer_norm(self):
+        x = A(2, 5, 8)
+        gamma, beta = P(8), A(8)
+        m = make_model(
+            [make_node("LayerNormalization", ["x", "g", "b"], ["y"],
+                       axis=-1, epsilon=1e-5)],
+            inputs=[("x", (2, 5, 8))], outputs=["y"],
+            initializers={"g": gamma, "b": beta})
+        expected = TF.layer_norm(torch.from_numpy(x), (8,),
+                                 torch.from_numpy(gamma),
+                                 torch.from_numpy(beta), 1e-5).numpy()
+        check_model(m, {"x": x}, expected, atol=1e-4)
+
+
+class TestEndToEnd:
+    """Imported models forward-match torch and fine-tune end-to-end
+    (the convert_to_variables flow the BERT/TF path established)."""
+
+    def _mlp_model(self):
+        tm = torch.nn.Sequential(
+            torch.nn.Linear(6, 16), torch.nn.ReLU(),
+            torch.nn.Linear(16, 3))
+        w1 = tm[0].weight.detach().numpy()    # [16, 6]
+        b1 = tm[0].bias.detach().numpy()
+        w2 = tm[2].weight.detach().numpy()
+        b2 = tm[2].bias.detach().numpy()
+        nodes = [
+            make_node("Gemm", ["x", "w1", "b1"], ["h"], transB=1),
+            make_node("Relu", ["h"], ["hr"]),
+            make_node("Gemm", ["hr", "w2", "b2"], ["logits"], transB=1),
+        ]
+        m = make_model(nodes, inputs=[("x", (None, 6))], outputs=["logits"],
+                       initializers={"w1": w1, "b1": b1,
+                                     "w2": w2, "b2": b2})
+        return tm, m
+
+    def test_mlp_forward_parity(self):
+        tm, m = self._mlp_model()
+        from deeplearning4j_tpu.imports.onnx_import import import_onnx
+        sd = import_onnx(m, input_shapes={"x": (4, 6)})
+        x = A(4, 6)
+        expected = tm(torch.from_numpy(x)).detach().numpy()
+        out = sd.output({"x": x}, sd.onnx_outputs[:1])
+        np.testing.assert_allclose(out[sd.onnx_outputs[0]].to_numpy(),
+                                   expected, atol=1e-5)
+
+    def test_mlp_fine_tune(self):
+        _, m = self._mlp_model()
+        from deeplearning4j_tpu.autodiff import TrainingConfig
+        from deeplearning4j_tpu.data import DataSet
+        from deeplearning4j_tpu.imports.onnx_import import import_onnx
+        from deeplearning4j_tpu.learning import Adam
+
+        sd = import_onnx(m, input_shapes={"x": (16, 6)})
+        logits = sd.get_variable(sd.onnx_outputs[0])
+        sd.convert_to_variables()        # imported weights → trainable
+        y = sd.placeholder("y", shape=(16, 3))
+        sd.loss_ops.softmax_cross_entropy(
+            logits, sd.get_variable("y")).rename("loss")
+        sd.set_loss_variables("loss")
+        sd.set_training_config(
+            TrainingConfig(updater=Adam(3e-3), loss_name="loss"))
+
+        rs = np.random.RandomState(3)
+        xs = rs.randn(16, 6).astype(F32)
+        cls = ((xs[:, 0] > 0).astype(int)
+               + (xs[:, 1] > 0).astype(int))
+        ys = np.eye(3, dtype=F32)[cls]
+        history = sd.fit(DataSet(xs, ys), epochs=80)
+        curve = history.loss_curve()
+        assert curve[-1] < curve[0] * 0.7, (curve[0], curve[-1])
+
+    def test_cnn_forward_parity(self):
+        conv = torch.nn.Conv2d(1, 4, 3, padding=1)
+        bn = torch.nn.BatchNorm2d(4).eval()
+        bn.running_mean.data = torch.randn(4) * 0.1
+        bn.running_var.data = torch.rand(4) + 0.5
+        fc = torch.nn.Linear(4, 2)
+        tm = lambda t: fc(TF.relu(
+            bn(conv(t))).max(dim=3).values.max(dim=2).values)
+
+        nodes = [
+            make_node("Conv", ["x", "cw", "cb"], ["c"], kernel_shape=[3, 3],
+                      pads=[1, 1, 1, 1]),
+            make_node("BatchNormalization",
+                      ["c", "g", "b", "rm", "rv"], ["n"], epsilon=1e-5),
+            make_node("Relu", ["n"], ["r"]),
+            make_node("GlobalMaxPool", ["r"], ["p"]),
+            make_node("Flatten", ["p"], ["pf"], axis=1),
+            make_node("Gemm", ["pf", "fw", "fb"], ["logits"], transB=1),
+        ]
+        inits = {
+            "cw": conv.weight.detach().numpy(),
+            "cb": conv.bias.detach().numpy(),
+            "g": bn.weight.detach().numpy(),
+            "b": bn.bias.detach().numpy(),
+            "rm": bn.running_mean.numpy(),
+            "rv": bn.running_var.numpy(),
+            "fw": fc.weight.detach().numpy(),
+            "fb": fc.bias.detach().numpy(),
+        }
+        m = make_model(nodes, inputs=[("x", (2, 1, 8, 8))],
+                       outputs=["logits"], initializers=inits)
+        x = A(2, 1, 8, 8)
+        with torch.no_grad():
+            expected = tm(torch.from_numpy(x)).numpy()
+        check_model(m, {"x": x}, expected, atol=1e-4)
+
+    def test_attention_block_forward_parity(self):
+        """Single-head self-attention built from MatMul/Transpose/Softmax
+        (the exported-transformer op closure)."""
+        B, T, D = 2, 5, 8
+        wq, wk, wv = A(D, D), A(D, D), A(D, D)
+        nodes = [
+            make_node("MatMul", ["x", "wq"], ["q"]),
+            make_node("MatMul", ["x", "wk"], ["k"]),
+            make_node("MatMul", ["x", "wv"], ["v"]),
+            make_node("Transpose", ["k"], ["kt"], perm=[0, 2, 1]),
+            make_node("MatMul", ["q", "kt"], ["scores"]),
+            make_node("Mul", ["scores", "scale"], ["scaled"]),
+            make_node("Softmax", ["scaled"], ["attn"], axis=-1),
+            make_node("MatMul", ["attn", "v"], ["y"]),
+        ]
+        m = make_model(
+            nodes, inputs=[("x", (B, T, D))], outputs=["y"],
+            initializers={"wq": wq, "wk": wk, "wv": wv,
+                          "scale": np.asarray(1 / np.sqrt(D), F32)})
+        x = A(B, T, D)
+        xt = torch.from_numpy(x)
+        q, k, v = xt @ torch.from_numpy(wq), xt @ torch.from_numpy(wk), \
+            xt @ torch.from_numpy(wv)
+        expected = (TF.softmax(q @ k.transpose(1, 2) / np.sqrt(D), dim=-1)
+                    @ v).numpy()
+        check_model(m, {"x": x}, expected, atol=1e-5)
+
+    def test_unsupported_op_reports_cleanly(self):
+        from deeplearning4j_tpu.imports.onnx_import import (
+            UnsupportedOnnxOpError, import_onnx)
+        m = make_model([make_node("STFT", ["x"], ["y"])],
+                       inputs=[("x", (4, 4))], outputs=["y"])
+        with pytest.raises(UnsupportedOnnxOpError, match="STFT"):
+            import_onnx(m)
+
+
+class TestReviewRegressions:
+    """Cases from the round-3 code review of the importer."""
+
+    def test_conv_same_lower_pads_at_beginning(self):
+        # XLA's "SAME" is SAME_UPPER; SAME_LOWER must place the odd pad
+        # pixel at the beginning
+        x = A(1, 2, 7, 7)
+        w = A(3, 2, 2, 2)
+        m = make_model(
+            [make_node("Conv", ["x", "w"], ["y"], kernel_shape=[2, 2],
+                       auto_pad="SAME_LOWER")],
+            inputs=[("x", (1, 2, 7, 7))], outputs=["y"],
+            initializers={"w": w})
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 0), (1, 0)))
+        expected = TF.conv2d(torch.from_numpy(xp),
+                             torch.from_numpy(w)).numpy()
+        check_model(m, {"x": x}, expected, atol=1e-4)
+
+    def test_maxpool_same_upper(self):
+        x = A(1, 2, 7, 7)
+        m = make_model(
+            [make_node("MaxPool", ["x"], ["y"], kernel_shape=[2, 2],
+                       strides=[2, 2], auto_pad="SAME_UPPER")],
+            inputs=[("x", (1, 2, 7, 7))], outputs=["y"])
+        xp = np.pad(x, ((0, 0), (0, 0), (0, 1), (0, 1)),
+                    constant_values=-np.inf)
+        expected = TF.max_pool2d(torch.from_numpy(xp), 2, 2).numpy()
+        check_model(m, {"x": x}, expected)
+
+    def test_flatten_negative_axis(self):
+        x = A(2, 3, 4)
+        m = _unary_model("Flatten", shape=(2, 3, 4), axis=-1)
+        check_model(m, {"x": x}, x.reshape(6, 4))
+
+    def test_softmax_opset11_negative_axis(self):
+        x = A(2, 3, 4)
+        m = _unary_model("Softmax", shape=(2, 3, 4), opset=11, axis=-1)
+        e = np.exp(x - x.max(-1, keepdims=True))
+        check_model(m, {"x": x}, e / e.sum(-1, keepdims=True), atol=1e-6)
+
+    def test_fp16_int32_data_bit_patterns(self):
+        from deeplearning4j_tpu.imports.onnx_import import tensor_to_numpy
+        from deeplearning4j_tpu.imports.onnx_ir_pb2 import TensorProto
+
+        t = TensorProto(dims=[2], data_type=TensorProto.FLOAT16)
+        t.int32_data.extend([15360, 16384])     # bit patterns of 1.0, 2.0
+        v = tensor_to_numpy(t)
+        assert v.dtype == np.float16
+        np.testing.assert_array_equal(v, np.asarray([1.0, 2.0], np.float16))
+
+    def test_clip_with_dynamic_bound_errors(self):
+        from deeplearning4j_tpu.imports.onnx_import import import_onnx
+
+        m = make_model(
+            [make_node("Relu", ["lo_in"], ["lo"]),
+             make_node("Clip", ["x", "lo"], ["y"])],
+            inputs=[("x", (3,)), ("lo_in", (1,))], outputs=["y"])
+        with pytest.raises(ValueError, match="statically resolvable"):
+            import_onnx(m)

@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Generate the frozen serde fixtures for the format-stability suite.
+
+Reference: deeplearning4j ``deeplearning4j-core`` regressiontest package —
+models serialized by OLD releases are committed as resources and every later
+release must keep loading them (SURVEY.md §4.4, §7.3.8).
+
+Run ONCE when a format version is introduced:
+
+    python tools/make_format_fixtures.py
+
+Outputs land in ``tests/resources/serde/v<N>/`` where <N> bumps only when a
+container format version bumps. The directory is APPEND-ONLY: committed
+fixture bytes are never regenerated or edited — a load-path change that
+breaks them is a compatibility regression, not a fixture problem (see
+tests/resources/serde/README.md). Expected activations are computed at
+generation time and stored beside the models, so the parity check is against
+frozen bytes, not re-derivation.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+# fixtures are generated on the CPU backend for cross-machine determinism
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                   "tests", "resources", "serde", "v1")
+
+
+def make_mln(out):
+    from deeplearning4j_tpu.data import DataSet
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf import layers as L
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12345)
+            .updater(Adam(learning_rate=0.01))
+            .activation("tanh")
+            .list()
+            .layer(L.DenseLayer(n_out=8))
+            .layer(L.OutputLayer(n_out=3, loss="mcxent",
+                                 activation="softmax"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    model = MultiLayerNetwork(conf)
+    model.init()
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)]
+    model.fit(DataSet(x, y), epochs=3)       # real updater state
+    model.save(os.path.join(out, "mln.zip"), save_updater=True)
+    probe = rng.randn(5, 4).astype(np.float32)
+    np.savez(os.path.join(out, "mln_expected.npz"), probe=probe,
+             output=model.output(probe).to_numpy())
+
+
+def make_cg(out):
+    from deeplearning4j_tpu.data import DataSet
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.nn import (ComputationGraph,
+                                       ComputationGraphConfiguration,
+                                       InputType, NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf import layers as L
+
+    conf = (ComputationGraphConfiguration
+            .graph_builder(NeuralNetConfiguration.builder()
+                           .seed(7).updater(Adam(0.05)).activation("tanh"))
+            .add_inputs("in")
+            .add_layer("dense", L.DenseLayer(n_out=8), "in")
+            .add_layer("out", L.OutputLayer(n_out=3), "dense")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    model = ComputationGraph(conf)
+    model.init()
+    rng = np.random.RandomState(1)
+    x = rng.randn(16, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)]
+    model.fit(DataSet(x, y), epochs=3)
+    model.save(os.path.join(out, "cg.zip"), save_updater=True)
+    probe = rng.randn(5, 4).astype(np.float32)
+    np.savez(os.path.join(out, "cg_expected.npz"), probe=probe,
+             output=model.output(probe)[0].to_numpy())
+
+
+def make_samediff(out):
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+    rng = np.random.RandomState(2)
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(None, 3))
+    w = sd.var("w", init=rng.randn(3, 4).astype(np.float32))
+    b = sd.var("b", shape=(4,), init="zeros")
+    sd.math.sigmoid((x @ w) + b).rename("out")
+    sd.save(os.path.join(out, "samediff.sdz"))
+    probe = rng.randn(4, 3).astype(np.float32)
+    np.savez(os.path.join(out, "samediff_expected.npz"), probe=probe,
+             output=sd.output({"x": probe}, ["out"])["out"].to_numpy())
+
+
+def make_samediff_controlflow(out):
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+    sd = SameDiff.create()
+    x = sd.placeholder("x", shape=(2,))
+    pred = sd.math.greater(x.sum(), 0.0)
+    branched = sd.cond(pred,
+                       lambda s, a: s.math.multiply(a, 2.0),
+                       lambda s, a: s.math.multiply(a, -1.0),
+                       x, name="branchy")
+    sd.while_loop(lambda s, v: s.math.less(v.sum(), 20.0),
+                  lambda s, v: s.math.multiply(v, 2.0),
+                  branched, name="doubler").rename("final")
+    sd.save(os.path.join(out, "samediff_controlflow.sdz"))
+    pos = np.asarray([1.0, 2.0], np.float32)
+    neg = np.asarray([-1.0, -2.0], np.float32)
+    np.savez(
+        os.path.join(out, "samediff_controlflow_expected.npz"),
+        pos=pos, neg=neg,
+        out_pos=sd.output({"x": pos}, ["final"])["final"].to_numpy(),
+        out_neg=sd.output({"x": neg}, ["final"])["final"].to_numpy())
+
+
+def make_word2vec(out):
+    from deeplearning4j_tpu.nlp import (Word2Vec, write_word2vec_model,
+                                        write_word_vectors)
+
+    rng = np.random.default_rng(5)
+    sents = []
+    for i in range(400):
+        c = "cat" if i % 2 == 0 else "dog"
+        sents.append(" ".join(f"{c}{j}" for j in rng.integers(0, 12, 10)))
+    w = Word2Vec(min_word_frequency=3, layer_size=16, negative=3, epochs=2,
+                 batch_size=256, seed=9)
+    w.set_sentence_iterator(sents)
+    w.fit()
+    write_word2vec_model(w, os.path.join(out, "word2vec_model.zip"))
+    write_word_vectors(w, os.path.join(out, "vectors.txt"), binary=False)
+    write_word_vectors(w, os.path.join(out, "vectors.bin"), binary=True)
+    words = sorted(w.vocab.words())[:8]
+    np.savez(os.path.join(out, "word2vec_expected.npz"),
+             words=np.asarray(words),
+             vectors=np.stack([w.get_word_vector(wd) for wd in words]))
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    make_mln(OUT)
+    make_cg(OUT)
+    make_samediff(OUT)
+    make_samediff_controlflow(OUT)
+    make_word2vec(OUT)
+    from deeplearning4j_tpu.autodiff import samediff as sd_mod
+    from deeplearning4j_tpu.nlp import serializer as nlp_ser
+    manifest = {
+        "generated_with": {
+            "model_serializer_format": 1,
+            "samediff_format": sd_mod._FORMAT_VERSION,
+            "word2vec_format": nlp_ser._FORMAT_VERSION,
+        },
+        "policy": "append-only: never regenerate committed fixtures; new "
+                  "format versions add a new vN directory",
+    }
+    with open(os.path.join(OUT, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+    print("fixtures written to", os.path.abspath(OUT))
+
+
+if __name__ == "__main__":
+    main()
