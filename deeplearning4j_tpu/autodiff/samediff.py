@@ -647,12 +647,36 @@ class SameDiff:
     def set_training_config(self, config: "TrainingConfig") -> None:
         self._training_config = config
         self._updater_state = None
+        # invalidate cached train steps: a replaced config/updater must
+        # never hit a step traced with the old hyperparameters
+        self._tc_version = getattr(self, "_tc_version", 0) + 1
+        for k in [k for k in self._fn_cache if k[0] == "train_step"]:
+            del self._fn_cache[k]
 
     setTrainingConfig = set_training_config
 
     def _train_step_fn(self, loss_name: str, ph_names: Tuple[str, ...]):
         """One fused XLA module: forward + backward + updater (the reference's
-        TrainingSession materialized per-op; here it is one executable)."""
+        TrainingSession materialized per-op; here it is one executable).
+
+        Cached in ``_fn_cache`` (invalidated with it on graph mutation):
+        without this, every ``fit`` call wrapped a FRESH ``jax.jit`` and
+        re-traced — ~1 s of host work per call, pathological for per-batch
+        fit callers like the RL learners."""
+        tc0 = self._training_config
+        # key on a set_training_config version counter + the updater's
+        # hyperparameters — NOT object ids (CPython reuses freed addresses,
+        # silently resurrecting a step traced with old settings)
+        upd0 = tc0.updater
+        cache_key = ("train_step", loss_name, ph_names,
+                     getattr(self, "_tc_version", 0),
+                     type(upd0).__name__,
+                     getattr(upd0, "learning_rate", None),
+                     getattr(upd0, "momentum", None),
+                     tc0.l1, tc0.l2, tc0.grad_clip_value)
+        cached = self._fn_cache.get(cache_key)
+        if cached is not None:
+            return cached
         fn = self._make_fn((loss_name,), training=True)
         tc = self._training_config
         updater = tc.updater
@@ -677,7 +701,9 @@ class SameDiff:
             new_params, new_state = updater.apply(grads, upd_state, params, iteration)
             return new_params, new_state, loss
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        jitted = jax.jit(step, donate_argnums=(0, 1))
+        self._fn_cache[cache_key] = jitted
+        return jitted
 
     def fit(self, data=None, epochs: int = 1, batch_size: Optional[int] = None,
             feature_placeholder: Optional[str] = None,

@@ -1,6 +1,7 @@
 from .dataset import DataSet, MultiDataSet
 from .iterators import (DataSetIterator, NDArrayDataSetIterator, ExistingDataSetIterator,
-                        MultipleEpochsIterator, MnistDataSetIterator, IrisDataSetIterator)
+                        MultipleEpochsIterator, MnistDataSetIterator, IrisDataSetIterator,
+                        Cifar10DataSetIterator, EmnistDataSetIterator)
 from .normalizers import (NormalizerStandardize, NormalizerMinMaxScaler,
                           ImagePreProcessingScaler, normalizer_from_json)
 from .records import (RecordReader, SequenceRecordReader, CSVRecordReader,
@@ -14,3 +15,7 @@ from .image import (ImageRecordReader, ImageTransform, ResizeImageTransform,
 from .record_iterator import (RecordReaderDataSetIterator,
                               SequenceRecordReaderDataSetIterator,
                               AsyncDataSetIterator)
+from .reducers import Reducer, Join
+from .sequence import (convert_to_sequence, window_sequence,
+                       window_sequences, reduce_sequence)
+from .analysis import AnalyzeLocal, DataAnalysis, ColumnAnalysis

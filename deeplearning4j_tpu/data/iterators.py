@@ -245,3 +245,138 @@ class IrisDataSetIterator(DataSetIterator):
         for i in range(0, len(self.features), self.batch_size):
             yield self._apply_pre(DataSet(self.features[i:i + self.batch_size],
                                           self.labels[i:i + self.batch_size]))
+
+
+def _synthetic_class_images(n: int, n_classes: int, hw: int, channels: int,
+                            seed: int, train: bool):
+    """Per-class smooth random prototype + per-example shift/noise —
+    deterministic, CNN-learnable, linearly non-trivial (the synthetic
+    fallback pattern the MNIST iterator established)."""
+    rng = np.random.RandomState(seed + (0 if train else 1))
+    protos = np.zeros((n_classes, channels, hw, hw), np.float32)
+    for c in range(n_classes):
+        prng = np.random.RandomState(1000 + c)
+        base = prng.randn(channels, 8, 8)
+        # smooth upsample: nearest then box blur
+        big = np.repeat(np.repeat(base, hw // 8 + 1, 1), hw // 8 + 1, 2)
+        big = big[:, :hw, :hw]
+        k = np.ones((3, 3), np.float32) / 9.0
+        for ch in range(channels):
+            p = np.pad(big[ch], 1, mode="edge")
+            big[ch] = sum(p[dy:dy + hw, dx:dx + hw] * k[dy, dx]
+                          for dy in range(3) for dx in range(3))
+        protos[c] = big
+    protos = (protos - protos.min()) / (np.ptp(protos) + 1e-9)
+    labels = rng.randint(0, n_classes, n)
+    images = np.zeros((n, channels, hw, hw), np.float32)
+    for i, c in enumerate(labels):
+        dx, dy = rng.randint(-3, 4, 2)
+        img = np.roll(np.roll(protos[c], dy, axis=1), dx, axis=2)
+        images[i] = np.clip(img + rng.randn(channels, hw, hw) * 0.15, 0, 1)
+    return (images * 255).astype(np.uint8), labels.astype(np.int64)
+
+
+class Cifar10DataSetIterator(DataSetIterator):
+    """Reference dl4j-data Cifar10DataSetIterator: 32x32x3 in [0,1] (NCHW),
+    one-hot [10]. Loads the standard binary batches when present under
+    $DL4J_TPU_DATA_DIR/cifar-10-batches-bin; otherwise a deterministic
+    synthetic fallback (marked via ``.synthetic``) keeps pipelines and CI
+    runnable without egress."""
+
+    LABELS = ["airplane", "automobile", "bird", "cat", "deer", "dog",
+              "frog", "horse", "ship", "truck"]
+
+    def __init__(self, batch_size: int, train: bool = True,
+                 num_examples: Optional[int] = None, seed: int = 6):
+        self.batch_size = batch_size
+        self.synthetic = False
+        n = num_examples or (50000 if train else 10000)
+        root = os.path.join(_DATA_DIR, "cifar-10-batches-bin")
+        files = ([f"data_batch_{i}.bin" for i in range(1, 6)] if train
+                 else ["test_batch.bin"])
+        paths = [os.path.join(root, f) for f in files]
+        if all(os.path.exists(p) for p in paths):
+            recs = []
+            for p in paths:
+                raw = np.fromfile(p, np.uint8).reshape(-1, 3073)
+                recs.append(raw)
+            raw = np.concatenate(recs)[:n]
+            labels = raw[:, 0].astype(np.int64)
+            images = raw[:, 1:].reshape(-1, 3, 32, 32)
+        else:
+            self.synthetic = True
+            n = min(n, 8000 if train else 1500)
+            images, labels = _synthetic_class_images(n, 10, 32, 3, seed,
+                                                     train)
+        self.features = images.astype(np.float32) / 255.0
+        self.labels = np.eye(10, dtype=np.float32)[labels]
+
+    def batch(self) -> int:
+        return self.batch_size
+
+    def total_examples(self) -> int:
+        return len(self.features)
+
+    def __iter__(self):
+        for i in range(0, len(self.features), self.batch_size):
+            yield self._apply_pre(DataSet(
+                self.features[i:i + self.batch_size],
+                self.labels[i:i + self.batch_size]))
+
+
+class EmnistDataSetIterator(DataSetIterator):
+    """Reference dl4j-data EmnistDataSetIterator. ``dataset`` picks the
+    split ("letters": 26 classes, "digits"/"mnist": 10, "balanced": 47);
+    idx files are looked up like MNIST's, with the synthetic per-class
+    fallback otherwise."""
+
+    _CLASSES = {"letters": 26, "digits": 10, "mnist": 10, "balanced": 47,
+                "byclass": 62, "bymerge": 47}
+
+    def __init__(self, dataset: str, batch_size: int, train: bool = True,
+                 num_examples: Optional[int] = None, seed: int = 6,
+                 flatten: bool = True):
+        if dataset not in self._CLASSES:
+            raise ValueError(f"unknown EMNIST split {dataset!r}; one of "
+                             f"{sorted(self._CLASSES)}")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.flatten = flatten
+        self.synthetic = False
+        n_classes = self._CLASSES[dataset]
+        n = num_examples or (60000 if train else 10000)
+        tag = "train" if train else "test"
+        img_path = _find_idx(
+            [f"emnist-{dataset}-{tag}-images-idx3-ubyte"])
+        lbl_path = _find_idx(
+            [f"emnist-{dataset}-{tag}-labels-idx1-ubyte"])
+        if img_path and lbl_path:
+            images = _read_idx(img_path)[:n]
+            labels = _read_idx(lbl_path)[:n].astype(np.int64)
+            if dataset == "letters":     # letters labels are 1-based
+                labels = labels - 1
+            images = images.reshape(len(images), 1, 28, 28)
+        else:
+            self.synthetic = True
+            n = min(n, 6000 if train else 1000)
+            images, labels = _synthetic_class_images(n, n_classes, 28, 1,
+                                                     seed, train)
+        feats = images.astype(np.float32) / 255.0
+        self.features = feats.reshape(len(feats), -1) if flatten \
+            else feats.reshape(len(feats), 1, 28, 28)
+        self.labels = np.eye(n_classes, dtype=np.float32)[labels]
+
+    def num_classes(self) -> int:
+        return self.labels.shape[1]
+
+    def batch(self) -> int:
+        return self.batch_size
+
+    def total_examples(self) -> int:
+        return len(self.features)
+
+    def __iter__(self):
+        for i in range(0, len(self.features), self.batch_size):
+            yield self._apply_pre(DataSet(
+                self.features[i:i + self.batch_size],
+                self.labels[i:i + self.batch_size]))

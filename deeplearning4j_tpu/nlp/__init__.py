@@ -5,12 +5,18 @@
 - ``lookup_table``       InMemoryLookupTable (syn0/syn1/syn1neg)
 - ``word2vec``           SequenceVectors engine + Word2Vec builder front
 - ``paragraph_vectors``  ParagraphVectors: PV-DM / PV-DBOW + infer_vector
+- ``glove``              Glove: co-occurrence counting + AdaGrad factorization
+- ``fasttext``           FastText: subword (char n-gram) vectors, OOV queries
+- ``graph_vectors``      DeepWalk / Node2Vec over random walks
 - ``serializer``         WordVectorSerializer: txt / Google-bin / model zip
 
 The fused skip-gram/CBOW device rounds live in ``ops/embeddings.py`` (the
 TPU analog of libnd4j's sg_cb kernels).
 """
 
+from .fasttext import FastText, char_ngrams, fasttext_hash
+from .glove import Glove
+from .graph_vectors import DeepWalk, Graph, Node2Vec, random_walks
 from .lookup_table import InMemoryLookupTable
 from .paragraph_vectors import ParagraphVectors
 from .serializer import (read_word2vec_model, read_word_vectors,
@@ -25,8 +31,10 @@ from .vocab import (VocabCache, VocabConstructor, VocabWord, build_huffman,
 from .word2vec import SequenceVectors, Word2Vec, WordVectors
 
 __all__ = [
-    "CollectionSentenceIterator", "CommonPreprocessor",
-    "DefaultTokenizerFactory", "FileSentenceIterator", "InMemoryLookupTable",
+    "CollectionSentenceIterator", "CommonPreprocessor", "DeepWalk",
+    "DefaultTokenizerFactory", "FastText", "FileSentenceIterator", "Glove",
+    "Graph", "InMemoryLookupTable", "Node2Vec", "char_ngrams",
+    "fasttext_hash", "random_walks",
     "LabelAwareIterator", "LineSentenceIterator", "NGramTokenizerFactory",
     "ParagraphVectors", "SentenceIterator", "SequenceVectors", "Tokenizer",
     "TokenizerFactory", "VocabCache", "VocabConstructor", "VocabWord",

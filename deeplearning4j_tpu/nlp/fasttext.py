@@ -1,0 +1,200 @@
+"""FastText: subword-enriched word vectors.
+
+Reference: dl4j-nlp ``models/fasttext/FastText`` (SURVEY §2.3 NLP row) — a
+thin wrapper around the external fastText C++ library. No external binary
+here: the skip-gram-with-subwords training procedure (Bojanowski et al.) is
+implemented natively on the existing fused device rounds:
+
+- every vocab word expands to itself + its char n-grams (minn..maxn over
+  ``<word>``), n-grams hashed into ``bucket`` extra table rows with
+  fastText's FNV-1a variant;
+- the input vector of a center word is the MEAN of its subword rows, and
+  gradients spread back over those rows — exactly the shape of the engine's
+  fused CBOW round (``ops/embeddings.cbow``), so training reuses it: the
+  "context window" slot carries the center's subword ids, the "center"
+  slot carries the context word (the skip-gram target), negatives come
+  from the engine's on-device unigram table;
+- out-of-vocabulary words get vectors from their n-grams alone — the
+  fastText property the reference wrapper exposes via
+  ``getWordVector`` on unseen words.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .lookup_table import InMemoryLookupTable
+from .vocab import VocabConstructor
+from .word2vec import SequenceVectors
+
+
+def fasttext_hash(ngram: str) -> int:
+    """fastText's FNV-1a over utf-8 bytes (Dictionary::hash); 32-bit
+    wraparound made explicit with a mask."""
+    h = 2166136261
+    for byte in ngram.encode("utf-8"):
+        h = ((h ^ byte) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+def char_ngrams(word: str, minn: int, maxn: int) -> List[str]:
+    w = f"<{word}>"
+    out = []
+    for n in range(minn, maxn + 1):
+        if n >= len(w):
+            break
+        for i in range(len(w) - n + 1):
+            out.append(w[i:i + n])
+    return out
+
+
+class FastText(SequenceVectors):
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+            self._iter = None
+
+        def min_word_frequency(self, v): self._kw["min_word_frequency"] = v; return self
+        def layer_size(self, v): self._kw["layer_size"] = v; return self
+        def window_size(self, v): self._kw["window"] = v; return self
+        def learning_rate(self, v): self._kw["learning_rate"] = v; return self
+        def negative_sample(self, v): self._kw["negative"] = int(v); return self
+        def epochs(self, v): self._kw["epochs"] = v; return self
+        def batch_size(self, v): self._kw["batch_size"] = v; return self
+        def seed(self, v): self._kw["seed"] = v; return self
+        def bucket(self, v): self._kw["bucket"] = v; return self
+        def minn(self, v): self._kw["minn"] = v; return self
+        def maxn(self, v): self._kw["maxn"] = v; return self
+
+        def iterate(self, it):
+            self._iter = it
+            return self
+
+        def build(self) -> "FastText":
+            ft = FastText(**self._kw)
+            if self._iter is not None:
+                ft.set_sentence_iterator(self._iter)
+            return ft
+
+    @staticmethod
+    def builder() -> "FastText.Builder":
+        return FastText.Builder()
+
+    def __init__(self, *, bucket: int = 100_000, minn: int = 3,
+                 maxn: int = 6, **kw):
+        kw.setdefault("algorithm", "cbow")   # reuses the fused cbow round
+        super().__init__(**kw)
+        self.bucket = bucket
+        self.minn = minn
+        self.maxn = maxn
+        self._sentence_iter = None
+        self._subword_ids: Optional[np.ndarray] = None   # [V, G] padded
+        self._subword_mask: Optional[np.ndarray] = None  # [V, G]
+
+    # -- plumbing ---------------------------------------------------------
+    def set_sentence_iterator(self, it) -> None:
+        from .text import CollectionSentenceIterator
+
+        if isinstance(it, (list, tuple)):
+            it = CollectionSentenceIterator(it)
+        self._sentence_iter = it
+
+    def _token_stream(self):
+        from .text import DefaultTokenizerFactory
+
+        assert self._sentence_iter is not None, "no corpus"
+        self._sentence_iter.reset()
+        tok = DefaultTokenizerFactory()
+        for sentence in self._sentence_iter:
+            yield tok.create(sentence).get_tokens()
+
+    def subword_row_ids(self, word: str, in_vocab_index: int = -1
+                        ) -> List[int]:
+        """Table rows for a word: its own row (if in vocab) + hashed
+        n-gram rows living above the vocab block."""
+        V = len(self.vocab)
+        ids = [in_vocab_index] if in_vocab_index >= 0 else []
+        for g in char_ngrams(word, self.minn, self.maxn):
+            ids.append(V + fasttext_hash(g) % self.bucket)
+        return ids
+
+    def build_vocab(self, token_seqs) -> None:
+        self.vocab = VocabConstructor(self.min_word_frequency).build(
+            token_seqs)
+        V = len(self.vocab)
+        # syn0 covers vocab + n-gram buckets; syn1neg only needs the vocab
+        # block (targets are words) but shares the table shape for the
+        # fused round's donation contract
+        self.lookup_table = InMemoryLookupTable(
+            V + self.bucket, self.layer_size, seed=self.seed)
+        self.lookup_table.reset_weights(False, True)
+        sub = [self.subword_row_ids(w, i)
+               for i, w in enumerate(self.vocab.words())]
+        G = max(len(s) for s in sub) if sub else 1
+        self._subword_ids = np.zeros((V, G), np.int32)
+        self._subword_mask = np.zeros((V, G), np.float32)
+        for i, s in enumerate(sub):
+            self._subword_ids[i, :len(s)] = s
+            self._subword_mask[i, :len(s)] = 1.0
+
+    def fit(self) -> None:
+        if len(self.vocab) == 0 or self.lookup_table.syn0 is None:
+            self.build_vocab(self._token_stream())
+            if len(self.vocab) == 0:
+                raise ValueError("empty vocabulary after pruning")
+        corpus = self._encode_corpus(self._token_stream())
+
+        def stream(rng, keep):
+            # skip-gram pairs; the cbow-round "window" is the CENTER's
+            # subword set, the cbow-round "center" is the CONTEXT word
+            for ids in corpus:
+                pairs = self._sentence_pairs(ids, rng, keep)
+                if pairs is None:
+                    continue
+                centers, contexts = pairs
+                yield (ids.size, contexts,
+                       self._subword_ids[centers],
+                       self._subword_mask[centers])
+
+        self._train_encoded(corpus, stream_factory=stream)
+
+    # -- queries (subword composition) ------------------------------------
+    def get_word_vector(self, word: str) -> np.ndarray:
+        idx = self.vocab.index_of(word)
+        rows = self.subword_row_ids(word, idx)
+        if not rows:
+            raise KeyError(f"cannot build a vector for {word!r}")
+        syn0 = np.asarray(self.lookup_table.syn0)
+        return syn0[np.asarray(rows, np.int64)].mean(axis=0)
+
+    def get_word_vector_matrix(self) -> np.ndarray:
+        """Composed [V, D] export matrix (subword means) — overrides the
+        base's raw-syn0 export protocol."""
+        syn0 = np.asarray(self.lookup_table.syn0)
+        num = (syn0[self._subword_ids.reshape(-1)]
+               .reshape(*self._subword_ids.shape, -1)
+               * self._subword_mask[..., None]).sum(axis=1)
+        return num / np.maximum(self._subword_mask.sum(axis=1), 1.0)[:, None]
+
+    def words_nearest(self, word_or_vec, top_n: int = 10) -> List[str]:
+        if isinstance(word_or_vec, str):
+            vec = self.get_word_vector(word_or_vec)
+            exclude = {self.vocab.index_of(word_or_vec)}
+        else:
+            vec = np.asarray(word_or_vec, np.float32)
+            exclude = set()
+        mat = self.get_word_vector_matrix()
+        mat = mat / np.maximum(np.linalg.norm(mat, axis=1, keepdims=True),
+                               1e-12)
+        v = vec / max(np.linalg.norm(vec), 1e-12)
+        order = np.argsort(-(mat @ v))
+        out = []
+        for idx in order:
+            if int(idx) in exclude:
+                continue
+            out.append(self.vocab.word_for(int(idx)))
+            if len(out) == top_n:
+                break
+        return out

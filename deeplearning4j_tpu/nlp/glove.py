@@ -1,0 +1,276 @@
+"""GloVe: global-vectors training over a co-occurrence matrix.
+
+Reference: deeplearning4j-nlp ``models/glove/Glove`` +
+``AbstractCoOccurrences`` (SURVEY §2.3 NLP row) — co-occurrence counting
+with 1/distance weighting inside a symmetric window, then AdaGrad descent
+on the weighted least-squares objective
+
+    J = Σ_ij f(X_ij) (w_i·w̃_j + b_i + b̃_j − log X_ij)²,
+    f(x) = min(1, (x/x_max)^alpha).
+
+TPU-native structure (same split as Word2Vec's device-corpus path):
+
+- co-occurrence accumulation happens on the HOST, vectorized per sentence
+  chunk with one ``np.unique`` aggregation per chunk (the reference shuffles
+  this work across RoundRobin worker threads; one vectorized pass replaces
+  them);
+- the nonzero triplets upload ONCE, and training runs as a ``lax.scan`` of
+  fused batched rounds — gather rows → residual → AdaGrad scatter-update —
+  with all four parameter tables (w, w̃, b, b̃) and their AdaGrad
+  accumulators donated on device;
+- like the reference, the final word vector is ``w + w̃``.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .lookup_table import InMemoryLookupTable
+from .text import (CollectionSentenceIterator, DefaultTokenizerFactory,
+                   SentenceIterator, TokenizerFactory)
+from .vocab import VocabCache, VocabConstructor
+from .word2vec import WordVectors
+
+
+class Glove(WordVectors):
+    MAX_BLOCK_ROUNDS = 64
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+            self._iter = None
+            self._tok: TokenizerFactory = DefaultTokenizerFactory()
+
+        def min_word_frequency(self, v): self._kw["min_word_frequency"] = v; return self
+        def layer_size(self, v): self._kw["layer_size"] = v; return self
+        def window_size(self, v): self._kw["window"] = v; return self
+        def learning_rate(self, v): self._kw["learning_rate"] = v; return self
+        def epochs(self, v): self._kw["epochs"] = v; return self
+        def x_max(self, v): self._kw["x_max"] = v; return self
+        def alpha(self, v): self._kw["alpha"] = v; return self
+        def batch_size(self, v): self._kw["batch_size"] = v; return self
+        def seed(self, v): self._kw["seed"] = v; return self
+        def symmetric(self, v): self._kw["symmetric"] = v; return self
+        def shuffle(self, v): self._kw["shuffle"] = v; return self
+
+        def iterate(self, it):
+            if isinstance(it, (list, tuple)):
+                it = CollectionSentenceIterator(it)
+            self._iter = it
+            return self
+
+        def tokenizer_factory(self, tf):
+            self._tok = tf
+            return self
+
+        def build(self) -> "Glove":
+            g = Glove(**self._kw)
+            g._sentence_iter = self._iter
+            g._tokenizer = self._tok
+            return g
+
+    @staticmethod
+    def builder() -> "Glove.Builder":
+        return Glove.Builder()
+
+    def __init__(self, *, layer_size: int = 100, window: int = 15,
+                 learning_rate: float = 0.05, epochs: int = 5,
+                 x_max: float = 100.0, alpha: float = 0.75,
+                 min_word_frequency: int = 5, batch_size: int = 8192,
+                 seed: int = 42, symmetric: bool = True,
+                 shuffle: bool = True):
+        self.layer_size = layer_size
+        self.window = window
+        self.learning_rate = learning_rate
+        self.epochs = epochs
+        self.x_max = x_max
+        self.alpha = alpha
+        self.min_word_frequency = min_word_frequency
+        self.batch_size = batch_size
+        self.seed = seed
+        self.symmetric = symmetric
+        self.shuffle = shuffle
+        self._sentence_iter: Optional[SentenceIterator] = None
+        self._tokenizer: TokenizerFactory = DefaultTokenizerFactory()
+        self.words_per_sec = 0.0
+        self.last_loss = 0.0
+        super().__init__(VocabCache(), InMemoryLookupTable(0, layer_size))
+
+    # -- corpus plumbing (mirrors Word2Vec) -------------------------------
+    def set_sentence_iterator(self, it) -> None:
+        if isinstance(it, (list, tuple)):
+            it = CollectionSentenceIterator(it)
+        self._sentence_iter = it
+
+    def _token_stream(self):
+        assert self._sentence_iter is not None, "no corpus"
+        self._sentence_iter.reset()
+        for sentence in self._sentence_iter:
+            yield self._tokenizer.create(sentence).get_tokens()
+
+    def build_vocab(self, token_seqs) -> None:
+        self.vocab = VocabConstructor(self.min_word_frequency).build(
+            token_seqs)
+        self.lookup_table = InMemoryLookupTable(
+            len(self.vocab), self.layer_size, seed=self.seed)
+
+    # -- co-occurrence counting (host, vectorized) ------------------------
+    def co_occurrences(self, corpus: List[np.ndarray]):
+        """Aggregate weighted counts over the corpus. Returns
+        (rows, cols, counts) for the upper/whole matrix depending on
+        ``symmetric`` convention: the reference accumulates both (i,j) and
+        (j,i); we do the same so each row sees its full context."""
+        V = len(self.vocab)
+        W = self.window
+        offs = np.arange(1, W + 1)
+        weights = 1.0 / offs
+        acc = {}
+        CHUNK = 4096
+        keys_parts, vals_parts = [], []
+        for s0 in range(0, len(corpus), CHUNK):
+            chunk = corpus[s0:s0 + CHUNK]
+            kk, vv = [], []
+            for ids in chunk:
+                n = ids.size
+                if n < 2:
+                    continue
+                for d, wgt in zip(offs, weights):
+                    if d >= n:
+                        break
+                    a, b = ids[:-d].astype(np.int64), ids[d:].astype(np.int64)
+                    kk.append(a * V + b)
+                    vv.append(np.full(a.size, wgt, np.float64))
+                    kk.append(b * V + a)
+                    vv.append(np.full(a.size, wgt, np.float64))
+            if not kk:
+                continue
+            keys = np.concatenate(kk)
+            vals = np.concatenate(vv)
+            uk, inv = np.unique(keys, return_inverse=True)
+            sums = np.zeros(uk.size, np.float64)
+            np.add.at(sums, inv, vals)
+            keys_parts.append(uk)
+            vals_parts.append(sums)
+        if not keys_parts:
+            return (np.empty(0, np.int32),) * 2 + (np.empty(0, np.float32),)
+        keys = np.concatenate(keys_parts)
+        vals = np.concatenate(vals_parts)
+        uk, inv = np.unique(keys, return_inverse=True)
+        sums = np.zeros(uk.size, np.float64)
+        np.add.at(sums, inv, vals)
+        return ((uk // V).astype(np.int32), (uk % V).astype(np.int32),
+                sums.astype(np.float32))
+
+    # -- device training --------------------------------------------------
+    def _make_block(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        lr = float(self.learning_rate)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+        def block(w, wc, b, bc, gw, gwc, gb, gbc, cols):
+            def body(carry, inp):
+                w, wc, b, bc, gw, gwc, gb, gbc = carry
+                i, j, logx, fw, pm = inp
+                wi = w[i]
+                wj = wc[j]
+                diff = (jnp.einsum("bd,bd->b", wi, wj) + b[i] + bc[j]
+                        - logx)                          # [B]
+                fdiff = fw * diff * pm
+                loss = 0.5 * (fdiff * diff).sum()
+                # AdaGrad (reference: Glove uses AdaGrad with lr 0.05)
+                g_wi = fdiff[:, None] * wj
+                g_wj = fdiff[:, None] * wi
+                gw = gw.at[i].add(g_wi * g_wi)
+                gwc = gwc.at[j].add(g_wj * g_wj)
+                gb = gb.at[i].add(fdiff * fdiff)
+                gbc = gbc.at[j].add(fdiff * fdiff)
+                w = w.at[i].add(-lr * g_wi / jnp.sqrt(gw[i] + 1e-8))
+                wc = wc.at[j].add(-lr * g_wj / jnp.sqrt(gwc[j] + 1e-8))
+                b = b.at[i].add(-lr * fdiff / jnp.sqrt(gb[i] + 1e-8))
+                bc = bc.at[j].add(-lr * fdiff / jnp.sqrt(gbc[j] + 1e-8))
+                return (w, wc, b, bc, gw, gwc, gb, gbc), loss
+            carry, losses = lax.scan(
+                body, (w, wc, b, bc, gw, gwc, gb, gbc), cols)
+            return carry + (losses.mean(),)
+
+        return block
+
+    def fit(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        if len(self.vocab) == 0:
+            self.build_vocab(self._token_stream())
+            if len(self.vocab) == 0:
+                raise ValueError("empty vocabulary after pruning")
+        corpus = []
+        for tokens in self._token_stream():
+            ids = [self.vocab.index_of(t) for t in tokens]
+            ids = np.asarray([i for i in ids if i >= 0], dtype=np.int32)
+            if ids.size:
+                corpus.append(ids)
+        total_words = sum(c.size for c in corpus)
+
+        rows, cols_, counts = self.co_occurrences(corpus)
+        nnz = rows.size
+        if nnz == 0:
+            raise ValueError("no co-occurrences — corpus too small")
+        logx = np.log(np.maximum(counts, 1e-12)).astype(np.float32)
+        fw = np.minimum(1.0, (counts / self.x_max) ** self.alpha) \
+            .astype(np.float32)
+
+        V, D, B = len(self.vocab), self.layer_size, self.batch_size
+        rng = np.random.default_rng(self.seed)
+        w = jnp.asarray(((rng.random((V, D)) - 0.5) / D).astype(np.float32))
+        wc = jnp.asarray(((rng.random((V, D)) - 0.5) / D).astype(np.float32))
+        b = jnp.zeros((V,), jnp.float32)
+        bc = jnp.zeros((V,), jnp.float32)
+        gw = jnp.full((V, D), 1e-8, jnp.float32)
+        gwc = jnp.full((V, D), 1e-8, jnp.float32)
+        gb = jnp.full((V,), 1e-8, jnp.float32)
+        gbc = jnp.full((V,), 1e-8, jnp.float32)
+
+        block = self._make_block()
+        span = B * self.MAX_BLOCK_ROUNDS
+        t0 = time.perf_counter()
+        losses = []
+        for _ep in range(self.epochs):
+            order = rng.permutation(nnz) if self.shuffle else np.arange(nnz)
+            pad = (-nnz) % span
+            # filler indices are masked out by pm; np.resize cycles when
+            # pad > nnz (tiny co-occurrence sets)
+            idx = (np.concatenate([order, np.resize(order, pad)])
+                   if pad else order)
+            pm_full = np.ones(idx.size, np.float32)
+            if pad:
+                pm_full[nnz:] = 0.0
+            R_total = idx.size // B
+            i3 = rows[idx].reshape(R_total, B)
+            j3 = cols_[idx].reshape(R_total, B)
+            lx3 = logx[idx].reshape(R_total, B)
+            fw3 = fw[idx].reshape(R_total, B)
+            pm3 = pm_full.reshape(R_total, B)
+            for r0 in range(0, R_total, self.MAX_BLOCK_ROUNDS):
+                sl = slice(r0, r0 + self.MAX_BLOCK_ROUNDS)
+                w, wc, b, bc, gw, gwc, gb, gbc, loss = block(
+                    w, wc, b, bc, gw, gwc, gb, gbc,
+                    (i3[sl], j3[sl], lx3[sl], fw3[sl], pm3[sl]))
+                losses.append(loss)
+        last = np.asarray(jnp.stack(losses[-20:])) if losses else \
+            np.zeros(1, np.float32)
+        dt = time.perf_counter() - t0
+        self.words_per_sec = total_words * self.epochs / max(dt, 1e-9)
+        self.last_loss = float(last.mean())
+        # reference convention: final vectors are w + w̃
+        self.lookup_table.syn0 = np.asarray(w) + np.asarray(wc)
+        self._w = np.asarray(w)
+        self._wc = np.asarray(wc)
+        self._bias = np.asarray(b)
+        self._bias_c = np.asarray(bc)

@@ -1,0 +1,156 @@
+"""DeepWalk / Node2Vec: vertex embeddings from random walks.
+
+Reference: deeplearning4j-graph ``models/deepwalk/DeepWalk`` +
+``iterator/RandomWalkIterator`` (SURVEY §2.3 NLP row). The construction is
+walks-as-sentences: sample random walks over the graph, then train the
+skip-gram engine on them — which here means the walks feed straight into
+the TPU device-corpus Word2Vec path. Node2Vec generalizes the walk
+distribution with the (p, q) second-order bias (Grover & Leskovec); p=q=1
+reduces to DeepWalk's uniform walks.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .word2vec import Word2Vec
+
+
+class Graph:
+    """Adjacency-list graph (reference: org.deeplearning4j.graph.graph.Graph)."""
+
+    def __init__(self, n_vertices: int, directed: bool = False):
+        self.n = n_vertices
+        self.directed = directed
+        self._adj: List[List[int]] = [[] for _ in range(n_vertices)]
+
+    def add_edge(self, a: int, b: int) -> None:
+        self._adj[a].append(b)
+        if not self.directed:
+            self._adj[b].append(a)
+
+    def neighbors(self, v: int) -> List[int]:
+        return self._adj[v]
+
+    def num_vertices(self) -> int:
+        return self.n
+
+
+def random_walks(graph: Graph, num_walks: int, walk_length: int,
+                 seed: int = 42, p: float = 1.0, q: float = 1.0
+                 ) -> List[List[int]]:
+    """``num_walks`` walks from every vertex. p/q are node2vec's return /
+    in-out parameters; transition weight to x from (prev t, cur v):
+    1/p if x == t, 1 if x adjacent to t, 1/q otherwise."""
+    rng = np.random.default_rng(seed)
+    walks = []
+    biased = not (p == 1.0 and q == 1.0)
+    adj_sets = [set(a) for a in graph._adj] if biased else None
+    for _ in range(num_walks):
+        for start in range(graph.num_vertices()):
+            if not graph.neighbors(start):
+                continue
+            walk = [start]
+            while len(walk) < walk_length:
+                cur = walk[-1]
+                nbrs = graph.neighbors(cur)
+                if not nbrs:
+                    break
+                if len(walk) == 1 or not biased:
+                    nxt = nbrs[rng.integers(len(nbrs))]
+                else:
+                    prev = walk[-2]
+                    w = np.asarray(
+                        [1.0 / p if x == prev
+                         else (1.0 if x in adj_sets[prev] else 1.0 / q)
+                         for x in nbrs])
+                    w /= w.sum()
+                    nxt = nbrs[rng.choice(len(nbrs), p=w)]
+                walk.append(int(nxt))
+            walks.append(walk)
+    return walks
+
+
+class DeepWalk:
+    """reference: DeepWalk.Builder().windowSize(..).vectorSize(..).build()
+    then fit over a walk iterator — here ``fit(graph)`` samples the walks
+    and trains in one call."""
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def window_size(self, v): self._kw["window_size"] = v; return self
+        def vector_size(self, v): self._kw["vector_size"] = v; return self
+        def walk_length(self, v): self._kw["walk_length"] = v; return self
+        def num_walks(self, v): self._kw["num_walks"] = v; return self
+        def learning_rate(self, v): self._kw["learning_rate"] = v; return self
+        def epochs(self, v): self._kw["epochs"] = v; return self
+        def negative_sample(self, v): self._kw["negative"] = int(v); return self
+        def seed(self, v): self._kw["seed"] = v; return self
+
+        def build(self) -> "DeepWalk":
+            return DeepWalk(**self._kw)
+
+    @staticmethod
+    def builder() -> "DeepWalk.Builder":
+        return DeepWalk.Builder()
+
+    # node2vec parameters; DeepWalk keeps the uniform walk
+    p = 1.0
+    q = 1.0
+
+    def __init__(self, window_size: int = 5, vector_size: int = 64,
+                 walk_length: int = 40, num_walks: int = 10,
+                 learning_rate: float = 0.025, epochs: int = 1,
+                 negative: int = 5, seed: int = 42):
+        self.window_size = window_size
+        self.vector_size = vector_size
+        self.walk_length = walk_length
+        self.num_walks = num_walks
+        self.learning_rate = learning_rate
+        self.epochs = epochs
+        self.negative = negative
+        self.seed = seed
+        self._w2v: Optional[Word2Vec] = None
+
+    def fit(self, graph: Graph) -> "DeepWalk":
+        walks = random_walks(graph, self.num_walks, self.walk_length,
+                             seed=self.seed, p=self.p, q=self.q)
+        sentences = [" ".join(str(v) for v in walk) for walk in walks]
+        w2v = Word2Vec(min_word_frequency=1, layer_size=self.vector_size,
+                       window=self.window_size, negative=self.negative,
+                       learning_rate=self.learning_rate, epochs=self.epochs,
+                       batch_size=1024, seed=self.seed)
+        w2v.set_sentence_iterator(sentences)
+        w2v.fit()
+        self._w2v = w2v
+        return self
+
+    # -- queries ----------------------------------------------------------
+    def get_vertex_vector(self, v: int) -> np.ndarray:
+        assert self._w2v is not None, "call fit(graph) first"
+        return self._w2v.get_word_vector(str(v))
+
+    def similarity(self, a: int, b: int) -> float:
+        assert self._w2v is not None, "call fit(graph) first"
+        return self._w2v.similarity(str(a), str(b))
+
+    def verticies_nearest(self, v: int, top_n: int = 10) -> List[int]:
+        assert self._w2v is not None, "call fit(graph) first"
+        return [int(w) for w in self._w2v.words_nearest(str(v), top_n)]
+
+    vertices_nearest = verticies_nearest
+
+
+class Node2Vec(DeepWalk):
+    """Grover & Leskovec's biased-walk generalization; the reference repo
+    carries DeepWalk only — node2vec is the standard successor with the
+    identical training half, so it rides the same engine."""
+
+    def __init__(self, *args, p: float = 1.0, q: float = 1.0, **kw):
+        super().__init__(*args, **kw)
+        self.p = p
+        self.q = q

@@ -38,7 +38,9 @@ _FORMAT_VERSION = 1
 
 def write_word_vectors(model: WordVectors, path: PathLike,
                        binary: bool = False, header: bool = True) -> None:
-    syn0 = np.asarray(model.lookup_table.syn0, dtype=np.float32)
+    # get_word_vector_matrix is the export protocol: composed models
+    # (FastText subword means) override it; the base returns raw syn0
+    syn0 = np.asarray(model.get_word_vector_matrix(), dtype=np.float32)
     words = model.vocab.words()
     if binary:
         with open(path, "wb") as f:

@@ -1,13 +1,26 @@
 """Reinforcement learning (reference: rl4j, SURVEY §2.3 row 26).
 
-- ``mdp``  MDP SPI + CartPole / GridWorld environments
-- ``dqn``  QLearningDiscreteDense, ExpReplay, EpsGreedy, DQNPolicy
+- ``mdp``             MDP SPI + CartPole / GridWorld environments
+- ``dqn``             QLearningDiscreteDense, ExpReplay, EpsGreedy, DQNPolicy
+- ``networks``        SameDiffQNetwork (+dueling), ActorCriticNetwork
+- ``async_learning``  A3CDiscreteDense, AsyncNStepQLearningDiscreteDense,
+                      ACPolicy
+- ``history``         HistoryProcessor (crop/rescale/skip/stack)
 """
 
+from .async_learning import (A3CConfiguration, A3CDiscreteDense, ACPolicy,
+                             AsyncNStepQLearningDiscreteDense,
+                             AsyncQLConfiguration)
 from .dqn import (DQNPolicy, EpsGreedy, ExpReplay, QLConfiguration,
                   QLearningDiscreteDense)
+from .history import HistoryProcessor, HistoryProcessorConfiguration
 from .mdp import MDP, CartPole, DiscreteSpace, GridWorld, ObservationSpace
+from .networks import (ActorCriticNetwork, DuelingQNetwork, SameDiffQNetwork)
 
-__all__ = ["CartPole", "DQNPolicy", "DiscreteSpace", "EpsGreedy",
-           "ExpReplay", "GridWorld", "MDP", "ObservationSpace",
-           "QLConfiguration", "QLearningDiscreteDense"]
+__all__ = ["A3CConfiguration", "A3CDiscreteDense", "ACPolicy",
+           "ActorCriticNetwork", "AsyncNStepQLearningDiscreteDense",
+           "AsyncQLConfiguration", "CartPole", "DQNPolicy", "DiscreteSpace",
+           "DuelingQNetwork", "EpsGreedy", "ExpReplay", "GridWorld",
+           "HistoryProcessor", "HistoryProcessorConfiguration", "MDP",
+           "ObservationSpace", "QLConfiguration", "QLearningDiscreteDense",
+           "SameDiffQNetwork"]

@@ -1,0 +1,190 @@
+"""GloVe / FastText / DeepWalk-Node2Vec convergence + behavior tests
+(round-3 verdict item 9: the NLP family beyond Word2Vec/ParagraphVectors).
+Reference: deeplearning4j-nlp glove/fasttext + deeplearning4j-graph
+DeepWalk (SURVEY §2.3)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (DeepWalk, FastText, Glove, Graph,
+                                    Node2Vec, char_ngrams, fasttext_hash,
+                                    random_walks)
+
+
+def _cluster_corpus(n=1200, vocab_half=20, seed=0):
+    """Two disjoint topic clusters; same shape the Word2Vec tests use."""
+    rng = np.random.default_rng(seed)
+    sents = []
+    for i in range(n):
+        c = "a" if i % 2 == 0 else "b"
+        sents.append(" ".join(
+            f"{c}{j}" for j in rng.integers(0, vocab_half, 12)))
+    return sents
+
+
+def _mean_sim(m, pairs):
+    return float(np.mean([m.similarity(x, y) for x, y in pairs]))
+
+
+class TestGlove:
+    def test_co_occurrences_weighting(self):
+        g = Glove(min_word_frequency=1, window=2)
+        g.set_sentence_iterator(["x y z"])
+        g.build_vocab(g._token_stream())
+        xi, yi, zi = (g.vocab.index_of(w) for w in ("x", "y", "z"))
+        corpus = [np.asarray([xi, yi, zi], np.int32)]
+        rows, cols, counts = g.co_occurrences(corpus)
+        m = {(int(r), int(c)): float(v)
+             for r, c, v in zip(rows, cols, counts)}
+        # adjacent pairs weight 1, distance-2 weight 1/2, symmetric
+        assert m[(xi, yi)] == pytest.approx(1.0)
+        assert m[(yi, xi)] == pytest.approx(1.0)
+        assert m[(xi, zi)] == pytest.approx(0.5)
+        assert m[(zi, xi)] == pytest.approx(0.5)
+
+    def test_learns_cluster_structure(self):
+        g = (Glove.builder().min_word_frequency(3).layer_size(24)
+             .window_size(8).epochs(30).learning_rate(0.05)
+             .batch_size(1024).seed(1)
+             .iterate(_cluster_corpus()).build())
+        g.fit()
+        same = _mean_sim(g, [("a0", f"a{i}") for i in range(1, 6)])
+        diff = _mean_sim(g, [("a0", f"b{i}") for i in range(5)])
+        assert same > diff + 0.3, (same, diff)
+        assert np.isfinite(g.last_loss)
+
+    def test_loss_decreases(self):
+        sents = _cluster_corpus(400)
+        g1 = (Glove.builder().min_word_frequency(2).layer_size(16)
+              .epochs(1).seed(3).batch_size(512).iterate(sents).build())
+        g1.fit()
+        g30 = (Glove.builder().min_word_frequency(2).layer_size(16)
+               .epochs(30).seed(3).batch_size(512).iterate(sents).build())
+        g30.fit()
+        assert g30.last_loss < g1.last_loss * 0.8, (g1.last_loss,
+                                                    g30.last_loss)
+
+
+class TestFastText:
+    def test_hash_matches_fasttext_reference_values(self):
+        # FNV-1a 32-bit: well-known test vectors
+        assert fasttext_hash("") == 2166136261
+        assert fasttext_hash("a") == 0xe40c292c
+        assert fasttext_hash("ab") == 0x4d2505ca
+
+    def test_char_ngrams(self):
+        grams = char_ngrams("cat", 3, 4)
+        assert "<ca" in grams and "at>" in grams and "cat" in grams
+        assert "<cat" in grams and "cat>" in grams
+        assert all(3 <= len(g) <= 4 for g in grams)
+
+    def test_learns_cluster_structure(self):
+        ft = (FastText.builder().min_word_frequency(3).layer_size(24)
+              .epochs(4).negative_sample(5).batch_size(512).seed(2)
+              .bucket(4096).iterate(_cluster_corpus()).build())
+        ft.fit()
+        same = _mean_sim(ft, [("a0", f"a{i}") for i in range(1, 6)])
+        diff = _mean_sim(ft, [("a0", f"b{i}") for i in range(5)])
+        assert same > diff + 0.2, (same, diff)
+
+    def test_oov_vector_from_subwords(self):
+        ft = (FastText.builder().min_word_frequency(3).layer_size(16)
+              .epochs(2).negative_sample(3).batch_size(512).seed(2)
+              .bucket(4096).iterate(_cluster_corpus(400)).build())
+        ft.fit()
+        # "a0a1" shares n-grams with cluster-a words; never in the corpus
+        v = ft.get_word_vector("a0a1")
+        assert v.shape == (16,)
+        assert np.isfinite(v).all() and np.abs(v).sum() > 0
+
+    def test_oov_lands_near_its_subword_cluster(self):
+        ft = (FastText.builder().min_word_frequency(3).layer_size(24)
+              .epochs(4).negative_sample(5).batch_size(512).seed(2)
+              .bucket(4096).iterate(_cluster_corpus()).build())
+        ft.fit()
+        # an unseen surface form made of cluster-a material
+        sim_a = np.mean([ft.similarity("a00", f"a{i}") for i in range(5)])
+        sim_b = np.mean([ft.similarity("a00", f"b{i}") for i in range(5)])
+        assert sim_a > sim_b, (sim_a, sim_b)
+
+
+def _two_communities(k=8, bridge=1):
+    """Two cliques of k vertices joined by `bridge` edges."""
+    g = Graph(2 * k)
+    for base in (0, k):
+        for i in range(k):
+            for j in range(i + 1, k):
+                g.add_edge(base + i, base + j)
+    for b in range(bridge):
+        g.add_edge(b, k + b)
+    return g
+
+
+class TestDeepWalk:
+    def test_random_walks_stay_on_graph(self):
+        g = _two_communities()
+        walks = random_walks(g, num_walks=2, walk_length=10, seed=0)
+        assert len(walks) == 2 * g.num_vertices()
+        for w in walks:
+            for a, b in zip(w, w[1:]):
+                assert b in g.neighbors(a), (a, b)
+
+    def test_communities_separate(self):
+        g = _two_communities()
+        dw = (DeepWalk.builder().window_size(4).vector_size(16)
+              .walk_length(30).num_walks(12).epochs(3).seed(1).build())
+        dw.fit(g)
+        same = np.mean([dw.similarity(1, j) for j in range(2, 6)])
+        diff = np.mean([dw.similarity(1, 8 + j) for j in range(2, 6)])
+        assert same > diff + 0.3, (same, diff)
+        near = dw.vertices_nearest(1, 5)
+        assert sum(v < 8 for v in near) >= 4, near
+
+    def test_node2vec_biased_walks_differ_and_learn(self):
+        g = _two_communities()
+        n2v = Node2Vec(window_size=4, vector_size=16, walk_length=30,
+                       num_walks=12, epochs=3, seed=1, p=0.5, q=2.0)
+        n2v.fit(g)
+        same = np.mean([n2v.similarity(1, j) for j in range(2, 6)])
+        diff = np.mean([n2v.similarity(1, 8 + j) for j in range(2, 6)])
+        assert same > diff + 0.3, (same, diff)
+        # q>1 biases walks toward staying local (BFS-like): the walk sets
+        # must actually differ from uniform DeepWalk walks
+        uni = random_walks(g, 2, 12, seed=7)
+        bia = random_walks(g, 2, 12, seed=7, p=0.5, q=2.0)
+        assert uni != bia
+
+
+class TestSerializerCompat:
+    def test_glove_vectors_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.nlp import read_word_vectors, \
+            write_word_vectors
+
+        g = (Glove.builder().min_word_frequency(2).layer_size(12)
+             .epochs(3).seed(4).batch_size(512)
+             .iterate(_cluster_corpus(300)).build())
+        g.fit()
+        p = str(tmp_path / "glove.txt")
+        write_word_vectors(g, p, binary=False)
+        r = read_word_vectors(p, binary=False)
+        for w in ("a0", "b3"):
+            np.testing.assert_allclose(r.get_word_vector(w),
+                                       g.get_word_vector(w), atol=1e-4)
+
+    def test_fasttext_composed_vectors_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.nlp import read_word_vectors, \
+            write_word_vectors
+
+        ft = (FastText.builder().min_word_frequency(2).layer_size(12)
+              .epochs(1).negative_sample(3).batch_size(256).seed(4)
+              .bucket(2048).iterate(_cluster_corpus(300)).build())
+        ft.fit()
+        p = str(tmp_path / "ft.bin")
+        write_word_vectors(ft, p, binary=True)
+        r = read_word_vectors(p, binary=True)
+        # the exported vector is the COMPOSED subword mean, not a table row
+        for w in ("a0", "b3"):
+            np.testing.assert_allclose(r.get_word_vector(w),
+                                       ft.get_word_vector(w), atol=1e-5)

@@ -1,0 +1,163 @@
+"""Async RL family + dueling DQN + HistoryProcessor tests (round-3 verdict
+item 9: the rl4j async half). Reference: rl4j ``async`` package,
+``HistoryProcessor`` (SURVEY §2.3)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.rl import (A3CConfiguration, A3CDiscreteDense,
+                                   ACPolicy, ActorCriticNetwork,
+                                   AsyncNStepQLearningDiscreteDense,
+                                   AsyncQLConfiguration, DuelingQNetwork,
+                                   GridWorld, HistoryProcessor,
+                                   HistoryProcessorConfiguration,
+                                   QLConfiguration, QLearningDiscreteDense,
+                                   SameDiffQNetwork)
+
+
+def _gridworld_factory(seed=0):
+    return lambda: GridWorld(size=6)
+
+
+class TestDuelingDQN:
+    def test_dueling_head_structure(self):
+        net = DuelingQNetwork(4, 3, hidden=(16,), seed=0)
+        q = net.output(np.zeros((2, 4), np.float32)).to_numpy()
+        assert q.shape == (2, 3)
+        # dueling decomposition: mean-centered advantages mean the Q spread
+        # comes from the A head; V shifts all actions equally. Check the
+        # graph has both heads.
+        names = set(net.sd._vars)
+        assert "value_w" in names and "adv_w" in names
+
+    def test_dueling_converges_on_gridworld(self):
+        mdp = GridWorld(size=6)
+        obs_dim = int(np.prod(mdp.observation_space.shape))
+        net = DuelingQNetwork(obs_dim, mdp.action_space.n, hidden=(32,),
+                              lr=5e-3, seed=1)
+        conf = QLConfiguration(seed=1, max_step=2500, max_epoch_step=40,
+                               batch_size=32, target_dqn_update_freq=100,
+                               update_start=50, epsilon_nb_step=1200,
+                               min_epsilon=0.05, double_dqn=True)
+        learner = QLearningDiscreteDense(mdp, net, conf)
+        learner.train()
+        reward = learner.get_policy().play(GridWorld(size=6), max_steps=40)
+        assert reward > 0.5, reward
+
+
+class TestA3C:
+    def test_converges_on_gridworld(self):
+        # single worker for the convergence ASSERTION (deterministic);
+        # the 2-worker path is smoke-tested below
+        mdp0 = GridWorld(size=6)
+        obs_dim = int(np.prod(mdp0.observation_space.shape))
+        net = ActorCriticNetwork(obs_dim, mdp0.action_space.n,
+                                 hidden=(32,), lr=6e-3, seed=2)
+        conf = A3CConfiguration(seed=2, max_step=6000, max_epoch_step=40,
+                                num_threads=1, nstep=8)
+        a3c = A3CDiscreteDense(_gridworld_factory(), net, conf)
+        rewards = a3c.train()
+        assert len(rewards) > 5
+        policy = a3c.get_policy()
+        plays = [policy.play(GridWorld(size=6), max_steps=40)
+                 for _ in range(5)]
+        assert np.mean(plays) > 0.5, plays
+
+    def test_two_workers_train_concurrently(self):
+        mdp0 = GridWorld(size=6)
+        obs_dim = int(np.prod(mdp0.observation_space.shape))
+        net = ActorCriticNetwork(obs_dim, mdp0.action_space.n,
+                                 hidden=(16,), lr=5e-3, seed=5)
+        conf = A3CConfiguration(seed=5, max_step=800, max_epoch_step=40,
+                                num_threads=2, nstep=8)
+        a3c = A3CDiscreteDense(_gridworld_factory(), net, conf)
+        rewards = a3c.train()
+        assert a3c.step_count >= 800
+        assert len(rewards) >= 2
+        logits, value = net.policy_and_value(
+            np.zeros((1, obs_dim), np.float32))
+        assert np.isfinite(logits).all() and np.isfinite(value).all()
+
+    def test_ac_policy_samples_and_greedy(self):
+        net = ActorCriticNetwork(4, 3, hidden=(8,), seed=0)
+        stochastic = ACPolicy(net, np.random.default_rng(0))
+        greedy = ACPolicy(net, greedy=True)
+        obs = np.zeros(4, np.float32)
+        acts = {stochastic.next_action(obs) for _ in range(30)}
+        assert len(acts) >= 2, "stochastic policy never explored"
+        g = {greedy.next_action(obs) for _ in range(5)}
+        assert len(g) == 1, "greedy policy must be deterministic"
+
+
+class TestAsyncNStepQ:
+    def test_converges_on_gridworld(self):
+        # single worker for the convergence ASSERTION: thread scheduling
+        # makes multi-worker runs nondeterministic despite fixed seeds
+        mdp0 = GridWorld(size=6)
+        obs_dim = int(np.prod(mdp0.observation_space.shape))
+        net = SameDiffQNetwork(obs_dim, mdp0.action_space.n, hidden=(32,),
+                               lr=8e-3, seed=3)
+        conf = AsyncQLConfiguration(seed=3, max_step=8000,
+                                    max_epoch_step=40, num_threads=1,
+                                    nstep=5, target_dqn_update_freq=50,
+                                    epsilon_nb_step=3000, min_epsilon=0.05)
+        learner = AsyncNStepQLearningDiscreteDense(_gridworld_factory(),
+                                                   net, conf)
+        learner.train()
+        reward = learner.get_policy().play(GridWorld(size=6), max_steps=40)
+        assert reward > 0.5, reward
+
+    def test_two_workers_train_concurrently(self):
+        # multi-worker smoke: both threads contribute steps/episodes and
+        # the shared net stays finite (no convergence assertion — async
+        # interleaving is nondeterministic by design)
+        mdp0 = GridWorld(size=6)
+        obs_dim = int(np.prod(mdp0.observation_space.shape))
+        net = SameDiffQNetwork(obs_dim, mdp0.action_space.n, hidden=(16,),
+                               lr=5e-3, seed=4)
+        conf = AsyncQLConfiguration(seed=4, max_step=800,
+                                    max_epoch_step=40, num_threads=2,
+                                    nstep=5, target_dqn_update_freq=20)
+        learner = AsyncNStepQLearningDiscreteDense(_gridworld_factory(),
+                                                   net, conf)
+        rewards = learner.train()
+        assert learner.step_count >= 800
+        assert len(rewards) >= 2
+        q = net.output(np.zeros((1, obs_dim), np.float32)).to_numpy()
+        assert np.isfinite(q).all()
+
+
+class TestHistoryProcessor:
+    def test_stacking_and_initial_fill(self):
+        hp = HistoryProcessor(HistoryProcessorConfiguration(
+            history_length=3))
+        hp.start_episode(np.asarray([1.0, 2.0]))
+        assert hp.is_ready()
+        h = hp.get_history()
+        np.testing.assert_array_equal(h, np.tile([1.0, 2.0], (3, 1)))
+        hp.add(np.asarray([3.0, 4.0]))
+        h = hp.get_history()
+        np.testing.assert_array_equal(h[-1], [3.0, 4.0])
+        np.testing.assert_array_equal(h[0], [1.0, 2.0])
+        assert hp.flat_history().shape == (6,)
+
+    def test_skip_frame(self):
+        hp = HistoryProcessor(HistoryProcessorConfiguration(
+            history_length=2, skip_frame=3))
+        taken = [hp.record(np.asarray([float(i)])) for i in range(7)]
+        assert taken == [True, False, False, True, False, False, True]
+        np.testing.assert_array_equal(hp.get_history(),
+                                      [[3.0], [6.0]])
+
+    def test_crop_and_rescale(self):
+        conf = HistoryProcessorConfiguration(
+            history_length=1, crop_top=2, crop_bottom=2, crop_left=4,
+            crop_right=4, rescaled_width=4, rescaled_height=4)
+        hp = HistoryProcessor(conf)
+        frame = np.arange(20 * 16, dtype=np.float32).reshape(20, 16)
+        out = hp.preprocess(frame)
+        assert out.shape == (4, 4)
+        # cropped region is rows 2:18, cols 4:12; corners map to its corners
+        assert out[0, 0] == frame[2, 4]
