@@ -356,6 +356,10 @@ class EmnistDataSetIterator(DataSetIterator):
             if dataset == "letters":     # letters labels are 1-based
                 labels = labels - 1
             images = images.reshape(len(images), 1, 28, 28)
+            # EMNIST idx files store each image TRANSPOSED relative to
+            # MNIST orientation (the reference fetcher and torchvision
+            # both transpose on read)
+            images = images.transpose(0, 1, 3, 2)
         else:
             self.synthetic = True
             n = min(n, 6000 if train else 1000)

@@ -704,3 +704,224 @@ class InceptionResNetV1(ZooModel):
                     self.image_size, self.image_size, 3))
                 .build())
         return ComputationGraph(conf).init()
+
+
+class FaceNetNN4Small2(ZooModel):
+    """reference zoo.model.FaceNetNN4Small2: the OpenFace nn4.small2
+    inception variant — stem convs + inception modules (1x1/3x3/5x5 +
+    pooling-projection branches merged channel-wise), a 128-d embedding
+    bottleneck, L2 normalization, and a center-loss softmax head
+    (reference: FaceNetHelper.appendGraph + CenterLossOutputLayer)."""
+
+    def __init__(self, num_classes: int = 100, embedding_size: int = 128,
+                 seed: int = 123, image_size: int = 96):
+        self.num_classes = num_classes
+        self.embedding_size = embedding_size
+        self.seed = seed
+        self.image_size = image_size
+
+    def init(self) -> ComputationGraph:
+        from ..nn.conf.layers_ext import CenterLossOutputLayer
+        from ..nn.graph import L2NormalizeVertex
+
+        gb = (ComputationGraphConfiguration
+              .graph_builder(NeuralNetConfiguration.builder()
+                             .seed(self.seed).updater(Adam(1e-3))
+                             .activation("relu").weight_init("relu"))
+              .add_inputs("input"))
+        n = [0]
+
+        def conv_bn(inp, ch, k, stride=1, pad=None):
+            i = n[0]
+            n[0] += 1
+            pad = pad if pad is not None else k // 2
+            gb.add_layer(f"c{i}", L.ConvolutionLayer(
+                n_out=ch, kernel_size=(k, k), stride=(stride, stride),
+                padding=(pad, pad), has_bias=False,
+                activation="identity"), inp)
+            gb.add_layer(f"b{i}", L.BatchNormalization(activation="relu"),
+                         f"c{i}")
+            return f"b{i}"
+
+        def inception(name, inp, b1x1, b3r, b3, b5r, b5, pool_proj):
+            """Four branches: 1x1 | 1x1→3x3 | 1x1→5x5 | pool→1x1;
+            a zero channel count drops that branch (nn4.small2 trims
+            branches in the later modules)."""
+            outs = []
+            if b1x1:
+                outs.append(conv_bn(inp, b1x1, 1))
+            if b3:
+                r = conv_bn(inp, b3r, 1)
+                outs.append(conv_bn(r, b3, 3))
+            if b5:
+                r = conv_bn(inp, b5r, 1)
+                outs.append(conv_bn(r, b5, 5))
+            if pool_proj:
+                gb.add_layer(f"{name}_pool", L.SubsamplingLayer(
+                    kernel_size=(3, 3), stride=(1, 1), padding=(1, 1)), inp)
+                outs.append(conv_bn(f"{name}_pool", pool_proj, 1))
+            gb.add_vertex(f"{name}_cat", MergeVertex(), *outs)
+            return f"{name}_cat"
+
+        # stem (96 -> 24 -> 12)
+        prev = conv_bn("input", 64, 7, 2, 3)
+        gb.add_layer("stem_pool", L.SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2), padding=(1, 1)), prev)
+        prev = conv_bn("stem_pool", 64, 1)
+        prev = conv_bn(prev, 192, 3)
+        gb.add_layer("stem_pool2", L.SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2), padding=(1, 1)), prev)
+        prev = "stem_pool2"
+        # inception stack (nn4.small2 module shapes)
+        prev = inception("i3a", prev, 64, 96, 128, 16, 32, 32)
+        prev = inception("i3b", prev, 64, 96, 128, 32, 64, 64)
+        gb.add_layer("pool3", L.SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2), padding=(1, 1)), prev)
+        prev = inception("i4a", "pool3", 256, 96, 192, 32, 64, 128)
+        prev = inception("i4e", prev, 0, 160, 256, 64, 128, 0)
+        gb.add_layer("pool4", L.SubsamplingLayer(
+            kernel_size=(3, 3), stride=(2, 2), padding=(1, 1)), prev)
+        prev = inception("i5a", "pool4", 256, 96, 384, 0, 0, 96)
+        prev = inception("i5b", prev, 256, 96, 384, 0, 0, 96)
+        gb.add_layer("gap", L.GlobalPoolingLayer(pooling_type="avg"), prev)
+        gb.add_layer("bottleneck", L.DenseLayer(
+            n_out=self.embedding_size, activation="identity"), "gap")
+        gb.add_vertex("embeddings", L2NormalizeVertex(), "bottleneck")
+        gb.add_layer("lossLayer", CenterLossOutputLayer(
+            n_out=self.num_classes, loss="mcxent", activation="softmax",
+            alpha=0.1, lambda_=3e-4), "embeddings")
+        conf = (gb.set_outputs("lossLayer")
+                .set_input_types(InputType.convolutional(
+                    self.image_size, self.image_size, 3))
+                .build())
+        return ComputationGraph(conf).init()
+
+
+class NASNet(ZooModel):
+    """reference zoo.model.NASNet (NASNet-A mobile): stem conv + stacks of
+    NASNet-A normal cells with reduction cells between stacks. Cells follow
+    the published NASNet-A block structure — five branch pairs of
+    {separable 3x3/5x5, avg/max pool, identity} combined by adds and
+    concatenated — with 1x1 "adjust" projections aligning the previous
+    cell's channels (the reference's adjustBlock)."""
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123,
+                 image_size: int = 96, penultimate_filters: int = 192,
+                 cells_per_stack: int = 2):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.image_size = image_size
+        self.filters = penultimate_filters // 24 * 4   # base cell width
+        self.cells_per_stack = cells_per_stack
+
+    def init(self) -> ComputationGraph:
+        gb = (ComputationGraphConfiguration
+              .graph_builder(NeuralNetConfiguration.builder()
+                             .seed(self.seed).updater(Adam(1e-3))
+                             .activation("relu").weight_init("relu"))
+              .add_inputs("input"))
+        n = [0]
+
+        def uid(tag):
+            n[0] += 1
+            return f"{tag}{n[0]}"
+
+        def adjust(inp, ch, stride=1):
+            """1x1 projection + BN to ch channels (reference adjustBlock)."""
+            c = uid("adj")
+            gb.add_layer(c, L.ConvolutionLayer(
+                n_out=ch, kernel_size=(1, 1), stride=(stride, stride),
+                has_bias=False, activation="identity"), inp)
+            b = uid("adjbn")
+            gb.add_layer(b, L.BatchNormalization(activation="identity"), c)
+            return b
+
+        def sep(inp, ch, k, stride=1):
+            s = uid("sep")
+            gb.add_layer(s, L.SeparableConvolution2D(
+                n_out=ch, kernel_size=(k, k), stride=(stride, stride),
+                convolution_mode="same", has_bias=False,
+                activation="identity"), inp)
+            b = uid("sepbn")
+            gb.add_layer(b, L.BatchNormalization(activation="relu"), s)
+            return b
+
+        def avgp(inp, stride=1):
+            p = uid("avg")
+            gb.add_layer(p, L.SubsamplingLayer(
+                kernel_size=(3, 3), stride=(stride, stride), padding=(1, 1),
+                pooling_type="avg"), inp)
+            return p
+
+        def maxp(inp, stride=1):
+            p = uid("max")
+            gb.add_layer(p, L.SubsamplingLayer(
+                kernel_size=(3, 3), stride=(stride, stride),
+                padding=(1, 1)), inp)
+            return p
+
+        def add(a, b):
+            v = uid("addv")
+            gb.add_vertex(v, ElementWiseVertex("add"), a, b)
+            return v
+
+        def normal_cell(prev, cur, ch, prev_stride=1):
+            """NASNet-A normal cell over (h_{i-1}, h_i); ``prev_stride=2``
+            is the adjustBlock's spatial alignment right after a
+            reduction cell."""
+            p = adjust(prev, ch, prev_stride)
+            h = adjust(cur, ch)
+            b1 = add(sep(h, ch, 5), sep(p, ch, 3))
+            b2 = add(sep(p, ch, 5), sep(p, ch, 3))
+            b3 = add(avgp(h), p)
+            b4 = add(avgp(p), avgp(p))
+            b5 = add(sep(h, ch, 3), h)
+            cat = uid("ncat")
+            gb.add_vertex(cat, MergeVertex(), b1, b2, b3, b4, b5)
+            return cat
+
+        def reduction_cell(prev, cur, ch):
+            """NASNet-A reduction cell (stride-2 branches)."""
+            p = adjust(prev, ch)
+            h = adjust(cur, ch)
+            b1 = add(sep(h, ch, 5, 2), sep(p, ch, 7, 2))
+            b2 = add(maxp(h, 2), sep(p, ch, 7, 2))
+            b3 = add(avgp(h, 2), sep(p, ch, 5, 2))
+            b4 = add(maxp(h, 2), sep(b1, ch, 3))
+            b5 = add(avgp(b1), b2)
+            cat = uid("rcat")
+            gb.add_vertex(cat, MergeVertex(), b2, b3, b4, b5)
+            return cat
+
+        ch = self.filters
+        stem = uid("stem")
+        gb.add_layer(stem, L.ConvolutionLayer(
+            n_out=ch, kernel_size=(3, 3), stride=(2, 2), padding=(1, 1),
+            has_bias=False, activation="identity"), "input")
+        stem_bn = uid("stembn")
+        gb.add_layer(stem_bn, L.BatchNormalization(activation="identity"),
+                     stem)
+        prev_cell, cur = stem_bn, stem_bn
+        after_reduction = False
+        for stack in range(3):
+            for _ in range(self.cells_per_stack):
+                nxt = normal_cell(prev_cell, cur, ch,
+                                  prev_stride=2 if after_reduction else 1)
+                after_reduction = False
+                prev_cell, cur = cur, nxt
+            if stack < 2:
+                nxt = reduction_cell(prev_cell, cur, ch * 2)
+                prev_cell, cur = cur, nxt
+                ch *= 2
+                after_reduction = True
+        act = uid("relu")
+        gb.add_layer(act, L.ActivationLayer(activation="relu"), cur)
+        gb.add_layer("gap", L.GlobalPoolingLayer(pooling_type="avg"), act)
+        gb.add_layer("out", L.OutputLayer(n_out=self.num_classes,
+                                          loss="mcxent",
+                                          activation="softmax"), "gap")
+        conf = (gb.set_outputs("out")
+                .set_input_types(InputType.convolutional(
+                    self.image_size, self.image_size, 3))
+                .build())
+        return ComputationGraph(conf).init()

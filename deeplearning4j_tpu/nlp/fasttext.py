@@ -43,7 +43,7 @@ def char_ngrams(word: str, minn: int, maxn: int) -> List[str]:
     w = f"<{word}>"
     out = []
     for n in range(minn, maxn + 1):
-        if n >= len(w):
+        if n > len(w):
             break
         for i in range(len(w) - n + 1):
             out.append(w[i:i + n])

@@ -128,7 +128,6 @@ class Glove(WordVectors):
         W = self.window
         offs = np.arange(1, W + 1)
         weights = 1.0 / offs
-        acc = {}
         CHUNK = 4096
         keys_parts, vals_parts = [], []
         for s0 in range(0, len(corpus), CHUNK):

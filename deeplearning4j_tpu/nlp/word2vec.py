@@ -618,9 +618,13 @@ class SequenceVectors(WordVectors):
             return np.float32(max(self.learning_rate * (1 - frac),
                                   self.min_learning_rate))
 
-        # uint16 indices on the wire whenever the vocab fits (the relay
-        # moves 5-10 MB/s; bytes ARE throughput — see _make_block).
-        idx_dt = np.uint16 if V <= (1 << 16) else np.int32
+        # uint16 indices on the wire whenever the TABLE fits (the relay
+        # moves 5-10 MB/s; bytes ARE throughput — see _make_block). The
+        # table can be taller than the vocab: FastText streams subword row
+        # ids up to V + bucket, so sizing off len(vocab) alone would wrap
+        # ids >= 2^16.
+        n_rows = self.lookup_table.vocab_size or V
+        idx_dt = np.uint16 if n_rows <= (1 << 16) else np.int32
 
         def _rounds(npairs):
             """Pad-to-a-multiple-of-a-full-block bookkeeping shared by

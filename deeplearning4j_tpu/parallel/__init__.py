@@ -8,3 +8,5 @@ from .sharding import tp_param_specs, tp_shardings, apply_tp
 from .inference import ParallelInference
 from .distributed import SharedTrainingMaster, initialize, shutdown
 from .ring_attention import ring_attention, ring_self_attention
+from .sharded_embeddings import ShardedEmbedding
+from .pipeline import PipelineParallel, pipeline_apply, stack_stage_params

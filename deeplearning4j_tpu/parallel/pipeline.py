@@ -1,0 +1,125 @@
+"""Pipeline parallelism: microbatched stage execution over a mesh axis.
+
+Reference status: the reference has NO pipeline parallelism (SURVEY §2.4
+marks the row absent; "optional later via shard_map stages"). On TPU it is
+a natural mesh dimension, so the rebuild provides the canonical GPipe-style
+construction natively (same spirit as the ring-attention and tensor-parallel
+additions):
+
+- S homogeneous stages live one-per-device along a mesh ``stage`` axis
+  (stage parameters stacked on a leading [S, ...] axis and sharded over it);
+- the global batch splits into M microbatches; a ``lax.scan`` runs
+  M + S - 1 ticks in which every device applies its stage to the activation
+  it holds and passes the result to the next stage with neighbor-only
+  ``ppermute`` (rides ICI);
+- stage 0 injects microbatch t at tick t; the last stage's outputs are
+  collected tick-aligned and reassembled, then ``psum``-broadcast.
+
+The whole pipeline is one jitted module and is DIFFERENTIABLE (scan +
+ppermute both have transpose rules), so ``jax.grad`` through
+``pipeline_apply`` yields per-stage parameter gradients — enough to train.
+Bubble fraction is the textbook (S-1)/(M+S-1); pick M >> S.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def stack_stage_params(params_list):
+    """[per-stage pytree, ...] → one pytree with leading stage axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, x: jnp.ndarray,
+                   mesh: Mesh, n_micro: int, axis: str = "stage"):
+    """Run ``stage_fn(params, x) -> y`` (same shape in/out) as an S-stage
+    pipeline over ``axis``. x: [B, ...] with B divisible by ``n_micro``.
+    Returns [B, ...] replicated."""
+    from jax.experimental.shard_map import shard_map
+
+    S = mesh.shape[axis]
+    B = x.shape[0]
+    assert B % n_micro == 0, "batch must divide into microbatches"
+    mb = B // n_micro
+
+    def local(params_l, x_full):
+        me = lax.axis_index(axis)
+        p = jax.tree.map(lambda a: a[0], params_l)     # my stage's slice
+        micro = x_full.reshape((n_micro, mb) + x_full.shape[1:])
+        T = n_micro + S - 1
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            act = carry
+            # stage 0 injects microbatch t (clipped; late ticks are
+            # pipeline-drain bubbles masked out at collection)
+            inj = micro[jnp.clip(t, 0, n_micro - 1)]
+            inp = jnp.where(me == 0, inj, act)
+            out = stage_fn(p, inp)
+            nxt = lax.ppermute(out, axis, perm)
+            return nxt, out
+
+        act0 = lax.pvary(jnp.zeros((mb,) + x_full.shape[1:], x_full.dtype),
+                         axis)
+        _, outs = lax.scan(tick, act0, jnp.arange(T))   # [T, mb, ...]
+        # microbatch m exits the LAST stage at tick m + S - 1
+        final = lax.dynamic_slice_in_dim(outs, S - 1, n_micro, axis=0)
+        final = final * (me == S - 1).astype(final.dtype)
+        final = lax.psum(final, axis)                   # replicate
+        return final.reshape((B,) + x_full.shape[1:])
+
+    # P(axis) is a prefix spec: leading (stage) dim sharded, the rest
+    # replicated, for every leaf of the params pytree
+    fn = shard_map(local, mesh=mesh, in_specs=(P(axis), P()),
+                   out_specs=P())
+    return fn(stacked_params, x)
+
+
+class PipelineParallel:
+    """Convenience wrapper: holds stacked stage params sharded over the
+    mesh axis and exposes jitted forward / train_step."""
+
+    def __init__(self, stage_fn: Callable, params_list, mesh: Mesh,
+                 n_micro: int, axis: str = "stage"):
+        self.stage_fn = stage_fn
+        self.mesh = mesh
+        self.axis = axis
+        self.n_micro = n_micro
+        stacked = stack_stage_params(params_list)
+        self.params = jax.tree.map(
+            lambda a: jax.device_put(a, NamedSharding(
+                mesh, P(*(axis,) + (None,) * (a.ndim - 1)))), stacked)
+
+        @jax.jit
+        def fwd(params, x):
+            return pipeline_apply(self.stage_fn, params, x, self.mesh,
+                                  self.n_micro, self.axis)
+
+        self._fwd = fwd
+
+        @jax.jit
+        def step(params, x, y, lr):
+            def loss_fn(p):
+                out = pipeline_apply(self.stage_fn, p, x, self.mesh,
+                                     self.n_micro, self.axis)
+                return jnp.mean((out - y) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            new = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+            return new, loss
+
+        self._step = step
+
+    def forward(self, x) -> jnp.ndarray:
+        return self._fwd(self.params, jnp.asarray(x))
+
+    def train_step(self, x, y, lr: float = 1e-2) -> float:
+        self.params, loss = self._step(self.params, jnp.asarray(x),
+                                       jnp.asarray(y), jnp.float32(lr))
+        return loss

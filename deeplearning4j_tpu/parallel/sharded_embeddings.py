@@ -1,0 +1,105 @@
+"""Sharded embedding tables: the parameter-server row, TPU-style.
+
+Reference: ``nd4j-parameter-server-parent`` ``VoidParameterServer`` v1
+(SURVEY §2.4 "Parameter-server sharded embeddings") — Word2Vec syn0/syn1
+ROWS sharded across "Shard" nodes, workers sending
+``SkipGramRequestMessage``s, ``SkipGramTrainer`` applying updates
+shard-side. The survey's prescribed TPU translation is exactly this
+module: the table lives row-sharded over a mesh axis, lookups and
+scatter-updates run inside ``shard_map`` with one ``psum`` per lookup —
+the collective IS the parameter-server round-trip, compiled onto ICI
+instead of Aeron UDP.
+
+Mechanics per device (table shard [V/N, D]):
+- ``lookup(ids)``: global ids → local offsets; out-of-shard rows gather a
+  clipped row masked to zero; ``psum`` over the axis assembles the full
+  [B, D] batch on every device.
+- ``apply_gradients(ids, grads)``: every device scatter-adds only the
+  rows it owns (duplicate ids sum, as the reference's serialized per-pair
+  updates do). No host round-trip, no gradient for foreign rows.
+
+Tables whose row count does not divide the axis size are zero-padded; the
+padding rows are unreachable by construction (ids < vocab_size).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class ShardedEmbedding:
+    def __init__(self, vocab_size: int, dim: int, mesh: Mesh,
+                 axis: str = "model", seed: int = 0,
+                 scale: Optional[float] = None):
+        self.vocab_size = vocab_size
+        self.dim = dim
+        self.mesh = mesh
+        self.axis = axis
+        n_shards = mesh.shape[axis]
+        self._padded = -(-vocab_size // n_shards) * n_shards
+        rng = np.random.default_rng(seed)
+        scale = scale if scale is not None else 1.0 / dim
+        host = (rng.random((self._padded, dim)) - 0.5).astype(np.float32) \
+            * (2 * scale)
+        host[vocab_size:] = 0.0
+        self._sharding = NamedSharding(mesh, P(axis, None))
+        self.table = jax.device_put(host, self._sharding)
+        self._build()
+
+    def _build(self) -> None:
+        from jax.experimental.shard_map import shard_map
+
+        axis = self.axis
+
+        def local_lookup(table_l, ids):
+            me = lax.axis_index(axis)
+            v_local = table_l.shape[0]
+            local = ids - me * v_local
+            hit = (local >= 0) & (local < v_local)
+            rows = table_l[jnp.clip(local, 0, v_local - 1)]
+            rows = rows * hit[:, None].astype(rows.dtype)
+            return lax.psum(rows, axis)
+
+        def local_update(table_l, ids, grads):
+            me = lax.axis_index(axis)
+            v_local = table_l.shape[0]
+            local = ids - me * v_local
+            hit = (local >= 0) & (local < v_local)
+            g = grads * hit[:, None].astype(grads.dtype)
+            return table_l.at[jnp.clip(local, 0, v_local - 1)].add(g)
+
+        repl = P()
+        self._lookup = jax.jit(shard_map(
+            local_lookup, mesh=self.mesh,
+            in_specs=(P(axis, None), repl), out_specs=repl))
+        self._update = jax.jit(shard_map(
+            local_update, mesh=self.mesh,
+            in_specs=(P(axis, None), repl, repl),
+            out_specs=P(axis, None)), donate_argnums=(0,))
+
+    # -- API ---------------------------------------------------------------
+    def lookup(self, ids) -> jnp.ndarray:
+        """[B] int32 global ids → [B, D] rows (replicated)."""
+        return self._lookup(self.table, jnp.asarray(ids, jnp.int32))
+
+    def apply_gradients(self, ids, grads) -> None:
+        """Scatter-add ``grads`` [B, D] into rows ``ids`` (duplicates
+        sum); only the owning shard of each row is touched."""
+        self.table = self._update(self.table,
+                                  jnp.asarray(ids, jnp.int32),
+                                  jnp.asarray(grads, jnp.float32))
+
+    def to_numpy(self) -> np.ndarray:
+        return np.asarray(self.table)[:self.vocab_size]
+
+    @property
+    def n_shards(self) -> int:
+        return self.mesh.shape[self.axis]

@@ -250,7 +250,10 @@ class AsyncNStepQLearningDiscreteDense(_AsyncBase):
                 self.net.fit(DataSet(ob, y), epochs=1)
                 self._updates += 1
                 if self._updates % c.target_dqn_update_freq == 0:
-                    self.target = self.net.clone()
+                    # parameter copy, NOT clone(): a clone rebuilds the
+                    # graph and re-traces while every worker waits on this
+                    # lock
+                    self.target.copy_params_from(self.net)
             if done or ep_steps >= c.max_epoch_step:
                 self._record_episode(ep_reward)
                 obs = mdp.reset()

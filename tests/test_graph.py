@@ -386,3 +386,57 @@ class TestZooAdditions:
         g = InceptionResNetV1(num_classes=16, image_size=96).init()
         x = np.random.RandomState(2).randn(1, 3, 96, 96).astype(np.float32)
         assert g.output({"input": x})[0].shape == (1, 16)
+
+
+class TestZooCompletion:
+    """Round-3: the final two reference zoo models — 16/16 coverage."""
+
+    def test_facenet_nn4small2_builds_and_steps(self):
+        from deeplearning4j_tpu.models import FaceNetNN4Small2
+        from deeplearning4j_tpu.nn.graph import L2NormalizeVertex
+
+        g = FaceNetNN4Small2(num_classes=5, image_size=64).init()
+        x = np.random.RandomState(0).randn(2, 3, 64, 64).astype(np.float32)
+        out = g.output({"input": x})
+        assert out[0].shape == (2, 5)
+        # structural: L2-normalized embedding bottleneck + center-loss head
+        assert any(isinstance(getattr(n, "vertex", None), L2NormalizeVertex)
+                   for n in g.conf.nodes.values())
+        from deeplearning4j_tpu.nn.conf.layers_ext import \
+            CenterLossOutputLayer
+
+        assert any(isinstance(getattr(n, "layer", None),
+                              CenterLossOutputLayer)
+                   for n in g.conf.nodes.values())
+        y = np.eye(5, dtype=np.float32)[[0, 1]]
+        g.fit(DataSet(x, y), epochs=1)
+        assert np.isfinite(float(g.score_value))
+
+    def test_facenet_embeddings_are_l2_normalized(self):
+        from deeplearning4j_tpu.models import FaceNetNN4Small2
+
+        g = FaceNetNN4Small2(num_classes=5, image_size=64).init()
+        x = np.random.RandomState(2).randn(3, 3, 64, 64).astype(np.float32)
+        import jax
+
+        acts, _ = g._forward(g._params, g._states, {"input": x}, False,
+                             jax.random.PRNGKey(0))
+        emb = np.asarray(acts["embeddings"])
+        np.testing.assert_allclose(np.linalg.norm(emb, axis=1),
+                                   np.ones(3), atol=1e-4)
+
+    def test_nasnet_builds_and_steps(self):
+        from deeplearning4j_tpu.models import NASNet
+        from deeplearning4j_tpu.nn.conf.layers import \
+            SeparableConvolution2D
+
+        g = NASNet(num_classes=7, image_size=32, cells_per_stack=1).init()
+        x = np.random.RandomState(1).randn(1, 3, 32, 32).astype(np.float32)
+        assert g.output({"input": x})[0].shape == (1, 7)
+        n_sep = sum(isinstance(getattr(n, "layer", None),
+                               SeparableConvolution2D)
+                    for n in g.conf.nodes.values())
+        assert n_sep >= 20, n_sep   # cell structure is separable-conv heavy
+        y = np.eye(7, dtype=np.float32)[[2]]
+        g.fit(DataSet(x, y), epochs=1)
+        assert np.isfinite(float(g.score_value))

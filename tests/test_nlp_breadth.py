@@ -188,3 +188,31 @@ class TestSerializerCompat:
         for w in ("a0", "b3"):
             np.testing.assert_allclose(r.get_word_vector(w),
                                        ft.get_word_vector(w), atol=1e-5)
+
+
+class TestFastTextWireWidth:
+    def test_large_bucket_subword_ids_survive_the_wire(self):
+        """Regression (round-3 review): with the default bucket=100k the
+        subword row ids exceed 2^16; the host pipeline must widen its wire
+        dtype off the TABLE height, not len(vocab), or ids wrap."""
+        ft = (FastText.builder().min_word_frequency(2).layer_size(8)
+              .epochs(1).negative_sample(2).batch_size(128).seed(6)
+              .bucket(100_000).iterate(_cluster_corpus(200)).build())
+        ft.fit()
+        assert ft.lookup_table.vocab_size > (1 << 16)
+        # rows above 2^16 must have been TRAINED (nonzero), proving the
+        # indices were not truncated to uint16 on the way to the device
+        high = np.asarray(ft.lookup_table.syn0)[(1 << 16):]
+        assert np.abs(high).sum() > 0
+
+    def test_short_oov_word_gets_a_vector(self):
+        """Regression: char_ngrams must include the full '<w>' gram of
+        length exactly n, so 1-char OOV words still resolve."""
+        grams = char_ngrams("a", 3, 6)
+        assert "<a>" in grams
+        ft = (FastText.builder().min_word_frequency(2).layer_size(8)
+              .epochs(1).negative_sample(2).batch_size(128).seed(6)
+              .bucket(2048).iterate(_cluster_corpus(200)).build())
+        ft.fit()
+        v = ft.get_word_vector("z")       # OOV single char
+        assert v.shape == (8,) and np.isfinite(v).all()

@@ -1,0 +1,158 @@
+"""Sharded embeddings (parameter-server analog) + pipeline parallelism
+tests over the virtual 8-device CPU mesh. Reference: SURVEY §2.4
+"Parameter-server sharded embeddings" (VoidParameterServer) and "Pipeline
+parallel" rows."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+
+def _mesh(axis: str, n: int) -> Mesh:
+    devs = jax.devices()[:n]
+    if len(devs) < n:
+        pytest.skip(f"needs {n} devices")
+    return Mesh(np.asarray(devs).reshape(n), (axis,))
+
+
+class TestShardedEmbedding:
+    def test_lookup_matches_dense(self):
+        from deeplearning4j_tpu.parallel.sharded_embeddings import \
+            ShardedEmbedding
+
+        mesh = _mesh("model", 4)
+        emb = ShardedEmbedding(vocab_size=50, dim=8, mesh=mesh,
+                               axis="model", seed=1)
+        dense = emb.to_numpy()
+        ids = np.asarray([0, 7, 13, 49, 25, 13], np.int32)
+        got = np.asarray(emb.lookup(ids))
+        np.testing.assert_allclose(got, dense[ids], atol=1e-6)
+
+    def test_vocab_not_divisible_pads_safely(self):
+        from deeplearning4j_tpu.parallel.sharded_embeddings import \
+            ShardedEmbedding
+
+        mesh = _mesh("model", 8)
+        emb = ShardedEmbedding(vocab_size=13, dim=4, mesh=mesh,
+                               axis="model", seed=2)
+        assert emb.table.shape[0] % 8 == 0
+        ids = np.arange(13, dtype=np.int32)
+        got = np.asarray(emb.lookup(ids))
+        np.testing.assert_allclose(got, emb.to_numpy(), atol=1e-6)
+
+    def test_scatter_update_only_touches_owned_rows(self):
+        from deeplearning4j_tpu.parallel.sharded_embeddings import \
+            ShardedEmbedding
+
+        mesh = _mesh("model", 4)
+        emb = ShardedEmbedding(vocab_size=40, dim=4, mesh=mesh,
+                               axis="model", seed=3)
+        before = emb.to_numpy().copy()
+        ids = np.asarray([3, 21, 3, 39], np.int32)     # dup id 3 must SUM
+        grads = np.ones((4, 4), np.float32)
+        emb.apply_gradients(ids, grads)
+        after = emb.to_numpy()
+        expected = before.copy()
+        np.add.at(expected, ids, grads)
+        np.testing.assert_allclose(after, expected, atol=1e-6)
+
+    def test_trains_a_toy_objective(self):
+        """Pull looked-up rows toward targets using sharded updates only
+        (the VoidParameterServer SkipGramTrainer round shape)."""
+        from deeplearning4j_tpu.parallel.sharded_embeddings import \
+            ShardedEmbedding
+
+        mesh = _mesh("model", 4)
+        emb = ShardedEmbedding(vocab_size=20, dim=6, mesh=mesh,
+                               axis="model", seed=4)
+        rng = np.random.default_rng(0)
+        targets = rng.standard_normal((20, 6)).astype(np.float32)
+        ids_all = np.arange(20, dtype=np.int32)
+
+        def loss():
+            return float(np.mean(
+                (np.asarray(emb.lookup(ids_all)) - targets) ** 2))
+
+        l0 = loss()
+        for _ in range(100):
+            ids = rng.integers(0, 20, 16).astype(np.int32)
+            rows = np.asarray(emb.lookup(ids))
+            grad = -(0.5 * (rows - targets[ids]))     # lr-scaled descent
+            emb.apply_gradients(ids, grad)
+        assert loss() < l0 * 0.1, (l0, loss())
+
+
+def _stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _stage_params(rng, d, s):
+    return [{"w": rng.standard_normal((d, d)).astype(np.float32) * 0.5,
+             "b": np.zeros(d, np.float32)} for _ in range(s)]
+
+
+class TestPipelineParallel:
+    def test_forward_matches_sequential(self):
+        from deeplearning4j_tpu.parallel.pipeline import (PipelineParallel,
+                                                          pipeline_apply,
+                                                          stack_stage_params)
+
+        S, D, B, M = 4, 8, 16, 8
+        mesh = _mesh("stage", S)
+        rng = np.random.default_rng(1)
+        params = _stage_params(rng, D, S)
+        x = rng.standard_normal((B, D)).astype(np.float32)
+        pp = PipelineParallel(_stage_fn, params, mesh, n_micro=M)
+        got = np.asarray(pp.forward(x))
+        ref = x
+        for p in params:
+            ref = np.tanh(ref @ p["w"] + p["b"])
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_gradients_match_sequential(self):
+        from deeplearning4j_tpu.parallel.pipeline import (pipeline_apply,
+                                                          stack_stage_params)
+
+        S, D, B, M = 4, 6, 8, 4
+        mesh = _mesh("stage", S)
+        rng = np.random.default_rng(2)
+        params = _stage_params(rng, D, S)
+        stacked = stack_stage_params(params)
+        x = rng.standard_normal((B, D)).astype(np.float32)
+        y = rng.standard_normal((B, D)).astype(np.float32)
+
+        def pipe_loss(p):
+            out = pipeline_apply(_stage_fn, p, jnp.asarray(x), mesh, M,
+                                 "stage")
+            return jnp.mean((out - jnp.asarray(y)) ** 2)
+
+        def seq_loss(p):
+            h = jnp.asarray(x)
+            for s in range(S):
+                ps = jax.tree.map(lambda a, s=s: a[s], p)
+                h = _stage_fn(ps, h)
+            return jnp.mean((h - jnp.asarray(y)) ** 2)
+
+        g_pipe = jax.grad(pipe_loss)(stacked)
+        g_seq = jax.grad(seq_loss)(stacked)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(np.asarray(g_pipe[k]),
+                                       np.asarray(g_seq[k]), atol=1e-4)
+
+    def test_train_step_reduces_loss(self):
+        from deeplearning4j_tpu.parallel.pipeline import PipelineParallel
+
+        S, D, B, M = 4, 8, 32, 8
+        mesh = _mesh("stage", S)
+        rng = np.random.default_rng(3)
+        pp = PipelineParallel(_stage_fn, _stage_params(rng, D, S), mesh,
+                              n_micro=M)
+        x = rng.standard_normal((B, D)).astype(np.float32)
+        y = np.tanh(x) * 0.5
+        losses = [float(pp.train_step(x, y, lr=0.1)) for _ in range(60)]
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
