@@ -487,10 +487,25 @@ def multi_head_dot_product_attention(q, k, v, wq, wk, wv, wo, mask=None,
         return proj.reshape(b, x.shape[1], num_heads, -1).transpose(0, 2, 1, 3)
 
     qh, kh, vh = split_heads(q, wq), split_heads(k, wk), split_heads(v, wv)
-    m = None
-    if mask is not None:
-        m = mask.reshape(b, 1, 1, tk)
-    out = dot_product_attention(qh, kh, vh, m, scaled)  # [B, H, Tq, dh]
+    out = None
+    if mask is None and tq == tk:
+        # unmasked self-attention routes through the Pallas flash kernel
+        # on TPU (3-8x at long T, no T×T buffer — BASELINE.md); the dense
+        # path remains the reference semantics everywhere else
+        from ..common.environment import Environment
+        from .pallas_attention import flash_attention, supports_flash
+
+        if (Environment.get().allow_pallas()
+                and jax.default_backend() == "tpu"
+                and supports_flash(tq, qh.shape[-1])):
+            scale = (qh.shape[-1] ** -0.5) if scaled else 1.0
+            out = flash_attention(qh, kh, vh, sm_scale=scale,
+                                  interpret=False)
+    if out is None:
+        m = None
+        if mask is not None:
+            m = mask.reshape(b, 1, 1, tk)
+        out = dot_product_attention(qh, kh, vh, m, scaled)  # [B, H, Tq, dh]
     out = out.transpose(0, 2, 1, 3).reshape(b, tq, -1)
     return out @ wo
 

@@ -118,6 +118,7 @@ def _ensure_loaded() -> None:
         random,
         loss,
         image,
+        pallas_attention,
         bitwise,
         embeddings,
     )

@@ -1018,6 +1018,23 @@ class TestDatatypeAndImportOps:
               spec=[["ellipsis"], ["newaxis"], ["idx", 0]])
 
 
+class TestPallasOps:
+    def test_flash_attention_matches_dense(self):
+        """Pallas flash-attention kernel (interpret mode here; Mosaic on
+        TPU) vs the dense reference op."""
+        from deeplearning4j_tpu.ops.nn import dot_product_attention
+
+        rng = np.random.RandomState(5)
+        q = rng.randn(1, 2, 128, 32).astype(np.float32) * 0.4
+        k = rng.randn(1, 2, 128, 32).astype(np.float32) * 0.4
+        v = rng.randn(1, 2, 128, 32).astype(np.float32) * 0.4
+        got = exec_op("flash_attention", q, k, v, interpret=True)
+        ref = dot_product_attention(jnp.asarray(q), jnp.asarray(k),
+                                    jnp.asarray(v))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5)
+
+
 class TestCoverageLedger:
     """The reference's coverage-ledger gate: every registered op must be
     exercised by this suite or explicitly listed as pending with a reason."""
