@@ -202,6 +202,9 @@ class _Ctx:
     def shape_of_input(self, i: int) -> Tuple[int, ...]:
         return self.imp.infer_shape(self.data_inputs[i])
 
+    def dtype_of_input(self, i: int) -> np.dtype:
+        return self.imp.infer_dtype(self.data_inputs[i])
+
     def emit(self, op_name: str, inputs: Sequence[Any], n_outputs=None, **kw):
         return self.sd._add_op(op_name, list(inputs),
                                name=self.name.replace(":", "_"),
@@ -249,7 +252,7 @@ class _Importer:
     def static_value(self, tensor_name: str) -> Optional[np.ndarray]:
         return self._static.get(tensor_name)
 
-    # --- shape inference over the partial graph -------------------------
+    # --- shape/dtype inference over the partial graph -------------------
     def infer_shape(self, tensor_name: str) -> Tuple[int, ...]:
         import jax
 
@@ -264,6 +267,29 @@ class _Importer:
             shp = tuple(int(d) for d in vinfo.shape)
             self._shape_cache[tensor_name] = shp
             return shp
+        return tuple(int(d) for d in self._eval_struct(tensor_name).shape)
+
+    def infer_dtype(self, tensor_name: str) -> np.dtype:
+        """True result dtype via abstract tracing (the `_Var.dtype` field is
+        only authoritative for placeholders/constants — op outputs default
+        to float32 there)."""
+        sval = self._static.get(tensor_name)
+        if sval is not None:
+            return np.asarray(sval).dtype
+        var = self.resolve_var(tensor_name)
+        vinfo = self.sd._vars[var.name]
+        if vinfo.value is not None:
+            return np.asarray(vinfo.value).dtype
+        if vinfo.producer is None:   # placeholder: declared dtype holds
+            return np.dtype(vinfo.dtype)
+        return np.dtype(self._eval_struct(tensor_name).dtype)
+
+    def _eval_struct(self, tensor_name: str):
+        """Abstract-eval the partial graph up to ``tensor_name`` and return
+        its jax.ShapeDtypeStruct (also fills the shape cache)."""
+        import jax
+
+        var = self.resolve_var(tensor_name)
         fn = self.sd._make_fn((var.name,), training=False)
         params = {n: jax.ShapeDtypeStruct(np.asarray(v.value).shape,
                                           np.asarray(v.value).dtype)
@@ -281,9 +307,8 @@ class _Importer:
             ph[n] = jax.ShapeDtypeStruct(tuple(pshape), pdt)
         key_struct = jax.ShapeDtypeStruct((2,), np.uint32)
         out = jax.eval_shape(fn, params, ph, key_struct)
-        shp = tuple(int(d) for d in out[0].shape)
-        self._shape_cache[tensor_name] = shp
-        return shp
+        self._shape_cache[tensor_name] = tuple(int(d) for d in out[0].shape)
+        return out[0]
 
     # --- main loop ------------------------------------------------------
     def run(self) -> SameDiff:
@@ -417,7 +442,10 @@ _FOLDERS: Dict[str, Callable] = {
     "Add": lambda ctx, s: np.add(s[0], s[1]),
     "Sub": lambda ctx, s: np.subtract(s[0], s[1]),
     "Mul": lambda ctx, s: np.multiply(s[0], s[1]),
-    "Div": lambda ctx, s: (np.floor_divide(s[0], s[1])
+    # ONNX integer Div truncates toward zero (C semantics), not floor;
+    # computed exactly in integer arithmetic (no float round-trip, so
+    # int64 values beyond 2^53 fold correctly)
+    "Div": lambda ctx, s: (_int_trunc_divide(s[0], s[1])
                            if np.issubdtype(np.asarray(s[0]).dtype,
                                             np.integer)
                            else np.divide(s[0], s[1])),
@@ -442,6 +470,14 @@ _FOLDERS: Dict[str, Callable] = {
 }
 
 
+def _int_trunc_divide(a, b):
+    """Exact integer division truncating toward zero (C semantics)."""
+    a, b = np.asarray(a), np.asarray(b)
+    q = np.floor_divide(np.abs(a), np.abs(b))
+    neg = (a < 0) ^ (b < 0)
+    return np.where(neg, -q, q).astype(a.dtype)
+
+
 def _np_reshape_onnx(x, shape):
     x = np.asarray(x)
     shape = [int(d) for d in np.asarray(shape, np.int64)]
@@ -462,14 +498,34 @@ def _binary(op_name):
 
 
 _BINARY = {
-    "Add": "add", "Sub": "subtract", "Mul": "multiply", "Div": "divide",
-    "Pow": "pow", "Mod": "floormod",
+    "Add": "add", "Sub": "subtract", "Mul": "multiply",
+    "Pow": "pow",
     "Equal": "equals", "Greater": "greater", "GreaterOrEqual": "greater_equal",
     "Less": "less", "LessOrEqual": "less_equal",
     "And": "boolean_and", "Or": "boolean_or", "Xor": "boolean_xor",
 }
 for _onnx_name, _our in _BINARY.items():
     onnx_op(_onnx_name)(_binary(_our))
+
+
+@onnx_op("Div")
+def _div(ctx):
+    # ONNX Div truncates toward zero on integers (C semantics); floats are
+    # true division. The registry has exact ops for both.
+    if np.issubdtype(ctx.dtype_of_input(0), np.integer) \
+            and np.issubdtype(ctx.dtype_of_input(1), np.integer):
+        return ctx.emit("truncatediv", [ctx.var(0), ctx.var(1)])
+    return ctx.emit("divide", [ctx.var(0), ctx.var(1)])
+
+
+@onnx_op("Mod")
+def _mod(ctx):
+    if not ctx.attr("fmod", 0):
+        # fmod=0: Python/floor semantics (integer inputs per spec)
+        return ctx.emit("floormod", [ctx.var(0), ctx.var(1)])
+    # fmod=1: C-style truncated remainder (sign follows the dividend) —
+    # exactly the registry "mod" op (jnp.fmod), dtype-preserving
+    return ctx.emit("mod", [ctx.var(0), ctx.var(1)])
 
 
 def _unary(op_name, **fixed_kw):
@@ -709,9 +765,21 @@ def _unsqueeze(ctx):
 @onnx_op("Flatten")
 def _flatten(ctx):
     shp = ctx.shape_of_input(0)
-    axis = ctx.attr("axis", 1) % max(len(shp), 1) if shp else 0
+    axis = _norm_axis_incl(ctx.attr("axis", 1), len(shp)) if shp else 0
     lead = int(np.prod(shp[:axis], dtype=np.int64)) if axis > 0 else 1
+    if axis == len(shp) and shp:
+        # spec-legal axis==rank: everything into dim 0 → [prod, 1]
+        return ctx.emit("reshape", [ctx.var(0)], shape=(lead, 1))
     return ctx.emit("reshape", [ctx.var(0)], shape=(lead, -1))
+
+
+def _norm_axis_incl(axis: int, rank: int) -> int:
+    """Normalize an ONNX coerce-to-2D axis where axis==rank is legal
+    (Flatten, opset<13 Softmax): only negatives wrap."""
+    a = axis + rank if axis < 0 else axis
+    if not 0 <= a <= rank:
+        raise ValueError(f"axis {axis} out of range for rank {rank}")
+    return a
 
 
 @onnx_op("Gather")
@@ -858,8 +926,10 @@ def _softmax(ctx):
         return ctx.emit("softmax", [ctx.var(0)], axis=ctx.attr("axis", -1))
     # opset<13: softmax over the flattened trailing dims [axis:]
     shp = ctx.shape_of_input(0)
-    axis = ctx.attr("axis", 1) % max(len(shp), 1) if shp else 0
+    axis = _norm_axis_incl(ctx.attr("axis", 1), len(shp)) if shp else 0
     lead = int(np.prod(shp[:axis], dtype=np.int64)) if axis > 0 else 1
+    # axis==rank flattens to [prod, 1]; softmax over one element is 1.0,
+    # which the (lead, -1) reshape realizes naturally
     flat = ctx.sd._add_op("reshape", [ctx.var(0)], shape=(lead, -1))
     sm = ctx.sd._add_op("softmax", [flat], axis=-1)
     return ctx.emit("reshape", [sm], shape=tuple(shp))
@@ -871,7 +941,7 @@ def _log_softmax(ctx):
         return ctx.emit("log_softmax", [ctx.var(0)],
                         axis=ctx.attr("axis", -1))
     shp = ctx.shape_of_input(0)
-    axis = ctx.attr("axis", 1) % max(len(shp), 1) if shp else 0
+    axis = _norm_axis_incl(ctx.attr("axis", 1), len(shp)) if shp else 0
     lead = int(np.prod(shp[:axis], dtype=np.int64)) if axis > 0 else 1
     flat = ctx.sd._add_op("reshape", [ctx.var(0)], shape=(lead, -1))
     sm = ctx.sd._add_op("log_softmax", [flat], axis=-1)
@@ -941,7 +1011,16 @@ def _pool_mapper(kind):
             raise UnsupportedOnnxOpError(f"{kind} rank {len(k)}", ctx.name)
         s = tuple(ctx.attr("strides", [1] * len(k)))
         pad_sym, pad_explicit = _conv_pads(ctx, len(k), k, s)
+        # Decide exclude-pad BEFORE the explicit-pad rewrite zeroes pad_sym:
+        # ONNX default count_include_pad=0 divides by the number of
+        # non-padding elements in each window.
+        padded = (any(int(b) or int(e) for b, e in zip(*pad_explicit))
+                  if pad_explicit is not None
+                  else pad_sym == "SAME" or any(pad_sym))
+        exclude_pad = (kind == "avgpool2d" and padded
+                       and not ctx.attr("count_include_pad", 0))
         x = ctx.var(0)
+        paddings = None
         if pad_explicit is not None:
             begin, end = pad_explicit
             paddings = ((0, 0), (0, 0)) + tuple(
@@ -950,15 +1029,59 @@ def _pool_mapper(kind):
             x = ctx.sd._add_op("pad", [x], paddings=paddings,
                                constant_value=fill)
             pad_sym = (0,) * len(k)
-        if kind == "avgpool2d" and any(pad_sym) \
-                and not ctx.attr("count_include_pad", 0):
-            raise UnsupportedOnnxOpError(
-                "AveragePool(count_include_pad=0 with nonzero pads)",
-                ctx.name)
-        return ctx.emit(kind, [x], kernel=k, strides=s, padding=pad_sym,
-                        data_format="NCHW")
+        if not exclude_pad:
+            return ctx.emit(kind, [x], kernel=k, strides=s, padding=pad_sym,
+                            data_format="NCHW")
+        # avgpool over zero-padded input divides by the full kernel area
+        # (= count_include_pad=1 semantics; ops/nn.py _pool). Correct with a
+        # precomputed (1, 1, oh, ow) scale k²/n_valid — pads, kernel, and
+        # strides are all static, so no runtime mask pooling is needed.
+        pooled = ctx.sd._add_op(kind, [x], kernel=k, strides=s,
+                                padding=pad_sym, data_format="NCHW",
+                                name=_safe(ctx.name) + "_incl")
+        shp = ctx.shape_of_input(0)
+        if pad_explicit is not None:
+            begin, end = ([int(v) for v in pad_explicit[0]],
+                          [int(v) for v in pad_explicit[1]])
+        elif pad_sym == "SAME":   # SAME_UPPER: extra pad at the end
+            begin, end = [], []
+            for d, (kk, ss) in zip(shp[2:], zip(k, s)):
+                out = -(-d // ss)
+                total = max((out - 1) * ss + kk - d, 0)
+                begin.append(total // 2)
+                end.append(total - total // 2)
+        else:
+            begin = end = [int(v) for v in pad_sym]
+        counts = _pool_valid_counts(shp[2:], k, s, begin, end)
+        try:
+            sdt = ctx.dtype_of_input(0)
+        except Exception:
+            sdt = np.dtype(np.float32)
+        scale = ((k[0] * k[1]) / counts).astype(sdt)[None, None]
+        c = ctx.sd.constant(_safe(ctx.name) + "_cip_scale", scale)
+        return ctx.emit("multiply", [pooled, c])
 
     return m
+
+
+def _pool_valid_counts(hw, k, s, begin, end):
+    """Number of non-padding elements per pooling window, shape (oh, ow) —
+    computed with an integral image over the validity mask."""
+    H, W = int(hw[0]), int(hw[1])
+    valid = np.zeros((H + begin[0] + end[0], W + begin[1] + end[1]),
+                     np.float64)
+    valid[begin[0]:begin[0] + H, begin[1]:begin[1] + W] = 1.0
+    integ = np.zeros((valid.shape[0] + 1, valid.shape[1] + 1))
+    integ[1:, 1:] = valid.cumsum(0).cumsum(1)
+    oh = (valid.shape[0] - k[0]) // s[0] + 1
+    ow = (valid.shape[1] - k[1]) // s[1] + 1
+    i0 = np.arange(oh) * s[0]
+    j0 = np.arange(ow) * s[1]
+    counts = (integ[np.ix_(i0 + k[0], j0 + k[1])]
+              - integ[np.ix_(i0, j0 + k[1])]
+              - integ[np.ix_(i0 + k[0], j0)]
+              + integ[np.ix_(i0, j0)])
+    return np.maximum(counts, 1.0)
 
 
 onnx_op("MaxPool")(_pool_mapper("maxpool2d"))

@@ -187,6 +187,9 @@ def unigram_int_table(cache: VocabCache, power: float = 0.75,
     the same truncation the reference's finite table applies."""
     assert size & (size - 1) == 0, "size must be a power of two"
     counts = cache.counts().astype(np.float64)
+    if counts.size == 0 or counts.sum() <= 0:
+        raise ValueError("empty vocabulary after pruning — cannot build "
+                         "the negative-sampling table")
     probs = counts ** power
     probs /= probs.sum()
     alloc = np.floor(probs * size).astype(np.int64)

@@ -456,7 +456,10 @@ class SequenceVectors(WordVectors):
         receives ``(hs_dev, ntable_dev)`` device tables."""
         import jax.numpy as jnp
 
-        key = (tag, len(self.vocab), int(self.vocab.counts().sum()),
+        # content hash (not just len/sum): two rebuilt vocabs with equal size
+        # and total count must not reuse stale Huffman paths / unigram tables
+        counts = np.ascontiguousarray(self.vocab.counts())
+        key = (tag, len(self.vocab), hash(counts.tobytes()),
                self.negative, self.algorithm, self.use_hs) + extra
         if getattr(self, "_block_cache_key", None) != key:
             hs_dev = ntable_dev = None

@@ -44,6 +44,44 @@ class TestElementwise:
         a, b = A(3, 4), P(3, 4)
         check_model(m, {"a": a, "b": b}, fn(a, b))
 
+    def test_div_runtime_integer_truncates(self):
+        # unfolded integer Div must match the folder's C truncation
+        x = np.asarray([[-7, 7, -9, 9]], np.int64)
+        y = np.asarray([[2, -2, 4, 4]], np.int64)
+        m = make_model([make_node("Div", ["x", "y"], ["z"])],
+                       inputs=[("x", (1, 4)), ("y", (1, 4))], outputs=["z"],
+                       input_dtypes={"x": np.int64, "y": np.int64})
+        got = run_model(m, {"x": x, "y": y})[0]
+        np.testing.assert_array_equal(got, np.asarray([[-3, -3, -2, 2]]))
+        assert np.issubdtype(got.dtype, np.integer)
+
+    def test_mod_fmod_integer_dtype(self):
+        x = np.asarray([[-7, 7, -9]], np.int64)
+        y = np.asarray([[2, -2, 4]], np.int64)
+        m = make_model([make_node("Mod", ["x", "y"], ["z"], fmod=1)],
+                       inputs=[("x", (1, 3)), ("y", (1, 3))], outputs=["z"],
+                       input_dtypes={"x": np.int64, "y": np.int64})
+        got = run_model(m, {"x": x, "y": y})[0]
+        np.testing.assert_array_equal(got, np.fmod(x, y))
+        assert np.issubdtype(got.dtype, np.integer)
+
+    def test_mod_floor_default(self):
+        # fmod=0 → Python/floor semantics (sign follows the divisor)
+        x = np.asarray([[7, -7, 7, -7]], F32)
+        y = np.asarray([[3, 3, -3, -3]], F32)
+        m = make_model([make_node("Mod", ["x", "y"], ["z"])],
+                       inputs=[("x", (1, 4)), ("y", (1, 4))], outputs=["z"])
+        check_model(m, {"x": x, "y": y}, np.mod(x, y))
+
+    def test_mod_fmod_truncated(self):
+        # fmod=1 → C-style truncated remainder (sign follows the dividend);
+        # ADVICE r3: was mapped to floormod unconditionally
+        x = np.asarray([[5.3, -5.3, 5.3, -5.3]], F32)
+        y = np.asarray([[2.0, 2.0, -2.0, -2.0]], F32)
+        m = make_model([make_node("Mod", ["x", "y"], ["z"], fmod=1)],
+                       inputs=[("x", (1, 4)), ("y", (1, 4))], outputs=["z"])
+        check_model(m, {"x": x, "y": y}, np.fmod(x, y), atol=1e-6)
+
     def test_broadcast(self):
         m = make_model([make_node("Add", ["a", "b"], ["y"])],
                        inputs=[("a", (2, 3, 4)), ("b", (4,))], outputs=["y"])
@@ -308,6 +346,20 @@ class TestShapeOps:
         m = _unary_model("Flatten", shape=(2, 3, 4, 5), axis=2)
         check_model(m, {"x": x}, x.reshape(6, 20))
 
+    def test_flatten_axis_rank(self):
+        # spec-legal axis==rank flattens everything into dim 0 → [prod, 1]
+        # (ADVICE r3: `% rank` wrapped it to axis 0 → [1, prod])
+        x = A(2, 3, 4)
+        m = _unary_model("Flatten", shape=(2, 3, 4), axis=3)
+        check_model(m, {"x": x}, x.reshape(24, 1))
+
+    def test_flatten_axis_zero_and_negative(self):
+        x = A(2, 3, 4)
+        check_model(_unary_model("Flatten", shape=(2, 3, 4), axis=0),
+                    {"x": x}, x.reshape(1, 24))
+        check_model(_unary_model("Flatten", shape=(2, 3, 4), axis=-1),
+                    {"x": x}, x.reshape(6, 4))
+
     def test_gather_dynamic_indices(self):
         x = A(5, 4)
         m = make_model([make_node("Gather", ["x", "i"], ["y"], axis=0)],
@@ -383,6 +435,24 @@ class TestShapeOps:
                           "ax0": np.asarray([0], np.int64),
                           "minus1": np.asarray([-1], np.int64)})
         check_model(m, {"x": x}, x.reshape(2, 12))
+
+    def test_div_fold_truncates_toward_zero(self):
+        """Folded integer Div uses C truncation (ONNX spec), not floor:
+        -7/2 must fold to -3, and the folded value drives a Reshape."""
+        x = A(2, 3)
+        nodes = [
+            make_node("Div", ["neg", "two"], ["q"]),     # [-7]/[2] → [-3]
+            make_node("Add", ["q", "four"], ["d0"]),     # [-3]+[4] → [1]
+            make_node("Concat", ["d0", "minus1"], ["newshape"], axis=0),
+            make_node("Reshape", ["x", "newshape"], ["y"]),
+        ]
+        m = make_model(
+            nodes, inputs=[("x", (2, 3))], outputs=["y"],
+            initializers={"neg": np.asarray([-7], np.int64),
+                          "two": np.asarray([2], np.int64),
+                          "four": np.asarray([4], np.int64),
+                          "minus1": np.asarray([-1], np.int64)})
+        check_model(m, {"x": x}, x.reshape(1, 6))
 
 
 class TestNN:
@@ -513,6 +583,57 @@ class TestNN:
         check_model(m, {"x": x},
                     TF.avg_pool2d(torch.from_numpy(x), 3, 3, 1,
                                   count_include_pad=True).numpy(),
+                    atol=1e-5)
+
+    def test_avgpool_pads_exclude(self):
+        # ONNX default count_include_pad=0: divisor counts only non-pad
+        # elements (ADVICE r3 medium: the old import silently included pads)
+        x = A(1, 2, 6, 6)
+        m = make_model(
+            [make_node("AveragePool", ["x"], ["y"], kernel_shape=[3, 3],
+                       strides=[3, 3], pads=[1, 1, 1, 1])],
+            inputs=[("x", (1, 2, 6, 6))], outputs=["y"])
+        check_model(m, {"x": x},
+                    TF.avg_pool2d(torch.from_numpy(x), 3, 3, 1,
+                                  count_include_pad=False).numpy(),
+                    atol=1e-5)
+
+    @staticmethod
+    def _np_avgpool_exclude(x, k, s, pads):
+        """Loop-reference exclude-pad average pool (NCHW, pads=(t,l,b,r))."""
+        t, l, b, r = pads
+        xp = np.pad(x, ((0, 0), (0, 0), (t, b), (l, r)))
+        valid = np.pad(np.ones_like(x), ((0, 0), (0, 0), (t, b), (l, r)))
+        N, C, H, W = xp.shape
+        oh, ow = (H - k) // s + 1, (W - k) // s + 1
+        out = np.zeros((N, C, oh, ow), x.dtype)
+        for i in range(oh):
+            for j in range(ow):
+                win = xp[:, :, i * s:i * s + k, j * s:j * s + k]
+                cnt = valid[:, :, i * s:i * s + k, j * s:j * s + k]
+                out[:, :, i, j] = win.sum((2, 3)) / cnt.sum((2, 3))
+        return out
+
+    def test_avgpool_asymmetric_pads_exclude(self):
+        x = A(1, 2, 7, 7)
+        m = make_model(
+            [make_node("AveragePool", ["x"], ["y"], kernel_shape=[3, 3],
+                       strides=[2, 2], pads=[0, 1, 1, 0])],
+            inputs=[("x", (1, 2, 7, 7))], outputs=["y"])
+        check_model(m, {"x": x},
+                    self._np_avgpool_exclude(x, 3, 2, (0, 1, 1, 0)),
+                    atol=1e-5)
+
+    def test_avgpool_same_upper_exclude(self):
+        # SAME_UPPER on 7×7/k3/s2 pads (1,1) each side; default
+        # count_include_pad=0 must exclude those pads from the divisor
+        x = A(1, 2, 7, 7)
+        m = make_model(
+            [make_node("AveragePool", ["x"], ["y"], kernel_shape=[3, 3],
+                       strides=[2, 2], auto_pad="SAME_UPPER")],
+            inputs=[("x", (1, 2, 7, 7))], outputs=["y"])
+        check_model(m, {"x": x},
+                    self._np_avgpool_exclude(x, 3, 2, (1, 1, 1, 1)),
                     atol=1e-5)
 
     def test_global_average_pool(self):
