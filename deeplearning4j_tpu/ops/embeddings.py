@@ -52,7 +52,9 @@ def _table_add(table, idx, grads, dense: bool):
         return table + jnp.einsum(
             "nv,nd->vd", onehot, grads.astype(jnp.bfloat16),
             preferred_element_type=table.dtype)
-    return table.at[idx].add(grads)
+    # grads may be f32 even when the table is bf16 (the NS/HS math promotes
+    # through the f32 labels/lr); cast so the scatter writes table-width
+    return table.at[idx].add(grads.astype(table.dtype))
 
 
 def _neg_round(h, u, labels, lr, pair_mask):

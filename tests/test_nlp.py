@@ -265,6 +265,20 @@ class TestWord2Vec:
         near = w.words_nearest("a0", 10)
         assert sum(n.startswith("a") for n in near) >= 8
 
+    def test_skipgram_bfloat16_tables_learn(self):
+        # table_dtype="bfloat16" halves table HBM traffic; convergence
+        # quality must survive the reduced-precision accumulates
+        w = (Word2Vec.builder().min_word_frequency(5).layer_size(32).seed(42)
+             .window_size(3).negative_sample(5).epochs(3).batch_size(256)
+             .table_dtype("bfloat16")
+             .iterate(CollectionSentenceIterator(_cluster_corpus()))
+             .build())
+        w.fit()
+        same = _mean_sim(w, [("a0", f"a{i}") for i in range(1, 6)])
+        diff = _mean_sim(w, [("a0", f"b{i}") for i in range(5)])
+        assert same > diff + 0.3, (same, diff)
+        assert w.lookup_table.syn0.dtype == np.float32  # stored back as f32
+
     def test_hierarchical_softmax_learns(self):
         w = Word2Vec(min_word_frequency=5, layer_size=24, negative=0,
                      use_hierarchic_softmax=True, epochs=3, batch_size=256,
