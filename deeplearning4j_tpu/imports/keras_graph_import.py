@@ -152,7 +152,8 @@ def import_functional_parsed(f, cfg) -> ComputationGraph:
         # Sequential importer's flatten_pending equivalent)
         _SHAPE_PRESERVING = {"Dropout", "Activation", "ReLU", "LeakyReLU",
                              "Softmax", "ELU", "AlphaDropout",
-                             "GaussianDropout", "GaussianNoise"}
+                             "GaussianDropout", "GaussianNoise", "PReLU",
+                             "LayerNormalization"}
         for kl in layers_cfg:
             cls = kl["class_name"]
             if cls == "InputLayer":
@@ -189,8 +190,7 @@ def import_functional_parsed(f, cfg) -> ComputationGraph:
                         "(channel-dim concat only)")
                 gb.add_vertex(name, MergeVertex(), *srcs)
             elif cls == "Flatten":
-                gb.add_layer(name, L.ActivationLayer(activation="identity"),
-                             *srcs)
+                gb.add_layer(name, L.FlattenLayer(), *srcs)
                 flatten_src[name] = srcs[0]
             else:
                 layer, setter = _convert_layer(kl, f)
@@ -226,10 +226,13 @@ def import_functional_parsed(f, cfg) -> ComputationGraph:
 
         # weights (+ the deferred flatten→dense row permute)
         permute_for = dict(dense_after_flatten)
+        from .keras_import import (_check_tree_shapes, _flatten_perm,
+                                   _jnp_tree, _np_tree)
+
         for name, setter in setters.items():
             if setter is None:
                 continue
-            params = {k: np.asarray(v) for k, v in net._params[name].items()}
+            params = _np_tree(net._params[name])
             if getattr(setter, "wants_state", False):
                 state = {k: np.asarray(v)
                          for k, v in net._states[name].items()}
@@ -241,20 +244,9 @@ def import_functional_parsed(f, cfg) -> ComputationGraph:
             if name in permute_for:
                 t = conf.node_output_types[permute_for[name]]
                 if isinstance(t, CNNInput):
-                    C, H, W = t.channels, t.height, t.width
-                    perm = np.arange(H * W * C).reshape(H, W, C) \
-                        .transpose(2, 0, 1).ravel()
+                    perm = _flatten_perm(
+                        (t.channels, t.height, t.width))
                     params["W"] = np.asarray(params["W"])[perm]
-            for k, v in net._params[name].items():
-                expect = np.asarray(v).shape
-                got = np.asarray(params[k]).shape
-                if expect != got:
-                    raise ValueError(
-                        f"node {name!r} param {k!r}: imported shape {got} "
-                        f"!= initialized shape {expect}")
-            import jax.numpy as jnp
-
-            net._params[name] = {
-                k: jnp.asarray(np.asarray(v, np.float32))
-                for k, v in params.items()}
+            _check_tree_shapes(net._params[name], params, f"node {name!r}")
+            net._params[name] = _jnp_tree(params)
         return net

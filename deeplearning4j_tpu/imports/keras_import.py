@@ -2,9 +2,19 @@
 
 Reference: dl4j-modelimport ``org.deeplearning4j.nn.modelimport.keras.
 KerasModelImport`` / ``KerasSequentialModel`` + the ~60 ``KerasLayer``
-mapping classes (SURVEY.md §2.3). This rebuild maps the common Sequential
-surface; the h5 container is read with h5py (the reference wraps HDF5 via
-JavaCPP ``Hdf5Archive``).
+mapping classes (SURVEY.md §2.3). The h5 container is read with h5py (the
+reference wraps HDF5 via JavaCPP ``Hdf5Archive``).
+
+Mapped layer types (round 4: ~45 incl. the functional importer's merges):
+Dense, Conv1D/2D/3D, SeparableConv2D, DepthwiseConv2D, Conv2DTranspose,
+Max/AveragePooling1D/2D/3D, GlobalMax/AveragePooling1D/2D/3D, Flatten,
+Dropout, GaussianNoise/GaussianDropout/AlphaDropout, BatchNormalization,
+LayerNormalization, Activation/ReLU/LeakyReLU/ELU/Softmax/PReLU,
+ZeroPadding1D/2D, Cropping1D/2D, UpSampling1D/2D, Permute, Reshape,
+RepeatVector, Embedding, LSTM, GRU (both reset_after forms), SimpleRNN,
+Bidirectional(LSTM|GRU|SimpleRNN), InputLayer — plus functional-graph
+Add/Subtract/Multiply/Average/Maximum/Concatenate and the
+``register_custom_layer`` hook (reference KerasLayer.registerCustomLayer).
 
 Layout conversions (the part the reference spends KerasLayer subclasses on):
 
@@ -62,8 +72,52 @@ def _pair(v) -> Tuple[int, int]:
     return int(v), int(v)
 
 
+def _triple(v) -> Tuple[int, int, int]:
+    if isinstance(v, (list, tuple)):
+        return int(v[0]), int(v[1]), int(v[2])
+    return int(v), int(v), int(v)
+
+
+def _flatten_perm(shape) -> np.ndarray:
+    """Kernel row permutation mapping Keras's channels-last Flatten order
+    to this body's channels-first order; shape = (C, *spatial)."""
+    c, spatial = int(shape[0]), tuple(int(s) for s in shape[1:])
+    nd = len(spatial)
+    arr = np.arange(int(np.prod(shape))).reshape(*spatial, c)
+    return arr.transpose((nd,) + tuple(range(nd))).ravel()
+
+
+def _pad2d_spec(v) -> Tuple[int, int, int, int]:
+    """Keras 2D padding/cropping spec → (top, bottom, left, right)."""
+    if isinstance(v, int):
+        return v, v, v, v
+    a, b = v
+    if isinstance(a, int):
+        return a, a, b, b
+    return int(a[0]), int(a[1]), int(b[0]), int(b[1])
+
+
+# Custom-layer hook (reference: KerasLayer.registerCustomLayer): maps a
+# Keras class_name to a callable ``(config, weights) -> (Layer, setter)``
+# where ``setter`` is ``None`` or ``setter(params_dict)`` filling imported
+# weights (add ``setter.wants_state = True`` for ``setter(params, state)``).
+_CUSTOM_LAYERS: Dict[str, Callable] = {}
+
+
+def register_custom_layer(class_name: str, factory: Callable) -> None:
+    _CUSTOM_LAYERS[class_name] = factory
+
+
+def unregister_custom_layer(class_name: str) -> None:
+    _CUSTOM_LAYERS.pop(class_name, None)
+
+
 class KerasModelImport:
     """Reference-shaped entry points."""
+
+    # reference spelling: KerasLayer.registerCustomLayer
+    register_custom_layer = staticmethod(register_custom_layer)
+    registerCustomLayer = staticmethod(register_custom_layer)
 
     @staticmethod
     def import_keras_sequential_model_and_weights(h5_path: str) -> MultiLayerNetwork:
@@ -160,14 +214,20 @@ def _import_sequential_parsed(f, cfg) -> MultiLayerNetwork:
 
 
 class _SequentialBuilder:
+    # layers that keep spatial layout (and therefore the flattened row
+    # order) intact — the Flatten permute tracking passes through them
+    _SHAPE_PRESERVING = ()   # filled after class body (needs L.*)
+
     def __init__(self):
         self.layers: List[L.Layer] = []
         self.weights: List[Optional[Callable]] = []  # per our-layer: params setter
         self.input_type: Optional[InputType] = None
         self.input_is_nhwc = False
+        self.input_is_ndhwc = False
         self.flatten_pending = False      # saw Flatten; next Dense needs row permute
-        self.flatten_shape: Optional[Tuple[int, int, int]] = None  # (C, H, W)
-        self.cur_cnn: Optional[Tuple[int, int, int]] = None        # (C, H, W)
+        # spatial shape at the Flatten: (C, H, W) or (C, D, H, W)
+        self.flatten_shape: Optional[Tuple[int, ...]] = None
+        self.cur_cnn: Optional[Tuple[int, ...]] = None  # (C,H,W)|(C,D,H,W)
         self.pending_activation: Optional[str] = None
 
     # -- input bookkeeping ------------------------------------------------
@@ -178,6 +238,11 @@ class _SequentialBuilder:
             self.input_type = InputType.convolutional(h, w, c)
             self.input_is_nhwc = True
             self.cur_cnn = (c, h, w)
+        elif len(dims) == 4:  # NDHWC
+            d, h, w, c = dims
+            self.input_type = InputType.convolutional_3d(d, h, w, c)
+            self.input_is_ndhwc = True
+            self.cur_cnn = (c, d, h, w)
         elif len(dims) == 2:
             t, feat = dims
             self.input_type = InputType.recurrent(feat, t)
@@ -187,22 +252,30 @@ class _SequentialBuilder:
             raise UnsupportedKerasLayerError("InputLayer", f"rank {len(dims)}")
 
     def _update_cnn_shape(self, layer: L.Layer):
-        """Track (C, H, W) through conv/pool layers for the Flatten permute."""
+        """Track (C, H, W) / (C, D, H, W) through spatial layers for the
+        Flatten permute."""
         if self.cur_cnn is None:
             return
-        if not isinstance(layer, (L.ConvolutionLayer, L.SubsamplingLayer,
-                                  L.BatchNormalization, L.DropoutLayer,
-                                  L.ActivationLayer)):
-            self.cur_cnn = None  # left CNN space (Dense/GlobalPool/...)
+        if isinstance(layer, self._SHAPE_PRESERVING):
             return
-        if isinstance(layer, (L.BatchNormalization, L.DropoutLayer,
-                              L.ActivationLayer)):
-            return  # shape-preserving
-        t = layer.set_input_type(CNNInput(*self.cur_cnn))
-        if isinstance(t, CNNInput):
-            self.cur_cnn = (t.channels, t.height, t.width)
-        else:
-            self.cur_cnn = None
+        if len(self.cur_cnn) == 3 and isinstance(
+                layer, (L.ConvolutionLayer, L.SubsamplingLayer,
+                        L.ZeroPaddingLayer, L.Cropping2D, L.Upsampling2D)):
+            t = layer.set_input_type(CNNInput(*self.cur_cnn))
+            self.cur_cnn = ((t.channels, t.height, t.width)
+                            if isinstance(t, CNNInput) else None)
+            return
+        if len(self.cur_cnn) == 4 and isinstance(
+                layer, (L.Convolution3DLayer, L.Subsampling3DLayer,
+                        L.Upsampling3D, L.ZeroPadding3DLayer, L.Cropping3D)):
+            from ..nn.conf.inputs import CNN3DInput
+
+            c, d, h, w = self.cur_cnn
+            t = layer.set_input_type(CNN3DInput(c, d, h, w))
+            self.cur_cnn = ((t.channels, t.depth, t.height, t.width)
+                            if isinstance(t, CNN3DInput) else None)
+            return
+        self.cur_cnn = None  # left CNN space (Dense/GlobalPool/...)
 
     # -- per-layer mapping ------------------------------------------------
     def add(self, kl: Dict[str, Any], f) -> None:
@@ -219,9 +292,23 @@ class _SequentialBuilder:
             # Keras-2-era h5: no InputLayer entry, the first real layer
             # carries batch_input_shape
             self._set_input(c.get("batch_input_shape") or c.get("batch_shape"))
+        # registered custom layers; serialized names may carry the
+        # register_keras_serializable package prefix ("pkg>ClassName")
+        custom = _CUSTOM_LAYERS.get(cls) \
+            or _CUSTOM_LAYERS.get(cls.split(">")[-1])
+        if custom is not None:
+            layer, setter = custom(c, ws)
+            self._push(layer, setter)
+            return
         if cls in ("Flatten",):
+            # remember the spatial shape for the next Dense's row permute,
+            # and materialize the flatten explicitly so ANY layer may
+            # follow (LayerNormalization/PReLU/... — not just Dense)
             self.flatten_pending = True
             self.flatten_shape = self.cur_cnn
+            self.layers.append(L.FlattenLayer())
+            self.weights.append(None)
+            self.cur_cnn = None
             return
         if cls == "Dropout":
             self.layers.append(L.DropoutLayer(rate=float(c["rate"])))
@@ -276,11 +363,10 @@ class _SequentialBuilder:
         kernel = ws[0]
         bias = ws[1] if use_bias and len(ws) > 1 else None
         if self.flatten_pending and self.flatten_shape is not None:
-            C, H, W = self.flatten_shape
-            # keras flattens NHWC → rows in HWC order; the body here flattens
-            # NCHW → CHW order. Permute rows once so activations match.
-            perm = np.arange(H * W * C).reshape(H, W, C).transpose(2, 0, 1).ravel()
-            kernel = kernel[perm]
+            # keras flattens channels-last → rows in (spatial..., C) order;
+            # the body here flattens channels-first. Permute rows once so
+            # activations match (2D and 3D).
+            kernel = kernel[_flatten_perm(self.flatten_shape)]
         self.flatten_pending = False
 
         if act == "softmax":
@@ -413,44 +499,388 @@ class _SequentialBuilder:
             raise UnsupportedKerasLayerError(
                 "LSTM", "return_sequences=False (add GlobalPooling or use "
                 "return_sequences=True)")
-        units = int(c["units"])
-        layer = L.LSTM(n_out=units)
-        kernel, recurrent, bias = (ws + [None] * 3)[:3]
+        layer, params = _convert_lstm(c, ws)
+        self._push(layer, _dict_setter(params))
 
-        # keras gates i,f,c,o → fused i,f,o,g column order
-        def remap_cols(m):
-            i, fgate, g, o = np.split(m, 4, axis=-1)
-            return np.concatenate([i, fgate, o, g], axis=-1)
-
-        w = remap_cols(np.concatenate([kernel, recurrent], axis=0))
-        b = remap_cols(bias[None, :])[0] if bias is not None else None
-
-        def setter(params):
-            params["W"] = w
-            if b is not None:
-                params["b"] = b
-
-        self._push(layer, setter)
+    def _map_GRU(self, c, ws):
+        _require_weights(ws, 'GRU', c.get('name', '?'))
+        if not c.get("return_sequences", False):
+            raise UnsupportedKerasLayerError("GRU",
+                                             "return_sequences=False")
+        layer, params = _convert_gru(c, ws)
+        self._push(layer, _dict_setter(params))
 
     def _map_SimpleRNN(self, c, ws):
         _require_weights(ws, 'SimpleRNN', c.get('name', '?'))
         if not c.get("return_sequences", False):
             raise UnsupportedKerasLayerError("SimpleRNN",
                                              "return_sequences=False")
-        layer = L.SimpleRnn(n_out=int(c["units"]),
-                            activation=_act(c.get("activation", "tanh")))
-        kernel, recurrent, bias = (ws + [None] * 3)[:3]
+        layer, params = _convert_simple_rnn(c, ws)
+        self._push(layer, _dict_setter(params))
+
+    def _map_Bidirectional(self, c, ws):
+        name = c.get("name", "?")
+        _require_weights(ws, 'Bidirectional', name)
+        inner = c.get("layer", {})
+        bwd_cfg = c.get("backward_layer")
+        if bwd_cfg:
+            # Keras always serializes backward_layer (auto-derived from the
+            # forward layer); reject only a MATERIALLY different one — the
+            # import runs both directions with the wrapped layer's config
+            watch = ("units", "activation", "recurrent_activation",
+                     "use_bias", "reset_after", "return_sequences",
+                     "unit_forget_bias")
+            ic0 = inner.get("config", {})
+            bc0 = bwd_cfg.get("config", {})
+            if (bwd_cfg.get("class_name") != inner.get("class_name")
+                    or any(bc0.get(k, ic0.get(k)) != ic0.get(k)
+                           for k in watch)):
+                raise UnsupportedKerasLayerError(
+                    "Bidirectional",
+                    f"{name}: backward_layer differs from the wrapped "
+                    "layer's config")
+        inner_cls = inner.get("class_name")
+        conv = {"LSTM": _convert_lstm, "GRU": _convert_gru,
+                "SimpleRNN": _convert_simple_rnn}.get(inner_cls)
+        if conv is None:
+            raise UnsupportedKerasLayerError(
+                "Bidirectional", f"{name}: wrapped {inner_cls!r}")
+        ic = inner.get("config", {})
+        if not ic.get("return_sequences", False):
+            raise UnsupportedKerasLayerError(
+                "Bidirectional", f"{name}: return_sequences=False")
+        n_half = len(ws) // 2
+        fwd_layer, fwd_params = conv(ic, ws[:n_half])
+        _, bwd_params = conv(ic, ws[n_half:])
+        mode = {"concat": "concat", "sum": "add", "mul": "mul",
+                "ave": "average", "average": "average"}.get(
+                    c.get("merge_mode", "concat"))
+        if mode is None:
+            raise UnsupportedKerasLayerError(
+                "Bidirectional", f"merge_mode={c.get('merge_mode')!r}")
+        layer = L.Bidirectional(layer=fwd_layer, mode=mode)
 
         def setter(params):
-            params["W"] = kernel
-            params["RW"] = recurrent
+            # update (not replace) so initialized keys absent from the h5
+            # keep their init values — except biases, which the converters
+            # explicitly zero when use_bias=False
+            params["fwd"].update(
+                {k: np.asarray(v) for k, v in fwd_params.items()})
+            params["bwd"].update(
+                {k: np.asarray(v) for k, v in bwd_params.items()})
+
+        self._push(layer, setter)
+
+    # -- spatial extras ---------------------------------------------------
+    def _map_SeparableConv2D(self, c, ws):
+        _require_weights(ws, 'SeparableConv2D', c.get('name', '?'))
+        if _pair(c.get("dilation_rate", 1)) != (1, 1):
+            raise UnsupportedKerasLayerError("SeparableConv2D", "dilation")
+        layer = L.SeparableConvolution2D(
+            n_out=int(c["filters"]), kernel_size=_pair(c["kernel_size"]),
+            stride=_pair(c.get("strides", 1)),
+            depth_multiplier=int(c.get("depth_multiplier", 1)),
+            convolution_mode="same" if c.get("padding") == "same" else "truncate",
+            activation=_act(c.get("activation")),
+            has_bias=bool(c.get("use_bias", True)))
+        depth = ws[0].transpose(3, 2, 0, 1)   # [kh,kw,C,m] → [m,C,kh,kw]
+        point = ws[1].transpose(3, 2, 0, 1)   # [1,1,C·m,F] → [F,C·m,1,1]
+        bias = ws[2] if len(ws) > 2 else None
+
+        def setter(params):
+            params["dW"] = depth
+            params["pW"] = point
             if bias is not None:
                 params["b"] = bias
 
         self._push(layer, setter)
 
+    def _map_Conv2DTranspose(self, c, ws):
+        _require_weights(ws, 'Conv2DTranspose', c.get('name', '?'))
+        if _pair(c.get("dilation_rate", 1)) != (1, 1):
+            raise UnsupportedKerasLayerError("Conv2DTranspose", "dilation")
+        layer = L.Deconvolution2D(
+            n_out=int(c["filters"]), kernel_size=_pair(c["kernel_size"]),
+            stride=_pair(c.get("strides", 1)),
+            convolution_mode="same" if c.get("padding") == "same" else "truncate",
+            activation=_act(c.get("activation")),
+            has_bias=bool(c.get("use_bias", True)))
+        kernel = ws[0].transpose(3, 2, 0, 1)  # [kh,kw,out,in] → [in,out,kh,kw]
+        bias = ws[1] if len(ws) > 1 else None
+
+        def setter(params):
+            params["W"] = kernel
+            if bias is not None:
+                params["b"] = bias
+
+        self._push(layer, setter)
+
+    def _map_Conv1D(self, c, ws):
+        _require_weights(ws, 'Conv1D', c.get('name', '?'))
+        if c.get("padding") == "causal":
+            raise UnsupportedKerasLayerError("Conv1D", "causal padding")
+        layer = L.Convolution1DLayer(
+            n_out=int(c["filters"]),
+            kernel_size=int(_one(c["kernel_size"])),
+            stride=int(_one(c.get("strides", 1))),
+            dilation=int(_one(c.get("dilation_rate", 1))),
+            convolution_mode="same" if c.get("padding") == "same" else "truncate",
+            activation=_act(c.get("activation")),
+            has_bias=bool(c.get("use_bias", True)))
+        kernel = ws[0].transpose(2, 1, 0)     # [k,in,out] → [out,in,k]
+        bias = ws[1] if len(ws) > 1 else None
+
+        def setter(params):
+            params["W"] = kernel
+            if bias is not None:
+                params["b"] = bias
+
+        self._push(layer, setter)
+
+    def _map_Conv3D(self, c, ws):
+        _require_weights(ws, 'Conv3D', c.get('name', '?'))
+        layer = L.Convolution3DLayer(
+            n_out=int(c["filters"]), kernel_size=_triple(c["kernel_size"]),
+            stride=_triple(c.get("strides", 1)),
+            dilation=_triple(c.get("dilation_rate", 1)),
+            convolution_mode="same" if c.get("padding") == "same" else "truncate",
+            activation=_act(c.get("activation")),
+            has_bias=bool(c.get("use_bias", True)))
+        kernel = ws[0].transpose(4, 3, 0, 1, 2)  # [kd,kh,kw,in,out]→[out,in,kd,kh,kw]
+        bias = ws[1] if len(ws) > 1 else None
+
+        def setter(params):
+            params["W"] = kernel
+            if bias is not None:
+                params["b"] = bias
+
+        self._push(layer, setter)
+
+    def _map_MaxPooling1D(self, c, ws):
+        self._push(self._pool1d(c, "max"), None)
+
+    def _map_AveragePooling1D(self, c, ws):
+        self._push(self._pool1d(c, "avg"), None)
+
+    def _pool1d(self, c, kind):
+        if c.get("padding", "valid") == "same":
+            raise UnsupportedKerasLayerError("Pooling1D", "same padding")
+        return L.Subsampling1DLayer(
+            pooling_type=kind, kernel_size=int(_one(c.get("pool_size", 2))),
+            stride=int(_one(c.get("strides") or c.get("pool_size", 2))))
+
+    def _map_MaxPooling3D(self, c, ws):
+        self._push(self._pool3d(c, "max"), None)
+
+    def _map_AveragePooling3D(self, c, ws):
+        self._push(self._pool3d(c, "avg"), None)
+
+    def _pool3d(self, c, kind):
+        if c.get("padding", "valid") == "same":
+            raise UnsupportedKerasLayerError("Pooling3D", "same padding")
+        return L.Subsampling3DLayer(
+            pooling_type=kind, kernel_size=_triple(c.get("pool_size", 2)),
+            stride=_triple(c.get("strides") or c.get("pool_size", 2)))
+
+    def _map_GlobalAveragePooling1D(self, c, ws):
+        self._push(L.GlobalPoolingLayer(pooling_type="avg"), None)
+
+    def _map_GlobalMaxPooling1D(self, c, ws):
+        self._push(L.GlobalPoolingLayer(pooling_type="max"), None)
+
+    def _map_GlobalAveragePooling3D(self, c, ws):
+        self._push(L.GlobalPoolingLayer(pooling_type="avg"), None)
+
+    def _map_GlobalMaxPooling3D(self, c, ws):
+        self._push(L.GlobalPoolingLayer(pooling_type="max"), None)
+
+    def _map_ZeroPadding2D(self, c, ws):
+        self._push(L.ZeroPaddingLayer(
+            padding=_pad2d_spec(c.get("padding", 1))), None)
+
+    def _map_Cropping2D(self, c, ws):
+        self._push(L.Cropping2D(
+            cropping=_pad2d_spec(c.get("cropping", 0))), None)
+
+    def _map_ZeroPadding1D(self, c, ws):
+        v = c.get("padding", 1)
+        lo, hi = (v, v) if isinstance(v, int) else (int(v[0]), int(v[1]))
+        self._push(L.ZeroPadding1DLayer(padding=(lo, hi)), None)
+
+    def _map_Cropping1D(self, c, ws):
+        v = c.get("cropping", 0)
+        lo, hi = (v, v) if isinstance(v, int) else (int(v[0]), int(v[1]))
+        self._push(L.Cropping1D(cropping=(lo, hi)), None)
+
+    def _map_UpSampling2D(self, c, ws):
+        if c.get("interpolation", "nearest") != "nearest":
+            raise UnsupportedKerasLayerError("UpSampling2D",
+                                             c.get("interpolation"))
+        self._push(L.Upsampling2D(size=_pair(c.get("size", 2))), None)
+
+    def _map_UpSampling1D(self, c, ws):
+        self._push(L.Upsampling1D(size=int(_one(c.get("size", 2)))), None)
+
+    # -- normalization / activations / shape utils ------------------------
+    def _map_LayerNormalization(self, c, ws):
+        name = c.get("name", "?")
+        _require_weights(ws, 'LayerNormalization', name)
+        axis = c.get("axis", -1)
+        axis = axis[0] if isinstance(axis, (list, tuple)) and len(axis) == 1 \
+            else axis
+        if axis != -1:
+            # the rank isn't reliably known at map time, so a positive axis
+            # can't be verified to be the feature axis — refuse rather than
+            # import silently-wrong normalization
+            raise UnsupportedKerasLayerError(
+                "LayerNormalization",
+                f"{name}: axis={c.get('axis')} (only the last axis, -1, "
+                "is supported)")
+        scale = bool(c.get("scale", True))
+        center = bool(c.get("center", True))
+        if len(ws) != int(scale) + int(center):
+            raise UnsupportedKerasLayerError(
+                "LayerNormalization",
+                f"{name}: got {len(ws)} weights for scale={scale}, "
+                f"center={center}")
+        it = iter(ws)
+        gamma = next(it) if scale else None
+        beta = next(it) if center else None
+        layer = L.LayerNormalization(eps=float(c.get("epsilon", 1e-3)))
+
+        def setter(params):
+            if gamma is not None:
+                params["gain"] = gamma
+            if beta is not None:
+                params["bias"] = beta
+
+        self._push(layer, setter)
+
+    def _map_PReLU(self, c, ws):
+        name = c.get("name", "?")
+        _require_weights(ws, 'PReLU', name)
+        alpha = np.asarray(ws[0])
+        # our alpha is per-feature/per-channel; Keras's is per-element
+        # unless shared_axes collapse the spatial dims
+        squeezed = alpha.reshape(-1) if alpha.size == alpha.shape[-1] \
+            else None
+        if squeezed is None:
+            raise UnsupportedKerasLayerError(
+                "PReLU", f"{name}: per-element alpha of shape "
+                f"{alpha.shape}; import supports per-channel/per-feature "
+                "only (set shared_axes over the spatial dims)")
+        layer = L.PReLULayer()
+
+        def setter(params):
+            params["alpha"] = squeezed
+
+        self._push(layer, setter)
+
+    def _map_RepeatVector(self, c, ws):
+        self._push(L.RepeatVector(n=int(c["n"])), None)
+
+    def _map_Permute(self, c, ws):
+        self._push(L.Permute(dims=tuple(int(d) for d in c["dims"])), None)
+
+    def _map_Reshape(self, c, ws):
+        shape = tuple(int(d) for d in c["target_shape"])
+        self._push(L.ReshapeLayer(shape=shape), None)
+
+    def _map_GaussianNoise(self, c, ws):
+        self._push(L.GaussianNoiseLayer(stddev=float(c["stddev"])), None)
+
+    def _map_GaussianDropout(self, c, ws):
+        self._push(L.GaussianDropoutLayer(rate=float(c["rate"])), None)
+
+    def _map_AlphaDropout(self, c, ws):
+        self._push(L.AlphaDropoutLayer(rate=float(c["rate"])), None)
+
     # -- assembly ---------------------------------------------------------
     def finish(self) -> MultiLayerNetwork:
+        return _finish_sequential(self)
+
+
+_SequentialBuilder._SHAPE_PRESERVING = (
+    L.BatchNormalization, L.DropoutLayer, L.ActivationLayer, L.PReLULayer,
+    L.LayerNormalization, L.AlphaDropoutLayer, L.GaussianDropoutLayer,
+    L.GaussianNoiseLayer)
+
+
+def _one(v):
+    return v[0] if isinstance(v, (list, tuple)) else v
+
+
+def _dict_setter(vals: Dict[str, np.ndarray]) -> Callable:
+    def setter(params):
+        for k, v in vals.items():
+            params[k] = np.asarray(v)
+
+    return setter
+
+
+def _convert_lstm(c, ws) -> Tuple[L.Layer, Dict[str, np.ndarray]]:
+    units = int(c["units"])
+    kernel, recurrent, bias = (list(ws) + [None] * 3)[:3]
+
+    # keras gates i,f,c,o → fused i,f,o,g column order
+    def remap_cols(m):
+        i, fgate, g, o = np.split(m, 4, axis=-1)
+        return np.concatenate([i, fgate, o, g], axis=-1)
+
+    params = {"W": remap_cols(np.concatenate([kernel, recurrent], axis=0))}
+    if bias is not None:
+        params["b"] = remap_cols(np.asarray(bias)[None, :])[0]
+    else:
+        # use_bias=False: must overwrite the initialized forget-gate
+        # bias of 1.0 — keeping it would silently diverge from Keras
+        params["b"] = np.zeros((4 * units,), np.float32)
+    return L.LSTM(n_out=units), params
+
+
+def _convert_gru(c, ws) -> Tuple[L.Layer, Dict[str, np.ndarray]]:
+    """Keras GRU gate order is z (update), r (reset), h (candidate); the
+    GRU layer here wants [r, u] fused columns (reference gruCell order).
+    reset_after=True (the Keras default) keeps separate input/recurrent
+    candidate paths and a [2, 3n] bias."""
+    units = n = int(c["units"])
+    ra = bool(c.get("reset_after", True))
+    kernel, recurrent = np.asarray(ws[0]), np.asarray(ws[1])
+    bias = np.asarray(ws[2]) if len(ws) > 2 else None
+    Wz, Wr, Wh = kernel[:, :n], kernel[:, n:2 * n], kernel[:, 2 * n:]
+    Rz, Rr, Rh = (recurrent[:, :n], recurrent[:, n:2 * n],
+                  recurrent[:, 2 * n:])
+    w_ru = np.concatenate([np.concatenate([Wr, Wz], axis=1),
+                           np.concatenate([Rr, Rz], axis=1)], axis=0)
+    params: Dict[str, np.ndarray] = {"W_ru": w_ru}
+    if ra:
+        params["W_cx"] = Wh
+        params["W_ch"] = Rh
+        if bias is not None:
+            bias = bias.reshape(2, 3 * n)
+            bi, bh = bias[0], bias[1]
+            params["b_ru"] = np.concatenate(
+                [bi[n:2 * n] + bh[n:2 * n], bi[:n] + bh[:n]])
+            params["b_cx"] = bi[2 * n:]
+            params["b_ch"] = bh[2 * n:]
+    else:
+        params["W_c"] = np.concatenate([Wh, Rh], axis=0)
+        if bias is not None:
+            bias = bias.reshape(-1)
+            params["b_ru"] = np.concatenate([bias[n:2 * n], bias[:n]])
+            params["b_c"] = bias[2 * n:]
+    return L.GRU(n_out=units, reset_after=ra), params
+
+
+def _convert_simple_rnn(c, ws) -> Tuple[L.Layer, Dict[str, np.ndarray]]:
+    layer = L.SimpleRnn(n_out=int(c["units"]),
+                        activation=_act(c.get("activation", "tanh")))
+    params = {"W": ws[0], "RW": ws[1]}
+    if len(ws) > 2:
+        params["b"] = ws[2]
+    return layer, params
+
+
+def _finish_sequential(self: "_SequentialBuilder") -> MultiLayerNetwork:
         if self.input_type is None:
             raise ValueError("model has no InputLayer / batch_shape")
         if not self.layers:
@@ -460,12 +890,14 @@ class _SequentialBuilder:
             lb.layer(layer)
         conf = lb.set_input_type(self.input_type).build()
 
-        if self.input_is_nhwc:
-            # keep Keras's NHWC input contract: transpose once on entry, then
-            # run the NCHW body (weights were already transposed to OIHW)
+        if self.input_is_nhwc or self.input_is_ndhwc:
+            # keep Keras's channels-last input contract: transpose once on
+            # entry, then run the channels-first body (weights were already
+            # transposed at import)
+            perm = (0, 3, 1, 2) if self.input_is_nhwc else (0, 4, 1, 2, 3)
             existing = conf.preprocessors.get(0)
             nhwc = Preprocessor("NhwcToNchw",
-                                lambda x: x.transpose(0, 3, 1, 2),
+                                lambda x: x.transpose(*perm),
                                 conf.layer_output_types[0]
                                 if conf.layer_output_types else None)
             if existing is not None:
@@ -479,7 +911,7 @@ class _SequentialBuilder:
         for i, setter in enumerate(self.weights):
             if setter is None:
                 continue
-            params = {k: np.asarray(v) for k, v in model._params[i].items()}
+            params = _np_tree(model._params[i])
             if getattr(setter, "wants_state", False):
                 state = {k: np.asarray(v) for k, v in model._states[i].items()}
                 setter(params, state)
@@ -493,15 +925,34 @@ class _SequentialBuilder:
                                     for k, v in state.items()}
             else:
                 setter(params)
-            for k, v in model._params[i].items():
-                expect = np.asarray(v).shape
-                got = np.asarray(params[k]).shape
-                if expect != got:
-                    raise ValueError(
-                        f"layer {i} param {k!r}: imported shape {got} != "
-                        f"initialized shape {expect}")
-            import jax.numpy as jnp
-
-            model._params[i] = {k: jnp.asarray(np.asarray(v, dtype=np.float32))
-                                for k, v in params.items()}
+            _check_tree_shapes(model._params[i], params, f"layer {i}")
+            model._params[i] = _jnp_tree(params)
         return model
+
+
+def _np_tree(tree):
+    """Params may nest (Bidirectional's fwd/bwd sub-dicts)."""
+    return {k: (_np_tree(v) if isinstance(v, dict) else np.asarray(v))
+            for k, v in tree.items()}
+
+
+def _jnp_tree(tree):
+    import jax.numpy as jnp
+
+    return {k: (_jnp_tree(v) if isinstance(v, dict)
+                else jnp.asarray(np.asarray(v, dtype=np.float32)))
+            for k, v in tree.items()}
+
+
+def _check_tree_shapes(expect_tree, got_tree, where: str) -> None:
+    for k, v in expect_tree.items():
+        got = got_tree[k]
+        if isinstance(v, dict):
+            _check_tree_shapes(v, got, f"{where}.{k}")
+            continue
+        expect = np.asarray(v).shape
+        gshape = np.asarray(got).shape
+        if expect != gshape:
+            raise ValueError(
+                f"{where} param {k!r}: imported shape {gshape} != "
+                f"initialized shape {expect}")

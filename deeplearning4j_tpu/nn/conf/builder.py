@@ -214,6 +214,10 @@ class MultiLayerConfiguration:
         if isinstance(cur, CNNInput) and isinstance(layer, ff_like) \
                 and not isinstance(layer, L.RnnOutputLayer):
             return cnn_to_ff(cur)
+        from .inputs import CNN3DInput, cnn3d_to_ff
+        if isinstance(cur, CNN3DInput) and isinstance(layer, ff_like) \
+                and not isinstance(layer, L.RnnOutputLayer):
+            return cnn3d_to_ff(cur)
         if isinstance(cur, RNNInput) and isinstance(layer, L.DenseLayer) \
                 and not isinstance(layer, (L.OutputLayer,)):
             return rnn_to_ff(cur)

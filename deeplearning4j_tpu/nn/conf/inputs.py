@@ -97,6 +97,13 @@ def cnn_to_ff(t: CNNInput) -> Preprocessor:
                         lambda x: x.reshape(x.shape[0], -1), FFInput(size))
 
 
+def cnn3d_to_ff(t: "CNN3DInput") -> Preprocessor:
+    """Reference Cnn3DToFeedForwardPreProcessor analog (NCDHW flatten)."""
+    size = t.channels * t.depth * t.height * t.width
+    return Preprocessor("Cnn3DToFeedForward",
+                        lambda x: x.reshape(x.shape[0], -1), FFInput(size))
+
+
 def ff_to_cnn(t: FFInput, c: int, h: int, w: int) -> Preprocessor:
     return Preprocessor("FeedForwardToCnn",
                         lambda x: x.reshape(x.shape[0], c, h, w), CNNInput(c, h, w))

@@ -500,11 +500,17 @@ class GlobalPoolingLayer(Layer):
         if isinstance(input_type, RNNInput):
             self._mode = "rnn"
             return FFInput(input_type.size)
-        raise ValueError("GlobalPoolingLayer needs CNN or RNN input")
+        from .inputs import CNN3DInput
+        if isinstance(input_type, CNN3DInput):
+            self._mode = "cnn3d"
+            return FFInput(input_type.channels)
+        raise ValueError("GlobalPoolingLayer needs CNN/CNN3D/RNN input")
 
     def apply(self, params, x, state, training, rng, mask=None):
         kind = self.pooling_type.lower()
-        if x.ndim == 4:
+        if x.ndim == 5:    # NCDHW
+            axes = (2, 3, 4)
+        elif x.ndim == 4:
             axes = (2, 3)
         else:  # [B, T, F]
             axes = (1,)
@@ -608,6 +614,70 @@ class LSTM(Layer):
 class GravesLSTM(LSTM):
     """Reference GravesLSTM (peepholes omitted — deprecated upstream; the
     non-peephole path is identical to LSTM)."""
+
+
+@dataclass
+class GRU(Layer):
+    """GRU layer. ``reset_after=False`` is the reference gruCell form
+    (reset applied before the recurrent matmul — libnd4j
+    ``generic/recurrent/gruCell.cpp`` semantics); ``reset_after=True`` is
+    the CuDNN/Keras form, provided so Keras h5 checkpoints import exactly
+    (imports/keras_import.py)."""
+
+    n_out: int = 0
+    reset_after: bool = False
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, RNNInput):
+            raise ValueError("GRU needs RNN input [B, T, F]")
+        self.n_in = input_type.size
+        return RNNInput(self.n_out, input_type.timesteps)
+
+    def init_params(self, key, dtype=jnp.float32):
+        k1, k2, k3 = jax.random.split(key, 3)
+        wi = self.weight_init or "xavier"
+        p = {"W_ru": init_weights(k1, (self.n_in + self.n_out,
+                                       2 * self.n_out), wi, dtype),
+             "b_ru": jnp.zeros((2 * self.n_out,), dtype)}
+        if self.reset_after:
+            p["W_cx"] = init_weights(k2, (self.n_in, self.n_out), wi, dtype)
+            p["W_ch"] = init_weights(k3, (self.n_out, self.n_out), wi,
+                                     dtype)
+            p["b_cx"] = jnp.zeros((self.n_out,), dtype)
+            p["b_ch"] = jnp.zeros((self.n_out,), dtype)
+        else:
+            p["W_c"] = init_weights(k2, (self.n_in + self.n_out,
+                                         self.n_out), wi, dtype)
+            p["b_c"] = jnp.zeros((self.n_out,), dtype)
+        return p
+
+    def _run(self, params, x, h0=None):
+        if self.reset_after:
+            return get_op("gru_layer_ra").fn(
+                x, params["W_ru"], params["W_cx"], params["W_ch"],
+                params["b_ru"], params["b_cx"], params["b_ch"], h0=h0)
+        return get_op("gru_layer").fn(x, params["W_ru"], params["W_c"],
+                                      params["b_ru"], params["b_c"], h0=h0)
+
+    def apply(self, params, x, state, training, rng):
+        x = self._maybe_dropout(x, training, rng)
+        ys, _ = self._run(params, x)
+        return ys, state
+
+    def apply_masked(self, params, x, state, training, rng, fmask):
+        y, st = self.apply(params, x, state, training, rng)
+        return y * fmask[:, :, None].astype(y.dtype), st
+
+    def is_rnn(self):
+        return True
+
+    def init_rnn_state(self, batch, dtype=jnp.float32):
+        return jnp.zeros((batch, self.n_out), dtype)
+
+    def apply_rnn(self, params, x, rnn_state, state, training, rng):
+        x = self._maybe_dropout(x, training, rng)
+        ys, h = self._run(params, x, h0=rnn_state)
+        return ys, h, state
 
 
 @dataclass

@@ -465,6 +465,135 @@ class SpaceToBatchLayer(Layer):
 
 
 @dataclass
+class FlattenLayer(Layer):
+    """Explicit row-major flatten of the non-batch dims (the Keras-import
+    Flatten target: unlike the builder's automatic CnnToFeedForward
+    preprocessor, this works with ANY following layer, e.g.
+    Flatten→LayerNormalization→Dense)."""
+
+    def set_input_type(self, input_type):
+        if isinstance(input_type, FFInput):
+            self.n_in = input_type.size
+            return input_type
+        if isinstance(input_type, CNNInput):
+            n = input_type.channels * input_type.height * input_type.width
+        elif isinstance(input_type, CNN3DInput):
+            n = (input_type.channels * input_type.depth
+                 * input_type.height * input_type.width)
+        elif isinstance(input_type, RNNInput):
+            if input_type.timesteps is None:
+                raise ValueError("FlattenLayer needs known timesteps")
+            n = input_type.size * input_type.timesteps
+        else:
+            raise ValueError(f"FlattenLayer: unsupported {input_type}")
+        self.n_in = n
+        return FFInput(n)
+
+    def apply(self, params, x, state, training, rng):
+        return x.reshape(x.shape[0], -1), state
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
+class LayerNormalization(Layer):
+    """Feature-axis layer norm with learned gain/bias. FF input normalizes
+    [B, F] over F; RNN input [B, T, F] over F; CNN input [B, C, H, W] over
+    C (Keras's axis=-1 on NHWC == the channel dim, which is axis 1 in this
+    NCHW body). Backed by the registry ``layer_norm`` op."""
+
+    eps: float = 1e-3
+
+    def set_input_type(self, input_type):
+        if isinstance(input_type, FFInput):
+            self.n_in = input_type.size
+        elif isinstance(input_type, RNNInput):
+            self.n_in = input_type.size
+        elif isinstance(input_type, CNNInput):
+            self.n_in = input_type.channels
+        else:
+            raise ValueError("LayerNormalization needs FF/RNN/CNN input")
+        return input_type
+
+    def init_params(self, key, dtype=jnp.float32):
+        return {"gain": jnp.ones((self.n_in,), dtype),
+                "bias": jnp.zeros((self.n_in,), dtype)}
+
+    def apply(self, params, x, state, training, rng):
+        axis = 1 if x.ndim == 4 else -1
+        return get_op("layer_norm").fn(x, params["gain"], params["bias"],
+                                       axis=axis, epsilon=self.eps), state
+
+
+@dataclass
+class Permute(Layer):
+    """Permute non-batch dims of a sequence input (reference Keras-parity
+    helper; dims are 1-based like Keras's Permute). Only the [B, T, F]
+    layout is supported — image layouts differ between the Keras (NHWC)
+    and this body (NCHW), so a dims tuple would be ambiguous there."""
+
+    dims: Tuple[int, ...] = (2, 1)
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, RNNInput) or tuple(self.dims) \
+                not in ((2, 1), (1, 2)):
+            raise ValueError("Permute supports RNN input with dims "
+                             "(2,1)/(1,2) only")
+        self.n_in = input_type.size
+        if tuple(self.dims) == (1, 2):
+            return input_type
+        return RNNInput(input_type.timesteps, self.n_in)
+
+    def apply(self, params, x, state, training, rng):
+        if tuple(self.dims) == (2, 1):
+            x = jnp.swapaxes(x, 1, 2)
+        return x, state
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
+class ReshapeLayer(Layer):
+    """Reshape the non-batch dims (row-major). FF→FF, FF→RNN, RNN→FF,
+    RNN→RNN — image shapes are excluded for the same NHWC/NCHW ambiguity
+    Permute documents."""
+
+    shape: Tuple[int, ...] = ()
+
+    def set_input_type(self, input_type):
+        if isinstance(input_type, FFInput):
+            n = input_type.size
+        elif isinstance(input_type, RNNInput):
+            if input_type.timesteps is None:
+                raise ValueError("ReshapeLayer needs a known timestep count")
+            n = input_type.size * input_type.timesteps
+        else:
+            raise ValueError("ReshapeLayer supports FF/RNN input only")
+        import numpy as _np
+
+        if int(_np.prod(self.shape)) != n:
+            raise ValueError(f"cannot reshape {n} features into "
+                             f"{self.shape}")
+        self.n_in = n
+        if len(self.shape) == 1:
+            return FFInput(self.shape[0])
+        if len(self.shape) == 2:
+            return RNNInput(self.shape[1], self.shape[0])
+        raise ValueError("ReshapeLayer target rank must be 1 or 2")
+
+    def apply(self, params, x, state, training, rng):
+        return x.reshape((x.shape[0],) + tuple(self.shape)), state
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
 class RepeatVector(Layer):
     """[B, F] → [B, n, F] (reference RepeatVector)."""
 

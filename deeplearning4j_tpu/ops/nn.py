@@ -111,7 +111,17 @@ def deconv2d(x, w, b=None, strides=(1, 1), padding=(0, 0), data_format: str = "N
     sh, sw = _pair(strides)
     kh, kw = w.shape[2], w.shape[3]
     if isinstance(padding, str) and padding.upper() == "SAME":
-        pad = "SAME"
+        # SAME transposed conv (output = input×stride, TF/Keras contract):
+        # the gradient-of-forward-SAME-conv padding, pb_t = k-1-pb_f with
+        # pb_f = max(k-s,0)//2 — lax can't take a string here because the
+        # lhs is dilated
+        pad = []
+        for k, s in ((kh, sh), (kw, sw)):
+            tot_f = max(k - s, 0)
+            pb_f = tot_f // 2
+            pe_f = tot_f - pb_f
+            pad.append((k - 1 - pb_f, k - 1 - pe_f + max(s - k, 0)))
+        pad = tuple(pad)
     else:
         ph, pw = _pair(padding)
         pad = ((kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw))

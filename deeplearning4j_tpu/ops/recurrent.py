@@ -93,6 +93,35 @@ def gru_layer(x, w_ru, w_c, b_ru, b_c, h0=None, time_major: bool = False):
     return ys, h_t
 
 
+@op("gru_layer_ra", "recurrent")
+def gru_layer_ra(x, w_ru, w_cx, w_ch, b_ru, b_cx, b_ch, h0=None,
+                 time_major: bool = False):
+    """GRU with the CuDNN/Keras ``reset_after=True`` candidate form:
+    ``r,u = σ([x,h]·w_ru + b_ru)``;
+    ``c = tanh(x·w_cx + b_cx + r*(h·w_ch + b_ch))``;
+    ``h' = u*h + (1-u)*c``.
+    Distinct from :func:`gru_layer` (the v1 form resets BEFORE the
+    recurrent matmul); both exist because Keras h5 checkpoints default to
+    reset_after=True while the reference's gruCell is the v1 form."""
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)
+    t, bsz, _ = x.shape
+    n_out = w_cx.shape[1]
+    h = h0 if h0 is not None else jnp.zeros((bsz, n_out), dtype=x.dtype)
+
+    def step(h, xt):
+        ru = jax.nn.sigmoid(jnp.concatenate([xt, h], axis=-1) @ w_ru + b_ru)
+        r, u = jnp.split(ru, 2, axis=-1)
+        c = jnp.tanh(xt @ w_cx + b_cx + r * (h @ w_ch + b_ch))
+        h = u * h + (1.0 - u) * c
+        return h, h
+
+    h_t, ys = lax.scan(step, h, x)
+    if not time_major:
+        ys = jnp.swapaxes(ys, 0, 1)
+    return ys, h_t
+
+
 @op("simple_rnn_layer", "recurrent")
 def simple_rnn_layer(x, w, rw, b, h0=None, time_major: bool = False,
                      activation=jnp.tanh):

@@ -119,9 +119,9 @@ class TestFunctionalImport:
         assert float(net.score_value) < first * 0.7
 
     def test_unsupported_layer_raises_cleanly(self, tmp_path):
-        inp = keras.layers.Input((4, 6), name="in0")
-        g = keras.layers.GRU(5, return_sequences=True)(inp)
-        out = keras.layers.Dense(2)(g)
+        inp = keras.layers.Input((4, 4, 4, 2), name="in0")
+        g = keras.layers.ConvLSTM2D(3, 2, return_sequences=True)(inp)
+        out = keras.layers.GlobalAveragePooling3D()(g)
         m = keras.Model(inp, out)
         path = str(tmp_path / "m.h5")
         m.save(path)

@@ -188,10 +188,10 @@ class TestKerasSequentialImport:
 
     def test_unsupported_layer_raises_cleanly(self, tmp_path):
         m = keras.Sequential([
-            keras.layers.Input((4, 4)),
-            keras.layers.GRU(3, return_sequences=True),
+            keras.layers.Input((4, 4, 4, 2)),
+            keras.layers.ConvLSTM2D(3, 2, return_sequences=True),
         ])
         path = str(tmp_path / "m.h5")
         m.save(path)
-        with pytest.raises(UnsupportedKerasLayerError, match="GRU"):
+        with pytest.raises(UnsupportedKerasLayerError, match="ConvLSTM2D"):
             KerasModelImport.import_keras_sequential_model_and_weights(path)

@@ -716,6 +716,33 @@ class TestRecurrent:
         expected0 = np.tanh(x[:, 0] @ w)
         np.testing.assert_allclose(np.asarray(ys2)[:, 0], expected0, atol=1e-5)
 
+    def test_gru_reset_after_vs_torch(self):
+        # torch.nn.GRU implements exactly the reset_after form:
+        # n_t = tanh(W_in x + b_in + r*(W_hn h + b_hn))
+        import torch
+
+        b, t, nin, nout = 2, 5, 4, 3
+        x = r(b, t, nin)
+        g = torch.nn.GRU(nin, nout, batch_first=True)
+        wih = g.weight_ih_l0.detach().numpy()   # [3n, nin] rows r,z,n
+        whh = g.weight_hh_l0.detach().numpy()
+        bih = g.bias_ih_l0.detach().numpy()
+        bhh = g.bias_hh_l0.detach().numpy()
+        n = nout
+        w_ru = np.zeros((nin + n, 2 * n), np.float32)
+        w_ru[:nin, :n] = wih[:n].T          # r gate, input part
+        w_ru[:nin, n:] = wih[n:2 * n].T     # z gate, input part
+        w_ru[nin:, :n] = whh[:n].T
+        w_ru[nin:, n:] = whh[n:2 * n].T
+        b_ru = np.concatenate([bih[:n] + bhh[:n],
+                               bih[n:2 * n] + bhh[n:2 * n]])
+        ys, h = exec_op("gru_layer_ra", x, w_ru, wih[2 * n:].T.copy(),
+                        whh[2 * n:].T.copy(), b_ru, bih[2 * n:],
+                        bhh[2 * n:])
+        expected, _ = g(torch.tensor(x))
+        np.testing.assert_allclose(np.asarray(ys),
+                                   expected.detach().numpy(), atol=1e-5)
+
     def test_sru(self):
         b, t, n = 2, 6, 4
         x = r(b, t, n)
