@@ -123,6 +123,7 @@ class SequenceVectors(WordVectors):
                  iterations: int = 1, epochs: int = 1, batch_size: int = 512,
                  seed: int = 42, algorithm: str = "skipgram",
                  workers: int = 1, table_dtype: str = "float32",
+                 mesh=None, table_sharding_axis: str = "model",
                  special_tokens: Sequence[str] = ()):
         if use_hierarchic_softmax:
             # DOCUMENTED DIVERGENCE: the reference can train HS and negative
@@ -165,6 +166,15 @@ class SequenceVectors(WordVectors):
             raise ValueError(f"table_dtype must be float32|bfloat16, "
                              f"got {table_dtype!r}")
         self.table_dtype = table_dtype
+        # Row-sharded syn0/syn1 over a mesh axis — the reference's
+        # VoidParameterServer sharded exactly this workload (SURVEY §2.4
+        # row 4); here the device-windowed block runs under shard_map with
+        # psum-assembled row lookups (ops/embeddings.py sharded_skipgram).
+        if mesh is not None and self.use_hs:
+            raise ValueError("sharded tables support negative sampling "
+                             "only (use_hierarchic_softmax=False)")
+        self.mesh = mesh
+        self.table_sharding_axis = table_sharding_axis
         self._special_tokens = list(special_tokens)
         self.words_per_sec: float = 0.0
         super().__init__(VocabCache(), InMemoryLookupTable(0, layer_size))
@@ -538,9 +548,11 @@ class SequenceVectors(WordVectors):
                 x_ids.reshape(-1), mode="drop")
             return packed_c, packed_x, count
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def block(syn0, syn1, ids, sent, n_valid, negpool, p0, lr01, key,
-                  blk_id):
+        shard_axis = (self.table_sharding_axis if self.mesh is not None
+                      else None)
+
+        def block_fn(syn0, syn1, ids, sent, n_valid, negpool, p0, lr01, key,
+                     blk_id):
             key = jax.random.fold_in(key, blk_id)
             packed_c, packed_x, count = pack(ids, sent, n_valid, p0, key)
             lr0, lr1 = lr01
@@ -574,8 +586,12 @@ class SequenceVectors(WordVectors):
                     negs = jnp.where(negs == x[:, None], (negs + 1) % V,
                                      negs)
                     tgt = jnp.concatenate([x[:, None], negs], axis=1)
-                    s0, s1, loss = E.skipgram(s0, s1, c, tgt, lab, lr, pm,
-                                              dense=False)
+                    if shard_axis is not None:
+                        s0, s1, loss = E.sharded_skipgram(
+                            s0, s1, c, tgt, lab, lr, pm, axis=shard_axis)
+                    else:
+                        s0, s1, loss = E.skipgram(s0, s1, c, tgt, lab, lr,
+                                                  pm, dense=False)
                 return (r + 1, s0, s1, lsum + loss * pm.sum(),
                         wsum + pm.sum())
 
@@ -584,7 +600,20 @@ class SequenceVectors(WordVectors):
             _, syn0, syn1, lsum, wsum = lax.while_loop(cond, body, init)
             return (syn0, syn1, lsum / jnp.maximum(wsum, 1.0), wsum)
 
-        return block
+        if shard_axis is None:
+            return jax.jit(block_fn, donate_argnums=(0, 1))
+        # sharded tables: the pack + negatives run REPLICATED (all inputs
+        # replicated, deterministic ops), only table rows live split
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        tspec = P(shard_axis, None)
+        sharded = shard_map(
+            block_fn, mesh=self.mesh,
+            in_specs=(tspec, tspec, P(), P(), P(), P(), P(), P(), P(), P()),
+            out_specs=(tspec, tspec, P(), P()),
+            check_rep=False)
+        return jax.jit(sharded, donate_argnums=(0, 1))
 
     def _block_for(self, tag: str, make: Callable, *extra):
         """Shared block-function cache: rebuild (re-trace) only when the
@@ -627,7 +656,10 @@ class SequenceVectors(WordVectors):
             total_words = raw_words * self.epochs * self.iterations
 
         block = self._block_for("win", self._make_window_block,
-                                self.window, self._window_centers)
+                                self.window, self._window_centers,
+                                None if self.mesh is None
+                                else (id(self.mesh),
+                                      self.table_sharding_axis))
 
         flat = (np.concatenate(corpus) if corpus
                 else np.empty(0, np.int32)).astype(np.int32)
@@ -646,9 +678,30 @@ class SequenceVectors(WordVectors):
         base_key = jax.random.PRNGKey(self.seed)
         tdt = (jnp.bfloat16 if getattr(self, "table_dtype", "float32")
                == "bfloat16" else jnp.float32)
-        syn0 = jnp.asarray(self.lookup_table.syn0, tdt)
-        syn1 = jnp.asarray(self.lookup_table.syn1 if self.use_hs
-                           else self.lookup_table.syn1neg, tdt)
+        syn1_host = (self.lookup_table.syn1 if self.use_hs
+                     else self.lookup_table.syn1neg)
+        V = len(self.vocab)
+        if self.mesh is not None:
+            # row-shard the tables over the mesh axis (zero-padded to a
+            # shard multiple; pad rows are unreachable — ids < V)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            n_sh = self.mesh.shape[self.table_sharding_axis]
+            Vp = -(-V // n_sh) * n_sh
+            tsh = NamedSharding(self.mesh, P(self.table_sharding_axis,
+                                             None))
+            self._repl_sharding = NamedSharding(self.mesh, P())
+
+            def place(t):
+                padded = np.zeros((Vp, t.shape[1]), np.float32)
+                padded[:V] = np.asarray(t)
+                return jax.device_put(jnp.asarray(padded, tdt), tsh)
+
+            syn0, syn1 = place(self.lookup_table.syn0), place(syn1_host)
+        else:
+            self._repl_sharding = None
+            syn0 = jnp.asarray(self.lookup_table.syn0, tdt)
+            syn1 = jnp.asarray(syn1_host, tdt)
         losses, pair_counts = [], []
         n_blocks = 0
         words_seen = 0
@@ -667,7 +720,8 @@ class SequenceVectors(WordVectors):
         npad = -(-max(flat.size, 1) // self.CORPUS_BUCKET) \
             * self.CORPUS_BUCKET
         buf_len = npad + self._window_span + 2 * W
-        ckey = (flat.size, hash(flat.tobytes()), buf_len, str(idx_dt))
+        ckey = (flat.size, hash(flat.tobytes()), buf_len, str(idx_dt),
+                None if self.mesh is None else id(self.mesh))
         cached = getattr(self, "_corpus_dev_cache", None)
         if cached is not None and cached[0] == ckey:
             ids_full, sent_full_dev = cached[1]
@@ -676,9 +730,12 @@ class SequenceVectors(WordVectors):
             ids_np[W:W + flat.size] = flat.astype(idx_dt)
             sent_np = np.full(buf_len, np.iinfo(sent_dt).max, sent_dt)
             sent_np[W:W + flat.size] = sent_full
-            ids_full = jax.device_put(ids_np)
-            sent_full_dev = jax.device_put(sent_np)
+            ids_full = jax.device_put(ids_np, self._repl_sharding)
+            sent_full_dev = jax.device_put(sent_np, self._repl_sharding)
             self._corpus_dev_cache = (ckey, (ids_full, sent_full_dev))
+        if self.mesh is not None:
+            self._win_negpool = jax.device_put(self._win_negpool,
+                                               self._repl_sharding)
         n_raw = flat.size
 
         if self.sampling > 0:
@@ -743,12 +800,16 @@ class SequenceVectors(WordVectors):
         self.words_per_sec = words_seen / max(dt, 1e-9)
         self.pairs_per_sec = pairs_seen / max(dt, 1e-9)
         self.last_loss = float(last.mean()) if losses else 0.0
-        self.lookup_table.syn0 = np.asarray(syn0.astype(jnp.float32))
+        # [:V] strips the shard-padding rows of a mesh-sharded fit (no-op
+        # for the single-table path, whose row count is exactly V)
+        V = len(self.vocab)
+        self.lookup_table.syn0 = np.asarray(syn0.astype(jnp.float32))[:V]
         if self.use_hs:
-            self.lookup_table.syn1 = np.asarray(syn1.astype(jnp.float32))
+            self.lookup_table.syn1 = np.asarray(
+                syn1.astype(jnp.float32))[:V]
         else:
             self.lookup_table.syn1neg = np.asarray(
-                syn1.astype(jnp.float32))
+                syn1.astype(jnp.float32))[:V]
 
     def _train_encoded(self, corpus: List[np.ndarray],
                        stream_factory: Optional[Callable] = None,
@@ -773,6 +834,12 @@ class SequenceVectors(WordVectors):
         if (stream_factory is None and self.algorithm == "skipgram"
                 and getattr(self, "device_corpus", True)):
             return self._train_windowed(corpus, total_words)
+        if getattr(self, "mesh", None) is not None:
+            raise ValueError(
+                "sharded tables (mesh=...) are implemented for the "
+                "device-windowed skip-gram path only — CBOW, custom "
+                "streams (ParagraphVectors), and device_corpus=False "
+                "would silently train unsharded")
 
         rng = np.random.default_rng(self.seed)
         keep = subsample_keep_probs(self.vocab, self.sampling)
@@ -1018,6 +1085,13 @@ class Word2Vec(SequenceVectors):
         def batch_size(self, v): self._kw["batch_size"] = v; return self
         def workers(self, v): self._kw["workers"] = v; return self
         def table_dtype(self, v): self._kw["table_dtype"] = v; return self
+
+        def sharded_tables(self, mesh, axis: str = "model"):
+            """Row-shard syn0/syn1 over a mesh axis (the reference's
+            VoidParameterServer workload, run as compiled collectives)."""
+            self._kw["mesh"] = mesh
+            self._kw["table_sharding_axis"] = axis
+            return self
 
         def elements_learning_algorithm(self, name: str):
             self._kw["algorithm"] = \

@@ -949,9 +949,17 @@ class LastTimeStep(Layer):
 
 @dataclass
 class EmbeddingLayer(Layer):
-    """Reference conf.layers.EmbeddingLayer: int index [B] (or one-hot) → [B, nOut]."""
+    """Reference conf.layers.EmbeddingLayer: int index [B] (or one-hot) → [B, nOut].
+
+    ``table_sharding`` names a mesh axis to row-shard the table over
+    (SURVEY §2.4 row 4 — the VoidParameterServer translation). When the
+    layer runs inside a ``shard_map`` binding that axis (ParallelWrapper
+    with ``model_axis``), lookups become masked-local-gather + psum and
+    the gradient scatter touches only owned rows; outside any mesh the
+    layer behaves exactly like the dense one."""
 
     n_out: int = 0
+    table_sharding: Optional[str] = None
 
     def set_input_type(self, input_type):
         self.n_in = input_type.size  # vocab size
@@ -961,6 +969,16 @@ class EmbeddingLayer(Layer):
         return {"W": init_weights(key, (self.n_in, self.n_out),
                                   self.weight_init or "xavier", dtype)}
 
+    def _lookup(self, W, idx):
+        if self.table_sharding:
+            from ...ops.embeddings import sharded_rows_lookup
+            try:
+                rows, _ = sharded_rows_lookup(W, idx, self.table_sharding)
+                return rows
+            except NameError:
+                pass   # axis not bound: plain single-table lookup
+        return jnp.take(W, idx, axis=0)
+
     def apply(self, params, x, state, training, rng):
         if jnp.issubdtype(x.dtype, jnp.floating) and x.ndim == 2 and x.shape[-1] == self.n_in:
             idx = jnp.argmax(x, axis=-1)  # one-hot form
@@ -968,7 +986,7 @@ class EmbeddingLayer(Layer):
             idx = x.astype(jnp.int32)
             if idx.ndim == 2 and idx.shape[-1] == 1:
                 idx = idx[:, 0]
-        out = jnp.take(params["W"], idx, axis=0)
+        out = self._lookup(params["W"], idx)
         return activation_fn(self.activation or "identity")(out), state
 
 
@@ -985,7 +1003,7 @@ class EmbeddingSequenceLayer(EmbeddingLayer):
         idx = x.astype(jnp.int32)
         if idx.ndim == 3 and idx.shape[-1] == 1:
             idx = idx[..., 0]
-        out = jnp.take(params["W"], idx, axis=0)
+        out = self._lookup(params["W"], idx)
         return activation_fn(self.activation or "identity")(out), state
 
 

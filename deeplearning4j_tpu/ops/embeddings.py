@@ -121,6 +121,61 @@ def skipgram_hs(syn0, syn1, centers, points, codes, path_mask, lr, pair_mask,
     return syn0, syn1, loss
 
 
+# ---------------------------------------------------------------------------
+# Row-sharded variants (the VoidParameterServer workload, SURVEY §2.4 row 4):
+# tables live split over a mesh axis inside shard_map; lookups psum the
+# masked local gathers (the collective IS the parameter-server round-trip)
+# and updates touch only owned rows. Plain functions, not registry ops —
+# they only have meaning under a bound mesh axis.
+
+
+def sharded_local_offsets(table_l, ids, axis: str):
+    """Global ids → (clipped local offsets, ownership mask) for a row
+    shard [V/N, D] living at this device's position on ``axis``."""
+    from jax import lax
+
+    me = lax.axis_index(axis)
+    v_local = table_l.shape[0]
+    local = ids - me * v_local
+    hit = (local >= 0) & (local < v_local)
+    return jnp.clip(local, 0, v_local - 1), hit
+
+
+def sharded_rows_lookup(table_l, ids, axis: str):
+    """[B*] global ids → (psum-assembled rows [B*, D], (local, hit)) from a
+    row-sharded table shard [V/N, D]."""
+    from jax import lax
+
+    local, hit = sharded_local_offsets(table_l, ids, axis)
+    rows = table_l[local]
+    rows = rows * hit[..., None].astype(rows.dtype)
+    return lax.psum(rows, axis), (local, hit)
+
+
+def sharded_rows_add(table_l, aux, grads):
+    """Scatter-add grads into the owned rows only (duplicates sum)."""
+    local, hit = aux
+    g = grads * hit[..., None].astype(grads.dtype)
+    return table_l.at[local].add(g.astype(table_l.dtype))
+
+
+def sharded_skipgram(syn0_l, syn1_l, centers, targets, labels, lr,
+                     pair_mask, axis: str):
+    """:func:`skipgram` with syn0/syn1 row-sharded over ``axis`` (call
+    inside shard_map). Identical math: the psum-assembled h/u rows make the
+    NS round replicated; each shard then applies only its own row updates,
+    so the post-round GLOBAL table state equals the single-device round."""
+    h, aux_c = sharded_rows_lookup(syn0_l, centers, axis)
+    B, K1 = targets.shape
+    u_flat, aux_t = sharded_rows_lookup(syn1_l, targets.reshape(-1), axis)
+    u = u_flat.reshape(B, K1, -1)
+    grad_h, grad_u, loss = _neg_round(h, u, labels, lr, pair_mask)
+    d = syn0_l.shape[1]
+    syn0_l = sharded_rows_add(syn0_l, aux_c, grad_h)
+    syn1_l = sharded_rows_add(syn1_l, aux_t, grad_u.reshape(-1, d))
+    return syn0_l, syn1_l, loss
+
+
 @op("cbow", "nlp")
 def cbow(syn0, syn1neg, contexts, ctx_mask, targets, labels, lr, pair_mask,
          dense: bool = False):

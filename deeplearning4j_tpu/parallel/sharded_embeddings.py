@@ -57,24 +57,18 @@ class ShardedEmbedding:
     def _build(self) -> None:
         from jax.experimental.shard_map import shard_map
 
+        from ..ops.embeddings import (sharded_local_offsets,
+                                      sharded_rows_add, sharded_rows_lookup)
+
         axis = self.axis
 
         def local_lookup(table_l, ids):
-            me = lax.axis_index(axis)
-            v_local = table_l.shape[0]
-            local = ids - me * v_local
-            hit = (local >= 0) & (local < v_local)
-            rows = table_l[jnp.clip(local, 0, v_local - 1)]
-            rows = rows * hit[:, None].astype(rows.dtype)
-            return lax.psum(rows, axis)
+            rows, _ = sharded_rows_lookup(table_l, ids, axis)
+            return rows
 
         def local_update(table_l, ids, grads):
-            me = lax.axis_index(axis)
-            v_local = table_l.shape[0]
-            local = ids - me * v_local
-            hit = (local >= 0) & (local < v_local)
-            g = grads * hit[:, None].astype(grads.dtype)
-            return table_l.at[jnp.clip(local, 0, v_local - 1)].add(g)
+            aux = sharded_local_offsets(table_l, ids, axis)
+            return sharded_rows_add(table_l, aux, grads)
 
         repl = P()
         self._lookup = jax.jit(shard_map(

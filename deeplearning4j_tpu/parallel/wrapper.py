@@ -43,9 +43,18 @@ class ParallelWrapper:
             self._accumulator: Optional[GradientsAccumulator] = None
             self._prefetch = 2
             self._averaging_frequency = 1
+            self._model_axis = 1
 
         def workers(self, n: int) -> "ParallelWrapper.Builder":
             self._workers = n
+            return self
+
+        def model_axis(self, m: int) -> "ParallelWrapper.Builder":
+            """Devices along the mesh's ``model`` axis (workers must divide
+            by it). Layers with a ``table_sharding`` config (EmbeddingLayer
+            family) shard their tables over this axis — the product-API
+            route into the sharded-embedding machinery (SURVEY §2.4 row 4)."""
+            self._model_axis = int(m)
             return self
 
         def training_mode(self, mode: str) -> "ParallelWrapper.Builder":
@@ -71,14 +80,21 @@ class ParallelWrapper:
 
         def build(self) -> "ParallelWrapper":
             return ParallelWrapper(self._model, self._workers, self._mode,
-                                   self._accumulator or DenseAllReduceAccumulator())
+                                   self._accumulator
+                                   or DenseAllReduceAccumulator(),
+                                   model_axis=self._model_axis)
 
     def __init__(self, model, workers: Optional[int], mode: str,
-                 accumulator: GradientsAccumulator):
+                 accumulator: GradientsAccumulator, model_axis: int = 1):
         self.model = model
         n = workers or len(jax.devices())
-        self.mesh = make_mesh(data=n, model=1, devices=jax.devices()[:n])
-        self.workers_count = n
+        if n % model_axis:
+            raise ValueError(
+                f"workers={n} not divisible by model_axis={model_axis}")
+        self.mesh = make_mesh(data=n // model_axis, model=model_axis,
+                              devices=jax.devices()[:n])
+        self.workers_count = n // model_axis   # data-parallel shards
+        self.model_axis = model_axis
         self.mode = mode
         self.accumulator = accumulator
         self._step = None
@@ -126,13 +142,55 @@ class ParallelWrapper:
             new_params, new_upd = updater.apply(grads, upd_state, params, it)
             return new_params, new_states, new_upd, loss
 
+        pspec = self._param_specs()
+        uspec = self._upd_specs(pspec)
         sharded = shard_map(
             local_step, mesh=self.mesh,
-            in_specs=(P(), P(), P(), P("data"), P("data"), P("data"), P("data"),
-                      P(), P()),
-            out_specs=(P(), P(), P(), P()),
+            in_specs=(pspec, P(), uspec, P("data"), P("data"), P("data"),
+                      P("data"), P(), P()),
+            out_specs=(pspec, P(), uspec, P()),
             check_rep=False)
         return jax.jit(sharded, donate_argnums=(0, 1, 2))
+
+    def _param_specs(self):
+        """Per-layer partition specs: replicated except row-sharded
+        embedding tables (layers carrying ``table_sharding``)."""
+        model = self.model
+        if not hasattr(model.conf, "layers"):    # ComputationGraph
+            for name, node in getattr(model.conf, "nodes", {}).items():
+                lyr = getattr(node, "layer", None)
+                if getattr(lyr, "table_sharding", None):
+                    raise NotImplementedError(
+                        "table_sharding through ParallelWrapper is wired "
+                        "for MultiLayerNetwork; ComputationGraph tables "
+                        "are not routed yet")
+            return P()
+        specs = []
+        for layer in model.conf.layers:
+            ax = getattr(layer, "table_sharding", None)
+            if not ax:
+                specs.append(P())
+                continue
+            if ax not in self.mesh.shape:
+                raise ValueError(f"table_sharding={ax!r} is not a mesh "
+                                 f"axis of {tuple(self.mesh.shape)}")
+            n_sh = self.mesh.shape[ax]
+            if layer.n_in is None or layer.n_in % n_sh:
+                raise ValueError(
+                    f"embedding vocab {layer.n_in} must be divisible by "
+                    f"the {ax!r} axis size {n_sh} (pad the vocab)")
+            specs.append({"W": P(ax, None)})
+        return specs
+
+    def _upd_specs(self, pspec):
+        """Updater state mirrors params per top-level key (Adam m/v,
+        Nesterov v, ...) — shard those subtrees like the params."""
+        upd_state = self.model._updater_state
+        if not isinstance(upd_state, dict) or not upd_state:
+            return P()
+        pstruct = jax.tree.structure(self.model._params)
+        return {k: (pspec if jax.tree.structure(v) == pstruct else P())
+                for k, v in upd_state.items()}
 
     def fit(self, data, epochs: int = 1) -> None:
         model = self.model
