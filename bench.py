@@ -369,6 +369,91 @@ def bench_resnet50_disk(steps: int, batch: int = 64,
     }
 
 
+def bench_resnet50_predecoded(steps: int, batch: int = 64,
+                              image_size: int = 224) -> dict:
+    """ResNet-50 fed from the PRE-DECODED binary record container
+    (data/binary_records.py; VERDICT r3 item 4) — the same disk pipeline
+    as resnet50-disk but with JPEG decode paid ONCE at conversion: training
+    reads are memmap slices at page-cache speed. On this 1-core host the
+    decode-bound path does ~34 img/s; this shows what the container buys."""
+    import tempfile
+    from pathlib import Path
+
+    import jax
+
+    from deeplearning4j_tpu.data import (AsyncDataSetIterator,
+                                         BinaryRecordDataSetIterator)
+    from deeplearning4j_tpu.models import ResNet50
+
+    n_images = (max(steps, 10) + 2) * batch
+    container = Path(tempfile.gettempdir()) / \
+        f"d4t_bench_predec_{image_size}_{n_images}.d4tbin"
+    if not container.exists():
+        # decode-once conversion: synthesize pixels straight into the
+        # container (decoding n JPEGs first would take n/34 s on this
+        # 1-core host and measure nothing new — the round-trip fidelity of
+        # ImageRecordReader→write_records is covered in tests). Write to a
+        # temp name + rename so an interrupted conversion never leaves a
+        # truncated container that later runs would trust.
+        from deeplearning4j_tpu.data.binary_records import BinaryRecordWriter
+
+        rng = np.random.default_rng(0)
+        tmp = container.with_suffix(".tmp")
+        with BinaryRecordWriter(
+                str(tmp),
+                [("features", (3, image_size, image_size), "uint8"),
+                 ("label", (), "int32")], chunk_records=batch) as w:
+            for i in range(n_images):
+                w.append(rng.integers(0, 255,
+                                      (3, image_size, image_size),
+                                      dtype=np.uint8), i % 10)
+        os.replace(tmp, container)
+
+    model = ResNet50(num_classes=1000, image_size=image_size).init()
+    model.conf.global_conf.compute_dtype = "bfloat16"
+
+    import jax.numpy as jnp
+
+    # ship raw uint8 (4× less H2D traffic than f32), scale ON DEVICE, and
+    # keep the worker thread jax-free (raw_numpy): both the host f32 cast
+    # (~830 img/s on this 1-core host) and worker-thread device_put
+    # (catastrophic through the axon relay) are measured cliffs —
+    # BASELINE.md round-4 input-pipeline audit
+    base = BinaryRecordDataSetIterator(str(container), batch_size=batch,
+                                       num_classes=1000, raw_numpy=True)
+    it = AsyncDataSetIterator(
+        base, queue_size=8, device_prefetch=True,
+        feature_transform=lambda x: x.astype(jnp.float32) / 255.0)
+    gen = iter(it)
+    first = next(gen)
+    model.fit(first, epochs=1)     # warmup: compile the step
+    float(model._score_dev)
+
+    t0 = time.perf_counter()
+    n = 0
+    for ds in gen:
+        if n >= steps:
+            break
+        model.fit(ds, epochs=1)
+        n += 1
+    float(model._score_dev)
+    dt = time.perf_counter() - t0
+    gen.close()
+    return {
+        "metric": "resnet50_imagenet_train_predecoded",
+        "value": n * batch / dt,
+        "unit": "images/sec",
+        "steps_timed": n, "batch": batch,
+        "platform": jax.devices()[0].platform,
+        "image_size": image_size,
+        "dtype": "bf16 compute / fp32 params",
+        "container_bytes": container.stat().st_size,
+        "data": f"{n_images} pre-decoded uint8 records in a .d4tbin "
+                "container on disk -> memmap chunk reads -> async device "
+                "prefetch",
+    }
+
+
 def bench_word2vec(steps: int) -> dict:
     """North-star config 4: Word2Vec skip-gram + negative sampling over a
     synthetic zipfian corpus; throughput = corpus words consumed / sec
@@ -429,7 +514,7 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", default="resnet50",
                         choices=["lenet", "resnet50", "bert", "word2vec",
-                                 "resnet50-disk"])
+                                 "resnet50-disk", "resnet50-predecoded"])
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--batch", type=int, default=None,
                         help="per-config default: resnet50=128, bert=32")
@@ -450,6 +535,8 @@ def main() -> None:
         result = bench_word2vec(args.steps or 200)
     elif args.config == "resnet50-disk":
         result = bench_resnet50_disk(steps, batch=args.batch or 64)
+    elif args.config == "resnet50-predecoded":
+        result = bench_resnet50_predecoded(steps, batch=args.batch or 64)
     else:
         result = bench_resnet50(steps, batch=args.batch or 128,
                                 with_listener=args.with_listener)

@@ -19,3 +19,5 @@ from .reducers import Reducer, Join
 from .sequence import (convert_to_sequence, window_sequence,
                        window_sequences, reduce_sequence)
 from .analysis import AnalyzeLocal, DataAnalysis, ColumnAnalysis
+from .binary_records import (BinaryRecordWriter, BinaryRecordReader,
+                             BinaryRecordDataSetIterator, write_records)

@@ -183,10 +183,22 @@ class AsyncDataSetIterator(DataSetIterator):
     _END = object()
 
     def __init__(self, base: DataSetIterator, queue_size: int = 4,
-                 device_prefetch: bool = True):
+                 device_prefetch: bool = True,
+                 feature_transform=None):
         self.base = base
         self.queue_size = queue_size
         self.device_prefetch = device_prefetch
+        # Optional jax fn applied to the FEATURES on device after the put
+        # (e.g. ``lambda x: x.astype(jnp.float32) / 255`` for uint8 image
+        # containers: shipping the 4×-smaller raw bytes and converting on
+        # device moves the cast off the host decode thread — measured 5×
+        # on the 1-core bench host, BASELINE.md round-4 pre-decoded row)
+        if feature_transform is not None and not device_prefetch:
+            raise ValueError("feature_transform is applied on device and "
+                             "requires device_prefetch=True")
+        self._feature_transform = (None if feature_transform is None
+                                   else __import__("jax").jit(
+                                       feature_transform))
 
     def batch(self) -> int:
         return self.base.batch()
@@ -194,12 +206,27 @@ class AsyncDataSetIterator(DataSetIterator):
     def reset(self) -> None:
         self.base.reset()
 
-    def _stage(self, ds: DataSet) -> DataSet:
-        if not self.device_prefetch:
-            return ds
+    def _stage(self, ds) -> DataSet:
         import jax
 
         from ..ndarray.ndarray import NDArray
+
+        if isinstance(ds, tuple):
+            # raw numpy (x, y) from a jax-free worker (the binary-record
+            # fast path) — build the DataSet here on the consumer thread
+            x, y = ds
+            xd = NDArray(jax.device_put(x))
+            if self._feature_transform is not None:
+                xd = NDArray(self._feature_transform(xd.value))
+            out = DataSet.__new__(DataSet)
+            out.features = xd
+            out.labels = NDArray(jax.device_put(y)) if y is not None \
+                else None
+            out.features_mask = None
+            out.labels_mask = None
+            return out
+        if not self.device_prefetch:
+            return ds
 
         def put(nd):
             if nd is None:
@@ -208,6 +235,9 @@ class AsyncDataSetIterator(DataSetIterator):
 
         out = DataSet.__new__(DataSet)
         out.features = put(ds.features)
+        if self._feature_transform is not None and out.features is not None:
+            out.features = NDArray(
+                self._feature_transform(out.features.value))
         out.labels = put(ds.labels)
         out.features_mask = put(ds.features_mask)
         out.labels_mask = put(ds.labels_mask)
@@ -216,8 +246,15 @@ class AsyncDataSetIterator(DataSetIterator):
     def __iter__(self) -> Iterator[DataSet]:
         from ..common.background import prefetch_iter
 
-        # staging (device_put) runs on the worker thread so H2D transfer
-        # overlaps the consumer's step; the queue/shutdown/exception
-        # machinery is the shared prefetch_iter helper
-        yield from prefetch_iter((self._stage(ds) for ds in self.base),
-                                 maxsize=self.queue_size)
+        # Device staging runs on the CONSUMER thread. Round-4 measurement:
+        # device_put from a non-main thread through the axon relay
+        # serializes cross-thread array use catastrophically (11.7 s/step
+        # vs 84 ms for an identical ResNet batch), and consumer-side
+        # device_put is itself async, so nothing is lost on direct
+        # backends. CAVEAT: the worker thread is fully jax-free only for
+        # bases yielding raw (x, y) numpy tuples (binary-record
+        # ``raw_numpy=True``); bases that construct DataSet inside their
+        # own __next__ still touch jax there, because NDArray eagerly
+        # converts (ndarray.py) — prefer the tuple protocol for new bases.
+        for ds in prefetch_iter(iter(self.base), maxsize=self.queue_size):
+            yield self._stage(ds)
