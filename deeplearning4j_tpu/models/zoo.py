@@ -3,9 +3,11 @@
 Reference: dl4j-zoo ``org.deeplearning4j.zoo.model.{LeNet, AlexNet, VGG16,
 VGG19, ResNet50, SqueezeNet, Darknet19, TinyYOLO, UNet, SimpleCNN,
 TextGenerationLSTM, ...}`` (SURVEY.md §2.3). Architectures follow the
-reference's published configurations; ``init_pretrained`` has no weight server
-in this environment (zero egress) and raises with instructions instead of
-silently downloading.
+reference's published configurations; ``init_pretrained`` loads
+``PretrainedType``-keyed ModelSerializer containers from a LOCAL weight
+cache (``DL4J_TPU_PRETRAINED_DIR``) — this environment has no egress, so a
+missing entry raises with the exact path to populate instead of
+downloading (see ``ZooModel``).
 
 All CNN zoo models use NCHW like the reference; ResNet-50 is the
 ComputationGraph flagship (north-star config 2).
@@ -13,6 +15,7 @@ ComputationGraph flagship (north-star config 2).
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence, Tuple
 
 from ..learning.updaters import Adam, Nesterovs
@@ -24,18 +27,59 @@ from ..nn.graph import (ComputationGraph, ComputationGraphConfiguration,
 from ..nn.multilayer import MultiLayerNetwork
 
 
+class PretrainedType:
+    """Reference org.deeplearning4j.zoo.PretrainedType."""
+
+    IMAGENET = "imagenet"
+    MNIST = "mnist"
+    CIFAR10 = "cifar10"
+    VGGFACE = "vggface"
+
+
 class ZooModel:
-    """Base (reference org.deeplearning4j.zoo.ZooModel)."""
+    """Base (reference org.deeplearning4j.zoo.ZooModel).
+
+    ``init_pretrained`` follows the reference's API shape (a
+    ``PretrainedType``-keyed weight cache + ModelSerializer container) with
+    ONE documented divergence: the reference downloads missing weights
+    from Konduit's CDN; this environment has no network egress (SURVEY
+    §0), so the cache is local-only — a missing entry raises with the
+    exact path where a checkpoint must be placed. The cache directory is
+    ``$DL4J_TPU_PRETRAINED_DIR`` (default ``~/.deeplearning4j_tpu/
+    pretrained``); entries are ``<ModelClass>_<type>.zip`` ModelSerializer
+    containers (write one with ``util.model_serializer.write_model``)."""
 
     def init(self):
         raise NotImplementedError
 
-    def init_pretrained(self, kind: str = "imagenet"):
-        raise RuntimeError(
-            f"{type(self).__name__}: pretrained weights unavailable — this "
-            "environment has no network egress. Train from scratch via init() "
-            "or load a local checkpoint with MultiLayerNetwork/"
-            "ComputationGraph.load().")
+    @staticmethod
+    def pretrained_cache_dir() -> str:
+        return os.environ.get(
+            "DL4J_TPU_PRETRAINED_DIR",
+            os.path.join(os.path.expanduser("~"),
+                         ".deeplearning4j_tpu", "pretrained"))
+
+    def pretrained_path(self, kind: str = PretrainedType.IMAGENET) -> str:
+        return os.path.join(self.pretrained_cache_dir(),
+                            f"{type(self).__name__}_{kind}.zip")
+
+    def pretrained_available(self,
+                             kind: str = PretrainedType.IMAGENET) -> bool:
+        return os.path.exists(self.pretrained_path(kind))
+
+    def init_pretrained(self, kind: str = PretrainedType.IMAGENET):
+        from ..util.model_serializer import restore_model
+
+        path = self.pretrained_path(kind)
+        if not os.path.exists(path):
+            raise RuntimeError(
+                f"{type(self).__name__}: no pretrained {kind!r} weights in "
+                f"the local cache ({path}). This environment has no "
+                "network egress, so automatic download is unavailable — "
+                "place a ModelSerializer container at that path (or set "
+                "DL4J_TPU_PRETRAINED_DIR), or train from scratch via "
+                "init().")
+        return restore_model(path)
 
     initPretrained = init_pretrained
 

@@ -208,6 +208,11 @@ class MultiLayerConfiguration:
 
     @staticmethod
     def _preprocessor_for(cur: InputType, layer: L.Layer) -> Optional[Preprocessor]:
+        # frozen wrappers keep their inner layer's input contract
+        # (transfer learning freezes CNN feature extractors whose Dense
+        # heads still need the automatic CnnToFeedForward insertion)
+        if isinstance(layer, L.FrozenLayer) and layer.layer is not None:
+            layer = layer.layer
         ff_like = (L.DenseLayer, L.OutputLayer, L.ElementWiseMultiplicationLayer)
         if isinstance(cur, CNNFlatInput):
             return flat_to_cnn(cur)

@@ -96,6 +96,17 @@ def restore_computation_graph(path: str, load_updater: bool = False):
     return _restore(path, ComputationGraph, ComputationGraphConfiguration, load_updater)
 
 
+def restore_model(path: str, load_updater: bool = False):
+    """Restore either model class, dispatching on the container's
+    ``meta.json`` kind entry (reference ``ModelSerializer.restore*`` pair,
+    merged — the zip records what it holds)."""
+    with zipfile.ZipFile(path) as zf:
+        meta = json.loads(zf.read(_META_ENTRY))
+    if meta.get("kind") == "ComputationGraph":
+        return restore_computation_graph(path, load_updater)
+    return restore_multi_layer_network(path, load_updater)
+
+
 def restore_normalizer(path: str):
     from ..data.normalizers import normalizer_from_json
 

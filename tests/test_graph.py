@@ -289,9 +289,11 @@ class TestZoo:
         out = m.output(np.zeros((2, 7, 30), np.float32))
         assert out.shape == (2, 7, 30)
 
-    def test_pretrained_raises_helpfully(self):
+    def test_pretrained_raises_helpfully(self, tmp_path, monkeypatch):
         from deeplearning4j_tpu.models import LeNet
 
+        # empty cache dir: behavior must not depend on host ~/.deeplearning4j_tpu
+        monkeypatch.setenv("DL4J_TPU_PRETRAINED_DIR", str(tmp_path))
         with pytest.raises(RuntimeError, match="no network egress"):
             LeNet().init_pretrained()
 

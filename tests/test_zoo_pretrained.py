@@ -1,0 +1,99 @@
+"""Zoo init_pretrained (VERDICT r3 item 6; reference
+``ZooModel.initPretrained`` + ``PretrainedType``, SURVEY §2.3 zoo row).
+Remote download is environment-impossible (no egress, SURVEY §0) — the
+local weight-cache path is the API under test."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import DataSet
+from deeplearning4j_tpu.models import LeNet, PretrainedType, SimpleCNN
+from deeplearning4j_tpu.util.model_serializer import write_model
+
+rng = np.random.RandomState(5)
+
+
+def _mnist_batch(n=16):
+    x = rng.rand(n, 1, 28, 28).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+    return DataSet(x, y)
+
+
+@pytest.fixture()
+def cache(tmp_path, monkeypatch):
+    d = tmp_path / "pretrained"
+    d.mkdir()
+    monkeypatch.setenv("DL4J_TPU_PRETRAINED_DIR", str(d))
+    return d
+
+
+class TestInitPretrained:
+    def test_missing_weights_raise_with_cache_path(self, cache):
+        m = LeNet()
+        assert not m.pretrained_available(PretrainedType.MNIST)
+        with pytest.raises(RuntimeError) as e:
+            m.init_pretrained(PretrainedType.MNIST)
+        assert "LeNet_mnist.zip" in str(e.value)
+        assert "no network egress" in str(e.value)
+
+    def test_load_from_local_cache_fixture(self, cache):
+        # generate a small "pretrained" fixture locally: train LeNet a few
+        # steps, save it into the cache under the PretrainedType key
+        zoo = LeNet()
+        trained = zoo.init()
+        for _ in range(3):
+            trained.fit(_mnist_batch(), epochs=1)
+        write_model(trained, str(cache / "LeNet_mnist.zip"))
+
+        loaded = LeNet().init_pretrained(PretrainedType.MNIST)
+        x = _mnist_batch(4)
+        np.testing.assert_allclose(
+            loaded.output(x.features.to_numpy()).to_numpy(),
+            trained.output(x.features.to_numpy()).to_numpy(), atol=1e-6)
+
+    def test_transfer_learning_from_pretrained(self, cache):
+        """The first thing transfer-learning users do: initPretrained →
+        freeze the feature extractor → replace + train the head."""
+        from deeplearning4j_tpu.nn import (FineTuneConfiguration,
+                                           TransferLearning)
+        from deeplearning4j_tpu.learning import Sgd
+        from deeplearning4j_tpu.nn.conf import layers as L
+
+        zoo = SimpleCNN(num_classes=10)
+        base = zoo.init()
+        base.fit(_simple_batch(), epochs=1)
+        write_model(base, str(cache / "SimpleCNN_cifar10.zip"))
+
+        pre = SimpleCNN(num_classes=10) \
+            .init_pretrained(PretrainedType.CIFAR10)
+        n_layers = len(pre.conf.layers)
+        net = (TransferLearning.builder(pre)
+               .fine_tune_configuration(
+                   FineTuneConfiguration.builder()
+                   .updater(Sgd(learning_rate=0.01)).build())
+               .set_feature_extractor(n_layers - 2)
+               .remove_output_layer()
+               .add_layer(L.OutputLayer(n_out=3, loss="mcxent",
+                                        activation="softmax"))
+               .build())
+        frozen_w = np.asarray(net._params[0]["W"]).copy()
+        ds = DataSet(rng.rand(8, 3, 48, 48).astype(np.float32),
+                     np.eye(3, dtype=np.float32)[rng.randint(0, 3, 8)])
+        first = None
+        for _ in range(25):
+            net.fit(ds, epochs=1)
+            if first is None:
+                first = float(net.score_value)
+        assert float(net.score_value) < first
+        np.testing.assert_array_equal(np.asarray(net._params[0]["W"]),
+                                      frozen_w)
+
+
+def _simple_batch(n=8):
+    x = rng.rand(n, 3, 48, 48).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+    return DataSet(x, y)
