@@ -57,6 +57,55 @@ def resize_bilinear(x, height: int, width: int, align_corners: bool = False,
     return (top * (1 - wy) + bot * wy).astype(x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else dtype)
 
 
+@op("resize_bicubic", "image")
+def resize_bicubic(x, height: int, width: int):
+    """Keys cubic (a=-0.5) resize with half-pixel centers, the TF2
+    ``resize(method="bicubic")`` contract; x: [N, H, W, C]."""
+    n, _, _, c = x.shape
+    dtype = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
+    return jax.image.resize(x.astype(dtype), (n, height, width, c),
+                            method="cubic", antialias=False)
+
+
+def _area_weights(out_size: int, in_size: int):
+    """[out, in] interval-overlap weight matrix: output cell i averages the
+    source interval [i·s, (i+1)·s) (TF area-resize semantics)."""
+    import numpy as np
+
+    scale = in_size / out_size
+    wm = np.zeros((out_size, in_size), np.float32)
+    for i in range(out_size):
+        lo, hi = i * scale, (i + 1) * scale
+        j0, j1 = int(np.floor(lo)), int(np.ceil(hi))
+        for j in range(j0, min(j1, in_size)):
+            overlap = min(hi, j + 1) - max(lo, j)
+            if overlap > 0:
+                wm[i, j] = overlap / scale
+    return jnp.asarray(wm)
+
+
+@op("resize_area", "image")
+def resize_area(x, height: int, width: int):
+    """Box-integration (area) resize — each output pixel is the exact mean
+    of its source box (TF ``resize_area``); x: [N, H, W, C]. The overlap
+    weights are small dense [out, in] matrices so the whole resize is two
+    MXU-friendly contractions."""
+    n, h, w, c = x.shape
+    dtype = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
+    wy = _area_weights(height, h)
+    wx = _area_weights(width, w)
+    return jnp.einsum("oh,nhwc,pw->nopc", wy, x.astype(dtype),
+                      wx).astype(dtype)
+
+
+@op("adjust_gamma", "image")
+def adjust_gamma(x, gamma: float = 1.0, gain: float = 1.0):
+    """out = gain * x**gamma (reference adjust_gamma / tf.image); integer
+    inputs promote to float32 like the sibling image ops."""
+    dtype = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
+    return (gain * jnp.power(x.astype(dtype), gamma)).astype(dtype)
+
+
 @op("rgb_to_hsv", "image")
 def rgb_to_hsv(x):
     r, g, b = x[..., 0], x[..., 1], x[..., 2]
