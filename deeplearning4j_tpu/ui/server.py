@@ -1,0 +1,189 @@
+"""Training-UI web server (reference: ``deeplearning4j-ui``
+``VertxUIServer`` / ``UIServer.getInstance().attach(storage)``, SURVEY
+§5.5 — the "optional tiny web dashboard" half of the named TPU
+equivalent; TensorBoard event files remain the primary dashboard).
+
+A stdlib ``http.server`` on a background thread serving:
+
+- ``/``                 — single-page dashboard (inline HTML/JS/SVG; no
+                          external assets — this environment has no
+                          egress, and the reference bundles its JS too)
+- ``/api/tags``         — JSON list of scalar tags across attached stores
+- ``/api/series?tag=t`` — JSON ``[[step, value], ...]`` for one tag
+- ``/healthz``          — liveness
+
+Any attached :class:`InMemoryStatsStorage` (queried live) or JSONL path
+written by :class:`FileStatsStorage` (re-read per request) feeds the
+charts; the page polls every 2 s, so a training run with a
+``StatsListener`` attached renders a live loss curve exactly like the
+reference's overview tab.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from .stats import FileStatsStorage, InMemoryStatsStorage
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>deeplearning4j-tpu UI</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:24px;background:#fafafa}
+ h1{font-size:18px} .tag{margin:18px 0}
+ svg{background:#fff;border:1px solid #ddd} .axis{stroke:#999}
+ text{font-size:11px;fill:#555} polyline{fill:none;stroke:#2a6fdb;stroke-width:1.5}
+ .latest{color:#2a6fdb;font-weight:600}
+</style></head><body>
+<h1>deeplearning4j-tpu training UI</h1>
+<div id="charts"></div>
+<script>
+function esc(s){const d=document.createElement('div');d.textContent=s;return d.innerHTML;}
+async function refresh(){
+  const tags = await (await fetch('/api/tags')).json();
+  const root = document.getElementById('charts');
+  for (const tag of tags){
+    const pts = await (await fetch('/api/series?tag='+encodeURIComponent(tag))).json();
+    if (!pts.length) continue;
+    let div = document.getElementById('c_'+tag);
+    if (!div){
+      div = document.createElement('div'); div.className='tag'; div.id='c_'+tag;
+      root.appendChild(div);
+    }
+    const W=640,H=180,P=36;
+    const xs=pts.map(p=>p[0]), ys=pts.map(p=>p[1]);
+    const x0=Math.min(...xs), x1=Math.max(...xs)||1;
+    const y0=Math.min(...ys), y1=Math.max(...ys);
+    const sx=s=>P+(W-2*P)*(s-x0)/Math.max(x1-x0,1e-9);
+    const sy=v=>H-P-(H-2*P)*(v-y0)/Math.max(y1-y0,1e-9);
+    const line=pts.map(p=>sx(p[0]).toFixed(1)+','+sy(p[1]).toFixed(1)).join(' ');
+    div.innerHTML = '<b>'+esc(tag)+'</b> <span class="latest">'+
+      ys[ys.length-1].toPrecision(5)+'</span> (step '+xs[xs.length-1]+')<br>'+
+      '<svg width="'+W+'" height="'+H+'">'+
+      '<line class="axis" x1="'+P+'" y1="'+(H-P)+'" x2="'+(W-P)+'" y2="'+(H-P)+'"/>'+
+      '<line class="axis" x1="'+P+'" y1="'+P+'" x2="'+P+'" y2="'+(H-P)+'"/>'+
+      '<text x="'+P+'" y="'+(H-P+14)+'">'+x0+'</text>'+
+      '<text x="'+(W-P-30)+'" y="'+(H-P+14)+'">'+x1+'</text>'+
+      '<text x="2" y="'+(H-P)+'">'+y0.toPrecision(3)+'</text>'+
+      '<text x="2" y="'+(P+4)+'">'+y1.toPrecision(3)+'</text>'+
+      '<polyline points="'+line+'"/></svg>';
+  }
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>
+"""
+
+
+class UIServer:
+    """Reference-shaped singleton: ``UIServer.get_instance().attach(...)``
+    then ``enable()`` (reference ``attachUI``/port 9000 convention)."""
+
+    _instance: Optional["UIServer"] = None
+    _lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._stores: List[Any] = []
+        self._paths: List[str] = []
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def get_instance(cls) -> "UIServer":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    getInstance = get_instance
+
+    # -- wiring ----------------------------------------------------------
+    def attach(self, storage) -> "UIServer":
+        """Attach an InMemoryStatsStorage (live queries) or a JSONL stats
+        path / FileStatsStorage (re-read per request)."""
+        if isinstance(storage, str):
+            self._paths.append(storage)
+        elif isinstance(storage, FileStatsStorage):
+            self._paths.append(storage.path)
+        elif hasattr(storage, "records"):
+            self._stores.append(storage)
+        else:
+            raise TypeError(
+                f"cannot attach {type(storage).__name__}: need an "
+                "InMemoryStatsStorage, a FileStatsStorage, or a JSONL "
+                "path (TensorBoardStatsStorage is viewed with "
+                "`tensorboard --logdir`, not this server)")
+        return self
+
+    def detach_all(self) -> None:
+        self._stores = []
+        self._paths = []
+
+    # -- data ------------------------------------------------------------
+    def _records(self) -> List[Dict[str, Any]]:
+        recs: List[Dict[str, Any]] = []
+        for s in self._stores:
+            recs.extend(getattr(s, "records", []))
+        for p in self._paths:
+            try:
+                recs.extend(FileStatsStorage.read(p))
+            except (OSError, ValueError):
+                pass
+        return recs
+
+    def tags(self) -> List[str]:
+        return sorted({r["tag"] for r in self._records()})
+
+    def series(self, tag: str) -> List[Tuple[int, float]]:
+        return sorted((r["step"], r["value"]) for r in self._records()
+                      if r["tag"] == tag)
+
+    # -- server ----------------------------------------------------------
+    def enable(self, port: int = 9000) -> int:
+        """Start serving (reference default port 9000; pass 0 for an
+        ephemeral port). Returns the bound port."""
+        if self._httpd is not None:
+            return self._httpd.server_address[1]
+        ui = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):    # quiet
+                pass
+
+            def _send(self, body: bytes, ctype: str, code: int = 200):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                u = urlparse(self.path)
+                if u.path == "/":
+                    self._send(_PAGE.encode(), "text/html; charset=utf-8")
+                elif u.path == "/healthz":
+                    self._send(b"ok", "text/plain")
+                elif u.path == "/api/tags":
+                    self._send(json.dumps(ui.tags()).encode(),
+                               "application/json")
+                elif u.path == "/api/series":
+                    tag = parse_qs(u.query).get("tag", [""])[0]
+                    self._send(json.dumps(ui.series(tag)).encode(),
+                               "application/json")
+                else:
+                    self._send(b"not found", "text/plain", 404)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None

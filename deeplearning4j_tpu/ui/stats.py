@@ -66,14 +66,26 @@ class FileStatsStorage(StatsStorage):
         self._f.write(json.dumps({"session": session, "tag": tag,
                                   "step": step, "value": float(value),
                                   "time": time.time()}) + "\n")
+        # per-write flush: a live dashboard (UIServer) re-reads this file
+        # per request, and buffered records would lag it by ~8 KB
+        self._f.flush()
 
     def close(self):
         self._f.close()
 
     @staticmethod
     def read(path: str) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
         with open(path) as f:
-            return [json.loads(l) for l in f if l.strip()]
+            for line in f:
+                if not line.strip():
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    # torn tail line of a file being written concurrently
+                    continue
+        return out
 
 
 class TensorBoardStatsStorage(StatsStorage):

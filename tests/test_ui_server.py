@@ -1,0 +1,125 @@
+"""Web dashboard (reference VertxUIServer / UIServer.getInstance(),
+SURVEY §5.5 — the optional-dashboard half; VERDICT r3 missing #5)."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.ui import (FileStatsStorage, InMemoryStatsStorage,
+                                   StatsListener, UIServer)
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=10) as r:
+        return r.status, r.read()
+
+
+class TestUIServer:
+    def test_serves_dashboard_and_series(self):
+        store = InMemoryStatsStorage()
+        for i in range(5):
+            store.put_scalar("s0", "score", i, 1.0 / (i + 1))
+        ui = UIServer()
+        ui.attach(store)
+        port = ui.enable(port=0)
+        try:
+            code, body = _get(port, "/")
+            assert code == 200 and b"training UI" in body
+            code, body = _get(port, "/api/tags")
+            assert json.loads(body) == ["score"]
+            code, body = _get(port, "/api/series?tag=score")
+            series = json.loads(body)
+            assert series[0] == [0, 1.0] and len(series) == 5
+            code, _ = _get(port, "/healthz")
+            assert code == 200
+        finally:
+            ui.stop()
+
+    def test_live_updates_visible(self):
+        store = InMemoryStatsStorage()
+        ui = UIServer()
+        ui.attach(store)
+        port = ui.enable(port=0)
+        try:
+            _, body = _get(port, "/api/series?tag=loss")
+            assert json.loads(body) == []
+            store.put_scalar("s", "loss", 1, 0.5)
+            _, body = _get(port, "/api/series?tag=loss")
+            assert json.loads(body) == [[1, 0.5]]
+        finally:
+            ui.stop()
+
+    def test_jsonl_stats_file_attach(self, tmp_path):
+        path = str(tmp_path / "stats.jsonl")
+        fs = FileStatsStorage(path)
+        fs.put_scalar("s", "score", 0, 2.0)
+        fs.put_scalar("s", "score", 1, 1.0)   # put_scalar flushes per write
+        ui = UIServer()
+        ui.attach(path)
+        port = ui.enable(port=0)
+        try:
+            _, body = _get(port, "/api/series?tag=score")
+            assert json.loads(body) == [[0, 2.0], [1, 1.0]]
+        finally:
+            ui.stop()
+            fs.close()
+
+    def test_attach_rejects_tensorboard_storage(self, tmp_path):
+        import pytest
+
+        from deeplearning4j_tpu.ui import TensorBoardStatsStorage
+
+        ui = UIServer()
+        with pytest.raises(TypeError, match="tensorboard --logdir"):
+            ui.attach(TensorBoardStatsStorage(str(tmp_path)))
+
+    def test_torn_jsonl_line_skipped(self, tmp_path):
+        path = tmp_path / "stats.jsonl"
+        path.write_text('{"session":"s","tag":"score","step":0,'
+                        '"value":1.0,"time":0}\n{"session":"s","ta')
+        ui = UIServer()
+        ui.attach(str(path))
+        port = ui.enable(port=0)
+        try:
+            _, body = _get(port, "/api/series?tag=score")
+            assert json.loads(body) == [[0, 1.0]]
+        finally:
+            ui.stop()
+
+    def test_training_feeds_dashboard(self):
+        """The reference wiring: model + StatsListener + attached UI."""
+        from deeplearning4j_tpu.data import DataSet
+        from deeplearning4j_tpu.learning import Sgd
+        from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf import layers as L
+
+        store = InMemoryStatsStorage()
+        conf = (NeuralNetConfiguration.builder().seed(1)
+                .updater(Sgd(learning_rate=0.1)).list()
+                .layer(L.DenseLayer(n_out=8, activation="tanh"))
+                .layer(L.OutputLayer(n_out=2, activation="softmax",
+                                     loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        model.set_listeners(StatsListener(store, collect_every_n=1))
+        rng = np.random.RandomState(0)
+        ds = DataSet(rng.randn(16, 4).astype(np.float32),
+                     np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)])
+        model.fit(ds, epochs=5)
+        ui = UIServer()
+        ui.attach(store)
+        port = ui.enable(port=0)
+        try:
+            _, body = _get(port, "/api/tags")
+            tags = json.loads(body)
+            assert "score" in tags
+            _, body = _get(port, "/api/series?tag=score")
+            assert len(json.loads(body)) >= 5
+        finally:
+            ui.stop()
