@@ -227,3 +227,11 @@ def normalize_moments(counts, mean_ss, var_ss, shift: float = 0.0):
 @op("zero_fraction", "reduce", differentiable=False)
 def zero_fraction(x):
     return jnp.mean((x == 0).astype(jnp.float32))
+
+
+@op("percentile", "reduce", differentiable=False)
+def percentile(x, q: float, axis=None, interpolation: str = "linear"):
+    """Reference percentile op; interpolation per numpy (linear|lower|
+    higher|nearest|midpoint)."""
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.percentile(x, q, axis=ax, method=interpolation)

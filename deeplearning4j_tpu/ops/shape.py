@@ -451,3 +451,57 @@ def space_to_batch(x, block_shape, paddings):
     b0, b1 = block_shape
     out = x.reshape(n, h // b0, b0, w // b1, b1, c).transpose(2, 4, 0, 1, 3, 5)
     return out.reshape(n * b0 * b1, h // b0, w // b1, c)
+
+
+@op("mirror_pad", "shape")
+def mirror_pad(x, paddings, mode: str = "reflect"):
+    """TF MirrorPad semantics (reference mirror_pad op): mode "reflect"
+    excludes the edge value from the mirror, "symmetric" includes it."""
+    m = mode.lower()
+    if m not in ("reflect", "symmetric"):
+        raise ValueError(f"mirror_pad mode must be reflect|symmetric, got {mode!r}")
+    pads = tuple((int(lo), int(hi)) for lo, hi in paddings)
+    return jnp.pad(x, pads, mode=m)
+
+
+@op("searchsorted", "shape", differentiable=False)
+def searchsorted(sorted_seq, values, side: str = "left"):
+    return jnp.searchsorted(sorted_seq, values, side=side)
+
+
+@op("bincount", "shape", differentiable=False)
+def bincount(x, weights=None, length=None, maxlength=None):
+    """Reference/TF bincount with a STATIC output length (XLA shapes
+    cannot grow with max(x) the way numpy's ``minlength`` does — that
+    param is deliberately absent so its grows-to-fit semantics can't be
+    assumed). Values ≥ length are dropped, matching TF's
+    ``maxlength`` contract."""
+    n = length or maxlength
+    if not n:
+        raise ValueError("bincount needs a static output length "
+                         "(length=/maxlength=)")
+    return jnp.bincount(jnp.asarray(x, jnp.int32).reshape(-1),
+                        weights=None if weights is None
+                        else jnp.asarray(weights).reshape(-1),
+                        length=int(n))
+
+
+@op("histogram_fixed_width", "shape", differentiable=False)
+def histogram_fixed_width(x, value_range, nbins: int = 100):
+    """Reference histogram_fixed_width: counts per equal-width bin over
+    ``value_range``, outliers clamped to the edge bins."""
+    lo, hi = value_range[0], value_range[1]
+    xf = jnp.asarray(x, jnp.float32).reshape(-1)
+    idx = jnp.clip(((xf - lo) / jnp.maximum(hi - lo, 1e-30)
+                    * nbins).astype(jnp.int32), 0, nbins - 1)
+    return jnp.zeros((nbins,), jnp.int32).at[idx].add(1)
+
+
+@op("nth_element", "shape", differentiable=False)
+def nth_element(x, n: int, reverse: bool = False):
+    """n-th smallest (or largest with reverse=True) along the last axis
+    (reference nth_element)."""
+    s = jnp.sort(x, axis=-1)
+    if reverse:
+        s = jnp.flip(s, axis=-1)
+    return s[..., n]

@@ -374,3 +374,72 @@ def tf_strided_slice(x, spec=None):
         else:
             raise ValueError(f"bad strided-slice spec entry {ent!r}")
     return x[tuple(idx)]
+
+
+# --- round-4 tail: special functions + utility transforms the reference
+# ships as generic ops (libnd4j generic/parity_ops + transforms; SURVEY
+# §2.2) that were still absent from the registry ------------------------
+
+
+@op("lgamma", "transform")
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+@op("digamma", "transform")
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+@op("polygamma", "transform")
+def polygamma(n, x):
+    return jax.scipy.special.polygamma(jnp.asarray(n, jnp.int32), x)
+
+
+@op("zeta", "transform")
+def zeta(x, q):
+    """Hurwitz zeta (reference zeta op)."""
+    return jax.scipy.special.zeta(x, q)
+
+
+@op("igamma", "transform")
+def igamma(a, x):
+    """Regularized lower incomplete gamma P(a, x)."""
+    return jax.scipy.special.gammainc(a, x)
+
+
+@op("igammac", "transform")
+def igammac(a, x):
+    """Regularized upper incomplete gamma Q(a, x) = 1 - P(a, x)."""
+    return jax.scipy.special.gammaincc(a, x)
+
+
+@op("betainc", "transform", differentiable=False)
+def betainc(a, b, x):
+    """Regularized incomplete beta. Marked non-differentiable: jax defines
+    no gradient w.r.t. a/b (only x), so the conservative contract holds."""
+    return jax.scipy.special.betainc(a, b, x)
+
+
+@op("erfinv", "transform")
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+@op("roll", "transform")
+def roll(x, shift, axis=None):
+    """Circular shift (reference roll op)."""
+    if axis is None:
+        return jnp.roll(x, shift)
+    axis = tuple(axis) if isinstance(axis, (list, tuple)) else int(axis)
+    shift = tuple(shift) if isinstance(shift, (list, tuple)) else int(shift)
+    return jnp.roll(x, shift, axis)
+
+
+@op("standardize", "transform")
+def standardize(x, dims=(-1,)):
+    """Zero-mean unit-variance along ``dims`` (reference standardize op)."""
+    dims = tuple(dims) if isinstance(dims, (list, tuple)) else (int(dims),)
+    mean = jnp.mean(x, axis=dims, keepdims=True)
+    std = jnp.std(x, axis=dims, keepdims=True)
+    return (x - mean) / jnp.maximum(std, 1e-12)
