@@ -9,15 +9,15 @@ reduced window, unigram^0.75 negative sampling or Huffman hierarchical
 softmax, linear LR decay — but restructures the hot loop hardware-first
 (BASELINE.md "Word2Vec audit" records the measurements behind each choice):
 
-- DEFAULT skip-gram path (``_train_windowed``): the compacted corpus is
-  uploaded ONCE and lives on device; every scanned round derives its
-  windows, draws negatives from a device-resident unigram table, and
-  scatter-updates only the sampled table rows. Host→device traffic is ~2
-  bytes per corpus word — sized for the measured 5–10 MB/s relay link.
-- custom streams (ParagraphVectors) and CBOW use the host pair pipeline:
-  vectorized/native pair generation buffered into fixed-size uint16
-  column blocks, staged to device from a producer thread
-  (``common/background.prefetch_iter``) so upload overlaps execution;
+- DEFAULT paths — skip-gram AND CBOW (``_train_windowed``, round 4): the
+  corpus is uploaded ONCE and lives on device; every dispatch derives its
+  windows there (shifted slices), draws negatives from a pre-drawn pool,
+  and scatter-updates only the touched table rows. Skip-gram additionally
+  compacts its (center, context) pairs densely before training.
+- custom streams (ParagraphVectors) and ``device_corpus=False`` use the
+  host pair pipeline: vectorized/native pair generation buffered into
+  fixed-size uint16 column blocks, staged to device from a producer
+  thread (``common/background.prefetch_iter``);
 - both paths run ONE jitted ``lax.scan`` block per dispatch
   (``ops/embeddings.py`` fused rounds, tables donated) and compile exactly
   ONE block shape per fit;
@@ -493,50 +493,24 @@ class SequenceVectors(WordVectors):
             self._win_negpool = jnp.zeros((8,), jnp.int32)
         else:
             lab = jnp.zeros((B, 1 + K), jnp.float32).at[:, 0].set(1.0)
-            if B * K >= self.NEG_POOL_SIZE:
-                raise ValueError(
-                    f"batch_size×negative ({B}×{K}) needs more negatives "
-                    f"per round than NEG_POOL_SIZE={self.NEG_POOL_SIZE}; "
-                    "lower batch_size/negative or raise NEG_POOL_SIZE")
             # Pre-drawn negative POOL, walked with a prime stride per round
             # instead of a per-dispatch C×K table gather (round-4 trace:
             # that gather cost MORE than the training loop). word2vec.c
             # itself walks its 1e8-slot table with an LCG — a fixed
             # pseudo-random pool consumed at pseudo-random offsets is the
             # same statistical device, built from the unigram^0.75 table.
-            T = ntable_dev.shape[0]
-            M = self.NEG_POOL_SIZE
-            kp = jax.random.PRNGKey((self.seed ^ 0x5DEECE66) & 0x7FFFFFFF)
-            bits = jax.random.bits(kp, (M,), jnp.uint32)
-            self._win_negpool = ntable_dev[(bits & (T - 1)).astype(
-                jnp.int32)]
-        offs_host = list(range(-W, 0)) + list(range(1, W + 1))
+            self._win_negpool = self._build_negpool(ntable_dev, B * K)
 
         def pack(ids, sent, n_valid, p0, kb):
             """Derive + compact this span's pairs → ([C] centers, [C]
-            contexts, count). Contexts come from 2W STATIC shifted slices
-            of one contiguous dynamic-slice window (corpus buffers carry W
-            front-pad sentinel slots; stream position p = buffer index
-            p+W) — the round-3 element-granular ids[q] gathers were the
-            single most expensive fusion in the device trace. Compaction
-            is an order-preserving cumsum→scatter, so pairs train in
-            corpus order exactly as before."""
-            idw = lax.dynamic_slice(ids, (p0,), (S + 2 * W,)) \
-                .astype(jnp.int32)
-            sw = lax.dynamic_slice(sent, (p0,), (S + 2 * W,)) \
-                .astype(jnp.int32)
-            c_ids = idw[W:W + S]
-            c_sent = sw[W:W + S]
-            p = p0 + lax.broadcasted_iota(jnp.int32, (S,), 0)
-            live = p < n_valid        # pad/garbage slots carry the uint16
-            b = jax.random.randint(kb, (S,), 1, W + 1)  # sentinel sent id,
-            x_cols, v_cols = [], []   # so sent equality rejects them
-            for o in offs_host:
-                x_cols.append(idw[W + o:W + o + S])
-                v_cols.append((b >= abs(o)) & live
-                              & (sw[W + o:W + o + S] == c_sent))
-            x_ids = jnp.stack(x_cols, 1)                # [S, 2W]
-            valid = jnp.stack(v_cols, 1)
+            contexts, count). Window derivation is the shared
+            ``_derive_windows`` (shifted slices — the round-3
+            element-granular ids[q] gathers were the single most expensive
+            fusion in the device trace). Compaction is an order-preserving
+            cumsum→scatter, so pairs train in corpus order exactly as
+            before."""
+            c_ids, x_ids, valid, _ = _derive_windows(
+                ids, sent, n_valid, p0, S, W, kb)
             vf = valid.reshape(-1)
             dest = jnp.cumsum(vf.astype(jnp.int32)) - 1
             count = jnp.minimum(dest[-1] + 1, C)
@@ -575,16 +549,7 @@ class SequenceVectors(WordVectors):
                         s0, s1, c, points_d[x], codes_d[x], mask_d[x],
                         lr, pm, dense=False)
                 else:
-                    # stride-walk the pool; rounds per dispatch < 131
-                    g = (blk_id.astype(jnp.uint32) * jnp.uint32(131)
-                         + r.astype(jnp.uint32))
-                    start = ((g * jnp.uint32(48611))
-                             % jnp.uint32(negpool.shape[0] - B * K)) \
-                        .astype(jnp.int32)
-                    negs = lax.dynamic_slice(negpool, (start,),
-                                             (B * K,)).reshape(B, K)
-                    negs = jnp.where(negs == x[:, None], (negs + 1) % V,
-                                     negs)
+                    negs = _pool_negs(negpool, blk_id, r, B, K, V, x)
                     tgt = jnp.concatenate([x[:, None], negs], axis=1)
                     if shard_axis is not None:
                         s0, s1, loss = E.sharded_skipgram(
@@ -615,6 +580,100 @@ class SequenceVectors(WordVectors):
             check_rep=False)
         return jax.jit(sharded, donate_argnums=(0, 1))
 
+    @property
+    def _cbow_centers(self) -> int:
+        """Examples per device-windowed CBOW round (same tiny-vocab
+        stability cap rationale as ``_round_pairs``)."""
+        return max(1, min(self.batch_size, 8 * max(len(self.vocab), 1)))
+
+    # -- shared device-window helpers (skip-gram + CBOW blocks) ----------
+    def _build_negpool(self, ntable_dev, round_negs: int):
+        """Pre-drawn negative pool (see _make_window_block docstring);
+        shared by both windowed blocks so the stride/seed/size contracts
+        cannot drift between algorithms."""
+        import jax
+        import jax.numpy as jnp
+
+        if round_negs >= self.NEG_POOL_SIZE:
+            raise ValueError(
+                f"negatives per round ({round_negs}) must be below "
+                f"NEG_POOL_SIZE={self.NEG_POOL_SIZE}; lower batch_size/"
+                "negative or raise NEG_POOL_SIZE")
+        T = ntable_dev.shape[0]
+        kp = jax.random.PRNGKey((self.seed ^ 0x5DEECE66) & 0x7FFFFFFF)
+        bits = jax.random.bits(kp, (self.NEG_POOL_SIZE,), jnp.uint32)
+        return ntable_dev[(bits & (T - 1)).astype(jnp.int32)]
+
+    def _make_cbow_window_block(self, hs_dev=None, ntable_dev=None):
+        """Device-windowed CBOW block (round-4): the corpus lives on
+        device and every dispatch derives a span of S = B_C·R center
+        positions' context windows there — contexts from 2W shifted
+        slices, masked mean in the kernel. Unlike skip-gram there is
+        nothing to compact: every in-bounds position IS one example, so a
+        plain fixed-R ``lax.scan`` is already dense (examples whose
+        reduced window is empty carry pair-mask 0). Negatives ride the
+        same pre-drawn pool as the skip-gram block. Statistical procedure
+        matches the host CBOW path (reduced windows, masked mean,
+        NS/HS on the center word)."""
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..ops import embeddings as E
+
+        is_hs = self.use_hs
+        V, K, W = len(self.vocab), self.negative, self.window
+        B_C = self._cbow_centers
+        R = self.MAX_BLOCK_ROUNDS
+        S = B_C * R
+        if is_hs:
+            points_d, codes_d, mask_d = hs_dev
+            self._win_negpool = jnp.zeros((8,), jnp.int32)
+        else:
+            lab = jnp.zeros((B_C, 1 + K), jnp.float32).at[:, 0].set(1.0)
+            self._win_negpool = self._build_negpool(ntable_dev, B_C * K)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def block(syn0, syn1, ids, sent, n_valid, negpool, p0, lr01, key,
+                  blk_id):
+            key = jax.random.fold_in(key, blk_id)
+            c_ids, ctx_all, valid, live = _derive_windows(
+                ids, sent, n_valid, p0, S, W, key)
+            cm_all = valid.astype(jnp.float32)
+            lr0, lr1 = lr01
+
+            def body(carry, r):
+                s0, s1 = carry
+                sl = r * B_C
+                c = lax.dynamic_slice(c_ids, (sl,), (B_C,))
+                cx = lax.dynamic_slice(ctx_all, (sl, jnp.int32(0)),
+                                       (B_C, 2 * W))
+                cm = lax.dynamic_slice(cm_all, (sl, jnp.int32(0)),
+                                       (B_C, 2 * W))
+                lv = lax.dynamic_slice(live, (sl,), (B_C,))
+                pm = (lv & (cm.sum(axis=1) > 0)).astype(jnp.float32)
+                lr = lr0 + (lr1 - lr0) * r.astype(jnp.float32) / R
+                if is_hs:
+                    s0, s1, loss = E.cbow_hs(
+                        s0, s1, cx, cm, points_d[c], codes_d[c],
+                        mask_d[c], lr, pm, dense=False)
+                else:
+                    negs = _pool_negs(negpool, blk_id, r, B_C, K, V, c)
+                    tgt = jnp.concatenate([c[:, None], negs], axis=1)
+                    s0, s1, loss = E.cbow(s0, s1, cx, cm, tgt, lab, lr,
+                                          pm, dense=False)
+                return (s0, s1), (loss, pm.sum())
+
+            (syn0, syn1), (losses, ns) = lax.scan(
+                body, (syn0, syn1), jnp.arange(R, dtype=jnp.int32))
+            return (syn0, syn1,
+                    (losses * ns).sum() / jnp.maximum(ns.sum(), 1.0),
+                    ns.sum())
+
+        return block
+
     def _block_for(self, tag: str, make: Callable, *extra):
         """Shared block-function cache: rebuild (re-trace) only when the
         config/vocab the closure captures actually changed. ``make``
@@ -640,13 +699,14 @@ class SequenceVectors(WordVectors):
 
     def _train_windowed(self, corpus: List[np.ndarray],
                         total_words: Optional[int] = None) -> None:
-        """Skip-gram fit with device-resident corpus (see
-        ``_make_window_block``). Statistical procedure matches
-        ``_train_encoded``: frequent-word subsampling + stream compaction
-        per epoch (ON DEVICE since round 4 — ``_subsample_fn``, keyed off
-        a dedicated fold of the base key), reduced windows, NS from the
-        unigram^0.75 pool or HS Huffman paths, linear LR decay by
-        corpus-words consumed."""
+        """Device-resident-corpus fit for BOTH algorithms: skip-gram
+        (``_make_window_block``, dense-packed pairs) and CBOW
+        (``_make_cbow_window_block``, one example per position).
+        Statistical procedure matches the host path: frequent-word
+        subsampling + stream compaction per epoch (ON DEVICE since round
+        4 — ``_subsample_fn``, keyed off a dedicated fold of the base
+        key), reduced windows, NS from the unigram^0.75 pool or HS
+        Huffman paths, linear LR decay by corpus-words consumed."""
         import jax
         import jax.numpy as jnp
 
@@ -655,11 +715,19 @@ class SequenceVectors(WordVectors):
         if total_words is None:
             total_words = raw_words * self.epochs * self.iterations
 
-        block = self._block_for("win", self._make_window_block,
-                                self.window, self._window_centers,
-                                None if self.mesh is None
-                                else (id(self.mesh),
-                                      self.table_sharding_axis))
+        is_cbow = self.algorithm == "cbow"
+        if is_cbow and self.mesh is not None:
+            raise ValueError("sharded tables support the skip-gram "
+                             "windowed path only (no sharded CBOW kernel)")
+        if is_cbow:
+            block = self._block_for("cwin", self._make_cbow_window_block,
+                                    self.window, self._cbow_centers)
+        else:
+            block = self._block_for("win", self._make_window_block,
+                                    self.window, self._window_centers,
+                                    None if self.mesh is None
+                                    else (id(self.mesh),
+                                          self.table_sharding_axis))
 
         flat = (np.concatenate(corpus) if corpus
                 else np.empty(0, np.int32)).astype(np.int32)
@@ -719,7 +787,9 @@ class SequenceVectors(WordVectors):
         W = self.window
         npad = -(-max(flat.size, 1) // self.CORPUS_BUCKET) \
             * self.CORPUS_BUCKET
-        buf_len = npad + self._window_span + 2 * W
+        span = (self._cbow_centers * self.MAX_BLOCK_ROUNDS if is_cbow
+                else self._window_span)   # positions per dispatch
+        buf_len = npad + span + 2 * W
         ckey = (flat.size, hash(flat.tobytes()), buf_len, str(idx_dt),
                 None if self.mesh is None else id(self.mesh))
         cached = getattr(self, "_corpus_dev_cache", None)
@@ -754,8 +824,6 @@ class SequenceVectors(WordVectors):
         else:
             n_exp = float(n_raw)
             n_loop = n_raw
-
-        span = self._window_span     # positions per packed dispatch
 
         def lr_at(frac: float) -> np.float32:
             return np.float32(max(
@@ -821,25 +889,28 @@ class SequenceVectors(WordVectors):
         skip-gram configs or ``(centers, ctx, cmask)`` for CBOW configs.
         ParagraphVectors uses this to inject doc-label ids into the stream.
 
-        Plain skip-gram fits (no custom stream) use the device-windowed
-        path (``_train_windowed``) — corpus resident on device, pairs
-        derived there. Custom streams and CBOW use the host pair pipeline
-        below (native ``sg_pairs`` C++ producer + background staging).
-        ``device_corpus=False`` on the instance forces the host path.
+        Plain fits (no custom stream) — skip-gram AND CBOW — use the
+        device-windowed path (``_train_windowed``): corpus resident on
+        device, windows derived there. Custom streams (ParagraphVectors)
+        use the host pair pipeline below (native ``sg_pairs`` C++ producer
+        + background staging); ``device_corpus=False`` on the instance
+        forces the host path for either algorithm.
         """
         import jax.numpy as jnp
 
         import jax
 
-        if (stream_factory is None and self.algorithm == "skipgram"
+        if (stream_factory is None
                 and getattr(self, "device_corpus", True)):
+            # both algorithms ride the device-windowed corpus (round 4:
+            # CBOW derives its windows on device too)
             return self._train_windowed(corpus, total_words)
         if getattr(self, "mesh", None) is not None:
             raise ValueError(
                 "sharded tables (mesh=...) are implemented for the "
-                "device-windowed skip-gram path only — CBOW, custom "
-                "streams (ParagraphVectors), and device_corpus=False "
-                "would silently train unsharded")
+                "device-windowed paths only — custom streams "
+                "(ParagraphVectors) and device_corpus=False would "
+                "silently train unsharded")
 
         rng = np.random.default_rng(self.seed)
         keep = subsample_keep_probs(self.vocab, self.sampling)
@@ -1059,6 +1130,46 @@ class SequenceVectors(WordVectors):
         labels = np.zeros((B, 1 + K), dtype=np.float32)
         labels[:, 0] = 1.0
         return targets, labels
+
+
+def _derive_windows(ids, sent, n_valid, p0, S, W, key):
+    """Shared device window derivation for the windowed blocks: one
+    contiguous dynamic-slice window (buffers carry W front-pad sentinel
+    slots; stream position p = buffer index p+W), contexts as 2W STATIC
+    shifted slices, validity from reduced window b ~ U[1, W] + sentence
+    equality + stream bounds. Returns (c_ids [S], ctx [S, 2W],
+    valid [S, 2W] bool, live [S] bool)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    idw = lax.dynamic_slice(ids, (p0,), (S + 2 * W,)).astype(jnp.int32)
+    sw = lax.dynamic_slice(sent, (p0,), (S + 2 * W,)).astype(jnp.int32)
+    c_ids = idw[W:W + S]
+    c_sent = sw[W:W + S]
+    p = p0 + lax.broadcasted_iota(jnp.int32, (S,), 0)
+    live = p < n_valid
+    b = jax.random.randint(key, (S,), 1, W + 1)
+    ctx_cols, v_cols = [], []
+    for o in list(range(-W, 0)) + list(range(1, W + 1)):
+        ctx_cols.append(idw[W + o:W + o + S])
+        v_cols.append((b >= abs(o)) & live
+                      & (sw[W + o:W + o + S] == c_sent))
+    return (c_ids, jnp.stack(ctx_cols, 1), jnp.stack(v_cols, 1), live)
+
+
+def _pool_negs(negpool, blk_id, r, B, K, V, positives):
+    """Stride-walk a [B, K] window of the pre-drawn pool for round ``r``
+    of dispatch ``blk_id`` and collision-shift against ``positives``
+    (rounds per dispatch < 131; uint32 math so the product wraps safely)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    g = blk_id.astype(jnp.uint32) * jnp.uint32(131) + r.astype(jnp.uint32)
+    start = ((g * jnp.uint32(48611))
+             % jnp.uint32(negpool.shape[0] - B * K)).astype(jnp.int32)
+    negs = lax.dynamic_slice(negpool, (start,), (B * K,)).reshape(B, K)
+    return jnp.where(negs == positives[:, None], (negs + 1) % V, negs)
 
 
 class Word2Vec(SequenceVectors):

@@ -298,6 +298,26 @@ class TestWord2Vec:
         diff = _mean_sim(w, [("a0", f"b{i}") for i in range(5)])
         assert same > diff + 0.4, (same, diff)
 
+    def test_cbow_hierarchical_softmax_learns(self):
+        # CBOW + HS through the round-4 device-windowed path
+        w = Word2Vec(min_word_frequency=5, layer_size=24, negative=0,
+                     use_hierarchic_softmax=True, algorithm="cbow",
+                     epochs=8, batch_size=256, seed=6)
+        w.set_sentence_iterator(_cluster_corpus(1000))
+        w.fit()
+        same = _mean_sim(w, [("a0", f"a{i}") for i in range(1, 6)])
+        diff = _mean_sim(w, [("a0", f"b{i}") for i in range(5)])
+        assert same > diff + 0.3, (same, diff)
+
+    def test_cbow_host_path_still_available(self):
+        # device_corpus=False keeps the round-3 host pair pipeline
+        w = Word2Vec(min_word_frequency=5, layer_size=16, negative=3,
+                     algorithm="cbow", epochs=2, batch_size=128, seed=2)
+        w.device_corpus = False
+        w.set_sentence_iterator(_cluster_corpus(300, sent_len=8))
+        w.fit()
+        assert np.isfinite(w.last_loss)
+
     def test_subsampling_and_iterations_run(self):
         w = Word2Vec(min_word_frequency=2, layer_size=16, negative=3,
                      sampling=1e-2, iterations=2, epochs=2, batch_size=128,
