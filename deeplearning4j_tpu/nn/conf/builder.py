@@ -40,6 +40,12 @@ class GlobalConf:
     # Mixed precision: forward/backward compute dtype (e.g. "bfloat16" for the
     # MXU) while params stay in `dtype` and the loss reduces in float32.
     compute_dtype: Optional[str] = None
+    # Rematerialization: wrap each layer apply in jax.checkpoint so the
+    # backward pass recomputes activations instead of storing them —
+    # trades FLOPs for HBM (the TPU answer to big models / long context;
+    # absent from the reference, whose workspaces only recycle, not
+    # recompute). Gradients are bit-identical either way.
+    gradient_checkpointing: bool = False
 
 
 class NeuralNetConfiguration:
@@ -92,6 +98,13 @@ class Builder:
     def compute_dtype(self, dtype: str) -> "Builder":
         """bf16 compute with fp32 master params (TPU mixed precision)."""
         self._conf.compute_dtype = dtype
+        return self
+
+    def gradient_checkpointing(self, v: bool = True) -> "Builder":
+        """Rematerialize per-layer activations in backward
+        (jax.checkpoint): ~constant activation memory in depth for extra
+        forward FLOPs; gradients unchanged."""
+        self._conf.gradient_checkpointing = bool(v)
         return self
 
     def list(self) -> "ListBuilder":

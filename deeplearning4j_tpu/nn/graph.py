@@ -443,8 +443,15 @@ class ComputationGraph:
                     x = f32(x)
                 acts[name] = node.layer.pre_output(head_params, x)
             else:
-                y, st = node.layer.apply(params.get(name, {}), x,
-                                         states.get(name, {}), training, sub)
+                def run(lp, xx, st, k, _l=node.layer):
+                    return _l.apply(lp, xx, st, training, k)
+
+                if self.conf.global_conf.gradient_checkpointing and training:
+                    # rematerialize this node's activations in backward
+                    # (see GlobalConf.gradient_checkpointing)
+                    run = jax.checkpoint(run)
+                y, st = run(params.get(name, {}), x,
+                            states.get(name, {}), sub)
                 acts[name] = y
                 if st:
                     new_states[name] = st

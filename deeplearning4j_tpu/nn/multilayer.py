@@ -109,13 +109,22 @@ class MultiLayerNetwork:
     # --- forward ---------------------------------------------------------
     def _apply_layer(self, layer, lp, x, st, training, rng, fmask):
         """One layer forward, routing through apply_masked when a
-        per-timestep feature mask is present (SURVEY §5.7)."""
-        if layer.weight_noise is not None:
-            rng, sub = jax.random.split(rng)
-            lp = layer.weight_noise.apply(lp, sub, training)
-        if fmask is not None:
-            return layer.apply_masked(lp, x, st, training, rng, fmask)
-        return layer.apply(lp, x, st, training, rng)
+        per-timestep feature mask is present (SURVEY §5.7). With
+        ``gradient_checkpointing`` the whole layer apply is wrapped in
+        jax.checkpoint: backward rematerializes this layer's activations
+        instead of keeping them live across the step."""
+
+        def run(lp, x, st, rng, fmask):
+            if layer.weight_noise is not None:
+                rng, sub = jax.random.split(rng)
+                lp = layer.weight_noise.apply(lp, sub, training)
+            if fmask is not None:
+                return layer.apply_masked(lp, x, st, training, rng, fmask)
+            return layer.apply(lp, x, st, training, rng)
+
+        if self.conf.global_conf.gradient_checkpointing and training:
+            run = jax.checkpoint(run)
+        return run(lp, x, st, rng, fmask)
 
     def _forward(self, params, states, x, training: bool, rng, fmask=None):
         """Single traced forward pass through preprocessors + layers."""
@@ -147,8 +156,15 @@ class MultiLayerNetwork:
                 x = pre(x)
             rng, sub = jax.random.split(rng)
             if rnn_states is not None and layer.is_rnn():
-                x, r, st = layer.apply_rnn(params[i], x, rnn_states[i],
-                                           states[i], training, sub)
+                def run_rnn(lp, xx, rs, st, k, _l=layer):
+                    return _l.apply_rnn(lp, xx, rs, st, training, k)
+
+                if self.conf.global_conf.gradient_checkpointing and training:
+                    # TBPTT recurrent segments are exactly where
+                    # activation memory bites — remat them too
+                    run_rnn = jax.checkpoint(run_rnn)
+                x, r, st = run_rnn(params[i], x, rnn_states[i],
+                                   states[i], sub)
                 if fmask is not None:
                     x = x * fmask[:, :, None].astype(x.dtype)
                 new_rnn.append(r)

@@ -304,6 +304,153 @@ class TestTBPTT:
 
 
 # ------------------------------------------------------ transfer learning
+class TestGradientCheckpointing:
+    """jax.checkpoint rematerialization knob: same math, less activation
+    memory (TPU-first capability; no reference counterpart — its
+    workspaces recycle but never recompute)."""
+
+    def _fit_once(self, remat: bool, graph: bool = False):
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 8).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)]
+        b = (NeuralNetConfiguration.builder().seed(7)
+             .updater(Sgd(learning_rate=0.1)))
+        if remat:
+            b = b.gradient_checkpointing(True)
+        if graph:
+            from deeplearning4j_tpu.nn import (ComputationGraph,
+                                               ComputationGraphConfiguration)
+            from deeplearning4j_tpu.nn.conf import layers as LL
+
+            gb = (ComputationGraphConfiguration.graph_builder(b)
+                  .add_inputs("in"))
+            gb.add_layer("d1", LL.DenseLayer(n_out=16, activation="tanh"),
+                         "in")
+            gb.add_layer("d2", LL.DenseLayer(n_out=16, activation="relu"),
+                         "d1")
+            gb.add_layer("out", LL.OutputLayer(n_out=3, loss="mcxent",
+                                               activation="softmax"), "d2")
+            conf = (gb.set_outputs("out")
+                    .set_input_types(InputType.feed_forward(8)).build())
+            model = ComputationGraph(conf).init()
+            for _ in range(5):
+                model.fit(DataSet(x, y))
+            return model
+        conf = (b.list()
+                .layer(L.DenseLayer(n_out=16, activation="tanh"))
+                .layer(L.DenseLayer(n_out=16, activation="relu"))
+                .layer(L.OutputLayer(n_out=3, loss="mcxent",
+                                     activation="softmax"))
+                .set_input_type(InputType.feed_forward(8))
+                .build())
+        model = MultiLayerNetwork(conf).init()
+        for _ in range(5):
+            model.fit(DataSet(x, y))
+        return model
+
+    def test_mln_params_match_without_remat(self):
+        base = self._fit_once(remat=False)
+        remat = self._fit_once(remat=True)
+        for i in range(len(base._params)):
+            for k in base._params[i]:
+                np.testing.assert_allclose(
+                    np.asarray(remat._params[i][k]),
+                    np.asarray(base._params[i][k]), atol=1e-6)
+
+    def test_remat_shrinks_activation_memory(self):
+        """XLA's own memory analysis: temp (activation) buffers of the
+        compiled grad step shrink under rematerialization ON TPU
+        (measured on the real chip: 791 MB → 0 MB for a 24×2048 Dense
+        stack at batch 4096). The CPU backend's scheduler does NOT show
+        the win (its remat graph allocates MORE temp), so this assertion
+        only runs on hardware — the CPU-mesh suite covers grad
+        correctness via the params-match tests above."""
+        import jax
+
+        if jax.devices()[0].platform not in ("tpu", "axon"):
+            import pytest
+
+            pytest.skip("memory win is a TPU-scheduling property")
+
+        # big enough that activations can't hide in fused scratch: at
+        # 24×2048 wide, batch 4096, the non-remat grad step keeps ~790 MB
+        # of temp activation buffers
+        B, D = 4096, 2048
+
+        def temp_bytes(remat):
+            m = self._deep_stack(remat, D)
+            x = jnp.asarray(np.random.RandomState(0)
+                            .randn(B, D).astype(np.float32))
+            y = jnp.asarray(np.eye(3, dtype=np.float32)[
+                np.random.RandomState(1).randint(0, 3, B)])
+            key = jax.random.PRNGKey(0)
+
+            def loss_fn(params):
+                loss, _ = m._loss(params, m._states, x, y, None, True, key)
+                return loss
+
+            comp = jax.jit(jax.grad(loss_fn)).lower(m._params).compile()
+            return comp.memory_analysis().temp_size_in_bytes
+
+        base, remat = temp_bytes(False), temp_bytes(True)
+        assert remat < base * 0.5, (base, remat)
+
+    def _deep_stack(self, remat, width=256):
+        b = (NeuralNetConfiguration.builder().seed(1)
+             .updater(Sgd(learning_rate=0.01)))
+        if remat:
+            b = b.gradient_checkpointing(True)
+        lb = b.list()
+        for _ in range(24):
+            lb.layer(L.DenseLayer(n_out=width, activation="tanh"))
+        conf = (lb.layer(L.OutputLayer(n_out=3, loss="mcxent",
+                                       activation="softmax"))
+                .set_input_type(InputType.feed_forward(width)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_tbptt_rnn_params_match_without_remat(self):
+        """The apply_rnn TBPTT branch remats too (review finding: the
+        knob must not be a silent no-op on exactly the long-sequence
+        workloads it targets)."""
+
+        def fit(remat):
+            b = (NeuralNetConfiguration.builder().seed(9)
+                 .updater(Sgd(learning_rate=0.05)))
+            if remat:
+                b = b.gradient_checkpointing(True)
+            conf = (b.list()
+                    .layer(L.LSTM(n_out=8))
+                    .layer(L.RnnOutputLayer(n_out=2, loss="mcxent",
+                                            activation="softmax"))
+                    .backprop_type("TruncatedBPTT").tbptt_length(4)
+                    .set_input_type(InputType.recurrent(2, 12))
+                    .build())
+            model = MultiLayerNetwork(conf).init()
+            rng = np.random.RandomState(0)
+            x = rng.randn(8, 12, 2).astype(np.float32)
+            y = np.eye(2, dtype=np.float32)[
+                (np.cumsum(x[:, :, 0], axis=1) > 0).astype(int)]
+            for _ in range(4):
+                model.fit(DataSet(x, y), epochs=1)
+            return model
+
+        base, remat = fit(False), fit(True)
+        for i in range(len(base._params)):
+            for k in base._params[i]:
+                np.testing.assert_allclose(
+                    np.asarray(remat._params[i][k]),
+                    np.asarray(base._params[i][k]), atol=1e-6)
+
+    def test_graph_params_match_without_remat(self):
+        base = self._fit_once(remat=False, graph=True)
+        remat = self._fit_once(remat=True, graph=True)
+        for name in base._params:
+            for k in base._params[name]:
+                np.testing.assert_allclose(
+                    np.asarray(remat._params[name][k]),
+                    np.asarray(base._params[name][k]), atol=1e-6)
+
+
 class TestTransferLearning:
     def _base_model(self):
         conf = (NeuralNetConfiguration.builder().seed(11)
