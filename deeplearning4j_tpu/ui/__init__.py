@@ -10,10 +10,10 @@ for programmatic queries, or JSONL. Device-side kernel traces come from
 from .stats import (FileStatsStorage, InMemoryStatsStorage, StatsListener,
                     StatsStorage, TensorBoardStatsStorage)
 from .tensorboard import TensorBoardEventWriter, read_scalar_events
-from .server import UIServer
+from .server import RemoteUIStatsStorageRouter, UIServer
 
 __all__ = [
     "FileStatsStorage", "InMemoryStatsStorage", "StatsListener",
     "StatsStorage", "TensorBoardStatsStorage", "TensorBoardEventWriter",
-    "read_scalar_events", "UIServer",
+    "read_scalar_events", "UIServer", "RemoteUIStatsStorageRouter",
 ]

@@ -27,7 +27,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
-from .stats import FileStatsStorage, InMemoryStatsStorage
+from .stats import (FileStatsStorage, InMemoryStatsStorage,
+                    StatsStorage)
 
 _PAGE = """<!DOCTYPE html>
 <html><head><meta charset="utf-8"><title>deeplearning4j-tpu UI</title>
@@ -89,6 +90,9 @@ class UIServer:
         self._paths: List[str] = []
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # records POSTed by RemoteUIStatsStorageRouter clients
+        self._remote = InMemoryStatsStorage()
+        self._stores.append(self._remote)
 
     @classmethod
     def get_instance(cls) -> "UIServer":
@@ -118,7 +122,7 @@ class UIServer:
         return self
 
     def detach_all(self) -> None:
-        self._stores = []
+        self._stores = [self._remote]
         self._paths = []
 
     # -- data ------------------------------------------------------------
@@ -175,6 +179,34 @@ class UIServer:
                 else:
                     self._send(b"not found", "text/plain", 404)
 
+            def do_POST(self):
+                # remote stats ingestion (reference
+                # RemoteUIStatsStorageRouter: workers POST their updates
+                # to the UI server)
+                u = urlparse(self.path)
+                if u.path != "/api/post":
+                    self._send(b"not found", "text/plain", 404)
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    recs = json.loads(self.rfile.read(n).decode())
+                    if isinstance(recs, dict):
+                        recs = [recs]
+                    # validate the WHOLE batch before inserting any record
+                    # (a 400 must mean nothing was stored, or a client
+                    # retry would duplicate the good prefix)
+                    parsed = [(str(rec.get("session", "")),
+                               str(rec["tag"]), int(rec["step"]),
+                               float(rec["value"])) for rec in recs]
+                except (ValueError, KeyError, TypeError,
+                        AttributeError) as e:
+                    self._send(f"bad record: {e}".encode(), "text/plain",
+                               400)
+                    return
+                for session, tag, step, value in parsed:
+                    ui._remote.put_scalar(session, tag, step, value)
+                self._send(b"ok", "text/plain")
+
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
@@ -187,3 +219,72 @@ class UIServer:
             self._httpd.server_close()
             self._httpd = None
             self._thread = None
+
+
+class RemoteUIStatsStorageRouter(StatsStorage):
+    """StatsStorage that POSTs scalars to a remote :class:`UIServer`
+    (reference ``RemoteUIStatsStorageRouter`` — how Spark workers fed the
+    driver-hosted UI; here: how any process feeds a central dashboard).
+
+    ``put_scalar`` only enqueues (never blocks the training loop); a
+    daemon sender thread drains the bounded queue in small batches,
+    best-effort — when the server is unreachable or the queue is full,
+    records drop rather than stall training."""
+
+    def __init__(self, url: str, queue_size: int = 4096,
+                 timeout: float = 2.0):
+        import queue
+        import threading
+
+        self.url = url.rstrip("/")
+        self.timeout = float(timeout)
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        self._closed = False
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def put_scalar(self, session, tag, step, value) -> None:
+        import queue
+
+        try:
+            self._q.put_nowait({"session": session, "tag": tag,
+                                "step": int(step),
+                                "value": float(value)})
+        except queue.Full:
+            pass    # best-effort: drop under backpressure
+
+    def _drain(self) -> None:
+        import queue
+        import urllib.request
+
+        while not self._closed:
+            try:
+                batch = [self._q.get(timeout=0.25)]
+            except queue.Empty:
+                continue
+            while len(batch) < 256:
+                try:
+                    batch.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            req = urllib.request.Request(
+                self.url + "/api/post", data=json.dumps(batch).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout):
+                    pass
+            except OSError:
+                pass    # server down: drop the batch
+
+    def flush(self, deadline: float = 5.0) -> None:
+        """Best-effort wait for the queue to drain (tests/shutdown)."""
+        import time
+
+        t0 = time.time()
+        while not self._q.empty() and time.time() - t0 < deadline:
+            time.sleep(0.02)
+        time.sleep(0.1)     # let the in-flight batch land
+
+    def close(self) -> None:
+        self.flush()
+        self._closed = True

@@ -90,6 +90,60 @@ class TestUIServer:
         finally:
             ui.stop()
 
+    def test_remote_router_posts_to_server(self):
+        """Reference RemoteUIStatsStorageRouter flow: a worker process
+        POSTs its scalars to the central dashboard."""
+        from deeplearning4j_tpu.ui.server import RemoteUIStatsStorageRouter
+
+        ui = UIServer()
+        port = ui.enable(port=0)
+        try:
+            router = RemoteUIStatsStorageRouter(f"http://127.0.0.1:{port}")
+            for i in range(4):
+                router.put_scalar("w0", "score", i, 3.0 - i)
+            router.flush()
+            _, body = _get(port, "/api/series?tag=score")
+            assert json.loads(body) == [[0, 3.0], [1, 2.0], [2, 1.0],
+                                        [3, 0.0]]
+            router.close()
+            # malformed posts get a 400, not a crash
+            import urllib.error
+            import urllib.request
+
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/post", data=b'{"tag": "x"}',
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req, timeout=5)
+                assert False, "expected 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+            # 400 batches are ATOMIC: a good prefix before a bad record
+            # must not be stored (retry would duplicate it)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/post",
+                data=b'[{"tag":"atomic","step":1,"value":1.0},'
+                     b'{"tag":"x"}]',
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req, timeout=5)
+                assert False, "expected 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+            _, body = _get(port, "/api/series?tag=atomic")
+            assert json.loads(body) == []
+            # non-dict JSON items also 400 (not 500)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/post", data=b'[1]',
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req, timeout=5)
+                assert False, "expected 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            ui.stop()
+
     def test_training_feeds_dashboard(self):
         """The reference wiring: model + StatsListener + attached UI."""
         from deeplearning4j_tpu.data import DataSet
