@@ -139,6 +139,17 @@ class _Container:
         self._mm = np.memmap(self.path, np.uint8, mode="r")
         self._rec_bytes = [int(np.prod(shp, dtype=np.int64)) * dt.itemsize
                            for _, shp, dt in self.columns]
+        # a crash mid-write (header written, last chunk not flushed) must
+        # fail HERE with a clear message, not later inside read_chunk with
+        # an opaque reshape error
+        need = self._data_start + self.n_records * sum(self._rec_bytes)
+        if self._mm.size < need:
+            raise ValueError(
+                f"{path}: truncated container — header promises "
+                f"{self.n_records} records ({need} bytes) but the file is "
+                f"{self._mm.size} bytes; the writer likely crashed "
+                "mid-write (re-create the container or re-run the "
+                "converter)")
 
     def n_chunks(self) -> int:
         return -(-self.n_records // self.chunk_records) \

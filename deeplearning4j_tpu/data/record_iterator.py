@@ -213,15 +213,23 @@ class AsyncDataSetIterator(DataSetIterator):
 
         if isinstance(ds, tuple):
             # raw numpy (x, y) from a jax-free worker (the binary-record
-            # fast path) — build the DataSet here on the consumer thread
+            # fast path) — build the DataSet here on the consumer thread.
+            # device_prefetch=False matches the non-tuple branch: no
+            # explicit committed device_put; the NDArray wrap still runs
+            # jnp.asarray (a default-device transfer on TPU), exactly as
+            # it would when the caller constructs a DataSet itself
             x, y = ds
-            xd = NDArray(jax.device_put(x))
-            if self._feature_transform is not None:
-                xd = NDArray(self._feature_transform(xd.value))
+            if self.device_prefetch:
+                xd = NDArray(jax.device_put(x))
+                if self._feature_transform is not None:
+                    xd = NDArray(self._feature_transform(xd.value))
+                yd = NDArray(jax.device_put(y)) if y is not None else None
+            else:
+                xd = NDArray(x)
+                yd = NDArray(y) if y is not None else None
             out = DataSet.__new__(DataSet)
             out.features = xd
-            out.labels = NDArray(jax.device_put(y)) if y is not None \
-                else None
+            out.labels = yd
             out.features_mask = None
             out.labels_mask = None
             return out

@@ -121,6 +121,11 @@ def import_functional_parsed(f, cfg) -> ComputationGraph:
         setters: Dict[str, Optional[Callable]] = {}
         flatten_src: Dict[str, str] = {}     # flatten node -> its input
         dense_after_flatten: List[Tuple[str, str]] = []
+        # shape-preserving chain member -> flatten's source (its per-feature
+        # weights need the same row permute as the downstream Dense kernel)
+        perfeature_after_flatten: Dict[str, str] = {}
+        # (cls, name, flatten source) of layers that break the permute chain
+        broken_chain: List[Tuple[str, str, str]] = []
         node_of: Dict[str, str] = {}         # keras name -> graph node name
 
         inputs = []
@@ -153,7 +158,7 @@ def import_functional_parsed(f, cfg) -> ComputationGraph:
         _SHAPE_PRESERVING = {"Dropout", "Activation", "ReLU", "LeakyReLU",
                              "Softmax", "ELU", "AlphaDropout",
                              "GaussianDropout", "GaussianNoise", "PReLU",
-                             "LayerNormalization"}
+                             "LayerNormalization", "BatchNormalization"}
         for kl in layers_cfg:
             cls = kl["class_name"]
             if cls == "InputLayer":
@@ -173,6 +178,13 @@ def import_functional_parsed(f, cfg) -> ComputationGraph:
                     "not supported")
             srcs = [node_of[s] for s, _ in sites[0]]
             src_shapes = [shape for _, shape in sites[0]]
+            if cls in _MERGE_OPS or cls == "Concatenate":
+                # a merge fed by a Flatten chain scrambles the flattened
+                # row order beyond tracking — a downstream Dense would
+                # import silently wrong; record for the post-build check
+                for s in srcs:
+                    if s in flatten_src:
+                        broken_chain.append((cls, name, flatten_src[s]))
             if cls in _MERGE_OPS:
                 gb.add_vertex(name, ElementWiseVertex(_MERGE_OPS[cls]),
                               *srcs)
@@ -191,21 +203,42 @@ def import_functional_parsed(f, cfg) -> ComputationGraph:
                 gb.add_vertex(name, MergeVertex(), *srcs)
             elif cls == "Flatten":
                 gb.add_layer(name, L.FlattenLayer(), *srcs)
-                flatten_src[name] = srcs[0]
+                # chain through an upstream Flatten (or chain member): a
+                # Flatten of an already-flat tensor is an identity, so the
+                # permute source stays the ORIGINAL CNN tensor
+                flatten_src[name] = flatten_src.get(srcs[0], srcs[0])
             else:
                 layer, setter = _convert_layer(kl, f)
                 gb.add_layer(name, layer, *srcs)
                 setters[name] = setter
                 if cls in _SHAPE_PRESERVING and srcs[0] in flatten_src:
                     flatten_src[name] = flatten_src[srcs[0]]
+                    # per-feature weights of chain members (LayerNorm
+                    # gain/bias, PReLU alpha) see CHW-ordered activations
+                    perfeature_after_flatten[name] = flatten_src[srcs[0]]
                 if isinstance(layer, L.DenseLayer) and \
                         srcs[0] in flatten_src:
                     dense_after_flatten.append((name, flatten_src[srcs[0]]))
+                elif cls not in _SHAPE_PRESERVING and \
+                        srcs[0] in flatten_src:
+                    # the pending HWC->CHW row permute can't be tracked
+                    # through this layer — refuse IF the flatten was over a
+                    # CNN tensor (checked after build, when output types of
+                    # the flatten source are known)
+                    broken_chain.append((cls, name, flatten_src[srcs[0]]))
             node_of[name] = name
 
         outputs = _endpoints(cfg["config"].get("output_layers"))
         gb.set_outputs(*outputs)
         conf = gb.set_input_types(*[input_types[i] for i in inputs]).build()
+
+        for bcls, bname, bsrc in broken_chain:
+            if isinstance(conf.node_output_types[bsrc], CNNInput):
+                raise UnsupportedKerasLayerError(
+                    bcls,
+                    f"{bname}: layer between Flatten and Dense does not "
+                    "preserve the flattened row order; the HWC->CHW kernel "
+                    "permute cannot be applied soundly")
 
         # NHWC input contract: transpose once on entry per image input
         for iname in inputs:
@@ -227,7 +260,8 @@ def import_functional_parsed(f, cfg) -> ComputationGraph:
         # weights (+ the deferred flatten→dense row permute)
         permute_for = dict(dense_after_flatten)
         from .keras_import import (_check_tree_shapes, _flatten_perm,
-                                   _jnp_tree, _np_tree)
+                                   _jnp_tree, _np_tree,
+                                   _permute_per_feature)
 
         for name, setter in setters.items():
             if setter is None:
@@ -247,6 +281,18 @@ def import_functional_parsed(f, cfg) -> ComputationGraph:
                     perm = _flatten_perm(
                         (t.channels, t.height, t.width))
                     params["W"] = np.asarray(params["W"])[perm]
+            if name in perfeature_after_flatten:
+                t = conf.node_output_types[perfeature_after_flatten[name]]
+                if isinstance(t, CNNInput):
+                    perm = _flatten_perm(
+                        (t.channels, t.height, t.width))
+                    _permute_per_feature(params, perm)
+                    if net._states.get(name):    # BN mean/var
+                        st = dict(net._states[name])
+                        _permute_per_feature(st, perm)
+                        net._states[name] = {
+                            k: np.asarray(v, np.float32)
+                            for k, v in st.items()}
             _check_tree_shapes(net._params[name], params, f"node {name!r}")
             net._params[name] = _jnp_tree(params)
         return net

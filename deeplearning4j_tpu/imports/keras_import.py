@@ -87,6 +87,19 @@ def _flatten_perm(shape) -> np.ndarray:
     return arr.transpose((nd,) + tuple(range(nd))).ravel()
 
 
+def _permute_per_feature(tree: Dict[str, Any], perm: np.ndarray) -> None:
+    """Apply the Flatten row permutation to per-feature parameter vectors
+    (LayerNorm gain/bias, PReLU alpha, BN gamma/beta/mean/var) of layers
+    sitting between a Flatten and the Dense that consumes the permute: the
+    body's flattened activations are in CHW order while Keras stored these
+    vectors over HWC-flattened features."""
+    n = perm.shape[0]
+    for k, v in tree.items():
+        a = np.asarray(v)
+        if a.ndim == 1 and a.shape[0] == n:
+            tree[k] = a[perm]
+
+
 def _pad2d_spec(v) -> Tuple[int, int, int, int]:
     """Keras 2D padding/cropping spec → (top, bottom, left, right)."""
     if isinstance(v, int):
@@ -303,9 +316,12 @@ class _SequentialBuilder:
         if cls in ("Flatten",):
             # remember the spatial shape for the next Dense's row permute,
             # and materialize the flatten explicitly so ANY layer may
-            # follow (LayerNormalization/PReLU/... — not just Dense)
-            self.flatten_pending = True
-            self.flatten_shape = self.cur_cnn
+            # follow (LayerNormalization/PReLU/... — not just Dense).
+            # Flatten of an already-flat tensor is an identity: keep an
+            # already-pending permute instead of overwriting it with None
+            if not (self.flatten_pending and self.flatten_shape is not None):
+                self.flatten_pending = True
+                self.flatten_shape = self.cur_cnn
             self.layers.append(L.FlattenLayer())
             self.weights.append(None)
             self.cur_cnn = None
@@ -337,6 +353,34 @@ class _SequentialBuilder:
 
     def _push(self, layer: L.Layer, setter: Optional[Callable]):
         self._update_cnn_shape(layer)
+        if self.flatten_pending and self.flatten_shape is not None:
+            if isinstance(layer, self._SHAPE_PRESERVING):
+                # a shape-preserving layer between Flatten and Dense: its
+                # per-feature weights (if any) see CHW-ordered activations
+                # and must be permuted like the Dense kernel rows
+                if setter is not None:
+                    perm = _flatten_perm(self.flatten_shape)
+                    inner = setter
+                    if getattr(inner, "wants_state", False):
+                        def setter(params, state, _i=inner, _p=perm):
+                            _i(params, state)
+                            _permute_per_feature(params, _p)
+                            _permute_per_feature(state, _p)
+
+                        setter.wants_state = True
+                    else:
+                        def setter(params, _i=inner, _p=perm):
+                            _i(params)
+                            _permute_per_feature(params, _p)
+            else:
+                # the pending HWC→CHW row permute can't be tracked through
+                # this layer; applying it later would be wrong, dropping it
+                # silently wrong the other way — refuse
+                raise UnsupportedKerasLayerError(
+                    type(layer).__name__,
+                    "layer between Flatten and Dense does not preserve the "
+                    "flattened row order; the HWC->CHW kernel permute cannot "
+                    "be applied soundly")
         # Keras's activation="leaky_relu" kwarg means
         # keras.activations.leaky_relu with negative_slope=0.2; body layers
         # apply activations without an alpha channel (op default 0.01), so

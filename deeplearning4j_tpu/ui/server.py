@@ -137,12 +137,41 @@ class UIServer:
                 pass
         return recs
 
-    def tags(self) -> List[str]:
-        return sorted({r["tag"] for r in self._records()})
+    def sessions(self) -> List[str]:
+        return sorted({str(r.get("session", "")) for r in self._records()})
 
-    def series(self, tag: str) -> List[Tuple[int, float]]:
-        return sorted((r["step"], r["value"]) for r in self._records()
-                      if r["tag"] == tag)
+    def tags(self) -> List[str]:
+        """Tag list; session-qualified as "session/tag" when records from
+        more than one session are attached (two workers posting the same
+        tag must chart as two series, not one interleaved sawtooth —
+        reference UI keys by session)."""
+        recs = self._records()
+        sessions = {str(r.get("session", "")) for r in recs}
+        if len(sessions) > 1:
+            return sorted({f"{r.get('session', '')}/{r['tag']}"
+                           for r in recs})
+        return sorted({r["tag"] for r in recs})
+
+    def series(self, tag: str,
+               session: Optional[str] = None) -> List[Tuple[int, float]]:
+        """Step-sorted (step, value) series for a tag. ``session`` filters
+        to one session; a "session/tag"-qualified tag (as emitted by
+        ``tags()`` in multi-session mode) is split the same way."""
+        recs = self._records()
+        if session is None and "/" in tag \
+                and tag not in {r["tag"] for r in recs}:
+            # qualified, not literal: split at the longest KNOWN session
+            # prefix (session ids may themselves contain "/")
+            sessions = {str(r.get("session", "")) for r in recs}
+            for cand in sorted(
+                    (s for s in sessions if tag.startswith(s + "/")),
+                    key=len, reverse=True):
+                session, tag = cand, tag[len(cand) + 1:]
+                break
+        return sorted((r["step"], r["value"]) for r in recs
+                      if r["tag"] == tag
+                      and (session is None
+                           or str(r.get("session", "")) == session))
 
     # -- server ----------------------------------------------------------
     def enable(self, port: int = 9000) -> int:
@@ -172,10 +201,16 @@ class UIServer:
                 elif u.path == "/api/tags":
                     self._send(json.dumps(ui.tags()).encode(),
                                "application/json")
-                elif u.path == "/api/series":
-                    tag = parse_qs(u.query).get("tag", [""])[0]
-                    self._send(json.dumps(ui.series(tag)).encode(),
+                elif u.path == "/api/sessions":
+                    self._send(json.dumps(ui.sessions()).encode(),
                                "application/json")
+                elif u.path == "/api/series":
+                    q = parse_qs(u.query)
+                    tag = q.get("tag", [""])[0]
+                    session = q.get("session", [None])[0]
+                    self._send(
+                        json.dumps(ui.series(tag, session=session)).encode(),
+                        "application/json")
                 else:
                     self._send(b"not found", "text/plain", 404)
 

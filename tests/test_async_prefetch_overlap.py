@@ -85,3 +85,39 @@ class TestPrefetchOverlap:
         assert len(seen) == 5
         np.testing.assert_array_equal(seen[0].features.to_numpy(),
                                       np.ones((4, 3), np.float32))
+
+
+class TestDevicePrefetchDisabled:
+    def test_tuple_batches_skip_device_put(self, monkeypatch):
+        """Raw (x, y) tuple batches from a jax-free worker must honor
+        device_prefetch=False — no jax.device_put (round-4 advisor
+        finding: the tuple branch ran before the early return)."""
+        import jax
+
+        def boom(*a, **k):
+            raise AssertionError("device_put called with "
+                                 "device_prefetch=False")
+
+        class _TupleProducer(DataSetIterator):
+            def __init__(self):
+                self.i = 0
+
+            def batch(self):
+                return 4
+
+            def reset(self):
+                self.i = 0
+
+            def __iter__(self):
+                for _ in range(3):
+                    yield (np.ones((4, 3), np.float32),
+                           np.zeros((4,), np.int32))
+
+        monkeypatch.setattr(jax, "device_put", boom)
+        seen = list(AsyncDataSetIterator(_TupleProducer(),
+                                         device_prefetch=False))
+        assert len(seen) == 3
+        np.testing.assert_array_equal(seen[0].features.to_numpy(),
+                                      np.ones((4, 3), np.float32))
+        np.testing.assert_array_equal(seen[0].labels.to_numpy(),
+                                      np.zeros((4,), np.int32))

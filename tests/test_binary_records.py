@@ -182,3 +182,24 @@ class TestConverter:
         ref = np.stack([rr.next()[0] for _ in range(8)])
         np.testing.assert_allclose(ds.features.to_numpy(), ref,
                                    atol=1.0 / 255 / 2 + 1e-6)
+
+
+class TestTruncation:
+    def test_truncated_container_diagnosed_on_open(self, tmp_path):
+        """A container cut short by a crash mid-write must fail at open
+        with a clear 'truncated' message, not later inside read_chunk with
+        an opaque reshape error (round-4 advisor finding)."""
+        path = str(tmp_path / "t.d4tbin")
+        _write(path, n=37, chunk=16)
+        data = open(path, "rb").read()
+        cut = str(tmp_path / "cut.d4tbin")
+        with open(cut, "wb") as f:
+            f.write(data[:-50])        # drop the tail of the last chunk
+        with pytest.raises(ValueError, match="truncated"):
+            BinaryRecordReader(cut)
+
+    def test_exact_size_still_opens(self, tmp_path):
+        path = str(tmp_path / "ok.d4tbin")
+        _write(path, n=37, chunk=16)
+        r = BinaryRecordReader(path)
+        assert r.has_next()

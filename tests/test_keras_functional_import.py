@@ -137,3 +137,76 @@ class TestFunctionalImport:
         m.save(path)
         net = KerasModelImport.import_keras_model_and_weights(path)
         assert isinstance(net, MultiLayerNetwork)
+
+
+class TestFlattenChainSoundness:
+    """Round-5 review findings, pinned: the HWC->CHW permute chain must be
+    either correctly applied or refused — never silently dropped."""
+
+    def test_flatten_bn_dense_parity(self, tmp_path):
+        # BatchNormalization between Flatten and Dense: its per-feature
+        # gamma/beta/mean/var must be permuted with the Dense kernel rows
+        inp = keras.layers.Input((6, 6, 2), name="in0")
+        c = keras.layers.Conv2D(3, 3)(inp)
+        fl = keras.layers.Flatten()(c)
+        bn = keras.layers.BatchNormalization()(fl)
+        out = keras.layers.Dense(4)(bn)
+        m = keras.Model(inp, out)
+        x = rng.randn(6, 6, 6, 2).astype(np.float32)
+        m.compile(optimizer="sgd", loss="mse")
+        m.fit(x, rng.randn(6, 4).astype(np.float32), epochs=2,
+              verbose=0)   # non-trivial BN stats AND gamma/beta
+        roundtrip(m, {"in0": x}, tmp_path)
+
+    def test_flatten_layernorm_dense_parity(self, tmp_path):
+        inp = keras.layers.Input((5, 5, 2), name="in0")
+        c = keras.layers.Conv2D(2, 2)(inp)
+        fl = keras.layers.Flatten()(c)
+        ln = keras.layers.LayerNormalization()(fl)
+        out = keras.layers.Dense(3)(ln)
+        m = keras.Model(inp, out)
+        lnl = [l for l in m.layers
+               if isinstance(l, keras.layers.LayerNormalization)][0]
+        lnl.set_weights([rng.normal(1.0, 0.5, w.shape).astype(np.float32)
+                         for w in lnl.get_weights()])
+        roundtrip(m, {"in0": rng.randn(3, 5, 5, 2).astype(np.float32)},
+                  tmp_path)
+
+    def test_merge_of_flatten_refused(self, tmp_path):
+        # a merge fed by a Flatten chain scrambles the row order beyond
+        # tracking — refuse, don't import a silently wrong Dense
+        inp = keras.layers.Input((6, 6, 2), name="in0")
+        c = keras.layers.Conv2D(3, 3)(inp)
+        fl = keras.layers.Flatten()(c)
+        d = keras.layers.Dense(48)(keras.layers.Flatten()(inp))
+        cat = keras.layers.Concatenate()([fl, d])
+        out = keras.layers.Dense(4)(cat)
+        m = keras.Model(inp, out)
+        path = str(tmp_path / "m.h5")
+        m.save(path)
+        with pytest.raises(UnsupportedKerasLayerError):
+            KerasModelImport.import_keras_model_and_weights(path)
+
+    def test_double_flatten_still_permutes_functional(self, tmp_path):
+        inp = keras.layers.Input((6, 6, 2), name="in0")
+        c = keras.layers.Conv2D(3, 3)(inp)
+        f1 = keras.layers.Flatten()(c)
+        f2 = keras.layers.Flatten()(f1)
+        out = keras.layers.Dense(4)(f2)
+        m = keras.Model(inp, out)
+        roundtrip(m, {"in0": rng.randn(3, 6, 6, 2).astype(np.float32)},
+                  tmp_path)
+
+    def test_flatten_bn_flatten_dense(self, tmp_path):
+        # Flatten AFTER a chain member must keep pointing at the CNN source
+        inp = keras.layers.Input((5, 5, 2), name="in0")
+        c = keras.layers.Conv2D(2, 2)(inp)
+        f1 = keras.layers.Flatten()(c)
+        bn = keras.layers.BatchNormalization()(f1)
+        f2 = keras.layers.Flatten()(bn)
+        out = keras.layers.Dense(3)(f2)
+        m = keras.Model(inp, out)
+        x = rng.randn(6, 5, 5, 2).astype(np.float32)
+        m.compile(optimizer="sgd", loss="mse")
+        m.fit(x, rng.randn(6, 3).astype(np.float32), epochs=2, verbose=0)
+        roundtrip(m, {"in0": x}, tmp_path)

@@ -274,7 +274,45 @@ class TestReviewRegressions:
             keras.layers.LayerNormalization(),
             keras.layers.Dense(4),
         ])
+        # non-trivial gamma/beta: with the defaults (gamma=1, beta=0) the
+        # per-feature permute is invisible (round-4 advisor finding)
+        ln = m.layers[2]
+        rng = np.random.default_rng(7)
+        ln.set_weights([rng.normal(1.0, 0.5, w.shape).astype(np.float32)
+                        for w in ln.get_weights()])
         roundtrip(m, img(2, 4, 4, 2), tmp_path)
+
+    def test_flatten_then_prelu_then_dense(self, tmp_path):
+        # PReLU alpha is per-feature over the flattened HWC order — must be
+        # permuted with the Dense kernel rows
+        m = keras.Sequential([
+            keras.layers.Input((4, 4, 2)),
+            keras.layers.Conv2D(3, 2),
+            keras.layers.Flatten(),
+            keras.layers.PReLU(),
+            keras.layers.Dense(4),
+        ])
+        pr = m.layers[2]
+        rng = np.random.default_rng(3)
+        pr.set_weights([rng.uniform(0.05, 0.9, w.shape).astype(np.float32)
+                        for w in pr.get_weights()])
+        roundtrip(m, img(2, 4, 4, 2), tmp_path)
+
+    def test_flatten_then_reshape_refused(self, tmp_path):
+        # a layer between Flatten and Dense that does not provably preserve
+        # the flattened row order makes the pending HWC->CHW permute
+        # unsound either way — the import must refuse, not silently guess
+        m = keras.Sequential([
+            keras.layers.Input((4, 4, 2)),
+            keras.layers.Conv2D(3, 2),
+            keras.layers.Flatten(),
+            keras.layers.Reshape((27,)),
+            keras.layers.Dense(4),
+        ])
+        path = str(tmp_path / "m.h5")
+        m.save(path)
+        with pytest.raises(UnsupportedKerasLayerError, match="row order"):
+            KerasModelImport.import_keras_sequential_model_and_weights(path)
 
     def test_separable_conv_dilation_raises(self, tmp_path):
         m = keras.Sequential([
@@ -395,3 +433,15 @@ class TestXceptionStyleE2E:
         last = float(net.score_value)
         assert np.isfinite(last)
         assert last < first, (first, last)
+
+    def test_double_flatten_still_permutes(self, tmp_path):
+        # Flatten of an already-flat tensor is an identity — the pending
+        # HWC->CHW permute must survive it (round-5 review finding)
+        m = keras.Sequential([
+            keras.layers.Input((4, 4, 2)),
+            keras.layers.Conv2D(3, 2),
+            keras.layers.Flatten(),
+            keras.layers.Flatten(),
+            keras.layers.Dense(4),
+        ])
+        roundtrip(m, img(2, 4, 4, 2), tmp_path)

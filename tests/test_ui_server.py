@@ -177,3 +177,56 @@ class TestUIServer:
             assert len(json.loads(body)) >= 5
         finally:
             ui.stop()
+
+
+class TestMultiSession:
+    def test_tags_session_qualified_and_series_filtered(self):
+        """Two workers posting the same tag must chart as two series keyed
+        by session, not one interleaved sawtooth (round-4 advisor
+        finding; reference UI keys by session)."""
+        store = InMemoryStatsStorage()
+        for i in range(3):
+            store.put_scalar("w0", "score", i, 10.0 + i)
+            store.put_scalar("w1", "score", i, 20.0 + i)
+        ui = UIServer()
+        ui.attach(store)
+        port = ui.enable(port=0)
+        try:
+            _, body = _get(port, "/api/tags")
+            assert json.loads(body) == ["w0/score", "w1/score"]
+            _, body = _get(port, "/api/sessions")
+            assert json.loads(body) == ["w0", "w1"]
+            _, body = _get(port, "/api/series?tag=score&session=w1")
+            assert json.loads(body) == [[0, 20.0], [1, 21.0], [2, 22.0]]
+            # qualified-tag form (what the dashboard page sends back)
+            _, body = _get(port, "/api/series?tag=w0/score")
+            assert json.loads(body) == [[0, 10.0], [1, 11.0], [2, 12.0]]
+        finally:
+            ui.stop()
+
+    def test_single_session_tags_stay_plain(self):
+        store = InMemoryStatsStorage()
+        store.put_scalar("s0", "score", 0, 1.0)
+        ui = UIServer()
+        ui.attach(store)
+        port = ui.enable(port=0)
+        try:
+            _, body = _get(port, "/api/tags")
+            assert json.loads(body) == ["score"]
+        finally:
+            ui.stop()
+
+    def test_session_id_containing_slash(self):
+        store = InMemoryStatsStorage()
+        store.put_scalar("run/1", "score", 0, 5.0)
+        store.put_scalar("w0", "score", 0, 9.0)
+        ui = UIServer()
+        ui.attach(store)
+        port = ui.enable(port=0)
+        try:
+            _, body = _get(port, "/api/tags")
+            assert json.loads(body) == ["run/1/score", "w0/score"]
+            _, body = _get(port, "/api/series?tag=run/1/score")
+            assert json.loads(body) == [[0, 5.0]]
+        finally:
+            ui.stop()
