@@ -211,7 +211,13 @@ def import_functional_parsed(f, cfg) -> ComputationGraph:
                 layer, setter = _convert_layer(kl, f)
                 gb.add_layer(name, layer, *srcs)
                 setters[name] = setter
-                if cls in _SHAPE_PRESERVING and srcs[0] in flatten_src:
+                if getattr(layer, "shape_preserving", False):
+                    # registered custom layer opted in (keras_import.py
+                    # hook contract) — chain without per-feature permute
+                    # bookkeeping (custom layers own their weight layout)
+                    if srcs[0] in flatten_src:
+                        flatten_src[name] = flatten_src[srcs[0]]
+                elif cls in _SHAPE_PRESERVING and srcs[0] in flatten_src:
                     flatten_src[name] = flatten_src[srcs[0]]
                     # per-feature weights of chain members (LayerNorm
                     # gain/bias, PReLU alpha) see CHW-ordered activations
@@ -220,6 +226,7 @@ def import_functional_parsed(f, cfg) -> ComputationGraph:
                         srcs[0] in flatten_src:
                     dense_after_flatten.append((name, flatten_src[srcs[0]]))
                 elif cls not in _SHAPE_PRESERVING and \
+                        not getattr(layer, "shape_preserving", False) and \
                         srcs[0] in flatten_src:
                     # the pending HWC->CHW row permute can't be tracked
                     # through this layer — refuse IF the flatten was over a

@@ -114,6 +114,9 @@ def _pad2d_spec(v) -> Tuple[int, int, int, int]:
 # Keras class_name to a callable ``(config, weights) -> (Layer, setter)``
 # where ``setter`` is ``None`` or ``setter(params_dict)`` filling imported
 # weights (add ``setter.wants_state = True`` for ``setter(params, state)``).
+# A custom layer that keeps the flattened row order intact (elementwise /
+# normalization-style) may set ``layer.shape_preserving = True`` so it can
+# sit between Flatten and Dense without tripping the permute-chain refusal.
 _CUSTOM_LAYERS: Dict[str, Callable] = {}
 
 
@@ -354,7 +357,8 @@ class _SequentialBuilder:
     def _push(self, layer: L.Layer, setter: Optional[Callable]):
         self._update_cnn_shape(layer)
         if self.flatten_pending and self.flatten_shape is not None:
-            if isinstance(layer, self._SHAPE_PRESERVING):
+            if isinstance(layer, self._SHAPE_PRESERVING) \
+                    or getattr(layer, "shape_preserving", False):
                 # a shape-preserving layer between Flatten and Dense: its
                 # per-feature weights (if any) see CHW-ordered activations
                 # and must be permuted like the Dense kernel rows

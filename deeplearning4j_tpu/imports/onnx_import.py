@@ -1044,24 +1044,38 @@ def _pool_mapper(kind):
             begin, end = ([int(v) for v in pad_explicit[0]],
                           [int(v) for v in pad_explicit[1]])
         elif pad_sym == "SAME":   # SAME_UPPER: extra pad at the end
-            begin, end = [], []
-            for d, (kk, ss) in zip(shp[2:], zip(k, s)):
-                out = -(-d // ss)
-                total = max((out - 1) * ss + kk - d, 0)
-                begin.append(total // 2)
-                end.append(total - total // 2)
+            begin, end = _same_pad_begin_end(shp[2:], k, s)
         else:
             begin = end = [int(v) for v in pad_sym]
-        counts = _pool_valid_counts(shp[2:], k, s, begin, end)
         try:
             sdt = ctx.dtype_of_input(0)
         except Exception:
             sdt = np.dtype(np.float32)
-        scale = ((k[0] * k[1]) / counts).astype(sdt)[None, None]
+        scale = _avgpool_exclude_pad_scale(shp[2:], k, s, begin, end,
+                                           sdt)[None, None]
         c = ctx.sd.constant(_safe(ctx.name) + "_cip_scale", scale)
         return ctx.emit("multiply", [pooled, c])
 
     return m
+
+
+def _same_pad_begin_end(hw, k, s):
+    """SAME_UPPER padding split (extra pad at the end) per spatial dim —
+    shared by the ONNX count_include_pad path and the TF AvgPool mapper."""
+    begin, end = [], []
+    for d, (kk, ss) in zip(hw, zip(k, s)):
+        out = -(-int(d) // int(ss))
+        total = max((out - 1) * int(ss) + int(kk) - int(d), 0)
+        begin.append(total // 2)
+        end.append(total - total // 2)
+    return begin, end
+
+
+def _avgpool_exclude_pad_scale(hw, k, s, begin, end, dtype):
+    """(oh, ow) multiplier correcting a full-kernel-area average to the
+    exclude-padding divisor (TF AvgPool / ONNX count_include_pad=0)."""
+    counts = _pool_valid_counts(hw, k, s, begin, end)
+    return ((k[0] * k[1]) / counts).astype(dtype)
 
 
 def _pool_valid_counts(hw, k, s, begin, end):

@@ -226,11 +226,17 @@ class _Importer:
         self._static[f"{node_name}:{out_index}"] = np.asarray(value)
 
     # --- shape inference over the partial graph -------------------------
-    def infer_shape(self, tensor_name: str) -> Tuple[int, ...]:
+    def infer_shape(self, tensor_name: str,
+                    assume_unknown: Optional[int] = None) -> Tuple[int, ...]:
+        """Shape of a tensor in the partially built graph. With
+        ``assume_unknown``, unknown placeholder dims (batch=None in frozen
+        inference graphs) are substituted with that value instead of
+        raising — use ONLY when the caller reads dims that don't depend on
+        the substituted ones (e.g. pooling H/W with batch unknown)."""
         import jax
 
         key = self._canon(tensor_name)
-        if key in self._shape_cache:
+        if assume_unknown is None and key in self._shape_cache:
             return self._shape_cache[key]
         var = self.resolve_var(key)
         vinfo = self.sd._vars[var.name]
@@ -247,16 +253,20 @@ class _Importer:
         for n in self.sd.placeholders():
             pshape = self.sd._vars[n].shape
             if pshape is None or any(d is None for d in pshape):
-                raise ValueError(
-                    f"cannot infer shape of {tensor_name!r}: placeholder "
-                    f"{n!r} has unknown dims — pass input_shapes={{...}} to "
-                    "the importer")
+                # unknown RANK can't be assumed away — only unknown dims
+                if assume_unknown is None or pshape is None:
+                    raise ValueError(
+                        f"cannot infer shape of {tensor_name!r}: placeholder "
+                        f"{n!r} has unknown dims — pass input_shapes={{...}} "
+                        "to the importer")
+                pshape = [assume_unknown if d is None else d for d in pshape]
             pdt = np.dtype(self.sd._vars[n].dtype)
             ph[n] = jax.ShapeDtypeStruct(tuple(pshape), pdt)
         key_struct = jax.ShapeDtypeStruct((2,), np.uint32)
         out = jax.eval_shape(fn, params, ph, key_struct)
         shp = tuple(int(d) for d in out[0].shape)
-        self._shape_cache[key] = shp
+        if assume_unknown is None:
+            self._shape_cache[key] = shp
         return shp
 
     # --- main loop ------------------------------------------------------
@@ -311,10 +321,20 @@ class _Importer:
             if outs is not None:
                 self._bind(node.name, outs)
 
-        # graph outputs: tensors nobody consumes
+        # graph outputs: nodes NONE of whose output ports are consumed.
+        # (A node with one consumed port and dangling siblings — TopKV2
+        # when only indices are read, IdentityN — is an intermediate, not
+        # an output; TF freezing wraps real outputs in Identity nodes.)
         for node in self.gd.node:
             key = f"{node.name}:0"
-            if key in self._env and consumed.get(key, 0) == 0:
+            if key not in self._env:
+                continue
+            i, any_consumed = 0, False
+            while f"{node.name}:{i}" in self._env:
+                if consumed.get(f"{node.name}:{i}", 0):
+                    any_consumed = True
+                i += 1
+            if not any_consumed:
                 self.outputs.append(self._env[key].name)
         return self.sd
 
@@ -445,7 +465,14 @@ _FOLDERS: Dict[str, Callable] = {
         or None),
     "Reshape": lambda ctx, s: np.reshape(s[0], np.asarray(s[1]).tolist()),
     "Transpose": lambda ctx, s: np.transpose(s[0], np.asarray(s[1]).tolist()),
-    "Range": lambda ctx, s: np.arange(int(s[0]), int(s[1]), int(s[2])),
+    "Div": lambda ctx, s: (np.trunc(np.divide(s[0], s[1])).astype(
+        np.result_type(s[0], s[1])) if np.issubdtype(
+            np.result_type(s[0], s[1]), np.integer) else s[0] / s[1]),
+    # .item() (not int()) keeps float ranges exact: int(0.5) == 0 would
+    # poison the step (conformance case Range.float_step pinned this)
+    "Range": lambda ctx, s: np.arange(
+        np.asarray(s[0]).item(), np.asarray(s[1]).item(),
+        np.asarray(s[2]).item()).astype(np.result_type(s[0], s[1], s[2])),
     "GatherV2": lambda ctx, s: np.take(s[0], s[1].astype(np.int64),
                                        axis=int(s[2]) if len(s) > 2 else 0),
     "StridedSlice": lambda ctx, s: s[0][_strided_slice_spec(ctx, s[1], s[2], s[3])],
@@ -477,7 +504,7 @@ def _binary(op_name):
 
 _BINARY = {
     "Add": "add", "AddV2": "add", "Sub": "subtract", "Mul": "multiply",
-    "RealDiv": "divide", "Div": "divide", "FloorDiv": "floordiv",
+    "RealDiv": "divide", "FloorDiv": "floordiv",
     "FloorMod": "floormod", "Maximum": "maximum", "Minimum": "minimum",
     "Pow": "pow", "SquaredDifference": "squaredsubtract",
     "TruncateDiv": "truncatediv", "Atan2": "atan2",
@@ -548,6 +575,16 @@ def _select(ctx):
 def _clip_by_value(ctx):
     return ctx.emit("clip_by_value", [ctx.var(0)],
                     clip_min=float(ctx.static(1)), clip_max=float(ctx.static(2)))
+
+
+@tf_op("Div")
+def _div(ctx):
+    # TF Div: C semantics — integer inputs truncate toward zero, floats
+    # divide exactly (conformance case Div.v1_int pinned this)
+    dt = ctx.attr("T")
+    if dt is not None and np.issubdtype(np.dtype(dt), np.integer):
+        return ctx.emit("truncatediv", [ctx.var(0), ctx.var(1)])
+    return ctx.emit("divide", [ctx.var(0), ctx.var(1)])
 
 
 # --------------------------------------------------------------------------
@@ -744,7 +781,15 @@ def _fill(ctx):
 
 @tf_op("Range")
 def _range(ctx):
-    return ctx.emit("range", [ctx.var(0), ctx.var(1), ctx.var(2)])
+    # jnp.arange needs Python scalars (XLA static shapes): Range is a
+    # structural op — require static inputs and fold to a constant.
+    # (The _FOLDERS entry normally handles this; this path covers Range
+    # nodes whose inputs resolved static but weren't folded.)
+    start, limit, delta = (np.asarray(ctx.static(i)).item()
+                           for i in range(3))
+    val = np.arange(start, limit, delta).astype(
+        np.dtype(ctx.attr("Tidx", np.dtype(np.int32))))
+    return ctx.sd.constant(ctx.name.replace("/", "_") + "_range", val)
 
 
 @tf_op("ZerosLike")
@@ -905,8 +950,29 @@ def _max_pool(ctx):
 @tf_op("AvgPool")
 def _avg_pool(ctx):
     fmt, k, s, pad = _tf_pool_args(ctx)
-    return ctx.emit("avgpool2d", [ctx.var(0)], kernel=k, strides=s, padding=pad,
-                    data_format="NCHW" if fmt == "NCHW" else "NHWC")
+    df = "NCHW" if fmt == "NCHW" else "NHWC"
+    pooled = ctx.emit("avgpool2d", [ctx.var(0)], kernel=k, strides=s,
+                      padding=pad, data_format=df)
+    if pad != "SAME":
+        return pooled
+    # TF AvgPool EXCLUDES padding from the divisor; ops/nn averages over
+    # the full kernel area. Pads/kernel/strides are static, so correct
+    # with a precomputed (oh, ow) scale — shared machinery with the ONNX
+    # count_include_pad=0 path (conformance case AvgPool.k3s1_same).
+    # assume_unknown=1: frozen graphs commonly have batch=None; only the
+    # spatial dims feed the scale and they don't depend on batch.
+    from .onnx_import import _avgpool_exclude_pad_scale, _same_pad_begin_end
+
+    shp = ctx.imp.infer_shape(ctx.data_inputs[0], assume_unknown=1)
+    hw = shp[2:4] if df == "NCHW" else shp[1:3]
+    begin, end = _same_pad_begin_end(hw, k, s)
+    if not any(begin) and not any(end):
+        return pooled
+    scale = _avgpool_exclude_pad_scale(
+        hw, k, s, begin, end, np.dtype(ctx.attr("T", np.dtype(np.float32))))
+    scale = scale[None, None] if df == "NCHW" else scale[None, :, :, None]
+    c = ctx.sd.constant(ctx.name.replace("/", "_") + "_cip_scale", scale)
+    return ctx.sd._add_op("multiply", [pooled, c])
 
 
 @tf_op("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3")
@@ -929,6 +995,38 @@ def _fused_batch_norm(ctx):
 def _matrix_diag(ctx):
     table = {"MatrixDiag": "matrix_diag", "MatrixDiagPart": "matrix_diag_part"}
     return ctx.emit(table[ctx.node.op], [ctx.var(0)])
+
+
+@tf_op("MatrixDiagV2", "MatrixDiagV3", "MatrixDiagPartV2", "MatrixDiagPartV3")
+def _matrix_diag_v23(ctx):
+    # TF2's tf.linalg.diag/diag_part emit the V3 ops (conformance corpus
+    # caught the gap). Main-diagonal defaults map to the V1 semantics;
+    # band extraction (k != 0) / explicit geometry are refused.
+    part = "Part" in ctx.node.op
+
+    def _static_int(i, default):
+        if ctx.n_in() <= i:
+            return default
+        return [int(v) for v in np.atleast_1d(ctx.static(i)).tolist()]
+
+    k = _static_int(1, [0])
+    if part:
+        padding = float(np.asarray(ctx.static(2)).item()) \
+            if ctx.n_in() > 2 else 0.0
+        nondefault = k != [0] or padding != 0.0
+    else:
+        num_rows = _static_int(2, [-1])
+        num_cols = _static_int(3, [-1])
+        padding = float(np.asarray(ctx.static(4)).item()) \
+            if ctx.n_in() > 4 else 0.0
+        nondefault = (k != [0] or num_rows != [-1] or num_cols != [-1]
+                      or padding != 0.0)
+    if nondefault:
+        raise UnsupportedTFOpError(
+            f"{ctx.node.op}(k/num_rows/num_cols/padding != defaults) — "
+            "band diagonals are not mapped", ctx.name)
+    return ctx.emit("matrix_diag_part" if part else "matrix_diag",
+                    [ctx.var(0)])
 
 
 @tf_op("TopKV2")

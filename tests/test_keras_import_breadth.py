@@ -445,3 +445,37 @@ class TestXceptionStyleE2E:
             keras.layers.Dense(4),
         ])
         roundtrip(m, img(2, 4, 4, 2), tmp_path)
+
+
+class TestCustomLayerFlattenChain:
+    def test_custom_shape_preserving_between_flatten_and_dense(self,
+                                                               tmp_path):
+        """A registered custom layer may declare shape_preserving=True to
+        sit inside the Flatten->Dense permute chain (round-5 review
+        finding: the refusal had no opt-out for custom layers)."""
+        import tensorflow as _tf
+
+        @keras.utils.register_keras_serializable("t5")
+        class Clamp(keras.layers.Layer):
+            def call(self, x):
+                return _tf.clip_by_value(x, -1.0, 1.0)
+
+        from deeplearning4j_tpu.nn.conf import layers as L
+
+        def factory(config, weights):
+            layer = L.ActivationLayer(activation="hardtanh")
+            layer.shape_preserving = True
+            return layer, None
+
+        register_custom_layer("Clamp", factory)
+        try:
+            m = keras.Sequential([
+                keras.layers.Input((4, 4, 2)),
+                keras.layers.Conv2D(3, 2),
+                keras.layers.Flatten(),
+                Clamp(),
+                keras.layers.Dense(4),
+            ])
+            roundtrip(m, img(2, 4, 4, 2), tmp_path)
+        finally:
+            unregister_custom_layer("Clamp")

@@ -1,0 +1,156 @@
+"""TF-import conformance harness at reference scale (VERDICT r4 item 1).
+
+Reference: ``org.nd4j.imports.tfgraphs.TFGraphTestAllSameDiff`` — the
+data-driven golden-graph suite (SURVEY.md §4.3). Cases live in
+``tf_conformance_cases.py``; this file is the runner plus the coverage
+gates (the op-ledger pattern of ``test_op_validation.py``):
+
+1. every case freezes → imports → executes → compares vs TF eager within
+   per-case tolerance, and asserts its TARGET op is literally present in
+   the frozen GraphDef (coverage can't silently rot);
+2. every op in ``supported_tf_ops()`` is targeted by ≥1 case or carries a
+   written reason in ``SKIP_LEDGER`` — a newly mapped op without cases
+   FAILS this suite;
+3. ``UNMAPPED_REFERENCE_OPS`` (reference mapper-table ops deliberately not
+   mapped) must stay unmapped or the ledger updated;
+4. corpus scale ≥300 cases (the reference's ~1500 tiny graphs, scaled to
+   the 131-op mapped surface at ~2.5 variants/op).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.imports import (import_frozen_tf,  # noqa: E402
+                                        supported_tf_ops)
+from deeplearning4j_tpu.imports.tf_graph_mapper import \
+    UnsupportedTFOpError  # noqa: E402
+
+from tf_conformance_cases import (CASES, SKIP_LEDGER,  # noqa: E402
+                                  UNMAPPED_REFERENCE_OPS, Case)
+
+
+def _freeze(fn, specs):
+    from tensorflow.python.framework.convert_to_constants import \
+        convert_variables_to_constants_v2
+
+    cf = tf.function(fn).get_concrete_function(*specs)
+    frozen = convert_variables_to_constants_v2(cf)
+    gd = frozen.graph.as_graph_def()
+    in_names = [t.name.split(":")[0] for t in frozen.inputs]
+    return gd, in_names
+
+
+def _run(c: Case):
+    specs = [tf.TensorSpec(np.shape(a), tf.as_dtype(np.asarray(a).dtype))
+             for a in c.inputs]
+    expected = np.asarray(c.fn(*[tf.constant(a) for a in c.inputs]))
+    gd, in_names = _freeze(c.fn, specs)
+    if c.require_in_graph:
+        present = {n.op for n in gd.node}
+        assert c.target in present, (
+            f"{c.tag}: target op {c.target!r} not in frozen graph "
+            f"(has {sorted(present)}); the case no longer covers what it "
+            "claims — fix the case or the TF call emitting it")
+    sd = import_frozen_tf(gd)
+    assert sd.tf_outputs, f"{c.tag}: importer found no outputs"
+    out = sd.output(dict(zip(in_names, c.inputs)),
+                    sd.tf_outputs[:1])[sd.tf_outputs[0]].to_numpy()
+    np.testing.assert_allclose(
+        np.asarray(out, np.float64), np.asarray(expected, np.float64),
+        atol=c.atol, rtol=c.rtol, err_msg=c.tag)
+
+
+@pytest.mark.parametrize("c", CASES, ids=[c.tag for c in CASES])
+def test_conformance(c: Case):
+    _run(c)
+
+
+class TestCoverageGates:
+    def test_every_mapped_op_targeted_or_ledgered(self):
+        targets = {c.target for c in CASES}
+        mapped = set(supported_tf_ops())
+        untested = mapped - targets - set(SKIP_LEDGER)
+        assert not untested, (
+            f"mapped TF ops with no conformance case and no skip-ledger "
+            f"entry: {sorted(untested)} — add cases to "
+            "tf_conformance_cases.py or a written skip reason")
+
+    def test_ledger_not_stale(self):
+        targets = {c.target for c in CASES}
+        mapped = set(supported_tf_ops())
+        both = targets & set(SKIP_LEDGER)
+        assert not both, f"ops both cased and skip-ledgered: {sorted(both)}"
+        ghost = set(SKIP_LEDGER) - mapped
+        assert not ghost, f"skip-ledger names unmapped ops: {sorted(ghost)}"
+        for op, reason in SKIP_LEDGER.items():
+            assert len(reason) > 20, f"skip reason for {op} too thin"
+
+    def test_targets_all_actually_mapped(self):
+        mapped = set(supported_tf_ops())
+        phantom = {c.target for c in CASES} - mapped
+        assert not phantom, (
+            f"cases target unmapped ops: {sorted(phantom)}")
+
+    def test_unmapped_reference_ledger(self):
+        mapped = set(supported_tf_ops())
+        drifted = set(UNMAPPED_REFERENCE_OPS) & mapped
+        assert not drifted, (
+            f"ops in the unmapped-reference ledger are now mapped: "
+            f"{sorted(drifted)} — remove them from the ledger and add "
+            "conformance cases")
+        for op, reason in UNMAPPED_REFERENCE_OPS.items():
+            assert len(reason) > 10, f"unmapped reason for {op} too thin"
+
+    def test_corpus_scale(self):
+        assert len(CASES) >= 300, (
+            f"conformance corpus has {len(CASES)} cases; the reference-"
+            "scale bar is >=300 (SURVEY §4.3)")
+
+    def test_unique_tags(self):
+        tags = [c.tag for c in CASES]
+        assert len(tags) == len(set(tags))
+
+
+class TestRefusals:
+    """Ops the importer REFUSES must fail loudly with actionable messages
+    (the skip-ledger's negative coverage)."""
+
+    def test_where_single_arg_refused(self):
+        def fn(a):
+            return tf.where(a > 0.0)
+
+        specs = [tf.TensorSpec([3, 4], tf.float32)]
+        gd, _ = _freeze(fn, specs)
+        with pytest.raises(UnsupportedTFOpError, match="Where"):
+            import_frozen_tf(gd)
+
+    def test_unknown_op_names_itself(self):
+        def fn(a):
+            return tf.raw_ops.Unique(x=a)[0]
+
+        specs = [tf.TensorSpec([6], tf.float32)]
+        gd, _ = _freeze(fn, specs)
+        with pytest.raises(UnsupportedTFOpError, match="Unique"):
+            import_frozen_tf(gd)
+
+
+class TestDynamicBatch:
+    def test_avgpool_same_imports_with_batch_none(self):
+        """Frozen inference graphs routinely carry batch=None; the SAME
+        avg-pool divisor correction must not refuse them (round-5 review
+        finding — only H/W feed the scale)."""
+        def fn(a):
+            return tf.nn.avg_pool2d(a, 3, 1, "SAME")
+
+        specs = [tf.TensorSpec([None, 4, 4, 1], tf.float32)]
+        gd, in_names = _freeze(fn, specs)
+        sd = import_frozen_tf(gd)
+        x = np.random.RandomState(5).randn(2, 4, 4, 1).astype(np.float32)
+        out = sd.output({in_names[0]: x},
+                        sd.tf_outputs[:1])[sd.tf_outputs[0]].to_numpy()
+        np.testing.assert_allclose(out, fn(tf.constant(x)).numpy(),
+                                   atol=1e-5, rtol=1e-5)
