@@ -502,6 +502,120 @@ def bench_word2vec(steps: int) -> dict:
     }
 
 
+def _zipf_sentences(n_words: int, vocab_size: int = 10_000,
+                    sent_len: int = 20, seed: int = 123):
+    rng = np.random.default_rng(seed)
+    n_sent = max(1, n_words // sent_len)
+    p = 1.0 / np.arange(1, vocab_size + 1)
+    p /= p.sum()
+    words = np.array([f"w{i}" for i in range(vocab_size)])
+    ids = rng.choice(vocab_size, size=(n_sent, sent_len), p=p)
+    return [" ".join(row) for row in words[ids]]
+
+
+def bench_word2vec_variant(steps: int, algorithm: str = "cbow",
+                           hs: bool = False) -> dict:
+    """CBOW / hierarchical-softmax driver-visible lines (VERDICT r4 item
+    7): same corpus/methodology as bench_word2vec, different training
+    path."""
+    import jax
+
+    from deeplearning4j_tpu.nlp import Word2Vec
+
+    sents = _zipf_sentences(steps * 1000 * 20)
+    w2v = Word2Vec(min_word_frequency=5, layer_size=100, window=5,
+                   negative=0 if hs else 5, use_hierarchic_softmax=hs,
+                   sampling=1e-3, epochs=1, batch_size=8192, seed=42,
+                   algorithm=algorithm)
+    w2v.set_sentence_iterator(sents)
+    w2v.fit()
+    cold = w2v.words_per_sec
+    w2v.fit()
+    name = f"word2vec_{algorithm}{'_hs' if hs else ''}_train"
+    return {
+        "metric": name, "value": w2v.words_per_sec, "unit": "words/sec",
+        "platform": jax.devices()[0].platform, "vocab": len(w2v.vocab),
+        "corpus_words": len(sents) * 20,
+        "cold_words_per_sec": round(cold),
+        "layer_size": 100, "window": 5,
+        "negative": w2v.negative, "hs": hs,
+        "data": "synthetic zipfian corpus (host RAM)",
+        "final_loss": round(w2v.last_loss, 4),
+    }
+
+
+def bench_paragraph_vectors(steps: int) -> dict:
+    """PV-DBOW on the device-windowed machinery (VERDICT r4 weak #1 /
+    round-5 item 2): 40k docs x 100 words; words/sec includes the
+    interleaved word-vector pass (reference default
+    trainElementsRepresentation=true)."""
+    import jax
+
+    from deeplearning4j_tpu.nlp import ParagraphVectors
+    from deeplearning4j_tpu.nlp.text import LabelAwareIterator
+
+    doc_len = 100
+    n_docs = max(10, steps * 1000 * 20 // doc_len)
+    docs = _zipf_sentences(n_docs * doc_len, sent_len=doc_len)
+    labels = [f"DOC_{i}" for i in range(len(docs))]
+    pv = (ParagraphVectors.builder().min_word_frequency(5).layer_size(100)
+          .epochs(1).negative_sample(5).batch_size(8192).seed(42)
+          .sampling(1e-3)
+          .iterate(LabelAwareIterator(docs, labels)).build())
+    pv.fit()
+    cold = pv.words_per_sec
+    pv.fit()
+    return {
+        "metric": "paragraph_vectors_dbow_train",
+        "value": pv.words_per_sec, "unit": "words/sec",
+        "platform": jax.devices()[0].platform,
+        "vocab": len(pv.vocab), "n_docs": n_docs,
+        "corpus_words": n_docs * doc_len,
+        "cold_words_per_sec": round(cold),
+        "train_word_vectors": True,
+        "data": "synthetic zipfian docs (host RAM)",
+        "final_loss": round(pv.last_loss, 4),
+    }
+
+
+def bench_glove(n_words: int = 1_000_000) -> dict:
+    import jax
+
+    from deeplearning4j_tpu.nlp import Glove
+
+    sents = _zipf_sentences(n_words)
+    g = (Glove.builder().min_word_frequency(5).layer_size(100)
+         .window_size(5).epochs(5).batch_size(8192).seed(42)
+         .iterate(sents).build())
+    g.fit()
+    return {
+        "metric": "glove_train", "value": g.words_per_sec,
+        "unit": "words/sec", "platform": jax.devices()[0].platform,
+        "vocab": len(g.vocab), "corpus_words": n_words, "epochs": 5,
+        "data": "synthetic zipfian corpus (host RAM); includes host "
+                "co-occurrence accumulation",
+    }
+
+
+def bench_fasttext(n_words: int = 400_000) -> dict:
+    import jax
+
+    from deeplearning4j_tpu.nlp import FastText
+
+    sents = _zipf_sentences(n_words)
+    ft = (FastText.builder().min_word_frequency(5).layer_size(100)
+          .negative_sample(5).epochs(1).batch_size(8192).seed(42)
+          .iterate(sents).build())
+    ft.fit()
+    return {
+        "metric": "fasttext_train", "value": ft.words_per_sec,
+        "unit": "words/sec", "platform": jax.devices()[0].platform,
+        "vocab": len(ft.vocab), "corpus_words": n_words,
+        "data": "synthetic zipfian corpus (host RAM); subword host "
+                "pipeline (round-2-era stream path)",
+    }
+
+
 def main() -> None:
     # Persistent executable cache: compile each bench module once per
     # MACHINE, not once per process (the reference ships pre-built libnd4j
@@ -514,8 +628,9 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", default="flagships",
                         choices=["flagships", "lenet", "resnet50", "bert",
-                                 "word2vec", "resnet50-disk",
-                                 "resnet50-predecoded"])
+                                 "word2vec", "word2vec-cbow", "word2vec-hs",
+                                 "paragraph-vectors", "glove", "fasttext",
+                                 "resnet50-disk", "resnet50-predecoded"])
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--batch", type=int, default=None,
                         help="per-config default: resnet50=128, bert=32")
@@ -547,6 +662,13 @@ def main() -> None:
         # measured plateau and its vs_baseline anchor is batch-32).
         emit(bench_bert(args.steps or 80, batch=32))
         emit(bench_word2vec(args.steps or 200))
+        # NLP family (round-5 items 2+7): CBOW + HS driver-visible w2v
+        # variants, PV-DBOW on the device-windowed path, GloVe + FastText
+        emit(bench_word2vec_variant(args.steps or 200, "cbow"))
+        emit(bench_word2vec_variant(args.steps or 200, "skipgram", hs=True))
+        emit(bench_paragraph_vectors(args.steps or 200))
+        emit(bench_glove())
+        emit(bench_fasttext())
         emit(bench_resnet50(args.steps or 80, batch=args.batch or 128,
                             with_listener=args.with_listener))
         return
@@ -558,6 +680,17 @@ def main() -> None:
         result = bench_bert(steps, batch=args.batch or 32)
     elif args.config == "word2vec":
         result = bench_word2vec(args.steps or 200)
+    elif args.config == "word2vec-cbow":
+        result = bench_word2vec_variant(args.steps or 200, "cbow")
+    elif args.config == "word2vec-hs":
+        result = bench_word2vec_variant(args.steps or 200, "skipgram",
+                                        hs=True)
+    elif args.config == "paragraph-vectors":
+        result = bench_paragraph_vectors(args.steps or 200)
+    elif args.config == "glove":
+        result = bench_glove(n_words=(args.steps or 50) * 20_000)
+    elif args.config == "fasttext":
+        result = bench_fasttext(n_words=(args.steps or 20) * 20_000)
     elif args.config == "resnet50-disk":
         result = bench_resnet50_disk(steps, batch=args.batch or 64)
     elif args.config == "resnet50-predecoded":

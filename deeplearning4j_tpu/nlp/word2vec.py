@@ -257,6 +257,10 @@ class SequenceVectors(WordVectors):
     # NS path consumes pool windows at prime-stride offsets instead of
     # gathering the unigram table per candidate (see _make_window_block).
     NEG_POOL_SIZE = 1 << 23
+    # Hierarchical-softmax round-size cap: every pair's path hits the
+    # Huffman root, so summed-scatter collisions per round == round size
+    # (see _round_pairs).
+    HS_MAX_ROUND = 128
 
     @property
     def _window_centers(self) -> int:
@@ -281,7 +285,18 @@ class SequenceVectors(WordVectors):
         stable regime while leaving any vocab ≥ ~1k at the full
         batch-size-derived round."""
         B = self._window_centers * 2 * self.window
-        return max(2 * self.window, min(B, 8 * max(len(self.vocab), 1)))
+        cap = min(B, 8 * max(len(self.vocab), 1))
+        floor = max(2 * self.window, 2)
+        if self.use_hs:
+            # HS concentrates EVERY pair's update on the Huffman ROOT row
+            # (and nearly every pair on the top tree nodes), so collisions
+            # per round equal the round size itself — far past the ~190
+            # summed-update stability boundary at the NS cap. Measured on
+            # the 4M-word bench corpus: B=8190 NaNs, B<=HS_MAX_ROUND
+            # trains cleanly (round 5). The cap must also beat the 2W
+            # floor, or window>=65 would reintroduce the NaN.
+            return min(max(floor, cap), self.HS_MAX_ROUND)
+        return max(floor, cap)
 
     @property
     def _window_span(self) -> int:
@@ -583,8 +598,12 @@ class SequenceVectors(WordVectors):
     @property
     def _cbow_centers(self) -> int:
         """Examples per device-windowed CBOW round (same tiny-vocab
-        stability cap rationale as ``_round_pairs``)."""
-        return max(1, min(self.batch_size, 8 * max(len(self.vocab), 1)))
+        stability cap rationale as ``_round_pairs``; same HS root-row
+        collision cap)."""
+        cap = min(self.batch_size, 8 * max(len(self.vocab), 1))
+        if self.use_hs:
+            cap = min(cap, self.HS_MAX_ROUND)
+        return max(1, cap)
 
     # -- shared device-window helpers (skip-gram + CBOW blocks) ----------
     def _build_negpool(self, ntable_dev, round_negs: int):
@@ -677,15 +696,20 @@ class SequenceVectors(WordVectors):
     def _block_for(self, tag: str, make: Callable, *extra):
         """Shared block-function cache: rebuild (re-trace) only when the
         config/vocab the closure captures actually changed. ``make``
-        receives ``(hs_dev, ntable_dev)`` device tables."""
+        receives ``(hs_dev, ntable_dev)`` device tables. Keyed BY TAG so
+        paths that alternate two blocks in one fit (ParagraphVectors DBOW
+        + word skip-gram) don't thrash a single slot."""
         import jax.numpy as jnp
 
         # content hash (not just len/sum): two rebuilt vocabs with equal size
         # and total count must not reuse stale Huffman paths / unigram tables
         counts = np.ascontiguousarray(self.vocab.counts())
-        key = (tag, len(self.vocab), hash(counts.tobytes()),
+        key = (len(self.vocab), hash(counts.tobytes()),
                self.negative, self.algorithm, self.use_hs) + extra
-        if getattr(self, "_block_cache_key", None) != key:
+        cache = getattr(self, "_block_cache", None)
+        if cache is None:
+            cache = self._block_cache = {}
+        if tag not in cache or cache[tag][0] != key:
             hs_dev = ntable_dev = None
             if self.use_hs:
                 hs_codes, hs_points, hs_mask = huffman_arrays(self.vocab)
@@ -693,9 +717,8 @@ class SequenceVectors(WordVectors):
                           jnp.asarray(hs_mask))
             else:
                 ntable_dev = jnp.asarray(unigram_int_table(self.vocab))
-            self._block_fn = make(hs_dev, ntable_dev)
-            self._block_cache_key = key
-        return self._block_fn
+            cache[tag] = (key, make(hs_dev, ntable_dev))
+        return cache[tag][1]
 
     def _train_windowed(self, corpus: List[np.ndarray],
                         total_words: Optional[int] = None) -> None:

@@ -9,5 +9,6 @@ from .inference import ParallelInference
 from .distributed import SharedTrainingMaster, initialize, shutdown
 from .ring_attention import ring_attention, ring_self_attention
 from .sharded_embeddings import ShardedEmbedding
-from .pipeline import (PipelineParallel, pipeline_apply, pipeline_from_mln,
+from .pipeline import (HeterogeneousPipeline, PipelineParallel,
+                       pipeline_apply, pipeline_from_mln,
                        stack_stage_params)
