@@ -30,12 +30,13 @@ from ..nn.conf import layers as L
 from ..nn.conf.builder import NeuralNetConfiguration
 from ..nn.conf.inputs import CNNInput, InputType, Preprocessor
 from ..nn.graph import (ComputationGraph, ComputationGraphConfiguration,
-                        ElementWiseVertex, MergeVertex)
+                        DotProductVertex, ElementWiseVertex, MergeVertex)
 from .keras_import import (UnsupportedKerasLayerError, _layer_weights,
                            _read_h5, _SequentialBuilder)
 
 _MERGE_OPS = {"Add": "add", "Subtract": "subtract",
-              "Multiply": "mul", "Average": "avg", "Maximum": "max"}
+              "Multiply": "mul", "Average": "avg", "Maximum": "max",
+              "Minimum": "min"}
 
 
 def _call_sites(kl: Dict[str, Any]) -> List[List[Tuple[str, Optional[list]]]]:
@@ -201,6 +202,22 @@ def import_functional_parsed(f, cfg) -> ComputationGraph:
                         f"{name}: axis={axis} on rank-{rank} inputs "
                         "(channel-dim concat only)")
                 gb.add_vertex(name, MergeVertex(), *srcs)
+            elif cls == "Dot":
+                axes = c.get("axes", -1)
+                ax = (axes if isinstance(axes, int)
+                      else (axes[0] if len(set(axes)) == 1 else None))
+                if ax not in (-1, 1):
+                    raise UnsupportedKerasLayerError(
+                        "Dot", f"{name}: axes={axes} (feature-axis dot of "
+                        "two [B, F] inputs only)")
+                gb.add_vertex(name, DotProductVertex(
+                    normalize=bool(c.get("normalize", False))), *srcs)
+            elif cls == "Masking":
+                raise UnsupportedKerasLayerError(
+                    "Masking",
+                    f"{name}: in-graph mask propagation is wired for "
+                    "Sequential models only (MultiLayerNetwork threads the "
+                    "derived mask; ComputationGraph does not)")
             elif cls == "Flatten":
                 gb.add_layer(name, L.FlattenLayer(), *srcs)
                 # chain through an upstream Flatten (or chain member): a

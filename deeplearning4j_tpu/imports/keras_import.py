@@ -128,6 +128,21 @@ def unregister_custom_layer(class_name: str) -> None:
     _CUSTOM_LAYERS.pop(class_name, None)
 
 
+# Lambda-layer hook (reference: KerasLambdaLayer + SameDiffLambdaLayer —
+# lambda BODIES are not portable across serialization, so the
+# implementation is registered in code by the Lambda layer's NAME and
+# looked up at import). ``fn`` maps a jnp array to a jnp array.
+_LAMBDA_FNS: Dict[str, Callable] = {}
+
+
+def register_lambda(name: str, fn: Callable) -> None:
+    _LAMBDA_FNS[name] = fn
+
+
+def unregister_lambda(name: str) -> None:
+    _LAMBDA_FNS.pop(name, None)
+
+
 class KerasModelImport:
     """Reference-shaped entry points."""
 
@@ -283,13 +298,18 @@ class _SequentialBuilder:
             return
         if len(self.cur_cnn) == 4 and isinstance(
                 layer, (L.Convolution3DLayer, L.Subsampling3DLayer,
-                        L.Upsampling3D, L.ZeroPadding3DLayer, L.Cropping3D)):
+                        L.Upsampling3D, L.ZeroPadding3DLayer, L.Cropping3D,
+                        L.ConvLSTM2DLayer)):
             from ..nn.conf.inputs import CNN3DInput
 
             c, d, h, w = self.cur_cnn
             t = layer.set_input_type(CNN3DInput(c, d, h, w))
-            self.cur_cnn = ((t.channels, t.depth, t.height, t.width)
-                            if isinstance(t, CNN3DInput) else None)
+            if isinstance(t, CNN3DInput):
+                self.cur_cnn = (t.channels, t.depth, t.height, t.width)
+            elif isinstance(t, CNNInput):   # ConvLSTM return_sequences=False
+                self.cur_cnn = (t.channels, t.height, t.width)
+            else:
+                self.cur_cnn = None
             return
         self.cur_cnn = None  # left CNN space (Dense/GlobalPool/...)
 
@@ -833,6 +853,140 @@ class _SequentialBuilder:
     def _map_Reshape(self, c, ws):
         shape = tuple(int(d) for d in c["target_shape"])
         self._push(L.ReshapeLayer(shape=shape), None)
+
+    # -- round-5 tail (VERDICT r4 missing #2) ------------------------------
+    def _map_ThresholdedReLU(self, c, ws):
+        self._push(L.ThresholdedReLULayer(theta=float(c.get("theta", 1.0))),
+                   None)
+
+    def _map_Masking(self, c, ws):
+        self._push(L.MaskingLayer(mask_value=float(c.get("mask_value",
+                                                         0.0))), None)
+
+    def _map_Lambda(self, c, ws):
+        name = c.get("name", "lambda")
+        fn = _LAMBDA_FNS.get(name)
+        if fn is None:
+            raise UnsupportedKerasLayerError(
+                "Lambda",
+                f"{name}: lambda bodies are not portable — register the "
+                f"implementation first with register_lambda({name!r}, fn)")
+        self._push(L.LambdaLayer(fn=fn, name=name), None)
+
+    def _map_TimeDistributed(self, c, ws):
+        inner_cfg = c.get("layer", {})
+        icls = inner_cfg.get("class_name")
+        ic = inner_cfg.get("config", {})
+        if icls == "Dense":
+            _require_weights(ws, 'TimeDistributed(Dense)',
+                             c.get('name', '?'))
+            inner = L.DenseLayer(n_out=int(ic["units"]),
+                                 activation=_act(ic.get("activation")),
+                                 has_bias=bool(ic.get("use_bias", True)))
+            kernel = ws[0]
+            bias = ws[1] if len(ws) > 1 else None
+
+            def setter(params):
+                params["W"] = np.asarray(kernel)
+                if bias is not None:
+                    params["b"] = np.asarray(bias)
+        elif icls == "Activation":
+            inner = L.ActivationLayer(activation=_act(ic.get("activation")))
+            setter = None
+        elif icls == "Dropout":
+            inner = L.DropoutLayer(rate=float(ic["rate"]))
+            setter = None
+        else:
+            raise UnsupportedKerasLayerError(
+                "TimeDistributed",
+                f"inner layer {icls!r} (Dense/Activation/Dropout are "
+                "mapped)")
+        self._push(L.TimeDistributedLayer(inner=inner), setter)
+
+    def _map_ConvLSTM2D(self, c, ws):
+        name = c.get("name", "?")
+        _require_weights(ws, 'ConvLSTM2D', name)
+        if c.get("data_format", "channels_last") != "channels_last":
+            raise UnsupportedKerasLayerError("ConvLSTM2D",
+                                             "channels_first h5")
+        if _pair(c.get("strides", 1)) != (1, 1) or \
+                _pair(c.get("dilation_rate", 1)) != (1, 1):
+            raise UnsupportedKerasLayerError(
+                "ConvLSTM2D", f"{name}: strides/dilation != 1")
+        if c.get("activation", "tanh") != "tanh":
+            raise UnsupportedKerasLayerError(
+                "ConvLSTM2D",
+                f"{name}: activation={c.get('activation')!r} (tanh only)")
+        if c.get("recurrent_activation", "sigmoid") != "sigmoid":
+            raise UnsupportedKerasLayerError(
+                "ConvLSTM2D", f"{name}: recurrent_activation="
+                f"{c.get('recurrent_activation')!r} (sigmoid only)")
+        layer = L.ConvLSTM2DLayer(
+            n_out=int(c["filters"]), kernel_size=_pair(c["kernel_size"]),
+            convolution_mode="same" if c.get("padding") == "same"
+            else "truncate",
+            return_sequences=bool(c.get("return_sequences", False)),
+            has_bias=bool(c.get("use_bias", True)))
+        # Keras: kernel [kh,kw,C,4F], recurrent [kh,kw,F,4F], bias [4F] —
+        # the layer stores Keras gate order (i,f,c,o), so only HWIO→OIHW
+        wx = ws[0].transpose(3, 2, 0, 1)
+        wh = ws[1].transpose(3, 2, 0, 1)
+        bias = ws[2] if len(ws) > 2 else None
+
+        def setter(params):
+            params["Wx"] = wx
+            params["Wh"] = wh
+            if bias is not None:
+                params["b"] = bias
+
+        self._push(layer, setter)
+
+    def _map_SeparableConv1D(self, c, ws):
+        name = c.get("name", "?")
+        _require_weights(ws, 'SeparableConv1D', name)
+        if int(_one(c.get("dilation_rate", 1))) != 1:
+            raise UnsupportedKerasLayerError("SeparableConv1D",
+                                             f"{name}: dilation")
+        if c.get("padding") == "causal":
+            raise UnsupportedKerasLayerError("SeparableConv1D",
+                                             f"{name}: causal padding")
+        layer = L.SeparableConvolution1D(
+            n_out=int(c["filters"]),
+            kernel_size=int(_one(c["kernel_size"])),
+            stride=int(_one(c.get("strides", 1))),
+            depth_multiplier=int(c.get("depth_multiplier", 1)),
+            convolution_mode="same" if c.get("padding") == "same"
+            else "truncate",
+            activation=_act(c.get("activation")),
+            has_bias=bool(c.get("use_bias", True)))
+        depth = ws[0].transpose(2, 1, 0)[..., None]   # [k,C,m]→[m,C,k,1]
+        point = ws[1].transpose(2, 1, 0)[..., None]   # [1,C·m,F]→[F,C·m,1,1]
+        bias = ws[2] if len(ws) > 2 else None
+
+        def setter(params):
+            params["dW"] = depth
+            params["pW"] = point
+            if bias is not None:
+                params["b"] = bias
+
+        self._push(layer, setter)
+
+    def _map_ZeroPadding3D(self, c, ws):
+        p = c.get("padding", 1)
+        spec = (p if isinstance(p, int)
+                else tuple(tuple(e) if isinstance(e, (list, tuple)) else e
+                           for e in p))
+        self._push(L.ZeroPadding3DLayer(padding=spec), None)
+
+    def _map_Cropping3D(self, c, ws):
+        p = c.get("cropping", 1)
+        spec = (p if isinstance(p, int)
+                else tuple(tuple(e) if isinstance(e, (list, tuple)) else e
+                           for e in p))
+        self._push(L.Cropping3D(cropping=spec), None)
+
+    def _map_UpSampling3D(self, c, ws):
+        self._push(L.Upsampling3D(size=_triple(c.get("size", 2))), None)
 
     def _map_GaussianNoise(self, c, ws):
         self._push(L.GaussianNoiseLayer(stddev=float(c["stddev"])), None)

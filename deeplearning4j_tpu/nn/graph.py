@@ -89,7 +89,38 @@ class ElementWiseVertex(GraphVertex):
             for v in inputs[1:]:
                 out = jnp.maximum(out, v)
             return out
+        if op == "min":
+            out = inputs[0]
+            for v in inputs[1:]:
+                out = jnp.minimum(out, v)
+            return out
         raise ValueError(f"unknown elementwise op {self.op!r}")
+
+
+@dataclass
+class DotProductVertex(GraphVertex):
+    """Keras functional ``Dot`` merge (round-5 Keras-import tail): batched
+    dot of two FF inputs over the feature axis, optionally L2-normalized
+    (cosine proximity). Output is [B, 1]."""
+
+    normalize: bool = False
+
+    def output_type(self, *ts):
+        if len(ts) != 2 or not all(isinstance(t, FFInput) for t in ts):
+            raise ValueError("DotProductVertex needs two FF inputs")
+        if ts[0].size != ts[1].size:
+            raise ValueError(
+                f"DotProductVertex inputs differ: {ts[0].size} vs "
+                f"{ts[1].size}")
+        return FFInput(1)
+
+    def apply(self, a, b):
+        if self.normalize:
+            a = a / jnp.maximum(jnp.linalg.norm(a, axis=-1, keepdims=True),
+                                1e-12)
+            b = b / jnp.maximum(jnp.linalg.norm(b, axis=-1, keepdims=True),
+                                1e-12)
+        return jnp.sum(a * b, axis=-1, keepdims=True)
 
 
 @dataclass

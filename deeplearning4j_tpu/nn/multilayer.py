@@ -134,6 +134,10 @@ class MultiLayerNetwork:
             pre = self.conf.preprocessors.get(i)
             if pre is not None:
                 x = pre(x)
+            if isinstance(layer, L.MaskingLayer) and fmask is None:
+                # Keras Masking semantics: the mask is DERIVED in-graph and
+                # threaded to downstream mask-aware layers (round-5)
+                fmask = layer.derive_mask(x)
             rng, sub = jax.random.split(rng)
             x, st = self._apply_layer(layer, params[i], x, states[i],
                                       training, sub, fmask)
@@ -154,6 +158,8 @@ class MultiLayerNetwork:
             pre = self.conf.preprocessors.get(i)
             if pre is not None:
                 x = pre(x)
+            if isinstance(layer, L.MaskingLayer) and fmask is None:
+                fmask = layer.derive_mask(x)   # see _forward
             rng, sub = jax.random.split(rng)
             if rnn_states is not None and layer.is_rnn():
                 def run_rnn(lp, xx, rs, st, k, _l=layer):
@@ -228,6 +234,21 @@ class MultiLayerNetwork:
         if not hasattr(out_layer, "compute_score"):
             raise ValueError("last layer must be a loss head (OutputLayer/"
                              "LossLayer/Yolo2OutputLayer/...) to train")
+        # Keras Masking semantics end at the LOSS too: with a leading
+        # MaskingLayer and no explicit masks, the derived mask masks the
+        # per-timestep loss of a recurrent head (round-5; the reference
+        # propagates feature masks into label masks the same way). Derived
+        # here (not just inside the forward) so compute_score sees it.
+        if fmask is None and self.layers \
+                and isinstance(self.layers[0], L.MaskingLayer):
+            x0 = x
+            pre0 = self.conf.preprocessors.get(0)
+            if pre0 is not None:
+                x0 = pre0(x0)
+            fmask = self.layers[0].derive_mask(jnp.asarray(x0))
+        if mask is None and fmask is not None \
+                and isinstance(out_layer, L.RnnOutputLayer):
+            mask = fmask
         if rnn_states is not None:
             pre, new_states, new_rnn = self._forward_to_preout(
                 params, states, x, training, rng, fmask, rnn_states)

@@ -210,3 +210,48 @@ class TestFlattenChainSoundness:
         m.compile(optimizer="sgd", loss="mse")
         m.fit(x, rng.randn(6, 3).astype(np.float32), epochs=2, verbose=0)
         roundtrip(m, {"in0": x}, tmp_path)
+
+
+class TestRound5Merges:
+    def test_minimum_merge(self, tmp_path):
+        inp = keras.layers.Input((5,), name="in0")
+        d1 = keras.layers.Dense(7, activation="relu")(inp)
+        d2 = keras.layers.Dense(7, activation="relu")(inp)
+        merged = keras.layers.Minimum()([d1, d2])
+        out = keras.layers.Dense(2)(merged)
+        m = keras.Model(inp, out)
+        roundtrip(m, {"in0": rng.randn(4, 5).astype(np.float32)}, tmp_path)
+
+    def test_dot_merge(self, tmp_path):
+        a = keras.layers.Input((6,), name="ina")
+        b = keras.layers.Input((6,), name="inb")
+        da = keras.layers.Dense(8, activation="tanh")(a)
+        db = keras.layers.Dense(8, activation="tanh")(b)
+        dot = keras.layers.Dot(axes=1)([da, db])
+        m = keras.Model([a, b], dot)
+        roundtrip(m, {"ina": rng.randn(5, 6).astype(np.float32),
+                      "inb": rng.randn(5, 6).astype(np.float32)}, tmp_path)
+
+    def test_dot_merge_normalized(self, tmp_path):
+        a = keras.layers.Input((6,), name="ina")
+        b = keras.layers.Input((6,), name="inb")
+        da = keras.layers.Dense(8)(a)
+        db = keras.layers.Dense(8)(b)
+        dot = keras.layers.Dot(axes=1, normalize=True)([da, db])
+        m = keras.Model([a, b], dot)
+        roundtrip(m, {"ina": rng.randn(5, 6).astype(np.float32),
+                      "inb": rng.randn(5, 6).astype(np.float32)}, tmp_path)
+
+    def test_masking_refused_in_graphs(self, tmp_path):
+        inp = keras.layers.Input((6, 4), name="in0")
+        mk = keras.layers.Masking()(inp)
+        ls = keras.layers.LSTM(5, return_sequences=True)(mk)
+        out = keras.layers.GlobalAveragePooling1D()(ls)
+        m = keras.Model(inp, out)
+        path = str(tmp_path / "m.h5")
+        m.save(path)
+        # Keras 3 lowers the mask into NotEqual op-layers in the DAG;
+        # whichever node is reached first, the import must refuse
+        with pytest.raises(UnsupportedKerasLayerError,
+                           match="Masking|NotEqual"):
+            KerasModelImport.import_keras_model_and_weights(path)

@@ -479,3 +479,144 @@ class TestCustomLayerFlattenChain:
             roundtrip(m, img(2, 4, 4, 2), tmp_path)
         finally:
             unregister_custom_layer("Clamp")
+
+
+class TestRound5Tail:
+    """The last ~14 Keras layer types (VERDICT r4 missing #2)."""
+
+    def test_thresholded_relu(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((6,)),
+            keras.layers.Dense(8),
+            keras.layers.ThresholdedReLU(theta=0.4),
+            keras.layers.Dense(3),
+        ])
+        roundtrip(m, rng.randn(4, 6).astype(np.float32), tmp_path)
+
+    def test_time_distributed_dense(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((5, 4)),
+            keras.layers.TimeDistributed(keras.layers.Dense(
+                7, activation="relu")),
+            keras.layers.GlobalAveragePooling1D(),
+            keras.layers.Dense(2),
+        ])
+        roundtrip(m, seq(3, 5, 4), tmp_path)
+
+    def test_lambda_registered(self, tmp_path):
+        from deeplearning4j_tpu.imports.keras_import import (
+            register_lambda, unregister_lambda)
+
+        m = keras.Sequential([
+            keras.layers.Input((6,)),
+            keras.layers.Dense(8),
+            keras.layers.Lambda(lambda t: t * 2.0 + 1.0, name="scale2"),
+            keras.layers.Dense(3),
+        ])
+        import jax.numpy as jnp
+
+        register_lambda("scale2", lambda t: t * 2.0 + 1.0)
+        try:
+            roundtrip(m, rng.randn(4, 6).astype(np.float32), tmp_path)
+        finally:
+            unregister_lambda("scale2")
+
+    def test_lambda_unregistered_refused(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((6,)),
+            keras.layers.Lambda(lambda t: t + 1.0, name="mystery"),
+        ])
+        path = str(tmp_path / "m.h5")
+        m.save(path)
+        with pytest.raises(UnsupportedKerasLayerError,
+                           match="register_lambda"):
+            KerasModelImport.import_keras_sequential_model_and_weights(path)
+
+    def test_separable_conv1d(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((12, 4)),
+            keras.layers.SeparableConv1D(6, 3, depth_multiplier=2,
+                                         padding="same",
+                                         activation="relu"),
+            keras.layers.SeparableConv1D(3, 3, padding="valid"),
+            keras.layers.GlobalAveragePooling1D(),
+        ])
+        roundtrip(m, seq(2, 12, 4), tmp_path)
+
+    def test_zero_padding_cropping_3d_asymmetric(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((4, 4, 4, 2)),
+            keras.layers.ZeroPadding3D(((1, 2), (0, 1), (2, 0))),
+            keras.layers.Conv3D(3, 2),
+            keras.layers.Cropping3D(((1, 0), (0, 1), (1, 1))),
+            keras.layers.GlobalAveragePooling3D(),
+        ])
+        roundtrip(m, rng.randn(2, 4, 4, 4, 2).astype(np.float32), tmp_path)
+
+    def test_upsampling_3d(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((3, 3, 3, 2)),
+            keras.layers.UpSampling3D(2),
+            keras.layers.GlobalMaxPooling3D(),
+        ])
+        roundtrip(m, rng.randn(2, 3, 3, 3, 2).astype(np.float32), tmp_path)
+
+    def test_conv_lstm_2d_sequences(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((4, 6, 6, 2)),   # [T, H, W, C]
+            keras.layers.ConvLSTM2D(3, 3, padding="same",
+                                    return_sequences=True),
+            keras.layers.GlobalAveragePooling3D(),
+        ])
+        roundtrip(m, rng.randn(2, 4, 6, 6, 2).astype(np.float32), tmp_path,
+                  atol=5e-4)
+
+    def test_conv_lstm_2d_last_state(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((3, 5, 5, 2)),
+            keras.layers.ConvLSTM2D(4, 3, padding="valid",
+                                    return_sequences=False),
+            keras.layers.GlobalAveragePooling2D(),
+        ])
+        roundtrip(m, rng.randn(2, 3, 5, 5, 2).astype(np.float32), tmp_path,
+                  atol=5e-4)
+
+    def test_masking_lstm_pooling_parity(self, tmp_path):
+        """The masked recurrent e2e the verdict names: Masking's derived
+        mask must freeze downstream pooling exactly as Keras does."""
+        m = keras.Sequential([
+            keras.layers.Input((6, 4)),
+            keras.layers.Masking(mask_value=0.0),
+            keras.layers.LSTM(5, return_sequences=True),
+            keras.layers.GlobalAveragePooling1D(),
+            keras.layers.Dense(3),
+        ])
+        x = seq(3, 6, 4)
+        x[0, 4:] = 0.0     # masked tail
+        x[1, 2:] = 0.0
+        roundtrip(m, x, tmp_path, atol=5e-4)
+
+    def test_masked_model_fine_tunes(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((6, 4)),
+            keras.layers.Masking(mask_value=0.0),
+            keras.layers.LSTM(5, return_sequences=True),
+            keras.layers.GlobalAveragePooling1D(),
+            keras.layers.Dense(3, activation="softmax"),
+        ])
+        path = str(tmp_path / "m.h5")
+        m.save(path)
+        net = KerasModelImport.import_keras_sequential_model_and_weights(
+            path)
+        from deeplearning4j_tpu.data import DataSet
+
+        x = seq(16, 6, 4)
+        x[:8, 3:] = 0.0
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 16)]
+        ds = DataSet(x, y)
+        first = float(net.score(ds))
+        for _ in range(30):
+            net.fit(ds)
+        assert float(net.score(ds)) < first, "masked model did not train"
+
+

@@ -428,3 +428,47 @@ class TestReviewRegressions:
             zf.writestr("meta.json", meta)
         with pytest.raises(ValueError, match="coefficient count mismatch"):
             MultiLayerNetwork.load(path)
+
+
+class TestMaskingLayerLoss:
+    """Round-5: a leading MaskingLayer's derived mask must reach the
+    per-timestep loss of a recurrent head (Keras Masking semantics; the
+    reference propagates feature masks into label masks)."""
+
+    def _net(self):
+        from deeplearning4j_tpu.learning import Sgd
+        from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf import layers as L
+
+        conf = (NeuralNetConfiguration.builder().seed(3)
+                .updater(Sgd(learning_rate=0.05)).list()
+                .layer(L.MaskingLayer(mask_value=0.0))
+                .layer(L.LSTM(n_out=6))
+                .layer(L.RnnOutputLayer(n_out=3, activation="softmax",
+                                        loss="mcxent"))
+                .set_input_type(InputType.recurrent(4, 5)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_masked_steps_excluded_from_loss(self):
+        from deeplearning4j_tpu.data import DataSet
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(6, 5, 4)).astype(np.float32)
+        x[:, 3:] = 0.0                        # masked tail
+        y = np.zeros((6, 5, 3), np.float32)
+        y[..., 0] = 1.0
+        net = self._net()
+        s1 = float(net.score(DataSet(x, y)))
+        # garbage labels in the MASKED region must not change the score
+        y2 = y.copy()
+        y2[:, 3:] = 0.0
+        y2[:, 3:, 2] = 1.0
+        s2 = float(net.score(DataSet(x, y2)))
+        assert abs(s1 - s2) < 1e-6, (s1, s2)
+        # ...but garbage labels in the VALID region must
+        y3 = y.copy()
+        y3[:, :3] = 0.0
+        y3[:, :3, 1] = 1.0
+        s3 = float(net.score(DataSet(x, y3)))
+        assert abs(s1 - s3) > 1e-3, (s1, s3)
