@@ -1,0 +1,334 @@
+"""ONNX deep-model end-to-end (round-5 item 5; reference SURVEY §2.1
+samediff-import-onnx row: the reference imports real zoo models).
+
+The ``onnx`` pip package is absent (no egress), so ``torch.onnx.export``
+cannot serialize — instead each test builds the EXPORTER-SHAPED GraphProto
+by hand on the vendored IR (tests/onnx_testlib.py, the established
+pattern) using the live torch module's own weights, then checks logits
+parity against that torch module and fine-tunes a step. The node
+sequences mirror what torch's exporter emits for these architectures
+(Conv/BatchNormalization/Relu/MaxPool/Add/GlobalAveragePool/Flatten/Gemm;
+LayerNormalization/MatMul/Transpose/Softmax/Gelu), opset 17.
+
+Op-coverage note: both graphs import with ZERO importer gaps — every op
+they need was already in the 101-op table (`supported_onnx_ops()`); any
+future gap raises UnsupportedOnnxOpError naming the op.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+from onnx_testlib import make_model, make_node, run_model  # noqa: E402
+
+F32 = np.float32
+
+
+def _np(t):
+    return t.detach().cpu().numpy().astype(F32)
+
+
+# =========================================================================
+# ResNet-18-class CNN: stem + 2 basic blocks (identity + projection
+# downsample) + GAP + FC — BN + residual + GAP, the structure the verdict
+# names.
+# =========================================================================
+
+class _BasicBlock(nn.Module):
+    def __init__(self, cin, cout, stride=1):
+        super().__init__()
+        self.conv1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(cout)
+        self.conv2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(cout)
+        self.down = None
+        if stride != 1 or cin != cout:
+            self.down = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride, bias=False),
+                nn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idn = x if self.down is None else self.down(x)
+        h = torch.relu(self.bn1(self.conv1(x)))
+        h = self.bn2(self.conv2(h))
+        return torch.relu(h + idn)
+
+
+class _ResNetMini(nn.Module):
+    def __init__(self, n_classes=5):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 8, 7, 2, 3, bias=False)
+        self.bn1 = nn.BatchNorm2d(8)
+        self.pool = nn.MaxPool2d(3, 2, 1)
+        self.layer1 = _BasicBlock(8, 8)
+        self.layer2 = _BasicBlock(8, 16, stride=2)
+        self.fc = nn.Linear(16, n_classes)
+
+    def forward(self, x):
+        h = self.pool(torch.relu(self.bn1(self.conv1(x))))
+        h = self.layer2(self.layer1(h))
+        h = h.mean(dim=(2, 3))
+        return self.fc(h)
+
+
+def _bn_inits(init, bn: nn.BatchNorm2d, p):
+    init[f"{p}_g"] = _np(bn.weight)
+    init[f"{p}_b"] = _np(bn.bias)
+    init[f"{p}_rm"] = _np(bn.running_mean)
+    init[f"{p}_rv"] = _np(bn.running_var)
+
+
+def _bn_node(p, src, dst):
+    return make_node("BatchNormalization",
+                     [src, f"{p}_g", f"{p}_b", f"{p}_rm", f"{p}_rv"],
+                     [dst], epsilon=1e-5)
+
+
+def _resnet_graph(tm: _ResNetMini, batch=None):
+    nodes, init = [], {}
+    init["c1_w"] = _np(tm.conv1.weight)
+    nodes += [
+        make_node("Conv", ["x", "c1_w"], ["c1"], kernel_shape=[7, 7],
+                  strides=[2, 2], pads=[3, 3, 3, 3]),
+        _bn_node("bn1", "c1", "n1"),
+        make_node("Relu", ["n1"], ["r1"]),
+        make_node("MaxPool", ["r1"], ["p1"], kernel_shape=[3, 3],
+                  strides=[2, 2], pads=[1, 1, 1, 1]),
+    ]
+    _bn_inits(init, tm.bn1, "bn1")
+
+    def block(name, blk: _BasicBlock, src):
+        init[f"{name}_w1"] = _np(blk.conv1.weight)
+        init[f"{name}_w2"] = _np(blk.conv2.weight)
+        s = blk.conv1.stride[0]
+        nodes.extend([
+            make_node("Conv", [src, f"{name}_w1"], [f"{name}_c1"],
+                      kernel_shape=[3, 3], strides=[s, s],
+                      pads=[1, 1, 1, 1]),
+            _bn_node(f"{name}_bn1", f"{name}_c1", f"{name}_n1"),
+            make_node("Relu", [f"{name}_n1"], [f"{name}_r1"]),
+            make_node("Conv", [f"{name}_r1", f"{name}_w2"], [f"{name}_c2"],
+                      kernel_shape=[3, 3], pads=[1, 1, 1, 1]),
+            _bn_node(f"{name}_bn2", f"{name}_c2", f"{name}_n2"),
+        ])
+        _bn_inits(init, blk.bn1, f"{name}_bn1")
+        _bn_inits(init, blk.bn2, f"{name}_bn2")
+        if blk.down is not None:
+            init[f"{name}_dw"] = _np(blk.down[0].weight)
+            nodes.extend([
+                make_node("Conv", [src, f"{name}_dw"], [f"{name}_dc"],
+                          kernel_shape=[1, 1], strides=[s, s]),
+                _bn_node(f"{name}_dbn", f"{name}_dc", f"{name}_dn"),
+            ])
+            _bn_inits(init, blk.down[1], f"{name}_dbn")
+            idn = f"{name}_dn"
+        else:
+            idn = src
+        nodes.extend([
+            make_node("Add", [f"{name}_n2", idn], [f"{name}_sum"]),
+            make_node("Relu", [f"{name}_sum"], [f"{name}_out"]),
+        ])
+        return f"{name}_out"
+
+    h = block("b1", tm.layer1, "p1")
+    h = block("b2", tm.layer2, h)
+    init["fc_w"] = _np(tm.fc.weight)      # [out, in] → Gemm transB
+    init["fc_b"] = _np(tm.fc.bias)
+    nodes += [
+        make_node("GlobalAveragePool", [h], ["gap"]),
+        make_node("Flatten", ["gap"], ["flat"], axis=1),
+        make_node("Gemm", ["flat", "fc_w", "fc_b"], ["logits"], transB=1),
+    ]
+    return make_model(nodes, inputs=[("x", [batch, 3, 32, 32])],
+                      outputs=["logits"], initializers=init)
+
+
+class TestResNetClassONNX:
+    def _setup(self):
+        torch.manual_seed(7)
+        tm = _ResNetMini().eval()
+        # non-trivial BN running stats (fresh init is mean 0 / var 1 —
+        # permutation-invariant and too forgiving)
+        with torch.no_grad():
+            tm(torch.randn(16, 3, 32, 32))   # no_grad + eval: stats frozen
+            tm.train()
+            tm(torch.randn(16, 3, 32, 32))   # one train pass moves stats
+            tm.eval()
+        return tm
+
+    def test_logits_parity(self):
+        tm = self._setup()
+        x = np.random.RandomState(0).randn(2, 3, 32, 32).astype(F32)
+        with torch.no_grad():
+            expected = _np(tm(torch.from_numpy(x)))
+        got = run_model(_resnet_graph(tm, batch=2), {"x": x})[0]
+        np.testing.assert_allclose(got, expected, atol=2e-4, rtol=1e-3)
+
+    def test_fine_tune_step(self):
+        from deeplearning4j_tpu.autodiff import TrainingConfig
+        from deeplearning4j_tpu.data import DataSet
+        from deeplearning4j_tpu.imports.onnx_import import import_onnx
+        from deeplearning4j_tpu.learning import Adam
+
+        tm = self._setup()
+        sd = import_onnx(_resnet_graph(tm),
+                         input_shapes={"x": (8, 3, 32, 32)})
+        logits = sd.get_variable(sd.onnx_outputs[0])
+        sd.convert_to_variables()
+        sd.placeholder("y", shape=(8, 5))
+        sd.loss_ops.softmax_cross_entropy(
+            logits, sd.get_variable("y")).rename("loss")
+        sd.set_loss_variables("loss")
+        sd.set_training_config(TrainingConfig(updater=Adam(1e-3),
+                                              loss_name="loss"))
+        rs = np.random.RandomState(3)
+        xs = rs.randn(8, 3, 32, 32).astype(F32)
+        ys = np.eye(5, dtype=F32)[rs.randint(0, 5, 8)]
+        history = sd.fit(DataSet(xs, ys), epochs=15)
+        curve = history.loss_curve()
+        assert curve[-1] < curve[0], (curve[0], curve[-1])
+
+
+# =========================================================================
+# 2-block pre-LN transformer encoder (MHA with explicit projections,
+# GELU MLP, residuals) + mean-pool + linear head
+# =========================================================================
+
+D, H, FF, T = 16, 2, 32, 6
+
+
+class _Encoder(nn.Module):
+    def __init__(self, blocks=2, n_classes=4):
+        super().__init__()
+        self.blocks = nn.ModuleList()
+        for _ in range(blocks):
+            blk = nn.ModuleDict({
+                "ln1": nn.LayerNorm(D), "ln2": nn.LayerNorm(D),
+                "q": nn.Linear(D, D), "k": nn.Linear(D, D),
+                "v": nn.Linear(D, D), "o": nn.Linear(D, D),
+                "f1": nn.Linear(D, FF), "f2": nn.Linear(FF, D),
+            })
+            self.blocks.append(blk)
+        self.head = nn.Linear(D, n_classes)
+
+    def forward(self, x):                      # [B, T, D]
+        B = x.shape[0]
+        dh = D // H
+        for blk in self.blocks:
+            h = blk["ln1"](x)
+            q = blk["q"](h).view(B, T, H, dh).transpose(1, 2)
+            k = blk["k"](h).view(B, T, H, dh).transpose(1, 2)
+            v = blk["v"](h).view(B, T, H, dh).transpose(1, 2)
+            a = torch.softmax(q @ k.transpose(-1, -2) / dh ** 0.5, dim=-1)
+            att = (a @ v).transpose(1, 2).reshape(B, T, D)
+            x = x + blk["o"](att)
+            h2 = blk["ln2"](x)
+            x = x + blk["f2"](torch.nn.functional.gelu(blk["f1"](h2)))
+        return self.head(x.mean(dim=1))
+
+
+def _linear(nodes, init, p, src, dst, lin: nn.Linear):
+    init[f"{p}_w"] = _np(lin.weight).T.copy()     # [in, out] for MatMul
+    init[f"{p}_b"] = _np(lin.bias)
+    nodes.extend([
+        make_node("MatMul", [src, f"{p}_w"], [f"{p}_mm"]),
+        make_node("Add", [f"{p}_mm", f"{p}_b"], [dst]),
+    ])
+
+
+def _encoder_graph(tm: _Encoder, batch):
+    nodes, init = [], {}
+    dh = D // H
+    init["scale"] = np.asarray(1.0 / dh ** 0.5, F32)
+    init["shape_heads"] = np.asarray([batch, T, H, dh], np.int64)
+    init["shape_flat"] = np.asarray([batch, T, D], np.int64)
+    cur = "x"
+    for bi, blk in enumerate(tm.blocks):
+        p = f"b{bi}"
+        for ln_name in ("ln1", "ln2"):
+            init[f"{p}_{ln_name}_g"] = _np(blk[ln_name].weight)
+            init[f"{p}_{ln_name}_b"] = _np(blk[ln_name].bias)
+        nodes.append(make_node(
+            "LayerNormalization",
+            [cur, f"{p}_ln1_g", f"{p}_ln1_b"], [f"{p}_h"],
+            axis=-1, epsilon=1e-5))
+        for w in ("q", "k", "v"):
+            _linear(nodes, init, f"{p}_{w}", f"{p}_h", f"{p}_{w}p",
+                    blk[w])
+            nodes.extend([
+                make_node("Reshape", [f"{p}_{w}p", "shape_heads"],
+                          [f"{p}_{w}r"]),
+                make_node("Transpose", [f"{p}_{w}r"], [f"{p}_{w}t"],
+                          perm=[0, 2, 1, 3]),
+            ])
+        nodes.extend([
+            make_node("Transpose", [f"{p}_kt"], [f"{p}_ktt"],
+                      perm=[0, 1, 3, 2]),
+            make_node("MatMul", [f"{p}_qt", f"{p}_ktt"], [f"{p}_qk"]),
+            make_node("Mul", [f"{p}_qk", "scale"], [f"{p}_qks"]),
+            make_node("Softmax", [f"{p}_qks"], [f"{p}_attn"], axis=-1),
+            make_node("MatMul", [f"{p}_attn", f"{p}_vt"], [f"{p}_av"]),
+            make_node("Transpose", [f"{p}_av"], [f"{p}_avt"],
+                      perm=[0, 2, 1, 3]),
+            make_node("Reshape", [f"{p}_avt", "shape_flat"],
+                      [f"{p}_avf"]),
+        ])
+        _linear(nodes, init, f"{p}_o", f"{p}_avf", f"{p}_op", blk["o"])
+        nodes.append(make_node("Add", [cur, f"{p}_op"], [f"{p}_res1"]))
+        nodes.append(make_node(
+            "LayerNormalization",
+            [f"{p}_res1", f"{p}_ln2_g", f"{p}_ln2_b"], [f"{p}_h2"],
+            axis=-1, epsilon=1e-5))
+        _linear(nodes, init, f"{p}_f1", f"{p}_h2", f"{p}_f1o", blk["f1"])
+        nodes.append(make_node("Gelu", [f"{p}_f1o"], [f"{p}_gelu"]))
+        _linear(nodes, init, f"{p}_f2", f"{p}_gelu", f"{p}_f2o", blk["f2"])
+        nodes.append(make_node("Add", [f"{p}_res1", f"{p}_f2o"],
+                               [f"{p}_out"]))
+        cur = f"{p}_out"
+    nodes.append(make_node("ReduceMean", [cur], ["pooled"], axes=[1],
+                           keepdims=0))
+    init["head_w"] = _np(tm.head.weight)
+    init["head_b"] = _np(tm.head.bias)
+    nodes.append(make_node("Gemm", ["pooled", "head_w", "head_b"],
+                           ["logits"], transB=1))
+    return make_model(nodes, inputs=[("x", [batch, T, D])],
+                      outputs=["logits"], initializers=init)
+
+
+class TestTransformerEncoderONNX:
+    def test_logits_parity(self):
+        torch.manual_seed(11)
+        tm = _Encoder().eval()
+        x = np.random.RandomState(1).randn(2, T, D).astype(F32)
+        with torch.no_grad():
+            expected = _np(tm(torch.from_numpy(x)))
+        got = run_model(_encoder_graph(tm, batch=2), {"x": x})[0]
+        np.testing.assert_allclose(got, expected, atol=2e-4, rtol=1e-3)
+
+    def test_fine_tune_step(self):
+        from deeplearning4j_tpu.autodiff import TrainingConfig
+        from deeplearning4j_tpu.data import DataSet
+        from deeplearning4j_tpu.imports.onnx_import import import_onnx
+        from deeplearning4j_tpu.learning import Adam
+
+        torch.manual_seed(12)
+        tm = _Encoder().eval()
+        sd = import_onnx(_encoder_graph(tm, batch=8))
+        logits = sd.get_variable(sd.onnx_outputs[0])
+        sd.convert_to_variables()
+        sd.placeholder("y", shape=(8, 4))
+        sd.loss_ops.softmax_cross_entropy(
+            logits, sd.get_variable("y")).rename("loss")
+        sd.set_loss_variables("loss")
+        sd.set_training_config(TrainingConfig(updater=Adam(1e-3),
+                                              loss_name="loss"))
+        rs = np.random.RandomState(5)
+        xs = rs.randn(8, T, D).astype(F32)
+        ys = np.eye(4, dtype=F32)[rs.randint(0, 4, 8)]
+        history = sd.fit(DataSet(xs, ys), epochs=25)
+        curve = history.loss_curve()
+        assert curve[-1] < curve[0] * 0.9, (curve[0], curve[-1])
