@@ -137,17 +137,95 @@ def _summarize(metric: str, times, batch: int, flops_per_step, platform: str,
     return result
 
 
+def _resnet50_model(image_size: int = 224):
+    """The flagship ResNet-50 exactly as benched (bf16 compute / fp32
+    params) — shared by the throughput bench and the cold-start audit so
+    the two can never drift apart silently."""
+    from deeplearning4j_tpu.models import ResNet50
+
+    model = ResNet50(num_classes=1000, image_size=image_size).init()
+    model.conf.global_conf.compute_dtype = "bfloat16"
+    return model
+
+
+def _bert_training(batch: int = 32, seq: int = 128):
+    """BERT-base import + fine-tune training step setup (shared by
+    bench_bert and the cold-start audit). Returns
+    (step, params, upd, ph, n_params)."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+    from deeplearning4j_tpu.imports import import_frozen_tf
+    from deeplearning4j_tpu.imports.tf_fixtures import (
+        build_bert_frozen_graph, make_bert_batch)
+    from deeplearning4j_tpu.learning import Adam
+
+    hidden, vocab, n_classes = 768, 30522, 3
+    gd, in_names, n_params = build_bert_frozen_graph(
+        batch=batch, seq=seq, hidden=hidden, vocab=vocab)
+    sd = import_frozen_tf(gd)
+    sd.convert_to_variables()
+    pooled = sd.get_variable(sd.tf_outputs[0])
+    w = sd.var("cls_w", shape=(hidden, n_classes), init="xavier")
+    b = sd.var("cls_b", shape=(n_classes,), init="zeros")
+    pooled.mmul(w).add(b).rename("logits")
+    sd.placeholder("labels", shape=(batch, n_classes))
+    sd.ops.softmax_cross_entropy(sd.get_variable("logits"),
+                                 sd.get_variable("labels"), name="loss")
+    sd.set_loss_variables("loss")
+    tc = TrainingConfig(updater=Adam(2e-5), loss_name="loss")
+    sd.set_training_config(tc)
+    ids, types, mask, y = make_bert_batch(batch, seq, vocab, n_classes)
+    ph = {k: jnp.asarray(v) for k, v in
+          {**dict(zip(in_names, (ids, types, mask))), "labels": y}.items()}
+    params = sd._params()
+    upd = tc.updater.init(params)
+    step = sd._train_step_fn("loss", tuple(sd.placeholders()))
+    return step, params, upd, ph, n_params
+
+
+def _lenet_model():
+    """The flagship LeNet config (shared bench / cold-audit)."""
+    from deeplearning4j_tpu.learning import Nesterovs
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf import layers as L
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(123)
+            .updater(Nesterovs(learning_rate=0.01, momentum=0.9))
+            .activation("relu")
+            .weight_init("xavier")
+            .list()
+            .layer(L.ConvolutionLayer(n_out=20, kernel_size=(5, 5)))
+            .layer(L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(L.ConvolutionLayer(n_out=50, kernel_size=(5, 5)))
+            .layer(L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(L.DenseLayer(n_out=500))
+            .layer(L.OutputLayer(n_out=10, loss="mcxent",
+                                 activation="softmax"))
+            .set_input_type(InputType.convolutional(28, 28, 1))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _w2v_model():
+    """The flagship Word2Vec hyperparameters (shared bench / cold-audit)."""
+    from deeplearning4j_tpu.nlp import Word2Vec
+
+    return Word2Vec(min_word_frequency=5, layer_size=100, window=5,
+                    negative=5, sampling=1e-3, epochs=1, batch_size=8192,
+                    seed=42)
+
+
 def bench_resnet50(steps: int, batch: int = 64, image_size: int = 224,
                    with_listener: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
 
     from deeplearning4j_tpu.data import DataSet
-    from deeplearning4j_tpu.models import ResNet50
 
-    model = ResNet50(num_classes=1000, image_size=image_size).init()
-    # bf16 compute on the MXU, fp32 master params
-    model.conf.global_conf.compute_dtype = "bfloat16"
+    model = _resnet50_model(image_size)
     if with_listener:
         from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
 
@@ -186,34 +264,7 @@ def bench_bert(steps: int, batch: int = 32, seq: int = 128) -> dict:
     import jax
     import jax.numpy as jnp
 
-    from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
-    from deeplearning4j_tpu.imports import import_frozen_tf
-    from deeplearning4j_tpu.imports.tf_fixtures import (build_bert_frozen_graph,
-                                                        make_bert_batch)
-    from deeplearning4j_tpu.learning import Adam
-
-    hidden, vocab, n_classes = 768, 30522, 3
-    gd, in_names, n_params = build_bert_frozen_graph(batch=batch, seq=seq,
-                                                     hidden=hidden, vocab=vocab)
-    sd = import_frozen_tf(gd)
-    sd.convert_to_variables()
-    pooled = sd.get_variable(sd.tf_outputs[0])
-    w = sd.var("cls_w", shape=(hidden, n_classes), init="xavier")
-    b = sd.var("cls_b", shape=(n_classes,), init="zeros")
-    logits = pooled.mmul(w).add(b).rename("logits")
-    sd.placeholder("labels", shape=(batch, n_classes))
-    sd.ops.softmax_cross_entropy(logits, sd.get_variable("labels"), name="loss")
-    sd.set_loss_variables("loss")
-    tc = TrainingConfig(updater=Adam(2e-5), loss_name="loss")
-    sd.set_training_config(tc)
-
-    ids, types, mask, y = make_bert_batch(batch, seq, vocab, n_classes)
-    ph = {k: jnp.asarray(v) for k, v in
-          {**dict(zip(in_names, (ids, types, mask))), "labels": y}.items()}
-    params = sd._params()
-    upd = tc.updater.init(params)
-    step = sd._train_step_fn("loss", tuple(sd.placeholders()))
-
+    step, params, upd, ph, n_params = _bert_training(batch, seq)
     state = {"params": params, "upd": upd, "loss": None}
 
     # FLOP count must be taken BEFORE the timed loop: the jitted step donates
@@ -244,27 +295,9 @@ def bench_lenet(steps: int, with_listener: bool = False) -> dict:
     import jax.numpy as jnp
 
     from deeplearning4j_tpu.data import MnistDataSetIterator
-    from deeplearning4j_tpu.learning import Nesterovs
-    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
-                                       NeuralNetConfiguration)
-    from deeplearning4j_tpu.nn.conf import layers as L
 
     batch = 128
-    conf = (NeuralNetConfiguration.builder()
-            .seed(123)
-            .updater(Nesterovs(learning_rate=0.01, momentum=0.9))
-            .activation("relu")
-            .weight_init("xavier")
-            .list()
-            .layer(L.ConvolutionLayer(n_out=20, kernel_size=(5, 5)))
-            .layer(L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
-            .layer(L.ConvolutionLayer(n_out=50, kernel_size=(5, 5)))
-            .layer(L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
-            .layer(L.DenseLayer(n_out=500))
-            .layer(L.OutputLayer(n_out=10, loss="mcxent", activation="softmax"))
-            .set_input_type(InputType.convolutional(28, 28, 1))
-            .build())
-    model = MultiLayerNetwork(conf).init()
+    model = _lenet_model()
     if with_listener:
         from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
 
@@ -475,9 +508,7 @@ def bench_word2vec(steps: int) -> dict:
     ids = rng.choice(vocab_size, size=(n_sent, sent_len), p=p)
     sents = [" ".join(row) for row in words[ids]]
 
-    w2v = Word2Vec(min_word_frequency=5, layer_size=100, window=5,
-                   negative=5, sampling=1e-3, epochs=1, batch_size=8192,
-                   seed=42)
+    w2v = _w2v_model()
     w2v.set_sentence_iterator(sents)
     # Same methodology as the lenet/resnet/bert benches: compile excluded,
     # steady state timed. fit() #1 builds vocab + traces/compiles the block
@@ -500,6 +531,90 @@ def bench_word2vec(steps: int) -> dict:
         "data": "synthetic zipfian corpus (host RAM)",
         "final_loss": round(w2v.last_loss, 4),
     }
+
+
+def _first_step_child(config: str) -> None:
+    """ONE optimizer step end-to-end, meant to run in a FRESH process (the
+    parent times the whole process: interpreter + imports + model build +
+    trace + compile-or-cache-load + execute = time-to-first-step)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.common.environment import Environment
+    from deeplearning4j_tpu.data import DataSet
+
+    Environment.get()   # applies DL4J_TPU_COMPILE_CACHE (library path)
+    rng = np.random.RandomState(0)
+    if config == "lenet":
+        model = _lenet_model()                 # shared flagship builder
+        x = rng.randn(128, 1, 28, 28).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 128)]
+        model.fit(DataSet(x, y))
+        loss = float(model._score_dev)
+    elif config == "resnet50":
+        # batch 64 (the cold ledger's recorded shape); the throughput
+        # bench default is 128 — the MODEL is the shared builder either way
+        model = _resnet50_model(224)
+        x = rng.randn(64, 3, 224, 224).astype(np.float32)
+        y = np.eye(1000, dtype=np.float32)[rng.randint(0, 1000, 64)]
+        model.fit(DataSet(jnp.asarray(x), jnp.asarray(y)))
+        loss = float(model._score_dev)
+    elif config == "bert":
+        step, params, upd, ph, _ = _bert_training(batch=32, seq=128)
+        _, _, loss_dev = step(params, upd, ph, jax.random.PRNGKey(0),
+                              jnp.asarray(0))
+        loss = float(loss_dev)
+    elif config == "word2vec":
+        w2v = _w2v_model()
+        w2v.set_sentence_iterator(_zipf_sentences(400_000))
+        w2v.fit()
+        loss = w2v.last_loss
+    else:
+        raise SystemExit(f"unknown first-step config {config}")
+    assert np.isfinite(loss), f"non-finite first-step loss for {config}"
+    print(f"FIRST_STEP_OK {config} loss={loss:.4f}", flush=True)
+
+
+def cold_audit(configs=("lenet", "resnet50", "bert", "word2vec")) -> None:
+    """Time-to-first-step ledger (round-5 item 6; SURVEY §5.6, §7.3 item
+    8 compile-cost honesty): for each flagship, spawn a FRESH process
+    against an empty persistent compile cache (cold) and a second fresh
+    process against the now-populated cache (warm). Emits one JSON line
+    per config with both wall times."""
+    import subprocess
+    import sys
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    for config in configs:
+        with tempfile.TemporaryDirectory(prefix="d4t_coldaudit_") as cache:
+            times = []
+            for run in ("cold", "warm_cache"):
+                env = dict(os.environ)
+                env["DL4J_TPU_COMPILE_CACHE"] = cache
+                t0 = time.perf_counter()
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--first-step", config],
+                    env=env, cwd=here, capture_output=True, text=True)
+                dt = time.perf_counter() - t0
+                if proc.returncode != 0 or "FIRST_STEP_OK" not in proc.stdout:
+                    raise RuntimeError(
+                        f"first-step {config} ({run}) failed rc="
+                        f"{proc.returncode}:\n{proc.stdout}\n{proc.stderr}")
+                times.append(dt)
+            print(json.dumps({
+                "metric": f"time_to_first_step_{config}",
+                "value": round(times[1], 2), "unit": "seconds",
+                "vs_baseline": 1.0,
+                "cold_s": round(times[0], 2),
+                "warm_cache_s": round(times[1], 2),
+                "speedup": round(times[0] / max(times[1], 1e-9), 2),
+                "note": "fresh process each; cold = empty persistent "
+                        "compile cache, warm = same cache populated by the "
+                        "cold run; time includes interpreter+imports+build+"
+                        "trace+compile-or-load+one optimizer step",
+            }), flush=True)
 
 
 def _zipf_sentences(n_words: int, vocab_size: int = 10_000,
@@ -597,7 +712,7 @@ def bench_glove(n_words: int = 1_000_000) -> dict:
     }
 
 
-def bench_fasttext(n_words: int = 400_000) -> dict:
+def bench_fasttext(n_words: int = 1_000_000) -> dict:
     import jax
 
     from deeplearning4j_tpu.nlp import FastText
@@ -607,12 +722,16 @@ def bench_fasttext(n_words: int = 400_000) -> dict:
           .negative_sample(5).epochs(1).batch_size(8192).seed(42)
           .iterate(sents).build())
     ft.fit()
+    cold = ft.words_per_sec
+    ft.fit()
     return {
         "metric": "fasttext_train", "value": ft.words_per_sec,
         "unit": "words/sec", "platform": jax.devices()[0].platform,
         "vocab": len(ft.vocab), "corpus_words": n_words,
-        "data": "synthetic zipfian corpus (host RAM); subword host "
-                "pipeline (round-2-era stream path)",
+        "cold_words_per_sec": round(cold),
+        "data": "synthetic zipfian corpus (host RAM); round-5 "
+                "device-windowed subword path (subword windows gathered "
+                "on device)",
     }
 
 
@@ -626,6 +745,13 @@ def main() -> None:
         os.path.abspath(__file__)), ".jax_cache"))
 
     parser = argparse.ArgumentParser()
+    parser.add_argument("--first-step", default=None,
+                        help="internal: run ONE optimizer step of the named "
+                             "config and exit (spawned by --cold-audit)")
+    parser.add_argument("--cold-audit", nargs="?", const="all", default=None,
+                        help="time-to-first-step ledger: fresh process per "
+                             "flagship, cold vs populated compile cache; "
+                             "optionally a comma-separated config subset")
     parser.add_argument("--config", default="flagships",
                         choices=["flagships", "lenet", "resnet50", "bert",
                                  "word2vec", "word2vec-cbow", "word2vec-hs",
@@ -639,6 +765,19 @@ def main() -> None:
                              "run (validates the listener bus does not tax the "
                              "hot loop)")
     args = parser.parse_args()
+
+    if args.first_step:
+        # NOTE: no enable_compilation_cache here — the child honors the
+        # DL4J_TPU_COMPILE_CACHE env var through Environment.get() inside
+        # the library, which is exactly the path being audited
+        _first_step_child(args.first_step)
+        return
+    if args.cold_audit:
+        if args.cold_audit == "all":
+            cold_audit()
+        else:
+            cold_audit(tuple(args.cold_audit.split(",")))
+        return
 
     steps = args.steps or 30
 

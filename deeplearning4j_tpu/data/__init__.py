@@ -1,7 +1,9 @@
 from .dataset import DataSet, MultiDataSet
 from .iterators import (DataSetIterator, NDArrayDataSetIterator, ExistingDataSetIterator,
                         MultipleEpochsIterator, MnistDataSetIterator, IrisDataSetIterator,
-                        Cifar10DataSetIterator, EmnistDataSetIterator)
+                        Cifar10DataSetIterator, EmnistDataSetIterator,
+                        LFWDataSetIterator, TinyImageNetDataSetIterator,
+                        UciSequenceDataSetIterator)
 from .normalizers import (NormalizerStandardize, NormalizerMinMaxScaler,
                           ImagePreProcessingScaler, normalizer_from_json)
 from .records import (RecordReader, SequenceRecordReader, CSVRecordReader,

@@ -139,12 +139,90 @@ class FastText(SequenceVectors):
             self._subword_ids[i, :len(s)] = s
             self._subword_mask[i, :len(s)] = 1.0
 
+    def _make_window_block(self, hs_dev=None, ntable_dev=None):
+        """Device FastText block (round 5): overrides the skip-gram
+        windowed block builder so ``_train_windowed`` drives THIS block
+        through its unchanged corpus-resident loop. Pairs come from the
+        shared ``_pack_span`` dense packer; each pair trains the CBOW
+        kernel with the CENTER's subword rows as the context window
+        (device-resident [V, G] id/mask tables, gathered per round) and
+        the CONTEXT word as target — the same math as the host stream,
+        minus the per-pair host subword expansion that capped it at the
+        10–20k words/s class."""
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..ops import embeddings as E
+        from .word2vec import _pack_span, _pool_negs
+
+        if self.use_hs or hs_dev is not None:
+            raise ValueError("FastText trains with negative sampling only")
+        V, K, W = len(self.vocab), self.negative, self.window
+        B = self._round_pairs
+        R = self.MAX_BLOCK_ROUNDS
+        S = self._window_span
+        C = -(-(S * 2 * W) // B) * B
+        lab = jnp.zeros((B, 1 + K), jnp.float32).at[:, 0].set(1.0)
+        self._win_negpool = self._build_negpool(ntable_dev, B * K)
+        sub_ids = jnp.asarray(self._subword_ids)
+        sub_mask = jnp.asarray(self._subword_mask)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def block(syn0, syn1, ids, sent, n_valid, negpool, p0, lr01, key,
+                  blk_id):
+            key = jax.random.fold_in(key, blk_id)
+            packed_c, packed_x, count = _pack_span(
+                ids, sent, n_valid, p0, S, W, C, key)
+            lr0, lr1 = lr01
+            countf = jnp.maximum(count.astype(jnp.float32), 1.0)
+
+            def cond(st):
+                return st[0] * B < count
+
+            def body(st):
+                r, s0, s1, lsum, wsum = st
+                c = lax.dynamic_slice(packed_c, (r * B,), (B,))
+                x = lax.dynamic_slice(packed_x, (r * B,), (B,))
+                pm = ((lax.broadcasted_iota(jnp.int32, (B,), 0) + r * B)
+                      < count).astype(jnp.float32)
+                lr = lr0 + (lr1 - lr0) * (r * B).astype(jnp.float32) / countf
+                negs = _pool_negs(negpool, blk_id, r, B, K, V, x)
+                tgt = jnp.concatenate([x[:, None], negs], axis=1)
+                s0, s1, loss = E.cbow(s0, s1, sub_ids[c], sub_mask[c],
+                                      tgt, lab, lr, pm, dense=False)
+                return (r + 1, s0, s1, lsum + loss * pm.sum(),
+                        wsum + pm.sum())
+
+            init = (jnp.int32(0), syn0, syn1, jnp.float32(0.0),
+                    jnp.float32(0.0))
+            _, syn0, syn1, lsum, wsum = lax.while_loop(cond, body, init)
+            return (syn0, syn1, lsum / jnp.maximum(wsum, 1.0), wsum)
+
+        return block
+
     def fit(self) -> None:
         if len(self.vocab) == 0 or self.lookup_table.syn0 is None:
             self.build_vocab(self._token_stream())
             if len(self.vocab) == 0:
                 raise ValueError("empty vocabulary after pruning")
         corpus = self._encode_corpus(self._token_stream())
+
+        if getattr(self, "device_corpus", True) and not self.use_hs \
+                and self.mesh is None:
+            # device-windowed path: _train_windowed's skip-gram branch
+            # drives the overridden _make_window_block above. algorithm is
+            # temporarily "skipgram" so the loop picks the PAIR machinery
+            # (sizing + branch); the constructor default stays "cbow" for
+            # the host fallback's stream format.
+            old = self.algorithm
+            self.algorithm = "skipgram"
+            try:
+                return self._train_windowed(corpus)
+            finally:
+                self.algorithm = old
 
         def stream(rng, keep):
             # skip-gram pairs; the cbow-round "window" is the CENTER's

@@ -517,25 +517,9 @@ class SequenceVectors(WordVectors):
             self._win_negpool = self._build_negpool(ntable_dev, B * K)
 
         def pack(ids, sent, n_valid, p0, kb):
-            """Derive + compact this span's pairs → ([C] centers, [C]
-            contexts, count). Window derivation is the shared
-            ``_derive_windows`` (shifted slices — the round-3
-            element-granular ids[q] gathers were the single most expensive
-            fusion in the device trace). Compaction is an order-preserving
-            cumsum→scatter, so pairs train in corpus order exactly as
-            before."""
-            c_ids, x_ids, valid, _ = _derive_windows(
-                ids, sent, n_valid, p0, S, W, kb)
-            vf = valid.reshape(-1)
-            dest = jnp.cumsum(vf.astype(jnp.int32)) - 1
-            count = jnp.minimum(dest[-1] + 1, C)
-            slot = jnp.where(vf, dest, C)               # C = dropped
-            packed_c = jnp.zeros((C,), jnp.int32).at[slot].set(
-                jnp.broadcast_to(c_ids[:, None], (S, 2 * W)).reshape(-1),
-                mode="drop")
-            packed_x = jnp.zeros((C,), jnp.int32).at[slot].set(
-                x_ids.reshape(-1), mode="drop")
-            return packed_c, packed_x, count
+            """Shared ``_pack_span`` (see its docstring): derive + compact
+            this span's pairs → ([C] centers, [C] contexts, count)."""
+            return _pack_span(ids, sent, n_valid, p0, S, W, C, kb)
 
         shard_axis = (self.table_sharding_axis if self.mesh is not None
                       else None)
@@ -891,16 +875,17 @@ class SequenceVectors(WordVectors):
         self.words_per_sec = words_seen / max(dt, 1e-9)
         self.pairs_per_sec = pairs_seen / max(dt, 1e-9)
         self.last_loss = float(last.mean()) if losses else 0.0
-        # [:V] strips the shard-padding rows of a mesh-sharded fit (no-op
-        # for the single-table path, whose row count is exactly V)
-        V = len(self.vocab)
-        self.lookup_table.syn0 = np.asarray(syn0.astype(jnp.float32))[:V]
+        # strip to the TABLE's row count: drops the shard-padding rows of
+        # a mesh-sharded fit, but keeps FastText's n-gram bucket rows
+        # (lookup_table.vocab_size = V + bucket there)
+        n_rows = self.lookup_table.vocab_size or len(self.vocab)
+        self.lookup_table.syn0 = np.asarray(syn0.astype(jnp.float32))[:n_rows]
         if self.use_hs:
             self.lookup_table.syn1 = np.asarray(
-                syn1.astype(jnp.float32))[:V]
+                syn1.astype(jnp.float32))[:n_rows]
         else:
             self.lookup_table.syn1neg = np.asarray(
-                syn1.astype(jnp.float32))[:V]
+                syn1.astype(jnp.float32))[:n_rows]
 
     def _train_encoded(self, corpus: List[np.ndarray],
                        stream_factory: Optional[Callable] = None,
@@ -1179,6 +1164,30 @@ def _derive_windows(ids, sent, n_valid, p0, S, W, key):
         v_cols.append((b >= abs(o)) & live
                       & (sw[W + o:W + o + S] == c_sent))
     return (c_ids, jnp.stack(ctx_cols, 1), jnp.stack(v_cols, 1), live)
+
+
+def _pack_span(ids, sent, n_valid, p0, S, W, C, key):
+    """Derive + densely compact a span's skip-gram pairs → ([C] centers,
+    [C] contexts, count). Window derivation is the shared
+    ``_derive_windows`` (shifted slices — the round-3 element-granular
+    ids[q] gathers were the single most expensive fusion in the device
+    trace). Compaction is an order-preserving cumsum→scatter, so pairs
+    train in corpus order. Shared by the skip-gram windowed block and
+    FastText's subword block."""
+    import jax.numpy as jnp
+
+    c_ids, x_ids, valid, _ = _derive_windows(ids, sent, n_valid, p0, S, W,
+                                             key)
+    vf = valid.reshape(-1)
+    dest = jnp.cumsum(vf.astype(jnp.int32)) - 1
+    count = jnp.minimum(dest[-1] + 1, C)
+    slot = jnp.where(vf, dest, C)               # C = dropped
+    packed_c = jnp.zeros((C,), jnp.int32).at[slot].set(
+        jnp.broadcast_to(c_ids[:, None], (S, 2 * W)).reshape(-1),
+        mode="drop")
+    packed_x = jnp.zeros((C,), jnp.int32).at[slot].set(
+        x_ids.reshape(-1), mode="drop")
+    return packed_c, packed_x, count
 
 
 def _pool_negs(negpool, blk_id, r, B, K, V, positives):

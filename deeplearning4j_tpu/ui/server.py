@@ -41,6 +41,7 @@ _PAGE = """<!DOCTYPE html>
 </style></head><body>
 <h1>deeplearning4j-tpu training UI</h1>
 <div id="charts"></div>
+<div id="sdgraph"></div>
 <script>
 function esc(s){const d=document.createElement('div');d.textContent=s;return d.innerHTML;}
 async function refresh(){
@@ -74,7 +75,39 @@ async function refresh(){
   }
 }
 refresh(); setInterval(refresh, 2000);
-</script></body></html>
+async function drawGraph(){
+  const g = await (await fetch('/api/graph')).json();
+  if (!g || !g.ops || !g.ops.length) return;
+  const root = document.getElementById('sdgraph');
+  const byDepth = {};
+  for (const op of g.ops){
+    (byDepth[op.depth] = byDepth[op.depth] || []).push(op);
+  }
+  let html = '<h1>SameDiff graph ('+g.n_ops+' ops, '+g.n_vars+
+             ' vars)</h1><div class="glayers">';
+  for (const d of Object.keys(byDepth).sort((a,b)=>a-b)){
+    html += '<div class="glayer"><span class="gdepth">'+d+'</span>';
+    for (const op of byDepth[d]){
+      // escAttr: esc() covers text context only — attribute values also
+      // need double quotes neutralized
+      const t = esc(op.inputs.join(', ')).replace(/"/g,'&quot;');
+      html += '<span class="gnode" title="in: '+t+
+              '">'+esc(op.op)+' <i>'+esc(op.name)+'</i></span>';
+    }
+    html += '</div>';
+  }
+  root.innerHTML = html + '</div>';
+}
+drawGraph();
+</script>
+<style>
+ .glayer{margin:3px 0}
+ .gdepth{display:inline-block;width:26px;color:#999}
+ .gnode{display:inline-block;background:#fff;border:1px solid #ccd;
+        border-radius:4px;padding:2px 7px;margin:1px 3px;font-size:12px}
+ .gnode i{color:#888;font-style:normal;font-size:10px}
+</style>
+</body></html>
 """
 
 
@@ -121,9 +154,38 @@ class UIServer:
                 "`tensorboard --logdir`, not this server)")
         return self
 
+    def attach_graph(self, source) -> "UIServer":
+        """Attach a SameDiff graph for the dashboard's SameDiff section
+        (reference: LogFileWriter's uigraphstatic log rendered by the UI's
+        SameDiff tab). ``source`` is a SameDiff instance, a structure dict
+        from ``graph_structure()``, or a ``LogFileWriter`` log path
+        (re-read per request — live like the JSONL stats)."""
+        from .graph_log import graph_structure
+
+        if isinstance(source, str):
+            self._graph_path = source
+            self._graph = None
+        elif isinstance(source, dict):
+            self._graph = source
+            self._graph_path = None
+        else:
+            self._graph = graph_structure(source)
+            self._graph_path = None
+        return self
+
+    def _graph_payload(self):
+        path = getattr(self, "_graph_path", None)
+        if path is not None:
+            from .graph_log import read_graph_log
+
+            return read_graph_log(path)["graph"] or {}
+        return getattr(self, "_graph", None) or {}
+
     def detach_all(self) -> None:
         self._stores = [self._remote]
         self._paths = []
+        self._graph = None
+        self._graph_path = None
 
     # -- data ------------------------------------------------------------
     def _records(self) -> List[Dict[str, Any]]:
@@ -203,6 +265,9 @@ class UIServer:
                                "application/json")
                 elif u.path == "/api/sessions":
                     self._send(json.dumps(ui.sessions()).encode(),
+                               "application/json")
+                elif u.path == "/api/graph":
+                    self._send(json.dumps(ui._graph_payload()).encode(),
                                "application/json")
                 elif u.path == "/api/series":
                     q = parse_qs(u.query)

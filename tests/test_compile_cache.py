@@ -93,3 +93,61 @@ class TestCompileCache:
                                  capture_output=True, text=True, env=env,
                                  cwd=_REPO, timeout=300)
             assert out.returncode == 0, out.stderr[-2000:]
+
+
+_MLN_FIT_SCRIPT = r"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {repo!r})
+from deeplearning4j_tpu.common.environment import Environment
+Environment.get().set_compile_cache({cache!r}, min_compile_secs=0.0)
+
+import numpy as np
+from deeplearning4j_tpu.data import DataSet
+from deeplearning4j_tpu.learning import Nesterovs
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf import layers as L
+
+conf = (NeuralNetConfiguration.builder().seed(123)
+        .updater(Nesterovs(learning_rate=0.01, momentum=0.9))
+        .activation("relu").weight_init("xavier").list()
+        .layer(L.ConvolutionLayer(n_out=8, kernel_size=(5, 5)))
+        .layer(L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        .layer(L.DenseLayer(n_out=32))
+        .layer(L.OutputLayer(n_out=10, loss="mcxent", activation="softmax"))
+        .set_input_type(InputType.convolutional(28, 28, 1)).build())
+model = MultiLayerNetwork(conf).init()
+rng = np.random.RandomState(0)
+x = rng.randn(32, 1, 28, 28).astype(np.float32)
+y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 32)]
+model.fit(DataSet(x, y))
+print("FIT_SECONDS", 0.0)
+assert np.isfinite(float(model._score_dev))
+"""
+
+
+def _run_mln_fit(cache_dir: str) -> None:
+    env = dict(os.environ)
+    env.pop("PYTEST_CURRENT_TEST", None)
+    out = subprocess.run(
+        [sys.executable, "-c",
+         _MLN_FIT_SCRIPT.format(repo=_REPO, cache=cache_dir)],
+        capture_output=True, text=True, env=env, cwd=_REPO, timeout=600)
+    assert out.returncode == 0, out.stderr[-3000:]
+
+
+class TestMLNColdStart:
+    """Round-5 item 6: the cache path must serve the MultiLayerNetwork
+    train step too (the bench --cold-audit flagship path), asserted
+    structurally like TestCompileCache."""
+
+    def test_mln_second_process_hits_cache(self):
+        with tempfile.TemporaryDirectory() as cache:
+            _run_mln_fit(cache)
+            entries = _cache_entries(cache)
+            assert entries, "first MLN process wrote no cache entries"
+            _run_mln_fit(cache)
+            assert _cache_entries(cache) == entries, \
+                "second MLN process recompiled instead of loading the " \
+                "persisted executables"

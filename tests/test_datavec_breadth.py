@@ -220,3 +220,144 @@ class TestFetchers:
         preds = model.output(feats).to_numpy()
         acc = (preds.argmax(1) == labels.argmax(1)).mean()
         assert acc > 0.5, acc
+
+
+class TestRound5DatasetTail:
+    """LFW / TinyImageNet / UCI-sequence iterators (VERDICT r4 missing
+    #4; SURVEY §2.3 datasets row), synthetic-fallback pattern."""
+
+    def test_lfw_shapes_and_determinism(self):
+        from deeplearning4j_tpu.data import LFWDataSetIterator
+
+        it = LFWDataSetIterator(batch_size=16, num_examples=64,
+                                image_hw=32, n_classes=8)
+        assert it.synthetic
+        ds = next(iter(it))
+        assert tuple(ds.features.shape) == (16, 3, 32, 32)
+        assert tuple(ds.labels.shape) == (16, 8)
+        it2 = LFWDataSetIterator(batch_size=16, num_examples=64,
+                                 image_hw=32, n_classes=8)
+        np.testing.assert_array_equal(ds.features.to_numpy(),
+                                      next(iter(it2)).features.to_numpy())
+
+    def test_lfw_reads_local_tree(self, tmp_path, monkeypatch):
+        PIL = pytest.importorskip("PIL")
+        from PIL import Image
+
+        import deeplearning4j_tpu.data.iterators as it_mod
+
+        monkeypatch.setattr(it_mod, "_DATA_DIR", str(tmp_path))
+        rng = np.random.RandomState(0)
+        for person in ("alice", "bob"):
+            d = tmp_path / "lfw" / person
+            d.mkdir(parents=True)
+            for i in range(3):
+                arr = rng.randint(0, 255, (40, 40, 3), dtype=np.uint8)
+                Image.fromarray(arr).save(d / f"{i}.jpg")
+        from deeplearning4j_tpu.data import LFWDataSetIterator
+
+        it = LFWDataSetIterator(batch_size=6, image_hw=32)
+        assert not it.synthetic
+        # stratified 75/25: round(3*0.75)=2 of each person's 3 images
+        assert it.total_examples() == 4
+        assert it.num_classes() == 2
+        assert it._names == ["alice", "bob"]
+        test_it = LFWDataSetIterator(batch_size=6, image_hw=32,
+                                     train=False)
+        assert test_it.total_examples() == 2
+        # train/test are DISJOINT (round-5 review finding: the real-tree
+        # branch used to ignore the train flag)
+        tr = {f.tobytes() for f in it.features}
+        te = {f.tobytes() for f in test_it.features}
+        assert not (tr & te)
+
+    def test_tiny_imagenet_synthetic(self):
+        from deeplearning4j_tpu.data import TinyImageNetDataSetIterator
+
+        it = TinyImageNetDataSetIterator(batch_size=32, num_examples=400)
+        assert it.synthetic
+        ds = next(iter(it))
+        assert tuple(ds.features.shape) == (32, 3, 64, 64)
+        assert it.num_classes() == 200
+
+    def test_uci_sequence_classifiable(self):
+        """The six synthetic-control patterns must be learnable by an
+        LSTM classifier (proves the generator is faithful, not noise)."""
+        from deeplearning4j_tpu.data import UciSequenceDataSetIterator
+        from deeplearning4j_tpu.learning import Adam
+        from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf import layers as L
+
+        train = UciSequenceDataSetIterator(batch_size=64, train=True)
+        test = UciSequenceDataSetIterator(batch_size=64, train=False)
+        assert train.synthetic
+        assert train.features.shape[1:] == (60, 1)
+        assert train.total_examples() == 450
+        assert test.total_examples() == 150
+
+        # normalize features (the raw series sit around 30 +/- trends)
+        mu = train.features.mean()
+        sd = train.features.std()
+        for it in (train, test):
+            it.features = (it.features - mu) / sd
+
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Adam(1e-2)).list()
+                .layer(L.LSTM(n_out=24))
+                .layer(L.GlobalPoolingLayer(pooling_type="avg"))
+                .layer(L.OutputLayer(n_out=6, activation="softmax",
+                                     loss="mcxent"))
+                .set_input_type(InputType.recurrent(1, 60)).build())
+        net = MultiLayerNetwork(conf).init()
+        for _ in range(30):
+            for ds in train:
+                net.fit(ds)
+        correct = total = 0
+        for ds in test:
+            pred = np.argmax(net.output(ds.features).to_numpy(), axis=1)
+            truth = np.argmax(ds.labels.to_numpy(), axis=1)
+            correct += int((pred == truth).sum())
+            total += len(truth)
+        acc = correct / total
+        assert acc > 0.7, f"UCI sequence accuracy {acc:.2f}"
+
+    def test_tiny_imagenet_real_val_layout(self, tmp_path, monkeypatch):
+        """The real tiny-imagenet-200 val split is FLAT (val/images +
+        val_annotations.txt), not per-class dirs (round-5 review
+        finding)."""
+        PIL = pytest.importorskip("PIL")
+        from PIL import Image
+
+        import deeplearning4j_tpu.data.iterators as it_mod
+
+        monkeypatch.setattr(it_mod, "_DATA_DIR", str(tmp_path))
+        base = tmp_path / "tiny-imagenet-200"
+        rng = np.random.RandomState(0)
+        wnids = ["n001", "n002"]
+        for w in wnids:
+            d = base / "train" / w / "images"
+            d.mkdir(parents=True)
+            for i in range(2):
+                Image.fromarray(rng.randint(0, 255, (64, 64, 3),
+                                            dtype=np.uint8)).save(
+                    d / f"{w}_{i}.JPEG")
+        vd = base / "val" / "images"
+        vd.mkdir(parents=True)
+        lines = []
+        for i, w in enumerate(("n002", "n001", "n002")):
+            fn = f"val_{i}.JPEG"
+            Image.fromarray(rng.randint(0, 255, (64, 64, 3),
+                                        dtype=np.uint8)).save(vd / fn)
+            lines.append(f"{fn}\t{w}\t0\t0\t10\t10")
+        (base / "val" / "val_annotations.txt").write_text(
+            "\n".join(lines))
+        from deeplearning4j_tpu.data import TinyImageNetDataSetIterator
+
+        it = TinyImageNetDataSetIterator(batch_size=4, train=False)
+        assert not it.synthetic
+        assert it.total_examples() == 3
+        labels = np.argmax(it.labels, axis=1).tolist()
+        assert labels == [1, 0, 1]      # n001=0, n002=1 (sorted wnids)
+        tr = TinyImageNetDataSetIterator(batch_size=4, train=True)
+        assert not tr.synthetic and tr.num_classes() == 2

@@ -216,3 +216,57 @@ class TestFastTextWireWidth:
         ft.fit()
         v = ft.get_word_vector("z")       # OOV single char
         assert v.shape == (8,) and np.isfinite(v).all()
+
+
+class TestFastTextDevicePath:
+    """Round-5: FastText rides the device-windowed corpus (the last
+    host-bound NLP family member). Host fallback must stay equivalent."""
+
+    def _fit(self, device):
+        from deeplearning4j_tpu.nlp import FastText
+
+        rng = np.random.default_rng(4)
+        pools = {0: [f"app{i}le" for i in range(8)],
+                 1: [f"zur{i}ich" for i in range(8)]}
+        sents = []
+        for _ in range(240):
+            c = int(rng.integers(0, 2))
+            sents.append(" ".join(rng.choice(pools[c], size=10)))
+        ft = (FastText.builder().min_word_frequency(1).layer_size(24)
+              .negative_sample(5).epochs(8).batch_size(256).seed(3)
+              .bucket(2000).iterate(sents).build())
+        ft.device_corpus = device
+        ft.fit()
+        return ft
+
+    def test_device_fit_learns_cluster_structure(self):
+        import numpy as np
+
+        ft = self._fit(True)
+        mat = ft.get_word_vector_matrix()
+        mat = mat / np.maximum(
+            np.linalg.norm(mat, axis=1, keepdims=True), 1e-12)
+        words = list(ft.vocab.words())
+        a = [i for i, w in enumerate(words) if w.startswith("app")]
+        z = [i for i, w in enumerate(words) if w.startswith("zur")]
+        within = np.mean([mat[i] @ mat[j] for i in a for j in a if i != j])
+        across = np.mean([mat[i] @ mat[j] for i in a for j in z])
+        assert within > across + 0.2, (within, across)
+
+    def test_bucket_rows_survive_device_fit(self):
+        ft = self._fit(True)
+        V = len(ft.vocab)
+        assert ft.lookup_table.syn0.shape[0] == V + 2000
+        # n-gram rows must have TRAINED (nonzero) — the strip-to-V bug
+        # class this pins
+        import numpy as np
+
+        ngram_norms = np.linalg.norm(ft.lookup_table.syn0[V:], axis=1)
+        assert (ngram_norms > 0).sum() > 10
+
+    def test_oov_vector_still_works_after_device_fit(self):
+        ft = self._fit(True)
+        v = ft.get_word_vector("app9le")   # OOV, shares subwords
+        import numpy as np
+
+        assert np.isfinite(v).all() and np.linalg.norm(v) > 0
