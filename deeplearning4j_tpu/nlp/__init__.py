@@ -19,7 +19,8 @@ from .glove import Glove
 from .graph_vectors import DeepWalk, Graph, Node2Vec, random_walks
 from .lookup_table import InMemoryLookupTable
 from .paragraph_vectors import ParagraphVectors
-from .serializer import (read_word2vec_model, read_word_vectors,
+from .serializer import (read_paragraph_vectors, read_word2vec_model,
+                         read_word_vectors, write_paragraph_vectors,
                          write_word2vec_model, write_word_vectors)
 from .text import (CollectionSentenceIterator, CommonPreprocessor,
                    DefaultTokenizerFactory, FileSentenceIterator,
@@ -41,4 +42,5 @@ __all__ = [
     "Word2Vec", "WordVectors", "build_huffman", "huffman_arrays",
     "read_word2vec_model", "read_word_vectors", "subsample_keep_probs",
     "unigram_table", "write_word2vec_model", "write_word_vectors",
+    "write_paragraph_vectors", "read_paragraph_vectors",
 ]

@@ -158,3 +158,80 @@ def read_word2vec_model(path: PathLike) -> Word2Vec:
         table.syn1neg = npz["syn1neg"] if "syn1neg" in npz else None
         model.lookup_table = table
         return model
+
+
+def write_paragraph_vectors(model, path: PathLike) -> None:
+    """ParagraphVectors zip container (reference
+    ``WordVectorSerializer.writeParagraphVectors``): the word2vec payload
+    plus the PV config (dm, train_word_vectors) and the doc-label list,
+    so ``read_paragraph_vectors`` restores label lookups, nearest_labels,
+    and infer_vector against the frozen tables."""
+    config = {
+        "format_version": _FORMAT_VERSION,
+        "layer_size": model.layer_size,
+        "window": model.window,
+        "learning_rate": model.learning_rate,
+        "min_learning_rate": model.min_learning_rate,
+        "negative": model.negative,
+        "use_hierarchic_softmax": model.use_hs,
+        "sampling": model.sampling,
+        "min_word_frequency": model.min_word_frequency,
+        "iterations": model.iterations,
+        "epochs": model.epochs,
+        "batch_size": model.batch_size,
+        "seed": model.seed,
+        "dm": model.dm,
+        "train_word_vectors": model.train_word_vectors,
+    }
+    vocab_rows = [{"word": model.vocab.entry_at(i).word,
+                   "count": model.vocab.entry_at(i).count}
+                  for i in range(len(model.vocab))]
+    labels = [model.vocab.word_for(i) for i in model._label_ids]
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("config.json", json.dumps(config))
+        z.writestr("vocab.json", json.dumps(vocab_rows))
+        z.writestr("labels.json", json.dumps(labels))
+        arrays = {"syn0": np.asarray(model.lookup_table.syn0)}
+        if model.lookup_table.syn1 is not None:
+            arrays["syn1"] = np.asarray(model.lookup_table.syn1)
+        if model.lookup_table.syn1neg is not None:
+            arrays["syn1neg"] = np.asarray(model.lookup_table.syn1neg)
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        z.writestr("tables.npz", buf.getvalue())
+
+
+def read_paragraph_vectors(path: PathLike):
+    from .paragraph_vectors import ParagraphVectors
+
+    with zipfile.ZipFile(path, "r") as z:
+        config = json.loads(z.read("config.json"))
+        version = config.pop("format_version", None)
+        if version != _FORMAT_VERSION:
+            raise ValueError(
+                f"unsupported paragraph-vectors format version {version!r} "
+                f"(supported: {_FORMAT_VERSION})")
+        vocab_rows = json.loads(z.read("vocab.json"))
+        labels = json.loads(z.read("labels.json"))
+        npz = np.load(io.BytesIO(z.read("tables.npz")))
+        model = ParagraphVectors(**config)
+        vocab = VocabCache()
+        for row in vocab_rows:
+            vocab.add(VocabWord(row["word"], row["count"]))
+        model.vocab = vocab
+        if model.use_hs:
+            build_huffman(model.vocab)
+        table = InMemoryLookupTable(len(vocab), config["layer_size"],
+                                    seed=config["seed"])
+        table.syn0 = npz["syn0"]
+        table.syn1 = npz["syn1"] if "syn1" in npz else None
+        table.syn1neg = npz["syn1neg"] if "syn1neg" in npz else None
+        model.lookup_table = table
+        model._label_ids = [vocab.index_of(l) for l in labels]
+        model._special_tokens = labels
+        return model
+
+
+# reference spellings
+writeParagraphVectors = write_paragraph_vectors
+readParagraphVectors = read_paragraph_vectors

@@ -611,3 +611,34 @@ class TestParagraphVectorsDevicePath:
         same = _mean_sim(pv, [("DOC_0", f"DOC_{i}") for i in (2, 4, 6, 8)])
         diff = _mean_sim(pv, [("DOC_0", f"DOC_{i}") for i in (1, 3, 5, 7)])
         assert same > diff + 0.15, (same, diff)
+
+
+class TestParagraphVectorsSerde:
+    """writeParagraphVectors/readParagraphVectors round-trip (reference
+    WordVectorSerializer PV container)."""
+
+    def test_roundtrip_preserves_labels_and_inference(self, tmp_path):
+        from deeplearning4j_tpu.nlp import (read_paragraph_vectors,
+                                            write_paragraph_vectors)
+
+        docs, labels = _cluster_docs()
+        pv = (ParagraphVectors.builder().min_word_frequency(1).layer_size(16)
+              .epochs(5).negative_sample(5).batch_size(256).seed(3)
+              .iterate(LabelAwareIterator(docs, labels)).build())
+        pv.fit()
+        path = str(tmp_path / "pv.zip")
+        write_paragraph_vectors(pv, path)
+        pv2 = read_paragraph_vectors(path)
+        assert pv2.dm == pv.dm
+        np.testing.assert_array_equal(pv2.lookup_table.syn0,
+                                      pv.lookup_table.syn0)
+        np.testing.assert_array_equal(
+            pv2.get_paragraph_vector("DOC_0"),
+            pv.get_paragraph_vector("DOC_0"))
+        assert pv2.nearest_labels("DOC_0", 3) == pv.nearest_labels(
+            "DOC_0", 3)
+        rng = np.random.default_rng(1)
+        text = " ".join(f"a{i}" for i in rng.integers(0, 50, size=20))
+        np.testing.assert_allclose(pv2.infer_vector(text, steps=5),
+                                   pv.infer_vector(text, steps=5),
+                                   atol=1e-6)
