@@ -488,6 +488,11 @@ _FOLDERS: Dict[str, Callable] = {
     "Fill": lambda ctx, s: np.full(np.asarray(s[0]).tolist(), s[1]),
     "ZerosLike": lambda ctx, s: np.zeros_like(s[0]),
     "OnesLike": lambda ctx, s: np.ones_like(s[0]),
+    # single-arg Where has a data-dependent output shape, which XLA can't
+    # trace — but a STATIC condition (mask known at freeze, e.g. BERT's
+    # fixed position masks) folds to a constant coordinate list here
+    "Where": lambda ctx, s: (np.argwhere(s[0]).astype(np.int64)
+                             if len(s) == 1 else None),
 }
 
 
@@ -837,8 +842,12 @@ def _cumsum(ctx):
 @tf_op("Where")
 def _where(ctx):
     if ctx.n_in() == 1:
+        # static conditions fold in _FOLDERS before reaching here; a
+        # PLACEHOLDER-dependent condition has a data-dependent output
+        # shape XLA cannot trace
         raise UnsupportedTFOpError(
-            "Where(cond) single-arg", ctx.name)  # dynamic output shape
+            "Where(cond) single-arg with non-static condition "
+            "(data-dependent output shape)", ctx.name)
     return ctx.emit("where", [ctx.var(0), ctx.var(1), ctx.var(2)])
 
 

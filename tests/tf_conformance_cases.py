@@ -84,12 +84,10 @@ def case(target: str, variant: str, fn: Callable, inputs: Sequence,
 # Ops mapped but not targetable by a numeric golden case — every entry
 # needs a written reason AND (where applicable) a refusal test in
 # test_tf_conformance.py.
-SKIP_LEDGER: Dict[str, str] = {
-    "Where": "single-arg Where has a data-dependent output shape; the "
-             "mapper REFUSES it with an actionable error (asserted in "
-             "TestRefusals). The 3-arg select form is covered by the "
-             "Select/SelectV2 cases.",
-}
+SKIP_LEDGER: Dict[str, str] = {}
+# (Where left the ledger in round 5: static conditions fold to constant
+# coordinate lists — cases below; non-static single-arg Where still
+# refuses with an actionable error, asserted in TestRefusals.)
 
 # Reference TFGraphMapper / ImportClassMapping op families deliberately NOT
 # mapped here (tf_graph_mapper.py module docstring states the scope). The
@@ -677,3 +675,15 @@ case("MatrixDiagV2", "raw",
 case("MatrixDiagPartV2", "raw",
      lambda a: tf.raw_ops.MatrixDiagPartV2(input=a, k=0, padding_value=0.0),
      [F(4, 6)])
+
+
+# single-arg Where with a STATIC condition folds at import (round 5);
+# the coordinate list rides the graph as a constant
+_wmask = np.array([True, False, True, True, False, True, False], bool)
+case("Where", "static_cond_1d",
+     lambda a: a + tf.cast(tf.reduce_sum(tf.where(tf.constant(_wmask))),
+                           tf.float32), [F(3, 4)])
+_wmask2 = Bl(3, 4)
+case("Where", "static_cond_2d",
+     lambda a: a + tf.cast(tf.shape(tf.where(tf.constant(_wmask2)))[0],
+                           tf.float32), [F(2, 3)])
