@@ -143,6 +143,19 @@ def unregister_lambda(name: str) -> None:
     _LAMBDA_FNS.pop(name, None)
 
 
+def resolve_lambda(name: str) -> Callable:
+    """Registered-lambda lookup shared by the Keras importer and the conf
+    serde; raises with the registration recipe when absent."""
+    fn = _LAMBDA_FNS.get(name)
+    if fn is None:
+        raise ValueError(
+            f"Lambda {name!r}: lambda bodies are not portable/serializable "
+            f"— register the implementation first with "
+            f"deeplearning4j_tpu.imports.keras_import.register_lambda"
+            f"({name!r}, fn)")
+    return fn
+
+
 class KerasModelImport:
     """Reference-shaped entry points."""
 
@@ -865,12 +878,10 @@ class _SequentialBuilder:
 
     def _map_Lambda(self, c, ws):
         name = c.get("name", "lambda")
-        fn = _LAMBDA_FNS.get(name)
-        if fn is None:
-            raise UnsupportedKerasLayerError(
-                "Lambda",
-                f"{name}: lambda bodies are not portable — register the "
-                f"implementation first with register_lambda({name!r}, fn)")
+        try:
+            fn = resolve_lambda(name)
+        except ValueError as e:
+            raise UnsupportedKerasLayerError("Lambda", str(e)) from None
         self._push(L.LambdaLayer(fn=fn, name=name), None)
 
     def _map_TimeDistributed(self, c, ws):

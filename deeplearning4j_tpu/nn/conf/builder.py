@@ -308,8 +308,24 @@ def _ser_obj(obj: Any) -> Any:
         return {"__class__": type(obj).__name__,
                 "fields": {k: _ser_obj(v) for k, v in obj.__dict__.items()}}
     if dataclasses.is_dataclass(obj):
-        fields = {f.name: _ser_obj(getattr(obj, f.name))
-                  for f in dataclasses.fields(obj)}
+        fields = {}
+        lambda_cls = _CLASSES.get("LambdaLayer")
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            if (lambda_cls is not None and isinstance(obj, lambda_cls)
+                    and f.name == "fn" and callable(v)):
+                # LambdaLayer ONLY: function bodies are not serializable —
+                # the reference pattern serializes the NAME and restores
+                # through the registered-lambda lookup (register_lambda).
+                # Other fn-bearing objects still fail loudly below.
+                if not getattr(obj, "name", ""):
+                    raise TypeError(
+                        "cannot serialize an unnamed LambdaLayer — give it "
+                        "a unique name=... so restore can look up the "
+                        "registered implementation")
+                fields[f.name] = {"__lambda__": obj.name}
+            else:
+                fields[f.name] = _ser_obj(v)
         return {"__class__": type(obj).__name__, "fields": fields}
     if isinstance(obj, GradientUpdater):
         return {"__class__": type(obj).__name__,
@@ -327,6 +343,10 @@ def _deser_obj(d: Any) -> Any:
             return tuple(_deser_obj(v) for v in d["__tuple__"])
         if "__ndarray__" in d:
             return np.asarray(d["__ndarray__"], dtype=d["dtype"])
+        if "__lambda__" in d:
+            from ...imports.keras_import import resolve_lambda
+
+            return resolve_lambda(d["__lambda__"])
         if "__class__" in d:
             cls = _CLASSES[d["__class__"]]
             fields = {k: _deser_obj(v) for k, v in d["fields"].items()}

@@ -67,6 +67,64 @@ def resize_bicubic(x, height: int, width: int):
                             method="cubic", antialias=False)
 
 
+@op("resize_lanczos3", "image")
+def resize_lanczos3(x, height: int, width: int, antialias: bool = True):
+    """Lanczos-windowed sinc (a=3) resize — the reference images/ dir's
+    ``resize_images`` LANCZOS3 method; x: [N, H, W, C]."""
+    n, _, _, c = x.shape
+    dtype = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
+    return jax.image.resize(x.astype(dtype), (n, height, width, c),
+                            method="lanczos3", antialias=antialias)
+
+
+@op("resize_lanczos5", "image")
+def resize_lanczos5(x, height: int, width: int, antialias: bool = True):
+    n, _, _, c = x.shape
+    dtype = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
+    return jax.image.resize(x.astype(dtype), (n, height, width, c),
+                            method="lanczos5", antialias=antialias)
+
+
+@op("resize_mitchellcubic", "image")
+def resize_mitchellcubic(x, height: int, width: int):
+    """Mitchell–Netravali cubic (B=C=1/3) — composed from the separable
+    kernel the same way jax.image builds its cubic (Keys) resizer, since
+    jax.image exposes only the a=-0.5 cubic."""
+    import numpy as np
+
+    def mitchell(t):
+        t = np.abs(t)
+        B = C = 1.0 / 3.0
+        return np.where(
+            t < 1,
+            ((12 - 9 * B - 6 * C) * t ** 3 + (-18 + 12 * B + 6 * C) * t ** 2
+             + (6 - 2 * B)) / 6.0,
+            np.where(
+                t < 2,
+                ((-B - 6 * C) * t ** 3 + (6 * B + 30 * C) * t ** 2
+                 + (-12 * B - 48 * C) * t + (8 * B + 24 * C)) / 6.0,
+                0.0))
+
+    def axis_weights(out_size, in_size):
+        scale = in_size / out_size
+        centers = (np.arange(out_size) + 0.5) * scale - 0.5
+        idx = np.arange(in_size)
+        w = mitchell(centers[:, None] - idx[None, :]) \
+            if scale <= 1 else mitchell(
+                (centers[:, None] - idx[None, :]) / scale) / scale
+        # edge handling: renormalize rows (kernel mass clipped at borders)
+        return (w / np.maximum(w.sum(1, keepdims=True), 1e-12)).astype(
+            np.float32)
+
+    n, h, w_in, c = x.shape
+    dtype = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
+    xf = x.astype(dtype)
+    wh = jnp.asarray(axis_weights(height, h))
+    ww = jnp.asarray(axis_weights(width, w_in))
+    out = jnp.einsum("oh,nhwc->nowc", wh, xf)
+    return jnp.einsum("pw,nowc->nopc", ww, out)
+
+
 def _area_weights(out_size: int, in_size: int):
     """[out, in] interval-overlap weight matrix: output cell i averages the
     source interval [i·s, (i+1)·s) (TF area-resize semantics)."""

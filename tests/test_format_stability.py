@@ -114,3 +114,90 @@ def _leaves(tree):
             yield from _leaves(v)
     else:
         yield tree
+
+
+class TestRound5LayerSerde:
+    """The round-5 layer types must survive the zip container."""
+
+    def test_time_distributed_masking_roundtrip(self, tmp_path):
+        import numpy as np
+
+        from deeplearning4j_tpu.learning import Adam
+        from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.util import model_serializer as MS
+
+        conf = (NeuralNetConfiguration.builder().seed(3)
+                .updater(Adam(1e-2)).list()
+                .layer(L.MaskingLayer(mask_value=0.0))
+                .layer(L.TimeDistributedLayer(
+                    inner=L.DenseLayer(n_out=7, activation="relu")))
+                .layer(L.GlobalPoolingLayer(pooling_type="avg"))
+                .layer(L.OutputLayer(n_out=3, activation="softmax",
+                                     loss="mcxent"))
+                .set_input_type(InputType.recurrent(4, 6)).build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(0).randn(3, 6, 4).astype(np.float32)
+        x[:, 4:] = 0.0
+        out1 = net.output(x).to_numpy()
+        p = str(tmp_path / "m.zip")
+        MS.write_model(net, p)
+        net2 = MS.restore_multi_layer_network(p)
+        np.testing.assert_allclose(net2.output(x).to_numpy(), out1,
+                                   atol=1e-6)
+
+    def test_lambda_layer_roundtrip_via_registry(self, tmp_path):
+        import numpy as np
+        import pytest as _pytest
+
+        from deeplearning4j_tpu.imports.keras_import import (
+            register_lambda, unregister_lambda)
+        from deeplearning4j_tpu.learning import Adam
+        from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.util import model_serializer as MS
+
+        fn = lambda t: t * 2.0 + 0.5  # noqa: E731
+        conf = (NeuralNetConfiguration.builder().seed(3)
+                .updater(Adam(1e-2)).list()
+                .layer(L.DenseLayer(n_out=7, activation="relu"))
+                .layer(L.LambdaLayer(fn=fn, name="x2p"))
+                .layer(L.OutputLayer(n_out=3, activation="softmax",
+                                     loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        out1 = net.output(x).to_numpy()
+        p = str(tmp_path / "m.zip")
+        MS.write_model(net, p)          # serializes the NAME, not the body
+        # restoring WITHOUT the registration must refuse actionably
+        with _pytest.raises(ValueError, match="register_lambda"):
+            MS.restore_multi_layer_network(p)
+        register_lambda("x2p", fn)
+        try:
+            net2 = MS.restore_multi_layer_network(p)
+            np.testing.assert_allclose(net2.output(x).to_numpy(), out1,
+                                       atol=1e-6)
+        finally:
+            unregister_lambda("x2p")
+
+    def test_unnamed_lambda_refused_at_save(self, tmp_path):
+        from deeplearning4j_tpu.learning import Adam
+        from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.util import model_serializer as MS
+
+        conf = (NeuralNetConfiguration.builder().seed(3)
+                .updater(Adam(1e-2)).list()
+                .layer(L.LambdaLayer(fn=lambda t: t * 2.0))   # no name
+                .layer(L.OutputLayer(n_out=3, activation="softmax",
+                                     loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        import pytest as _pytest
+
+        with _pytest.raises(TypeError, match="unnamed LambdaLayer"):
+            MS.write_model(net, str(tmp_path / "m.zip"))
