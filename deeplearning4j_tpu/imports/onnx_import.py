@@ -717,6 +717,82 @@ def _reshape(ctx):
     return ctx.emit("reshape", [ctx.var(0)], shape=tuple(shape))
 
 
+@onnx_op("Resize", "Upsample")
+def _resize(ctx):
+    """ONNX Resize (opset 10+: X, roi, scales, sizes) and the deprecated
+    Upsample (X, scales) — the CNN upsampling staple (round 5). Static
+    scales/sizes only (XLA static shapes); NCHW in the graph, resized
+    through the NHWC registry ops with a permute pair XLA fuses away."""
+    mode = ctx.attr("mode", "nearest")
+    ct = ctx.attr("coordinate_transformation_mode", "half_pixel")
+    shp = ctx.shape_of_input(0)
+    if len(shp) != 4:
+        raise UnsupportedOnnxOpError(
+            f"{ctx.node.op}: rank-{len(shp)} input (NCHW images only)",
+            ctx.name)
+    n, c, h, w = (int(d) for d in shp)
+    sizes = None
+    if ctx.node.op_type == "Upsample":
+        scales = np.asarray(ctx.static(1)).reshape(-1)
+    else:
+        sizes_in = (ctx.static_or_none(3) if ctx.n_in() > 3 else None)
+        scales_in = (ctx.static_or_none(2) if ctx.n_in() > 2 else None)
+        if sizes_in is not None and np.asarray(sizes_in).size:
+            sizes = np.asarray(sizes_in).reshape(-1)
+            scales = None
+        elif scales_in is not None and np.asarray(scales_in).size:
+            scales = np.asarray(scales_in).reshape(-1)
+        else:
+            raise UnsupportedOnnxOpError(
+                "Resize: scales/sizes must be static initializers",
+                ctx.name)
+    if sizes is not None:
+        oh, ow = int(sizes[2]), int(sizes[3])
+    else:
+        if not (abs(scales[0] - 1) < 1e-6 and abs(scales[1] - 1) < 1e-6):
+            raise UnsupportedOnnxOpError(
+                f"{ctx.node.op_type}: batch/channel scaling", ctx.name)
+        oh, ow = int(round(h * float(scales[2]))), \
+            int(round(w * float(scales[3])))
+    if ct == "align_corners":
+        ac, hp = True, False
+    elif ct in ("half_pixel", "pytorch_half_pixel"):
+        ac, hp = False, True
+    elif ct in ("asymmetric", "tf_crop_and_resize"):
+        if ct == "tf_crop_and_resize":
+            raise UnsupportedOnnxOpError("Resize(tf_crop_and_resize)",
+                                         ctx.name)
+        ac, hp = False, False
+    else:
+        raise UnsupportedOnnxOpError(
+            f"Resize(coordinate_transformation_mode={ct!r})", ctx.name)
+    mode = mode.decode() if isinstance(mode, bytes) else mode
+    nhwc = ctx.sd._add_op("permute", [ctx.var(0)], dims=(0, 2, 3, 1))
+    if mode == "nearest":
+        nm = ctx.attr("nearest_mode", "round_prefer_floor")
+        nm = nm.decode() if isinstance(nm, bytes) else nm
+        # the classic Upsample contract is asymmetric+floor — the exact
+        # integer-scale case every CNN decoder uses; reject samplings the
+        # registry op does not implement rather than import approximately
+        if hp and nm not in ("round_prefer_floor", "floor"):
+            raise UnsupportedOnnxOpError(
+                f"Resize(nearest, nearest_mode={nm!r})", ctx.name)
+        out = ctx.sd._add_op("resize_nearest", [nhwc], height=oh, width=ow,
+                             align_corners=ac, half_pixel_centers=hp)
+    elif mode == "linear":
+        out = ctx.sd._add_op("resize_bilinear", [nhwc], height=oh,
+                             width=ow, align_corners=ac,
+                             half_pixel_centers=hp)
+    elif mode == "cubic":
+        if ac or not hp:
+            raise UnsupportedOnnxOpError(
+                "Resize(cubic) supports half_pixel only", ctx.name)
+        out = ctx.sd._add_op("resize_bicubic", [nhwc], height=oh, width=ow)
+    else:
+        raise UnsupportedOnnxOpError(f"Resize(mode={mode!r})", ctx.name)
+    return ctx.emit("permute", [out], dims=(0, 3, 1, 2))
+
+
 @onnx_op("Transpose")
 def _transpose(ctx):
     perm = ctx.attr("perm")

@@ -1000,6 +1000,30 @@ def _fused_batch_norm(ctx):
     return (out, mean, var, mean, var, mean)
 
 
+@tf_op("ResizeBilinear", "ResizeNearestNeighbor", "ResizeBicubic")
+def _resize_image(ctx):
+    """TF image-resize nodes (detection/zoo graph staple, round 5);
+    size input must be static (XLA static shapes). Attrs map 1:1 onto
+    the registry resize ops (all NHWC like TF)."""
+    size = np.asarray(ctx.static(1)).reshape(-1)
+    h, w = int(size[0]), int(size[1])
+    ac = bool(ctx.attr("align_corners", False))
+    hp = bool(ctx.attr("half_pixel_centers", False))
+    opn = ctx.node.op
+    if opn == "ResizeNearestNeighbor":
+        return ctx.emit("resize_nearest", [ctx.var(0)], height=h, width=w,
+                        align_corners=ac, half_pixel_centers=hp)
+    if opn == "ResizeBicubic":
+        if ac or not hp:
+            # the registry bicubic implements TF2's half-pixel Keys
+            # kernel; the legacy corner modes have no consumer graphs
+            raise UnsupportedTFOpError(
+                "ResizeBicubic(align_corners or legacy centers)", ctx.name)
+        return ctx.emit("resize_bicubic", [ctx.var(0)], height=h, width=w)
+    return ctx.emit("resize_bilinear", [ctx.var(0)], height=h, width=w,
+                    align_corners=ac, half_pixel_centers=hp)
+
+
 @tf_op("MatrixDiag", "MatrixDiagPart")
 def _matrix_diag(ctx):
     table = {"MatrixDiag": "matrix_diag", "MatrixDiagPart": "matrix_diag_part"}

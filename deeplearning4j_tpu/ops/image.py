@@ -15,18 +15,22 @@ from .registry import op
 
 
 @op("resize_nearest", "image")
-def resize_nearest(x, height: int, width: int, align_corners: bool = False):
-    """x: [N, H, W, C]."""
+def resize_nearest(x, height: int, width: int, align_corners: bool = False,
+                   half_pixel_centers: bool = False):
+    """x: [N, H, W, C]. ``half_pixel_centers`` is TF2's default sampling
+    (floor((i + 0.5) * scale)); the legacy default is floor(i * scale)."""
     n, h, w, c = x.shape
-    if align_corners and height > 1:
-        rows = jnp.round(jnp.linspace(0, h - 1, height)).astype(jnp.int32)
-    else:
-        rows = jnp.floor(jnp.arange(height) * (h / height)).astype(jnp.int32)
-    if align_corners and width > 1:
-        cols = jnp.round(jnp.linspace(0, w - 1, width)).astype(jnp.int32)
-    else:
-        cols = jnp.floor(jnp.arange(width) * (w / width)).astype(jnp.int32)
-    return x[:, rows][:, :, cols]
+
+    def idx(out_size, in_size):
+        if align_corners and out_size > 1:
+            return jnp.round(
+                jnp.linspace(0, in_size - 1, out_size)).astype(jnp.int32)
+        scale = in_size / out_size
+        pts = ((jnp.arange(out_size) + 0.5) * scale if half_pixel_centers
+               else jnp.arange(out_size) * scale)
+        return jnp.clip(jnp.floor(pts).astype(jnp.int32), 0, in_size - 1)
+
+    return x[:, idx(height, h)][:, :, idx(width, w)]
 
 
 @op("resize_bilinear", "image")

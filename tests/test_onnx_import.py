@@ -879,3 +879,71 @@ class TestReviewRegressions:
             inputs=[("x", (3,)), ("lo_in", (1,))], outputs=["y"])
         with pytest.raises(ValueError, match="statically resolvable"):
             import_onnx(m)
+
+
+class TestResize:
+    """ONNX Resize/Upsample (round 5) vs torch.nn.functional.interpolate
+    goldens."""
+
+    def test_resize_linear_half_pixel_sizes(self):
+        x = A(2, 3, 6, 8)
+        exp = TF.interpolate(torch.from_numpy(x), size=(12, 16),
+                             mode="bilinear", align_corners=False).numpy()
+        m = make_model(
+            [make_node("Resize", ["x", "", "", "sizes"], ["y"],
+                       mode="linear",
+                       coordinate_transformation_mode="half_pixel")],
+            inputs=[("x", [2, 3, 6, 8])], outputs=["y"],
+            initializers={"sizes": np.array([2, 3, 12, 16], np.int64)})
+        check_model(m, {"x": x}, exp, atol=1e-5)
+
+    def test_resize_linear_align_corners(self):
+        x = A(2, 3, 6, 8)
+        exp = TF.interpolate(torch.from_numpy(x), size=(12, 16),
+                             mode="bilinear", align_corners=True).numpy()
+        m = make_model(
+            [make_node("Resize", ["x", "", "", "sizes"], ["y"],
+                       mode="linear",
+                       coordinate_transformation_mode="align_corners")],
+            inputs=[("x", [2, 3, 6, 8])], outputs=["y"],
+            initializers={"sizes": np.array([2, 3, 12, 16], np.int64)})
+        check_model(m, {"x": x}, exp, atol=1e-5)
+
+    def test_resize_nearest_scales_asymmetric(self):
+        # the classic Upsample contract: asymmetric + floor, 2x
+        x = A(1, 2, 4, 4)
+        exp = TF.interpolate(torch.from_numpy(x), scale_factor=2,
+                             mode="nearest").numpy()
+        m = make_model(
+            [make_node("Resize", ["x", "", "scales"], ["y"],
+                       mode="nearest",
+                       coordinate_transformation_mode="asymmetric",
+                       nearest_mode="floor")],
+            inputs=[("x", [1, 2, 4, 4])], outputs=["y"],
+            initializers={"scales": np.array([1, 1, 2, 2], np.float32)})
+        check_model(m, {"x": x}, exp, atol=0)
+
+    def test_upsample_op(self):
+        x = A(1, 2, 3, 5)
+        exp = TF.interpolate(torch.from_numpy(x), scale_factor=2,
+                             mode="nearest").numpy()
+        m = make_model(
+            [make_node("Upsample", ["x", "scales"], ["y"],
+                       mode="nearest")],
+            inputs=[("x", [1, 2, 3, 5])], outputs=["y"],
+            initializers={"scales": np.array([1, 1, 2, 2], np.float32)})
+        check_model(m, {"x": x}, exp, atol=0)
+
+    def test_resize_unsupported_modes_named(self):
+        from deeplearning4j_tpu.imports.onnx_import import (
+            UnsupportedOnnxOpError, import_onnx)
+
+        m = make_model(
+            [make_node("Resize", ["x", "", "", "sizes"], ["y"],
+                       mode="linear",
+                       coordinate_transformation_mode="tf_crop_and_resize")],
+            inputs=[("x", [1, 2, 4, 4])], outputs=["y"],
+            initializers={"sizes": np.array([1, 2, 8, 8], np.int64)})
+        with pytest.raises(UnsupportedOnnxOpError,
+                           match="tf_crop_and_resize"):
+            import_onnx(m)

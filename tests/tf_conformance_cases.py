@@ -687,3 +687,28 @@ _wmask2 = Bl(3, 4)
 case("Where", "static_cond_2d",
      lambda a: a + tf.cast(tf.shape(tf.where(tf.constant(_wmask2)))[0],
                            tf.float32), [F(2, 3)])
+
+# image resize nodes (round 5 — detection/zoo graph staple)
+_rimg = Pos(2, 6, 8, 3)
+case("ResizeBilinear", "v2_half_pixel",
+     lambda a: tf.image.resize(a, (12, 16), method="bilinear"), [_rimg],
+     atol=1e-5)
+case("ResizeBilinear", "v1_legacy",
+     lambda a: tf.compat.v1.image.resize_bilinear(a, (12, 16)), [_rimg],
+     atol=1e-5)
+case("ResizeBilinear", "v1_align_corners",
+     lambda a: tf.compat.v1.image.resize_bilinear(a, (12, 16),
+                                                  align_corners=True),
+     [_rimg], atol=1e-5)
+case("ResizeBilinear", "downscale",
+     lambda a: tf.image.resize(a, (3, 4), method="bilinear"), [_rimg],
+     atol=1e-5)
+case("ResizeNearestNeighbor", "v2_half_pixel",
+     lambda a: tf.image.resize(a, (12, 16), method="nearest"), [_rimg],
+     atol=0)
+case("ResizeNearestNeighbor", "v1_legacy",
+     lambda a: tf.compat.v1.image.resize_nearest_neighbor(a, (3, 4)),
+     [_rimg], atol=0)
+case("ResizeBicubic", "v2_half_pixel",
+     lambda a: tf.image.resize(a, (12, 16), method="bicubic"), [_rimg],
+     atol=2e-4)
