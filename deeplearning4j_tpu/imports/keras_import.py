@@ -982,6 +982,38 @@ class _SequentialBuilder:
 
         self._push(layer, setter)
 
+    def _map_GroupNormalization(self, c, ws):
+        name = c.get("name", "?")
+        _require_weights(ws, 'GroupNormalization', name)
+        axis = c.get("axis", -1)
+        if axis != -1:
+            raise UnsupportedKerasLayerError(
+                "GroupNormalization", f"{name}: axis={axis} (channels-last "
+                "h5 only)")
+        if not bool(c.get("scale", True)) or not bool(c.get("center", True)):
+            raise UnsupportedKerasLayerError(
+                "GroupNormalization", f"{name}: scale/center disabled")
+        groups = int(c.get("groups", 32))
+        layer = L.GroupNormalizationLayer(
+            groups=groups, eps=float(c.get("epsilon", 1e-3)))
+        gamma, beta = ws[0], ws[1]
+
+        def setter(params):
+            params["gain"] = np.asarray(gamma)
+            params["bias"] = np.asarray(beta)
+
+        self._push(layer, setter)
+
+    def _map_SpatialDropout1D(self, c, ws):
+        self._push(L.SpatialDropoutLayer(rate=float(c["rate"])), None)
+
+    def _map_SpatialDropout2D(self, c, ws):
+        if c.get("data_format", "channels_last") not in (None,
+                                                         "channels_last"):
+            raise UnsupportedKerasLayerError("SpatialDropout2D",
+                                             "channels_first h5")
+        self._push(L.SpatialDropoutLayer(rate=float(c["rate"])), None)
+
     def _map_ZeroPadding3D(self, c, ws):
         p = c.get("padding", 1)
         spec = (p if isinstance(p, int)

@@ -1471,3 +1471,80 @@ class ConvLSTM2DLayer(Layer):
         if self.return_sequences:
             return jnp.moveaxis(hs, 0, 2), state     # [B, F, T, H', W']
         return hT, state
+
+
+@dataclass
+class GroupNormalizationLayer(Layer):
+    """Keras GroupNormalization / reference GroupNorm pattern: channels
+    split into ``groups``; normalize over (group-channels, spatial) per
+    example; per-channel gain/bias. CNN [B, C, H, W] or FF [B, F]."""
+
+    groups: int = 32
+    eps: float = 1e-3
+
+    def set_input_type(self, input_type):
+        if isinstance(input_type, CNNInput):
+            self.n_in = input_type.channels
+        elif isinstance(input_type, FFInput):
+            self.n_in = input_type.size
+        else:
+            raise ValueError("GroupNormalizationLayer needs CNN or FF "
+                             f"input, got {input_type}")
+        if self.n_in % self.groups:
+            raise ValueError(
+                f"channels ({self.n_in}) must divide into groups "
+                f"({self.groups})")
+        return input_type
+
+    def init_params(self, key, dtype=jnp.float32):
+        return {"gain": jnp.ones((self.n_in,), dtype),
+                "bias": jnp.zeros((self.n_in,), dtype)}
+
+    def apply(self, params, x, state, training, rng):
+        B, C = x.shape[0], x.shape[1]
+        G = self.groups
+        spatial = x.shape[2:]
+        xg = x.reshape((B, G, C // G) + spatial)
+        axes = tuple(range(2, xg.ndim))
+        mu = xg.mean(axis=axes, keepdims=True)
+        var = ((xg - mu) ** 2).mean(axis=axes, keepdims=True)
+        xn = ((xg - mu) / jnp.sqrt(var + self.eps)).reshape(x.shape)
+        shape = (1, C) + (1,) * len(spatial)
+        return (xn * params["gain"].reshape(shape)
+                + params["bias"].reshape(shape)), state
+
+
+@dataclass
+class SpatialDropoutLayer(Layer):
+    """Drops whole FEATURE MAPS (reference weightnoise/SpatialDropout;
+    Keras SpatialDropout1D/2D): one Bernoulli draw per (example, channel),
+    broadcast over time/space, inverted scaling. Works on RNN [B, T, F]
+    (drops features) and CNN [B, C, H, W] (drops channels)."""
+
+    rate: float = 0.5
+
+    def set_input_type(self, input_type):
+        self.n_in = getattr(input_type, "size",
+                            getattr(input_type, "channels", None))
+        return input_type
+
+    def apply(self, params, x, state, training, rng):
+        if not training or self.rate <= 0:
+            return x, state
+        if x.ndim == 3:      # [B, T, F]: per (example, feature)
+            shape = (x.shape[0], 1, x.shape[2])
+        else:                # [B, C, *spatial]: per (example, channel)
+            shape = (x.shape[0], x.shape[1]) + (1,) * (x.ndim - 2)
+        keep = 1.0 - self.rate
+        m = jax.random.bernoulli(rng, keep, shape)
+        return x * m.astype(x.dtype) / keep, state
+
+    def apply_masked(self, params, x, state, training, rng, fmask):
+        y, st = self.apply(params, x, state, training, rng)
+        if y.ndim == 3:
+            y = y * fmask[:, :, None].astype(y.dtype)
+        return y, st
+
+    @property
+    def has_params(self):
+        return False

@@ -190,14 +190,14 @@ class TestKerasSequentialImport:
         assert ours.score(ds) < before * 0.8, (before, ours.score(ds))
 
     def test_unsupported_layer_raises_cleanly(self, tmp_path):
-        # ConvLSTM2D gained a mapper in round 5; GroupNormalization
-        # remains unmapped
+        # ConvLSTM2D and GroupNormalization gained mappers in round 5;
+        # UnitNormalization remains unmapped
         m = keras.Sequential([
-            keras.layers.Input((8, 4)),
-            keras.layers.GroupNormalization(groups=2),
+            keras.layers.Input((8,)),
+            keras.layers.UnitNormalization(),
         ])
         path = str(tmp_path / "m.h5")
         m.save(path)
         with pytest.raises(UnsupportedKerasLayerError,
-                           match="GroupNormalization"):
+                           match="UnitNormalization"):
             KerasModelImport.import_keras_sequential_model_and_weights(path)

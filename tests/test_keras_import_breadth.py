@@ -620,3 +620,53 @@ class TestRound5Tail:
         assert float(net.score(ds)) < first, "masked model did not train"
 
 
+
+    def test_group_normalization(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((8, 8, 4)),
+            keras.layers.Conv2D(8, 3),
+            keras.layers.GroupNormalization(groups=4),
+            keras.layers.GlobalAveragePooling2D(),
+            keras.layers.Dense(3),
+        ])
+        gn = m.layers[1]
+        rng2 = np.random.RandomState(9)
+        gn.set_weights([rng2.normal(1.0, 0.3, w.shape).astype(np.float32)
+                        for w in gn.get_weights()])
+        roundtrip(m, img(2, 8, 8, 4), tmp_path)
+
+    def test_group_normalization_dense(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((12,)),
+            keras.layers.Dense(8),
+            keras.layers.GroupNormalization(groups=2),
+            keras.layers.Dense(3),
+        ])
+        roundtrip(m, rng.randn(4, 12).astype(np.float32), tmp_path)
+
+    def test_spatial_dropout(self, tmp_path):
+        # identity at inference; importing + training must work
+        m = keras.Sequential([
+            keras.layers.Input((6, 4)),
+            keras.layers.SpatialDropout1D(0.3),
+            keras.layers.Conv1D(5, 3, padding="same"),
+            keras.layers.GlobalAveragePooling1D(),
+        ])
+        roundtrip(m, seq(2, 6, 4), tmp_path)
+        m2 = keras.Sequential([
+            keras.layers.Input((6, 6, 2)),
+            keras.layers.Conv2D(4, 3),
+            keras.layers.SpatialDropout2D(0.4),
+            keras.layers.GlobalMaxPooling2D(),
+            keras.layers.Dense(2, activation="softmax"),
+        ])
+        net = roundtrip(m2, img(2, 6, 6, 2), tmp_path)
+        # the TRAINING path draws the channel mask — must fit finitely
+        from deeplearning4j_tpu.data import DataSet
+
+        x = img(8, 6, 6, 2)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 8)]
+        first = float(net.score(DataSet(x, y)))
+        for _ in range(5):
+            net.fit(DataSet(x, y))
+        assert np.isfinite(float(net.score(DataSet(x, y))))
