@@ -1,0 +1,96 @@
+"""SystemInfo: hardware/software environment dump.
+
+Reference: nd4j-common ``org/nd4j/systeminfo/SystemInfo.java`` (SURVEY
+§5.5) — appended to crash reports and shown in the UI's system tab. TPU
+shape: host (OS, python, CPU, RAM), jax/device inventory with live
+per-device memory stats from the PJRT client, and the framework's
+library versions.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import sys
+from typing import Any, Dict, List
+
+
+def _host_ram_bytes() -> int:
+    try:
+        return (os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES"))
+    except (ValueError, OSError, AttributeError):
+        return 0
+
+
+def gather() -> Dict[str, Any]:
+    """Structured environment snapshot (JSON-serializable)."""
+    info: Dict[str, Any] = {
+        "os": f"{platform.system()} {platform.release()}",
+        "machine": platform.machine(),
+        "python": sys.version.split()[0],
+        "cpu_count": os.cpu_count(),
+        "host_ram_bytes": _host_ram_bytes(),
+    }
+    try:
+        import jax
+
+        info["jax_version"] = jax.__version__
+        devices: List[Dict[str, Any]] = []
+        for d in jax.devices():
+            dev = {"id": d.id, "platform": d.platform,
+                   "kind": getattr(d, "device_kind", "?")}
+            try:
+                stats = d.memory_stats()
+            except Exception:       # CPU backends have none
+                stats = None
+            if stats:
+                dev["bytes_in_use"] = int(stats.get("bytes_in_use", 0))
+                dev["bytes_limit"] = int(stats.get("bytes_limit", 0))
+                dev["peak_bytes_in_use"] = int(
+                    stats.get("peak_bytes_in_use", 0))
+            devices.append(dev)
+        info["devices"] = devices
+        info["default_backend"] = jax.default_backend()
+    except Exception as e:          # pragma: no cover - jax init failure
+        info["jax_error"] = str(e)
+    for mod in ("flax", "optax", "orbax.checkpoint", "numpy"):
+        try:
+            m = __import__(mod)
+            for part in mod.split(".")[1:]:
+                m = getattr(m, part)
+            info[f"{mod}_version"] = getattr(m, "__version__", "?")
+        except ImportError:
+            pass
+    return info
+
+
+def dump() -> str:
+    """Human-readable report (the reference's text-dump contract)."""
+    info = gather()
+    lines = ["=== SystemInfo ==="]
+    for k in ("os", "machine", "python", "cpu_count"):
+        lines.append(f"{k}: {info.get(k)}")
+    ram = info.get("host_ram_bytes") or 0
+    lines.append(f"host RAM: {ram / 2**30:.1f} GiB")
+    lines.append(f"jax: {info.get('jax_version', '?')} "
+                 f"(backend {info.get('default_backend', '?')})")
+    for d in info.get("devices", []):
+        mem = ""
+        if "bytes_in_use" in d:
+            mem = (f" — {d['bytes_in_use'] / 2**20:.0f} MiB in use"
+                   f" / {d['bytes_limit'] / 2**20:.0f} MiB"
+                   f" (peak {d['peak_bytes_in_use'] / 2**20:.0f})")
+        lines.append(f"device {d['id']}: {d['platform']} {d['kind']}{mem}")
+    for k, v in info.items():
+        if k.endswith("_version") and k != "jax_version":
+            lines.append(f"{k.replace('_version', '')}: {v}")
+    return "\n".join(lines)
+
+
+class SystemInfo:
+    """Reference-shaped static facade."""
+
+    gather = staticmethod(gather)
+    dump = staticmethod(dump)
+    # reference spelling
+    getSystemInfo = staticmethod(dump)

@@ -1,0 +1,127 @@
+"""CrashReportingUtil: OOM/crash post-mortem memory dump.
+
+Reference: dl4j-nn ``org/deeplearning4j/nn/util/CrashReportingUtil.java``
+(SURVEY §2.3 Common/infra, §5.3) — on an OOM it writes system info,
+workspace state, and a memory-by-layer estimate. TPU shape: SystemInfo
+(incl. live PJRT HBM stats), per-layer parameter counts/bytes, and an
+activation-memory estimate per layer for a given minibatch — the numbers
+that tell a user WHICH layer blew HBM.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _tree_bytes(tree) -> int:
+    return sum(np.asarray(a).nbytes
+               for a in jax.tree_util.tree_leaves(tree))
+
+
+def _tree_count(tree) -> int:
+    return sum(int(np.prod(np.shape(a)))
+               for a in jax.tree_util.tree_leaves(tree))
+
+
+def generate_memory_status_report(model, minibatch: int = 32) -> str:
+    """The crash-report body: system info + per-layer param/activation
+    memory table for a ``MultiLayerNetwork`` or ``ComputationGraph``."""
+    from ..common.system_info import SystemInfo
+
+    lines = [f"=== deeplearning4j-tpu memory status report "
+             f"({datetime.datetime.now().isoformat(timespec='seconds')}) ===",
+             SystemInfo.dump(), "", f"--- model (minibatch={minibatch}) ---"]
+    params = model._params
+    names = (list(params.keys()) if isinstance(params, dict)
+             else list(range(len(params))))
+    layers = getattr(model.conf, "layers", None)
+    total_param_bytes = 0
+    for n in names:
+        p = params[n]
+        pb = _tree_bytes(p)
+        total_param_bytes += pb
+        label = n
+        if layers is not None and isinstance(n, int) and n < len(layers):
+            label = f"{n} ({type(layers[n]).__name__})"
+        lines.append(f"layer {label}: {_tree_count(p):,} params, "
+                     f"{pb / 2**20:.2f} MiB")
+    lines.append(f"total parameters: {total_param_bytes / 2**20:.2f} MiB "
+                 "(x2-3 live during training: gradients + updater state)")
+
+    # activation-memory estimate: eval_shape the forward, sum per-layer
+    # output sizes at the given minibatch (the reference estimates
+    # per-layer activation memory the same way, analytically)
+    try:
+        act_bytes = _activation_estimate(model, minibatch, lines)
+        lines.append(f"activation estimate (fwd, minibatch {minibatch}): "
+                     f"{act_bytes / 2**20:.2f} MiB (backward roughly "
+                     "doubles this without gradient_checkpointing)")
+    except Exception as e:           # estimate is best-effort
+        lines.append(f"activation estimate unavailable: {e}")
+    return "\n".join(lines)
+
+
+def _activation_estimate(model, minibatch: int, lines) -> int:
+    from ..nn.multilayer import MultiLayerNetwork
+
+    if not isinstance(model, MultiLayerNetwork):
+        raise ValueError("per-layer activation walk supports "
+                         "MultiLayerNetwork (graphs: use the profiler)")
+    it = model.conf.input_type
+    from ..nn.conf.inputs import CNNInput, FFInput, RNNInput
+
+    if isinstance(it, FFInput):
+        shape = (minibatch, it.size)
+    elif isinstance(it, RNNInput):
+        shape = (minibatch, it.timesteps or 16, it.size)
+    elif isinstance(it, CNNInput):
+        shape = (minibatch, it.channels, it.height, it.width)
+    else:
+        raise ValueError(f"unsupported input type {it}")
+    total = 0
+    x = jax.ShapeDtypeStruct(shape, jnp.float32)
+    key = jax.random.PRNGKey(0)
+    for i, layer in enumerate(model.layers):
+        pre = model.conf.preprocessors.get(i)
+        if pre is not None:
+            x = jax.eval_shape(pre, x)
+
+        def run(xx, lp=model._params[i], st=model._states[i], _l=layer):
+            out, _ = _l.apply(lp, xx, st, False, key)
+            return out
+
+        x = jax.eval_shape(run, x)
+        nbytes = int(np.prod(x.shape)) * x.dtype.itemsize
+        total += nbytes
+        lines.append(f"  activation[{i} {type(layer).__name__}]: "
+                     f"{tuple(x.shape)} = {nbytes / 2**20:.2f} MiB")
+    return total
+
+
+def write_memory_crash_dump(model, path: Optional[str] = None,
+                            minibatch: int = 32) -> str:
+    """Write the report to ``path`` (default: cwd
+    ``dl4j-tpu-memory-crash-dump-<ts>.txt``) and return the path —
+    the reference's ``writeMemoryCrashDump`` contract."""
+    if path is None:
+        ts = datetime.datetime.now().strftime("%Y%m%d-%H%M%S")
+        path = os.path.abspath(f"dl4j-tpu-memory-crash-dump-{ts}.txt")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(generate_memory_status_report(model, minibatch))
+    return path
+
+
+class CrashReportingUtil:
+    """Reference-shaped static facade."""
+
+    generate_memory_status_report = staticmethod(
+        generate_memory_status_report)
+    write_memory_crash_dump = staticmethod(write_memory_crash_dump)
+    # reference spelling
+    writeMemoryCrashDump = staticmethod(write_memory_crash_dump)

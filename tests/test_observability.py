@@ -194,3 +194,46 @@ class TestProfiler:
         # disabled again: NaN flows through silently
         out = jax.jit(lambda x: jnp.log(x))(jnp.asarray(-1.0))
         assert np.isnan(float(out))
+
+
+class TestSystemInfoAndCrashReport:
+    """SystemInfo (SURVEY §5.5) + CrashReportingUtil (§2.3, §5.3)."""
+
+    def test_system_info_dump(self):
+        from deeplearning4j_tpu.common.system_info import SystemInfo
+
+        info = SystemInfo.gather()
+        assert info["cpu_count"] >= 1 and "devices" in info
+        text = SystemInfo.dump()
+        assert "SystemInfo" in text and "jax:" in text
+        import json
+
+        json.dumps(info)    # must stay JSON-serializable
+
+    def test_memory_crash_dump(self, tmp_path):
+        import numpy as np
+
+        from deeplearning4j_tpu.learning import Sgd
+        from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf import layers as L
+        from deeplearning4j_tpu.util.crash_reporting import \
+            CrashReportingUtil
+
+        conf = (NeuralNetConfiguration.builder().seed(1)
+                .updater(Sgd(learning_rate=0.1)).list()
+                .layer(L.ConvolutionLayer(n_out=4, kernel_size=(3, 3)))
+                .layer(L.SubsamplingLayer(kernel_size=(2, 2),
+                                          stride=(2, 2)))
+                .layer(L.DenseLayer(n_out=16))
+                .layer(L.OutputLayer(n_out=3, activation="softmax",
+                                     loss="mcxent"))
+                .set_input_type(InputType.convolutional(12, 12, 2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        path = CrashReportingUtil.write_memory_crash_dump(
+            net, str(tmp_path / "dump.txt"), minibatch=8)
+        text = open(path).read()
+        assert "memory status report" in text
+        assert "ConvolutionLayer" in text and "activation[" in text
+        assert "total parameters" in text
