@@ -24,10 +24,12 @@ Conformance: ``tests/test_tf_import.py`` generates golden graphs with the
 local TF (SURVEY.md §4.3 harness shape: freeze → import → execute → compare
 within per-op tolerance).
 
-Supported TF surface (round-3 statement of scope): FROZEN inference
-GraphDefs over the ~90 registered op names (``supported_tf_ops()``) — the
+Supported TF surface (round-5 statement of scope): FROZEN inference
+GraphDefs over the 138 registered op names (``supported_tf_ops()``) — the
 closure covering MLPs, CNNs (Conv2D/DepthwiseConv2d/pooling/FusedBatchNorm
-inference), and transformer encoders (BERT-base end-to-end, benched).
+inference/image resize), and transformer encoders (BERT-base end-to-end,
+benched). Conformance: 328 generated golden cases + coverage gates in
+``tests/test_tf_conformance.py`` (every mapped op targeted or ledgered).
 Deliberately OUT of scope, erroring with actionable messages rather than
 importing wrong:
 
