@@ -189,17 +189,109 @@ class KerasModelImport:
 
 
 def _read_h5(h5_path: str):
+    """Open a legacy ``.h5`` or a Keras-3 native ``.keras`` archive →
+    (weights file, model config). The ``.keras`` zip holds config.json +
+    model.weights.h5 (variables at ``layers/<name>/.../vars/<i>``); the
+    returned h5py File is tagged ``_keras3_format`` so ``_layer_weights``
+    reads the right layout."""
+    import io
+    import zipfile
+
     import h5py
 
+    # HDF5 check FIRST: zipfile.is_zipfile scans trailing bytes for the
+    # zip magic, so a legacy .h5 could be misclassified; and a zip that
+    # is not a .keras archive must refuse actionably, not KeyError
+    if not h5py.is_hdf5(h5_path) and zipfile.is_zipfile(h5_path):
+        with zipfile.ZipFile(h5_path) as z:
+            names = set(z.namelist())
+            if "config.json" not in names or \
+                    "model.weights.h5" not in names:
+                raise ValueError(
+                    f"{h5_path}: zip archive without config.json/"
+                    "model.weights.h5 — not a Keras-3 .keras model file")
+            cfg = json.loads(z.read("config.json"))
+            f = h5py.File(io.BytesIO(z.read("model.weights.h5")), "r")
+        f._keras3_format = True
+        # the weights store DISCARDS layer names (user-chosen included)
+        # and renumbers groups per model as snake_case(class) + per-class
+        # counter in config layer order — map config name → group name
+        f._keras3_names = _keras3_name_map(cfg)
+        return f, cfg
     f = h5py.File(h5_path, "r")
     cfg = json.loads(f.attrs["model_config"])
     return f, cfg
+
+
+def _keras3_snake(name: str) -> str:
+    """Keras's to_snake_case (utils/naming.py): the auto-name base the
+    weights store renumbers by."""
+    import re
+
+    name = re.sub(r"\W+", "", name)
+    name = re.sub(r"(.)([A-Z][a-z]+)", r"\1_\2", name)
+    return re.sub(r"([a-z])([A-Z])", r"\1_\2", name).lower()
+
+
+def _keras3_name_map(cfg) -> Dict[str, str]:
+    """config layer name → weights-store group name (per-class counter in
+    config order; verified empirically: both auto and USER names are
+    replaced by <snake_class>[_k] in the .keras variables file)."""
+    mapping: Dict[str, str] = {}
+    counters: Dict[str, int] = {}
+    for kl in cfg.get("config", {}).get("layers", []):
+        base = _keras3_snake(kl["class_name"])
+        k = counters.get(base, 0)
+        counters[base] = k + 1
+        cname = kl.get("config", {}).get("name", kl["class_name"])
+        mapping[cname] = base if k == 0 else f"{base}_{k}"
+    return mapping
+
+
+def _keras3_layer_weights(f, layer_name: str) -> List[np.ndarray]:
+    """Keras-3 weights store: variables under ``layers/<name>`` at
+    ``[nested group/]vars/<i>``. Order contract: a group's own ``vars``
+    (numerically sorted) come first, then child groups — with
+    ``forward_layer`` explicitly before ``backward_layer`` (alphabetical
+    order would swap a Bidirectional's halves relative to the legacy
+    ``weight_names`` order every mapper expects)."""
+    import h5py
+
+    layers_grp = f.get("layers")
+    if layers_grp is None:
+        return []
+    group = getattr(f, "_keras3_names", {}).get(layer_name, layer_name)
+    if group not in layers_grp:
+        return []
+
+    def child_key(k: str):
+        return {"forward_layer": "0", "backward_layer": "1"}.get(k, k)
+
+    def collect(g) -> List[np.ndarray]:
+        out: List[np.ndarray] = []
+        vars_grp = g.get("vars")
+        if isinstance(vars_grp, h5py.Group):
+            for k in sorted(vars_grp,
+                            key=lambda s: (not s.isdigit(),
+                                           int(s) if s.isdigit() else 0, s)):
+                item = vars_grp[k]
+                if isinstance(item, h5py.Dataset):
+                    out.append(np.asarray(item))
+        for k in sorted((kk for kk in g if kk != "vars"), key=child_key):
+            item = g[k]
+            if isinstance(item, h5py.Group):
+                out.extend(collect(item))
+        return out
+
+    return collect(layers_grp[group])
 
 
 def _layer_weights(f, layer_name: str) -> List[np.ndarray]:
     """Ordered weights via the layer group's weight_names attr (stable across
     Keras 2/3 nesting schemes). Weight-BEARING mappers must check for []
     and refuse — silently keeping random init would "import" a wrong model."""
+    if getattr(f, "_keras3_format", False):
+        return _keras3_layer_weights(f, layer_name)
     mw = f["model_weights"]
     if layer_name not in mw:
         return []

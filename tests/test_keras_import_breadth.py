@@ -670,3 +670,70 @@ class TestRound5Tail:
         for _ in range(5):
             net.fit(DataSet(x, y))
         assert np.isfinite(float(net.score(DataSet(x, y))))
+
+
+class TestKeras3NativeFormat:
+    """Round-5: the Keras-3 native .keras archive imports like legacy h5
+    (config.json + model.weights.h5 vars layout)."""
+
+    def _roundtrip_keras(self, model, x, tmp_path, atol=1e-4):
+        path = str(tmp_path / "model.keras")
+        model.save(path)
+        expected = model.predict(x, verbose=0)
+        ours = KerasModelImport.import_keras_sequential_model_and_weights(
+            path)
+        got = ours.output(x.astype(np.float32)).to_numpy()
+        np.testing.assert_allclose(got, expected, atol=atol, rtol=1e-3)
+        return ours
+
+    def test_dense_cnn_keras_format(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((8, 8, 2)),
+            keras.layers.Conv2D(4, 3, activation="relu"),
+            keras.layers.MaxPooling2D(2),
+            keras.layers.Flatten(),
+            keras.layers.Dense(5),
+        ])
+        self._roundtrip_keras(m, img(2, 8, 8, 2), tmp_path)
+
+    def test_bidirectional_order_keras_format(self, tmp_path):
+        # forward/backward halves must not swap (alphabetical group walk
+        # would reverse them)
+        m = keras.Sequential([
+            keras.layers.Input((5, 4)),
+            keras.layers.Bidirectional(
+                keras.layers.LSTM(3, return_sequences=True)),
+            keras.layers.GlobalAveragePooling1D(),
+        ])
+        self._roundtrip_keras(m, seq(2, 5, 4), tmp_path)
+
+    def test_batchnorm_separable_keras_format(self, tmp_path):
+        m = keras.Sequential([
+            keras.layers.Input((10, 10, 3)),
+            keras.layers.SeparableConv2D(6, 3, padding="same",
+                                         activation="relu"),
+            keras.layers.BatchNormalization(),
+            keras.layers.GlobalAveragePooling2D(),
+            keras.layers.Dense(4),
+        ])
+        x = img(8, 10, 10, 3)
+        m.compile(optimizer="sgd", loss="mse")
+        m.fit(x, np.random.RandomState(1).randn(8, 4).astype(np.float32),
+              epochs=1, verbose=0)     # non-trivial BN stats
+        self._roundtrip_keras(m, x, tmp_path)
+
+    def test_functional_keras_format(self, tmp_path):
+        inp = keras.layers.Input((6,), name="in0")
+        d1 = keras.layers.Dense(8, activation="tanh")(inp)
+        d2 = keras.layers.Dense(8, activation="relu")(inp)
+        merged = keras.layers.Add()([d1, d2])
+        out = keras.layers.Dense(3, activation="softmax")(merged)
+        m = keras.Model(inp, out)
+        path = str(tmp_path / "model.keras")
+        m.save(path)
+        x = rng.randn(4, 6).astype(np.float32)
+        expected = m.predict(x, verbose=0)
+        net = KerasModelImport.import_keras_model_and_weights(path)
+        got = net.output(x)
+        got = (got[0] if isinstance(got, (list, tuple)) else got).to_numpy()
+        np.testing.assert_allclose(got, expected, atol=1e-4, rtol=1e-3)
