@@ -140,6 +140,11 @@ def import_functional_parsed(f, cfg) -> ComputationGraph:
                     h, w, ch = dims
                     input_types[name] = InputType.convolutional(h, w, ch)
                     input_nhwc[name] = True
+                elif len(dims) == 4:   # NDHWC (Conv3D / ConvLSTM2D inputs)
+                    d, h, w, ch = dims
+                    input_types[name] = InputType.convolutional_3d(
+                        d, h, w, ch)
+                    input_nhwc[name] = "ndhwc"
                 elif len(dims) == 1:
                     input_types[name] = InputType.feed_forward(dims[0])
                     input_nhwc[name] = False
@@ -264,13 +269,16 @@ def import_functional_parsed(f, cfg) -> ComputationGraph:
                     "preserve the flattened row order; the HWC->CHW kernel "
                     "permute cannot be applied soundly")
 
-        # NHWC input contract: transpose once on entry per image input
+        # NHWC/NDHWC input contract: transpose once on entry per image
+        # input (channels-last arrays in, channels-first body)
         for iname in inputs:
             if input_nhwc[iname]:
                 node = conf.nodes[iname]
                 prev = node.preprocessors.get(0)
+                perm = ((0, 4, 1, 2, 3)
+                        if input_nhwc[iname] == "ndhwc" else (0, 3, 1, 2))
                 nhwc = Preprocessor("NhwcToNchw",
-                                    lambda x: x.transpose(0, 3, 1, 2),
+                                    lambda x, _p=perm: x.transpose(_p),
                                     conf.node_output_types[iname])
                 if prev is not None:
                     node.preprocessors[0] = Preprocessor(

@@ -119,9 +119,11 @@ class TestFunctionalImport:
         assert float(net.score_value) < first * 0.7
 
     def test_unsupported_layer_raises_cleanly(self, tmp_path):
-        inp = keras.layers.Input((4, 4, 4, 2), name="in0")
-        g = keras.layers.ConvLSTM2D(3, 2, return_sequences=True)(inp)
-        out = keras.layers.GlobalAveragePooling3D()(g)
+        # ConvLSTM2D + rank-4 inputs gained support in round 5;
+        # UnitNormalization remains unmapped
+        inp = keras.layers.Input((6,), name="in0")
+        d = keras.layers.Dense(4)(inp)
+        out = keras.layers.UnitNormalization()(d)
         m = keras.Model(inp, out)
         path = str(tmp_path / "m.h5")
         m.save(path)
@@ -255,3 +257,26 @@ class TestRound5Merges:
         with pytest.raises(UnsupportedKerasLayerError,
                            match="Masking|NotEqual"):
             KerasModelImport.import_keras_model_and_weights(path)
+
+
+class TestRank4Inputs:
+    """Round-5: functional DAGs with NDHWC (video / volumetric) inputs —
+    previously only the Sequential importer accepted rank-4 inputs."""
+
+    def test_conv3d_functional(self, tmp_path):
+        inp = keras.layers.Input((4, 6, 6, 2), name="in0")
+        c = keras.layers.Conv3D(3, 2, activation="relu")(inp)
+        g = keras.layers.GlobalAveragePooling3D()(c)
+        out = keras.layers.Dense(4)(g)
+        m = keras.Model(inp, out)
+        roundtrip(m, {"in0": rng.randn(2, 4, 6, 6, 2).astype(np.float32)},
+                  tmp_path)
+
+    def test_conv_lstm_functional(self, tmp_path):
+        inp = keras.layers.Input((3, 5, 5, 2), name="in0")
+        cl = keras.layers.ConvLSTM2D(4, 3, padding="same",
+                                     return_sequences=False)(inp)
+        g = keras.layers.GlobalAveragePooling2D()(cl)
+        m = keras.Model(inp, g)
+        roundtrip(m, {"in0": rng.randn(2, 3, 5, 5, 2).astype(np.float32)},
+                  tmp_path, atol=5e-4)
