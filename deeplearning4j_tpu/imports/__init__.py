@@ -7,7 +7,10 @@ Reference: nd4j ``samediff-import-{api,tensorflow,onnx}`` + legacy
 (SURVEY.md §2.1, §2.3, §3.4).
 """
 
-from .keras_import import KerasModelImport, UnsupportedKerasLayerError
+from .keras_import import (KerasModelImport, UnsupportedKerasLayerError,
+                           register_custom_layer, register_lambda,
+                           resolve_lambda, unregister_custom_layer,
+                           unregister_lambda)
 from .keras_graph_import import import_functional
 from .onnx_import import (OnnxFrameworkImporter, UnsupportedOnnxOpError,
                           import_onnx, onnx_op, supported_onnx_ops)
@@ -18,6 +21,8 @@ __all__ = [
     "TFGraphMapper", "UnsupportedTFOpError", "import_frozen_tf",
     "supported_tf_ops", "tf_op", "KerasModelImport",
     "UnsupportedKerasLayerError", "import_functional",
+    "register_custom_layer", "unregister_custom_layer",
+    "register_lambda", "unregister_lambda", "resolve_lambda",
     "OnnxFrameworkImporter", "UnsupportedOnnxOpError", "import_onnx",
     "onnx_op", "supported_onnx_ops",
 ]

@@ -1,11 +1,17 @@
-"""Keras h5 import → MultiLayerNetwork.
+"""Keras import → MultiLayerNetwork / ComputationGraph.
 
 Reference: dl4j-modelimport ``org.deeplearning4j.nn.modelimport.keras.
 KerasModelImport`` / ``KerasSequentialModel`` + the ~60 ``KerasLayer``
-mapping classes (SURVEY.md §2.3). The h5 container is read with h5py (the
-reference wraps HDF5 via JavaCPP ``Hdf5Archive``).
+mapping classes (SURVEY.md §2.3). Containers: legacy ``.h5`` (read with
+h5py — the reference wraps HDF5 via JavaCPP ``Hdf5Archive``) AND the
+Keras-3 native ``.keras`` zip (round 5; see ``_read_h5``).
 
-Mapped layer types (round 4: ~45 incl. the functional importer's merges):
+Mapped layer types (round 5: 59 sequential + the functional importer's
+merges — TimeDistributed, Masking (mask threaded to layers AND the
+recurrent loss), Lambda via ``register_lambda``, ConvLSTM2D,
+SeparableConv1D, ThresholdedReLU, GroupNormalization,
+SpatialDropout1D/2D, 3D pad/crop/upsample, Dot/Minimum merges joined in
+round 5; previously:
 Dense, Conv1D/2D/3D, SeparableConv2D, DepthwiseConv2D, Conv2DTranspose,
 Max/AveragePooling1D/2D/3D, GlobalMax/AveragePooling1D/2D/3D, Flatten,
 Dropout, GaussianNoise/GaussianDropout/AlphaDropout, BatchNormalization,
