@@ -487,6 +487,80 @@ def bench_resnet50_predecoded(steps: int, batch: int = 64,
     }
 
 
+def bench_pipeline_smoke(steps: int, batch: int = 64,
+                         steps_per_dispatch: int = 4) -> dict:
+    """Fast CPU-friendly smoke of the shared input/dispatch pipeline
+    (data/pipeline.py): a small MLP trained from an iterator whose final
+    batch is PARTIAL, with padding + async device feed + multi-step
+    dispatch all on. Self-validating: hard-fails unless the retrace
+    counters prove the per-step jit traced at most once and the scan chunk
+    exactly once. The emitted metrics (padded batches, host-wait vs
+    dispatch overlap) are the input-pipeline ledger for BENCH_*.json
+    rounds."""
+    import jax
+
+    from deeplearning4j_tpu.common.profiler import OpProfiler
+    from deeplearning4j_tpu.data import NDArrayDataSetIterator
+    from deeplearning4j_tpu.learning import Nesterovs
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.optimize.listeners import PipelineMetricsListener
+
+    conf = (NeuralNetConfiguration.builder().seed(123)
+            .updater(Nesterovs(learning_rate=0.01, momentum=0.9))
+            .activation("relu").weight_init("xavier").list()
+            .layer(L.DenseLayer(n_out=256))
+            .layer(L.DenseLayer(n_out=128))
+            .layer(L.OutputLayer(n_out=10, loss="mcxent",
+                                 activation="softmax"))
+            .set_input_type(InputType.feed_forward(784)).build())
+    model = MultiLayerNetwork(conf).init()
+    listener = PipelineMetricsListener()
+    model.set_listeners(listener)
+
+    rng = np.random.RandomState(0)
+    n = steps * batch + batch // 2      # the half batch forces a partial tail
+    x = rng.randn(n, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+    it = NDArrayDataSetIterator(x, y, batch_size=batch)
+
+    prof = OpProfiler.get()
+    prof.reset()
+    model.fit(it, epochs=1, steps_per_dispatch=steps_per_dispatch)  # warmup
+    float(model._score_dev)
+    prof.reset()
+    t0 = time.perf_counter()
+    model.fit(it, epochs=1, steps_per_dispatch=steps_per_dispatch)
+    float(model._score_dev)             # value fence
+    dt = time.perf_counter() - t0
+    traces = prof.trace_counts()
+    # counters were reset AFTER the warmup fit: any trace in the timed
+    # window is a retrace of an already-compiled step
+    if traces.get("trace/mln_fit_step", 0) > 0 \
+            or traces.get("trace/mln_fit_chunk", 0) > 0:
+        print(json.dumps({"error": "input pipeline retraced the train step "
+                          "— shape-stable batching is broken",
+                          "traces": traces}))
+        sys.exit(1)
+    images = n + (batch - n % batch) % batch    # padded count actually run
+    return {
+        "metric": "input_pipeline_smoke",
+        "value": images / dt,
+        "unit": "images/sec",
+        "steps_timed": -(-images // batch),
+        "batch": batch,
+        "steps_per_dispatch": steps_per_dispatch,
+        "platform": jax.devices()[0].platform,
+        "traces": traces,
+        "padded_batches": prof.counter_value("pipeline/padded_batches"),
+        "overlap": {k: (round(v, 4) if isinstance(v, float) else v)
+                    for k, v in prof.overlap_stats().items()},
+        "data": "synthetic MLP batches with a partial final batch "
+                "(pipeline padding + async feed + multi-step dispatch)",
+    }
+
+
 def bench_word2vec(steps: int) -> dict:
     """North-star config 4: Word2Vec skip-gram + negative sampling over a
     synthetic zipfian corpus; throughput = corpus words consumed / sec
@@ -756,7 +830,8 @@ def main() -> None:
                         choices=["flagships", "lenet", "resnet50", "bert",
                                  "word2vec", "word2vec-cbow", "word2vec-hs",
                                  "paragraph-vectors", "glove", "fasttext",
-                                 "resnet50-disk", "resnet50-predecoded"])
+                                 "resnet50-disk", "resnet50-predecoded",
+                                 "pipeline-smoke"])
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--batch", type=int, default=None,
                         help="per-config default: resnet50=128, bert=32")
@@ -830,6 +905,8 @@ def main() -> None:
         result = bench_glove(n_words=(args.steps or 50) * 20_000)
     elif args.config == "fasttext":
         result = bench_fasttext(n_words=(args.steps or 20) * 20_000)
+    elif args.config == "pipeline-smoke":
+        result = bench_pipeline_smoke(steps, batch=args.batch or 64)
     elif args.config == "resnet50-disk":
         result = bench_resnet50_disk(steps, batch=args.batch or 64)
     elif args.config == "resnet50-predecoded":

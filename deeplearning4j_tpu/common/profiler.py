@@ -27,6 +27,7 @@ class OpProfiler:
     def __init__(self) -> None:
         self._trace_dir: Optional[str] = None
         self._sections: Dict[str, Dict[str, float]] = {}
+        self._counters: Dict[str, int] = {}
 
     @classmethod
     def get(cls) -> "OpProfiler":
@@ -83,6 +84,45 @@ class OpProfiler:
     def get_statistics(self) -> Dict[str, Dict[str, float]]:
         return {k: dict(v) for k, v in self._sections.items()}
 
+    # --- event counters (compile/retrace accounting) --------------------
+    # The train-step builders bump ``trace/<name>`` INSIDE the function
+    # handed to jax.jit: the Python body only executes while jax traces,
+    # so the counter counts (re)traces — each of which implies an XLA
+    # compile — and stays silent on cached executions. Tests and the bench
+    # assert "one compile per fit config" directly on these.
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def counter_value(self, name: str) -> int:
+        return self._counters.get(name, 0)
+
+    def get_counters(self) -> Dict[str, int]:
+        return dict(self._counters)
+
+    def trace_counts(self) -> Dict[str, int]:
+        """Just the ``trace/*`` counters (the retrace ledger)."""
+        return {k: v for k, v in self._counters.items()
+                if k.startswith("trace/")}
+
+    def overlap_stats(self) -> Dict[str, float]:
+        """Transfer-vs-compute overlap summary for the input pipeline:
+        ``host_wait_s`` is time fit() spent blocked on the next (staged)
+        batch, ``dispatch_s`` is time spent issuing train steps. A healthy
+        overlapped loop keeps host_wait a small fraction of dispatch."""
+        out: Dict[str, float] = {}
+        for sec, key in (("pipeline/next_batch", "host_wait_s"),
+                         ("pipeline/dispatch", "dispatch_s")):
+            s = self._sections.get(sec)
+            if s:
+                out[key] = s["total_s"]
+                out[key.replace("_s", "_count")] = s["count"]
+        if "host_wait_s" in out and "dispatch_s" in out:
+            busy = out["host_wait_s"] + out["dispatch_s"]
+            if busy > 0:
+                out["host_wait_frac"] = out["host_wait_s"] / busy
+        return out
+
     def print_statistics(self) -> str:
         lines = [f"{'section':<32}{'count':>8}{'total ms':>12}"
                  f"{'mean ms':>12}{'max ms':>12}"]
@@ -98,3 +138,4 @@ class OpProfiler:
 
     def reset(self) -> None:
         self._sections.clear()
+        self._counters.clear()

@@ -66,8 +66,11 @@ class DataSet:
                        cut(self.features_mask, n_train, n), cut(self.labels_mask, n_train, n))
         return train, test
 
-    def batch_by(self, batch_size: int) -> Iterator["DataSet"]:
+    def batch_by(self, batch_size: int,
+                 drop_remainder: bool = False) -> Iterator["DataSet"]:
         n = self.num_examples()
+        if drop_remainder:
+            n = (n // batch_size) * batch_size
         for i in range(0, n, batch_size):
             yield DataSet(
                 NDArray(self.features.to_numpy()[i:i + batch_size]),

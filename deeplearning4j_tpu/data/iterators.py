@@ -52,12 +52,13 @@ class NDArrayDataSetIterator(DataSetIterator):
     """Iterate (features, labels) arrays in minibatches."""
 
     def __init__(self, features, labels, batch_size: int, shuffle: bool = False,
-                 seed: int = 123):
+                 seed: int = 123, drop_remainder: bool = False):
         self.features = np.asarray(features.value if isinstance(features, NDArray) else features)
         self.labels = np.asarray(labels.value if isinstance(labels, NDArray) else labels)
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.seed = seed
+        self.drop_remainder = drop_remainder
         self._epoch = 0
 
     def batch(self) -> int:
@@ -68,7 +69,10 @@ class NDArrayDataSetIterator(DataSetIterator):
         if self.shuffle:
             np.random.RandomState(self.seed + self._epoch).shuffle(idx)
         self._epoch += 1
-        for i in range(0, len(idx), self.batch_size):
+        stop = len(idx)
+        if self.drop_remainder:
+            stop = (stop // self.batch_size) * self.batch_size
+        for i in range(0, stop, self.batch_size):
             sel = idx[i:i + self.batch_size]
             yield self._apply_pre(DataSet(self.features[sel], self.labels[sel]))
 

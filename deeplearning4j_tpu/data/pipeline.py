@@ -1,0 +1,288 @@
+"""Shared input/dispatch pipeline for the training loops.
+
+One implementation feeds ``MultiLayerNetwork.fit``, ``ComputationGraph.fit``
+and ``ParallelWrapper.fit`` (SURVEY §3.1's "one compiled train-step per
+minibatch", with the host side around it made shape-stable and overlapped):
+
+- **shape-stable batching** (:func:`stable_batches`): every batch a fit
+  config sees has the SAME leading dimension — the final partial batch is
+  padded to the target size by wrapping real rows, with a per-example
+  weight vector (1 = real, 0 = pad) threaded into the loss so padded rows
+  contribute exactly nothing. One shape ⇒ the jitted train step compiles
+  exactly once per config instead of recompiling on the remainder batch
+  (whole-loop compilation with stable shapes is what keeps a TPU pipeline
+  saturated — cf. arXiv:1810.09868). ``drop_remainder=True`` skips the
+  partial batch instead.
+- **async device feed** (:func:`device_feed`, built on
+  ``common.background.staged_iter``): batch placement (``jax.device_put``
+  or a sharded put) is issued ``depth`` batches ahead of the consumer, so
+  the H2D transfer of batch *n+1* overlaps the device compute of batch
+  *n*; host-side assembly can additionally run on a prefetch thread.
+- **multi-step dispatch** (:func:`chunked`): group K stable batches per
+  Python dispatch; the networks stack them and run a ``lax.scan`` device
+  loop, amortizing Python/dispatch overhead over K steps (the same lever
+  as update-sharding's dispatch amortization, arXiv:2004.13336).
+- **observability**: :func:`timed_iter` feeds the ``pipeline/next_batch``
+  vs ``pipeline/dispatch`` sections of ``common.profiler.OpProfiler``,
+  and the step builders bump ``trace/*`` counters at trace time — tests
+  and the bench assert "one compile per config" on those.
+
+Padding wraps REAL rows (``row[i % n]``) rather than zero-filling:
+zero rows would pollute cross-example statistics (BatchNorm batch stats),
+while wrapped rows keep them in-distribution; the wrapped rows' loss and
+gradient contributions are removed exactly by the example-weight mask.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Iterator, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.background import staged_iter
+from ..common.profiler import OpProfiler
+from ..ndarray.ndarray import NDArray
+from .dataset import DataSet, MultiDataSet
+
+
+def resolve_batch_size(data: Any, batch_size: Optional[int]) -> Optional[int]:
+    """The pipeline's target (padded) batch size. A source that makes its
+    own batches (an iterator reporting ``batch()``) keeps its native size
+    — an explicit ``batch_size`` cannot re-batch an iterator (the pre-
+    pipeline fit ignored it there too) and padding every batch UP to a
+    larger figure would silently multiply per-step FLOPs. The explicit
+    argument applies to sources the pipeline slices itself (DataSet /
+    tuple). None = no stable target; batches pass through unpadded."""
+    b = getattr(data, "batch", None)
+    if callable(b):
+        try:
+            n = b()
+            if n and n > 0:
+                return int(n)
+        except NotImplementedError:
+            pass
+    return int(batch_size) if batch_size else None
+
+
+def iter_datasets(data: Any, batch_size: Optional[int] = None,
+                  allow_multi: bool = False) -> Iterator[Any]:
+    """The one batch-source protocol shared by every fit loop: DataSet
+    iterators (reset + __iter__), a single DataSet (optionally re-batched
+    by ``batch_size``), a (features, labels) tuple, and — for the graph —
+    MultiDataSet."""
+    if isinstance(data, (DataSet, MultiDataSet)):
+        if isinstance(data, MultiDataSet):
+            if not allow_multi:
+                raise TypeError("MultiDataSet requires ComputationGraph.fit")
+            if batch_size is not None:
+                # refusing beats silently training one giant batch
+                raise TypeError(
+                    "a MultiDataSet cannot be re-batched by batch_size; "
+                    "slice it upstream (e.g. an iterator of MultiDataSets) "
+                    "or pass batch_size=None")
+            yield data
+        elif batch_size is None:
+            yield data
+        else:
+            yield from data.batch_by(batch_size)
+        return
+    if hasattr(data, "reset") and hasattr(data, "__iter__"):
+        data.reset()
+        yield from data
+        return
+    if isinstance(data, tuple) and len(data) == 2:
+        yield from iter_datasets(DataSet(data[0], data[1]), batch_size)
+        return
+    raise TypeError(f"cannot iterate data of type {type(data)}")
+
+
+def _wrap_rows(value: jnp.ndarray, idx: np.ndarray) -> jnp.ndarray:
+    return jnp.asarray(value)[idx]
+
+
+def _pad_nd(nd: Optional[NDArray], idx: np.ndarray) -> Optional[NDArray]:
+    if nd is None:
+        return None
+    return NDArray(_wrap_rows(nd.value, idx))
+
+
+def pad_dataset(ds: Any, target: int) -> Tuple[Any, jnp.ndarray]:
+    """Pad ``ds`` (DataSet or MultiDataSet) to ``target`` examples by
+    wrapping real rows; returns ``(padded_ds, w)`` with the example-weight
+    vector ``w`` ([target] float32, 1 = real row, 0 = pad row).
+
+    The padded arrays live where NDArray places them (the jax default
+    device — NDArray converts eagerly, so a host-side gather is not an
+    option here). ParallelWrapper's numpy bind therefore pays one host
+    round-trip per PADDED batch before the sharded placement; keep the
+    batch size a multiple of the worker count so only the final remainder
+    batch pays it."""
+    n = ds.num_examples()
+    if n > target:
+        raise ValueError(f"batch of {n} examples exceeds the pipeline "
+                         f"target batch size {target}")
+    idx = np.arange(target) % n
+    w = jnp.asarray((np.arange(target) < n).astype(np.float32))
+    if isinstance(ds, MultiDataSet):
+        out = MultiDataSet.__new__(MultiDataSet)
+        out.features = [_pad_nd(f, idx) for f in ds.features]
+        out.labels = [_pad_nd(l, idx) for l in ds.labels]
+        out.features_masks = ([_pad_nd(m, idx) for m in ds.features_masks]
+                              if ds.features_masks else None)
+        out.labels_masks = ([_pad_nd(m, idx) for m in ds.labels_masks]
+                            if ds.labels_masks else None)
+        return out, w
+    out = DataSet.__new__(DataSet)
+    out.features = _pad_nd(ds.features, idx)
+    out.labels = _pad_nd(ds.labels, idx)
+    out.features_mask = _pad_nd(ds.features_mask, idx)
+    out.labels_mask = _pad_nd(ds.labels_mask, idx)
+    return out, w
+
+
+def stable_batches(data: Any, batch_size: Optional[int] = None,
+                   pad_partial: bool = True, drop_remainder: bool = False,
+                   round_to_multiple_of: int = 1,
+                   allow_multi: bool = False
+                   ) -> Iterator[Tuple[Any, jnp.ndarray, int]]:
+    """Yield ``(dataset, w, n_real)`` triples with a stable leading
+    dimension. The target size is ``resolve_batch_size(...)`` (falling
+    back to the first batch's size), rounded up to
+    ``round_to_multiple_of`` (ParallelWrapper's worker-count divisibility).
+    Batches already at the target get ``w`` = ones; smaller batches are
+    dropped (``drop_remainder``) or padded with zero-weight wrapped rows;
+    larger batches pass through unpadded (their own ones-``w``) — a
+    mixed-size source degrades to today's per-shape retraces instead of
+    failing."""
+    target = resolve_batch_size(data, batch_size)
+    prof = OpProfiler.get()
+    ones_cache: dict = {}
+
+    def ones_w(n: int) -> jnp.ndarray:
+        if n not in ones_cache:
+            ones_cache[n] = jnp.ones((n,), jnp.float32)
+        return ones_cache[n]
+
+    m = max(1, int(round_to_multiple_of))
+    for ds in iter_datasets(data, batch_size, allow_multi=allow_multi):
+        n = ds.num_examples()
+        if target is None:
+            target = n
+        tgt = -(-target // m) * m
+        if n == tgt:
+            yield ds, ones_w(n), n
+        elif drop_remainder and n < target:
+            # a batch is a droppable REMAINDER only vs the un-rounded
+            # target: full batches merely short of the worker multiple
+            # must still train (padded below), else a batch_size that is
+            # not a multiple of the worker count would drop EVERY batch
+            prof.count("pipeline/dropped_batches")
+            continue
+        elif n > tgt or not pad_partial:
+            # oversize or padding disabled: pass through; round up to the
+            # worker multiple only (the wrapper cannot run otherwise)
+            tgt_n = -(-n // m) * m
+            if tgt_n == n:
+                yield ds, ones_w(n), n
+            else:
+                prof.count("pipeline/padded_batches")
+                padded, w = pad_dataset(ds, tgt_n)
+                yield padded, w, n
+        else:
+            prof.count("pipeline/padded_batches")
+            padded, w = pad_dataset(ds, tgt)
+            yield padded, w, n
+
+
+def device_feed(batches: Iterable, place=None, depth: int = 2,
+                host_prefetch: int = 0) -> Iterator:
+    """Stage ``place(batch)`` (device placement) ``depth`` batches ahead of
+    the consumer — see ``common.background.staged_iter`` for the threading
+    contract. ``depth=0`` disables lookahead (fully serial feed)."""
+    if place is None:
+        place = lambda b: b  # noqa: E731
+    return staged_iter(batches, stage=place, depth=depth,
+                       host_prefetch=host_prefetch)
+
+
+def timed_iter(it: Iterable, section: str = "pipeline/next_batch"):
+    """Yield from ``it`` with each blocking ``next()`` timed into the
+    profiler — the host-wait half of the transfer-vs-compute overlap
+    ledger (the other half is the ``pipeline/dispatch`` section the fit
+    loops record around step dispatch)."""
+    prof = OpProfiler.get()
+    src = iter(it)
+    while True:
+        try:
+            with prof.time_section(section):
+                item = next(src)
+        except StopIteration:
+            return
+        yield item
+
+
+def run_epochs(data: Any, epochs: int, batch_size: Optional[int],
+               pad_partial: bool, drop_remainder: bool, prefetch: int,
+               steps_per_dispatch: int, bind, place, dispatch_one,
+               dispatch_chunk, stackable, on_epoch,
+               round_to_multiple_of: int = 1,
+               allow_multi: bool = False,
+               host_prefetch: int = 0) -> None:
+    """The one training-loop skeleton shared by MultiLayerNetwork.fit,
+    ComputationGraph.fit, and ParallelWrapper.fit: per epoch, stable
+    batches are bound (``bind(ds, w)`` → jit argument tuple), staged
+    ``prefetch`` ahead through ``place``, and dispatched either per step
+    or in ``steps_per_dispatch``-sized chunks — a chunk tail (or a
+    shape-unstable group, per ``stackable``) falls back to the per-step
+    path instead of compiling a second device loop for its length."""
+    k = max(1, int(steps_per_dispatch))
+    for _ in range(max(1, epochs)):
+        bound = (bind(ds, w) for ds, w, _n in
+                 stable_batches(data, batch_size, pad_partial=pad_partial,
+                                drop_remainder=drop_remainder,
+                                round_to_multiple_of=round_to_multiple_of,
+                                allow_multi=allow_multi))
+        feed = timed_iter(device_feed(bound, place=place,
+                                      depth=max(0, int(prefetch)),
+                                      host_prefetch=max(0, int(host_prefetch))))
+        if k == 1:
+            for b in feed:
+                dispatch_one(b)
+        else:
+            for group in chunked(feed, k):
+                if len(group) == k and stackable(group):
+                    dispatch_chunk(group)
+                else:
+                    for b in group:
+                        dispatch_one(b)
+        on_epoch()
+
+
+def note_steps(holder: Any, listeners: Iterable, losses: Iterable) -> None:
+    """Shared post-dispatch bookkeeping for every fit loop: advance the
+    holder's iteration counter, publish the DEVICE loss scalar (listeners
+    sync at their own print/collect boundaries, never here), and notify
+    listeners once per step — identical whether the losses came from one
+    per-step dispatch or a K-step scan chunk."""
+    for loss in losses:
+        holder._iteration += 1
+        holder._score_dev = loss
+        for lst in listeners:
+            lst.iteration_done(holder, holder._iteration, loss)
+
+
+def chunked(it: Iterable, k: int) -> Iterator[List]:
+    """Group ``k`` items per yield for multi-step dispatch; the final
+    group may be shorter (the fit loops run it through the per-step path
+    rather than compiling a second device loop for the tail)."""
+    if k < 1:
+        raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")
+    group: List = []
+    for item in it:
+        group.append(item)
+        if len(group) == k:
+            yield group
+            group = []
+    if group:
+        yield group

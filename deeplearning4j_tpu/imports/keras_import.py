@@ -1146,7 +1146,8 @@ class _SequentialBuilder:
 _SequentialBuilder._SHAPE_PRESERVING = (
     L.BatchNormalization, L.DropoutLayer, L.ActivationLayer, L.PReLULayer,
     L.LayerNormalization, L.AlphaDropoutLayer, L.GaussianDropoutLayer,
-    L.GaussianNoiseLayer)
+    L.GaussianNoiseLayer, L.GroupNormalizationLayer, L.SpatialDropoutLayer,
+    L.ThresholdedReLULayer)
 
 
 def _one(v):

@@ -728,7 +728,7 @@ def _resize(ctx):
     shp = ctx.shape_of_input(0)
     if len(shp) != 4:
         raise UnsupportedOnnxOpError(
-            f"{ctx.node.op}: rank-{len(shp)} input (NCHW images only)",
+            f"{ctx.node.op_type}: rank-{len(shp)} input (NCHW images only)",
             ctx.name)
     n, c, h, w = (int(d) for d in shp)
     sizes = None
@@ -777,6 +777,26 @@ def _resize(ctx):
         if hp and nm not in ("round_prefer_floor", "floor"):
             raise UnsupportedOnnxOpError(
                 f"Resize(nearest, nearest_mode={nm!r})", ctx.name)
+        # asymmetric samples floor(i*scale) exactly (ops/image
+        # resize_nearest with half_pixel_centers=False). The spec-default
+        # round_prefer_floor equals floor iff every sampled coordinate
+        # i*in/out has fractional part <= 1/2 (ties prefer floor) — true
+        # for the classic 2x/integer-downscale cases; gate on that exact
+        # rational test and refuse only genuinely divergent samplings
+        # instead of silently shifting the image
+        if not hp and not ac and nm != "floor":
+            def _rpf_equals_floor(in_sz, out_sz):
+                return all(2 * ((i * in_sz) % out_sz) <= out_sz
+                           for i in range(out_sz))
+
+            if not (nm == "round_prefer_floor"
+                    and _rpf_equals_floor(h, oh)
+                    and _rpf_equals_floor(w, ow)):
+                raise UnsupportedOnnxOpError(
+                    f"Resize(nearest, coordinate_transformation_mode="
+                    f"'asymmetric', nearest_mode={nm!r}) at {h}x{w}->"
+                    f"{oh}x{ow} — the asymmetric path implements floor "
+                    f"sampling, which differs here", ctx.name)
         out = ctx.sd._add_op("resize_nearest", [nhwc], height=oh, width=ow,
                              align_corners=ac, half_pixel_centers=hp)
     elif mode == "linear":

@@ -976,6 +976,17 @@ def _avg_pool(ctx):
 
     shp = ctx.imp.infer_shape(ctx.data_inputs[0], assume_unknown=1)
     hw = shp[2:4] if df == "NCHW" else shp[1:3]
+    # the correction is a host-precomputed per-pixel divisor, so the
+    # SPATIAL dims must be genuinely static: probing with two assumed
+    # values exposes dims that merely inherited the placeholder's unknown
+    # (computing the divisor from an assumed H=W=1 would silently rescale
+    # the whole feature map)
+    shp2 = ctx.imp.infer_shape(ctx.data_inputs[0], assume_unknown=2)
+    if hw != (shp2[2:4] if df == "NCHW" else shp2[1:3]):
+        raise UnsupportedTFOpError(
+            "AvgPool(SAME) exclude-pad correction needs static spatial "
+            "dims, but they are unknown in the graph (unknown batch alone "
+            "is fine) — pass input_shapes={...} to the importer", ctx.name)
     begin, end = _same_pad_begin_end(hw, k, s)
     if not any(begin) and not any(end):
         return pooled

@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..common.profiler import OpProfiler
+from ..data import pipeline as _pipe
 from ..data.dataset import DataSet, MultiDataSet
 from ..ndarray.ndarray import NDArray
 from ..ndarray.rng import get_random
@@ -394,6 +396,7 @@ class ComputationGraph:
         self._epoch = 0
         self._listeners: List[Any] = []
         self._fit_step = None
+        self._chunk_step = None
         self._infer_fn = None
         self._score_dev = None
 
@@ -513,7 +516,7 @@ class ComputationGraph:
 
     # --- loss ------------------------------------------------------------
     def _loss(self, params, states, inputs, labels: Dict[str, jnp.ndarray],
-              masks, training, rng):
+              masks, training, rng, w=None, w_denom=None):
         acts, new_states = self._forward(params, states, inputs, training, rng,
                                          to_preout=True)
         total = 0.0
@@ -528,8 +531,21 @@ class ComputationGraph:
                     jnp.issubdtype(pre.dtype, jnp.floating):
                 pre = pre.astype(jnp.float32)
             mask = masks.get(out_name) if masks else None
-            total = total + node.layer.loss.compute_score(
-                labels[out_name], pre, node.layer.activation, mask, average=True)
+            if w is None:
+                total = total + node.layer.loss.compute_score(
+                    labels[out_name], pre, node.layer.activation, mask,
+                    average=True)
+            else:
+                # example-weighted mean (shape-stable batching, see
+                # multilayer._loss): pad rows carry w=0 and the divisor is
+                # the real example count
+                from .multilayer import _fold_weights
+
+                s = node.layer.loss.compute_score(
+                    labels[out_name], pre, node.layer.activation,
+                    _fold_weights(mask, w), average=False)
+                total = total + s / (w_denom if w_denom is not None
+                                     else jnp.maximum(jnp.sum(w), 1.0))
         gc = self.conf.global_conf
         reg = 0.0
         for lname, lp in params.items():
@@ -585,14 +601,17 @@ class ComputationGraph:
         return inputs, labels, masks
 
     # --- training --------------------------------------------------------
-    def _build_fit_step(self):
+    def _step_core(self):
+        """Single train-step computation, shared by the per-step jit and
+        the multi-step lax.scan dispatch (see multilayer._step_core)."""
         gc = self.conf.global_conf
         updater = gc.updater
 
-        def step(params, states, upd_state, inputs, labels, masks, key, iteration):
+        def core(params, states, upd_state, inputs, labels, masks, key,
+                 iteration, w):
             def loss_fn(p):
                 loss, new_states = self._loss(p, states, inputs, labels, masks,
-                                              True, key)
+                                              True, key, w=w)
                 return loss, new_states
 
             (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -604,14 +623,105 @@ class ComputationGraph:
             new_params, new_upd = updater.apply(grads, upd_state, params, iteration)
             return new_params, new_states, new_upd, loss
 
+        return core
+
+    def _build_fit_step(self):
+        core = self._step_core()
+
+        def step(params, states, upd_state, inputs, labels, masks, key,
+                 iteration, w=None):
+            OpProfiler.get().count("trace/graph_fit_step")
+            return core(params, states, upd_state, inputs, labels, masks,
+                        key, iteration, w)
+
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
-    def fit(self, data, epochs: int = 1) -> None:
+    def _build_chunk_step(self):
+        """steps_per_dispatch=K device loop (see multilayer)."""
+        core = self._step_core()
+
+        def chunk(params, states, upd_state, inputs, labels, masks, keys,
+                  iteration0, ws):
+            OpProfiler.get().count("trace/graph_fit_chunk")
+
+            def body(carry, inp):
+                params, states, upd_state, it = carry
+                ins, lbl, msk, k, w = inp
+                params, states, upd_state, loss = core(
+                    params, states, upd_state, ins, lbl, msk, k, it, w)
+                return (params, states, upd_state, it + 1), loss
+
+            (params, states, upd_state, _), losses = jax.lax.scan(
+                body, (params, states, upd_state, iteration0),
+                (inputs, labels, masks, keys, ws))
+            return params, states, upd_state, losses
+
+        return jax.jit(chunk, donate_argnums=(0, 1, 2))
+
+    def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None,
+            *, pad_partial: Optional[bool] = None,
+            drop_remainder: bool = False, prefetch: int = 2,
+            steps_per_dispatch: int = 1, host_prefetch: int = 0) -> None:
+        """Training loop on the shared input/dispatch pipeline
+        (data/pipeline.py): shape-stable padded batching with the example
+        weight threaded into every output's loss, device placement issued
+        ``prefetch`` batches ahead, and an opt-in ``steps_per_dispatch``
+        lax.scan device loop. See MultiLayerNetwork.fit for knob docs."""
         self._check_init()
         if self._updater_state is None:
             self._updater_state = self.conf.global_conf.updater.init(self._params)
         if self._fit_step is None:
             self._fit_step = self._build_fit_step()
+        if isinstance(data, (DataSet, MultiDataSet)) and batch_size is None:
+            self._fit_serial(data, epochs)
+            return
+        if steps_per_dispatch > 1 and self._chunk_step is None:
+            self._chunk_step = self._build_chunk_step()
+        prof = OpProfiler.get()
+
+        def on_epoch():
+            self._epoch += 1
+            for lst in self._listeners:
+                if hasattr(lst, "epoch_done"):
+                    lst.epoch_done(self, self._epoch)
+
+        _pipe.run_epochs(
+            data, epochs, batch_size,
+            pad_partial=True if pad_partial is None else pad_partial,
+            drop_remainder=drop_remainder, prefetch=prefetch,
+            steps_per_dispatch=steps_per_dispatch,
+            bind=lambda ds, w: self._bind_dataset(ds) + (w,),
+            place=jax.device_put,
+            dispatch_one=lambda b: self._dispatch_one(b, prof),
+            dispatch_chunk=lambda g: self._dispatch_chunk(g, prof),
+            stackable=_chunk_stackable, on_epoch=on_epoch,
+            allow_multi=True, host_prefetch=host_prefetch)
+
+    def _dispatch_one(self, b, prof) -> None:
+        inputs, labels, masks, w = b
+        key = get_random().next_key()
+        with prof.time_section("pipeline/dispatch"):
+            (self._params, self._states, self._updater_state, loss) = \
+                self._fit_step(self._params, self._states, self._updater_state,
+                               inputs, labels, masks, key,
+                               jnp.asarray(self._iteration), w)
+        _pipe.note_steps(self, self._listeners, [loss])
+
+    def _dispatch_chunk(self, group, prof) -> None:
+        stack = lambda col: jax.tree.map(  # noqa: E731
+            lambda *leaves: jnp.stack(leaves), *[b[col] for b in group])
+        inputs, labels, masks = stack(0), stack(1), stack(2)
+        ws = jnp.stack([b[3] for b in group])
+        keys = jnp.stack([get_random().next_key() for _ in group])
+        with prof.time_section("pipeline/dispatch"):
+            (self._params, self._states, self._updater_state, losses) = \
+                self._chunk_step(self._params, self._states,
+                                 self._updater_state, inputs, labels, masks,
+                                 keys, jnp.asarray(self._iteration), ws)
+        _pipe.note_steps(self, self._listeners,
+                         [losses[i] for i in range(len(group))])
+
+    def _fit_serial(self, data, epochs: int = 1) -> None:
         for _ in range(max(1, epochs)):
             for ds in _iter_graph_data(data):
                 inputs, labels, masks = self._bind_dataset(ds)
@@ -621,10 +731,6 @@ class ComputationGraph:
                                    inputs, labels, masks, key,
                                    jnp.asarray(self._iteration))
                 self._iteration += 1
-                # keep the loss on device: forcing float() here would sync the
-                # pipeline every step (costly through the TPU tunnel);
-                # listeners receive the device scalar and sync at their own
-                # print/collect boundaries
                 self._score_dev = loss
                 for lst in self._listeners:
                     lst.iteration_done(self, self._iteration, loss)
@@ -678,12 +784,19 @@ class ComputationGraph:
             raise ValueError("call init() first")
 
 
+def _chunk_stackable(group) -> bool:
+    """Stacking precondition for multi-step dispatch: every batch in the
+    chunk binds the same dict keys with the same array shapes."""
+    def sig(b):
+        def d(m):
+            return tuple(sorted((k, tuple(v.shape)) for k, v in m.items()))
+
+        return d(b[0]), d(b[1]), d(b[2]), tuple(b[3].shape)
+
+    first = sig(group[0])
+    return all(sig(b) == first for b in group[1:])
+
+
 def _iter_graph_data(data):
-    if hasattr(data, "reset") and hasattr(data, "__iter__"):
-        data.reset()
-        yield from data
-        return
-    if isinstance(data, (DataSet, MultiDataSet)):
-        yield data
-        return
-    raise TypeError(f"cannot iterate data of type {type(data)}")
+    # one data protocol for serial and pipelined paths alike
+    yield from _pipe.iter_datasets(data, None, allow_multi=True)

@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..common.profiler import OpProfiler
+from ..data import pipeline as _pipe
 from ..data.dataset import DataSet
 from ..ndarray.ndarray import NDArray
 from ..ndarray.rng import get_random
@@ -37,6 +39,7 @@ class MultiLayerNetwork:
         self._epoch = 0
         self._listeners: List[Any] = []
         self._fit_step = None
+        self._chunk_step = None
         self._tbptt_step = None
         self._infer_fn = None
         self._score_dev = None
@@ -92,6 +95,7 @@ class MultiLayerNetwork:
             raise ValueError(f"param vector length {vec.size} != model params {off}")
         self._params = jax.tree.unflatten(treedef, out)
         self._fit_step = None  # donated buffers were replaced
+        self._chunk_step = None
 
     def param_table(self, layer_idx: int) -> Dict[str, NDArray]:
         return {k: NDArray(v) for k, v in self._params[layer_idx].items()}
@@ -229,7 +233,7 @@ class MultiLayerNetwork:
 
     # --- loss ------------------------------------------------------------
     def _loss(self, params, states, x, labels, mask, training: bool, rng,
-              fmask=None, rnn_states=None):
+              fmask=None, rnn_states=None, w=None, w_denom=None):
         out_layer = self.layers[-1]
         if not hasattr(out_layer, "compute_score"):
             raise ValueError("last layer must be a loss head (OutputLayer/"
@@ -267,7 +271,23 @@ class MultiLayerNetwork:
                 pre = pre.astype(jnp.float32)
         else:
             head_params = params[-1]
-        data_loss = out_layer.compute_score(head_params, pre, labels, mask, average=True)
+        if w is None:
+            data_loss = out_layer.compute_score(head_params, pre, labels,
+                                                mask, average=True)
+        else:
+            # example-weighted mean (shape-stable batching): pad rows carry
+            # w=0, so the weighted sum excludes them exactly and the divisor
+            # is the REAL example count — numerically the same loss the
+            # unpadded batch would produce (sum over reals / n_real).
+            # ``w_denom`` overrides the divisor for SPMD shards, where the
+            # correct denominator is global_real/num_shards so the pmean of
+            # per-shard losses equals the global mean over real examples
+            # (the regularization term stays unscaled either way).
+            total = out_layer.compute_score(head_params, pre, labels,
+                                            _fold_weights(mask, w),
+                                            average=False)
+            data_loss = total / (w_denom if w_denom is not None
+                                 else jnp.maximum(jnp.sum(w), 1.0))
         reg = 0.0
         gc = self.conf.global_conf
         for lp, layer in zip(params, self.layers):
@@ -321,16 +341,19 @@ class MultiLayerNetwork:
         return [i for i, l in enumerate(self.layers)
                 if isinstance(l, L.FrozenLayer)]
 
-    def _build_fit_step(self):
+    def _step_core(self):
+        """The single train-step computation, shared verbatim by the
+        per-step jit and the multi-step ``lax.scan`` dispatch so the two
+        paths cannot drift numerically."""
         gc = self.conf.global_conf
         updater = gc.updater
         frozen = self._frozen_indices()
 
-        def step(params, states, upd_state, x, y, mask, key, iteration,
-                 fmask=None):
+        def core(params, states, upd_state, x, y, mask, key, iteration,
+                 fmask, w):
             def loss_fn(p):
                 loss, new_states = self._loss(p, states, x, y, mask, True,
-                                              key, fmask)
+                                              key, fmask, w=w)
                 return loss, new_states
 
             (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -346,7 +369,43 @@ class MultiLayerNetwork:
             new_params = self._apply_constraints(new_params)
             return new_params, new_states, new_upd, loss
 
+        return core
+
+    def _build_fit_step(self):
+        core = self._step_core()
+
+        def step(params, states, upd_state, x, y, mask, key, iteration,
+                 fmask=None, w=None):
+            OpProfiler.get().count("trace/mln_fit_step")
+            return core(params, states, upd_state, x, y, mask, key,
+                        iteration, fmask, w)
+
         return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _build_chunk_step(self):
+        """Multi-step dispatch (``steps_per_dispatch=K``): one jitted
+        module runs K minibatches through a ``lax.scan`` device loop over
+        the stacked chunk — Python dispatch, listener sync, and H2D fencing
+        amortize over K steps."""
+        core = self._step_core()
+
+        def chunk(params, states, upd_state, xs, ys, masks, keys,
+                  iteration0, fmasks=None, ws=None):
+            OpProfiler.get().count("trace/mln_fit_chunk")
+
+            def body(carry, inp):
+                params, states, upd_state, it = carry
+                x, y, m, k, fm, w = inp
+                params, states, upd_state, loss = core(
+                    params, states, upd_state, x, y, m, k, it, fm, w)
+                return (params, states, upd_state, it + 1), loss
+
+            (params, states, upd_state, _), losses = jax.lax.scan(
+                body, (params, states, upd_state, iteration0),
+                (xs, ys, masks, keys, fmasks, ws))
+            return params, states, upd_state, losses
+
+        return jax.jit(chunk, donate_argnums=(0, 1, 2))
 
     def _apply_constraints(self, params):
         """Project weights after each update (reference BaseConstraint —
@@ -395,15 +454,112 @@ class MultiLayerNetwork:
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
-    def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None) -> None:
+    def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None,
+            *, pad_partial: Optional[bool] = None,
+            drop_remainder: bool = False, prefetch: int = 2,
+            steps_per_dispatch: int = 1, host_prefetch: int = 0) -> None:
         """The north-star loop (SURVEY.md §3.1): per minibatch, ONE compiled
-        train-step executes forward+backward+updater on device."""
+        train-step executes forward+backward+updater on device. The host
+        side runs the shared input/dispatch pipeline (data/pipeline.py):
+
+        - ``pad_partial`` (default on when a target batch size is known):
+          the final partial batch is padded to the configured batch size
+          with a zero example-weight mask threaded into the loss, so the
+          step compiles exactly ONCE per fit config instead of retracing
+          on the remainder shape; ``drop_remainder=True`` skips it instead.
+        - ``prefetch``: device placement of upcoming batches is issued this
+          many batches ahead of compute (double-buffered H2D overlap;
+          0 = serial feed).
+        - ``steps_per_dispatch=K``: run K minibatches per Python dispatch
+          through a ``lax.scan`` device loop, syncing loss/listeners once
+          per chunk.
+        - ``host_prefetch=N`` (opt-in): run batch assembly (slicing,
+          padding, array conversion) on a worker thread through an
+          N-deep queue. Leave 0 through the axon TPU relay — worker-
+          thread jax array creation serializes catastrophically there
+          (see data/record_iterator.py); safe on direct backends.
+
+        NOTE on padding numerics: the padded run is numerically identical
+        to the unpadded masked-loss run for per-example models (pinned
+        bit-for-bit in tests). Layers with CROSS-example statistics
+        (BatchNormalization) see the wrapped pad rows in their batch
+        mean/variance on the final partial batch — the same deliberate
+        policy ParallelWrapper has always used (in-distribution wrapped
+        rows beat zero rows); pass ``drop_remainder=True`` or
+        ``pad_partial=False`` if exact BN parity with the unpadded loop
+        matters more than trace stability.
+        """
         self._check_init()
         if self._updater_state is None:
             self._updater_state = self.conf.global_conf.updater.init(self._params)
         if self._fit_step is None:
             self._fit_step = self._build_fit_step()
 
+        tbptt = self.conf.backprop_type == "TruncatedBPTT"
+        # Single-DataSet/tuple calls with no batch size have one stable
+        # shape by construction (the bench hot loops); TBPTT has its own
+        # segment loop — both stay on the serial path.
+        if tbptt or (isinstance(data, (DataSet, tuple))
+                     and batch_size is None):
+            self._fit_serial(data, epochs, batch_size)
+            return
+        if steps_per_dispatch > 1 and self._chunk_step is None:
+            self._chunk_step = self._build_chunk_step()
+        prof = OpProfiler.get()
+
+        def on_epoch():
+            self._epoch += 1
+            for lst in self._listeners:
+                if hasattr(lst, "epoch_done"):
+                    lst.epoch_done(self, self._epoch)
+
+        _pipe.run_epochs(
+            data, epochs, batch_size,
+            pad_partial=True if pad_partial is None else pad_partial,
+            drop_remainder=drop_remainder, prefetch=prefetch,
+            steps_per_dispatch=steps_per_dispatch,
+            bind=self._bind_batch, place=jax.device_put,
+            dispatch_one=lambda b: self._dispatch_one(b, prof),
+            dispatch_chunk=lambda g: self._dispatch_chunk(g, prof),
+            stackable=_same_shapes, on_epoch=on_epoch,
+            host_prefetch=host_prefetch)
+
+    def _bind_batch(self, ds: DataSet, w):
+        """DataSet → the jit argument tuple (x, y, mask, fmask, w)."""
+        return (jnp.asarray(ds.features.value),
+                jnp.asarray(ds.labels.value),
+                jnp.asarray(ds.labels_mask.value)
+                if ds.labels_mask is not None else None,
+                jnp.asarray(ds.features_mask.value)
+                if ds.features_mask is not None else None,
+                w)
+
+    def _dispatch_one(self, b, prof) -> None:
+        x, y, mask, fmask, w = b
+        key = get_random().next_key()
+        with prof.time_section("pipeline/dispatch"):
+            (self._params, self._states, self._updater_state,
+             loss) = self._fit_step(self._params, self._states,
+                                    self._updater_state, x, y, mask, key,
+                                    jnp.asarray(self._iteration), fmask, w)
+        _pipe.note_steps(self, self._listeners, [loss])
+
+    def _dispatch_chunk(self, group, prof) -> None:
+        xs, ys, masks, fmasks, ws = _stack_batches(group)
+        # keys drawn in batch order — the chunked loop consumes the SAME
+        # rng stream the per-step loop would
+        keys = jnp.stack([get_random().next_key() for _ in group])
+        with prof.time_section("pipeline/dispatch"):
+            (self._params, self._states, self._updater_state,
+             losses) = self._chunk_step(self._params, self._states,
+                                        self._updater_state, xs, ys, masks,
+                                        keys, jnp.asarray(self._iteration),
+                                        fmasks, ws)
+        _pipe.note_steps(self, self._listeners,
+                         [losses[i] for i in range(len(group))])
+
+    def _fit_serial(self, data, epochs: int = 1,
+                    batch_size: Optional[int] = None) -> None:
         tbptt = self.conf.backprop_type == "TruncatedBPTT"
         for _ in range(max(1, epochs)):
             for ds in _iter_data(data, batch_size):
@@ -482,6 +638,7 @@ class MultiLayerNetwork:
                     self._score_dev = loss
             self._params[idx] = lp
             self._fit_step = None
+            self._chunk_step = None
             self._infer_fn = None
 
     def _fit_tbptt(self, x, y, mask, fmask, key):
@@ -615,6 +772,39 @@ class MultiLayerNetwork:
         return net
 
 
+def _fold_weights(mask, w):
+    """Fold per-example weights ``w`` [B] into an (optional) loss mask —
+    the padded-batch contract: pad rows carry w=0, so their per-element
+    loss terms multiply to exactly 0.0."""
+    if mask is None:
+        return w
+    wb = w
+    while wb.ndim < mask.ndim:
+        wb = wb[..., None]
+    return mask * wb
+
+
+def _same_shapes(group) -> bool:
+    """True when every batch tuple in the chunk has identical array shapes
+    (None members must agree too) — the stacking precondition."""
+    def sig(b):
+        return tuple(None if a is None else tuple(a.shape) for a in b)
+
+    first = sig(group[0])
+    return all(sig(b) == first for b in group[1:])
+
+
+def _stack_batches(group):
+    """Stack K batch tuples [(x, y, mask, fmask, w), ...] along a new
+    leading axis for the scan device loop; None columns stay None."""
+    def col(i):
+        if group[0][i] is None:
+            return None
+        return jnp.stack([b[i] for b in group])
+
+    return col(0), col(1), col(2), col(3), col(4)
+
+
 def _normalize_gradients(grads, mode: str, threshold: float):
     mode = mode.lower()
     if mode == "clipelementwiseabsolutevalue":
@@ -634,17 +824,5 @@ def _normalize_gradients(grads, mode: str, threshold: float):
 
 
 def _iter_data(data, batch_size):
-    if hasattr(data, "reset") and hasattr(data, "__iter__"):
-        data.reset()
-        yield from data
-        return
-    if isinstance(data, DataSet):
-        if batch_size is None:
-            yield data
-        else:
-            yield from data.batch_by(batch_size)
-        return
-    if isinstance(data, tuple) and len(data) == 2:
-        yield from _iter_data(DataSet(data[0], data[1]), batch_size)
-        return
-    raise TypeError(f"cannot iterate data of type {type(data)}")
+    # one data protocol for serial and pipelined paths alike
+    yield from _pipe.iter_datasets(data, batch_size)

@@ -242,6 +242,7 @@ class TransferLearningHelper:
             self.model._params[i] = top._params[j]
             self.model._states[i] = top._states[j]
         self.model._fit_step = None
+        self.model._chunk_step = None
         self.model._infer_fn = None
 
     def _top_net(self) -> MultiLayerNetwork:

@@ -34,6 +34,12 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
+# jax.enable_x64 (the public context manager) only exists on newer jax;
+# 0.4.x spells it jax.experimental.enable_x64 — same semantics
+_enable_x64 = getattr(jax, "enable_x64", None)
+if _enable_x64 is None:
+    from jax.experimental import enable_x64 as _enable_x64
+
 from .registry import op
 
 # Tuned on v5e at T=4096 (BASELINE.md): 512/1024 runs 3.4x faster than
@@ -123,7 +129,7 @@ def _fa_forward(q, k, v, scale, causal, block_q, block_k, interpret):
         pltpu.VMEM((block_q, 128), jnp.float32),   # running denominator
         pltpu.VMEM((block_q, d), jnp.float32),     # unnormalized out
     ]
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         o = pl.pallas_call(
             kernel,
             grid=(bh, n_q, n_k),

@@ -1,6 +1,6 @@
 from .listeners import (TrainingListener, ScoreIterationListener, PerformanceListener,
                         EvaluativeListener, CheckpointListener, TimeIterationListener,
-                        CollectScoresIterationListener)
+                        CollectScoresIterationListener, PipelineMetricsListener)
 from .earlystopping import (EarlyStoppingConfiguration, EarlyStoppingResult,
                             EarlyStoppingTrainer, MaxEpochsTerminationCondition,
                             ScoreImprovementEpochTerminationCondition,

@@ -111,6 +111,47 @@ class EvaluativeListener(TrainingListener):
             logger.info("eval at iteration %d: %s=%.4f", iteration, self.metric, value)
 
 
+class PipelineMetricsListener(TrainingListener):
+    """Surfaces the input/dispatch pipeline's observability through the
+    listener bus (the metrics bus, SURVEY §5.5): per-epoch snapshots of the
+    OpProfiler ``trace/*`` compile/retrace counters, the pipeline padding
+    counters, and the transfer-vs-compute overlap ledger
+    (``pipeline/next_batch`` host-wait vs ``pipeline/dispatch`` time).
+
+    The headline assertion it enables: ``trace_count("mln_fit_step") == 1``
+    after an epoch whose final batch was partial — shape-stable batching
+    compiled the step exactly once per fit config."""
+
+    def __init__(self, frequency_epochs: int = 1):
+        self.frequency = max(1, frequency_epochs)
+        self.snapshots: List[dict] = []
+
+    def _profiler(self):
+        from ..common.profiler import OpProfiler
+
+        return OpProfiler.get()
+
+    def epoch_done(self, model, epoch: int) -> None:
+        if epoch % self.frequency:
+            return
+        prof = self._profiler()
+        self.snapshots.append({
+            "epoch": epoch,
+            "traces": prof.trace_counts(),
+            "counters": {k: v for k, v in prof.get_counters().items()
+                         if k.startswith("pipeline/")},
+            "overlap": prof.overlap_stats(),
+        })
+
+    def trace_count(self, step_name: str) -> int:
+        """Current (re)trace count for a step, e.g. ``mln_fit_step``,
+        ``graph_fit_step``, ``pw_fit_step`` or their ``*_chunk`` twins."""
+        return self._profiler().counter_value(f"trace/{step_name}")
+
+    def overlap_stats(self) -> dict:
+        return self._profiler().overlap_stats()
+
+
 class CheckpointListener(TrainingListener):
     """Rolling checkpoints every N iterations/epochs (reference
     CheckpointListener with keepLast retention + checkpoint.json index)."""

@@ -34,6 +34,10 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax < 0.5 has no varying-type system: pvary is the identity there (the
+# rep checker it informs does not exist either)
+_pvary = getattr(lax, "pvary", lambda x, axis_name: x)
+
 
 def stack_stage_params(params_list):
     """[per-stage pytree, ...] → one pytree with leading stage axis."""
@@ -69,8 +73,8 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x: jnp.ndarray,
             nxt = lax.ppermute(out, axis, perm)
             return nxt, out
 
-        act0 = lax.pvary(jnp.zeros((mb,) + x_full.shape[1:], x_full.dtype),
-                         axis)
+        act0 = _pvary(jnp.zeros((mb,) + x_full.shape[1:], x_full.dtype),
+                      axis)
         _, outs = lax.scan(tick, act0, jnp.arange(T))   # [T, mb, ...]
         # microbatch m exits the LAST stage at tick m + S - 1
         final = lax.dynamic_slice_in_dim(outs, S - 1, n_micro, axis=0)
@@ -194,7 +198,7 @@ class HeterogeneousPipeline:
                 nxt = lax.ppermute(out, axis, perm)
                 return nxt, out
 
-            act0 = lax.pvary(jnp.zeros((pad,), jnp.float32), axis)
+            act0 = _pvary(jnp.zeros((pad,), jnp.float32), axis)
             _, outs = lax.scan(tick, act0, jnp.arange(T))
             final = lax.dynamic_slice_in_dim(outs, S - 1, n_micro, axis=0)
             y = final[:, :o_last].reshape((B,) + oshape_last)
@@ -203,8 +207,13 @@ class HeterogeneousPipeline:
 
         from jax.experimental.shard_map import shard_map
 
+        # check_rep=False: the lax.switch over per-stage programs yields
+        # branch outputs whose replication types the jax-0.4 checker cannot
+        # unify (newer jax resolves this through pvary varying types); the
+        # psum at the tail replicates the result regardless
         return shard_map(local, mesh=self.mesh,
-                         in_specs=(P(axis, None), P()), out_specs=P())
+                         in_specs=(P(axis, None), P()), out_specs=P(),
+                         check_rep=False)
 
     def _fns(self, B: int):
         cache = getattr(self, "_jit_cache", None)

@@ -29,6 +29,10 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax < 0.5 has no varying-type system: pvary is the identity there (the
+# collective-type checker it informs does not exist either)
+_pvary = getattr(lax, "pvary", lambda x, axis_name: x)
+
 
 def _block_attend(q, k, v, bias_fn, m_prev, l_prev, o_prev):
     """One online-softmax accumulation step over a K/V block.
@@ -79,9 +83,9 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
 
     # mark the accumulators device-varying so shard_map's collective-type
     # checker accepts them as scan carries alongside the rotating K/V
-    m0 = lax.pvary(jnp.full((b, h, t_local), -jnp.inf, q.dtype), axis_name)
-    l0 = lax.pvary(jnp.zeros((b, h, t_local), q.dtype), axis_name)
-    o0 = lax.pvary(jnp.zeros((b, h, t_local, d), q.dtype), axis_name)
+    m0 = _pvary(jnp.full((b, h, t_local), -jnp.inf, q.dtype), axis_name)
+    l0 = _pvary(jnp.zeros((b, h, t_local), q.dtype), axis_name)
+    o0 = _pvary(jnp.zeros((b, h, t_local, d), q.dtype), axis_name)
 
     def step(carry, i):
         k_blk, v_blk, kv_idx, m, l, o = carry
@@ -119,8 +123,12 @@ def ring_self_attention(x, wq, wk, wv, wo, n_heads: int, mesh: Mesh,
         ctx = ring_attention(q, k, v, seq_axis, causal=causal)
         return jnp.einsum("btd,do->bto", ctx.reshape(b, t, -1), wo)
 
+    # check_rep=False: jax-0.4's replication checker cannot type the ring
+    # scan's rotating K/V carries under differentiation (newer jax resolves
+    # them through pvary varying types); the collective schedule is correct
+    # either way
     fn = shard_map(
         local, mesh=mesh,
         in_specs=(P(None, seq_axis, None), P(), P(), P(), P()),
-        out_specs=P(None, seq_axis, None))
+        out_specs=P(None, seq_axis, None), check_rep=False)
     return jax.jit(fn)(x, wq, wk, wv, wo)

@@ -28,8 +28,11 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
+from ..common.profiler import OpProfiler
+from ..data import pipeline as _pipe
 from ..data.dataset import DataSet
 from ..ndarray.rng import get_random
+from ..nn.multilayer import _same_shapes
 from .accumulator import DenseAllReduceAccumulator, GradientsAccumulator
 from .mesh import make_mesh, shard_batch
 
@@ -82,10 +85,12 @@ class ParallelWrapper:
             return ParallelWrapper(self._model, self._workers, self._mode,
                                    self._accumulator
                                    or DenseAllReduceAccumulator(),
-                                   model_axis=self._model_axis)
+                                   model_axis=self._model_axis,
+                                   prefetch=self._prefetch)
 
     def __init__(self, model, workers: Optional[int], mode: str,
-                 accumulator: GradientsAccumulator, model_axis: int = 1):
+                 accumulator: GradientsAccumulator, model_axis: int = 1,
+                 prefetch: int = 2):
         self.model = model
         n = workers or len(jax.devices())
         if n % model_axis:
@@ -97,14 +102,18 @@ class ParallelWrapper:
         self.model_axis = model_axis
         self.mode = mode
         self.accumulator = accumulator
+        self.prefetch = prefetch
         self._step = None
+        self._chunk_step = None
         self._listeners: List[Any] = []
 
     def set_listeners(self, *ls) -> None:
         self._listeners = list(ls)
 
     # ------------------------------------------------------------------
-    def _build_step(self):
+    def _local_core(self):
+        """The per-shard train step, shared by the per-step shard_map and
+        the steps_per_dispatch scan (one definition, no drift)."""
         model = self.model
         updater = model.conf.global_conf.updater
         acc = self.accumulator
@@ -114,6 +123,15 @@ class ParallelWrapper:
         def local_step(params, states, upd_state, x, y, mask, w, key, it):
             idx = jax.lax.axis_index(axis)
             key = jax.random.fold_in(key, idx)
+            # Per-shard weighted data loss with a GLOBAL divisor: each shard
+            # divides its weighted sum by global_real/num_shards, so the
+            # pmean of per-shard losses (and of their grads) is exactly the
+            # mean over real examples across the whole batch — pad rows
+            # (w=0) contribute nothing and, unlike a whole-loss rescale,
+            # the regularization term is never inflated.
+            n_shards = jax.lax.psum(1.0, axis)
+            real = jax.lax.psum(jnp.sum(w), axis)
+            denom = jnp.maximum(real, 1.0) / n_shards
 
             def loss_fn(p):
                 if is_graph:
@@ -121,15 +139,12 @@ class ParallelWrapper:
                     out_name = model.conf.network_outputs[0]
                     loss, new_states = model._loss(p, states, inputs,
                                                    {out_name: y}, {out_name: mask},
-                                                   True, key)
+                                                   True, key, w=w,
+                                                   w_denom=denom)
                 else:
-                    loss, new_states = model._loss(p, states, x, y, mask, True, key)
-                # The loss mean divides by the PADDED per-shard batch; rescale
-                # so remainder batches match the single-device semantics of
-                # mean-over-real-examples (w: 1=real, 0=pad). Grads scale too.
-                total = w.shape[0] * jax.lax.psum(1.0, axis)
-                real = jax.lax.psum(jnp.sum(w), axis)
-                loss = loss * total / jnp.maximum(real, 1.0)
+                    loss, new_states = model._loss(p, states, x, y, mask,
+                                                   True, key, w=w,
+                                                   w_denom=denom)
                 return loss, new_states
 
             (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -142,6 +157,10 @@ class ParallelWrapper:
             new_params, new_upd = updater.apply(grads, upd_state, params, it)
             return new_params, new_states, new_upd, loss
 
+        return local_step
+
+    def _build_step(self):
+        local_step = self._local_core()
         pspec = self._param_specs()
         uspec = self._upd_specs(pspec)
         sharded = shard_map(
@@ -150,7 +169,49 @@ class ParallelWrapper:
                       P("data"), P(), P()),
             out_specs=(pspec, P(), uspec, P()),
             check_rep=False)
-        return jax.jit(sharded, donate_argnums=(0, 1, 2))
+
+        def step(*args):
+            OpProfiler.get().count("trace/pw_fit_step")
+            return sharded(*args)
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _build_chunk_step(self):
+        """steps_per_dispatch=K: each shard scans its K local slices of the
+        stacked chunk inside ONE SPMD program — the per-step collectives
+        (gradient psum, loss/stats pmean) run inside the scan body, and
+        Python dispatch + listener sync amortize over K steps."""
+        local_step = self._local_core()
+
+        def local_chunk(params, states, upd_state, xs, ys, masks, ws, keys,
+                        it0):
+            def body(carry, inp):
+                params, states, upd_state, it = carry
+                x, y, m, w, k = inp
+                params, states, upd_state, loss = local_step(
+                    params, states, upd_state, x, y, m, w, k, it)
+                return (params, states, upd_state, it + 1), loss
+
+            (params, states, upd_state, _), losses = jax.lax.scan(
+                body, (params, states, upd_state, it0),
+                (xs, ys, masks, ws, keys))
+            return params, states, upd_state, losses
+
+        pspec = self._param_specs()
+        uspec = self._upd_specs(pspec)
+        batch = P(None, "data")   # [K, B, ...]: stack axis whole, B sharded
+        sharded = shard_map(
+            local_chunk, mesh=self.mesh,
+            in_specs=(pspec, P(), uspec, batch, batch, batch, batch, P(),
+                      P()),
+            out_specs=(pspec, P(), uspec, P()),
+            check_rep=False)
+
+        def chunk(*args):
+            OpProfiler.get().count("trace/pw_fit_chunk")
+            return sharded(*args)
+
+        return jax.jit(chunk, donate_argnums=(0, 1, 2))
 
     def _param_specs(self):
         """Per-layer partition specs: replicated except row-sharded
@@ -192,57 +253,93 @@ class ParallelWrapper:
         return {k: (pspec if jax.tree.structure(v) == pstruct else P())
                 for k, v in upd_state.items()}
 
-    def fit(self, data, epochs: int = 1) -> None:
+    def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None,
+            *, pad_partial: Optional[bool] = None,
+            drop_remainder: bool = False, prefetch: Optional[int] = None,
+            steps_per_dispatch: int = 1, host_prefetch: int = 0) -> None:
+        """Data-parallel training on the shared input/dispatch pipeline
+        (data/pipeline.py): batches are padded BOTH to the configured batch
+        size (one compile per fit config) and to a multiple of the worker
+        count (shardability) — padding wraps REAL rows (keeps BatchNorm
+        batch stats sane; zero rows would pollute them) while the zeroed
+        loss-mask and example-weight remove their loss/gradient
+        contributions exactly (see ``_local_core``'s renormalization).
+        Sharded device placement is issued ``prefetch`` batches ahead
+        (default: the builder's ``prefetch_buffer``), and
+        ``steps_per_dispatch=K`` scans K minibatches inside one SPMD
+        dispatch."""
         model = self.model
         model._check_init()
         if model._updater_state is None:
             model._updater_state = model.conf.global_conf.updater.init(model._params)
         if self._step is None:
             self._step = self._build_step()
-        n = self.workers_count
-        for _ in range(max(1, epochs)):
-            for ds in _iter(data):
-                x = np.asarray(ds.features.to_numpy())
-                y = np.asarray(ds.labels.to_numpy())
-                mask = (np.asarray(ds.labels_mask.to_numpy(), np.float32)
-                        if ds.labels_mask is not None
-                        else np.ones((x.shape[0],), np.float32))
-                w = np.ones((x.shape[0],), np.float32)
-                if x.shape[0] % n:
-                    # pad by wrapping REAL rows (keeps BatchNorm batch stats
-                    # sane — zero rows would pollute them) but zero their
-                    # loss-mask and example-weight so padded rows contribute
-                    # nothing to loss/gradients and the loss renormalizes to
-                    # mean-over-real-examples (see local_step)
-                    pad = n - x.shape[0] % n
-                    x = np.concatenate([x, x[:pad]])
-                    y = np.concatenate([y, y[:pad]])
-                    mask = np.concatenate(
-                        [mask, np.zeros((pad,) + mask.shape[1:], mask.dtype)])
-                    w = np.concatenate([w, np.zeros((pad,), np.float32)])
-                xs, ys, ms, ws = shard_batch(self.mesh, x, y, mask, w)
-                key = get_random().next_key()
-                (model._params, model._states, model._updater_state, loss) = \
-                    self._step(model._params, model._states, model._updater_state,
-                               xs, ys, ms, ws, key, jnp.asarray(model._iteration))
-                model._iteration += 1
-                model._score_dev = loss
-                for lst in self._listeners:
-                    lst.iteration_done(model, model._iteration, loss)
+        if steps_per_dispatch > 1 and self._chunk_step is None:
+            self._chunk_step = self._build_chunk_step()
+        prof = OpProfiler.get()
+
+        def on_epoch():
+            model._epoch += 1
+            for lst in self._listeners:
+                if hasattr(lst, "epoch_done"):
+                    lst.epoch_done(model, model._epoch)
+
+        _pipe.run_epochs(
+            data, epochs, batch_size,
+            pad_partial=True if pad_partial is None else pad_partial,
+            drop_remainder=drop_remainder,
+            prefetch=self.prefetch if prefetch is None else prefetch,
+            steps_per_dispatch=steps_per_dispatch,
+            bind=self._bind_batch,
+            place=lambda b: shard_batch(self.mesh, *b),
+            dispatch_one=lambda b: self._dispatch_one(b, prof),
+            dispatch_chunk=lambda g: self._dispatch_chunk(g, prof),
+            stackable=_same_shapes, on_epoch=on_epoch,
+            round_to_multiple_of=self.workers_count,
+            host_prefetch=host_prefetch)
+
+    def _bind_batch(self, ds: DataSet, w):
+        """DataSet → (x, y, mask, w) as HOST arrays. The mask is the RAW
+        labels-mask (ones when absent — shard_map's in_specs need a real
+        array); ``_loss``'s single ``_fold_weights`` application zeroes
+        the pad rows, so w is never applied twice. Staying numpy here
+        matters: the ONLY device placement is the sharded one
+        (``shard_batch`` in the feed) — a jnp conversion first would
+        commit every full batch to device 0 and then reshard it, doubling
+        per-step H2D traffic."""
+        x = ds.features.to_numpy()
+        y = ds.labels.to_numpy()
+        mask = (np.asarray(ds.labels_mask.to_numpy(), np.float32)
+                if ds.labels_mask is not None
+                else np.ones((x.shape[0],), np.float32))
+        return x, y, mask, np.asarray(w, np.float32)
+
+    def _dispatch_one(self, b, prof) -> None:
+        model = self.model
+        xs, ys, ms, ws = b
+        key = get_random().next_key()
+        with prof.time_section("pipeline/dispatch"):
+            (model._params, model._states, model._updater_state, loss) = \
+                self._step(model._params, model._states, model._updater_state,
+                           xs, ys, ms, ws, key, jnp.asarray(model._iteration))
+        _pipe.note_steps(model, self._listeners, [loss])
+
+    def _dispatch_chunk(self, group, prof) -> None:
+        model = self.model
+        # the group's arrays are already SHARDED by the feed's shard_batch:
+        # jnp.stack composes shardings device-side ([K, B, ...] with B
+        # still split over the data axis), matching the chunk in_specs
+        stack = lambda i: jnp.stack([b[i] for b in group])  # noqa: E731
+        keys = jnp.stack([get_random().next_key() for _ in group])
+        with prof.time_section("pipeline/dispatch"):
+            (model._params, model._states, model._updater_state, losses) = \
+                self._chunk_step(model._params, model._states,
+                                 model._updater_state, stack(0), stack(1),
+                                 stack(2), stack(3), keys,
+                                 jnp.asarray(model._iteration))
+        _pipe.note_steps(model, self._listeners,
+                         [losses[i] for i in range(len(group))])
 
     def shutdown(self) -> None:
         self._step = None
-
-
-def _iter(data):
-    if hasattr(data, "reset") and hasattr(data, "__iter__"):
-        data.reset()
-        yield from data
-        return
-    if isinstance(data, DataSet):
-        yield data
-        return
-    if isinstance(data, tuple) and len(data) == 2:
-        yield DataSet(data[0], data[1])
-        return
-    raise TypeError(f"cannot iterate {type(data)}")
+        self._chunk_step = None

@@ -8,6 +8,15 @@ backends; in-process fakes for distribution). Must run before jax initializes.
 
 import os
 
+# The 8-virtual-device request must precede jax backend initialization, and
+# older jax has no jax_num_cpu_devices config — the XLA flag is the portable
+# spelling, so set it before importing jax at all.
+if not os.environ.get("DL4J_TPU_TEST_ON_TPU"):
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
+
 import jax
 
 # The shell pre-sets JAX_PLATFORMS=axon (the tunneled TPU) and the axon plugin
@@ -16,7 +25,10 @@ import jax
 # DL4J_TPU_TEST_ON_TPU=1.
 if not os.environ.get("DL4J_TPU_TEST_ON_TPU"):
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except Exception:
+        pass  # older jax: the XLA_FLAGS fallback above already applied
 
 # fp64 available for gradient checks (reference GradientCheckUtil enforces fp64).
 jax.config.update("jax_enable_x64", True)
