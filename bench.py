@@ -561,6 +561,115 @@ def bench_pipeline_smoke(steps: int, batch: int = 64,
     }
 
 
+def bench_telemetry_smoke(steps: int, batch: int = 64,
+                          steps_per_dispatch: int = 4) -> dict:
+    """CPU-friendly smoke of the in-graph telemetry layer: a LeNet-class
+    conv model (realistic FLOP:param ratio — telemetry cost is O(params)
+    while the step is O(params x batch)) trained from an iterator with a
+    partial final batch, once with telemetry off and once with a
+    TelemetrySink + NanSentinelListener attached. Self-validating
+    hard-fails:
+
+    - any retrace in either timed window (telemetry must not destabilize
+      shapes), checked on BOTH the per-step jit and the
+      ``steps_per_dispatch`` scan chunk;
+    - any delta between the two configs' compile footprints (each must
+      trace each step kind exactly once);
+    - telemetry step-time overhead > 10%.
+
+    Timing methodology: the off/on epochs are INTERLEAVED round-robin and
+    compared by median, so host-load drift (this box swings >20%
+    run-to-run) hits both configs equally instead of masquerading as
+    telemetry overhead. The emitted JSON carries the overlap ledger and
+    the telemetry drain ledger (batched-readback time — the only host
+    sync telemetry pays)."""
+    import statistics as _stats
+
+    import jax
+
+    from deeplearning4j_tpu.common.profiler import OpProfiler
+    from deeplearning4j_tpu.data import NDArrayDataSetIterator
+    from deeplearning4j_tpu.optimize import (NanSentinelListener,
+                                             TelemetrySink)
+    from deeplearning4j_tpu.ui import InMemoryStatsStorage
+
+    rng = np.random.RandomState(0)
+    n = steps * batch + batch // 2      # the half batch forces a partial tail
+    x = rng.randn(n, 1, 28, 28).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+    it = NDArrayDataSetIterator(x, y, batch_size=batch)
+    prof = OpProfiler.get()
+
+    storage = InMemoryStatsStorage()
+    models = {"off": _lenet_model(), "on": _lenet_model()}
+    models["on"].set_listeners(TelemetrySink(storage, drain_every_n=25),
+                               NanSentinelListener("warn", check_every_n=25))
+
+    def fail(msg, **extra):
+        print(json.dumps({"error": msg, **extra}))
+        sys.exit(1)
+
+    # compile footprint: one warmup fit per config on the CHUNKED path
+    # (traces both the per-step jit and the scan chunk); the footprints
+    # must be identical — telemetry rides the same single trace per kind
+    warm = {}
+    for name, model in models.items():
+        prof.reset()
+        model.fit(it, epochs=1, steps_per_dispatch=steps_per_dispatch)
+        float(model._score_dev)
+        warm[name] = prof.trace_counts()
+    if warm["on"] != warm["off"]:
+        fail("telemetry changed the compile footprint (retrace delta)",
+             off_traces=warm["off"], on_traces=warm["on"])
+
+    prof.reset()
+    times = {"off": [], "on": []}
+    for _ in range(5):                  # interleaved rounds
+        for name, model in models.items():
+            t0 = time.perf_counter()
+            model.fit(it, epochs=1,
+                      steps_per_dispatch=steps_per_dispatch)
+            float(model._score_dev)     # value fence
+            times[name].append(time.perf_counter() - t0)
+    hot = prof.trace_counts()
+    if any(hot.values()):
+        fail("train step retraced inside a timed window — telemetry or "
+             "pipeline shape stability is broken", traces=hot)
+    t_off = _stats.median(times["off"])
+    t_on = _stats.median(times["on"])
+    overhead = (t_on - t_off) / t_off
+    if overhead > 0.10:
+        fail(f"telemetry step-time overhead {overhead:.1%} exceeds the 10% "
+             "budget", off_s=round(t_off, 4), on_s=round(t_on, 4),
+             off_times=[round(t, 4) for t in times["off"]],
+             on_times=[round(t, 4) for t in times["on"]])
+    if not storage.series("loss") \
+            or not any(t.startswith("grad_norm/") for t in storage.tags()):
+        fail("telemetry enabled but no grad-norm series reached the "
+             "storage", tags=storage.tags())
+
+    images = n + (batch - n % batch) % batch    # padded count actually run
+    return {
+        "metric": "telemetry_smoke",
+        "value": images / t_on,
+        "unit": "images/sec",
+        "batch": batch,
+        "steps_per_dispatch": steps_per_dispatch,
+        "platform": jax.devices()[0].platform,
+        "traces": warm["on"],
+        "telemetry_overhead_frac": round(overhead, 4),
+        "epoch_s_off_median": round(t_off, 4),
+        "epoch_s_on_median": round(t_on, 4),
+        "overlap": {k: (round(v, 4) if isinstance(v, float) else v)
+                    for k, v in prof.overlap_stats().items()},
+        "telemetry_drain": {k: (round(v, 5) if isinstance(v, float) else v)
+                            for k, v in prof.telemetry_stats().items()},
+        "series_collected": len(storage.tags()),
+        "data": "synthetic LeNet batches with a partial final batch; "
+                "telemetry on vs off interleaved, identical pipeline knobs",
+    }
+
+
 def bench_word2vec(steps: int) -> dict:
     """North-star config 4: Word2Vec skip-gram + negative sampling over a
     synthetic zipfian corpus; throughput = corpus words consumed / sec
@@ -831,7 +940,7 @@ def main() -> None:
                                  "word2vec", "word2vec-cbow", "word2vec-hs",
                                  "paragraph-vectors", "glove", "fasttext",
                                  "resnet50-disk", "resnet50-predecoded",
-                                 "pipeline-smoke"])
+                                 "pipeline-smoke", "telemetry-smoke"])
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--batch", type=int, default=None,
                         help="per-config default: resnet50=128, bert=32")
@@ -907,6 +1016,8 @@ def main() -> None:
         result = bench_fasttext(n_words=(args.steps or 20) * 20_000)
     elif args.config == "pipeline-smoke":
         result = bench_pipeline_smoke(steps, batch=args.batch or 64)
+    elif args.config == "telemetry-smoke":
+        result = bench_telemetry_smoke(steps, batch=args.batch or 64)
     elif args.config == "resnet50-disk":
         result = bench_resnet50_disk(steps, batch=args.batch or 64)
     elif args.config == "resnet50-predecoded":

@@ -123,6 +123,21 @@ class OpProfiler:
                 out["host_wait_frac"] = out["host_wait_s"] / busy
         return out
 
+    def telemetry_stats(self) -> Dict[str, float]:
+        """In-graph-telemetry drain ledger: host time spent in the batched
+        aux readbacks (``telemetry/drain`` sections — the ONLY host sync
+        the telemetry layer pays) plus the drained-step counter. Empty
+        when telemetry never ran."""
+        out: Dict[str, float] = {}
+        s = self._sections.get("telemetry/drain")
+        if s:
+            out = {"drain_s": s["total_s"], "drain_count": s["count"],
+                   "drain_max_s": s["max_s"]}
+        n = self._counters.get("telemetry/drained_steps")
+        if n:
+            out["drained_steps"] = n
+        return out
+
     def print_statistics(self) -> str:
         lines = [f"{'section':<32}{'count':>8}{'total ms':>12}"
                  f"{'mean ms':>12}{'max ms':>12}"]

@@ -22,6 +22,63 @@ def _host_ram_bytes() -> int:
         return 0
 
 
+def _rss_bytes() -> int:
+    """Current process resident set size; without /proc the PEAK RSS is
+    the best portable approximation (ru_maxrss: KiB on Linux, bytes on
+    macOS). 0 when unknowable."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return peak if sys.platform == "darwin" else peak * 1024
+    except Exception:
+        return 0
+
+
+def memory_summary() -> Dict[str, Any]:
+    """Live device/host memory telemetry — cheap enough to poll (the
+    ``UIServer`` ``/api/health`` endpoint and the dashboard's health strip
+    call it per request). Per-device PJRT memory stats, the live-buffer
+    census (``jax.live_arrays``: count + bytes — the leak detector), and
+    host RSS vs total RAM."""
+    out: Dict[str, Any] = {"host": {"ram_bytes": _host_ram_bytes(),
+                                    "rss_bytes": _rss_bytes()}}
+    try:
+        import jax
+
+        devices: List[Dict[str, Any]] = []
+        for d in jax.devices():
+            dev: Dict[str, Any] = {"id": d.id, "platform": d.platform}
+            try:
+                stats = d.memory_stats()
+            except Exception:       # CPU backends have none
+                stats = None
+            if stats:
+                dev["bytes_in_use"] = int(stats.get("bytes_in_use", 0))
+                dev["bytes_limit"] = int(stats.get("bytes_limit", 0))
+                dev["peak_bytes_in_use"] = int(
+                    stats.get("peak_bytes_in_use", 0))
+            devices.append(dev)
+        out["devices"] = devices
+        out["backend"] = jax.default_backend()
+        try:
+            live = jax.live_arrays()
+            out["live_buffers"] = {
+                "count": len(live),
+                "bytes": int(sum(int(getattr(a, "nbytes", 0) or 0)
+                                 for a in live))}
+        except Exception:           # pragma: no cover - older jax
+            pass
+    except Exception as e:          # pragma: no cover - jax init failure
+        out["jax_error"] = str(e)
+    return out
+
+
 def gather() -> Dict[str, Any]:
     """Structured environment snapshot (JSON-serializable)."""
     info: Dict[str, Any] = {
@@ -92,5 +149,6 @@ class SystemInfo:
 
     gather = staticmethod(gather)
     dump = staticmethod(dump)
+    memory_summary = staticmethod(memory_summary)
     # reference spelling
     getSystemInfo = staticmethod(dump)

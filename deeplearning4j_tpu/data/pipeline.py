@@ -37,6 +37,7 @@ from __future__ import annotations
 
 from typing import Any, Iterable, Iterator, List, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -259,17 +260,59 @@ def run_epochs(data: Any, epochs: int, batch_size: Optional[int],
         on_epoch()
 
 
-def note_steps(holder: Any, listeners: Iterable, losses: Iterable) -> None:
+def note_steps(holder: Any, listeners: Iterable, losses,
+               auxes: Optional[List] = None) -> None:
     """Shared post-dispatch bookkeeping for every fit loop: advance the
     holder's iteration counter, publish the DEVICE loss scalar (listeners
     sync at their own print/collect boundaries, never here), and notify
     listeners once per step — identical whether the losses came from one
-    per-step dispatch or a K-step scan chunk."""
-    for loss in losses:
+    per-step dispatch or a K-step scan chunk. ``auxes`` (aligned with
+    ``losses``) carries the in-graph telemetry pytrees of DEVICE values
+    when the step was built with telemetry; listeners exposing
+    ``telemetry_done`` receive them un-synced (TelemetrySink /
+    NanSentinelListener batch their own readbacks)."""
+    for i, loss in enumerate(losses):
         holder._iteration += 1
         holder._score_dev = loss
+        aux = auxes[i] if auxes is not None else None
         for lst in listeners:
             lst.iteration_done(holder, holder._iteration, loss)
+            if aux is not None:
+                cb = getattr(lst, "telemetry_done", None)
+                if cb is not None:
+                    cb(holder, holder._iteration, aux)
+
+
+def unstack_aux(auxes, k: int) -> List:
+    """Split a scan-stacked telemetry aux pytree ([K, ...] leaves) into K
+    per-step pytrees of device values (lazy slices — no host sync)."""
+    return [jax.tree.map(lambda a, _i=i: a[_i], auxes) for i in range(k)]
+
+
+def note_dispatch(holder: Any, listeners: Iterable, out, telemetry: bool,
+                  k: Optional[int] = None) -> None:
+    """Decode ONE train-step (``k=None``) or scan-chunk (``k`` steps)
+    output — a 4-tuple, or a 5-tuple carrying the telemetry aux when the
+    step was built with it — publish the carried state onto ``holder``,
+    then run :func:`note_steps`. The single place the step builders'
+    return contract is unpacked; all three networks' dispatchers share it.
+
+    Ordering matters: the holder's ``_params``/``_states``/
+    ``_updater_state`` MUST be replaced before listeners run — the step
+    donated the old buffers, so a listener reading ``model._params``
+    during ``iteration_done`` (StatsListener, EvaluativeListener) would
+    otherwise touch deleted arrays."""
+    params, states, upd = out[0], out[1], out[2]
+    holder._params, holder._states, holder._updater_state = \
+        params, states, upd
+    if k is None:
+        loss = out[3]
+        note_steps(holder, listeners, [loss],
+                   [out[4]] if telemetry else None)
+        return
+    losses = out[3]
+    note_steps(holder, listeners, [losses[i] for i in range(k)],
+               unstack_aux(out[4], k) if telemetry else None)
 
 
 def chunked(it: Iterable, k: int) -> Iterator[List]:

@@ -395,6 +395,7 @@ class ComputationGraph:
         self._iteration = 0
         self._epoch = 0
         self._listeners: List[Any] = []
+        self._telemetry = None
         self._fit_step = None
         self._chunk_step = None
         self._infer_fn = None
@@ -426,6 +427,15 @@ class ComputationGraph:
 
     def set_listeners(self, *listeners) -> None:
         self._listeners = list(listeners)
+        from ..optimize.telemetry import config_for
+
+        cfg = config_for(self._listeners)
+        if cfg != self._telemetry:
+            # in-graph telemetry is a build-time property of the jitted
+            # step (see MultiLayerNetwork.set_listeners)
+            self._telemetry = cfg
+            self._fit_step = None
+            self._chunk_step = None
 
     def num_params(self) -> int:
         return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(self._params))
@@ -606,6 +616,8 @@ class ComputationGraph:
         the multi-step lax.scan dispatch (see multilayer._step_core)."""
         gc = self.conf.global_conf
         updater = gc.updater
+        tele = self._telemetry
+        from ..optimize import telemetry as _tel
 
         def core(params, states, upd_state, inputs, labels, masks, key,
                  iteration, w):
@@ -621,7 +633,15 @@ class ComputationGraph:
                 grads = _normalize_gradients(grads, gc.grad_normalization,
                                              gc.grad_norm_threshold)
             new_params, new_upd = updater.apply(grads, upd_state, params, iteration)
-            return new_params, new_states, new_upd, loss
+            if tele is None:
+                return new_params, new_states, new_upd, loss
+            # per-node stats in sorted node-name order (telemetry.groups)
+            aux = _tel.layer_stats(params, new_params, grads, loss)
+            if tele.nan_guard:
+                aux, new_params, new_states, new_upd = _tel.apply_nan_guard(
+                    aux, new_params, params, new_states, states, new_upd,
+                    upd_state)
+            return new_params, new_states, new_upd, loss, aux
 
         return core
 
@@ -639,6 +659,7 @@ class ComputationGraph:
     def _build_chunk_step(self):
         """steps_per_dispatch=K device loop (see multilayer)."""
         core = self._step_core()
+        tele = self._telemetry
 
         def chunk(params, states, upd_state, inputs, labels, masks, keys,
                   iteration0, ws):
@@ -647,14 +668,20 @@ class ComputationGraph:
             def body(carry, inp):
                 params, states, upd_state, it = carry
                 ins, lbl, msk, k, w = inp
-                params, states, upd_state, loss = core(
-                    params, states, upd_state, ins, lbl, msk, k, it, w)
-                return (params, states, upd_state, it + 1), loss
+                out = core(params, states, upd_state, ins, lbl, msk, k, it, w)
+                if tele is None:
+                    params, states, upd_state, loss = out
+                    return (params, states, upd_state, it + 1), loss
+                params, states, upd_state, loss, aux = out
+                return (params, states, upd_state, it + 1), (loss, aux)
 
-            (params, states, upd_state, _), losses = jax.lax.scan(
+            (params, states, upd_state, _), ys_out = jax.lax.scan(
                 body, (params, states, upd_state, iteration0),
                 (inputs, labels, masks, keys, ws))
-            return params, states, upd_state, losses
+            if tele is None:
+                return params, states, upd_state, ys_out
+            losses, auxes = ys_out
+            return params, states, upd_state, losses, auxes
 
         return jax.jit(chunk, donate_argnums=(0, 1, 2))
 
@@ -701,11 +728,11 @@ class ComputationGraph:
         inputs, labels, masks, w = b
         key = get_random().next_key()
         with prof.time_section("pipeline/dispatch"):
-            (self._params, self._states, self._updater_state, loss) = \
-                self._fit_step(self._params, self._states, self._updater_state,
-                               inputs, labels, masks, key,
-                               jnp.asarray(self._iteration), w)
-        _pipe.note_steps(self, self._listeners, [loss])
+            out = self._fit_step(self._params, self._states,
+                                 self._updater_state, inputs, labels, masks,
+                                 key, jnp.asarray(self._iteration), w)
+        _pipe.note_dispatch(self, self._listeners, out,
+                            self._telemetry is not None)
 
     def _dispatch_chunk(self, group, prof) -> None:
         stack = lambda col: jax.tree.map(  # noqa: E731
@@ -714,26 +741,23 @@ class ComputationGraph:
         ws = jnp.stack([b[3] for b in group])
         keys = jnp.stack([get_random().next_key() for _ in group])
         with prof.time_section("pipeline/dispatch"):
-            (self._params, self._states, self._updater_state, losses) = \
-                self._chunk_step(self._params, self._states,
-                                 self._updater_state, inputs, labels, masks,
-                                 keys, jnp.asarray(self._iteration), ws)
-        _pipe.note_steps(self, self._listeners,
-                         [losses[i] for i in range(len(group))])
+            out = self._chunk_step(self._params, self._states,
+                                   self._updater_state, inputs, labels, masks,
+                                   keys, jnp.asarray(self._iteration), ws)
+        _pipe.note_dispatch(self, self._listeners, out,
+                            self._telemetry is not None, len(group))
 
     def _fit_serial(self, data, epochs: int = 1) -> None:
         for _ in range(max(1, epochs)):
             for ds in _iter_graph_data(data):
                 inputs, labels, masks = self._bind_dataset(ds)
                 key = get_random().next_key()
-                (self._params, self._states, self._updater_state, loss) = \
-                    self._fit_step(self._params, self._states, self._updater_state,
-                                   inputs, labels, masks, key,
-                                   jnp.asarray(self._iteration))
-                self._iteration += 1
-                self._score_dev = loss
-                for lst in self._listeners:
-                    lst.iteration_done(self, self._iteration, loss)
+                out = self._fit_step(self._params, self._states,
+                                     self._updater_state, inputs, labels,
+                                     masks, key,
+                                     jnp.asarray(self._iteration))
+                _pipe.note_dispatch(self, self._listeners, out,
+                                    self._telemetry is not None)
             self._epoch += 1
             for lst in self._listeners:
                 if hasattr(lst, "epoch_done"):

@@ -38,6 +38,7 @@ class MultiLayerNetwork:
         self._iteration = 0
         self._epoch = 0
         self._listeners: List[Any] = []
+        self._telemetry = None
         self._fit_step = None
         self._chunk_step = None
         self._tbptt_step = None
@@ -70,6 +71,18 @@ class MultiLayerNetwork:
 
     def set_listeners(self, *listeners) -> None:
         self._listeners = list(listeners)
+        from ..optimize.telemetry import config_for
+
+        cfg = config_for(self._listeners)
+        if cfg != self._telemetry:
+            # telemetry is a build-time property of the jitted step: the
+            # aux pytree is computed IN-GRAPH, so flipping it rebuilds the
+            # step exactly once (trace/<step> stays 1 per fit config) and
+            # adds zero per-iteration host syncs
+            self._telemetry = cfg
+            self._fit_step = None
+            self._chunk_step = None
+            self._tbptt_step = None
 
     setListeners = set_listeners
 
@@ -344,10 +357,15 @@ class MultiLayerNetwork:
     def _step_core(self):
         """The single train-step computation, shared verbatim by the
         per-step jit and the multi-step ``lax.scan`` dispatch so the two
-        paths cannot drift numerically."""
+        paths cannot drift numerically. When telemetry is enabled the core
+        additionally returns the in-graph aux pytree (per-layer grad/
+        update/param norms, update:param ratio, non-finite counts — see
+        optimize.telemetry) computed inside the same compiled module."""
         gc = self.conf.global_conf
         updater = gc.updater
         frozen = self._frozen_indices()
+        tele = self._telemetry
+        from ..optimize import telemetry as _tel
 
         def core(params, states, upd_state, x, y, mask, key, iteration,
                  fmask, w):
@@ -367,7 +385,14 @@ class MultiLayerNetwork:
                 # side effects (weight decay, momentum drift)
                 new_params[i] = params[i]
             new_params = self._apply_constraints(new_params)
-            return new_params, new_states, new_upd, loss
+            if tele is None:
+                return new_params, new_states, new_upd, loss
+            aux = _tel.layer_stats(params, new_params, grads, loss)
+            if tele.nan_guard:
+                aux, new_params, new_states, new_upd = _tel.apply_nan_guard(
+                    aux, new_params, params, new_states, states, new_upd,
+                    upd_state)
+            return new_params, new_states, new_upd, loss, aux
 
         return core
 
@@ -388,6 +413,7 @@ class MultiLayerNetwork:
         the stacked chunk — Python dispatch, listener sync, and H2D fencing
         amortize over K steps."""
         core = self._step_core()
+        tele = self._telemetry
 
         def chunk(params, states, upd_state, xs, ys, masks, keys,
                   iteration0, fmasks=None, ws=None):
@@ -396,14 +422,21 @@ class MultiLayerNetwork:
             def body(carry, inp):
                 params, states, upd_state, it = carry
                 x, y, m, k, fm, w = inp
-                params, states, upd_state, loss = core(
-                    params, states, upd_state, x, y, m, k, it, fm, w)
-                return (params, states, upd_state, it + 1), loss
+                out = core(params, states, upd_state, x, y, m, k, it, fm, w)
+                if tele is None:
+                    params, states, upd_state, loss = out
+                    return (params, states, upd_state, it + 1), loss
+                params, states, upd_state, loss, aux = out
+                # aux rides the scan's stacked outputs: [K, ...] per leaf
+                return (params, states, upd_state, it + 1), (loss, aux)
 
-            (params, states, upd_state, _), losses = jax.lax.scan(
+            (params, states, upd_state, _), ys_out = jax.lax.scan(
                 body, (params, states, upd_state, iteration0),
                 (xs, ys, masks, keys, fmasks, ws))
-            return params, states, upd_state, losses
+            if tele is None:
+                return params, states, upd_state, ys_out
+            losses, auxes = ys_out
+            return params, states, upd_state, losses, auxes
 
         return jax.jit(chunk, donate_argnums=(0, 1, 2))
 
@@ -434,6 +467,8 @@ class MultiLayerNetwork:
         gc = self.conf.global_conf
         updater = gc.updater
         frozen = self._frozen_indices()
+        tele = self._telemetry
+        from ..optimize import telemetry as _tel
 
         def step(params, states, upd_state, rnn_states, x, y, mask, key,
                  iteration, fmask=None):
@@ -450,7 +485,19 @@ class MultiLayerNetwork:
             for i in frozen:
                 new_params[i] = params[i]
             new_params = self._apply_constraints(new_params)
-            return new_params, new_states, new_upd, new_rnn, loss
+            if tele is None:
+                return new_params, new_states, new_upd, new_rnn, loss
+            aux = _tel.layer_stats(params, new_params, grads, loss)
+            if tele.nan_guard:
+                aux, new_params, new_states, new_upd = _tel.apply_nan_guard(
+                    aux, new_params, params, new_states, states, new_upd,
+                    upd_state)
+                # the recurrent carries of a skipped segment are poisoned
+                # too — restore them alongside the params
+                ok = aux["skipped"] == 0
+                new_rnn = jax.tree.map(lambda n, o: jnp.where(ok, n, o),
+                                       new_rnn, rnn_states)
+            return new_params, new_states, new_upd, new_rnn, loss, aux
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
@@ -538,11 +585,11 @@ class MultiLayerNetwork:
         x, y, mask, fmask, w = b
         key = get_random().next_key()
         with prof.time_section("pipeline/dispatch"):
-            (self._params, self._states, self._updater_state,
-             loss) = self._fit_step(self._params, self._states,
-                                    self._updater_state, x, y, mask, key,
-                                    jnp.asarray(self._iteration), fmask, w)
-        _pipe.note_steps(self, self._listeners, [loss])
+            out = self._fit_step(self._params, self._states,
+                                 self._updater_state, x, y, mask, key,
+                                 jnp.asarray(self._iteration), fmask, w)
+        _pipe.note_dispatch(self, self._listeners, out,
+                            self._telemetry is not None)
 
     def _dispatch_chunk(self, group, prof) -> None:
         xs, ys, masks, fmasks, ws = _stack_batches(group)
@@ -550,13 +597,12 @@ class MultiLayerNetwork:
         # rng stream the per-step loop would
         keys = jnp.stack([get_random().next_key() for _ in group])
         with prof.time_section("pipeline/dispatch"):
-            (self._params, self._states, self._updater_state,
-             losses) = self._chunk_step(self._params, self._states,
-                                        self._updater_state, xs, ys, masks,
-                                        keys, jnp.asarray(self._iteration),
-                                        fmasks, ws)
-        _pipe.note_steps(self, self._listeners,
-                         [losses[i] for i in range(len(group))])
+            out = self._chunk_step(self._params, self._states,
+                                   self._updater_state, xs, ys, masks,
+                                   keys, jnp.asarray(self._iteration),
+                                   fmasks, ws)
+        _pipe.note_dispatch(self, self._listeners, out,
+                            self._telemetry is not None, len(group))
 
     def _fit_serial(self, data, epochs: int = 1,
                     batch_size: Optional[int] = None) -> None:
@@ -570,21 +616,20 @@ class MultiLayerNetwork:
                 fmask = (jnp.asarray(ds.features_mask.value)
                          if ds.features_mask is not None else None)
                 key = get_random().next_key()
+                # device scalars throughout; float() only on access (avoids
+                # per-step sync). Listeners get the device values too and
+                # sync only at their own print/collect/drain boundaries.
                 if tbptt and x.ndim == 3:
-                    loss = self._fit_tbptt(x, y, mask, fmask, key)
+                    loss, aux = self._fit_tbptt(x, y, mask, fmask, key)
+                    _pipe.note_steps(self, self._listeners, [loss],
+                                     [aux] if aux is not None else None)
                 else:
-                    (self._params, self._states, self._updater_state,
-                     loss) = self._fit_step(self._params, self._states,
-                                            self._updater_state, x, y, mask,
-                                            key, jnp.asarray(self._iteration),
-                                            fmask)
-                self._iteration += 1
-                # device scalar; float() only on access (avoids per-step sync).
-                # Listeners get the device scalar too and sync only at their
-                # own print/collect boundaries.
-                self._score_dev = loss
-                for lst in self._listeners:
-                    lst.iteration_done(self, self._iteration, loss)
+                    out = self._fit_step(self._params, self._states,
+                                         self._updater_state, x, y, mask,
+                                         key, jnp.asarray(self._iteration),
+                                         fmask)
+                    _pipe.note_dispatch(self, self._listeners, out,
+                                        self._telemetry is not None)
             self._epoch += 1
             for lst in self._listeners:
                 if hasattr(lst, "epoch_done"):
@@ -652,18 +697,36 @@ class MultiLayerNetwork:
                           or self.conf.global_conf.dtype)
         rnn = [l.init_rnn_state(x.shape[0], dtype) if l.is_rnn() else None
                for l in self.layers]
-        loss = None
+        loss, aux, seg_aux = None, None, None
         for s0 in range(0, T, k):
             seg = slice(s0, min(s0 + k, T))
             key, sub = jax.random.split(key)
-            (self._params, self._states, self._updater_state, rnn,
-             loss) = self._tbptt_step(
+            out = self._tbptt_step(
                 self._params, self._states, self._updater_state, rnn,
                 x[:, seg], y[:, seg] if y.ndim == 3 else y,
                 mask[:, seg] if mask is not None and mask.ndim >= 2 else mask,
                 sub, jnp.asarray(self._iteration),
                 fmask[:, seg] if fmask is not None else None)
-        return loss
+            if self._telemetry is not None:
+                (self._params, self._states, self._updater_state, rnn,
+                 loss, seg_aux) = out
+                if aux is None:
+                    aux = dict(seg_aux)
+                else:
+                    # norms report the FINAL segment (the one the carried
+                    # params came from), but the NaN evidence accumulates
+                    # across segments — a poisoned middle segment must not
+                    # vanish from the iteration's aux or the NanSentinel
+                    # would miss it
+                    prev = aux
+                    aux = dict(seg_aux)
+                    for k_ in ("nonfinite", "nonfinite_total", "skipped"):
+                        if k_ in seg_aux:
+                            aux[k_] = prev[k_] + seg_aux[k_]
+            else:
+                (self._params, self._states, self._updater_state, rnn,
+                 loss) = out
+        return loss, aux
 
     # --- streaming inference (reference: MultiLayerNetwork.rnnTimeStep
     # with its per-layer stateMap) ----------------------------------------

@@ -1,6 +1,7 @@
 from .listeners import (TrainingListener, ScoreIterationListener, PerformanceListener,
                         EvaluativeListener, CheckpointListener, TimeIterationListener,
                         CollectScoresIterationListener, PipelineMetricsListener)
+from .telemetry import (TelemetryConfig, TelemetrySink, NanSentinelListener)
 from .earlystopping import (EarlyStoppingConfiguration, EarlyStoppingResult,
                             EarlyStoppingTrainer, MaxEpochsTerminationCondition,
                             ScoreImprovementEpochTerminationCondition,

@@ -105,8 +105,26 @@ class EvaluativeListener(TrainingListener):
 
     def iteration_done(self, model, iteration, score):
         if iteration % self.frequency == 0:
-            ev = model.evaluate(self.data)
-            value = getattr(ev, self.metric)()
+            try:
+                ev = model.evaluate(self.data)
+            except Exception:
+                # a bad holdout batch (shape drift, corrupt record, OOM on
+                # the eval path) must not kill a long training run — log
+                # and resume; the next boundary retries
+                logger.warning("EvaluativeListener: evaluation failed at "
+                               "iteration %d; skipping this boundary",
+                               iteration, exc_info=True)
+                return
+            # a misconfigured metric NAME is a config error, not a bad
+            # batch — resolve it unguarded so the typo fails fast
+            metric_fn = getattr(ev, self.metric)
+            try:
+                value = metric_fn()
+            except Exception:
+                logger.warning("EvaluativeListener: %s computation failed "
+                               "at iteration %d; skipping this boundary",
+                               self.metric, iteration, exc_info=True)
+                return
             self.history.append((iteration, value))
             logger.info("eval at iteration %d: %s=%.4f", iteration, self.metric, value)
 
@@ -141,6 +159,7 @@ class PipelineMetricsListener(TrainingListener):
             "counters": {k: v for k, v in prof.get_counters().items()
                          if k.startswith("pipeline/")},
             "overlap": prof.overlap_stats(),
+            "telemetry": prof.telemetry_stats(),
         })
 
     def trace_count(self, step_name: str) -> int:

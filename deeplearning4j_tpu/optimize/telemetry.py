@@ -1,0 +1,312 @@
+"""In-graph training telemetry: the device half of the metrics bus.
+
+Reference: deeplearning4j-ui ``StatsListener`` streams per-layer param/
+gradient/update statistics and update:param ratios into ``StatsStorage``
+(SURVEY §2.3/§5.5), and nd4j's ``OpProfiler`` NAN_PANIC halts on the first
+non-finite op output. Both are host-side observers there — every statistic
+costs a device→host sync and NAN_PANIC costs per-op checks.
+
+The TPU shape inverts this: the statistics are computed INSIDE the jitted
+train step (``layer_stats`` below), so XLA fuses them with the backward
+pass — per-layer gradient norm, update norm, param norm, update:param
+ratio, and a non-finite element count come out as a small auxiliary pytree
+of device scalars/vectors alongside the loss. Enabling telemetry therefore
+adds ZERO host syncs and ZERO extra compiles to the hot loop: the step is
+(re)built once with the aux outputs and ``trace/<step>`` stays 1 per fit
+config; under ``ParallelWrapper`` the counts are psum'd with the same
+collectives as the weight update, and under ``steps_per_dispatch`` chunks
+the aux is stacked through the ``lax.scan`` device loop.
+
+Host side, two listeners drain the aux asynchronously:
+
+- :class:`TelemetrySink` buffers the device pytrees and every
+  ``drain_every_n`` iterations does ONE batched ``jax.device_get`` into a
+  ``StatsStorage`` backend (in-memory / JSONL / TensorBoard) — the same
+  three-line attach as ``StatsListener``.
+- :class:`NanSentinelListener` is the graded NAN_PANIC analog: it inspects
+  the non-finite counts within one drain window and, per policy, warns,
+  skips the poisoned update (applied in-graph via :func:`apply_nan_guard`:
+  the pre-step param/updater/state copies already live in the graph, so
+  the update is dropped with a ``where`` — params stay finite and equal to
+  the pre-NaN step), or raises with the offending layer named.
+
+Attaching either listener through ``set_listeners`` enables telemetry
+automatically (``wants_telemetry``); the networks rebuild their step once.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..common.profiler import OpProfiler
+from .listeners import TrainingListener
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+@dataclass(frozen=True)
+class TelemetryConfig:
+    """Build-time switch captured by the train-step builders. ``nan_guard``
+    additionally compiles the skip-update policy into the step (see
+    :func:`apply_nan_guard`)."""
+
+    nan_guard: bool = False
+
+
+def config_for(listeners) -> Optional[TelemetryConfig]:
+    """The telemetry config a listener set implies (None = aux disabled).
+    Listeners opt in with a ``wants_telemetry`` attribute; a skip-policy
+    ``NanSentinelListener`` additionally sets ``wants_nan_guard``."""
+    if not any(getattr(l, "wants_telemetry", False) for l in listeners):
+        return None
+    return TelemetryConfig(nan_guard=any(getattr(l, "wants_nan_guard", False)
+                                         for l in listeners))
+
+
+# --- in-graph statistics (called inside the jitted step) --------------------
+
+def groups(params) -> List[Any]:
+    """Per-layer param subtrees in the canonical telemetry order: list
+    index for MultiLayerNetwork-style param lists, sorted node name for
+    ComputationGraph-style dicts — must match :func:`layer_names`."""
+    if isinstance(params, dict):
+        return [params[k] for k in sorted(params)]
+    return list(params)
+
+
+def layer_names(model) -> List[str]:
+    """Host-side labels for the aux vectors' layer axis."""
+    conf = getattr(model, "conf", None)
+    layers = getattr(conf, "layers", None)
+    if layers is not None:
+        return [f"{i}_{type(l).__name__}" for i, l in enumerate(layers)]
+    params = getattr(model, "_params", None)
+    if isinstance(params, dict):
+        return sorted(params)
+    return []
+
+
+def _sumsq(tree) -> jnp.ndarray:
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+
+
+def _nonfinite(tree) -> jnp.ndarray:
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.int32)
+    return sum(jnp.sum(~jnp.isfinite(l)).astype(jnp.int32) for l in leaves)
+
+
+def _stack(xs, dtype) -> jnp.ndarray:
+    if not xs:
+        return jnp.zeros((0,), dtype)
+    return jnp.stack([x.astype(dtype) for x in xs])
+
+
+def nonfinite_counts(grads) -> jnp.ndarray:
+    """Per-layer non-finite element counts ([L] int32) of a gradient tree.
+    Split out so ``ParallelWrapper`` can take it on the RAW per-shard
+    grads and psum it across the data axis before reduction."""
+    return _stack([_nonfinite(g) for g in groups(grads)], jnp.int32)
+
+
+def layer_stats(params, new_params, grads, loss,
+                nonfinite: Optional[jnp.ndarray] = None
+                ) -> Dict[str, jnp.ndarray]:
+    """The auxiliary telemetry pytree, computed in-graph.
+
+    All entries are device values: ``loss`` (scalar), ``grad_norm`` /
+    ``update_norm`` / ``param_norm`` / ``update_ratio`` ([L] float32, one
+    slot per layer in :func:`groups` order), ``nonfinite`` ([L] int32
+    non-finite gradient elements per layer) and ``nonfinite_total``
+    (scalar, including a non-finite loss). Layers without params read 0.
+    """
+    po, pn, gr = groups(params), groups(new_params), groups(grads)
+    grad_norm = jnp.sqrt(_stack([_sumsq(g) for g in gr], jnp.float32))
+    update_norm = jnp.sqrt(_stack(
+        [_sumsq(jax.tree.map(lambda n, o: n - o, n_, o_))
+         for n_, o_ in zip(pn, po)], jnp.float32))
+    param_norm = jnp.sqrt(_stack([_sumsq(p) for p in pn], jnp.float32))
+    nf = nonfinite if nonfinite is not None else nonfinite_counts(grads)
+    total = (jnp.sum(nf).astype(jnp.int32)
+             + (~jnp.isfinite(loss)).astype(jnp.int32))
+    return {
+        "loss": loss,
+        "grad_norm": grad_norm,
+        "update_norm": update_norm,
+        "param_norm": param_norm,
+        "update_ratio": update_norm / jnp.maximum(param_norm, 1e-12),
+        "nonfinite": nf,
+        "nonfinite_total": total,
+    }
+
+
+def apply_nan_guard(aux, new_params, params, new_states, states,
+                    new_upd, upd_state):
+    """The skip-update NAN_PANIC policy, compiled into the step: when the
+    step produced any non-finite gradient (or loss), drop the param/
+    updater-state/layer-state updates and carry the pre-step copies —
+    which are already live in the graph — forward instead. The poisoned
+    update never lands and no host round-trip is involved; the listener
+    only reports. Returns (aux + ``skipped`` flag, params, states, upd)."""
+    ok = aux["nonfinite_total"] == 0
+
+    def keep(n, o):
+        return jnp.where(ok, n, o)
+
+    aux = dict(aux)
+    aux["skipped"] = (~ok).astype(jnp.int32)
+    return (aux,
+            jax.tree.map(keep, new_params, params),
+            jax.tree.map(keep, new_states, states),
+            jax.tree.map(keep, new_upd, upd_state))
+
+
+# --- listener-bus drains (host side, async) ---------------------------------
+
+class TelemetrySink(TrainingListener):
+    """Drains the in-graph aux into a ``StatsStorage`` backend.
+
+    Buffers the DEVICE pytrees per iteration (cheap: references only) and
+    every ``drain_every_n`` iterations performs ONE batched
+    ``jax.device_get`` of the whole window — the only host sync telemetry
+    pays, timed into the profiler's ``telemetry/drain`` section.
+    ``keep_every_n`` subsamples iterations for long runs. Scalars emitted
+    per drained iteration: ``loss``, ``nonfinite_total`` (and
+    ``skipped_updates`` under the nan guard), plus
+    ``{grad_norm,update_norm,param_norm,update_ratio}/<layer>`` and —
+    only when non-zero — ``nonfinite/<layer>``."""
+
+    wants_telemetry = True
+
+    def __init__(self, storage, drain_every_n: int = 10,
+                 session_id: str = "", keep_every_n: int = 1):
+        self.storage = storage
+        self.every = max(1, drain_every_n)
+        self.keep = max(1, keep_every_n)
+        self.session = session_id
+        self._buf: List[tuple] = []
+        self._names: Optional[List[str]] = None
+        self.drains = 0
+
+    def telemetry_done(self, model, iteration: int, aux) -> None:
+        if iteration % self.keep:
+            return
+        if self._names is None:
+            self._names = layer_names(model)
+        self._buf.append((iteration, aux))
+        if len(self._buf) >= self.every:
+            self.drain()
+
+    def drain(self) -> None:
+        """Flush the buffered window (one batched readback)."""
+        if not self._buf:
+            return
+        prof = OpProfiler.get()
+        with prof.time_section("telemetry/drain"):
+            host = jax.device_get([a for _, a in self._buf])
+        names = self._names or []
+
+        def name(j: int) -> str:
+            return names[j] if j < len(names) else str(j)
+
+        put = self.storage.put_scalar
+        for (it, _), aux in zip(self._buf, host):
+            put(self.session, "loss", it, float(aux["loss"]))
+            put(self.session, "nonfinite_total", it,
+                int(aux["nonfinite_total"]))
+            if "skipped" in aux:
+                put(self.session, "skipped_updates", it, int(aux["skipped"]))
+            for series in ("grad_norm", "update_norm", "param_norm",
+                           "update_ratio"):
+                vec = aux[series]
+                for j in range(len(vec)):
+                    put(self.session, f"{series}/{name(j)}", it,
+                        float(vec[j]))
+            nf = aux["nonfinite"]
+            for j in range(len(nf)):
+                if int(nf[j]):
+                    put(self.session, f"nonfinite/{name(j)}", it,
+                        int(nf[j]))
+        prof.count("telemetry/drained_steps", len(self._buf))
+        self.drains += 1
+        self._buf.clear()
+
+    def epoch_done(self, model, epoch: int) -> None:
+        self.drain()
+
+
+class NanSentinelListener(TrainingListener):
+    """Graded NAN_PANIC (reference: nd4j OpProfiler NAN_PANIC / the
+    all-or-nothing ``jax_debug_nans`` toggle). Policies:
+
+    - ``"warn"``  — log a warning naming the offending layer(s);
+    - ``"skip"``  — the poisoned update is dropped IN-GRAPH (the step is
+      built with :func:`apply_nan_guard`, so params stay finite and equal
+      to the pre-NaN step); the listener reports what was skipped;
+    - ``"raise"`` — raise ``FloatingPointError`` naming the layer.
+
+    Detection is asynchronous: device non-finite counts buffer and one
+    batched readback runs every ``check_every_n`` iterations (and at epoch
+    end) — a poisoned step is caught within one drain window without ever
+    syncing the hot loop per-iteration."""
+
+    wants_telemetry = True
+    POLICIES = ("warn", "skip", "raise")
+
+    def __init__(self, policy: str = "warn", check_every_n: int = 10):
+        if policy not in self.POLICIES:
+            raise ValueError(f"policy must be one of {self.POLICIES}, "
+                             f"got {policy!r}")
+        self.policy = policy
+        self.wants_nan_guard = policy == "skip"
+        self.every = max(1, check_every_n)
+        self._buf: List[tuple] = []
+        self._names: Optional[List[str]] = None
+        self.events: List[dict] = []
+
+    def telemetry_done(self, model, iteration: int, aux) -> None:
+        if self._names is None:
+            self._names = layer_names(model)
+        self._buf.append((iteration, aux["nonfinite"],
+                          aux["nonfinite_total"]))
+        if len(self._buf) >= self.every:
+            self.check()
+
+    def check(self) -> None:
+        """Inspect the buffered window (one batched readback)."""
+        if not self._buf:
+            return
+        with OpProfiler.get().time_section("telemetry/drain"):
+            host = jax.device_get([(nf, tot) for _, nf, tot in self._buf])
+        buf, self._buf = self._buf, []
+        names = self._names or []
+        for (it, _, _), (nf, tot) in zip(buf, host):
+            if int(tot) == 0:
+                continue
+            layers = [(names[j] if j < len(names) else str(j), int(c))
+                      for j, c in enumerate(nf) if int(c)]
+            where = ", ".join(f"{n} ({c} non-finite grad elements)"
+                              for n, c in layers) or "loss"
+            self.events.append({"iteration": it, "layers": layers,
+                                "total": int(tot)})
+            if self.policy == "raise":
+                raise FloatingPointError(
+                    f"non-finite gradients at iteration {it}: {where}")
+            if self.policy == "skip":
+                logger.warning("NanSentinel: skipped poisoned update at "
+                               "iteration %d (%s)", it, where)
+            else:
+                logger.warning("NanSentinel: non-finite gradients at "
+                               "iteration %d (%s)", it, where)
+
+    def epoch_done(self, model, epoch: int) -> None:
+        self.check()

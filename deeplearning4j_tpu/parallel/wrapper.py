@@ -105,10 +105,22 @@ class ParallelWrapper:
         self.prefetch = prefetch
         self._step = None
         self._chunk_step = None
+        self._telemetry = None
         self._listeners: List[Any] = []
 
     def set_listeners(self, *ls) -> None:
         self._listeners = list(ls)
+        from ..optimize.telemetry import config_for
+
+        cfg = config_for(self._listeners)
+        if cfg != self._telemetry:
+            # in-graph telemetry is a build-time property of the SPMD step
+            # (see MultiLayerNetwork.set_listeners); the aux statistics are
+            # aggregated across shards with the same collectives as the
+            # weight update
+            self._telemetry = cfg
+            self._step = None
+            self._chunk_step = None
 
     # ------------------------------------------------------------------
     def _local_core(self):
@@ -119,6 +131,8 @@ class ParallelWrapper:
         acc = self.accumulator
         axis = acc.axis_name
         is_graph = hasattr(model, "conf") and hasattr(model.conf, "network_inputs")
+        tele = self._telemetry
+        from ..optimize import telemetry as _tel
 
         def local_step(params, states, upd_state, x, y, mask, w, key, it):
             idx = jax.lax.axis_index(axis)
@@ -148,6 +162,12 @@ class ParallelWrapper:
                 return loss, new_states
 
             (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            if tele is not None:
+                # non-finite counts are taken on the RAW per-shard grads
+                # (reduction would smear one shard's NaN across all of
+                # them) and aggregated with the same collective family as
+                # the weight update
+                raw_nf = jax.lax.psum(_tel.nonfinite_counts(grads), axis)
             grads = acc.reduce_gradients(grads)
             loss = jax.lax.pmean(loss, axis)
             # keep batchnorm running stats consistent across shards
@@ -155,7 +175,17 @@ class ParallelWrapper:
                 lambda s: jax.lax.pmean(s, axis)
                 if jnp.issubdtype(s.dtype, jnp.floating) else s, new_states)
             new_params, new_upd = updater.apply(grads, upd_state, params, it)
-            return new_params, new_states, new_upd, loss
+            if tele is None:
+                return new_params, new_states, new_upd, loss
+            # norms on the REDUCED grads / updated params: replicated
+            # values, identical on every shard
+            aux = _tel.layer_stats(params, new_params, grads, loss,
+                                   nonfinite=raw_nf)
+            if tele.nan_guard:
+                aux, new_params, new_states, new_upd = _tel.apply_nan_guard(
+                    aux, new_params, params, new_states, states, new_upd,
+                    upd_state)
+            return new_params, new_states, new_upd, loss, aux
 
         return local_step
 
@@ -163,11 +193,14 @@ class ParallelWrapper:
         local_step = self._local_core()
         pspec = self._param_specs()
         uspec = self._upd_specs(pspec)
+        out_specs = (pspec, P(), uspec, P())
+        if self._telemetry is not None:
+            out_specs += (P(),)    # aux pytree: replicated device scalars
         sharded = shard_map(
             local_step, mesh=self.mesh,
             in_specs=(pspec, P(), uspec, P("data"), P("data"), P("data"),
                       P("data"), P(), P()),
-            out_specs=(pspec, P(), uspec, P()),
+            out_specs=out_specs,
             check_rep=False)
 
         def step(*args):
@@ -182,29 +215,40 @@ class ParallelWrapper:
         (gradient psum, loss/stats pmean) run inside the scan body, and
         Python dispatch + listener sync amortize over K steps."""
         local_step = self._local_core()
+        tele = self._telemetry
 
         def local_chunk(params, states, upd_state, xs, ys, masks, ws, keys,
                         it0):
             def body(carry, inp):
                 params, states, upd_state, it = carry
                 x, y, m, w, k = inp
-                params, states, upd_state, loss = local_step(
-                    params, states, upd_state, x, y, m, w, k, it)
-                return (params, states, upd_state, it + 1), loss
+                out = local_step(params, states, upd_state, x, y, m, w, k,
+                                 it)
+                if tele is None:
+                    params, states, upd_state, loss = out
+                    return (params, states, upd_state, it + 1), loss
+                params, states, upd_state, loss, aux = out
+                return (params, states, upd_state, it + 1), (loss, aux)
 
-            (params, states, upd_state, _), losses = jax.lax.scan(
+            (params, states, upd_state, _), ys_out = jax.lax.scan(
                 body, (params, states, upd_state, it0),
                 (xs, ys, masks, ws, keys))
-            return params, states, upd_state, losses
+            if tele is None:
+                return params, states, upd_state, ys_out
+            losses, auxes = ys_out
+            return params, states, upd_state, losses, auxes
 
         pspec = self._param_specs()
         uspec = self._upd_specs(pspec)
         batch = P(None, "data")   # [K, B, ...]: stack axis whole, B sharded
+        out_specs = (pspec, P(), uspec, P())
+        if tele is not None:
+            out_specs += (P(),)
         sharded = shard_map(
             local_chunk, mesh=self.mesh,
             in_specs=(pspec, P(), uspec, batch, batch, batch, batch, P(),
                       P()),
-            out_specs=(pspec, P(), uspec, P()),
+            out_specs=out_specs,
             check_rep=False)
 
         def chunk(*args):
@@ -319,10 +363,11 @@ class ParallelWrapper:
         xs, ys, ms, ws = b
         key = get_random().next_key()
         with prof.time_section("pipeline/dispatch"):
-            (model._params, model._states, model._updater_state, loss) = \
-                self._step(model._params, model._states, model._updater_state,
-                           xs, ys, ms, ws, key, jnp.asarray(model._iteration))
-        _pipe.note_steps(model, self._listeners, [loss])
+            out = self._step(model._params, model._states,
+                             model._updater_state, xs, ys, ms, ws, key,
+                             jnp.asarray(model._iteration))
+        _pipe.note_dispatch(model, self._listeners, out,
+                            self._telemetry is not None)
 
     def _dispatch_chunk(self, group, prof) -> None:
         model = self.model
@@ -332,13 +377,12 @@ class ParallelWrapper:
         stack = lambda i: jnp.stack([b[i] for b in group])  # noqa: E731
         keys = jnp.stack([get_random().next_key() for _ in group])
         with prof.time_section("pipeline/dispatch"):
-            (model._params, model._states, model._updater_state, losses) = \
-                self._chunk_step(model._params, model._states,
-                                 model._updater_state, stack(0), stack(1),
-                                 stack(2), stack(3), keys,
-                                 jnp.asarray(model._iteration))
-        _pipe.note_steps(model, self._listeners,
-                         [losses[i] for i in range(len(group))])
+            out = self._chunk_step(model._params, model._states,
+                                   model._updater_state, stack(0), stack(1),
+                                   stack(2), stack(3), keys,
+                                   jnp.asarray(model._iteration))
+        _pipe.note_dispatch(model, self._listeners, out,
+                            self._telemetry is not None, len(group))
 
     def shutdown(self) -> None:
         self._step = None

@@ -9,11 +9,17 @@ for programmatic queries, or JSONL. Device-side kernel traces come from
 
 from .stats import (FileStatsStorage, InMemoryStatsStorage, StatsListener,
                     StatsStorage, TensorBoardStatsStorage)
-from .tensorboard import TensorBoardEventWriter, read_scalar_events
+from .tensorboard import (TensorBoardEventWriter, read_histogram_events,
+                          read_scalar_events)
 from .server import RemoteUIStatsStorageRouter, UIServer
+# the device half of the metrics bus (in-graph telemetry) lives in
+# optimize.telemetry; re-exported here so the three-line attach
+# (listener -> storage -> TensorBoard/UIServer) is one import
+from ..optimize.telemetry import NanSentinelListener, TelemetrySink
 
 __all__ = [
     "FileStatsStorage", "InMemoryStatsStorage", "StatsListener",
     "StatsStorage", "TensorBoardStatsStorage", "TensorBoardEventWriter",
-    "read_scalar_events", "UIServer", "RemoteUIStatsStorageRouter",
+    "read_scalar_events", "read_histogram_events", "UIServer",
+    "RemoteUIStatsStorageRouter", "TelemetrySink", "NanSentinelListener",
 ]

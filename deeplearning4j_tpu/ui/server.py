@@ -22,13 +22,77 @@ reference's overview tab.
 from __future__ import annotations
 
 import json
+import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from .stats import (FileStatsStorage, InMemoryStatsStorage,
                     StatsStorage)
+
+
+class _JsonlTailCache:
+    """Parsed-record cache for attached JSONL stats files.
+
+    Re-parsing the whole file on every ``/api/series`` poll is O(file) per
+    request and the dashboard polls every 2 s — a long run's stats file
+    would dominate the server. Entries are keyed on ``(mtime_ns, size)``:
+    an exact match returns the cached records; growth of an append-only
+    file (the ``FileStatsStorage`` contract) parses only the appended tail
+    from the cached byte offset. A rewrite falls back to a full reparse —
+    detected by a shrink below the cached offset OR a changed leading-
+    bytes prefix (a restarted run recreating the path can reach a size
+    past the old offset between polls; the prefix check catches it
+    without hashing the file). A torn final line (mid-write, no trailing
+    newline) is left unparsed with the offset NOT advanced past it, so it
+    is retried complete on a later request."""
+
+    PREFIX_LEN = 64
+
+    def __init__(self) -> None:
+        self._state: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.tail_reads = 0
+        self.full_reads = 0
+
+    def stats(self) -> Dict[str, int]:
+        return {"hits": self.hits, "tail_reads": self.tail_reads,
+                "full_reads": self.full_reads,
+                "paths": len(self._state)}
+
+    def read(self, path: str) -> List[dict]:
+        st = os.stat(path)
+        sig = (st.st_mtime_ns, st.st_size)
+        with self._lock:
+            ent = self._state.get(path)
+            if ent is not None and ent["sig"] == sig:
+                self.hits += 1
+                return ent["records"]
+            with open(path, "rb") as f:
+                prefix = f.read(self.PREFIX_LEN)
+                if ent is not None and st.st_size >= ent["offset"] \
+                        and prefix == ent["prefix"]:
+                    offset, records = ent["offset"], list(ent["records"])
+                    self.tail_reads += 1
+                else:
+                    offset, records = 0, []
+                    self.full_reads += 1
+                f.seek(offset)
+                data = f.read()
+            end = data.rfind(b"\n") + 1
+            for line in data[:end].splitlines():
+                if not line.strip():
+                    continue
+                try:
+                    records.append(json.loads(line.decode()))
+                except (ValueError, UnicodeDecodeError):
+                    continue
+            self._state[path] = {"sig": sig, "offset": offset + end,
+                                 "records": records, "prefix": prefix}
+            return records
 
 _PAGE = """<!DOCTYPE html>
 <html><head><meta charset="utf-8"><title>deeplearning4j-tpu UI</title>
@@ -40,10 +104,30 @@ _PAGE = """<!DOCTYPE html>
  .latest{color:#2a6fdb;font-weight:600}
 </style></head><body>
 <h1>deeplearning4j-tpu training UI</h1>
+<div id="health" style="color:#666;font-size:12px;margin:-8px 0 14px"></div>
 <div id="charts"></div>
 <div id="sdgraph"></div>
 <script>
 function esc(s){const d=document.createElement('div');d.textContent=s;return d.innerHTML;}
+function mib(b){return (b/1048576).toFixed(0)+' MiB';}
+async function health(){
+  try{
+    const h = await (await fetch('/api/health')).json();
+    let parts = ['backend '+(h.backend||'?'),
+                 'up '+(h.uptime_s||0)+'s',
+                 (h.records||0)+' records'];
+    for (const d of (h.devices||[])){
+      if (d.bytes_in_use !== undefined)
+        parts.push('dev'+d.id+' '+mib(d.bytes_in_use)+'/'+mib(d.bytes_limit));
+    }
+    if (h.live_buffers)
+      parts.push(h.live_buffers.count+' live buffers ('+mib(h.live_buffers.bytes)+')');
+    if (h.host && h.host.rss_bytes)
+      parts.push('host rss '+mib(h.host.rss_bytes));
+    document.getElementById('health').textContent = parts.join(' — ');
+  }catch(e){}
+}
+health(); setInterval(health, 5000);
 async function refresh(){
   const tags = await (await fetch('/api/tags')).json();
   const root = document.getElementById('charts');
@@ -123,6 +207,8 @@ class UIServer:
         self._paths: List[str] = []
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._jsonl = _JsonlTailCache()
+        self._t0 = time.time()
         # records POSTed by RemoteUIStatsStorageRouter clients
         self._remote = InMemoryStatsStorage()
         self._stores.append(self._remote)
@@ -189,15 +275,41 @@ class UIServer:
 
     # -- data ------------------------------------------------------------
     def _records(self) -> List[Dict[str, Any]]:
+        """All SCALAR records across attached stores and JSONL paths.
+        JSONL files go through the tail cache (only the appended tail is
+        parsed per request); histogram records (no "value" field — the
+        TensorBoard backends render those) are filtered out here."""
         recs: List[Dict[str, Any]] = []
         for s in self._stores:
             recs.extend(getattr(s, "records", []))
         for p in self._paths:
             try:
-                recs.extend(FileStatsStorage.read(p))
+                recs.extend(r for r in self._jsonl.read(p) if "value" in r)
             except (OSError, ValueError):
                 pass
         return recs
+
+    def health(self) -> Dict[str, Any]:
+        """The /api/health payload: process uptime, attached-source census,
+        JSONL-cache effectiveness, and the live device/host memory
+        telemetry from ``common.system_info.memory_summary`` (per-device
+        PJRT stats + the jax live-buffer census)."""
+        from ..common.system_info import memory_summary
+
+        n = sum(len(getattr(s, "records", ())) for s in self._stores)
+        for p in self._paths:
+            try:
+                # counts from the tail cache — no full-list materialization
+                n += sum(1 for r in self._jsonl.read(p) if "value" in r)
+            except (OSError, ValueError):
+                pass
+        return {"status": "ok",
+                "uptime_s": round(time.time() - self._t0, 1),
+                "stores": len(self._stores),
+                "paths": len(self._paths),
+                "records": n,
+                "jsonl_cache": self._jsonl.stats(),
+                **memory_summary()}
 
     def sessions(self) -> List[str]:
         return sorted({str(r.get("session", "")) for r in self._records()})
@@ -260,6 +372,9 @@ class UIServer:
                     self._send(_PAGE.encode(), "text/html; charset=utf-8")
                 elif u.path == "/healthz":
                     self._send(b"ok", "text/plain")
+                elif u.path == "/api/health":
+                    self._send(json.dumps(ui.health()).encode(),
+                               "application/json")
                 elif u.path == "/api/tags":
                     self._send(json.dumps(ui.tags()).encode(),
                                "application/json")

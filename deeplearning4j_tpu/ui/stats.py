@@ -24,7 +24,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ..optimize.listeners import TrainingListener
-from .tensorboard import TensorBoardEventWriter
+from .tensorboard import TensorBoardEventWriter, host_histogram
 
 
 class StatsStorage:
@@ -34,6 +34,12 @@ class StatsStorage:
                    value: float) -> None:
         raise NotImplementedError
 
+    def put_histogram(self, session: str, tag: str, step: int,
+                      values) -> None:
+        """Histogram record (reference StatsListener's per-layer param/
+        gradient/update histograms). Default: dropped — scalar-only
+        backends stay valid without knowing about histograms."""
+
     def close(self) -> None:
         pass
 
@@ -41,14 +47,25 @@ class StatsStorage:
 class InMemoryStatsStorage(StatsStorage):
     def __init__(self) -> None:
         self.records: List[Dict[str, Any]] = []
+        self.histograms: List[Dict[str, Any]] = []
 
     def put_scalar(self, session, tag, step, value):
         self.records.append({"session": session, "tag": tag, "step": step,
                              "value": float(value), "time": time.time()})
 
+    def put_histogram(self, session, tag, step, values):
+        _, counts, edges = host_histogram(values)
+        self.histograms.append({
+            "session": session, "tag": tag, "step": step,
+            "bucket": counts.tolist(), "bucket_limit": edges[1:].tolist(),
+            "time": time.time()})
+
     # -- queries (reference: StatsStorage.getAllUpdatesAfter etc.) -------
     def tags(self) -> List[str]:
         return sorted({r["tag"] for r in self.records})
+
+    def histogram_tags(self) -> List[str]:
+        return sorted({r["tag"] for r in self.histograms})
 
     def series(self, tag: str) -> List[tuple]:
         return [(r["step"], r["value"]) for r in self.records
@@ -66,8 +83,19 @@ class FileStatsStorage(StatsStorage):
         self._f.write(json.dumps({"session": session, "tag": tag,
                                   "step": step, "value": float(value),
                                   "time": time.time()}) + "\n")
-        # per-write flush: a live dashboard (UIServer) re-reads this file
+        # per-write flush: a live dashboard (UIServer) tails this file
         # per request, and buffered records would lag it by ~8 KB
+        self._f.flush()
+
+    def put_histogram(self, session, tag, step, values):
+        _, counts, edges = host_histogram(values)
+        # "kind" distinguishes the record; scalar consumers (UIServer
+        # series) filter on the presence of "value"
+        self._f.write(json.dumps({"kind": "histogram", "session": session,
+                                  "tag": tag, "step": step,
+                                  "bucket": counts.tolist(),
+                                  "bucket_limit": edges[1:].tolist(),
+                                  "time": time.time()}) + "\n")
         self._f.flush()
 
     def close(self):
@@ -100,6 +128,11 @@ class TensorBoardStatsStorage(StatsStorage):
                                 value, step)
         self._writer.flush()
 
+    def put_histogram(self, session, tag, step, values):
+        self._writer.add_histogram(f"{session}/{tag}" if session else tag,
+                                   values, step)
+        self._writer.flush()
+
     def close(self):
         self._writer.close()
 
@@ -111,12 +144,14 @@ class StatsListener(TrainingListener):
 
     def __init__(self, storage: StatsStorage, collect_every_n: int = 10,
                  session_id: str = "", collect_param_norms: bool = True,
-                 collect_timing: bool = True):
+                 collect_timing: bool = True,
+                 collect_histograms: bool = False):
         self.storage = storage
         self.every = max(1, collect_every_n)
         self.session = session_id
         self.collect_param_norms = collect_param_norms
         self.collect_timing = collect_timing
+        self.collect_histograms = collect_histograms
         self._last_time: Optional[float] = None
 
     def iteration_done(self, model, iteration: int, score) -> None:
@@ -132,7 +167,7 @@ class StatsListener(TrainingListener):
                 self.storage.put_scalar(self.session, "iteration_ms",
                                         iteration, per_iter * 1e3)
             self._last_time = now
-        if self.collect_param_norms:
+        if self.collect_param_norms or self.collect_histograms:
             params = getattr(model, "_params", None)
             # MultiLayerNetwork keeps a per-layer param list; SameDiff's
             # _params is a METHOD returning {name: array} — support both
@@ -140,12 +175,25 @@ class StatsListener(TrainingListener):
                 params = [params()]
             if not isinstance(params, (list, tuple)):
                 params = []
+            if params:
+                import jax
+
+                # ONE batched transfer of the whole param tree — a
+                # per-array np.asarray loop would pay one device sync per
+                # parameter and defeat the "one sync per collection
+                # window" contract this listener advertises
+                params = jax.device_get(params)
             for i, lp in enumerate(params):
                 for name, w in lp.items():
                     arr = np.asarray(w)
-                    self.storage.put_scalar(
-                        self.session, f"param_mean_magnitude/{i}_{name}",
-                        iteration, float(np.mean(np.abs(arr))))
+                    if self.collect_param_norms:
+                        self.storage.put_scalar(
+                            self.session, f"param_mean_magnitude/{i}_{name}",
+                            iteration, float(np.mean(np.abs(arr))))
+                    if self.collect_histograms:
+                        self.storage.put_histogram(
+                            self.session, f"param/{i}_{name}", iteration,
+                            arr)
 
     def epoch_done(self, model, epoch: int) -> None:
         self.storage.put_scalar(self.session, "epoch", epoch, epoch)

@@ -2,11 +2,12 @@
 
 SURVEY §5.5 names TensorBoard events as the TPU-stack equivalent of the
 reference's Training UI wire (StatsListener → StatsStorage → Play UI). This
-module writes scalar summaries in the standard ``tfevents`` TFRecord format
-(public, stable format: length-prefixed records with masked CRC32C, protobuf
-``Event``/``Summary`` payloads hand-encoded below — only the three scalar
-fields are needed, so a protobuf dependency would be overkill and a
-tensorflow import costs ~10 s of startup).
+module writes scalar and histogram summaries in the standard ``tfevents``
+TFRecord format (public, stable format: length-prefixed records with masked
+CRC32C, protobuf ``Event``/``Summary``/``HistogramProto`` payloads
+hand-encoded below — only a handful of fields are needed, so a protobuf
+dependency would be overkill and a tensorflow import costs ~10 s of
+startup).
 """
 
 from __future__ import annotations
@@ -16,6 +17,8 @@ import socket
 import struct
 import time
 from typing import Optional
+
+import numpy as np
 
 # --- CRC32C (Castagnoli), table-driven --------------------------------------
 
@@ -98,6 +101,40 @@ def _scalar_summary(tag: str, value: float) -> bytes:
     return _field_bytes(1, val)
 
 
+def _packed_doubles(num: int, values) -> bytes:
+    """Packed repeated double field (HistogramProto bucket/bucket_limit)."""
+    payload = b"".join(struct.pack("<d", float(v)) for v in values)
+    return _field_bytes(num, payload)
+
+
+def host_histogram(values, bins: int = 30):
+    """(finite_values, counts, edges) — the one histogram-preparation
+    convention shared by every storage backend: non-finite values are
+    dropped (TensorBoard refuses NaN bucket stats, np.histogram's
+    auto-range refuses NaN) and an all-empty input degrades to a single
+    zero bucket."""
+    v = np.asarray(values, np.float64).ravel()
+    v = v[np.isfinite(v)]
+    if v.size == 0:
+        v = np.zeros((1,))
+    counts, edges = np.histogram(v, bins=bins)
+    return v, counts, edges
+
+
+def _histogram_summary(tag: str, values, bins: int = 30) -> bytes:
+    """Summary.Value with a ``histo`` (HistogramProto, field 5) payload."""
+    v, counts, edges = host_histogram(values, bins)
+    histo = (_field_double(1, float(v.min()))          # min
+             + _field_double(2, float(v.max()))        # max
+             + _field_double(3, float(v.size))         # num
+             + _field_double(4, float(v.sum()))        # sum
+             + _field_double(5, float(np.square(v).sum()))  # sum_squares
+             + _packed_doubles(6, edges[1:])           # bucket right edges
+             + _packed_doubles(7, counts))             # bucket counts
+    val = _field_bytes(1, tag.encode()) + _field_bytes(5, histo)
+    return _field_bytes(1, val)
+
+
 class TensorBoardEventWriter:
     """Append scalar events to a ``tfevents`` file under ``logdir``
     (one file per writer, standard naming so TensorBoard discovers it)."""
@@ -122,6 +159,15 @@ class TensorBoardEventWriter:
         self._write_record(_event(time.time(), step=step,
                                   summary=_scalar_summary(tag, value)))
 
+    def add_histogram(self, tag: str, values, step: int,
+                      bins: int = 30) -> None:
+        """Histogram summary (reference StatsListener's per-layer param/
+        gradient/update histograms land here; TensorBoard's Histograms/
+        Distributions tabs render them)."""
+        self._write_record(_event(time.time(), step=step,
+                                  summary=_histogram_summary(tag, values,
+                                                             bins)))
+
     def flush(self) -> None:
         self._f.flush()
 
@@ -130,10 +176,9 @@ class TensorBoardEventWriter:
         self._f.close()
 
 
-def read_scalar_events(path: str):
-    """Parse a tfevents file back into [(step, tag, value)] — used by tests
-    to prove the files are well-formed (record framing + CRCs verified)."""
-    out = []
+def _iter_record_payloads(path: str):
+    """Yield the event payloads of a tfevents file, verifying the TFRecord
+    framing (header + payload masked CRC32C)."""
     with open(path, "rb") as f:
         while True:
             header = f.read(8)
@@ -147,7 +192,27 @@ def read_scalar_events(path: str):
             (pcrc,) = struct.unpack("<I", f.read(4))
             if pcrc != _masked_crc(payload):
                 raise ValueError("corrupt payload CRC")
-            out.extend(_parse_event(payload))
+            yield payload
+
+
+def read_scalar_events(path: str):
+    """Parse a tfevents file back into [(step, tag, value)] — used by tests
+    to prove the files are well-formed (record framing + CRCs verified)."""
+    out = []
+    for payload in _iter_record_payloads(path):
+        out.extend((s, t, v) for s, t, v, h in _parse_event(payload)
+                   if h is None)
+    return out
+
+
+def read_histogram_events(path: str):
+    """Parse a tfevents file's histogram summaries into
+    [(step, tag, histo)] with ``histo`` a dict of the HistogramProto
+    fields (min/max/num/sum/sum_squares/bucket_limit/bucket)."""
+    out = []
+    for payload in _iter_record_payloads(path):
+        out.extend((s, t, h) for s, t, _v, h in _parse_event(payload)
+                   if h is not None)
     return out
 
 
@@ -183,7 +248,7 @@ def _parse_event(buf: bytes):
             i += ln
             if num == 5:  # summary
                 values.extend(_parse_summary(chunk))
-    return [(step, tag, val) for tag, val in values]
+    return [(step, tag, val, histo) for tag, val, histo in values]
 
 
 def _parse_summary(buf: bytes):
@@ -208,7 +273,7 @@ def _parse_summary(buf: bytes):
 
 def _parse_value(buf: bytes):
     i = 0
-    tag, val = "", float("nan")
+    tag, val, histo = "", float("nan"), None
     while i < len(buf):
         key, i = _read_varint(buf, i)
         num, wire = key >> 3, key & 7
@@ -216,6 +281,8 @@ def _parse_value(buf: bytes):
             ln, i = _read_varint(buf, i)
             if num == 1:
                 tag = buf[i:i + ln].decode()
+            elif num == 5:  # histo (HistogramProto)
+                histo = _parse_histo(buf[i:i + ln])
             i += ln
         elif wire == 5:
             if num == 2:
@@ -225,4 +292,36 @@ def _parse_value(buf: bytes):
             i += 8
         else:
             _, i = _read_varint(buf, i)
-    return tag, val
+    return tag, val, histo
+
+
+_HISTO_DOUBLES = {1: "min", 2: "max", 3: "num", 4: "sum", 5: "sum_squares"}
+
+
+def _parse_histo(buf: bytes):
+    out = {"min": 0.0, "max": 0.0, "num": 0.0, "sum": 0.0,
+           "sum_squares": 0.0, "bucket_limit": [], "bucket": []}
+    i = 0
+    while i < len(buf):
+        key, i = _read_varint(buf, i)
+        num, wire = key >> 3, key & 7
+        if wire == 1:
+            (v,) = struct.unpack("<d", buf[i:i + 8])
+            i += 8
+            if num in _HISTO_DOUBLES:
+                out[_HISTO_DOUBLES[num]] = v
+        elif wire == 2:  # packed repeated double
+            ln, i = _read_varint(buf, i)
+            chunk = buf[i:i + ln]
+            i += ln
+            vals = [struct.unpack("<d", chunk[k:k + 8])[0]
+                    for k in range(0, len(chunk) - 7, 8)]
+            if num == 6:
+                out["bucket_limit"] = vals
+            elif num == 7:
+                out["bucket"] = vals
+        elif wire == 5:
+            i += 4
+        else:
+            _, i = _read_varint(buf, i)
+    return out

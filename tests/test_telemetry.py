@@ -1,0 +1,557 @@
+"""In-graph training telemetry tests (PR 2): device-computed per-layer
+gradient/update stats ride every train-step builder as an aux pytree with
+ZERO extra compiles (trace/* stays 1 per fit config) and zero per-iteration
+host syncs; NanSentinelListener implements the graded NAN_PANIC analog;
+histograms flow through every StatsStorage backend; UIServer grows
+/api/health and an append-only JSONL tail cache."""
+
+import json
+import logging
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.profiler import OpProfiler
+from deeplearning4j_tpu.data import DataSet, NDArrayDataSetIterator
+from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.nn import (ComputationGraph, InputType,
+                                   MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.graph import ComputationGraphConfiguration
+from deeplearning4j_tpu.optimize import (EvaluativeListener,
+                                         NanSentinelListener, TelemetrySink)
+from deeplearning4j_tpu.optimize.telemetry import TelemetryConfig
+from deeplearning4j_tpu.parallel import ParallelWrapper
+from deeplearning4j_tpu.ui import (FileStatsStorage, InMemoryStatsStorage,
+                                   StatsListener, TensorBoardEventWriter,
+                                   TensorBoardStatsStorage, UIServer,
+                                   read_histogram_events,
+                                   read_scalar_events)
+from deeplearning4j_tpu.ui.server import _JsonlTailCache
+
+SERIES = ("grad_norm", "update_norm", "param_norm", "update_ratio")
+
+
+def mln_model(updater=None, seed=1):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(updater or Adam(0.05)).activation("tanh").list()
+            .layer(L.DenseLayer(n_out=8))
+            .layer(L.OutputLayer(n_out=2, loss="mcxent",
+                                 activation="softmax"))
+            .set_input_type(InputType.feed_forward(3))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def xy(n, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 3).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+    return x, y
+
+
+class TestInGraphTelemetry:
+    def test_mln_trace_stable_with_partial_batch(self):
+        """Acceptance criterion: telemetry enabled, one epoch whose final
+        batch is partial — trace/mln_fit_step == 1 and every series lands
+        in the storage with finite values."""
+        model = mln_model()
+        storage = InMemoryStatsStorage()
+        model.set_listeners(TelemetrySink(storage, drain_every_n=2))
+        x, y = xy(20)
+        prof = OpProfiler.get()
+        prof.reset()
+        model.fit(NDArrayDataSetIterator(x, y, batch_size=8), epochs=2)
+        assert prof.trace_counts() == {"trace/mln_fit_step": 1}
+        # every iteration drained (6 steps: 3 per epoch incl. padded tail)
+        steps = [s for s, _ in storage.series("loss")]
+        assert steps == [1, 2, 3, 4, 5, 6]
+        for series in SERIES:
+            for layer in ("0_DenseLayer", "1_OutputLayer"):
+                vals = [v for _, v in storage.series(f"{series}/{layer}")]
+                assert len(vals) == 6
+                assert all(np.isfinite(v) for v in vals)
+                assert all(v >= 0 for v in vals)
+        assert all(v == 0 for _, v in storage.series("nonfinite_total"))
+
+    def test_mln_chunk_trace_stable(self):
+        """steps_per_dispatch scan chunk: aux stacks through lax.scan; the
+        chunk and the per-step tail each trace exactly once."""
+        model = mln_model()
+        storage = InMemoryStatsStorage()
+        model.set_listeners(TelemetrySink(storage, drain_every_n=3))
+        x, y = xy(36)       # batch 8 -> 4 full (2 chunks of 2) + padded tail
+        prof = OpProfiler.get()
+        prof.reset()
+        model.fit(NDArrayDataSetIterator(x, y, batch_size=8), epochs=2,
+                  steps_per_dispatch=2)
+        traces = prof.trace_counts()
+        assert traces.get("trace/mln_fit_chunk") == 1
+        assert traces.get("trace/mln_fit_step") == 1    # the odd tail batch
+        steps = [s for s, _ in storage.series("loss")]
+        assert steps == list(range(1, 11))
+        assert all(np.isfinite(v) for _, v in storage.series(
+            "grad_norm/1_OutputLayer"))
+
+    def test_aux_unaffected_by_pad_rows(self):
+        """Padded batch (wrapped rows, w=0) must produce the SAME telemetry
+        as the unpadded masked batch — grads of pad rows are exactly
+        removed, so every norm matches."""
+        model = mln_model(updater=Sgd(learning_rate=0.1))
+        model._telemetry = TelemetryConfig()
+        model._updater_state = model.conf.global_conf.updater.init(
+            model._params)
+        step = model._build_fit_step()
+        x, y = xy(5)
+        idx = np.arange(8) % 5
+        xp, yp = x[idx], y[idx]
+        w = (np.arange(8) < 5).astype(np.float32)
+        key = jax.random.PRNGKey(0)
+        copy = lambda t: jax.tree.map(jnp.array, t)     # noqa: E731
+        out_pad = step(copy(model._params), copy(model._states),
+                       copy(model._updater_state), jnp.asarray(xp),
+                       jnp.asarray(yp), None, key, jnp.asarray(0), None,
+                       jnp.asarray(w))
+        out_raw = step(copy(model._params), copy(model._states),
+                       copy(model._updater_state), jnp.asarray(x),
+                       jnp.asarray(y), None, key, jnp.asarray(0), None,
+                       None)
+        aux_pad, aux_raw = jax.device_get((out_pad[4], out_raw[4]))
+        for k in ("loss", "grad_norm", "update_norm", "param_norm",
+                  "update_ratio"):
+            np.testing.assert_allclose(aux_pad[k], aux_raw[k], rtol=2e-5,
+                                       err_msg=k)
+        assert aux_pad["nonfinite_total"] == 0
+
+    def test_graph_trace_stable(self):
+        b = ComputationGraphConfiguration.graph_builder(
+            NeuralNetConfiguration.builder().seed(1).updater(Adam(0.05))
+            .activation("tanh"))
+        conf = (b.add_inputs("in")
+                .add_layer("d1", L.DenseLayer(n_out=8), "in")
+                .add_layer("out", L.OutputLayer(n_out=2, loss="mcxent",
+                                                activation="softmax"), "d1")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(3)).build())
+        g = ComputationGraph(conf).init()
+        storage = InMemoryStatsStorage()
+        g.set_listeners(TelemetrySink(storage, drain_every_n=2))
+        x, y = xy(20)
+        prof = OpProfiler.get()
+        prof.reset()
+        g.fit(NDArrayDataSetIterator(x, y, batch_size=8), epochs=1)
+        assert prof.trace_counts() == {"trace/graph_fit_step": 1}
+        # node-name-keyed series (sorted node order)
+        assert {f"grad_norm/d1", f"grad_norm/out"} <= set(storage.tags())
+        prof.reset()
+        g.fit(NDArrayDataSetIterator(x, y, batch_size=8), epochs=1,
+              steps_per_dispatch=2)
+        assert prof.trace_counts().get("trace/graph_fit_chunk") == 1
+
+    def test_parallel_wrapper_trace_stable(self):
+        model = mln_model()
+        pw = (ParallelWrapper.Builder(model).workers(8)
+              .training_mode("shared_gradients").build())
+        storage = InMemoryStatsStorage()
+        pw.set_listeners(TelemetrySink(storage, drain_every_n=2))
+        x, y = xy(36)       # batch 16 over 36 -> 2 full + padded tail
+        prof = OpProfiler.get()
+        prof.reset()
+        pw.fit(NDArrayDataSetIterator(x, y, batch_size=16), epochs=1)
+        assert prof.trace_counts() == {"trace/pw_fit_step": 1}
+        assert [s for s, _ in storage.series("loss")] == [1, 2, 3]
+        assert all(np.isfinite(v) for _, v in storage.series(
+            "update_ratio/0_DenseLayer"))
+        assert all(v == 0 for _, v in storage.series("nonfinite_total"))
+
+    def test_serial_path_telemetry(self):
+        """Single-DataSet fit (the serial path) flows aux too."""
+        model = mln_model()
+        storage = InMemoryStatsStorage()
+        model.set_listeners(TelemetrySink(storage, drain_every_n=1))
+        x, y = xy(8)
+        ds = DataSet(x, y)
+        for _ in range(3):
+            model.fit(ds, epochs=1)
+        assert [s for s, _ in storage.series("loss")] == [1, 2, 3]
+
+    def test_tbptt_telemetry_catches_mid_segment_nan(self):
+        """TBPTT: the per-iteration aux must accumulate NaN evidence across
+        segments — a NaN confined to a MIDDLE segment (later segments
+        finite) still reaches the sentinel."""
+        b = (NeuralNetConfiguration.builder().seed(9)
+             .updater(Adam(learning_rate=0.01)).list()
+             .layer(L.SimpleRnn(n_out=4))
+             .layer(L.RnnOutputLayer(n_out=2, loss="mcxent",
+                                     activation="softmax")))
+        conf = (b.backprop_type("TruncatedBPTT").tbptt_length(4)
+                .set_input_type(InputType.recurrent(2, 12)).build())
+        model = MultiLayerNetwork(conf).init()
+        sent = NanSentinelListener("warn", check_every_n=1)
+        model.set_listeners(sent)
+        rng = np.random.RandomState(0)
+        x = rng.randn(6, 12, 2).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[
+            (x[:, :, 0].cumsum(1) > 0).astype(int)]
+        x[2, 5, 1] = np.nan         # middle segment (t 4..7) only
+        model.fit(DataSet(x, y), epochs=1)
+        assert sent.events and sent.events[0]["total"] > 0
+
+    def test_listener_flip_rebuilds_once(self):
+        """set_listeners with/without telemetry listeners rebuilds the step
+        exactly once per flip — and a same-config set is a no-op."""
+        model = mln_model()
+        x, y = xy(8)
+        ds = DataSet(x, y)
+        model.fit(ds, epochs=1)
+        step_plain = model._fit_step
+        model.set_listeners()                       # no telemetry: no-op
+        assert model._fit_step is step_plain
+        sink = TelemetrySink(InMemoryStatsStorage())
+        model.set_listeners(sink)
+        assert model._fit_step is None              # rebuild scheduled
+        model.fit(ds, epochs=1)
+        step_tel = model._fit_step
+        model.set_listeners(sink)                   # same config: no-op
+        assert model._fit_step is step_tel
+
+    def test_no_host_sync_off_drain_boundary(self):
+        """TelemetrySink must not read back device values between drains
+        (the §5.5 no-tax contract, telemetry edition)."""
+        sink = TelemetrySink(InMemoryStatsStorage(), drain_every_n=100)
+
+        class Spy:
+            reads = 0
+
+            def __index__(self):
+                raise AssertionError("synced")
+
+        class FakeModel:
+            conf = None
+            _params = []
+
+        aux = {"loss": Spy(), "grad_norm": Spy(), "update_norm": Spy(),
+               "param_norm": Spy(), "update_ratio": Spy(),
+               "nonfinite": Spy(), "nonfinite_total": Spy()}
+        for it in range(1, 50):
+            sink.telemetry_done(FakeModel(), it, aux)
+        assert len(sink._buf) == 49     # buffered, never touched
+
+
+class TestNanSentinel:
+    def _nan_batch(self):
+        x, y = xy(8)
+        xbad = x.copy()
+        xbad[3, 1] = np.nan
+        return DataSet(x, y), DataSet(xbad, y)
+
+    def test_skip_policy_restores_params(self):
+        """Acceptance criterion: skip-update policy leaves params finite
+        and equal to the pre-NaN step, caught within one drain window."""
+        model = mln_model()
+        sent = NanSentinelListener("skip", check_every_n=1)
+        model.set_listeners(sent)
+        clean, bad = self._nan_batch()
+        model.fit(clean, epochs=1)
+        before = jax.device_get(model._params)
+        model.fit(bad, epochs=1)
+        after = jax.device_get(model._params)
+        for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+            np.testing.assert_array_equal(a, b)
+        assert len(sent.events) == 1
+        assert sent.events[0]["iteration"] == 2
+        assert any("DenseLayer" in n for n, _ in sent.events[0]["layers"])
+        # training continues finite after the skipped update
+        model.fit(clean, epochs=1)
+        assert np.isfinite(float(model._score_dev))
+        assert all(np.isfinite(l).all()
+                   for l in jax.tree.leaves(jax.device_get(model._params)))
+
+    def test_skip_policy_restores_updater_state(self):
+        """The skipped step must not advance momentum either: step 3 after
+        a skipped step 2 equals step 2 of a run that never saw the NaN.
+        (Nesterovs: iteration-free given a fixed lr — the host iteration
+        counter still advances over a skipped step, by design.)"""
+        from deeplearning4j_tpu.learning import Nesterovs
+
+        clean, bad = self._nan_batch()
+
+        def make():
+            m = mln_model(updater=Nesterovs(learning_rate=0.05,
+                                            momentum=0.9))
+            m.set_listeners(NanSentinelListener("skip", check_every_n=1))
+            return m
+
+        a = make()
+        a.fit(clean, epochs=1)
+        a.fit(bad, epochs=1)        # skipped in-graph
+        a.fit(clean, epochs=1)
+        b = make()
+        b.fit(clean, epochs=1)
+        b.fit(clean, epochs=1)
+        for pa, pb in zip(jax.tree.leaves(jax.device_get(a._params)),
+                          jax.tree.leaves(jax.device_get(b._params))):
+            np.testing.assert_allclose(pa, pb, rtol=1e-6)
+
+    def test_raise_policy_names_layer(self):
+        model = mln_model()
+        model.set_listeners(NanSentinelListener("raise", check_every_n=1))
+        _, bad = self._nan_batch()
+        with pytest.raises(FloatingPointError, match="DenseLayer"):
+            model.fit(bad, epochs=1)
+
+    def test_warn_policy_logs_and_continues(self, caplog):
+        model = mln_model()
+        sent = NanSentinelListener("warn", check_every_n=1)
+        model.set_listeners(sent)
+        _, bad = self._nan_batch()
+        with caplog.at_level(logging.WARNING, "deeplearning4j_tpu"):
+            model.fit(bad, epochs=1)
+        assert any("non-finite" in r.message for r in caplog.records)
+        assert sent.events and sent.events[0]["total"] > 0
+
+    def test_bad_policy_rejected(self):
+        with pytest.raises(ValueError, match="policy"):
+            NanSentinelListener("explode")
+
+
+class TestHistograms:
+    def test_tb_histogram_roundtrip(self, tmp_path):
+        w = TensorBoardEventWriter(str(tmp_path))
+        vals = np.random.RandomState(0).randn(1000)
+        w.add_histogram("params/w", vals, 7)
+        w.add_scalar("loss", 0.5, 7)
+        w.close()
+        histos = read_histogram_events(w.path)
+        assert len(histos) == 1
+        step, tag, h = histos[0]
+        assert (step, tag) == (7, "params/w")
+        assert h["num"] == 1000
+        assert len(h["bucket"]) == len(h["bucket_limit"]) == 30
+        assert sum(h["bucket"]) == 1000
+        np.testing.assert_allclose(h["sum"], vals.sum(), rtol=1e-9)
+        np.testing.assert_allclose(h["min"], vals.min(), rtol=1e-9)
+        # scalars unaffected; histos excluded from the scalar reader
+        assert [(t, v) for _, t, v in read_scalar_events(w.path)] \
+            == [("loss", 0.5)]
+
+    def test_tensorboard_itself_can_read_histograms(self, tmp_path):
+        tb = pytest.importorskip("tensorboard.backend.event_processing."
+                                 "event_file_loader")
+        w = TensorBoardEventWriter(str(tmp_path))
+        w.add_histogram("conformance/h", [1.0, 2.0, 3.0], 3)
+        w.close()
+        events = [e for e in tb.EventFileLoader(w.path).Load()
+                  if e.HasField("summary")]
+        assert events
+        val = events[0].summary.value[0]
+        assert val.tag == "conformance/h"
+        # classic loaders keep the histo field; modern ones migrate it to
+        # a [buckets, 3] tensor tagged for the histograms plugin — both
+        # mean our hand-encoded HistogramProto was accepted
+        if val.HasField("histo"):
+            assert val.histo.num == 3 and val.histo.max == 3.0
+        else:
+            assert val.metadata.plugin_data.plugin_name == "histograms"
+            assert val.tensor.tensor_shape.dim[1].size == 3
+            buckets = (np.array(val.tensor.float_val)
+                       if val.tensor.float_val
+                       else np.frombuffer(val.tensor.tensor_content,
+                                          "<f4")).reshape(-1, 3)
+            assert buckets[:, 2].sum() == 3     # counts column
+
+    def test_nonfinite_values_dropped(self, tmp_path):
+        w = TensorBoardEventWriter(str(tmp_path))
+        w.add_histogram("h", [1.0, np.nan, np.inf, 2.0], 0)
+        w.close()
+        _, _, h = read_histogram_events(w.path)[0]
+        assert h["num"] == 2 and np.isfinite(h["sum"])
+
+    def test_inmemory_and_jsonl_backends(self, tmp_path):
+        mem = InMemoryStatsStorage()
+        mem.put_histogram("s", "param/w", 1, np.arange(10.0))
+        assert mem.histogram_tags() == ["param/w"]
+        assert sum(mem.histograms[0]["bucket"]) == 10
+        path = str(tmp_path / "stats.jsonl")
+        fs = FileStatsStorage(path)
+        fs.put_scalar("s", "score", 1, 0.5)
+        fs.put_histogram("s", "param/w", 1, np.arange(10.0))
+        fs.close()
+        rows = FileStatsStorage.read(path)
+        kinds = [r.get("kind") for r in rows]
+        assert kinds == [None, "histogram"]
+        assert sum(rows[1]["bucket"]) == 10
+
+    def test_stats_listener_histograms_end_to_end(self, tmp_path):
+        model = mln_model()
+        storage = TensorBoardStatsStorage(str(tmp_path))
+        model.set_listeners(StatsListener(storage, collect_every_n=2,
+                                          collect_histograms=True))
+        x, y = xy(16)
+        for _ in range(4):
+            model.fit(DataSet(x, y), epochs=1)
+        storage.close()
+        files = [os.path.join(str(tmp_path), f)
+                 for f in os.listdir(str(tmp_path))]
+        histos = read_histogram_events(files[0])
+        tags = {t for _, t, _ in histos}
+        assert any(t.startswith("param/0_") for t in tags)
+        assert any(t.startswith("param/1_") for t in tags)
+
+    def test_stats_listener_single_batched_sync(self, monkeypatch):
+        """Satellite contract: ONE jax.device_get of the whole param tree
+        per collection window (the old loop paid one sync per array)."""
+        calls = []
+        real = jax.device_get
+
+        def spy(tree):
+            calls.append(tree)
+            return real(tree)
+
+        monkeypatch.setattr(jax, "device_get", spy)
+        model = mln_model()
+        listener = StatsListener(InMemoryStatsStorage(), collect_every_n=1,
+                                 collect_timing=False)
+        x, y = xy(8)
+        listener.iteration_done(model, 1, jnp.asarray(0.5))
+        assert len(calls) == 1          # whole tree, one transfer
+        assert isinstance(calls[0], list)
+
+
+class TestUIServerHealthAndCache:
+    def test_health_endpoint(self, tmp_path):
+        ui = UIServer()     # fresh instance, not the singleton
+        port = ui.enable(0)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/api/health") as r:
+                h = json.load(r)
+            assert h["status"] == "ok"
+            assert h["uptime_s"] >= 0
+            assert isinstance(h["devices"], list) and h["devices"]
+            assert "platform" in h["devices"][0]
+            assert h["live_buffers"]["count"] >= 0
+            assert h["host"]["rss_bytes"] > 0
+            assert "jsonl_cache" in h
+            page = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/").read().decode()
+            assert 'id="health"' in page and "/api/health" in page
+        finally:
+            ui.stop()
+
+    def test_jsonl_tail_cache_appends(self, tmp_path):
+        path = str(tmp_path / "s.jsonl")
+        fs = FileStatsStorage(path)
+        for i in range(4):
+            fs.put_scalar("", "score", i, float(i))
+        cache = _JsonlTailCache()
+        r1 = cache.read(path)
+        assert len(r1) == 4 and cache.full_reads == 1
+        assert cache.read(path) is r1           # unchanged file: cache hit
+        assert cache.hits == 1
+        for i in range(4, 7):
+            fs.put_scalar("", "score", i, float(i))
+        r2 = cache.read(path)
+        assert len(r2) == 7
+        assert cache.tail_reads == 1 and cache.full_reads == 1
+        assert [r["step"] for r in r2] == list(range(7))
+
+    def test_jsonl_tail_cache_truncate_reparses(self, tmp_path):
+        path = str(tmp_path / "s.jsonl")
+        with open(path, "w") as f:
+            for i in range(5):
+                f.write(json.dumps({"tag": "a", "step": i,
+                                    "value": 1.0}) + "\n")
+        cache = _JsonlTailCache()
+        assert len(cache.read(path)) == 5
+        with open(path, "w") as f:      # rewrite smaller
+            f.write(json.dumps({"tag": "a", "step": 0, "value": 9.0}) + "\n")
+        r = cache.read(path)
+        assert len(r) == 1 and r[0]["value"] == 9.0
+        assert cache.full_reads == 2
+
+    def test_jsonl_tail_cache_rewrite_to_larger_size_reparses(self,
+                                                              tmp_path):
+        """A restarted run recreating the path can grow PAST the cached
+        offset between polls — the leading-bytes prefix check must force a
+        full reparse instead of serving dead-run records + a misparsed
+        tail."""
+        path = str(tmp_path / "s.jsonl")
+        with open(path, "w") as f:
+            f.write(json.dumps({"tag": "old", "step": 0,
+                                "value": 1.0}) + "\n")
+        cache = _JsonlTailCache()
+        assert [r["tag"] for r in cache.read(path)] == ["old"]
+        with open(path, "w") as f:      # rewrite, LARGER than the offset
+            for i in range(5):
+                f.write(json.dumps({"tag": "new", "step": i,
+                                    "value": 2.0}) + "\n")
+        r = cache.read(path)
+        assert [r_["tag"] for r_ in r] == ["new"] * 5
+        assert cache.full_reads == 2
+
+    def test_jsonl_tail_cache_torn_line_retried(self, tmp_path):
+        path = str(tmp_path / "s.jsonl")
+        with open(path, "w") as f:
+            f.write(json.dumps({"tag": "a", "step": 0, "value": 1.0}) + "\n")
+            f.write('{"tag": "a", "st')      # torn mid-write
+        cache = _JsonlTailCache()
+        assert len(cache.read(path)) == 1
+        with open(path, "a") as f:           # writer completes the line
+            f.write('ep": 1, "value": 2.0}\n')
+        r = cache.read(path)
+        assert [x["step"] for x in r] == [0, 1]
+
+    def test_server_series_skips_histogram_rows(self, tmp_path):
+        path = str(tmp_path / "s.jsonl")
+        fs = FileStatsStorage(path)
+        fs.put_scalar("", "score", 0, 1.0)
+        fs.put_histogram("", "score", 0, np.arange(4.0))
+        fs.close()
+        ui = UIServer()
+        ui.attach(path)
+        assert ui.tags() == ["score"]
+        assert ui.series("score") == [(0, 1.0)]
+
+
+class TestEvaluativeListenerGuard:
+    def test_failing_evaluate_does_not_kill_training(self, caplog):
+        model = mln_model()
+
+        class Boom:
+            pass
+
+        calls = []
+        real_evaluate = model.evaluate
+
+        def flaky(data, *a, **k):
+            calls.append(1)
+            raise RuntimeError("corrupt holdout batch")
+
+        model.evaluate = flaky
+        listener = EvaluativeListener(Boom(), frequency=1)
+        model.set_listeners(listener)
+        x, y = xy(8)
+        with caplog.at_level(logging.WARNING, "deeplearning4j_tpu"):
+            model.fit(DataSet(x, y), epochs=1)    # must not raise
+        assert calls                # evaluate was attempted
+        assert listener.history == []
+        assert any("evaluation failed" in r.message for r in caplog.records)
+        model.evaluate = real_evaluate
+
+    def test_misconfigured_metric_fails_fast(self):
+        """A metric-name typo is a config error, not a bad batch — it must
+        raise on the first boundary, not be silently skipped forever."""
+        model = mln_model()
+        x, y = xy(8)
+        listener = EvaluativeListener(DataSet(x, y), frequency=1,
+                                      metric="acuracy")
+        model.set_listeners(listener)
+        with pytest.raises(AttributeError):
+            model.fit(DataSet(x, y), epochs=1)
