@@ -670,6 +670,222 @@ def bench_telemetry_smoke(steps: int, batch: int = 64,
     }
 
 
+def bench_fault_smoke(steps: int, batch: int = 64,
+                      checkpoint_every: int | None = None) -> dict:
+    """CPU-friendly smoke of the fault-tolerance layer: a LeNet-class
+    conv model (realistic step-compute : checkpoint-bytes ratio — the
+    checkpoint payload is O(params) while the step is O(params x batch))
+    trained from an iterator with a partial final batch, once with
+    checkpointing off and once with an async-atomic CheckpointListener
+    attached, then one injected transient input fault, then a simulated
+    kill + exact resume. ``checkpoint_every`` defaults to 2 checkpoints
+    per epoch — a cadence the background writer sustains without
+    backpressure (submissions spaced further apart than one
+    serialize+commit), which is the regime async checkpointing is
+    designed for. Self-validating hard-fails:
+
+    - resume-parity mismatch: a run crashed mid-fit (injected
+      ``SimulatedCrash``) and resumed from its last intact checkpoint
+      must reproduce the uninterrupted run's loss sequence EXACTLY
+      (bit-identical float equality, CPU);
+    - any retrace in a timed window, or any compile-footprint delta
+      between the checkpoint-on and checkpoint-off configs;
+    - injected transient fault not retried/recovered (retry counter must
+      read exactly the injected count and training must complete);
+    - async checkpointing step-time overhead > 10% vs checkpoint-off
+      (interleaved A/B medians, same methodology as telemetry-smoke).
+
+    Emits the checkpoint ledger (snapshot readback time — the only
+    hot-loop cost — plus background write time and bytes) and the fault
+    ledger."""
+    import shutil
+    import statistics as _stats
+    import tempfile
+
+    import jax
+
+    from deeplearning4j_tpu.common import faultinject
+    from deeplearning4j_tpu.common.profiler import OpProfiler
+    from deeplearning4j_tpu.data import NDArrayDataSetIterator
+    from deeplearning4j_tpu.ndarray.rng import set_default_seed
+    from deeplearning4j_tpu.optimize.listeners import (
+        CheckpointListener, CollectScoresIterationListener)
+
+    if checkpoint_every is None:
+        checkpoint_every = max(5, (steps + 1) // 2)
+    rng = np.random.RandomState(0)
+    n = steps * batch + batch // 2      # the half batch forces a partial tail
+    x = rng.randn(n, 1, 28, 28).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+
+    def make_it():
+        return NDArrayDataSetIterator(x, y, batch_size=batch)
+
+    def fail(msg, **extra):
+        print(json.dumps({"error": msg, **extra}))
+        sys.exit(1)
+
+    prof = OpProfiler.get()
+    faultinject.clear_plan()
+    ckdir = tempfile.mkdtemp(prefix="dl4j_fault_smoke_")
+    try:
+        listeners = {}
+        models = {"off": _lenet_model(), "on": _lenet_model()}
+        listeners["on"] = CheckpointListener(
+            ckdir, save_every_n_iterations=checkpoint_every, keep_last=2)
+        models["on"].set_listeners(listeners["on"])
+
+        # compile footprint: checkpointing must not change it
+        warm = {}
+        for name, model in models.items():
+            prof.reset()
+            model.fit(make_it(), epochs=1, batch_size=batch)
+            float(model._score_dev)
+            warm[name] = prof.trace_counts()
+        if warm["on"] != warm["off"]:
+            fail("checkpointing changed the compile footprint "
+                 "(retrace delta)", off_traces=warm["off"],
+                 on_traces=warm["on"])
+
+        # paired A/B timing: async checkpoint overhead vs off. Each
+        # "on" window carries its own snapshots + the writer thread's
+        # concurrent serialize/commit contention; the residual in-flight
+        # tail is drained BETWEEN windows (untimed) so the "off" windows
+        # stay clean. Host-load drift on this box is time-correlated and
+        # larger than the effect measured, so the estimator is the MEDIAN
+        # OF PER-ROUND RATIOS (each round pairs an on and an off epoch
+        # back-to-back, order alternating) after one untimed warmup
+        # round — the drift hits both halves of a pair equally.
+        def timed_epoch(name):
+            t0 = time.perf_counter()
+            models[name].fit(make_it(), epochs=1, batch_size=batch)
+            float(models[name]._score_dev)      # value fence
+            dt = time.perf_counter() - t0
+            if name == "on":
+                listeners["on"].flush()         # drain tail, untimed
+            return dt
+
+        timed_epoch("on")                       # untimed settle-in round
+        timed_epoch("off")
+        prof.reset()
+        times = {"off": [], "on": []}
+        ratios = []
+        for r in range(6):
+            order = ("on", "off") if r % 2 == 0 else ("off", "on")
+            round_t = {name: timed_epoch(name) for name in order}
+            times["on"].append(round_t["on"])
+            times["off"].append(round_t["off"])
+            ratios.append(round_t["on"] / round_t["off"])
+        hot = prof.trace_counts()
+        if any(hot.values()):
+            fail("train step retraced inside a timed window", traces=hot)
+        ckpt_ledger = prof.checkpoint_stats()
+        t_off = _stats.median(times["off"])
+        t_on = _stats.median(times["on"])
+        overhead = _stats.median(ratios) - 1.0
+        if overhead > 0.10:
+            fail(f"async checkpoint overhead {overhead:.1%} exceeds the "
+                 "10% budget", off_s=round(t_off, 4), on_s=round(t_on, 4),
+                 off_times=[round(t, 4) for t in times["off"]],
+                 on_times=[round(t, 4) for t in times["on"]])
+
+        # one injected transient input fault: retried, recovered, counted
+        prof.reset()
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "pipeline/bind", "index": 1, "kind": "transient"}]))
+        models["on"].fit(make_it(), epochs=1, batch_size=batch)
+        faultinject.clear_plan()
+        if prof.counter_value("pipeline/retries") != 1:
+            fail("injected transient fault was not retried exactly once",
+                 retries=prof.counter_value("pipeline/retries"))
+        if prof.trace_counts():
+            fail("fault retry retraced the train step",
+                 traces=prof.trace_counts())
+        fault_ledger = prof.fault_stats()
+
+        # kill-resume parity: uninterrupted baseline vs crash+resume.
+        # Retire the timing listener's writer BEFORE clearing its
+        # directory out from under it.
+        listeners["on"].close()
+        shutil.rmtree(ckdir)
+        os.makedirs(ckdir)
+        par_epochs = 2
+        par_steps = min(steps, 8)
+        xs, ys = x[:par_steps * batch], y[:par_steps * batch]
+
+        def par_it():
+            return NDArrayDataSetIterator(xs, ys, batch_size=batch,
+                                          shuffle=True, seed=3)
+
+        set_default_seed(99)
+        base_model = _lenet_model()
+        base_scores = CollectScoresIterationListener()
+        base_model.set_listeners(base_scores)
+        base_model.fit(par_it(), epochs=par_epochs, batch_size=batch)
+        baseline = [s for _, s in base_scores.scores]
+
+        set_default_seed(99)
+        victim = _lenet_model()
+        vs = CollectScoresIterationListener()
+        cl = CheckpointListener(ckdir, save_every_n_iterations=3,
+                                keep_last=2)
+        victim.set_listeners(vs, cl)
+        crash_at = par_steps + 1       # mid-epoch-2
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "train/step", "index": crash_at, "kind": "crash"}]))
+        crashed = False
+        try:
+            victim.fit(par_it(), epochs=par_epochs, batch_size=batch)
+        except faultinject.SimulatedCrash:
+            crashed = True
+        faultinject.clear_plan()
+        cl.close()
+        if not crashed:
+            fail("injected crash did not fire", crash_at=crash_at)
+        last = CheckpointListener.last_checkpoint(ckdir)
+        if last is None:
+            fail("no intact checkpoint after simulated kill")
+        resumed_model = _lenet_model()
+        rs = CollectScoresIterationListener()
+        resumed_model.set_listeners(rs)
+        resumed_model.fit(par_it(), epochs=par_epochs, batch_size=batch,
+                          resume_from=last)
+        resumed = [s for _, s in rs.scores]
+        if resumed != baseline:
+            diff = next((i for i, (a, b) in enumerate(zip(baseline, resumed))
+                         if a != b), min(len(baseline), len(resumed)))
+            fail("resume-parity mismatch: killed+resumed loss sequence "
+                 "differs from the uninterrupted run",
+                 first_diff_step=diff, baseline_len=len(baseline),
+                 resumed_len=len(resumed),
+                 resumed_from=os.path.basename(last))
+
+        images = (n + (batch - n % batch) % batch)
+        return {
+            "metric": "fault_smoke",
+            "value": images / t_on,
+            "unit": "images/sec",
+            "batch": batch,
+            "platform": jax.devices()[0].platform,
+            "traces": warm["on"],
+            "checkpoint_overhead_frac": round(overhead, 4),
+            "epoch_s_off_median": round(t_off, 4),
+            "epoch_s_on_median": round(t_on, 4),
+            "checkpoint_ledger": {k: (round(v, 5) if isinstance(v, float)
+                                      else v)
+                                  for k, v in ckpt_ledger.items()},
+            "fault_ledger": fault_ledger,
+            "resume_parity": "exact",
+            "resume_steps_compared": len(baseline),
+            "data": "synthetic LeNet batches with a partial final batch; "
+                    "async checkpointing on vs off interleaved, one "
+                    "injected transient fault, one simulated kill+resume",
+        }
+    finally:
+        faultinject.clear_plan()
+        shutil.rmtree(ckdir, ignore_errors=True)
+
+
 def bench_word2vec(steps: int) -> dict:
     """North-star config 4: Word2Vec skip-gram + negative sampling over a
     synthetic zipfian corpus; throughput = corpus words consumed / sec
@@ -940,7 +1156,8 @@ def main() -> None:
                                  "word2vec", "word2vec-cbow", "word2vec-hs",
                                  "paragraph-vectors", "glove", "fasttext",
                                  "resnet50-disk", "resnet50-predecoded",
-                                 "pipeline-smoke", "telemetry-smoke"])
+                                 "pipeline-smoke", "telemetry-smoke",
+                                 "fault-smoke"])
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--batch", type=int, default=None,
                         help="per-config default: resnet50=128, bert=32")
@@ -1018,6 +1235,8 @@ def main() -> None:
         result = bench_pipeline_smoke(steps, batch=args.batch or 64)
     elif args.config == "telemetry-smoke":
         result = bench_telemetry_smoke(steps, batch=args.batch or 64)
+    elif args.config == "fault-smoke":
+        result = bench_fault_smoke(steps, batch=args.batch or 64)
     elif args.config == "resnet50-disk":
         result = bench_resnet50_disk(steps, batch=args.batch or 64)
     elif args.config == "resnet50-predecoded":

@@ -55,7 +55,8 @@ def prefetch_iter(source: Iterable[T], maxsize: int = 8) -> Iterator[T]:
         finally:
             _put(_END)
 
-    t = threading.Thread(target=worker, daemon=True)
+    t = threading.Thread(target=worker, daemon=True,
+                         name="dl4j-prefetch-worker")
     t.start()
     try:
         while True:
@@ -64,6 +65,10 @@ def prefetch_iter(source: Iterable[T], maxsize: int = 8) -> Iterator[T]:
                 break
             yield item
         if err:
+            # re-raising the ORIGINAL exception object surfaces the
+            # producer's frames at the consuming site: its __traceback__
+            # (captured on the worker thread) is preserved and the
+            # consumer's raise appends this frame to it
             raise err[0]
     finally:
         stop.set()
@@ -107,9 +112,18 @@ def staged_iter(source: Iterable[T],
     if stage is None:
         stage = lambda x: x  # noqa: E731
     buf: "collections.deque" = collections.deque()
-    for item in it:
-        buf.append(stage(item))
-        if len(buf) > depth:
+    try:
+        for item in it:
+            buf.append(stage(item))
+            if len(buf) > depth:
+                yield buf.popleft()
+        while buf:
             yield buf.popleft()
-    while buf:
-        yield buf.popleft()
+    finally:
+        # an abandoned staged_iter must close the inner prefetch
+        # generator NOW (running its finally: stop + drain + join) rather
+        # than leaving the worker thread to GC timing — tests that break
+        # out of a fit epoch would otherwise leak daemon threads
+        close = getattr(it, "close", None)
+        if close is not None:
+            close()

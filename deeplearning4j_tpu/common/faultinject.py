@@ -1,0 +1,222 @@
+"""Deterministic fault injection for the training/serving stack.
+
+Reference analog: the failure modes the dl4j-scaleout operational layer is
+built around (SURVEY §5.3) — preempted workers, torn checkpoint writes,
+flaky input sources, NaN batches, wedged inference replicas. None of them
+are reproducible on demand in the wild, so the fault-tolerance code paths
+(checkpoint fallback, pipeline retry, replica retirement, kill-resume)
+would otherwise only run in production. This module makes every one of
+them a *deterministic, step-indexed* event:
+
+- A :class:`FaultPlan` is a list of fault specs, each bound to a SITE
+  (``"pipeline/bind"``, ``"pipeline/place"``, ``"train/step"``,
+  ``"checkpoint/pre_rename"``, ``"inference/worker"``) and a zero-based
+  INDEX at that site (batch ordinal within a fit call, checkpoint commit
+  sequence, inference request ordinal).
+- Instrumented code calls :func:`fault_point(site, index)` at the matching
+  place. Raising kinds (``transient``, ``crash``, ``dead_replica``) raise
+  there; ``slow`` sleeps in place; advisory kinds (``nan``) are returned
+  for the caller to apply (e.g. poison the batch it is about to bind).
+- Plans come from code (:func:`set_plan` — tests) or the environment
+  (``DL4J_TPU_FAULT_PLAN`` = inline JSON or ``@/path/to/plan.json`` —
+  subprocess kill tests), so a hard-killed worker can be relaunched with
+  the exact same fault schedule.
+
+Spec fields: ``{"site": ..., "kind": ..., "index": k}`` plus per-kind
+extras — ``times`` (how many calls at that index fire, default 1; the
+retry tests use ``times: 2`` to fail two attempts then recover),
+``seconds`` (``slow``), ``mode`` (``crash``: ``"raise"`` raises
+:class:`SimulatedCrash`, ``"exit"`` hard-kills the process via
+``os._exit`` — the no-cleanup death a preempted worker sees), ``code``
+(exit status, default 137).
+
+Every fired fault bumps an ``OpProfiler`` counter
+(``faults/<site>/<kind>``), so a run can assert both that injected faults
+actually fired and that zero fired in production configs.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+ENV_PLAN = "DL4J_TPU_FAULT_PLAN"
+
+
+class TransientFault(RuntimeError):
+    """A retryable failure (flaky storage read, interrupted H2D transfer).
+    The input pipeline retries these with bounded exponential backoff."""
+
+    transient = True
+
+
+class SimulatedCrash(BaseException):
+    """An injected process death. Derives from BaseException so ordinary
+    ``except Exception`` recovery paths cannot accidentally swallow the
+    "kill" — it unwinds like a real SIGKILL would end the process."""
+
+
+class DeadReplicaFault(RuntimeError):
+    """An inference replica dying mid-request (wedged device, OOM-killed
+    worker). ParallelInference retires the worker that sees one."""
+
+
+def is_transient(exc: BaseException) -> bool:
+    """The pipeline's retry predicate: opt-in via the ``transient``
+    attribute (so user iterators can mark their own retryable errors)."""
+    return bool(getattr(exc, "transient", False))
+
+
+class FaultPlan:
+    """A deterministic, consumable schedule of faults. Thread-safe: sites
+    fire from the training thread, checkpoint-writer thread, and inference
+    workers alike."""
+
+    def __init__(self, faults: List[Dict[str, Any]]):
+        self._lock = threading.Lock()
+        self._specs = []
+        for f in faults:
+            spec = dict(f)
+            spec.setdefault("times", 1)
+            spec["_fired"] = 0
+            if "site" not in spec or "kind" not in spec:
+                raise ValueError(f"fault spec needs site and kind: {f!r}")
+            self._specs.append(spec)
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        return cls(json.loads(text))
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultPlan"]:
+        raw = os.environ.get(ENV_PLAN)
+        if not raw:
+            return None
+        if raw.startswith("@"):
+            with open(raw[1:]) as f:
+                raw = f.read()
+        return cls.from_json(raw)
+
+    def take(self, site: str, index: Optional[int]) -> List[Dict[str, Any]]:
+        """Consume and return the specs firing at (site, index). A spec
+        with no ``index`` matches every call at its site (up to ``times``)."""
+        fired = []
+        with self._lock:
+            for spec in self._specs:
+                if spec["site"] != site or spec["_fired"] >= spec["times"]:
+                    continue
+                # an indexed spec only matches the SAME index — an
+                # index-less call site (e.g. the manifest's own atomic
+                # write) never consumes an indexed fault
+                want = spec.get("index")
+                if want is not None and want != index:
+                    continue
+                spec["_fired"] += 1
+                fired.append(spec)
+        return fired
+
+    def fired_count(self, site: Optional[str] = None) -> int:
+        with self._lock:
+            return sum(s["_fired"] for s in self._specs
+                       if site is None or s["site"] == site)
+
+
+_plan_lock = threading.Lock()
+_plan: Optional[FaultPlan] = None
+_env_checked = False
+
+
+def get_plan() -> Optional[FaultPlan]:
+    """The active plan: set_plan() wins; otherwise DL4J_TPU_FAULT_PLAN is
+    parsed once per process. None (the overwhelmingly common case) keeps
+    fault_point() to a single attribute check."""
+    global _plan, _env_checked
+    with _plan_lock:
+        if _plan is None and not _env_checked:
+            _env_checked = True
+            _plan = FaultPlan.from_env()
+        return _plan
+
+
+def set_plan(plan: Optional[FaultPlan]) -> None:
+    global _plan, _env_checked
+    with _plan_lock:
+        _plan = plan
+        _env_checked = True   # an explicit None must not resurrect the env plan
+
+
+def clear_plan() -> None:
+    """Reset to 'no plan, env re-read on next use' (test teardown)."""
+    global _plan, _env_checked
+    with _plan_lock:
+        _plan = None
+        _env_checked = False
+
+
+def fault_point(site: str, index: Optional[int] = None) -> List[Dict[str, Any]]:
+    """The instrumentation hook. Raising/sleeping kinds act here; advisory
+    specs (``nan`` — and any unrecognized kind) are returned for the call
+    site to apply. Returns [] when no plan is active (the hot-path cost is
+    one function call + one lock-free None check)."""
+    plan = _plan if _env_checked else get_plan()
+    if plan is None:
+        return []
+    fired = plan.take(site, index)
+    if not fired:
+        return []
+    from .profiler import OpProfiler
+
+    prof = OpProfiler.get()
+    advisory = []
+    for spec in fired:
+        kind = spec["kind"]
+        prof.count(f"faults/{site}/{kind}")
+        logger.warning("faultinject: firing %s at %s[%s]", kind, site, index)
+        if kind == "slow":
+            time.sleep(float(spec.get("seconds", 0.1)))
+        elif kind == "transient":
+            raise TransientFault(
+                f"injected transient fault at {site}[{index}]")
+        elif kind == "dead_replica":
+            raise DeadReplicaFault(
+                f"injected replica death at {site}[{index}]")
+        elif kind == "crash":
+            if spec.get("mode", "raise") == "exit":
+                os._exit(int(spec.get("code", 137)))
+            raise SimulatedCrash(f"injected crash at {site}[{index}]")
+        else:
+            advisory.append(spec)
+    return advisory
+
+
+def retry_call(fn, what: str, max_retries: int = 3,
+               base_delay_s: float = 0.05, max_delay_s: float = 2.0):
+    """Run ``fn()`` retrying TRANSIENT failures (:func:`is_transient`)
+    with bounded exponential backoff. Non-transient exceptions and the
+    final exhausted attempt propagate unchanged. Every retry bumps
+    ``pipeline/retries`` and the backoff wall time is ledgered under the
+    ``pipeline/retry_backoff`` profiler section — the fault-smoke bench
+    and tests assert recovery happened (and didn't in clean runs)."""
+    from .profiler import OpProfiler
+
+    prof = OpProfiler.get()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except Exception as e:
+            if not is_transient(e) or attempt >= max_retries:
+                raise
+            delay = min(base_delay_s * (2 ** attempt), max_delay_s)
+            logger.warning("%s failed transiently (%s); retry %d/%d in "
+                           "%.2fs", what, e, attempt + 1, max_retries, delay)
+            prof.count("pipeline/retries")
+            with prof.time_section("pipeline/retry_backoff"):
+                time.sleep(delay)
+            attempt += 1

@@ -138,6 +138,40 @@ class OpProfiler:
             out["drained_steps"] = n
         return out
 
+    def checkpoint_stats(self) -> Dict[str, float]:
+        """Checkpoint-path ledger: snapshot time (the batched readback on
+        the training thread — the ONLY hot-loop cost of async
+        checkpointing), background write/commit time, committed count and
+        bytes. Empty when no checkpoint ever committed."""
+        out: Dict[str, float] = {}
+        for sec, key in (("checkpoint/snapshot", "snapshot_s"),
+                         ("checkpoint/write", "write_s")):
+            s = self._sections.get(sec)
+            if s:
+                out[key] = s["total_s"]
+                out[key.replace("_s", "_count")] = s["count"]
+        for ctr, key in (("checkpoint/committed", "committed"),
+                         ("checkpoint/bytes", "bytes")):
+            n = self._counters.get(ctr)
+            if n:
+                out[key] = n
+        return out
+
+    def fault_stats(self) -> Dict[str, float]:
+        """Fault-tolerance ledger: injected-fault counters
+        (``faults/<site>/<kind>``), pipeline retry count, and backoff wall
+        time. The fault-smoke bench asserts on these both ways: injected
+        faults fired, and clean configs fired none."""
+        out: Dict[str, float] = {k: v for k, v in self._counters.items()
+                                 if k.startswith("faults/")}
+        n = self._counters.get("pipeline/retries")
+        if n:
+            out["retries"] = n
+        s = self._sections.get("pipeline/retry_backoff")
+        if s:
+            out["retry_backoff_s"] = s["total_s"]
+        return out
+
     def print_statistics(self) -> str:
         lines = [f"{'section':<32}{'count':>8}{'total ms':>12}"
                  f"{'mean ms':>12}{'max ms':>12}"]

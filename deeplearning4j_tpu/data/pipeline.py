@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..common import faultinject
 from ..common.background import staged_iter
 from ..common.profiler import OpProfiler
 from ..ndarray.ndarray import NDArray
@@ -223,35 +224,129 @@ def timed_iter(it: Iterable, section: str = "pipeline/next_batch"):
         yield item
 
 
+def _poison_nan(batch):
+    """Apply an injected ``nan`` fault: every floating array of the
+    batch's FIRST element (features — array, dict, or list alike) is
+    multiplied by NaN, which drives the step's loss and gradients
+    non-finite exactly the way a corrupt record would. Composes with the
+    telemetry layer's NanSentinelListener policies."""
+    def nanify(a):
+        if hasattr(a, "dtype") and np.issubdtype(np.dtype(a.dtype),
+                                                 np.floating):
+            return a * float("nan")
+        return a
+
+    return (jax.tree.map(nanify, batch[0]),) + tuple(batch[1:])
+
+
 def run_epochs(data: Any, epochs: int, batch_size: Optional[int],
                pad_partial: bool, drop_remainder: bool, prefetch: int,
                steps_per_dispatch: int, bind, place, dispatch_one,
                dispatch_chunk, stackable, on_epoch,
                round_to_multiple_of: int = 1,
                allow_multi: bool = False,
-               host_prefetch: int = 0) -> None:
+               host_prefetch: int = 0,
+               skip: Optional[Tuple[int, int]] = None) -> None:
     """The one training-loop skeleton shared by MultiLayerNetwork.fit,
     ComputationGraph.fit, and ParallelWrapper.fit: per epoch, stable
     batches are bound (``bind(ds, w)`` → jit argument tuple), staged
     ``prefetch`` ahead through ``place``, and dispatched either per step
     or in ``steps_per_dispatch``-sized chunks — a chunk tail (or a
     shape-unstable group, per ``stackable``) falls back to the per-step
-    path instead of compiling a second device loop for its length."""
+    path instead of compiling a second device loop for its length.
+
+    **Fault tolerance** (common.faultinject): ``bind`` and ``place`` are
+    wrapped in :func:`faultinject.retry_call` — transient failures
+    (injected or user-marked via a ``transient`` attribute) retry with
+    bounded exponential backoff, profiler-counted under
+    ``pipeline/retries``. Fault-plan sites fire here deterministically:
+    ``pipeline/bind`` (indexed by the fit call's batch ordinal; advisory
+    ``nan`` specs poison the bound batch), ``pipeline/place``, and
+    ``train/step`` (indexed by dispatch ordinal; a ``crash`` spec raises
+    :class:`faultinject.SimulatedCrash` before the step dispatches — the
+    in-process stand-in for preemption).
+
+    **Resume** (``skip=(epochs_done, steps_in_epoch)``): fast-forward a
+    checkpoint cursor by REPLAYING the host side — completed epochs are
+    consumed from the source (advancing any per-epoch shuffle RNG exactly
+    as the killed run did) without binding or dispatching, and the resume
+    epoch's first ``steps_in_epoch`` stable batches are drawn and
+    discarded. Dispatch then continues with the restored params/updater/
+    RNG key, making the continuation bit-identical to the uninterrupted
+    run. The post-checkpoint remainder of the resume epoch replays fully,
+    including its ``on_epoch`` boundary."""
     k = max(1, int(steps_per_dispatch))
-    for _ in range(max(1, epochs)):
-        bound = (bind(ds, w) for ds, w, _n in
-                 stable_batches(data, batch_size, pad_partial=pad_partial,
-                                drop_remainder=drop_remainder,
-                                round_to_multiple_of=round_to_multiple_of,
-                                allow_multi=allow_multi))
-        feed = timed_iter(device_feed(bound, place=place,
+    skip_epochs, skip_steps = skip if skip is not None else (0, 0)
+    n_bound = 0       # batch ordinal within this fit call (fault indexing)
+    n_dispatched = 0  # dispatch ordinal within this fit call
+
+    def guarded_bind(ds, w):
+        nonlocal n_bound
+        ordinal = n_bound
+        n_bound += 1
+
+        def attempt():
+            advisory = faultinject.fault_point("pipeline/bind", ordinal)
+            b = bind(ds, w)
+            for spec in advisory:
+                if spec["kind"] == "nan":
+                    b = _poison_nan(b)
+            return b
+
+        return faultinject.retry_call(attempt, "pipeline/bind")
+
+    n_placed = [0]
+
+    def guarded_place(b):
+        ordinal = n_placed[0]
+        n_placed[0] += 1
+
+        def attempt():
+            faultinject.fault_point("pipeline/place", ordinal)
+            return place(b)
+
+        return faultinject.retry_call(attempt, "pipeline/place")
+
+    for e in range(max(1, epochs)):
+        if e < skip_epochs:
+            # completed pre-kill: consume (advances iterator/shuffle
+            # state), dispatch nothing, and do NOT re-fire on_epoch —
+            # its effects are part of the restored checkpoint state
+            for _ in iter_datasets(data, batch_size,
+                                   allow_multi=allow_multi):
+                pass
+            continue
+        gen = stable_batches(data, batch_size, pad_partial=pad_partial,
+                             drop_remainder=drop_remainder,
+                             round_to_multiple_of=round_to_multiple_of,
+                             allow_multi=allow_multi)
+        if e == skip_epochs and skip_steps:
+            skipped = 0
+            for _ in gen:
+                skipped += 1
+                if skipped >= skip_steps:
+                    break
+            if skipped < skip_steps:
+                import logging
+
+                logging.getLogger("deeplearning4j_tpu").warning(
+                    "resume cursor wants %d steps into the epoch but the "
+                    "source produced %d batches — did the data change "
+                    "since the checkpoint?", skip_steps, skipped)
+        bound = (guarded_bind(ds, w) for ds, w, _n in gen)
+        feed = timed_iter(device_feed(bound, place=guarded_place,
                                       depth=max(0, int(prefetch)),
                                       host_prefetch=max(0, int(host_prefetch))))
         if k == 1:
             for b in feed:
+                faultinject.fault_point("train/step", n_dispatched)
+                n_dispatched += 1
                 dispatch_one(b)
         else:
             for group in chunked(feed, k):
+                for j in range(len(group)):
+                    faultinject.fault_point("train/step", n_dispatched + j)
+                n_dispatched += len(group)
                 if len(group) == k and stackable(group):
                     dispatch_chunk(group)
                 else:
@@ -271,8 +366,16 @@ def note_steps(holder: Any, listeners: Iterable, losses,
     when the step was built with telemetry; listeners exposing
     ``telemetry_done`` receive them un-synced (TelemetrySink /
     NanSentinelListener batch their own readbacks)."""
+    last = len(losses) - 1
     for i, loss in enumerate(losses):
         holder._iteration += 1
+        # resume-cursor bookkeeping: steps completed within the current
+        # epoch (reset by the fit loops' on_epoch), and whether the
+        # holder's published params correspond to THIS step — inside a
+        # scan chunk they only do at the final step, so checkpoint-style
+        # listeners defer their snapshot to the dispatch boundary
+        holder._steps_in_epoch = getattr(holder, "_steps_in_epoch", 0) + 1
+        holder._at_dispatch_boundary = (i == last)
         holder._score_dev = loss
         aux = auxes[i] if auxes is not None else None
         for lst in listeners:

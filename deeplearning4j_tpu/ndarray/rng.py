@@ -43,6 +43,28 @@ class Random:
             self._key, sub = jax.random.split(self._key)
             return sub
 
+    # --- checkpoint/resume support ------------------------------------
+    def get_state(self) -> dict:
+        """The full stream state. Capturing ``key`` (not just the seed)
+        is what makes a resumed run draw the EXACT keys the killed run
+        would have drawn next — seed-only restore would replay the stream
+        from the beginning (util.checkpoint snapshots this)."""
+        with self._lock:
+            return {"seed": self._seed, "key": self._key}
+
+    def set_state(self, state: dict) -> None:
+        """Restore a :meth:`get_state` snapshot; ``key`` may arrive as a
+        jax array, numpy array, or the (list, dtype-string) pair a JSON
+        checkpoint round-trip produces."""
+        import numpy as np
+
+        key = state["key"]
+        if not hasattr(key, "dtype") or not hasattr(key, "shape"):
+            key = np.asarray(key, dtype=state.get("key_dtype", "uint32"))
+        with self._lock:
+            self._key = jnp.asarray(key)
+            self._seed = int(state.get("seed", self._seed))
+
     # --- distribution draws -------------------------------------------
     def uniform(self, shape: Sequence[int], low: float = 0.0, high: float = 1.0,
                 dtype=jnp.float32) -> NDArray:

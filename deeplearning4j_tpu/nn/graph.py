@@ -427,6 +427,12 @@ class ComputationGraph:
 
     def set_listeners(self, *listeners) -> None:
         self._listeners = list(listeners)
+        for lst in self._listeners:
+            # checkpoint-style listeners snapshot their peers' state for
+            # exact resume (see MultiLayerNetwork.set_listeners)
+            bind = getattr(lst, "bind_group", None)
+            if callable(bind):
+                bind(self._listeners)
         from ..optimize.telemetry import config_for
 
         cfg = config_for(self._listeners)
@@ -688,19 +694,22 @@ class ComputationGraph:
     def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None,
             *, pad_partial: Optional[bool] = None,
             drop_remainder: bool = False, prefetch: int = 2,
-            steps_per_dispatch: int = 1, host_prefetch: int = 0) -> None:
+            steps_per_dispatch: int = 1, host_prefetch: int = 0,
+            resume_from: Optional[str] = None) -> None:
         """Training loop on the shared input/dispatch pipeline
         (data/pipeline.py): shape-stable padded batching with the example
         weight threaded into every output's loss, device placement issued
         ``prefetch`` batches ahead, and an opt-in ``steps_per_dispatch``
-        lax.scan device loop. See MultiLayerNetwork.fit for knob docs."""
+        lax.scan device loop. See MultiLayerNetwork.fit for knob docs,
+        including ``resume_from`` (exact checkpoint resume)."""
         self._check_init()
+        skip = self._begin_fit(resume_from)
         if self._updater_state is None:
             self._updater_state = self.conf.global_conf.updater.init(self._params)
         if self._fit_step is None:
             self._fit_step = self._build_fit_step()
         if isinstance(data, (DataSet, MultiDataSet)) and batch_size is None:
-            self._fit_serial(data, epochs)
+            self._fit_serial(data, epochs, skip=skip)
             return
         if steps_per_dispatch > 1 and self._chunk_step is None:
             self._chunk_step = self._build_chunk_step()
@@ -708,6 +717,7 @@ class ComputationGraph:
 
         def on_epoch():
             self._epoch += 1
+            self._steps_in_epoch = 0
             for lst in self._listeners:
                 if hasattr(lst, "epoch_done"):
                     lst.epoch_done(self, self._epoch)
@@ -722,7 +732,13 @@ class ComputationGraph:
             dispatch_one=lambda b: self._dispatch_one(b, prof),
             dispatch_chunk=lambda g: self._dispatch_chunk(g, prof),
             stackable=_chunk_stackable, on_epoch=on_epoch,
-            allow_multi=True, host_prefetch=host_prefetch)
+            allow_multi=True, host_prefetch=host_prefetch, skip=skip)
+
+    def _begin_fit(self, resume_from: Optional[str]):
+        from ..util.checkpoint import begin_fit_cursor
+
+        return begin_fit_cursor(self, resume_from,
+                                listeners=self._listeners)
 
     def _dispatch_one(self, b, prof) -> None:
         inputs, labels, masks, w = b
@@ -747,9 +763,18 @@ class ComputationGraph:
         _pipe.note_dispatch(self, self._listeners, out,
                             self._telemetry is not None, len(group))
 
-    def _fit_serial(self, data, epochs: int = 1) -> None:
-        for _ in range(max(1, epochs)):
+    def _fit_serial(self, data, epochs: int = 1, skip=None) -> None:
+        skip_epochs, skip_steps = skip if skip is not None else (0, 0)
+        for e in range(max(1, epochs)):
+            if e < skip_epochs:
+                for _ in _iter_graph_data(data):
+                    pass
+                continue
+            to_skip = skip_steps if e == skip_epochs else 0
             for ds in _iter_graph_data(data):
+                if to_skip:
+                    to_skip -= 1
+                    continue
                 inputs, labels, masks = self._bind_dataset(ds)
                 key = get_random().next_key()
                 out = self._fit_step(self._params, self._states,
@@ -759,6 +784,7 @@ class ComputationGraph:
                 _pipe.note_dispatch(self, self._listeners, out,
                                     self._telemetry is not None)
             self._epoch += 1
+            self._steps_in_epoch = 0
             for lst in self._listeners:
                 if hasattr(lst, "epoch_done"):
                     lst.epoch_done(self, self._epoch)

@@ -71,6 +71,12 @@ class MultiLayerNetwork:
 
     def set_listeners(self, *listeners) -> None:
         self._listeners = list(listeners)
+        for lst in self._listeners:
+            # checkpoint-style listeners snapshot their peers' state
+            # (state_dict protocol) for exact resume
+            bind = getattr(lst, "bind_group", None)
+            if callable(bind):
+                bind(self._listeners)
         from ..optimize.telemetry import config_for
 
         cfg = config_for(self._listeners)
@@ -504,7 +510,8 @@ class MultiLayerNetwork:
     def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None,
             *, pad_partial: Optional[bool] = None,
             drop_remainder: bool = False, prefetch: int = 2,
-            steps_per_dispatch: int = 1, host_prefetch: int = 0) -> None:
+            steps_per_dispatch: int = 1, host_prefetch: int = 0,
+            resume_from: Optional[str] = None) -> None:
         """The north-star loop (SURVEY.md §3.1): per minibatch, ONE compiled
         train-step executes forward+backward+updater on device. The host
         side runs the shared input/dispatch pipeline (data/pipeline.py):
@@ -535,8 +542,18 @@ class MultiLayerNetwork:
         rows beat zero rows); pass ``drop_remainder=True`` or
         ``pad_partial=False`` if exact BN parity with the unpadded loop
         matters more than trace stability.
+
+        ``resume_from`` (preemption recovery, SURVEY §5.3): path of a
+        checkpoint written by CheckpointListener. Restores params, layer
+        states, updater state, iteration/epoch counters, the RNG stream
+        key, and listener state, then fast-forwards the input pipeline to
+        the checkpoint's cursor — the resumed call must be given the SAME
+        data/epochs/batch arguments as the killed one, and its loss
+        sequence continues bit-identically (CPU, per-example models)
+        where the uninterrupted run would have gone.
         """
         self._check_init()
+        skip = self._begin_fit(resume_from)
         if self._updater_state is None:
             self._updater_state = self.conf.global_conf.updater.init(self._params)
         if self._fit_step is None:
@@ -548,7 +565,7 @@ class MultiLayerNetwork:
         # segment loop — both stay on the serial path.
         if tbptt or (isinstance(data, (DataSet, tuple))
                      and batch_size is None):
-            self._fit_serial(data, epochs, batch_size)
+            self._fit_serial(data, epochs, batch_size, skip=skip)
             return
         if steps_per_dispatch > 1 and self._chunk_step is None:
             self._chunk_step = self._build_chunk_step()
@@ -556,6 +573,7 @@ class MultiLayerNetwork:
 
         def on_epoch():
             self._epoch += 1
+            self._steps_in_epoch = 0
             for lst in self._listeners:
                 if hasattr(lst, "epoch_done"):
                     lst.epoch_done(self, self._epoch)
@@ -569,7 +587,13 @@ class MultiLayerNetwork:
             dispatch_one=lambda b: self._dispatch_one(b, prof),
             dispatch_chunk=lambda g: self._dispatch_chunk(g, prof),
             stackable=_same_shapes, on_epoch=on_epoch,
-            host_prefetch=host_prefetch)
+            host_prefetch=host_prefetch, skip=skip)
+
+    def _begin_fit(self, resume_from: Optional[str]):
+        from ..util.checkpoint import begin_fit_cursor
+
+        return begin_fit_cursor(self, resume_from,
+                                listeners=self._listeners)
 
     def _bind_batch(self, ds: DataSet, w):
         """DataSet → the jit argument tuple (x, y, mask, fmask, w)."""
@@ -605,10 +629,22 @@ class MultiLayerNetwork:
                             self._telemetry is not None, len(group))
 
     def _fit_serial(self, data, epochs: int = 1,
-                    batch_size: Optional[int] = None) -> None:
+                    batch_size: Optional[int] = None, skip=None) -> None:
         tbptt = self.conf.backprop_type == "TruncatedBPTT"
-        for _ in range(max(1, epochs)):
+        skip_epochs, skip_steps = skip if skip is not None else (0, 0)
+        for e in range(max(1, epochs)):
+            if e < skip_epochs:
+                # resume fast-forward: consume (advances iterator state),
+                # dispatch nothing; on_epoch effects are already in the
+                # restored checkpoint
+                for _ in _iter_data(data, batch_size):
+                    pass
+                continue
+            to_skip = skip_steps if e == skip_epochs else 0
             for ds in _iter_data(data, batch_size):
+                if to_skip:
+                    to_skip -= 1
+                    continue
                 x = jnp.asarray(ds.features.value)
                 y = jnp.asarray(ds.labels.value)
                 mask = (jnp.asarray(ds.labels_mask.value)
@@ -631,6 +667,7 @@ class MultiLayerNetwork:
                     _pipe.note_dispatch(self, self._listeners, out,
                                         self._telemetry is not None)
             self._epoch += 1
+            self._steps_in_epoch = 0
             for lst in self._listeners:
                 if hasattr(lst, "epoch_done"):
                     lst.epoch_done(self, self._epoch)

@@ -114,15 +114,18 @@ class SharedTrainingMaster:
 
     def fit(self, model, data, epochs: int = 1):
         """Train `model` over all global devices; resumes from the latest
-        checkpoint in `checkpoint_dir` when one exists (kill-resume story)."""
+        INTACT checkpoint in `checkpoint_dir` when one exists (kill-resume
+        story, SURVEY §5.3) — the restart loop is "relaunch the same
+        command": the checkpoint's cursor fast-forwards the input pipeline
+        so the continuation is exact, a checkpoint torn by the kill is
+        skipped by checksum, and checkpointing itself runs on the async
+        atomic writer (closed — i.e. made durable — before fit returns)."""
         from ..optimize.listeners import CheckpointListener
         from .accumulator import EncodedGradientsAccumulator
         from .wrapper import ParallelWrapper
 
-        if self.checkpoint_dir:
-            last = CheckpointListener.last_checkpoint(self.checkpoint_dir)
-            if last is not None:
-                model = type(model).load(last, load_updater=True)
+        resume = (CheckpointListener.last_checkpoint(self.checkpoint_dir)
+                  if self.checkpoint_dir else None)
         builder = (ParallelWrapper.Builder(model)
                    .workers(self.workers())
                    .training_mode("shared_gradients"))
@@ -130,8 +133,15 @@ class SharedTrainingMaster:
             builder.gradients_accumulator(
                 EncodedGradientsAccumulator(threshold_algorithm=self.threshold_algorithm))
         pw = builder.build()
+        ckpt = None
         if self.checkpoint_dir and self.checkpoint_every:
-            pw.set_listeners(CheckpointListener(
-                self.checkpoint_dir, save_every_n_iterations=self.checkpoint_every))
-        pw.fit(data, epochs=epochs)
+            ckpt = CheckpointListener(
+                self.checkpoint_dir,
+                save_every_n_iterations=self.checkpoint_every)
+            pw.set_listeners(ckpt)
+        try:
+            pw.fit(data, epochs=epochs, resume_from=resume)
+        finally:
+            if ckpt is not None:
+                ckpt.close()   # durability point: all submitted writes commit
         return model

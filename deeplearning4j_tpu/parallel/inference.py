@@ -5,22 +5,48 @@ Reference: dl4j-scaleout ``org.deeplearning4j.parallelism.ParallelInference``
 ``batch_limit`` of them, a worker runs the model, results scatter back to
 futures. On TPU one jitted apply replaces the per-device replica pool — the
 chip is time-shared by the XLA queue — so the host-side micro-batcher is the
-part worth keeping.
+part worth keeping: ``workers`` coalescing threads ("replicas") share one
+request queue.
 
 Modes (reference InferenceMode): SEQUENTIAL (run immediately, no batching),
 BATCHED (coalesce); INPLACE maps to SEQUENTIAL.
+
+Failure contract (the §5.3 serving story):
+
+- **Per-request timeouts**: :meth:`output` bounds its wait with a
+  ``max_wait_ms``-derived deadline (override:
+  ``Builder.request_timeout_ms``) and raises a ``TimeoutError`` naming the
+  queue depth and live-replica count instead of blocking forever on a
+  wedged replica.
+- **Failed-replica retirement**: a worker whose model dies fatally
+  (:class:`faultinject.DeadReplicaFault` — e.g. a wedged device) fails its
+  in-flight batch, retires itself, and leaves the remaining replicas
+  serving; when the LAST replica retires, queued and future requests fail
+  fast instead of queueing into a void. Ordinary per-batch exceptions
+  scatter to that batch's futures and the replica keeps serving (a bad
+  request must not kill the worker).
+- **Shutdown fails queued futures**: :meth:`shutdown` stops the workers,
+  then resolves every still-queued future with an error — no waiter is
+  left hanging on a future nobody will fulfil.
 """
 
 from __future__ import annotations
 
+import concurrent.futures
+import logging
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from typing import Any, List, Optional
 
 import numpy as np
 
+from ..common import faultinject
+from ..common.profiler import OpProfiler
 from ..ndarray.ndarray import NDArray
+
+logger = logging.getLogger("deeplearning4j_tpu")
 
 
 class ParallelInference:
@@ -31,6 +57,8 @@ class ParallelInference:
             self._batch_limit = 32
             self._queue_limit = 64
             self._max_wait_ms = 5.0
+            self._workers = 1
+            self._request_timeout_ms: Optional[float] = None
 
         def inference_mode(self, mode: str) -> "ParallelInference.Builder":
             self._mode = mode.lower()
@@ -52,70 +80,210 @@ class ParallelInference:
             self._max_wait_ms = ms
             return self
 
+        def workers(self, n: int) -> "ParallelInference.Builder":
+            """Coalescing worker threads sharing the request queue (the
+            replica-pool analog; reference ``workers(int)``)."""
+            self._workers = max(1, int(n))
+            return self
+
+        def request_timeout_ms(self, ms: float) -> "ParallelInference.Builder":
+            """Hard deadline for :meth:`output`. Default: derived from
+            ``max_wait_ms`` (see ParallelInference.__init__)."""
+            self._request_timeout_ms = ms
+            return self
+
         def build(self) -> "ParallelInference":
             return ParallelInference(self._model, self._mode, self._batch_limit,
-                                     self._queue_limit, self._max_wait_ms)
+                                     self._queue_limit, self._max_wait_ms,
+                                     workers=self._workers,
+                                     request_timeout_ms=self._request_timeout_ms)
 
     def __init__(self, model, mode: str = "batched", batch_limit: int = 32,
-                 queue_limit: int = 64, max_wait_ms: float = 5.0):
+                 queue_limit: int = 64, max_wait_ms: float = 5.0,
+                 workers: int = 1,
+                 request_timeout_ms: Optional[float] = None):
         self.model = model
         self.mode = "sequential" if mode in ("sequential", "inplace") else "batched"
         self.batch_limit = batch_limit
         self.max_wait_s = max_wait_ms / 1000.0
+        # a healthy replica turns a batch around in ~max_wait_s; 1000x that
+        # (floor 10s) only ever fires on a genuinely wedged pipeline
+        self.request_timeout_s = (request_timeout_ms / 1000.0
+                                  if request_timeout_ms is not None
+                                  else max(1000.0 * self.max_wait_s, 10.0))
         self._queue: "queue.Queue" = queue.Queue(maxsize=queue_limit)
         self._shutdown = False
-        self._worker: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._req_seq = 0
+        self._workers: List[threading.Thread] = []
+        self._alive = 0
         if self.mode == "batched":
-            self._worker = threading.Thread(target=self._drain, daemon=True)
-            self._worker.start()
+            self._alive = max(1, int(workers))
+            for i in range(self._alive):
+                t = threading.Thread(target=self._drain, args=(i,),
+                                     daemon=True,
+                                     name=f"dl4j-inference-{i}")
+                self._workers.append(t)
+                t.start()
 
     # ------------------------------------------------------------------
+    def alive_replicas(self) -> int:
+        with self._lock:
+            return self._alive
+
     def output(self, x) -> NDArray:
-        """Synchronous single-request API (reference output())."""
-        return self.output_async(x).result()
+        """Synchronous single-request API (reference output()), bounded by
+        the per-request deadline."""
+        fut = self.output_async(x)
+        try:
+            return fut.result(timeout=self.request_timeout_s)
+        except concurrent.futures.TimeoutError:
+            raise TimeoutError(
+                f"inference request timed out after "
+                f"{self.request_timeout_s:.1f}s (queue depth "
+                f"{self._queue.qsize()}, {self.alive_replicas()}/"
+                f"{len(self._workers) or 1} replicas alive); a wedged "
+                f"replica or an overloaded queue — raise "
+                f"request_timeout_ms or add workers") from None
 
     def output_async(self, x) -> Future:
         arr = np.asarray(x.value if isinstance(x, NDArray) else x)
         fut: Future = Future()
-        if self.mode == "sequential" or self._shutdown:
-            fut.set_result(self._run(arr))
+        if self._shutdown:
+            fut.set_exception(RuntimeError(
+                "ParallelInference is shut down; no replicas will serve "
+                "this request"))
             return fut
-        self._queue.put((arr, fut))
+        if self.mode == "sequential":
+            try:
+                fut.set_result(self._run(arr))
+            except Exception as e:
+                fut.set_exception(e)
+            return fut
+        if self.alive_replicas() == 0:
+            fut.set_exception(RuntimeError(
+                "all inference replicas have been retired (fatal replica "
+                "failures); restart the ParallelInference"))
+            return fut
+        with self._lock:
+            seq = self._req_seq
+            self._req_seq += 1
+        try:
+            # the enqueue itself is bounded by the request deadline too:
+            # a full queue behind a wedged replica must not turn the
+            # "timeout instead of hang" contract into an untimed block
+            self._queue.put((arr, fut, seq),
+                            timeout=self.request_timeout_s)
+        except queue.Full:
+            fut.set_exception(TimeoutError(
+                f"inference queue stayed full (depth "
+                f"{self._queue.qsize()}) for {self.request_timeout_s:.1f}s "
+                f"({self.alive_replicas()}/{len(self._workers) or 1} "
+                f"replicas alive)"))
+            return fut
+        # re-check AFTER enqueueing: the last replica may have retired
+        # between the alive check above and the put, in which case nobody
+        # will ever drain this request — fail it now rather than hang
+        if self.alive_replicas() == 0:
+            self._fail_queued(RuntimeError(
+                "all inference replicas have been retired (fatal replica "
+                "failures); restart the ParallelInference"))
         return fut
 
     def _run(self, batch: np.ndarray) -> NDArray:
         out = self.model.output(batch)
         return out[0] if isinstance(out, list) else out
 
-    def _drain(self) -> None:
+    def _retire(self, worker_id: int, exc: BaseException, futures) -> None:
+        """Fatal-failure bookkeeping shared by every way a worker dies:
+        fail the in-flight batch, drop the replica from the pool, and —
+        when it was the last one — fail everything still queued."""
+        for fut in futures:
+            if not fut.done():
+                fut.set_exception(exc if isinstance(exc, Exception)
+                                  else RuntimeError(f"inference replica "
+                                                    f"died: {exc}"))
+        OpProfiler.get().count("inference/replica_retired")
+        with self._lock:
+            self._alive -= 1
+            last = self._alive == 0
+        logger.warning("inference replica %d retired (%s); %d replicas "
+                       "remain", worker_id, exc, self.alive_replicas())
+        if last:
+            self._fail_queued(RuntimeError(
+                "all inference replicas retired"))
+
+    def _drain(self, worker_id: int) -> None:
+        prof = OpProfiler.get()
         while not self._shutdown:
             try:
                 first = self._queue.get(timeout=0.1)
             except queue.Empty:
                 continue
             batch = [first]
-            deadline = self.max_wait_s
+            # ONE coalescing window for the whole batch (an absolute
+            # deadline): a per-get timeout would reset with every
+            # trickling request and hold the first waiter up to
+            # batch_limit x max_wait_s
+            deadline = time.monotonic() + self.max_wait_s
             while len(batch) < self.batch_limit:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
                 try:
-                    batch.append(self._queue.get(timeout=deadline))
+                    batch.append(self._queue.get(timeout=remaining))
                 except queue.Empty:
                     break
             arrays = [b[0] for b in batch]
             futures = [b[1] for b in batch]
             sizes = [a.shape[0] for a in arrays]
             try:
+                for _, _, seq in batch:
+                    faultinject.fault_point("inference/worker", seq)
                 merged = np.concatenate(arrays, axis=0)
                 result = self._run(merged).to_numpy()
                 off = 0
                 for size, fut in zip(sizes, futures):
                     fut.set_result(NDArray(result[off:off + size]))
                     off += size
+            except faultinject.DeadReplicaFault as e:
+                # fatal: this replica is gone — fail its batch, retire
+                self._retire(worker_id, e, futures)
+                return
             except Exception as e:  # scatter failure to every waiter
+                prof.count("inference/batch_errors")
                 for fut in futures:
                     if not fut.done():
                         fut.set_exception(e)
+            except BaseException as e:
+                # a BaseException (e.g. an injected SimulatedCrash) must
+                # not skip the bookkeeping: waiters would hang and the
+                # pool would over-report live replicas
+                self._retire(worker_id, e, futures)
+                raise
+        with self._lock:
+            self._alive -= 1
+
+    def _fail_queued(self, exc: Exception) -> int:
+        n = 0
+        while True:
+            try:
+                _, fut, _ = self._queue.get_nowait()
+            except queue.Empty:
+                return n
+            if not fut.done():
+                fut.set_exception(exc)
+                n += 1
 
     def shutdown(self) -> None:
+        """Stop the workers and FAIL anything still queued — a waiter
+        blocked on ``future.result()`` gets an immediate error instead of
+        hanging on a future no worker will ever fulfil."""
         self._shutdown = True
-        if self._worker is not None:
-            self._worker.join(timeout=1.0)
+        for t in self._workers:
+            t.join(timeout=1.0)
+        n = self._fail_queued(RuntimeError(
+            "ParallelInference shut down with this request still queued"))
+        if n:
+            logger.warning("ParallelInference.shutdown failed %d queued "
+                           "request(s)", n)

@@ -110,6 +110,12 @@ class ParallelWrapper:
 
     def set_listeners(self, *ls) -> None:
         self._listeners = list(ls)
+        for lst in self._listeners:
+            # checkpoint-style listeners snapshot their peers' state for
+            # exact resume (see MultiLayerNetwork.set_listeners)
+            bind = getattr(lst, "bind_group", None)
+            if callable(bind):
+                bind(self._listeners)
         from ..optimize.telemetry import config_for
 
         cfg = config_for(self._listeners)
@@ -300,7 +306,8 @@ class ParallelWrapper:
     def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None,
             *, pad_partial: Optional[bool] = None,
             drop_remainder: bool = False, prefetch: Optional[int] = None,
-            steps_per_dispatch: int = 1, host_prefetch: int = 0) -> None:
+            steps_per_dispatch: int = 1, host_prefetch: int = 0,
+            resume_from: Optional[str] = None) -> None:
         """Data-parallel training on the shared input/dispatch pipeline
         (data/pipeline.py): batches are padded BOTH to the configured batch
         size (one compile per fit config) and to a multiple of the worker
@@ -311,9 +318,20 @@ class ParallelWrapper:
         Sharded device placement is issued ``prefetch`` batches ahead
         (default: the builder's ``prefetch_buffer``), and
         ``steps_per_dispatch=K`` scans K minibatches inside one SPMD
-        dispatch."""
+        dispatch. ``resume_from``: exact checkpoint resume — see
+        MultiLayerNetwork.fit; the restored (host) params/updater are
+        re-placed by the SPMD step's sharding on first dispatch."""
         model = self.model
         model._check_init()
+        from ..util.checkpoint import begin_fit_cursor
+
+        skip = begin_fit_cursor(model, resume_from,
+                                listeners=self._listeners)
+        if skip is not None:
+            # the wrapper's own compiled steps hold donated buffers of the
+            # replaced params — rebuild them too
+            self._step = None
+            self._chunk_step = None
         if model._updater_state is None:
             model._updater_state = model.conf.global_conf.updater.init(model._params)
         if self._step is None:
@@ -324,6 +342,7 @@ class ParallelWrapper:
 
         def on_epoch():
             model._epoch += 1
+            model._steps_in_epoch = 0
             for lst in self._listeners:
                 if hasattr(lst, "epoch_done"):
                     lst.epoch_done(model, model._epoch)
@@ -340,7 +359,7 @@ class ParallelWrapper:
             dispatch_chunk=lambda g: self._dispatch_chunk(g, prof),
             stackable=_same_shapes, on_epoch=on_epoch,
             round_to_multiple_of=self.workers_count,
-            host_prefetch=host_prefetch)
+            host_prefetch=host_prefetch, skip=skip)
 
     def _bind_batch(self, ds: DataSet, w):
         """DataSet → (x, y, mask, w) as HOST arrays. The mask is the RAW

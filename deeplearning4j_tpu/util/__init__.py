@@ -1,2 +1,4 @@
 from .model_serializer import write_model, restore_multi_layer_network, restore_normalizer
 from .crash_reporting import CrashReportingUtil
+from .checkpoint import (snapshot_training_state, restore_training_state,
+                         commit_checkpoint, last_checkpoint, CheckpointWriter)

@@ -1,0 +1,537 @@
+"""Atomic, async, resumable training checkpoints.
+
+Reference: dl4j's CheckpointListener + ModelSerializer give rolling model
+zips, but the operational contract here is orbax-grade (SURVEY §5.3): a
+checkpoint either exists completely or not at all, a reader can prove
+which, and a resumed run is *bit-identical* to one that was never killed.
+
+Three layers:
+
+- **Snapshot** (:func:`snapshot_training_state`): the training thread
+  captures params / layer states / updater state / the thread's RNG key
+  in ONE batched readback (``jax.device_get`` issues every D2H copy
+  asynchronously, then gathers), plus the host-side counters that make
+  resume exact — iteration/epoch, the data-pipeline cursor
+  (epochs_done / steps_in_epoch maintained by ``data.pipeline``), and any
+  listener state exposed through the ``state_dict``/``load_state_dict``
+  protocol. The snapshot is pure host data: the background writer never
+  touches live (donatable) device buffers.
+
+- **Commit** (:func:`commit_checkpoint`): serialize → ``<name>.tmp`` →
+  flush+fsync → ``os.replace`` → fsync(dir). The final name only ever
+  appears for a complete file. A sha256 of the exact committed bytes goes
+  into ``checkpoint.json`` (the manifest, itself written atomically), and
+  retention deletes only fully-committed files — the manifest drops an
+  entry before its file is unlinked, so no window exists where the index
+  references a deleted checkpoint.
+
+- **Verify** (:func:`last_checkpoint`): walk the manifest newest→oldest,
+  re-hashing each candidate; a missing/truncated/bit-flipped file is
+  warned about and skipped, falling back to the newest intact entry. With
+  no usable manifest (torn write, pre-manifest directory), a directory
+  scan validates each ``checkpoint_*.zip`` (zip CRC + meta entry) and
+  picks the newest intact one.
+
+The zip payload is the ModelSerializer container (v1 readers — plain
+``MultiLayerNetwork.load`` — still work) plus a ``resume.json`` entry
+carrying the rng/cursor/listener state; ``restore_training_state``
+consumes it for ``fit(resume_from=...)``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import logging
+import os
+import queue
+import threading
+import zipfile
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..common import faultinject
+from ..common.profiler import OpProfiler
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+MANIFEST_NAME = "checkpoint.json"
+RESUME_ENTRY = "resume.json"
+MANIFEST_FORMAT = 2
+
+
+# --------------------------------------------------------------------------
+# snapshot
+# --------------------------------------------------------------------------
+
+def snapshot_training_state(model, listeners=None) -> Dict[str, Any]:
+    """Host-side snapshot of everything resume needs, taken on the
+    training thread at a dispatch boundary. One batched readback."""
+    import jax
+
+    from ..ndarray.rng import get_random
+
+    rng = get_random()
+    with OpProfiler.get().time_section("checkpoint/snapshot"):
+        host = jax.device_get(
+            (model._params, model._states, model._updater_state,
+             rng.get_state()["key"]))
+        # device_get may return ZERO-COPY views of the device buffers on
+        # the CPU backend — and the very next train step DONATES those
+        # buffers, so the background writer would read freed memory
+        # (observed as glibc heap corruption). Force owning copies; the
+        # memcpy is trivial next to the serialize it feeds.
+        params, states, upd, key = jax.tree.map(np.array, host)
+    fit_epoch0 = getattr(model, "_fit_epoch0", model._epoch)
+    # the configuration is immutable across a fit — serialize it once per
+    # model, not once per checkpoint
+    conf_json = getattr(model, "_ckpt_conf_json", None)
+    if conf_json is None:
+        conf_json = model.conf.to_json()
+        model._ckpt_conf_json = conf_json
+    return {
+        "kind": type(model).__name__,
+        "conf_json": conf_json,
+        "params": params,
+        "states": states,
+        "updater": upd,
+        "iteration": int(model._iteration),
+        "epoch": int(model._epoch),
+        "rng": {"seed": rng.get_seed(),
+                "key": np.asarray(key).tolist(),
+                "key_dtype": str(np.asarray(key).dtype)},
+        "cursor": {
+            "epochs_done": int(model._epoch) - int(fit_epoch0),
+            "steps_in_epoch": int(getattr(model, "_steps_in_epoch", 0)),
+        },
+        "listener_state": gather_listener_state(listeners),
+    }
+
+
+def gather_listener_state(listeners) -> Dict[str, Any]:
+    """Listeners opt into exact resume with ``state_dict`` /
+    ``load_state_dict`` (JSON-serializable). Keyed by position+class so
+    restore maps back onto the same listener arrangement."""
+    out: Dict[str, Any] = {}
+    for i, lst in enumerate(listeners or []):
+        fn = getattr(lst, "state_dict", None)
+        if callable(fn):
+            try:
+                out[f"{i}:{type(lst).__name__}"] = fn()
+            except Exception:
+                logger.warning("state_dict of %s failed; its state will "
+                               "not resume", type(lst).__name__,
+                               exc_info=True)
+    return out
+
+
+def restore_listener_state(listeners, state: Dict[str, Any]) -> None:
+    for i, lst in enumerate(listeners or []):
+        key = f"{i}:{type(lst).__name__}"
+        fn = getattr(lst, "load_state_dict", None)
+        if callable(fn) and key in state:
+            fn(state[key])
+
+
+def serialize_snapshot(snapshot: Dict[str, Any]) -> bytes:
+    """Snapshot → ModelSerializer-container zip bytes (+ resume.json).
+
+    ZIP_STORED on purpose: trained float params are incompressible noise,
+    so DEFLATE costs ~6x the wall time of the raw copy for little size
+    win — and checkpoint cadence is bounded by write latency, not disk
+    space (readers accept either compression transparently)."""
+    from .model_serializer import (_COEFF_ENTRY, _CONF_ENTRY, _META_ENTRY,
+                                   _STATES_ENTRY, _UPDATER_ENTRY,
+                                   _savez_leaves)
+
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_STORED) as zf:
+        zf.writestr(_CONF_ENTRY, snapshot["conf_json"])
+        zf.writestr(_COEFF_ENTRY, _savez_leaves(snapshot["params"]))
+        zf.writestr(_STATES_ENTRY, _savez_leaves(snapshot["states"]))
+        zf.writestr(_META_ENTRY, json.dumps({
+            "iteration": snapshot["iteration"], "epoch": snapshot["epoch"],
+            "kind": snapshot["kind"], "format_version": 2,
+        }))
+        if snapshot["updater"] is not None:
+            zf.writestr(_UPDATER_ENTRY, _savez_leaves(snapshot["updater"]))
+        zf.writestr(RESUME_ENTRY, json.dumps({
+            "rng": snapshot["rng"],
+            "cursor": snapshot["cursor"],
+            "listener_state": snapshot["listener_state"],
+        }))
+    return buf.getvalue()
+
+
+# --------------------------------------------------------------------------
+# atomic commit + manifest
+# --------------------------------------------------------------------------
+
+def _fsync_dir(directory: str) -> None:
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:
+        return     # platforms without directory fds
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _atomic_write(path: str, data: bytes, seq: Optional[int] = None,
+                  durable: bool = True) -> None:
+    """data → <path>.tmp → fsync → rename. The faultinject site sits in
+    the torn-write window the rename is there to close. ``durable=False``
+    skips the fsyncs (still atomic): used for the manifest, whose loss is
+    recoverable — ``last_checkpoint`` falls back to a directory scan."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        if durable:
+            f.flush()
+            os.fsync(f.fileno())
+    faultinject.fault_point("checkpoint/pre_rename", seq)
+    os.replace(tmp, path)
+    if durable:
+        _fsync_dir(os.path.dirname(path) or ".")
+
+
+def read_manifest(directory: str) -> List[Any]:
+    """Manifest entries, oldest first. v2 entries are dicts (file/sha256/
+    iteration/tag); v1 entries are bare path strings. [] when missing or
+    unparseable (a torn manifest must not take the checkpoints with it —
+    the scan fallback still finds them)."""
+    path = os.path.join(directory, MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            return json.load(f).get("checkpoints", [])
+    except FileNotFoundError:
+        return []
+    except (json.JSONDecodeError, OSError, AttributeError):
+        logger.warning("unreadable checkpoint manifest %s; falling back to "
+                       "directory scan", path)
+        return []
+
+
+def write_manifest(directory: str, entries: List[Any]) -> None:
+    _atomic_write(os.path.join(directory, MANIFEST_NAME),
+                  json.dumps({"format": MANIFEST_FORMAT,
+                              "checkpoints": entries}).encode(),
+                  durable=False)
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _entry_name(e: Any) -> str:
+    return e["file"] if isinstance(e, dict) else os.path.basename(e)
+
+
+def _append_and_retain(directory: str, name: str, sha: str, iteration: int,
+                       keep_last: int) -> None:
+    """Fold one committed file into the manifest and apply retention.
+    The manifest stops referencing a file BEFORE it is unlinked: a crash
+    between the two leaves an orphan file, never a dangling index."""
+    entries = [e for e in read_manifest(directory) if _entry_name(e) != name]
+    entries.append({"file": name, "sha256": sha, "iteration": int(iteration),
+                    "tag": name[len("checkpoint_"):-len(".zip")]})
+    retained, dropped = entries, []
+    if keep_last and len(entries) > keep_last:
+        retained, dropped = entries[-keep_last:], entries[:-keep_last]
+    write_manifest(directory, retained)
+    for e in dropped:
+        try:
+            os.remove(os.path.join(directory, _entry_name(e)))
+        except FileNotFoundError:
+            pass
+
+
+def commit_checkpoint(directory: str, tag: str, data: bytes,
+                      iteration: int, keep_last: int,
+                      seq: Optional[int] = None) -> str:
+    """Atomically commit one checkpoint and fold it into the manifest;
+    apply retention. Returns the committed path. Single-writer per
+    directory (the listener's writer thread or the sync caller)."""
+    prof = OpProfiler.get()
+    name = f"checkpoint_{tag}.zip"
+    path = os.path.join(directory, name)
+    with prof.time_section("checkpoint/write"):
+        _atomic_write(path, data, seq=seq)
+        _append_and_retain(directory, name, hashlib.sha256(data).hexdigest(),
+                           iteration, keep_last)
+    prof.count("checkpoint/committed")
+    prof.count("checkpoint/bytes", len(data))
+    return path
+
+
+def committed_checkpoints(directory: str) -> List[str]:
+    """Committed checkpoint paths, oldest first — manifest order when one
+    exists, else an iteration-ordered directory scan. The listener's
+    restart-surviving ``saved`` list."""
+    entries = read_manifest(directory)
+    if entries:
+        paths = (os.path.join(directory, _entry_name(e)) for e in entries)
+        return [p for p in paths if os.path.exists(p)]
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    cands = [os.path.join(directory, f) for f in names
+             if f.startswith("checkpoint_") and f.endswith(".zip")]
+    return [p for _, _, p in sorted(
+        (_checkpoint_iteration(p), os.path.getmtime(p), p) for p in cands)]
+
+
+def register_committed(directory: str, path: str, iteration: int,
+                       keep_last: int) -> None:
+    """Fold an already-written checkpoint file (legacy ``model.save``
+    path) into the verified manifest and apply retention."""
+    _append_and_retain(directory, os.path.basename(path),
+                       _sha256_file(path), iteration, keep_last)
+
+
+def clean_stale_tmp(directory: str) -> int:
+    """Remove ``*.tmp`` left by writes torn mid-flight (the rename never
+    happened, so they are garbage by construction)."""
+    n = 0
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return 0
+    for f in names:
+        if f.endswith(".tmp"):
+            try:
+                os.remove(os.path.join(directory, f))
+                n += 1
+            except OSError:
+                pass
+    return n
+
+
+# --------------------------------------------------------------------------
+# verified reads
+# --------------------------------------------------------------------------
+
+def _zip_intact(path: str) -> bool:
+    from .model_serializer import _META_ENTRY
+
+    try:
+        with zipfile.ZipFile(path) as zf:
+            if zf.testzip() is not None:
+                return False
+            json.loads(zf.read(_META_ENTRY))
+        return True
+    except Exception:
+        return False
+
+
+def _checkpoint_iteration(path: str) -> int:
+    from .model_serializer import _META_ENTRY
+
+    try:
+        with zipfile.ZipFile(path) as zf:
+            return int(json.loads(zf.read(_META_ENTRY)).get("iteration", -1))
+    except Exception:
+        return -1
+
+
+def verify_checkpoint(directory: str, entry: Any) -> Optional[str]:
+    """One manifest entry → verified path, or None (with a warning)."""
+    if isinstance(entry, str):      # v1 manifest: existence + zip CRC only
+        path = entry if os.path.isabs(entry) else os.path.join(
+            directory, os.path.basename(entry))
+        if os.path.exists(path) and _zip_intact(path):
+            return path
+        logger.warning("checkpoint %s missing or corrupt; skipping", path)
+        return None
+    path = os.path.join(directory, entry["file"])
+    if not os.path.exists(path):
+        logger.warning("checkpoint %s indexed but missing; skipping", path)
+        return None
+    if _sha256_file(path) != entry.get("sha256"):
+        logger.warning("checkpoint %s fails its manifest checksum "
+                       "(truncated or bit-flipped write); skipping", path)
+        return None
+    return path
+
+
+def last_checkpoint(directory: str) -> Optional[str]:
+    """Newest checkpoint that PROVES intact — manifest+checksum first,
+    newest→oldest, then the directory-scan fallback."""
+    for entry in reversed(read_manifest(directory)):
+        path = verify_checkpoint(directory, entry)
+        if path is not None:
+            return path
+    return scan_newest_intact(directory)
+
+
+def scan_newest_intact(directory: str) -> Optional[str]:
+    """Manifest-less fallback: every committed ``checkpoint_*.zip`` is
+    validated (zip CRC + meta entry) and the one with the highest
+    iteration (mtime tiebreak) wins."""
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return None
+    cands = []
+    for f in names:
+        if not (f.startswith("checkpoint_") and f.endswith(".zip")):
+            continue
+        path = os.path.join(directory, f)
+        if _zip_intact(path):
+            cands.append((_checkpoint_iteration(path),
+                          os.path.getmtime(path), path))
+        else:
+            logger.warning("checkpoint %s is corrupt; skipping", path)
+    if not cands:
+        return None
+    return max(cands)[2]
+
+
+# --------------------------------------------------------------------------
+# resume
+# --------------------------------------------------------------------------
+
+def read_resume_state(path: str) -> Dict[str, Any]:
+    """The resume.json payload (empty dict for pre-PR-3 checkpoints —
+    they restore params/updater but fast-forward nothing)."""
+    with zipfile.ZipFile(path) as zf:
+        if RESUME_ENTRY not in zf.namelist():
+            return {}
+        return json.loads(zf.read(RESUME_ENTRY))
+
+
+def restore_training_state(model, path: str, listeners=None,
+                           restore_rng: bool = True) -> Dict[str, int]:
+    """Load a checkpoint INTO an existing (init()-ed) model and return the
+    pipeline cursor ``{"epochs_done": d, "steps_in_epoch": s}``. Restores
+    params / states / updater state / iteration / epoch / the calling
+    thread's RNG key / listener state — the full set a bit-identical
+    continuation needs."""
+    from ..ndarray.rng import get_random
+    from .model_serializer import load_state_entries
+
+    with zipfile.ZipFile(path) as zf:
+        # shared with ModelSerializer._restore: zip-entry loading +
+        # device materialization (donation safety) live in ONE place
+        load_state_entries(zf, model, load_updater=True)
+    # the restored params replace donated jit buffers — compiled steps
+    # referencing the old ones must rebuild
+    for attr in ("_fit_step", "_chunk_step", "_tbptt_step", "_infer_fn"):
+        if hasattr(model, attr):
+            setattr(model, attr, None)
+    resume = read_resume_state(path)
+    if restore_rng and resume.get("rng"):
+        get_random().set_state(resume["rng"])
+    if listeners and resume.get("listener_state"):
+        restore_listener_state(listeners, resume["listener_state"])
+    cursor = resume.get("cursor") or {}
+    return {"epochs_done": int(cursor.get("epochs_done", 0)),
+            "steps_in_epoch": int(cursor.get("steps_in_epoch", 0))}
+
+
+def begin_fit_cursor(model, resume_from: Optional[str],
+                     listeners=None) -> Optional[tuple]:
+    """The one resume-cursor setup every fit path shares (MLN /
+    ComputationGraph / ParallelWrapper): restore the checkpoint into the
+    model (when resuming) and anchor the cursor bookkeeping —
+    ``_fit_epoch0`` pins epoch counting to the LOGICAL run, so a
+    checkpoint taken after a resume still records its cursor relative to
+    the original call, and ``_steps_in_epoch`` counts dispatched steps
+    for the snapshot. Returns the pipeline ``skip`` tuple, or None for a
+    fresh fit."""
+    if resume_from is None:
+        model._fit_epoch0 = model._epoch
+        model._steps_in_epoch = 0
+        return None
+    cursor = restore_training_state(model, resume_from, listeners=listeners)
+    model._fit_epoch0 = model._epoch - cursor["epochs_done"]
+    model._steps_in_epoch = cursor["steps_in_epoch"]
+    return (cursor["epochs_done"], cursor["steps_in_epoch"])
+
+
+# --------------------------------------------------------------------------
+# async writer
+# --------------------------------------------------------------------------
+
+class CheckpointWriter:
+    """One background thread that serializes snapshots and commits them
+    atomically, so the training loop never blocks on zip/deflate/disk.
+    Bounded queue (depth 2): if checkpoints outrun the disk, submission
+    applies backpressure rather than buffering unboundedly. A write that
+    fails (including an injected pre-rename crash in ``raise`` mode) is
+    logged and recorded in ``errors``; the manifest is untouched, so
+    ``last_checkpoint`` keeps pointing at the previous intact one."""
+
+    def __init__(self, directory: str, keep_last: int = 3,
+                 on_commit=None):
+        self.dir = directory
+        self.keep_last = keep_last
+        self.errors: List[BaseException] = []
+        self._on_commit = on_commit
+        self._q: "queue.Queue" = queue.Queue(maxsize=2)
+        # pending counts submitted-but-uncommitted jobs under a condition
+        # variable (an Event would race: submit's clear can interleave
+        # with the worker observing a momentarily-empty queue and
+        # re-setting it, making flush() return with a job still queued)
+        self._pending = 0
+        self._cond = threading.Condition()
+        self._seq = 0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dl4j-ckpt-writer")
+        self._thread.start()
+
+    def submit(self, snapshot: Dict[str, Any], tag: str) -> None:
+        with self._cond:
+            self._pending += 1
+        self._q.put((snapshot, tag, self._seq))
+        self._seq += 1
+
+    def _run(self) -> None:
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+            snapshot, tag, seq = job
+            try:
+                data = serialize_snapshot(snapshot)
+                path = commit_checkpoint(self.dir, tag, data,
+                                         snapshot["iteration"],
+                                         self.keep_last, seq=seq)
+                if self._on_commit is not None:
+                    self._on_commit(path)
+            except BaseException as e:     # incl. SimulatedCrash(raise)
+                self.errors.append(e)
+                logger.warning("async checkpoint %s failed: %s", tag, e,
+                               exc_info=not isinstance(
+                                   e, faultinject.SimulatedCrash))
+            finally:
+                with self._cond:
+                    self._pending -= 1
+                    self._cond.notify_all()
+
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        """Block until every submitted checkpoint is committed (or
+        failed). The listener's explicit durability points — ``flush``/
+        ``close``/reading ``saved`` — come through here; nothing flushes
+        implicitly, so the training loop never stalls on the writer."""
+        with self._cond:
+            return self._cond.wait_for(lambda: self._pending == 0, timeout)
+
+    def close(self, timeout: float = 30.0) -> None:
+        drained = self.flush(timeout)
+        try:
+            # bounded put: with a wedged writer (stalled disk) and a full
+            # queue, close() must not hang the training thread forever
+            self._q.put(None, timeout=5.0 if drained else 1.0)
+        except queue.Full:
+            logger.warning("checkpoint writer did not drain within %.0fs; "
+                           "abandoning it (daemon thread)", timeout)
+        self._thread.join(timeout)

@@ -48,19 +48,73 @@ def _load_into_tree(data: bytes, template, what: str, cast_to_template: bool = F
 
 def write_model(model, path: str, save_updater: bool = False,
                 normalizer=None) -> None:
-    """Shared writer for MultiLayerNetwork and ComputationGraph."""
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-        zf.writestr(_CONF_ENTRY, model.conf.to_json())
-        zf.writestr(_COEFF_ENTRY, _savez_leaves(model._params))
-        zf.writestr(_STATES_ENTRY, _savez_leaves(model._states))
-        zf.writestr(_META_ENTRY, json.dumps({
-            "iteration": model._iteration, "epoch": model._epoch,
-            "kind": type(model).__name__, "format_version": 1,
-        }))
-        if save_updater and model._updater_state is not None:
-            zf.writestr(_UPDATER_ENTRY, _savez_leaves(model._updater_state))
-        if normalizer is not None:
-            zf.writestr(_NORMALIZER_ENTRY, json.dumps(normalizer.to_json()))
+    """Shared writer for MultiLayerNetwork and ComputationGraph. The zip
+    is staged to ``<path>.tmp`` and renamed into place, so a crash
+    mid-save never leaves a torn file at the target name (the same
+    atomicity contract util.checkpoint builds its manifest on)."""
+    import os
+
+    tmp = path + ".tmp"
+    try:
+        with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr(_CONF_ENTRY, model.conf.to_json())
+            zf.writestr(_COEFF_ENTRY, _savez_leaves(model._params))
+            zf.writestr(_STATES_ENTRY, _savez_leaves(model._states))
+            zf.writestr(_META_ENTRY, json.dumps({
+                "iteration": model._iteration, "epoch": model._epoch,
+                "kind": type(model).__name__, "format_version": 1,
+            }))
+            if save_updater and model._updater_state is not None:
+                zf.writestr(_UPDATER_ENTRY,
+                            _savez_leaves(model._updater_state))
+            if normalizer is not None:
+                zf.writestr(_NORMALIZER_ENTRY,
+                            json.dumps(normalizer.to_json()))
+        os.replace(tmp, path)
+    except BaseException:
+        # don't strand a half-written tmp at an arbitrary user path
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _materialize_on_device(tree):
+    """Restored trees become DEVICE arrays before they reach a model: the
+    fit step donates these buffers, and donating an array that zero-copy
+    aliases numpy-owned host memory (possible on the CPU backend) frees
+    memory numpy still owns — observed as glibc heap corruption under the
+    persistent compilation cache."""
+    import jax.numpy as jnp
+
+    return jax.tree.map(lambda a: jnp.array(jnp.asarray(a)), tree)
+
+
+def load_state_entries(zf: zipfile.ZipFile, model,
+                       load_updater: bool = True) -> None:
+    """Load the container's coefficient/state/meta(/updater) entries INTO
+    an existing initialized model, device-materialized. Shared by
+    :func:`_restore` (fresh model from the zip's conf) and
+    ``util.checkpoint.restore_training_state`` (resume into a live model)
+    so the donation-safety materialization cannot drift between them."""
+    names = zf.namelist()
+    model._params = _materialize_on_device(_load_into_tree(
+        zf.read(_COEFF_ENTRY), model._params, "coefficient",
+        cast_to_template=True))
+    if _STATES_ENTRY in names:
+        model._states = _materialize_on_device(_load_into_tree(
+            zf.read(_STATES_ENTRY), model._states, "state"))
+    meta = json.loads(zf.read(_META_ENTRY))
+    model._iteration = meta.get("iteration", 0)
+    model._epoch = meta.get("epoch", 0)
+    if load_updater:
+        if _UPDATER_ENTRY in names:
+            state0 = model.conf.global_conf.updater.init(model._params)
+            model._updater_state = _materialize_on_device(_load_into_tree(
+                zf.read(_UPDATER_ENTRY), state0, "updater state"))
+        else:
+            model._updater_state = None
 
 
 def _restore(path: str, model_cls, conf_cls, load_updater: bool):
@@ -68,18 +122,7 @@ def _restore(path: str, model_cls, conf_cls, load_updater: bool):
         conf = conf_cls.from_json(zf.read(_CONF_ENTRY).decode())
         model = model_cls(conf)
         model.init()
-        model._params = _load_into_tree(zf.read(_COEFF_ENTRY), model._params,
-                                        "coefficient", cast_to_template=True)
-        if _STATES_ENTRY in zf.namelist():
-            model._states = _load_into_tree(zf.read(_STATES_ENTRY), model._states,
-                                            "state")
-        meta = json.loads(zf.read(_META_ENTRY))
-        model._iteration = meta.get("iteration", 0)
-        model._epoch = meta.get("epoch", 0)
-        if load_updater and _UPDATER_ENTRY in zf.namelist():
-            state0 = conf.global_conf.updater.init(model._params)
-            model._updater_state = _load_into_tree(zf.read(_UPDATER_ENTRY), state0,
-                                                   "updater state")
+        load_state_entries(zf, model, load_updater=load_updater)
     return model
 
 

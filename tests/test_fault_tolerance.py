@@ -1,0 +1,579 @@
+"""Fault-tolerance suite (SURVEY §5.3): async atomic checkpoints, verified
+manifest fallback, exact full-state resume, and the deterministic fault-
+injection harness (common/faultinject) driving every recovery path in-process
+— the subprocess hard-kill variant lives in test_kill_resume.py."""
+
+import json
+import logging
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common import faultinject
+from deeplearning4j_tpu.common.profiler import OpProfiler
+from deeplearning4j_tpu.data import DataSet, NDArrayDataSetIterator
+from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.ndarray.ndarray import NDArray
+from deeplearning4j_tpu.ndarray.rng import set_default_seed
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.optimize import NanSentinelListener
+from deeplearning4j_tpu.optimize.listeners import (
+    CheckpointListener, CollectScoresIterationListener)
+from deeplearning4j_tpu.util import checkpoint as ckpt_util
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.clear_plan()
+    yield
+    faultinject.clear_plan()
+
+
+def small_model(seed: int = 5) -> MultiLayerNetwork:
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(learning_rate=0.05)).activation("tanh").list()
+            .layer(L.DenseLayer(n_out=8))
+            .layer(L.OutputLayer(n_out=2, loss="mcxent",
+                                 activation="softmax"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def make_data():
+    rng = np.random.RandomState(7)
+    x = rng.randn(64, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+    return x, y
+
+
+def make_iter():
+    x, y = make_data()
+    # shuffle=True on purpose: resume must replay the per-epoch shuffle
+    # RNG exactly (the cursor fast-forward consumes skipped epochs/batches)
+    return NDArrayDataSetIterator(x, y, batch_size=16, shuffle=True, seed=3)
+
+
+def plan(*specs):
+    faultinject.set_plan(faultinject.FaultPlan(list(specs)))
+
+
+# ---------------------------------------------------------------------------
+# atomic commit + manifest + fallback
+# ---------------------------------------------------------------------------
+
+class TestAtomicCheckpoints:
+    def test_midwrite_kill_falls_back_to_previous_intact(self, tmp_path):
+        """A crash between tmp-write and rename must leave last_checkpoint
+        on the PREVIOUS committed checkpoint, and resume must work."""
+        set_default_seed(1)
+        model = small_model()
+        cl = CheckpointListener(str(tmp_path), save_every_n_iterations=2,
+                                keep_last=5)
+        model.set_listeners(cl)
+        # the 4th zip write (commit seq 3 == iter_8) dies pre-rename
+        plan({"site": "checkpoint/pre_rename", "index": 3, "kind": "crash"})
+        model.fit(make_iter(), epochs=2, batch_size=16)
+        cl.close()
+        assert len(cl.errors()) == 1          # failure observable, not silent
+        files = sorted(os.listdir(tmp_path))
+        assert "checkpoint_iter_8.zip.tmp" in files     # the torn write
+        assert "checkpoint_iter_8.zip" not in files     # never committed
+        last = CheckpointListener.last_checkpoint(str(tmp_path))
+        assert last is not None and "iter_6" in last
+        # resume succeeds from the fallback
+        fresh = small_model()
+        fresh.fit(make_iter(), epochs=2, batch_size=16, resume_from=last)
+        assert fresh._iteration == 8
+
+    def test_corrupted_checkpoint_skipped_with_warning(self, tmp_path,
+                                                       caplog):
+        set_default_seed(1)
+        model = small_model()
+        cl = CheckpointListener(str(tmp_path), save_every_n_iterations=2,
+                                keep_last=5)
+        model.set_listeners(cl)
+        model.fit(make_iter(), epochs=2, batch_size=16)
+        cl.close()
+        assert len(cl.saved) >= 2
+        newest, previous = cl.saved[-1], cl.saved[-2]
+        # bit-flip the newest ...
+        blob = bytearray(open(newest, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        open(newest, "wb").write(bytes(blob))
+        with caplog.at_level(logging.WARNING, logger="deeplearning4j_tpu"):
+            last = CheckpointListener.last_checkpoint(str(tmp_path))
+        assert last == previous
+        assert any("checksum" in r.message for r in caplog.records)
+        # ... and truncate the fallback too: next-previous (or None) wins
+        open(previous, "wb").write(open(previous, "rb").read()[:100])
+        last2 = CheckpointListener.last_checkpoint(str(tmp_path))
+        assert last2 not in (newest, previous)
+
+    def test_retention_and_index_survive_restart(self, tmp_path):
+        """Relaunched listener rebuilds its saved list from the directory
+        (today's bug: it forgot prior checkpoints), keeps rotating the
+        same set, and clears stale tmp wreckage."""
+        set_default_seed(1)
+        model = small_model()
+        cl = CheckpointListener(str(tmp_path), save_every_n_iterations=2,
+                                keep_last=3)
+        model.set_listeners(cl)
+        model.fit(make_iter(), epochs=2, batch_size=16)
+        cl.close()
+        (tmp_path / "checkpoint_dead.zip.tmp").write_bytes(b"torn")
+        cl2 = CheckpointListener(str(tmp_path), save_every_n_iterations=2,
+                                 keep_last=3)
+        assert [os.path.basename(p) for p in cl2.saved] == \
+            [os.path.basename(p) for p in cl.saved]
+        assert not (tmp_path / "checkpoint_dead.zip.tmp").exists()
+        # continue training through the SAME retention window
+        model2 = small_model()
+        model2.set_listeners(cl2)
+        last = CheckpointListener.last_checkpoint(str(tmp_path))
+        model2.fit(make_iter(), epochs=3, batch_size=16, resume_from=last)
+        cl2.close()
+        names = [f for f in os.listdir(tmp_path)
+                 if f.startswith("checkpoint_") and f.endswith(".zip")]
+        assert len(names) == 3     # retention never exceeded keep_last
+        manifest = json.loads((tmp_path / "checkpoint.json").read_text())
+        listed = {e["file"] for e in manifest["checkpoints"]}
+        assert listed == set(names)    # index only references live files
+
+    def test_manifest_checksums_and_verified_reads(self, tmp_path):
+        set_default_seed(1)
+        model = small_model()
+        cl = CheckpointListener(str(tmp_path), save_every_n_iterations=3,
+                                keep_last=2)
+        model.set_listeners(cl)
+        model.fit(make_iter(), epochs=2, batch_size=16)
+        cl.close()
+        manifest = json.loads((tmp_path / "checkpoint.json").read_text())
+        assert manifest["format"] == 2
+        for entry in manifest["checkpoints"]:
+            path = tmp_path / entry["file"]
+            assert path.exists()
+            assert ckpt_util.verify_checkpoint(str(tmp_path), entry) == \
+                str(path)
+        # a v2 checkpoint stays loadable by the plain v1 reader
+        restored = MultiLayerNetwork.load(
+            CheckpointListener.last_checkpoint(str(tmp_path)),
+            load_updater=True)
+        assert restored.num_params() == model.num_params()
+
+    def test_scan_fallback_survives_torn_manifest(self, tmp_path):
+        set_default_seed(1)
+        model = small_model()
+        cl = CheckpointListener(str(tmp_path), save_every_n_iterations=2,
+                                keep_last=3)
+        model.set_listeners(cl)
+        model.fit(make_iter(), epochs=2, batch_size=16)
+        cl.close()
+        expect = cl.saved[-1]
+        (tmp_path / "checkpoint.json").write_text('{"form')   # torn write
+        assert CheckpointListener.last_checkpoint(str(tmp_path)) == expect
+
+
+# ---------------------------------------------------------------------------
+# pipeline fault injection + retry
+# ---------------------------------------------------------------------------
+
+class TestPipelineFaults:
+    def test_transient_fault_retried_then_recovered(self):
+        prof = OpProfiler.get()
+        prof.reset()
+        set_default_seed(1)
+        model = small_model()
+        scores = CollectScoresIterationListener()
+        model.set_listeners(scores)
+        plan({"site": "pipeline/bind", "index": 2, "kind": "transient",
+              "times": 2})
+        model.fit(make_iter(), epochs=1, batch_size=16)
+        assert prof.counter_value("pipeline/retries") == 2
+        assert model._iteration == 4          # all steps trained
+        assert len(scores.scores) == 4
+        stats = prof.fault_stats()
+        assert stats["faults/pipeline/bind/transient"] == 2
+        assert stats["retry_backoff_s"] > 0
+
+    def test_transient_fault_exhausts_retries_and_raises(self):
+        set_default_seed(1)
+        model = small_model()
+        plan({"site": "pipeline/bind", "index": 1, "kind": "transient",
+              "times": 10})
+        with pytest.raises(faultinject.TransientFault):
+            model.fit(make_iter(), epochs=1, batch_size=16)
+
+    def test_transient_place_fault_retried(self):
+        prof = OpProfiler.get()
+        prof.reset()
+        set_default_seed(1)
+        model = small_model()
+        plan({"site": "pipeline/place", "index": 1, "kind": "transient"})
+        model.fit(make_iter(), epochs=1, batch_size=16)
+        assert prof.counter_value("pipeline/retries") == 1
+        assert model._iteration == 4
+
+    def test_slow_batch_injection(self):
+        set_default_seed(1)
+        model = small_model()
+        plan({"site": "pipeline/bind", "index": 0, "kind": "slow",
+              "seconds": 0.05})
+        t0 = time.perf_counter()
+        model.fit(make_iter(), epochs=1, batch_size=16)
+        assert time.perf_counter() - t0 >= 0.05
+        assert model._iteration == 4
+
+    def test_nan_injection_composes_with_nan_sentinel_skip(self):
+        """An injected NaN batch drives the step's grads non-finite; the
+        PR-2 NanSentinelListener skip policy drops the poisoned update
+        in-graph and training continues with finite params."""
+        import jax
+
+        set_default_seed(1)
+        model = small_model()
+        sentinel = NanSentinelListener("skip", check_every_n=2)
+        scores = CollectScoresIterationListener()
+        model.set_listeners(sentinel, scores)
+        plan({"site": "pipeline/bind", "index": 1, "kind": "nan"})
+        model.fit(make_iter(), epochs=1, batch_size=16)
+        assert len(sentinel.events) == 1
+        assert sentinel.events[0]["iteration"] == 2
+        assert all(np.isfinite(np.asarray(l)).all()
+                   for l in jax.tree.leaves(model._params))
+        # the non-poisoned steps' losses stayed finite
+        finite = [s for i, s in scores.scores if i != 2]
+        assert np.isfinite(finite).all()
+
+    def test_env_driven_plan(self, monkeypatch):
+        """The env route a relaunched subprocess uses."""
+        monkeypatch.setenv(faultinject.ENV_PLAN, json.dumps(
+            [{"site": "pipeline/bind", "index": 0, "kind": "transient"}]))
+        faultinject.clear_plan()     # force env re-read
+        prof = OpProfiler.get()
+        prof.reset()
+        set_default_seed(1)
+        model = small_model()
+        model.fit(make_iter(), epochs=1, batch_size=16)
+        assert prof.counter_value("pipeline/retries") == 1
+
+
+# ---------------------------------------------------------------------------
+# exact resume parity
+# ---------------------------------------------------------------------------
+
+def _baseline(fit_kwargs, epochs=3):
+    set_default_seed(99)
+    model = small_model()
+    scores = CollectScoresIterationListener()
+    model.set_listeners(scores)
+    model.fit(make_iter(), epochs=epochs, **fit_kwargs)
+    return [s for _, s in scores.scores]
+
+
+def _killed_and_resumed(tmp_path, fit_kwargs, crash_at, every=3, epochs=3):
+    set_default_seed(99)
+    model = small_model()
+    scores = CollectScoresIterationListener()
+    cl = CheckpointListener(str(tmp_path), save_every_n_iterations=every,
+                            keep_last=2)
+    model.set_listeners(scores, cl)
+    plan({"site": "train/step", "index": crash_at, "kind": "crash"})
+    with pytest.raises(faultinject.SimulatedCrash):
+        model.fit(make_iter(), epochs=epochs, **fit_kwargs)
+    faultinject.clear_plan()
+    cl.close()
+    last = CheckpointListener.last_checkpoint(str(tmp_path))
+    assert last is not None
+    # "fresh process": new model object, new listeners, same fit call
+    resumed = small_model(seed=17)      # different init — must be overwritten
+    scores2 = CollectScoresIterationListener()
+    cl2 = CheckpointListener(str(tmp_path), save_every_n_iterations=every,
+                             keep_last=2)
+    resumed.set_listeners(scores2, cl2)
+    resumed.fit(make_iter(), epochs=epochs, resume_from=last, **fit_kwargs)
+    cl2.close()
+    return [s for _, s in scores2.scores]
+
+
+class TestExactResumeParity:
+    """The acceptance bar: a run hard-killed at step k and resumed yields
+    the SAME loss sequence as the uninterrupted run — bit-identical on
+    CPU. Listener state rides the checkpoint, so the resumed
+    CollectScores listener holds the full history."""
+
+    def test_plain_fit_parity(self, tmp_path):
+        base = _baseline({})
+        got = _killed_and_resumed(tmp_path, {}, crash_at=7)
+        assert got == base
+
+    def test_steps_per_dispatch_parity(self, tmp_path):
+        base = _baseline({"steps_per_dispatch": 4})
+        got = _killed_and_resumed(tmp_path, {"steps_per_dispatch": 4},
+                                  crash_at=7)
+        assert got == base
+
+    def test_parallel_wrapper_parity(self, tmp_path):
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+
+        def run(resume_dir=None, crash_at=None):
+            set_default_seed(99)
+            model = small_model()
+            pw = ParallelWrapper.Builder(model).workers(2).build()
+            scores = CollectScoresIterationListener()
+            listeners = [scores]
+            cl = None
+            if resume_dir is not None:
+                cl = CheckpointListener(resume_dir,
+                                        save_every_n_iterations=2,
+                                        keep_last=2)
+                listeners.append(cl)
+            pw.set_listeners(*listeners)
+            if crash_at is not None:
+                plan({"site": "train/step", "index": crash_at,
+                      "kind": "crash"})
+                with pytest.raises(faultinject.SimulatedCrash):
+                    pw.fit(make_iter(), epochs=2, batch_size=16)
+                faultinject.clear_plan()
+                cl.close()
+                return None
+            if resume_dir is not None:
+                last = CheckpointListener.last_checkpoint(resume_dir)
+                assert last is not None
+                # fresh wrapper + model, exact continuation
+                model2 = small_model(seed=17)
+                pw2 = ParallelWrapper.Builder(model2).workers(2).build()
+                scores2 = CollectScoresIterationListener()
+                cl2 = CheckpointListener(resume_dir,
+                                         save_every_n_iterations=2,
+                                         keep_last=2)
+                pw2.set_listeners(scores2, cl2)
+                pw2.fit(make_iter(), epochs=2, batch_size=16,
+                        resume_from=last)
+                cl2.close()
+                return [s for _, s in scores2.scores]
+            pw.fit(make_iter(), epochs=2, batch_size=16)
+            return [s for _, s in scores.scores]
+
+        base = run()
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            run(resume_dir=d, crash_at=5)
+            got = run(resume_dir=d)
+        assert got == base
+
+    def test_computation_graph_parity(self, tmp_path):
+        from deeplearning4j_tpu.nn import (ComputationGraph,
+                                           ComputationGraphConfiguration,
+                                           NeuralNetConfiguration)
+
+        def build():
+            conf = (ComputationGraphConfiguration
+                    .graph_builder(NeuralNetConfiguration.builder()
+                                   .seed(7).updater(Adam(0.05))
+                                   .activation("tanh"))
+                    .add_inputs("in")
+                    .add_layer("dense", L.DenseLayer(n_out=8), "in")
+                    .add_layer("out", L.OutputLayer(n_out=2), "dense")
+                    .set_outputs("out")
+                    .set_input_types(InputType.feed_forward(4))
+                    .build())
+            return ComputationGraph(conf).init()
+
+        set_default_seed(42)
+        g1 = build()
+        c1 = CollectScoresIterationListener()
+        g1.set_listeners(c1)
+        g1.fit(make_iter(), epochs=2, batch_size=16)
+        base = [s for _, s in c1.scores]
+
+        set_default_seed(42)
+        g2 = build()
+        cl = CheckpointListener(str(tmp_path), save_every_n_iterations=2,
+                                keep_last=2)
+        g2.set_listeners(CollectScoresIterationListener(), cl)
+        plan({"site": "train/step", "index": 5, "kind": "crash"})
+        with pytest.raises(faultinject.SimulatedCrash):
+            g2.fit(make_iter(), epochs=2, batch_size=16)
+        faultinject.clear_plan()
+        cl.close()
+        g3 = build()
+        c3 = CollectScoresIterationListener()
+        g3.set_listeners(c3)
+        g3.fit(make_iter(), epochs=2, batch_size=16,
+               resume_from=CheckpointListener.last_checkpoint(str(tmp_path)))
+        assert [s for _, s in c3.scores] == base
+
+    def test_mid_epoch_cursor_round_trip(self, tmp_path):
+        """The cursor must place the resumed run mid-epoch: kill inside
+        epoch 2, checkpoint mid-epoch, and the epoch counter + per-epoch
+        shuffle land exactly where the uninterrupted run's did."""
+        base = _baseline({}, epochs=4)
+        got = _killed_and_resumed(tmp_path, {}, crash_at=9, every=5,
+                                  epochs=4)
+        assert got == base
+
+    def test_resume_restores_rng_stream(self, tmp_path):
+        """Dropout draws per-step keys from the stateful stream — a
+        seed-only restore would desync it. The model here has dropout, so
+        parity proves the KEY (not just the seed) was restored."""
+        def dropout_model(seed=5):
+            conf = (NeuralNetConfiguration.builder().seed(seed)
+                    .updater(Sgd(learning_rate=0.1)).activation("tanh")
+                    .list()
+                    .layer(L.DenseLayer(n_out=16, dropout=0.5))
+                    .layer(L.OutputLayer(n_out=2, loss="mcxent",
+                                         activation="softmax"))
+                    .set_input_type(InputType.feed_forward(4))
+                    .build())
+            return MultiLayerNetwork(conf).init()
+
+        set_default_seed(7)
+        m1 = dropout_model()
+        s1 = CollectScoresIterationListener()
+        m1.set_listeners(s1)
+        m1.fit(make_iter(), epochs=2, batch_size=16)
+        base = [s for _, s in s1.scores]
+
+        set_default_seed(7)
+        m2 = dropout_model()
+        s2 = CollectScoresIterationListener()
+        cl = CheckpointListener(str(tmp_path), save_every_n_iterations=3,
+                                keep_last=2)
+        m2.set_listeners(s2, cl)
+        plan({"site": "train/step", "index": 5, "kind": "crash"})
+        with pytest.raises(faultinject.SimulatedCrash):
+            m2.fit(make_iter(), epochs=2, batch_size=16)
+        faultinject.clear_plan()
+        cl.close()
+        m3 = dropout_model(seed=11)
+        s3 = CollectScoresIterationListener()
+        m3.set_listeners(s3)
+        m3.fit(make_iter(), epochs=2, batch_size=16,
+               resume_from=CheckpointListener.last_checkpoint(str(tmp_path)))
+        assert [s for _, s in s3.scores] == base
+
+
+# ---------------------------------------------------------------------------
+# serving-side fault tolerance
+# ---------------------------------------------------------------------------
+
+class _SlowModel:
+    """Stand-in for a wedged replica: output() blocks far past any
+    reasonable deadline."""
+
+    def __init__(self, delay_s: float):
+        self.delay_s = delay_s
+
+    def output(self, batch):
+        time.sleep(self.delay_s)
+        b = np.asarray(batch)
+        return NDArray(np.zeros((b.shape[0], 2), np.float32))
+
+
+class TestParallelInferenceFaults:
+    def test_output_times_out_with_descriptive_error(self):
+        from deeplearning4j_tpu.parallel import ParallelInference
+
+        pi = (ParallelInference.Builder(_SlowModel(5.0))
+              .inference_mode("batched").max_wait_ms(5)
+              .request_timeout_ms(200).build())
+        try:
+            with pytest.raises(TimeoutError) as ei:
+                pi.output(np.zeros((1, 4), np.float32))
+            msg = str(ei.value)
+            assert "queue depth" in msg and "replicas alive" in msg
+        finally:
+            pi.shutdown()
+
+    def test_dead_replica_retired_and_pool_survives(self):
+        from deeplearning4j_tpu.parallel import ParallelInference
+
+        prof = OpProfiler.get()
+        prof.reset()
+        model = small_model()
+        pi = (ParallelInference.Builder(model).inference_mode("batched")
+              .workers(2).max_wait_ms(5).request_timeout_ms(5000).build())
+        try:
+            assert pi.output(np.zeros((2, 4), np.float32)).shape == (2, 2)
+            plan({"site": "inference/worker", "kind": "dead_replica"})
+            with pytest.raises(faultinject.DeadReplicaFault):
+                pi.output(np.zeros((2, 4), np.float32))
+            faultinject.clear_plan()
+            assert pi.alive_replicas() == 1
+            assert prof.counter_value("inference/replica_retired") == 1
+            # the surviving replica keeps serving
+            assert pi.output(np.zeros((3, 4), np.float32)).shape == (3, 2)
+        finally:
+            pi.shutdown()
+
+    def test_shutdown_fails_queued_futures(self):
+        from deeplearning4j_tpu.parallel import ParallelInference
+
+        pi = (ParallelInference.Builder(_SlowModel(0.5))
+              .inference_mode("batched").batch_limit(1).max_wait_ms(1)
+              .build())
+        # first request occupies the single worker; the rest stay queued
+        futs = [pi.output_async(np.zeros((1, 4), np.float32))
+                for _ in range(4)]
+        pi.shutdown()
+        resolved = [f for f in futs if f.done()]
+        # every future resolves (result or error) — nobody hangs
+        for f in futs:
+            assert f.done()
+        errs = [f for f in futs if f.exception(timeout=0) is not None]
+        assert errs, resolved
+        # post-shutdown submissions fail fast
+        fut = pi.output_async(np.zeros((1, 4), np.float32))
+        assert isinstance(fut.exception(timeout=0), RuntimeError)
+
+
+# ---------------------------------------------------------------------------
+# background helpers (satellite)
+# ---------------------------------------------------------------------------
+
+class TestBackgroundHygiene:
+    def test_prefetch_worker_thread_named_and_joined(self):
+        from deeplearning4j_tpu.common.background import staged_iter
+
+        def slow_source():
+            for i in range(100):
+                yield i
+
+        it = staged_iter(slow_source(), depth=1, host_prefetch=4)
+        assert next(it) == 0
+        names = {t.name for t in threading.enumerate()}
+        assert "dl4j-prefetch-worker" in names
+        it.close()    # abandoning the iterator must join the worker
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if not any(t.name == "dl4j-prefetch-worker"
+                       for t in threading.enumerate()):
+                break
+            time.sleep(0.01)
+        assert not any(t.name == "dl4j-prefetch-worker"
+                       for t in threading.enumerate())
+
+    def test_worker_exception_carries_producer_traceback(self):
+        from deeplearning4j_tpu.common.background import prefetch_iter
+
+        def bad_source():
+            yield 1
+            raise ValueError("producer exploded")
+
+        it = prefetch_iter(bad_source(), maxsize=2)
+        assert next(it) == 1
+        with pytest.raises(ValueError, match="producer exploded") as ei:
+            list(it)
+        # the producer's own frame must be visible in the chained traceback
+        import traceback
+
+        frames = "".join(traceback.format_exception(
+            type(ei.value), ei.value, ei.value.__traceback__))
+        assert "bad_source" in frames
