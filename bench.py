@@ -886,6 +886,216 @@ def bench_fault_smoke(steps: int, batch: int = 64,
         shutil.rmtree(ckdir, ignore_errors=True)
 
 
+def bench_supervisor_smoke(steps: int, batch: int = 64,
+                           checkpoint_every: int | None = None) -> dict:
+    """CPU-friendly smoke of the self-healing layer (ISSUE 4): the same
+    LeNet-class config as fault-smoke, trained once per round under a
+    plain CheckpointListener ("off") and once under a TrainingSupervisor
+    ("on" — incarnation claim, anchor checkpoint, heartbeat listener,
+    monitor thread, same checkpoint cadence), interleaved A/B; then one
+    injected mid-epoch crash that the supervisor must heal WITHOUT human
+    intervention. Self-validating hard-fails:
+
+    - resume-parity mismatch: the supervised run with an injected restart
+      must reproduce the uninterrupted run's loss sequence EXACTLY
+      (bit-identical float equality, CPU);
+    - any retrace inside a timed no-fault window (supervision must not
+      perturb the compile story);
+    - supervision overhead > 10% in the no-fault case (median of
+      per-round on/off ratios, same estimator as fault-smoke; the "on"
+      window deliberately pays the supervisor's FULL per-fit cost —
+      incarnation claim, anchor save_now, writer drain on close — and
+      each timed window spans several epochs so that fixed per-fit cost
+      amortizes the way any real run amortizes it);
+    - supervisor counters not visible (restart/attempt ledger empty after
+      the healed run).
+
+    Emits the supervisor ledger alongside the checkpoint ledger."""
+    import shutil
+    import statistics as _stats
+    import tempfile
+
+    import jax
+
+    from deeplearning4j_tpu.common import faultinject
+    from deeplearning4j_tpu.common.profiler import OpProfiler
+    from deeplearning4j_tpu.data import NDArrayDataSetIterator
+    from deeplearning4j_tpu.ndarray.rng import set_default_seed
+    from deeplearning4j_tpu.optimize.listeners import (
+        CheckpointListener, CollectScoresIterationListener)
+    from deeplearning4j_tpu.parallel import TrainingSupervisor
+
+    if checkpoint_every is None:
+        checkpoint_every = max(5, (steps + 1) // 2)
+    rng = np.random.RandomState(0)
+    n = steps * batch + batch // 2
+    x = rng.randn(n, 1, 28, 28).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+
+    def make_it():
+        return NDArrayDataSetIterator(x, y, batch_size=batch)
+
+    def fail(msg, **extra):
+        print(json.dumps({"error": msg, **extra}))
+        sys.exit(1)
+
+    prof = OpProfiler.get()
+    faultinject.clear_plan()
+    dirs = {"off": tempfile.mkdtemp(prefix="dl4j_sup_smoke_off_"),
+            "on": tempfile.mkdtemp(prefix="dl4j_sup_smoke_on_")}
+    try:
+        models = {"off": _lenet_model(), "on": _lenet_model()}
+        off_ckpt = CheckpointListener(
+            dirs["off"], save_every_n_iterations=checkpoint_every,
+            keep_last=2)
+        models["off"].set_listeners(off_ckpt)
+        sup = TrainingSupervisor(models["on"], dirs["on"],
+                                 save_every_n_iterations=checkpoint_every,
+                                 keep_last=2, backoff_base_s=0.01)
+
+        def run(name, epochs=1):
+            if name == "off":
+                models["off"].fit(make_it(), epochs=epochs,
+                                  batch_size=batch)
+            else:
+                res = sup.fit(make_it, epochs=epochs, batch_size=batch,
+                              resume="never")
+                if res.status != "completed" or res.restarts:
+                    fail("no-fault supervised epoch did not complete "
+                         "cleanly", result=repr(res))
+            float(models[name]._score_dev)      # value fence
+
+        # compile footprint: supervision must not change it
+        warm = {}
+        for name in ("off", "on"):
+            prof.reset()
+            run(name)
+            warm[name] = prof.trace_counts()
+        if warm["on"] != warm["off"]:
+            fail("supervision changed the compile footprint (retrace "
+                 "delta)", off_traces=warm["off"], on_traces=warm["on"])
+
+        # interleaved A/B timing (same estimator as fault-smoke: median
+        # of per-round on/off ratios after one untimed settle round);
+        # several epochs per window so the supervisor's fixed per-fit
+        # cost (anchor checkpoint + close drain) amortizes realistically
+        round_epochs = 4
+
+        def timed_epoch(name):
+            t0 = time.perf_counter()
+            run(name, epochs=round_epochs)
+            dt = time.perf_counter() - t0
+            if name == "off":
+                off_ckpt.flush()                # drain tail, untimed
+            return dt
+
+        timed_epoch("on")
+        timed_epoch("off")
+        prof.reset()
+        times = {"off": [], "on": []}
+        ratios = []
+        for r in range(6):
+            order = ("on", "off") if r % 2 == 0 else ("off", "on")
+            round_t = {name: timed_epoch(name) for name in order}
+            times["on"].append(round_t["on"])
+            times["off"].append(round_t["off"])
+            ratios.append(round_t["on"] / round_t["off"])
+        hot = prof.trace_counts()
+        if any(hot.values()):
+            fail("train step retraced inside a timed window", traces=hot)
+        ckpt_ledger = prof.checkpoint_stats()
+        t_off = _stats.median(times["off"])
+        t_on = _stats.median(times["on"])
+        overhead = _stats.median(ratios) - 1.0
+        if overhead > 0.10:
+            fail(f"supervision overhead {overhead:.1%} exceeds the 10% "
+                 "budget", off_s=round(t_off, 4), on_s=round(t_on, 4),
+                 on_times=[round(t, 4) for t in times["on"]],
+                 off_times=[round(t, 4) for t in times["off"]])
+        off_ckpt.close()
+
+        # injected restart: crash mid-epoch-2, supervisor heals, loss
+        # sequence bitwise-equal to the uninterrupted baseline
+        prof.reset()
+        par_epochs = 2
+        par_steps = min(steps, 8)
+        xs, ys = x[:par_steps * batch], y[:par_steps * batch]
+
+        def par_it():
+            return NDArrayDataSetIterator(xs, ys, batch_size=batch,
+                                          shuffle=True, seed=3)
+
+        set_default_seed(99)
+        base_model = _lenet_model()
+        base_scores = CollectScoresIterationListener()
+        base_model.set_listeners(base_scores)
+        base_model.fit(par_it(), epochs=par_epochs, batch_size=batch)
+        baseline = [s for _, s in base_scores.scores]
+
+        set_default_seed(99)
+        victim = _lenet_model()
+        vs = CollectScoresIterationListener()
+        victim.set_listeners(vs)
+        crash_at = par_steps + 1
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "train/step", "index": crash_at, "kind": "crash"}]))
+        heal_dir = tempfile.mkdtemp(prefix="dl4j_sup_smoke_heal_")
+        try:
+            sup2 = TrainingSupervisor(victim, heal_dir,
+                                      save_every_n_iterations=3,
+                                      keep_last=2, backoff_base_s=0.01)
+            res = sup2.fit(par_it, epochs=par_epochs, batch_size=batch,
+                           resume="never")
+        finally:
+            faultinject.clear_plan()
+            shutil.rmtree(heal_dir, ignore_errors=True)
+        if res.status != "completed" or res.restarts != 1:
+            fail("supervisor did not heal the injected crash with exactly "
+                 "one restart", result=repr(res),
+                 history=res.history)
+        resumed = [s for _, s in vs.scores]
+        if resumed != baseline:
+            diff = next((i for i, (a, b) in enumerate(zip(baseline, resumed))
+                         if a != b), min(len(baseline), len(resumed)))
+            fail("resume-parity mismatch: supervised+healed loss sequence "
+                 "differs from the uninterrupted run",
+                 first_diff_step=diff, baseline_len=len(baseline),
+                 resumed_len=len(resumed))
+        sup_ledger = prof.supervisor_stats()
+        if sup_ledger.get("restarts") != 1 or \
+                sup_ledger.get("attempts") != 2:
+            fail("supervisor ledger does not show the healed restart",
+                 ledger=sup_ledger)
+
+        images = (n + (batch - n % batch) % batch) * round_epochs
+        return {
+            "metric": "supervisor_smoke",
+            "value": images / t_on,
+            "unit": "images/sec",
+            "batch": batch,
+            "platform": jax.devices()[0].platform,
+            "traces": warm["on"],
+            "supervision_overhead_frac": round(overhead, 4),
+            "epoch_s_off_median": round(t_off, 4),
+            "epoch_s_on_median": round(t_on, 4),
+            "supervisor_ledger": {k: (round(v, 5) if isinstance(v, float)
+                                      else v)
+                                  for k, v in sup_ledger.items()},
+            "checkpoint_ledger": {k: (round(v, 5) if isinstance(v, float)
+                                      else v)
+                                  for k, v in ckpt_ledger.items()},
+            "resume_parity": "exact",
+            "resume_steps_compared": len(baseline),
+            "data": "synthetic LeNet batches; supervised vs plain "
+                    "checkpointed epochs interleaved, one injected "
+                    "mid-epoch crash healed by restart",
+        }
+    finally:
+        faultinject.clear_plan()
+        for d in dirs.values():
+            shutil.rmtree(d, ignore_errors=True)
+
+
 def bench_word2vec(steps: int) -> dict:
     """North-star config 4: Word2Vec skip-gram + negative sampling over a
     synthetic zipfian corpus; throughput = corpus words consumed / sec
@@ -1157,7 +1367,7 @@ def main() -> None:
                                  "paragraph-vectors", "glove", "fasttext",
                                  "resnet50-disk", "resnet50-predecoded",
                                  "pipeline-smoke", "telemetry-smoke",
-                                 "fault-smoke"])
+                                 "fault-smoke", "supervisor-smoke"])
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--batch", type=int, default=None,
                         help="per-config default: resnet50=128, bert=32")
@@ -1237,6 +1447,8 @@ def main() -> None:
         result = bench_telemetry_smoke(steps, batch=args.batch or 64)
     elif args.config == "fault-smoke":
         result = bench_fault_smoke(steps, batch=args.batch or 64)
+    elif args.config == "supervisor-smoke":
+        result = bench_supervisor_smoke(steps, batch=args.batch or 64)
     elif args.config == "resnet50-disk":
         result = bench_resnet50_disk(steps, batch=args.batch or 64)
     elif args.config == "resnet50-predecoded":

@@ -10,9 +10,10 @@ them a *deterministic, step-indexed* event:
 
 - A :class:`FaultPlan` is a list of fault specs, each bound to a SITE
   (``"pipeline/bind"``, ``"pipeline/place"``, ``"train/step"``,
-  ``"checkpoint/pre_rename"``, ``"inference/worker"``) and a zero-based
+  ``"train/wedge"``, ``"supervisor/hang"``, ``"checkpoint/pre_rename"``,
+  ``"inference/worker"``, ``"inference/probe"``) and a zero-based
   INDEX at that site (batch ordinal within a fit call, checkpoint commit
-  sequence, inference request ordinal).
+  sequence, inference request ordinal, supervisor attempt ordinal).
 - Instrumented code calls :func:`fault_point(site, index)` at the matching
   place. Raising kinds (``transient``, ``crash``, ``dead_replica``) raise
   there; ``slow`` sleeps in place; advisory kinds (``nan``) are returned
@@ -25,10 +26,17 @@ them a *deterministic, step-indexed* event:
 Spec fields: ``{"site": ..., "kind": ..., "index": k}`` plus per-kind
 extras — ``times`` (how many calls at that index fire, default 1; the
 retry tests use ``times: 2`` to fail two attempts then recover),
-``seconds`` (``slow``), ``mode`` (``crash``: ``"raise"`` raises
-:class:`SimulatedCrash`, ``"exit"`` hard-kills the process via
-``os._exit`` — the no-cleanup death a preempted worker sees), ``code``
-(exit status, default 137).
+``seconds`` (``slow``; for ``wedge`` the block's timeout ceiling),
+``mode`` (``crash``: ``"raise"`` raises :class:`SimulatedCrash`,
+``"exit"`` hard-kills the process via ``os._exit`` — the no-cleanup
+death a preempted worker sees), ``code`` (exit status, default 137).
+
+The ``wedge`` kind simulates a HUNG dispatch (a wedged device, a
+deadlocked collective): the calling thread blocks until
+:func:`release_wedges` (the supervisor's watchdog calls it when it
+abandons the attempt) or the spec's ``seconds`` ceiling, then raises
+:class:`WedgeReleased` — the wedged thread unwinds and dies rather than
+resuming training concurrently with its restarted replacement.
 
 Every fired fault bumps an ``OpProfiler`` counter
 (``faults/<site>/<kind>``), so a run can assert both that injected faults
@@ -65,6 +73,28 @@ class SimulatedCrash(BaseException):
 class DeadReplicaFault(RuntimeError):
     """An inference replica dying mid-request (wedged device, OOM-killed
     worker). ParallelInference retires the worker that sees one."""
+
+
+class WedgeReleased(BaseException):
+    """An injected wedge unblocked (watchdog abandonment or timeout).
+    BaseException for the same reason as SimulatedCrash: the wedged
+    thread must DIE, not be resurrected by a broad ``except Exception``
+    — its supervisor has already restarted the work elsewhere."""
+
+
+_wedge_event = threading.Event()
+
+
+def release_wedges() -> None:
+    """Unblock every thread parked in an injected ``wedge`` fault; each
+    raises :class:`WedgeReleased` and unwinds. The supervisor's watchdog
+    calls this when it abandons a hung attempt."""
+    _wedge_event.set()
+
+
+def reset_wedges() -> None:
+    """Re-arm the wedge latch (test setup / after a supervised restart)."""
+    _wedge_event.clear()
 
 
 def is_transient(exc: BaseException) -> bool:
@@ -146,6 +176,7 @@ def get_plan() -> Optional[FaultPlan]:
 
 def set_plan(plan: Optional[FaultPlan]) -> None:
     global _plan, _env_checked
+    reset_wedges()   # a stale release must not defang the new plan's wedges
     with _plan_lock:
         _plan = plan
         _env_checked = True   # an explicit None must not resurrect the env plan
@@ -154,6 +185,7 @@ def set_plan(plan: Optional[FaultPlan]) -> None:
 def clear_plan() -> None:
     """Reset to 'no plan, env re-read on next use' (test teardown)."""
     global _plan, _env_checked
+    reset_wedges()
     with _plan_lock:
         _plan = None
         _env_checked = False
@@ -180,6 +212,10 @@ def fault_point(site: str, index: Optional[int] = None) -> List[Dict[str, Any]]:
         logger.warning("faultinject: firing %s at %s[%s]", kind, site, index)
         if kind == "slow":
             time.sleep(float(spec.get("seconds", 0.1)))
+        elif kind == "wedge":
+            _wedge_event.wait(timeout=float(spec.get("seconds", 300.0)))
+            raise WedgeReleased(
+                f"injected wedge at {site}[{index}] released")
         elif kind == "transient":
             raise TransientFault(
                 f"injected transient fault at {site}[{index}]")

@@ -157,6 +157,21 @@ class OpProfiler:
                 out[key] = n
         return out
 
+    def supervisor_stats(self) -> Dict[str, float]:
+        """Self-healing-loop ledger: supervised attempts, restarts,
+        watchdog fires, preemptions, storm trips, give-ups (the
+        ``supervisor/*`` counters) plus backoff wall time — the /api/health
+        and drill-test view of what the restart loop actually did. Empty
+        when no supervisor ever ran."""
+        out: Dict[str, float] = {
+            k.split("/", 1)[1]: v for k, v in self._counters.items()
+            if k.startswith("supervisor/")}
+        s = self._sections.get("supervisor/backoff")
+        if s:
+            out["backoff_s"] = s["total_s"]
+            out["backoff_count"] = s["count"]
+        return out
+
     def fault_stats(self) -> Dict[str, float]:
         """Fault-tolerance ledger: injected-fault counters
         (``faults/<site>/<kind>``), pipeline retry count, and backoff wall

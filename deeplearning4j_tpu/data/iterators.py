@@ -47,6 +47,19 @@ class DataSetIterator:
             pre.pre_process(ds)
         return ds
 
+    # --- supervised-restart protocol (parallel.distributed) ------------
+    # A source with cross-epoch state (per-epoch shuffle RNG) opts into
+    # in-process restart by exposing its rewindable state: the
+    # TrainingSupervisor captures source_state() at fit entry and
+    # restores it before every restarted attempt, so the checkpoint
+    # cursor's host replay sees the SAME epoch/shuffle sequence the
+    # killed attempt saw. Stateless-per-epoch sources need neither.
+    def source_state(self) -> Optional[dict]:
+        return None
+
+    def restore_source_state(self, state: dict) -> None:
+        pass
+
 
 class NDArrayDataSetIterator(DataSetIterator):
     """Iterate (features, labels) arrays in minibatches."""
@@ -63,6 +76,14 @@ class NDArrayDataSetIterator(DataSetIterator):
 
     def batch(self) -> int:
         return self.batch_size
+
+    def source_state(self) -> dict:
+        # the per-epoch shuffle key is seed + _epoch: rewinding _epoch is
+        # all an in-process restart needs to replay identical shuffles
+        return {"epoch": self._epoch}
+
+    def restore_source_state(self, state: dict) -> None:
+        self._epoch = int(state.get("epoch", 0))
 
     def __iter__(self):
         idx = np.arange(len(self.features))

@@ -340,12 +340,16 @@ def run_epochs(data: Any, epochs: int, batch_size: Optional[int],
         if k == 1:
             for b in feed:
                 faultinject.fault_point("train/step", n_dispatched)
+                # a wedge here is a hung dispatch: the thread blocks until
+                # the supervisor's watchdog abandons it (release_wedges)
+                faultinject.fault_point("train/wedge", n_dispatched)
                 n_dispatched += 1
                 dispatch_one(b)
         else:
             for group in chunked(feed, k):
                 for j in range(len(group)):
                     faultinject.fault_point("train/step", n_dispatched + j)
+                    faultinject.fault_point("train/wedge", n_dispatched + j)
                 n_dispatched += len(group)
                 if len(group) == k and stackable(group):
                     dispatch_chunk(group)

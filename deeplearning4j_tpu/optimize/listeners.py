@@ -210,7 +210,9 @@ class CheckpointListener(TrainingListener):
 
     def __init__(self, directory: str, save_every_n_iterations: Optional[int] = None,
                  save_every_n_epochs: Optional[int] = None, keep_last: int = 3,
-                 async_write: bool = True):
+                 async_write: bool = True,
+                 max_total_bytes: Optional[int] = None,
+                 incarnation: Optional[int] = None):
         from ..util import checkpoint as _ckpt
 
         self.dir = directory
@@ -218,6 +220,13 @@ class CheckpointListener(TrainingListener):
         self.every_epoch = save_every_n_epochs
         self.keep_last = keep_last
         self.async_write = async_write
+        # disk-budget retention on top of keep_last: oldest committed
+        # checkpoints GC until the total fits (the newest always
+        # survives) — long supervised runs can't fill the disk
+        self.max_total_bytes = max_total_bytes
+        # supervised-restart fence: commits from an older incarnation are
+        # refused at the manifest (util.checkpoint.StaleIncarnationError)
+        self.incarnation = incarnation
         os.makedirs(directory, exist_ok=True)
         _ckpt.clean_stale_tmp(directory)
         # survive a process restart: retention + last_checkpoint continue
@@ -249,41 +258,76 @@ class CheckpointListener(TrainingListener):
         saved = [p for p in self._saved if p != path] + [path]
         if self.keep_last and len(saved) > self.keep_last:
             saved = saved[-self.keep_last:]
+        if self.max_total_bytes:
+            # the byte-budget GC already unlinked its victims — one stat
+            # per survivor keeps the mirror honest without a manifest read
+            saved = [p for p in saved if os.path.exists(p)]
         self._saved = saved
 
     def _get_writer(self):
         from ..util import checkpoint as _ckpt
 
         if self._writer is None:
-            self._writer = _ckpt.CheckpointWriter(self.dir, self.keep_last,
-                                                  on_commit=self._note_commit)
+            self._writer = _ckpt.CheckpointWriter(
+                self.dir, self.keep_last, on_commit=self._note_commit,
+                max_total_bytes=self.max_total_bytes,
+                incarnation=self.incarnation)
         return self._writer
 
     # --- saving ---------------------------------------------------------
-    def _save(self, model, tag: str) -> None:
+    def _save(self, model, tag: str, sync: bool = False) -> Optional[str]:
         from ..util import checkpoint as _ckpt
 
         if hasattr(model, "_params") and hasattr(model, "conf"):
             snapshot = _ckpt.snapshot_training_state(model,
                                                      listeners=self._group)
-            if self.async_write:
+            if self.async_write and not sync:
                 self._get_writer().submit(snapshot, tag)
-                return
-            data = _ckpt.serialize_snapshot(snapshot)
-            path = _ckpt.commit_checkpoint(self.dir, tag, data,
-                                           snapshot["iteration"],
-                                           self.keep_last, seq=self._seq)
-            self._seq += 1
-            self._note_commit(path)
-            return
+                return None
+            return self._commit_snapshot(snapshot, tag)
         # legacy self-serializing models (SameDiff): synchronous, but
         # still atomic + manifested + retained
         path = os.path.join(self.dir, f"checkpoint_{tag}.zip")
         model.save(path, save_updater=True)
         _ckpt.register_committed(self.dir, path,
                                  int(getattr(model, "_iteration", 0)),
-                                 self.keep_last)
+                                 self.keep_last,
+                                 max_total_bytes=self.max_total_bytes,
+                                 incarnation=self.incarnation)
         self._note_commit(path)
+        return path
+
+    def _commit_snapshot(self, snapshot: dict, tag: str) -> str:
+        from ..util import checkpoint as _ckpt
+
+        data = _ckpt.serialize_snapshot(snapshot)
+        path = _ckpt.commit_checkpoint(self.dir, tag, data,
+                                       snapshot["iteration"],
+                                       self.keep_last, seq=self._seq,
+                                       max_total_bytes=self.max_total_bytes,
+                                       incarnation=self.incarnation)
+        self._seq += 1
+        self._note_commit(path)
+        return path
+
+    def save_now(self, model, tag: str,
+                 rng_state: Optional[dict] = None) -> str:
+        """Flush-quality checkpoint: snapshot NOW on the calling thread,
+        commit synchronously (atomic + manifested + retained), and drain
+        any in-flight async writes first so this commit is the NEWEST.
+        The preemption-signal path (TrainingSupervisor) and the
+        supervisor's attempt-0 anchor come through here. ``rng_state``:
+        see ``util.checkpoint.snapshot_training_state``."""
+        from ..util import checkpoint as _ckpt
+
+        self.flush()
+        if hasattr(model, "_params") and hasattr(model, "conf"):
+            snapshot = _ckpt.snapshot_training_state(
+                model, listeners=self._group, rng_state=rng_state)
+            return self._commit_snapshot(snapshot, tag)
+        path = self._save(model, tag, sync=True)
+        assert path is not None
+        return path
 
     def iteration_done(self, model, iteration, score):
         if self.every_iter and iteration % self.every_iter == 0:

@@ -6,7 +6,11 @@ from .accumulator import (GradientsAccumulator, DenseAllReduceAccumulator,
 from .wrapper import ParallelWrapper
 from .sharding import tp_param_specs, tp_shardings, apply_tp
 from .inference import ParallelInference
-from .distributed import SharedTrainingMaster, initialize, shutdown
+from .distributed import (SharedTrainingMaster, TrainingSupervisor,
+                          SupervisedFitResult, RestartBudgetExceeded,
+                          RestartStorm, Preempted, HangDetected,
+                          AbandonedAttempt, classify_failure,
+                          supervise_processes, initialize, shutdown)
 from .ring_attention import ring_attention, ring_self_attention
 from .sharded_embeddings import ShardedEmbedding
 from .pipeline import (HeterogeneousPipeline, PipelineParallel,

@@ -7,17 +7,56 @@ Reference: dl4j-scaleout Spark masters + the Aeron parameter-server fabric
 - data plane: XLA collectives over ICI/DCN compiled into the step — no
   message library, no spanning-tree mesh, no encode/decode;
 - control plane (the role Aeron's handshake/heartbeat/mesh played):
-  the jax coordination service (``jax.distributed.initialize``);
+  the jax coordination service (``jax.distributed.initialize``) for
+  bootstrap, and — this module's :class:`TrainingSupervisor` — the
+  heartbeat / dead-node-handling half: a self-healing restart loop that
+  wraps any fit path;
 - elasticity: the async mesh's node-remap is replaced by checkpoint-restart
   (orbax-style atomic checkpoints + resume; SURVEY.md §5.3) — XLA collectives
-  are synchronous, so a lost host means restart-from-step-N, and that path is
-  what ``SharedTrainingMaster.fit`` wires in via its CheckpointListener.
+  are synchronous, so a lost participant means supervised restart-from-step-N
+  (the SPMD assumption of arXiv:2004.13336), not async continuation.
+
+The supervisor stack, in-process first:
+
+- **failure classification** (:func:`classify_failure`): transient input
+  faults / poisoned numerics / device-collective failure / external
+  preemption, each mapped to a policy — retry in place, raise (the
+  in-graph NanSentinel *skip* already handled the recoverable numerics),
+  checkpoint-restart, or clean exit with a restartable status;
+- **bounded restart budget** with exponential backoff and a restart-storm
+  circuit breaker; every restart resumes from the last intact checkpoint
+  through the util.checkpoint machinery, so a healed run's loss sequence
+  is bit-identical to an uninterrupted one;
+- **progress watchdog**: heartbeat = steps completed (fed by the listener
+  bus), a configurable deadline declares a hang, the wedged dispatch is
+  abandoned (``faultinject.release_wedges`` for drills) and the run
+  restarts;
+- **preemption signals**: SIGTERM/SIGINT trigger a flush-quality
+  checkpoint (async writer drained, committed synchronously) and a
+  ``"preempted"``/resumable result instead of dying dirty;
+- **incarnation fence**: each (re)start claims a monotonic incarnation id
+  in ``checkpoint.json``; a stale pre-restart writer that wakes up late
+  can never commit over its replacement's checkpoints.
+
+Process-level, :func:`supervise_processes` is the multi-host restart loop
+the reference mesh's dead-node remap becomes: launch the SPMD group, and
+when ANY participant dies, terminate the survivors and relaunch the whole
+group (synchronous collectives cannot continue around a hole) — each
+relaunch resumes from the shared checkpoint directory.
 """
 
 from __future__ import annotations
 
+import logging
 import os
-from typing import Any, List, Optional
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..common import faultinject
+from ..common.profiler import OpProfiler
+
+logger = logging.getLogger("deeplearning4j_tpu")
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -45,6 +84,710 @@ def shutdown() -> None:
     import jax
 
     jax.distributed.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# failure classification
+# ---------------------------------------------------------------------------
+
+CLASS_TRANSIENT = "transient_input"
+CLASS_NUMERIC = "poisoned_numerics"
+CLASS_DEVICE = "device_failure"
+CLASS_PREEMPTION = "preemption"
+CLASS_HANG = "hang"
+CLASS_USER = "user_error"
+
+#: classification → what the supervisor does about it. "retry" restarts
+#: from the last intact checkpoint with FLAT backoff (a transient input
+#: fault that exhausted the pipeline's own bounded retries — in-place
+#: retry is the policy, the checkpoint merely anchors exactness);
+#: "restart" is checkpoint-restart with exponential backoff; "raise"
+#: propagates (a FloatingPointError here means the NanSentinel was in
+#: raise mode — the *skip* policy for poisoned numerics is its in-graph
+#: job, and user/config errors are deterministic: restarting cannot
+#: help); "exit" is the preemption path — flush-quality checkpoint, then
+#: a clean return with a resumable status.
+DEFAULT_POLICIES: Dict[str, str] = {
+    CLASS_TRANSIENT: "retry",
+    CLASS_NUMERIC: "raise",
+    CLASS_DEVICE: "restart",
+    CLASS_HANG: "restart",
+    CLASS_PREEMPTION: "exit",
+    CLASS_USER: "raise",
+}
+
+
+class Preempted(BaseException):
+    """Raised inside the training thread (by the supervisor's heartbeat
+    listener, at a dispatch boundary) when a preemption signal arrived.
+    BaseException so user ``except Exception`` recovery cannot swallow
+    the shutdown request."""
+
+
+class HangDetected(RuntimeError):
+    """The watchdog's verdict on an attempt that stopped landing steps."""
+
+
+class RestartBudgetExceeded(RuntimeError):
+    """The supervisor gave up. ``history`` carries one record per failed
+    attempt (classification, policy, exception repr, steps landed)."""
+
+    def __init__(self, message: str, history: Optional[List[dict]] = None):
+        if history:
+            tail = "; ".join(
+                f"attempt {h['attempt']}: {h['class']} ({h['error']})"
+                for h in history[-3:])
+            message = f"{message} — failure history ({len(history)}): {tail}"
+        super().__init__(message)
+        self.history = list(history or [])
+
+
+class RestartStorm(RestartBudgetExceeded):
+    """Circuit breaker: consecutive restarts with ZERO forward progress —
+    something is deterministically broken; backing off harder won't fix
+    it, so stop burning the budget."""
+
+
+def classify_failure(exc: Optional[BaseException]) -> str:
+    """Map an exception that escaped a fit attempt to a failure class.
+    Unknown exceptions classify as device failure (restartable with a
+    bounded budget — the budget is the safety net for misclassification);
+    deterministic config/user errors classify as ``user_error`` so the
+    supervisor surfaces them immediately instead of retrying a bug."""
+    if exc is None:
+        return CLASS_HANG
+    if isinstance(exc, Preempted):
+        return CLASS_PREEMPTION
+    if faultinject.is_transient(exc):
+        return CLASS_TRANSIENT
+    if isinstance(exc, FloatingPointError):
+        return CLASS_NUMERIC
+    if isinstance(exc, (faultinject.SimulatedCrash,
+                        faultinject.WedgeReleased)):
+        return CLASS_DEVICE
+    if isinstance(exc, (TypeError, ValueError, KeyError, AttributeError,
+                        IndexError, NotImplementedError, AssertionError)):
+        return CLASS_USER
+    return CLASS_DEVICE
+
+
+class SupervisedFitResult:
+    """What a supervised fit ended as. ``status`` is ``"completed"`` or
+    ``"preempted"`` (every other ending raises); a preempted result is
+    ``resumable`` from ``resume_from`` — exit with ``resumable_exit_code``
+    and an outer :func:`supervise_processes` (or scheduler) relaunches."""
+
+    resumable_exit_code = 75      # EX_TEMPFAIL
+
+    def __init__(self, status: str, resume_from: Optional[str],
+                 restarts: int, attempts: int, history: List[dict]):
+        self.status = status
+        self.resumable = status == "preempted"
+        self.resume_from = resume_from
+        self.restarts = restarts
+        self.attempts = attempts
+        self.history = history
+
+    def __repr__(self) -> str:
+        return (f"SupervisedFitResult(status={self.status!r}, "
+                f"attempts={self.attempts}, restarts={self.restarts}, "
+                f"resume_from={self.resume_from!r})")
+
+
+class AbandonedAttempt(BaseException):
+    """Raised in a ZOMBIE attempt thread — one the watchdog abandoned
+    that later woke up — at its next listener boundary, so it dies
+    instead of training (and checkpointing) concurrently with its
+    replacement. BaseException: recovery code must not resurrect it."""
+
+
+class _AttemptFence:
+    """First listener in the supervised arrangement: only the CURRENT
+    attempt's thread may pass. A zombie thread (abandoned by the
+    watchdog, woken later) is killed at its next step/epoch boundary
+    BEFORE any downstream listener sees the callback — its beats can't
+    mask a replacement's hang, its scores can't corrupt restored listener
+    state, and its checkpoint cadence never fires."""
+
+    def __init__(self):
+        self.thread: Optional[threading.Thread] = None
+
+    def _check(self) -> None:
+        if threading.current_thread() is not self.thread:
+            raise AbandonedAttempt(
+                "attempt thread was abandoned by the supervisor; "
+                "unwinding instead of racing its replacement")
+
+    def iteration_done(self, model, iteration: int, score) -> None:
+        self._check()
+
+    def epoch_done(self, model, epoch: int) -> None:
+        self._check()
+
+
+class _Heartbeat:
+    """The progress pulse, fed by the listener bus: every completed step
+    beats; the watchdog compares the beat's age to the hang deadline. At
+    dispatch boundaries it also surfaces a pending preemption signal as
+    :class:`Preempted` — the training thread unwinds at a step boundary,
+    where the holder's published state is checkpoint-consistent. One
+    instance per attempt (a zombie's beats must not vouch for its
+    replacement; the fence kills zombies before they reach this anyway)."""
+
+    def __init__(self, supervisor: "TrainingSupervisor"):
+        self._sup = supervisor
+        self.steps = 0
+        self.last_beat = time.monotonic()
+
+    def iteration_done(self, model, iteration: int, score) -> None:
+        self.steps += 1
+        self.last_beat = time.monotonic()
+        sup = self._sup
+        if sup._preempt.is_set() and \
+                getattr(model, "_at_dispatch_boundary", True):
+            raise Preempted(
+                f"preemption signal {sup._preempt_signal} received")
+
+    def epoch_done(self, model, epoch: int) -> None:
+        self.last_beat = time.monotonic()
+
+
+class _Attempt:
+    """One supervised try of the wrapped fit, on its own daemon thread.
+    The thread seeds its per-thread RNG stream from the supervisor's
+    entry state (so attempt 1 draws exactly what an unsupervised fit on
+    the calling thread would have drawn; resumed attempts overwrite it
+    from the checkpoint anyway) and reports its FINAL stream state back
+    for preemption flushes and caller-stream transparency."""
+
+    def __init__(self, supervisor: "TrainingSupervisor", index: int,
+                 data: Any, epochs: int, resume_from: Optional[str],
+                 fit_kwargs: dict, entry_rng: dict,
+                 heartbeat: _Heartbeat):
+        self._sup = supervisor
+        self.index = index
+        self._data = data
+        self._epochs = epochs
+        self._resume_from = resume_from
+        self._fit_kwargs = fit_kwargs
+        self._entry_rng = entry_rng
+        self.heartbeat = heartbeat
+        self.error: Optional[BaseException] = None
+        self.rng_state: Optional[dict] = None
+        self.abandoned = False
+        self.done = threading.Event()
+        self.thread = threading.Thread(
+            target=self._main, daemon=True,
+            name=f"dl4j-supervised-fit-{index}")
+
+    def start(self) -> None:
+        self.thread.start()
+
+    def _main(self) -> None:
+        from ..ndarray.rng import get_random
+
+        try:
+            get_random().set_state(self._entry_rng)
+            # drill site: a "wedge" here hangs the attempt BEFORE its
+            # first heartbeat — the watchdog must catch that too
+            faultinject.fault_point("supervisor/hang", self.index - 1)
+            self._sup.target.fit(self._data, epochs=self._epochs,
+                                 resume_from=self._resume_from,
+                                 **self._fit_kwargs)
+        except BaseException as e:          # incl. SimulatedCrash/Preempted
+            self.error = e
+        finally:
+            try:
+                self.rng_state = get_random().get_state()
+            finally:
+                self.done.set()
+
+
+class TrainingSupervisor:
+    """Self-healing wrapper around any fit path (``MultiLayerNetwork``,
+    ``ComputationGraph``, ``ParallelWrapper`` — anything exposing
+    ``fit(data, epochs=..., resume_from=...)``, ``set_listeners`` and the
+    holder internals the checkpoint layer snapshots).
+
+    The supervised loop: claim an incarnation, anchor an initial
+    checkpoint (so even a step-0 crash replays exactly), run the fit on a
+    worker thread, and monitor it — classify every failure, restart from
+    the last intact checkpoint within a bounded budget (exponential
+    backoff, restart-storm circuit breaker), declare a hang when no step
+    lands within ``hang_deadline_s``, and turn SIGTERM/SIGINT into a
+    flush-quality checkpoint plus a resumable result. Because every
+    restart resumes through the PR-3 exact-resume machinery (params,
+    updater, RNG stream, listener state, pipeline cursor — and the data
+    source rewound via the ``source_state`` protocol or a fresh factory
+    call), the healed run's loss sequence is bit-identical to an
+    uninterrupted one.
+
+    ``data`` may be a zero-arg factory (recommended for stateful
+    sources): it is called once per attempt, giving every restart a
+    pristine source. A plain source is reused; cross-epoch state is
+    rewound through ``source_state``/``restore_source_state`` when the
+    source implements them.
+
+    In-process hang abandonment leaves the wedged daemon thread behind.
+    Two fences bound the damage if it later wakes: each attempt claims a
+    FRESH incarnation with its own checkpoint listener, so the zombie's
+    still-queued writer commits are refused at the manifest
+    (:class:`util.checkpoint.StaleIncarnationError`), and the
+    :class:`_AttemptFence` — first in the listener arrangement — kills
+    the zombie at its next step boundary before any listener (score
+    collection, checkpoint cadence) sees its callbacks. The narrow
+    residue — a zombie publishing one in-flight step's params onto the
+    shared holder while the replacement trains — is inherent to
+    same-process threads; a thread truly stuck inside native code
+    likewise keeps its OS thread until process exit. For both terminal
+    cases run under :func:`supervise_processes`, which replaces the
+    whole process.
+    """
+
+    def __init__(self, target, checkpoint_dir: str, *,
+                 save_every_n_iterations: Optional[int] = None,
+                 save_every_n_epochs: Optional[int] = None,
+                 keep_last: int = 3,
+                 max_total_bytes: Optional[int] = None,
+                 max_restarts: int = 5,
+                 backoff_base_s: float = 0.25,
+                 backoff_max_s: float = 30.0,
+                 storm_threshold: int = 3,
+                 hang_deadline_s: Optional[float] = None,
+                 hang_startup_grace_s: Optional[float] = None,
+                 poll_s: float = 0.05,
+                 preempt_grace_s: float = 10.0,
+                 handle_signals: Optional[bool] = None,
+                 policies: Optional[Dict[str, str]] = None):
+        self.target = target
+        self.holder = target if hasattr(target, "_params") else target.model
+        self.dir = checkpoint_dir
+        self.every_iter = save_every_n_iterations
+        self.every_epoch = save_every_n_epochs
+        self.keep_last = keep_last
+        self.max_total_bytes = max_total_bytes
+        self.max_restarts = max_restarts
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.storm_threshold = storm_threshold
+        self.hang_deadline_s = hang_deadline_s
+        # before an attempt's FIRST heartbeat, restore + retrace/compile
+        # legitimately stall for longer than a steady-state step — give
+        # startup its own (longer) deadline so a healthy resume is not
+        # declared hung mid-compile
+        self.hang_startup_grace_s = (
+            hang_startup_grace_s if hang_startup_grace_s is not None
+            else (max(5.0 * hang_deadline_s, 10.0)
+                  if hang_deadline_s is not None else None))
+        self.poll_s = poll_s
+        self.preempt_grace_s = preempt_grace_s
+        self.handle_signals = handle_signals
+        self.policies = dict(DEFAULT_POLICIES)
+        self.policies.update(policies or {})
+        self.incarnation: Optional[int] = None
+        self._preempt = threading.Event()
+        self._preempt_signal: Optional[int] = None
+        self._fence = _AttemptFence()
+        self._old_handlers: Dict[int, Any] = {}
+
+    # --- signals --------------------------------------------------------
+    def _install_signals(self) -> None:
+        if self.handle_signals is False:
+            return
+        if threading.current_thread() is not threading.main_thread():
+            if self.handle_signals:
+                logger.warning("supervisor: signal handlers need the main "
+                               "thread; preemption signals will not be "
+                               "caught in this run")
+            return
+        import signal as _signal
+
+        for sig in (_signal.SIGTERM, _signal.SIGINT):
+            try:
+                self._old_handlers[sig] = _signal.signal(sig, self._on_signal)
+            except (ValueError, OSError):          # exotic embeddings
+                pass
+
+    def _on_signal(self, signum, frame) -> None:
+        logger.warning("supervisor: signal %s received — flush checkpoint "
+                       "at the next step boundary, then exit resumable",
+                       signum)
+        self._preempt_signal = signum
+        self._preempt.set()
+
+    def _restore_signals(self) -> None:
+        import signal as _signal
+
+        for sig, old in self._old_handlers.items():
+            try:
+                _signal.signal(sig, old)
+            except (ValueError, OSError):
+                pass
+        self._old_handlers = {}
+
+    # --- monitoring -----------------------------------------------------
+    def _monitor(self, run: _Attempt) -> str:
+        """Watch one attempt: returns ``"done"`` (thread finished, clean
+        or with ``run.error``), ``"hang"`` (watchdog fired, attempt
+        abandoned) or ``"preempt_timeout"`` (signal arrived but the
+        thread would not reach a step boundary within the grace window —
+        abandoned, best-effort recovery from the last committed
+        checkpoint)."""
+        prof = OpProfiler.get()
+        heartbeat = run.heartbeat
+        grace_deadline: Optional[float] = None
+        while True:
+            if run.done.wait(self.poll_s):
+                return "done"
+            now = time.monotonic()
+            if self._preempt.is_set():
+                if grace_deadline is None:
+                    grace_deadline = now + self.preempt_grace_s
+                elif now > grace_deadline:
+                    run.abandoned = True
+                    faultinject.release_wedges()
+                    run.done.wait(2.0)
+                    return "preempt_timeout"
+            deadline = (self.hang_deadline_s if heartbeat.steps > 0
+                        else self.hang_startup_grace_s)
+            if deadline is not None and \
+                    now - heartbeat.last_beat > deadline:
+                prof.count("supervisor/watchdog_fires")
+                logger.warning(
+                    "supervisor: watchdog — no step within %.2fs (last "
+                    "heartbeat %d steps in); abandoning the wedged "
+                    "dispatch and restarting from the last checkpoint",
+                    deadline, heartbeat.steps)
+                run.abandoned = True
+                faultinject.release_wedges()
+                if not run.done.wait(5.0):
+                    logger.warning("supervisor: hung attempt thread did "
+                                   "not unwind; abandoning it (daemon)")
+                elif run.error is None:
+                    # the "hung" attempt was merely slow and finished
+                    # cleanly while being abandoned — that is a
+                    # completion, not a failure
+                    run.abandoned = False
+                    return "done"
+                return "hang"
+
+    # --- the self-healing loop -----------------------------------------
+    def fit(self, data, epochs: int = 1, resume: str = "auto",
+            **fit_kwargs) -> SupervisedFitResult:
+        """Run the wrapped fit to completion under supervision.
+
+        ``resume="auto"`` (default): a first attempt picks up the newest
+        intact checkpoint already in the directory — the relaunched-
+        process story. ``resume="never"``: the first attempt starts
+        fresh; checkpoints only serve restarts within THIS call."""
+        from ..ndarray.rng import get_random
+        from ..optimize.listeners import CheckpointListener
+        from ..util import checkpoint as _ckpt
+
+        if resume not in ("auto", "never"):
+            raise ValueError(f"resume must be 'auto' or 'never', "
+                             f"got {resume!r}")
+        prof = OpProfiler.get()
+        make_data: Optional[Callable[[], Any]] = \
+            data if callable(data) else None
+        src = make_data() if make_data else data
+        source_state = None
+        if make_data is None:
+            state_fn = getattr(src, "source_state", None)
+            if callable(state_fn):
+                source_state = state_fn()
+        user_listeners = list(getattr(self.target, "_listeners", []))
+        if not user_listeners and self.holder is not self.target:
+            # wrapper target with listeners attached to the MODEL: they
+            # must ride the supervised arrangement (and its checkpoints),
+            # not be silently displaced by it
+            user_listeners = list(getattr(self.holder, "_listeners", []))
+        target_restore = list(getattr(self.target, "_listeners", []))
+        entry_rng = get_random().get_state()
+        self._preempt.clear()
+        self._preempt_signal = None
+        self._install_signals()
+        history: List[dict] = []
+        restarts = 0
+        consec_no_progress = 0
+        status = "completed"
+        resume_path: Optional[str] = None
+        final_exc: Optional[BaseException] = None
+        run: Optional[_Attempt] = None
+        ckpt = None
+
+        def new_attempt_listener():
+            # one incarnation + checkpoint listener PER attempt: a zombie
+            # attempt's still-queued writer holds a now-stale incarnation
+            # and its commits are refused at the manifest
+            self.incarnation = _ckpt.claim_incarnation(self.dir)
+            return CheckpointListener(
+                self.dir, save_every_n_iterations=self.every_iter,
+                save_every_n_epochs=self.every_epoch,
+                keep_last=self.keep_last,
+                max_total_bytes=self.max_total_bytes,
+                incarnation=self.incarnation)
+
+        try:
+            ckpt = new_attempt_listener()
+            resume_from = (_ckpt.last_checkpoint(self.dir)
+                           if resume == "auto" else None)
+            if resume_from is None:
+                # anchor checkpoint: even a crash before the first
+                # periodic save restarts bit-exactly (initial params,
+                # updater, the entry RNG key the first attempt seeds,
+                # and the PRE-FIT listener state — a restart from the
+                # anchor must also rewind score histories). The group is
+                # bound in the supervised arrangement's positions so the
+                # position+class restore keys line up.
+                self.holder._fit_epoch0 = self.holder._epoch
+                self.holder._steps_in_epoch = 0
+                ckpt.bind_group([self._fence, *user_listeners])
+                ckpt.save_now(
+                    self.holder,
+                    f"init_{int(getattr(self.holder, '_iteration', 0))}",
+                    rng_state=entry_rng)
+            attempt = 0
+            while True:
+                attempt += 1
+                prof.count("supervisor/attempts")
+                faultinject.reset_wedges()
+                if attempt > 1:
+                    # drain the failed attempt's async writer BEFORE
+                    # choosing the resume point: a checkpoint submitted
+                    # just before the crash should not be replayed past
+                    ckpt.close()
+                    ckpt = new_attempt_listener()
+                    resume_from = _ckpt.last_checkpoint(self.dir)
+                    if make_data:
+                        src = make_data()
+                    elif source_state is not None:
+                        src.restore_source_state(source_state)
+                heartbeat = _Heartbeat(self)
+                # arrangement: the fence first (kills zombie threads
+                # before ANY listener sees their callbacks), user
+                # listeners next (their state rides the checkpoint), the
+                # checkpoint listener (a due save still lands at
+                # iteration boundaries), the heartbeat last (a preempted
+                # step is recorded by everything before it unwinds)
+                self.target.set_listeners(self._fence, *user_listeners,
+                                          ckpt, heartbeat)
+                run = _Attempt(self, attempt, src, epochs, resume_from,
+                               fit_kwargs, entry_rng, heartbeat)
+                self._fence.thread = run.thread
+                run.start()
+                outcome = self._monitor(run)
+                if outcome == "done" and run.error is None:
+                    break
+                watchdogged = outcome == "hang"
+                if watchdogged:
+                    exc: BaseException = HangDetected(
+                        f"no step within {self.hang_deadline_s}s "
+                        f"({run.heartbeat.steps} steps landed this "
+                        f"attempt); thread error: {run.error!r}")
+                else:
+                    exc = run.error or HangDetected(
+                        f"attempt abandoned ({outcome})")
+                cls = CLASS_HANG if watchdogged else classify_failure(exc)
+                policy = self.policies.get(cls, "restart")
+                history.append({
+                    "attempt": attempt, "class": cls, "policy": policy,
+                    "error": repr(exc), "steps": run.heartbeat.steps,
+                    "iteration": int(getattr(self.holder, "_iteration", 0)),
+                })
+                logger.warning("supervisor: attempt %d failed [%s → %s]: "
+                               "%r", attempt, cls, policy, exc)
+                # the POLICY decides (so a policies={"preemption":
+                # "restart"} override is honored); a grace-window timeout
+                # always exits — the environment is reclaiming us
+                if policy == "exit" or outcome == "preempt_timeout":
+                    prof.count("supervisor/preemptions")
+                    status = "preempted"
+                    if run.done.is_set() and not run.abandoned and \
+                            run.rng_state is not None:
+                        resume_path = ckpt.save_now(
+                            self.holder,
+                            f"preempt_{int(self.holder._iteration)}",
+                            rng_state=run.rng_state)
+                    else:
+                        # thread abandoned mid-dispatch: its state is not
+                        # boundary-consistent — fall back to what already
+                        # committed
+                        ckpt.flush()
+                        resume_path = _ckpt.last_checkpoint(self.dir)
+                    break
+                if policy == "raise":
+                    final_exc = exc
+                    break
+                # checkpoint-restart
+                if cls == CLASS_PREEMPTION:
+                    # a preemption override routed here: consume the
+                    # signal, or the next attempt preempts instantly
+                    self._preempt.clear()
+                    self._preempt_signal = None
+                if run.heartbeat.steps > 0:
+                    consec_no_progress = 0
+                else:
+                    consec_no_progress += 1
+                if consec_no_progress >= self.storm_threshold:
+                    prof.count("supervisor/storm_trips")
+                    final_exc = RestartStorm(
+                        f"restart storm: {consec_no_progress} consecutive "
+                        f"restarts with zero steps of progress", history)
+                    break
+                if restarts >= self.max_restarts:
+                    prof.count("supervisor/giveups")
+                    final_exc = RestartBudgetExceeded(
+                        f"restart budget ({self.max_restarts}) exhausted",
+                        history)
+                    break
+                restarts += 1
+                prof.count("supervisor/restarts")
+                delay = (self.backoff_base_s if policy == "retry" else
+                         min(self.backoff_base_s * (2 ** (restarts - 1)),
+                             self.backoff_max_s))
+                with prof.time_section("supervisor/backoff"):
+                    # interruptible: a preemption signal during backoff
+                    # must not wait the backoff out
+                    self._preempt.wait(delay)
+                if self._preempt.is_set():
+                    prof.count("supervisor/preemptions")
+                    status = "preempted"
+                    ckpt.flush()
+                    resume_path = _ckpt.last_checkpoint(self.dir)
+                    break
+        finally:
+            self._restore_signals()
+            self._fence.thread = None
+            try:
+                if ckpt is not None:
+                    ckpt.close()
+            finally:
+                self.target.set_listeners(*target_restore)
+        if final_exc is not None:
+            raise final_exc
+        if status == "completed" and run is not None \
+                and run.rng_state is not None:
+            # RNG transparency: the caller's stream ends where a plain
+            # (unsupervised) fit would have left it
+            get_random().set_state(run.rng_state)
+        return SupervisedFitResult(status, resume_path, restarts,
+                                   attempt, history)
+
+
+# ---------------------------------------------------------------------------
+# process-level supervision (the multi-host restart loop)
+# ---------------------------------------------------------------------------
+
+def supervise_processes(commands: List[List[str]], *,
+                        max_restarts: int = 5,
+                        backoff_base_s: float = 1.0,
+                        backoff_max_s: float = 60.0,
+                        storm_threshold: int = 3,
+                        storm_min_uptime_s: float = 1.0,
+                        env: Optional[Dict[str, str]] = None,
+                        make_env: Optional[Callable[[int],
+                                                    Optional[dict]]] = None,
+                        poll_s: float = 0.05,
+                        kill_grace_s: float = 5.0,
+                        resumable_code: int =
+                        SupervisedFitResult.resumable_exit_code) -> dict:
+    """Supervised restart loop for a synchronous SPMD process group — the
+    in-framework replacement for "relaunch the same command" runbooks and
+    the reference mesh's dead-node remap. All ``commands`` launch
+    together; if ANY participant exits nonzero, the survivors are
+    terminated (synchronous collectives cannot continue around a lost
+    host — SURVEY §5.8, arXiv:2004.13336) and the WHOLE group relaunches
+    after exponential backoff, resuming from its checkpoint directory.
+
+    A participant exiting ``resumable_code`` (EX_TEMPFAIL, what a
+    supervised fit's preempted status maps to) ends the loop with
+    ``status="preempted"`` instead of burning restarts — the cluster
+    scheduler owns the relaunch at that point. ``make_env(attempt)``
+    layers per-attempt environment on top of ``env`` (e.g. a fault plan
+    for the first incarnation only). The restart-storm breaker trips on
+    ``storm_threshold`` consecutive groups that died within
+    ``storm_min_uptime_s``."""
+    import subprocess
+
+    prof = OpProfiler.get()
+    history: List[dict] = []
+    restarts = 0
+    consec_fast = 0
+    attempt = 0
+    while True:
+        attempt += 1
+        prof.count("supervisor/proc_attempts")
+        e = dict(os.environ)
+        e.update(env or {})
+        if make_env is not None:
+            e.update(make_env(attempt - 1) or {})
+        t0 = time.monotonic()
+        procs: List[Any] = []
+        try:
+            for c in commands:
+                procs.append(subprocess.Popen(list(c), env=e))
+        except Exception:
+            # a rank that cannot even launch must not orphan the ranks
+            # already running (they would hold the checkpoint dir)
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(kill_grace_s)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait(5.0)
+            raise
+        failed_rank: Optional[int] = None
+        while True:
+            codes = [p.poll() for p in procs]
+            failed_rank = next((i for i, c in enumerate(codes)
+                                if c not in (None, 0)), None)
+            if failed_rank is not None or all(c == 0 for c in codes):
+                break
+            time.sleep(poll_s)
+        uptime = time.monotonic() - t0
+        if failed_rank is None:
+            return {"status": "completed", "attempts": attempt,
+                    "restarts": restarts, "history": history}
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + kill_grace_s
+        for p in procs:
+            try:
+                p.wait(max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(5.0)
+        codes = [p.poll() for p in procs]
+        history.append({"attempt": attempt, "codes": codes,
+                        "failed_rank": failed_rank,
+                        "uptime_s": round(uptime, 3)})
+        logger.warning("supervise_processes: rank %d exited %s after "
+                       "%.2fs; group restart", failed_rank,
+                       codes[failed_rank], uptime)
+        if codes[failed_rank] == resumable_code:
+            return {"status": "preempted", "resumable": True,
+                    "attempts": attempt, "restarts": restarts,
+                    "history": history}
+        consec_fast = consec_fast + 1 if uptime < storm_min_uptime_s else 0
+        if consec_fast >= storm_threshold:
+            prof.count("supervisor/storm_trips")
+            raise RestartStorm(
+                f"process group died {consec_fast} consecutive times "
+                f"within {storm_min_uptime_s}s", history)
+        if restarts >= max_restarts:
+            prof.count("supervisor/giveups")
+            raise RestartBudgetExceeded(
+                f"process-group restart budget ({max_restarts}) exhausted",
+                history)
+        restarts += 1
+        prof.count("supervisor/proc_restarts")
+        delay = min(backoff_base_s * (2 ** (restarts - 1)), backoff_max_s)
+        with prof.time_section("supervisor/backoff"):
+            time.sleep(delay)
 
 
 class SharedTrainingMaster:
@@ -91,6 +834,9 @@ class SharedTrainingMaster:
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = checkpoint_every
         self.threshold_algorithm = threshold_algorithm
+        # the last supervised run's SupervisedFitResult (status/restarts/
+        # failure history); None before any supervised fit
+        self.last_result: Optional["SupervisedFitResult"] = None
 
     def workers(self) -> int:
         """Global worker count. Single-process: workers_per_node bounds the
@@ -112,20 +858,28 @@ class SharedTrainingMaster:
             return len(jax.devices())
         return min(self.workers_per_node, jax.local_device_count())
 
-    def fit(self, model, data, epochs: int = 1):
-        """Train `model` over all global devices; resumes from the latest
-        INTACT checkpoint in `checkpoint_dir` when one exists (kill-resume
-        story, SURVEY §5.3) — the restart loop is "relaunch the same
-        command": the checkpoint's cursor fast-forwards the input pipeline
-        so the continuation is exact, a checkpoint torn by the kill is
-        skipped by checksum, and checkpointing itself runs on the async
-        atomic writer (closed — i.e. made durable — before fit returns)."""
+    def fit(self, model, data, epochs: int = 1, *,
+            supervise: bool = True,
+            supervisor_opts: Optional[Dict[str, Any]] = None):
+        """Train `model` over all global devices. With a checkpoint
+        directory configured the run is SELF-HEALING by default: a
+        :class:`TrainingSupervisor` wraps the wrapper's fit — failure
+        classification, bounded checkpoint-restart, hang watchdog,
+        preemption-signal flush, incarnation fence — and a relaunched
+        process resumes from the newest INTACT checkpoint automatically
+        (the checkpoint's cursor fast-forwards the input pipeline so the
+        continuation is bit-exact; a checkpoint torn by the kill is
+        skipped by checksum). Listeners already attached to ``model`` are
+        preserved and forwarded, their state riding the checkpoints. The
+        supervised result lands on ``self.last_result`` (status /
+        restarts / failure history); ``supervise=False`` keeps the plain
+        single-attempt behavior, and ``supervisor_opts`` forwards to the
+        :class:`TrainingSupervisor` constructor (budget, backoff,
+        ``hang_deadline_s``, policies...)."""
         from ..optimize.listeners import CheckpointListener
         from .accumulator import EncodedGradientsAccumulator
         from .wrapper import ParallelWrapper
 
-        resume = (CheckpointListener.last_checkpoint(self.checkpoint_dir)
-                  if self.checkpoint_dir else None)
         builder = (ParallelWrapper.Builder(model)
                    .workers(self.workers())
                    .training_mode("shared_gradients"))
@@ -133,12 +887,30 @@ class SharedTrainingMaster:
             builder.gradients_accumulator(
                 EncodedGradientsAccumulator(threshold_algorithm=self.threshold_algorithm))
         pw = builder.build()
+        # the reference master forwards the model's listeners to its
+        # trainers; dropping them silently (pre-supervisor behavior) lost
+        # user score/eval hooks the moment training went distributed
+        user_listeners = list(getattr(model, "_listeners", []))
+        if user_listeners:
+            pw.set_listeners(*user_listeners)
+        if self.checkpoint_dir and supervise:
+            # a configured directory is enough to supervise: with no
+            # periodic cadence the anchor checkpoint still makes restarts
+            # and preemption flushes exact (restarts just replay more)
+            sup = TrainingSupervisor(
+                pw, self.checkpoint_dir,
+                save_every_n_iterations=self.checkpoint_every or None,
+                **(supervisor_opts or {}))
+            self.last_result = sup.fit(data, epochs=epochs)
+            return model
+        resume = (CheckpointListener.last_checkpoint(self.checkpoint_dir)
+                  if self.checkpoint_dir else None)
         ckpt = None
         if self.checkpoint_dir and self.checkpoint_every:
             ckpt = CheckpointListener(
                 self.checkpoint_dir,
                 save_every_n_iterations=self.checkpoint_every)
-            pw.set_listeners(ckpt)
+            pw.set_listeners(*user_listeners, ckpt)
         try:
             pw.fit(data, epochs=epochs, resume_from=resume)
         finally:

@@ -25,6 +25,13 @@ Failure contract (the §5.3 serving story):
   fast instead of queueing into a void. Ordinary per-batch exceptions
   scatter to that batch's futures and the replica keeps serving (a bad
   request must not kill the worker).
+- **Replica resurrection** (on by default): a retired replica is REPLACED
+  instead of permanently shrinking the pool — after an exponential
+  backoff a health probe (the model re-run on a one-row slice of the last
+  successfully-served batch; drillable via the ``inference/probe`` fault
+  site) must pass, then a fresh worker thread joins the queue. Pool
+  capacity recovers; ``pool_stats()`` / ``/api/health`` report
+  live/retired/resurrected counts.
 - **Shutdown fails queued futures**: :meth:`shutdown` stops the workers,
   then resolves every still-queued future with an error — no waiter is
   left hanging on a future nobody will fulfil.
@@ -37,8 +44,9 @@ import logging
 import queue
 import threading
 import time
+import weakref
 from concurrent.futures import Future
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -47,6 +55,22 @@ from ..common.profiler import OpProfiler
 from ..ndarray.ndarray import NDArray
 
 logger = logging.getLogger("deeplearning4j_tpu")
+
+# live pools, for the /api/health census (weak: a dropped pool vanishes)
+_POOLS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def pool_health() -> Dict[str, int]:
+    """Aggregate live/retired/resurrected counts over every live
+    ParallelInference pool — the /api/health serving-capacity line."""
+    agg = {"pools": 0, "workers": 0, "alive": 0, "retired": 0,
+           "resurrected": 0}
+    for pool in list(_POOLS):
+        stats = pool.pool_stats()
+        agg["pools"] += 1
+        for k in ("workers", "alive", "retired", "resurrected"):
+            agg[k] += stats[k]
+    return agg
 
 
 class ParallelInference:
@@ -59,6 +83,9 @@ class ParallelInference:
             self._max_wait_ms = 5.0
             self._workers = 1
             self._request_timeout_ms: Optional[float] = None
+            self._resurrect = True
+            self._resurrect_backoff_ms = 250.0
+            self._max_resurrections = 16
 
         def inference_mode(self, mode: str) -> "ParallelInference.Builder":
             self._mode = mode.lower()
@@ -92,16 +119,36 @@ class ParallelInference:
             self._request_timeout_ms = ms
             return self
 
+        def resurrect_dead_replicas(self, enabled: bool = True,
+                                    backoff_ms: Optional[float] = None,
+                                    max_resurrections: Optional[int] = None
+                                    ) -> "ParallelInference.Builder":
+            """Replica resurrection policy (default ON): a retired
+            replica is replaced after health-probe + backoff instead of
+            permanently shrinking the pool."""
+            self._resurrect = enabled
+            if backoff_ms is not None:
+                self._resurrect_backoff_ms = backoff_ms
+            if max_resurrections is not None:
+                self._max_resurrections = max_resurrections
+            return self
+
         def build(self) -> "ParallelInference":
             return ParallelInference(self._model, self._mode, self._batch_limit,
                                      self._queue_limit, self._max_wait_ms,
                                      workers=self._workers,
-                                     request_timeout_ms=self._request_timeout_ms)
+                                     request_timeout_ms=self._request_timeout_ms,
+                                     resurrect=self._resurrect,
+                                     resurrect_backoff_ms=self._resurrect_backoff_ms,
+                                     max_resurrections=self._max_resurrections)
 
     def __init__(self, model, mode: str = "batched", batch_limit: int = 32,
                  queue_limit: int = 64, max_wait_ms: float = 5.0,
                  workers: int = 1,
-                 request_timeout_ms: Optional[float] = None):
+                 request_timeout_ms: Optional[float] = None,
+                 resurrect: bool = True,
+                 resurrect_backoff_ms: float = 250.0,
+                 max_resurrections: int = 16):
         self.model = model
         self.mode = "sequential" if mode in ("sequential", "inplace") else "batched"
         self.batch_limit = batch_limit
@@ -111,25 +158,44 @@ class ParallelInference:
         self.request_timeout_s = (request_timeout_ms / 1000.0
                                   if request_timeout_ms is not None
                                   else max(1000.0 * self.max_wait_s, 10.0))
+        self.resurrect = resurrect
+        self.resurrect_backoff_s = resurrect_backoff_ms / 1000.0
+        self.max_resurrections = max_resurrections
         self._queue: "queue.Queue" = queue.Queue(maxsize=queue_limit)
         self._shutdown = False
         self._lock = threading.Lock()
         self._req_seq = 0
         self._workers: List[threading.Thread] = []
+        self._resurrectors: List[threading.Thread] = []
         self._alive = 0
+        self._pool_size = 0          # configured capacity (drain threads)
+        self._retired_total = 0
+        self._resurrected_total = 0
+        self._resurrections_started = 0
+        self._probe_seq = 0
+        self._probe_input: Optional[np.ndarray] = None
         if self.mode == "batched":
             self._alive = max(1, int(workers))
+            self._pool_size = self._alive
             for i in range(self._alive):
                 t = threading.Thread(target=self._drain, args=(i,),
                                      daemon=True,
                                      name=f"dl4j-inference-{i}")
                 self._workers.append(t)
                 t.start()
+        _POOLS.add(self)
 
     # ------------------------------------------------------------------
     def alive_replicas(self) -> int:
         with self._lock:
             return self._alive
+
+    def pool_stats(self) -> Dict[str, int]:
+        """Live/retired/resurrected census (the /api/health line)."""
+        with self._lock:
+            return {"workers": self._pool_size, "alive": self._alive,
+                    "retired": self._retired_total,
+                    "resurrected": self._resurrected_total}
 
     def output(self, x) -> NDArray:
         """Synchronous single-request API (reference output()), bounded by
@@ -163,7 +229,8 @@ class ParallelInference:
         if self.alive_replicas() == 0:
             fut.set_exception(RuntimeError(
                 "all inference replicas have been retired (fatal replica "
-                "failures); restart the ParallelInference"))
+                "failures); a resurrection may be pending — retry, or "
+                "restart the ParallelInference"))
             return fut
         with self._lock:
             seq = self._req_seq
@@ -187,7 +254,8 @@ class ParallelInference:
         if self.alive_replicas() == 0:
             self._fail_queued(RuntimeError(
                 "all inference replicas have been retired (fatal replica "
-                "failures); restart the ParallelInference"))
+                "failures); a resurrection may be pending — retry, or "
+                "restart the ParallelInference"))
         return fut
 
     def _run(self, batch: np.ndarray) -> NDArray:
@@ -197,7 +265,9 @@ class ParallelInference:
     def _retire(self, worker_id: int, exc: BaseException, futures) -> None:
         """Fatal-failure bookkeeping shared by every way a worker dies:
         fail the in-flight batch, drop the replica from the pool, and —
-        when it was the last one — fail everything still queued."""
+        when it was the last one — fail everything still queued. With
+        resurrection enabled a replacement is scheduled (health-probe +
+        exponential backoff) so the pool's capacity recovers."""
         for fut in futures:
             if not fut.done():
                 fut.set_exception(exc if isinstance(exc, Exception)
@@ -206,12 +276,100 @@ class ParallelInference:
         OpProfiler.get().count("inference/replica_retired")
         with self._lock:
             self._alive -= 1
+            self._retired_total += 1
             last = self._alive == 0
         logger.warning("inference replica %d retired (%s); %d replicas "
                        "remain", worker_id, exc, self.alive_replicas())
         if last:
+            # bounded-latency contract first: nobody waits out a backoff
+            # on a request already queued; the resurrected replica serves
+            # NEW requests
             self._fail_queued(RuntimeError(
                 "all inference replicas retired"))
+        self._schedule_resurrection()
+
+    # --- resurrection --------------------------------------------------
+    def _schedule_resurrection(self) -> None:
+        if not self.resurrect or self._shutdown or self.mode != "batched":
+            return
+        with self._lock:
+            if self._resurrections_started >= self.max_resurrections:
+                logger.warning("inference pool resurrection budget (%d) "
+                               "exhausted; pool stays at %d/%d",
+                               self.max_resurrections, self._alive,
+                               self._pool_size)
+                return
+            self._resurrections_started += 1
+        t = threading.Thread(target=self._resurrector, daemon=True,
+                             name="dl4j-inference-resurrector")
+        self._resurrectors.append(t)
+        t.start()
+
+    def _probe(self) -> None:
+        """Health probe before a replacement worker joins: re-run the
+        model on a one-row slice of the last successfully served batch
+        (nothing served yet → model assumed healthy). The
+        ``inference/probe`` fault site makes probe failure drillable."""
+        faultinject.fault_point("inference/probe", self._next_probe_seq())
+        probe = self._probe_input
+        if probe is not None:
+            self._run(probe)
+
+    def _next_probe_seq(self) -> int:
+        with self._lock:
+            seq = self._probe_seq
+            self._probe_seq += 1
+        return seq
+
+    _PROBE_ATTEMPT_LIMIT = 10
+
+    def _resurrector(self) -> None:
+        backoff = self.resurrect_backoff_s
+        probes = 0
+        while not self._shutdown:
+            # interruptible sleep so shutdown() is not held up
+            deadline = time.monotonic() + backoff
+            while not self._shutdown and time.monotonic() < deadline:
+                time.sleep(min(0.05, backoff))
+            if self._shutdown:
+                return
+            try:
+                self._probe()
+            except Exception as e:
+                OpProfiler.get().count("inference/probe_failures")
+                probes += 1
+                if probes >= self._PROBE_ATTEMPT_LIMIT:
+                    # a probe that NEVER passes means the model itself is
+                    # broken — stop burning a daemon thread on it
+                    OpProfiler.get().count("inference/resurrection_abandoned")
+                    logger.warning(
+                        "inference resurrection abandoned after %d failed "
+                        "health probes (last: %s); pool stays at %d/%d",
+                        probes, e, self.alive_replicas(), self._pool_size)
+                    return
+                logger.warning("inference resurrection probe failed (%s); "
+                               "backing off %.2fs", e, backoff * 2)
+                backoff = min(backoff * 2, 30.0)
+                continue
+            with self._lock:
+                if self._shutdown:
+                    return
+                # id + append under ONE lock: two resurrectors racing
+                # (two near-simultaneous retirements) must not mint the
+                # same replica id
+                worker_id = len(self._workers)
+                t = threading.Thread(target=self._drain, args=(worker_id,),
+                                     daemon=True,
+                                     name=f"dl4j-inference-{worker_id}")
+                self._workers.append(t)
+                self._alive += 1
+                self._resurrected_total += 1
+            t.start()
+            OpProfiler.get().count("inference/replica_resurrected")
+            logger.warning("inference replica %d resurrected; %d/%d "
+                           "replicas alive", worker_id,
+                           self.alive_replicas(), self._pool_size)
+            return
 
     def _drain(self, worker_id: int) -> None:
         prof = OpProfiler.get()
@@ -242,6 +400,10 @@ class ParallelInference:
                     faultinject.fault_point("inference/worker", seq)
                 merged = np.concatenate(arrays, axis=0)
                 result = self._run(merged).to_numpy()
+                # one-row sample of a known-good input: what the
+                # resurrection health probe replays (copy — a view would
+                # pin the whole merged batch in memory between requests)
+                self._probe_input = merged[:1].copy()
                 off = 0
                 for size, fut in zip(sizes, futures):
                     fut.set_result(NDArray(result[off:off + size]))
@@ -282,6 +444,11 @@ class ParallelInference:
         self._shutdown = True
         for t in self._workers:
             t.join(timeout=1.0)
+        for t in self._resurrectors:
+            t.join(timeout=1.0)
+        with self._lock:
+            self._alive = 0      # pool_health must not count the dead
+        _POOLS.discard(self)
         n = self._fail_queued(RuntimeError(
             "ParallelInference shut down with this request still queued"))
         if n:

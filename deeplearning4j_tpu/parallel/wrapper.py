@@ -323,6 +323,11 @@ class ParallelWrapper:
         re-placed by the SPMD step's sharding on first dispatch."""
         model = self.model
         model._check_init()
+        if not self._listeners and getattr(model, "_listeners", None):
+            # listeners attached to the MODEL must not silently stop
+            # firing the moment training goes through the wrapper —
+            # adopt them (set_listeners also wires bind_group/telemetry)
+            self.set_listeners(*model._listeners)
         from ..util.checkpoint import begin_fit_cursor
 
         skip = begin_fit_cursor(model, resume_from,

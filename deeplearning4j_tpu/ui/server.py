@@ -291,10 +291,15 @@ class UIServer:
 
     def health(self) -> Dict[str, Any]:
         """The /api/health payload: process uptime, attached-source census,
-        JSONL-cache effectiveness, and the live device/host memory
-        telemetry from ``common.system_info.memory_summary`` (per-device
-        PJRT stats + the jax live-buffer census)."""
+        JSONL-cache effectiveness, the live device/host memory telemetry
+        from ``common.system_info.memory_summary`` (per-device PJRT stats
+        + the jax live-buffer census), the self-healing ledger (supervisor
+        restarts / watchdog fires / backoff waits + injected-fault
+        counters), and the inference-pool census
+        (live/retired/resurrected replicas)."""
+        from ..common.profiler import OpProfiler
         from ..common.system_info import memory_summary
+        from ..parallel.inference import pool_health
 
         n = sum(len(getattr(s, "records", ())) for s in self._stores)
         for p in self._paths:
@@ -303,12 +308,16 @@ class UIServer:
                 n += sum(1 for r in self._jsonl.read(p) if "value" in r)
             except (OSError, ValueError):
                 pass
+        prof = OpProfiler.get()
         return {"status": "ok",
                 "uptime_s": round(time.time() - self._t0, 1),
                 "stores": len(self._stores),
                 "paths": len(self._paths),
                 "records": n,
                 "jsonl_cache": self._jsonl.stats(),
+                "supervisor": prof.supervisor_stats(),
+                "faults": prof.fault_stats(),
+                "inference": pool_health(),
                 **memory_summary()}
 
     def sessions(self) -> List[str]:

@@ -62,22 +62,36 @@ RESUME_ENTRY = "resume.json"
 MANIFEST_FORMAT = 2
 
 
+class StaleIncarnationError(RuntimeError):
+    """A writer from an OLDER incarnation tried to commit into a
+    directory a newer incarnation has claimed (``checkpoint.json``
+    carries a monotonic ``incarnation`` id). The supervised-restart
+    fence: a wedged pre-restart process that wakes up late can never
+    clobber its replacement's checkpoints — the commit is refused and
+    the manifest stays untouched."""
+
+
 # --------------------------------------------------------------------------
 # snapshot
 # --------------------------------------------------------------------------
 
-def snapshot_training_state(model, listeners=None) -> Dict[str, Any]:
+def snapshot_training_state(model, listeners=None,
+                            rng_state: Optional[Dict[str, Any]] = None
+                            ) -> Dict[str, Any]:
     """Host-side snapshot of everything resume needs, taken on the
-    training thread at a dispatch boundary. One batched readback."""
+    training thread at a dispatch boundary. One batched readback.
+    ``rng_state`` overrides the calling thread's RNG stream state — the
+    supervisor's preemption flush runs on the MONITOR thread but must
+    record the TRAINING thread's stream (RNG instances are per-thread)."""
     import jax
 
     from ..ndarray.rng import get_random
 
-    rng = get_random()
+    state = rng_state if rng_state is not None else get_random().get_state()
     with OpProfiler.get().time_section("checkpoint/snapshot"):
         host = jax.device_get(
             (model._params, model._states, model._updater_state,
-             rng.get_state()["key"]))
+             state["key"]))
         # device_get may return ZERO-COPY views of the device buffers on
         # the CPU backend — and the very next train step DONATES those
         # buffers, so the background writer would read freed memory
@@ -99,7 +113,7 @@ def snapshot_training_state(model, listeners=None) -> Dict[str, Any]:
         "updater": upd,
         "iteration": int(model._iteration),
         "epoch": int(model._epoch),
-        "rng": {"seed": rng.get_seed(),
+        "rng": {"seed": int(state.get("seed", get_random().get_seed())),
                 "key": np.asarray(key).tolist(),
                 "key_dtype": str(np.asarray(key).dtype)},
         "cursor": {
@@ -198,28 +212,60 @@ def _atomic_write(path: str, data: bytes, seq: Optional[int] = None,
         _fsync_dir(os.path.dirname(path) or ".")
 
 
-def read_manifest(directory: str) -> List[Any]:
-    """Manifest entries, oldest first. v2 entries are dicts (file/sha256/
-    iteration/tag); v1 entries are bare path strings. [] when missing or
-    unparseable (a torn manifest must not take the checkpoints with it —
-    the scan fallback still finds them)."""
+def read_manifest_doc(directory: str) -> Dict[str, Any]:
+    """The whole manifest document ({} when missing or unparseable — a
+    torn manifest must not take the checkpoints with it; the scan
+    fallback still finds them). Carries ``checkpoints`` (entries, oldest
+    first) and ``incarnation`` (the monotonic supervised-restart fence)."""
     path = os.path.join(directory, MANIFEST_NAME)
     try:
         with open(path) as f:
-            return json.load(f).get("checkpoints", [])
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else {}
     except FileNotFoundError:
-        return []
-    except (json.JSONDecodeError, OSError, AttributeError):
+        return {}
+    except (json.JSONDecodeError, OSError):
         logger.warning("unreadable checkpoint manifest %s; falling back to "
                        "directory scan", path)
-        return []
+        return {}
 
 
-def write_manifest(directory: str, entries: List[Any]) -> None:
+def read_manifest(directory: str) -> List[Any]:
+    """Manifest entries, oldest first. v2 entries are dicts (file/sha256/
+    iteration/tag, optionally bytes); v1 entries are bare path strings."""
+    entries = read_manifest_doc(directory).get("checkpoints", [])
+    return entries if isinstance(entries, list) else []
+
+
+def manifest_incarnation(directory: str) -> int:
+    """The directory's current incarnation id (0 = never claimed)."""
+    try:
+        return int(read_manifest_doc(directory).get("incarnation", 0))
+    except (TypeError, ValueError):
+        return 0
+
+
+def write_manifest(directory: str, entries: List[Any],
+                   incarnation: Optional[int] = None) -> None:
+    doc: Dict[str, Any] = {"format": MANIFEST_FORMAT, "checkpoints": entries}
+    if incarnation is None:
+        incarnation = manifest_incarnation(directory)
+    if incarnation:
+        doc["incarnation"] = int(incarnation)
     _atomic_write(os.path.join(directory, MANIFEST_NAME),
-                  json.dumps({"format": MANIFEST_FORMAT,
-                              "checkpoints": entries}).encode(),
-                  durable=False)
+                  json.dumps(doc).encode(), durable=False)
+
+
+def claim_incarnation(directory: str) -> int:
+    """Bump and record the directory's incarnation id, invalidating every
+    writer fenced to an older one (their commits raise
+    :class:`StaleIncarnationError`). Called once per supervised (re)start
+    BEFORE the new attempt's writer is built."""
+    os.makedirs(directory, exist_ok=True)
+    doc = read_manifest_doc(directory)
+    inc = int(doc.get("incarnation", 0) or 0) + 1
+    write_manifest(directory, doc.get("checkpoints", []), incarnation=inc)
+    return inc
 
 
 def _sha256_file(path: str) -> str:
@@ -234,18 +280,56 @@ def _entry_name(e: Any) -> str:
     return e["file"] if isinstance(e, dict) else os.path.basename(e)
 
 
+def _entry_bytes(directory: str, e: Any) -> int:
+    if isinstance(e, dict) and "bytes" in e:
+        return int(e["bytes"])
+    try:
+        return os.path.getsize(os.path.join(directory, _entry_name(e)))
+    except OSError:
+        return 0
+
+
 def _append_and_retain(directory: str, name: str, sha: str, iteration: int,
-                       keep_last: int) -> None:
-    """Fold one committed file into the manifest and apply retention.
-    The manifest stops referencing a file BEFORE it is unlinked: a crash
-    between the two leaves an orphan file, never a dangling index."""
-    entries = [e for e in read_manifest(directory) if _entry_name(e) != name]
-    entries.append({"file": name, "sha256": sha, "iteration": int(iteration),
-                    "tag": name[len("checkpoint_"):-len(".zip")]})
+                       keep_last: int, size: Optional[int] = None,
+                       max_total_bytes: Optional[int] = None,
+                       incarnation: Optional[int] = None) -> None:
+    """Fold one committed file into the manifest and apply retention —
+    count-based (``keep_last``) then disk-budget (``max_total_bytes``:
+    oldest committed entries drop until the total fits; the newest always
+    survives). Only COMMITTED files are ever deleted, and the manifest
+    stops referencing a file BEFORE it is unlinked: a crash between the
+    two leaves an orphan file, never a dangling index. ``incarnation``
+    fences the fold: an older-incarnation writer raises
+    :class:`StaleIncarnationError` and the manifest is untouched."""
+    doc = read_manifest_doc(directory)
+    current = int(doc.get("incarnation", 0) or 0)
+    if incarnation is not None and int(incarnation) < current:
+        raise StaleIncarnationError(
+            f"writer incarnation {incarnation} is stale: {directory} was "
+            f"claimed by incarnation {current}; refusing to commit {name}")
+    old = doc.get("checkpoints", [])
+    entries = [e for e in (old if isinstance(old, list) else [])
+               if _entry_name(e) != name]
+    entry: Dict[str, Any] = {"file": name, "sha256": sha,
+                             "iteration": int(iteration),
+                             "tag": name[len("checkpoint_"):-len(".zip")]}
+    if size is not None:
+        entry["bytes"] = int(size)
+    entries.append(entry)
     retained, dropped = entries, []
     if keep_last and len(entries) > keep_last:
         retained, dropped = entries[-keep_last:], entries[:-keep_last]
-    write_manifest(directory, retained)
+    if max_total_bytes:
+        total = sum(_entry_bytes(directory, e) for e in retained)
+        while len(retained) > 1 and total > max_total_bytes:
+            total -= _entry_bytes(directory, retained[0])
+            dropped.append(retained[0])
+            retained = retained[1:]
+            OpProfiler.get().count("checkpoint/bytes_gc")
+    # pass the resolved value through (0 included) — None would make
+    # write_manifest re-read the manifest it was just handed
+    write_manifest(directory, retained,
+                   incarnation=max(current, int(incarnation or 0)))
     for e in dropped:
         try:
             os.remove(os.path.join(directory, _entry_name(e)))
@@ -255,17 +339,29 @@ def _append_and_retain(directory: str, name: str, sha: str, iteration: int,
 
 def commit_checkpoint(directory: str, tag: str, data: bytes,
                       iteration: int, keep_last: int,
-                      seq: Optional[int] = None) -> str:
+                      seq: Optional[int] = None,
+                      max_total_bytes: Optional[int] = None,
+                      incarnation: Optional[int] = None) -> str:
     """Atomically commit one checkpoint and fold it into the manifest;
     apply retention. Returns the committed path. Single-writer per
-    directory (the listener's writer thread or the sync caller)."""
+    directory (the listener's writer thread or the sync caller).
+    ``incarnation``: the writer's fence id — checked BEFORE the file is
+    written (so a stale writer leaves no orphan zip either) and again
+    under the manifest fold."""
     prof = OpProfiler.get()
+    if incarnation is not None \
+            and manifest_incarnation(directory) > int(incarnation):
+        raise StaleIncarnationError(
+            f"writer incarnation {incarnation} is stale: {directory} was "
+            f"claimed by incarnation {manifest_incarnation(directory)}")
     name = f"checkpoint_{tag}.zip"
     path = os.path.join(directory, name)
     with prof.time_section("checkpoint/write"):
         _atomic_write(path, data, seq=seq)
         _append_and_retain(directory, name, hashlib.sha256(data).hexdigest(),
-                           iteration, keep_last)
+                           iteration, keep_last, size=len(data),
+                           max_total_bytes=max_total_bytes,
+                           incarnation=incarnation)
     prof.count("checkpoint/committed")
     prof.count("checkpoint/bytes", len(data))
     return path
@@ -290,11 +386,18 @@ def committed_checkpoints(directory: str) -> List[str]:
 
 
 def register_committed(directory: str, path: str, iteration: int,
-                       keep_last: int) -> None:
+                       keep_last: int, max_total_bytes: Optional[int] = None,
+                       incarnation: Optional[int] = None) -> None:
     """Fold an already-written checkpoint file (legacy ``model.save``
     path) into the verified manifest and apply retention."""
+    try:
+        size: Optional[int] = os.path.getsize(path)
+    except OSError:
+        size = None
     _append_and_retain(directory, os.path.basename(path),
-                       _sha256_file(path), iteration, keep_last)
+                       _sha256_file(path), iteration, keep_last, size=size,
+                       max_total_bytes=max_total_bytes,
+                       incarnation=incarnation)
 
 
 def clean_stale_tmp(directory: str) -> int:
@@ -471,9 +574,12 @@ class CheckpointWriter:
     ``last_checkpoint`` keeps pointing at the previous intact one."""
 
     def __init__(self, directory: str, keep_last: int = 3,
-                 on_commit=None):
+                 on_commit=None, max_total_bytes: Optional[int] = None,
+                 incarnation: Optional[int] = None):
         self.dir = directory
         self.keep_last = keep_last
+        self.max_total_bytes = max_total_bytes
+        self.incarnation = incarnation
         self.errors: List[BaseException] = []
         self._on_commit = on_commit
         self._q: "queue.Queue" = queue.Queue(maxsize=2)
@@ -504,7 +610,9 @@ class CheckpointWriter:
                 data = serialize_snapshot(snapshot)
                 path = commit_checkpoint(self.dir, tag, data,
                                          snapshot["iteration"],
-                                         self.keep_last, seq=seq)
+                                         self.keep_last, seq=seq,
+                                         max_total_bytes=self.max_total_bytes,
+                                         incarnation=self.incarnation)
                 if self._on_commit is not None:
                     self._on_commit(path)
             except BaseException as e:     # incl. SimulatedCrash(raise)

@@ -1,0 +1,540 @@
+"""Self-healing training drills (ISSUE 4): the TrainingSupervisor's
+restart loop, watchdog, preemption handling, incarnation fence, and the
+satellite retention/forwarding/resurrection behaviors.
+
+The acceptance bar mirrors PR 3's: every healed run must be BIT-IDENTICAL
+to an uninterrupted one — the supervisor may add restarts, backoff, and
+checkpoints, but never numerics."""
+
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common import faultinject
+from deeplearning4j_tpu.common.profiler import OpProfiler
+from deeplearning4j_tpu.data import NDArrayDataSetIterator
+from deeplearning4j_tpu.learning import Sgd
+from deeplearning4j_tpu.ndarray.rng import set_default_seed
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.optimize.listeners import (
+    CheckpointListener, CollectScoresIterationListener, TrainingListener)
+from deeplearning4j_tpu.parallel import (HangDetected, Preempted,
+                                         RestartBudgetExceeded, RestartStorm,
+                                         TrainingSupervisor, classify_failure)
+from deeplearning4j_tpu.parallel.distributed import (CLASS_DEVICE, CLASS_HANG,
+                                                     CLASS_NUMERIC,
+                                                     CLASS_PREEMPTION,
+                                                     CLASS_TRANSIENT,
+                                                     CLASS_USER)
+from deeplearning4j_tpu.util import checkpoint as ckpt_util
+
+_rng = np.random.RandomState(7)
+X = _rng.randn(64, 4).astype(np.float32)
+Y = np.eye(2, dtype=np.float32)[(X.sum(1) > 0).astype(int)]
+EPOCHS = 5          # 4 steps/epoch with batch 16 -> 20 steps total
+
+
+def make_model():
+    conf = (NeuralNetConfiguration.builder().seed(5)
+            .updater(Sgd(learning_rate=0.3)).activation("tanh").list()
+            .layer(L.DenseLayer(n_out=8))
+            .layer(L.OutputLayer(n_out=2, loss="mcxent",
+                                 activation="softmax"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def make_it():
+    # shuffled: restarts must also rewind the per-epoch shuffle state
+    return NDArrayDataSetIterator(X, Y, batch_size=16, shuffle=True, seed=3)
+
+
+_BASELINE = None
+
+
+def baseline_scores():
+    # deterministic, so computed once for the whole module (the per-test
+    # RNG side effects are re-established by each test's set_default_seed)
+    global _BASELINE
+    if _BASELINE is None:
+        set_default_seed(42)
+        model = make_model()
+        scores = CollectScoresIterationListener()
+        model.set_listeners(scores)
+        model.fit(make_it(), epochs=EPOCHS, batch_size=16)
+        _BASELINE = [s for _, s in scores.scores]
+    return list(_BASELINE)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.clear_plan()
+    OpProfiler.get().reset()
+    yield
+    faultinject.clear_plan()
+
+
+class TestCrashRestart:
+    def test_env_fault_plan_kill_then_auto_restart_bit_exact(
+            self, tmp_path, monkeypatch):
+        """Kill-at-step-k via the ENV fault plan (the schedule a relaunched
+        worker would see): the supervisor classifies the SimulatedCrash as
+        a device failure, restarts from the last intact checkpoint, and
+        the final loss sequence equals the uninterrupted baseline
+        bitwise."""
+        base = baseline_scores()
+        monkeypatch.setenv(faultinject.ENV_PLAN, json.dumps(
+            [{"site": "train/step", "index": 12, "kind": "crash"}]))
+        faultinject.clear_plan()      # force the env plan to be re-read
+        set_default_seed(42)
+        model = make_model()
+        scores = CollectScoresIterationListener()
+        model.set_listeners(scores)
+        sup = TrainingSupervisor(model, str(tmp_path),
+                                 save_every_n_iterations=5,
+                                 backoff_base_s=0.01)
+        res = sup.fit(make_it(), epochs=EPOCHS, batch_size=16,
+                      resume="never")
+        assert res.status == "completed" and res.restarts == 1
+        assert len(res.history) == 1
+        assert res.history[0]["class"] == CLASS_DEVICE
+        assert [s for _, s in scores.scores] == base
+        stats = OpProfiler.get().supervisor_stats()
+        assert stats["restarts"] == 1 and stats["attempts"] == 2
+        assert stats["backoff_count"] == 1 and stats["backoff_s"] > 0
+
+    def test_crash_before_any_checkpoint_restarts_from_anchor(
+            self, tmp_path):
+        """A crash BEFORE the first periodic save must still heal exactly:
+        the supervisor's attempt-0 anchor checkpoint (initial params +
+        entry RNG key) is the resume point."""
+        base = baseline_scores()
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "train/step", "index": 2, "kind": "crash"}]))
+        set_default_seed(42)
+        model = make_model()
+        scores = CollectScoresIterationListener()
+        model.set_listeners(scores)
+        sup = TrainingSupervisor(model, str(tmp_path),
+                                 save_every_n_iterations=50,
+                                 backoff_base_s=0.01)
+        res = sup.fit(make_it(), epochs=EPOCHS, batch_size=16,
+                      resume="never")
+        assert res.status == "completed" and res.restarts == 1
+        assert [s for _, s in scores.scores] == base
+
+
+class TestWatchdog:
+    def test_wedged_dispatch_abandoned_and_healed_bit_exact(self, tmp_path):
+        base = baseline_scores()
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "train/wedge", "index": 9, "kind": "wedge"}]))
+        set_default_seed(42)
+        model = make_model()
+        scores = CollectScoresIterationListener()
+        model.set_listeners(scores)
+        sup = TrainingSupervisor(model, str(tmp_path),
+                                 save_every_n_iterations=4,
+                                 hang_deadline_s=0.5, poll_s=0.02,
+                                 backoff_base_s=0.01)
+        res = sup.fit(make_it(), epochs=EPOCHS, batch_size=16,
+                      resume="never")
+        assert res.status == "completed" and res.restarts == 1
+        assert res.history[0]["class"] == CLASS_HANG
+        assert [s for _, s in scores.scores] == base
+        assert OpProfiler.get().supervisor_stats()["watchdog_fires"] == 1
+
+    def test_hang_before_first_heartbeat(self, tmp_path):
+        """The supervisor/hang drill site wedges the attempt before ANY
+        step lands — the watchdog must catch a zero-progress hang too."""
+        base = baseline_scores()
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "supervisor/hang", "index": 0, "kind": "wedge"}]))
+        set_default_seed(42)
+        model = make_model()
+        scores = CollectScoresIterationListener()
+        model.set_listeners(scores)
+        sup = TrainingSupervisor(model, str(tmp_path),
+                                 save_every_n_iterations=50,
+                                 hang_deadline_s=0.4,
+                                 hang_startup_grace_s=1.2, poll_s=0.02,
+                                 backoff_base_s=0.01)
+        res = sup.fit(make_it(), epochs=EPOCHS, batch_size=16,
+                      resume="never")
+        assert res.status == "completed" and res.restarts == 1
+        assert [s for _, s in scores.scores] == base
+
+
+class TestBudgetAndStorm:
+    def test_restart_budget_exhaustion_raises_with_history(self, tmp_path):
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "train/step", "index": 2, "kind": "crash",
+              "times": 99}]))
+        set_default_seed(42)
+        model = make_model()
+        sup = TrainingSupervisor(model, str(tmp_path),
+                                 save_every_n_iterations=50,
+                                 max_restarts=2, backoff_base_s=0.01)
+        with pytest.raises(RestartBudgetExceeded) as ei:
+            sup.fit(make_it(), epochs=EPOCHS, batch_size=16,
+                    resume="never")
+        assert not isinstance(ei.value, RestartStorm)
+        assert len(ei.value.history) == 3          # budget 2 -> 3 attempts
+        assert all(h["class"] == CLASS_DEVICE for h in ei.value.history)
+        assert "failure history" in str(ei.value)
+        assert OpProfiler.get().supervisor_stats()["giveups"] == 1
+
+    def test_restart_storm_circuit_breaker(self, tmp_path):
+        """Zero-progress restarts trip the breaker long before the budget:
+        a deterministic step-0 failure is a bug, not weather."""
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "train/step", "index": 0, "kind": "crash",
+              "times": 99}]))
+        set_default_seed(42)
+        model = make_model()
+        sup = TrainingSupervisor(model, str(tmp_path),
+                                 save_every_n_iterations=50,
+                                 max_restarts=10, storm_threshold=2,
+                                 backoff_base_s=0.01)
+        with pytest.raises(RestartStorm) as ei:
+            sup.fit(make_it(), epochs=EPOCHS, batch_size=16,
+                    resume="never")
+        assert len(ei.value.history) == 2
+        assert all(h["steps"] == 0 for h in ei.value.history)
+        assert OpProfiler.get().supervisor_stats()["storm_trips"] == 1
+
+    def test_user_errors_raise_immediately(self, tmp_path):
+        """A deterministic config error must not burn the restart budget."""
+        set_default_seed(42)
+        model = make_model()
+        sup = TrainingSupervisor(model, str(tmp_path), backoff_base_s=0.01)
+        with pytest.raises(TypeError):
+            sup.fit("not a data source", epochs=1, resume="never")
+        assert OpProfiler.get().supervisor_stats().get("restarts", 0) == 0
+
+    def test_classification_table(self):
+        assert classify_failure(faultinject.TransientFault("x")) == \
+            CLASS_TRANSIENT
+        assert classify_failure(FloatingPointError("nan")) == CLASS_NUMERIC
+        assert classify_failure(faultinject.SimulatedCrash("k")) == \
+            CLASS_DEVICE
+        assert classify_failure(Preempted("sig")) == CLASS_PREEMPTION
+        assert classify_failure(ValueError("bad config")) == CLASS_USER
+        assert classify_failure(None) == CLASS_HANG
+        assert classify_failure(RuntimeError("??")) == CLASS_DEVICE
+
+
+class TestPreemption:
+    def test_sigterm_drill_flush_checkpoint_then_exact_resume(
+            self, tmp_path):
+        """The SIGTERM drill: mid-run preemption produces a flush-quality
+        checkpoint (async writer drained, committed synchronously) and a
+        resumable result; a fresh supervised run resumes from it and the
+        combined loss history equals the uninterrupted baseline."""
+        base = baseline_scores()
+
+        class KillerAt(TrainingListener):
+            def __init__(self, at):
+                self.at = at
+
+            def iteration_done(self, model, iteration, score):
+                if iteration == self.at:
+                    os.kill(os.getpid(), signal.SIGTERM)
+
+        set_default_seed(42)
+        model = make_model()
+        scores = CollectScoresIterationListener()
+        model.set_listeners(scores, KillerAt(7))
+        sup = TrainingSupervisor(model, str(tmp_path),
+                                 save_every_n_iterations=100,
+                                 backoff_base_s=0.01)
+        old = signal.getsignal(signal.SIGTERM)
+        res = sup.fit(make_it(), epochs=EPOCHS, batch_size=16,
+                      resume="never")
+        # handlers restored after the supervised run
+        assert signal.getsignal(signal.SIGTERM) is old
+        assert res.status == "preempted" and res.resumable
+        assert res.resume_from and os.path.exists(res.resume_from)
+        assert os.path.basename(res.resume_from).startswith(
+            "checkpoint_preempt_")
+        assert res.history[0]["class"] == CLASS_PREEMPTION
+        assert OpProfiler.get().supervisor_stats()["preemptions"] == 1
+
+        # "new process": fresh model + listeners, resume="auto"
+        set_default_seed(42)
+        model2 = make_model()
+        scores2 = CollectScoresIterationListener()
+        model2.set_listeners(scores2)
+        sup2 = TrainingSupervisor(model2, str(tmp_path),
+                                  save_every_n_iterations=100,
+                                  backoff_base_s=0.01)
+        res2 = sup2.fit(make_it(), epochs=EPOCHS, batch_size=16)
+        assert res2.status == "completed"
+        assert [s for _, s in scores2.scores] == base
+
+
+class TestIncarnationFence:
+    def test_stale_writer_cannot_commit(self, tmp_path):
+        d = str(tmp_path)
+        inc1 = ckpt_util.claim_incarnation(d)
+        assert inc1 == 1
+        ckpt_util.commit_checkpoint(d, "a", b"old" * 50, 1, 3,
+                                    incarnation=inc1)
+        inc2 = ckpt_util.claim_incarnation(d)
+        assert inc2 == 2
+        with pytest.raises(ckpt_util.StaleIncarnationError):
+            ckpt_util.commit_checkpoint(d, "b", b"stale" * 50, 2, 3,
+                                        incarnation=inc1)
+        # the stale attempt left neither a file nor a manifest entry
+        assert not os.path.exists(os.path.join(d, "checkpoint_b.zip"))
+        names = [e["file"] for e in ckpt_util.read_manifest(d)]
+        assert names == ["checkpoint_a.zip"]
+        # the new incarnation commits fine
+        ckpt_util.commit_checkpoint(d, "c", b"new" * 50, 2, 3,
+                                    incarnation=inc2)
+        names = [e["file"] for e in ckpt_util.read_manifest(d)]
+        assert names == ["checkpoint_a.zip", "checkpoint_c.zip"]
+        assert ckpt_util.manifest_incarnation(d) == 2
+
+    def test_stale_async_listener_records_error_not_corruption(
+            self, tmp_path):
+        """The end-to-end fence: a pre-restart listener's background
+        writer waking up late is refused at the manifest; the error is
+        observable on the listener and the newer incarnation's
+        checkpoints are untouched."""
+        d = str(tmp_path)
+        set_default_seed(42)
+        model = make_model()
+        model.fit((X, Y), epochs=1)      # materialize params/updater
+        stale = CheckpointListener(d, keep_last=3,
+                                   incarnation=ckpt_util.claim_incarnation(d))
+        new_inc = ckpt_util.claim_incarnation(d)
+        fresh = CheckpointListener(d, keep_last=3, incarnation=new_inc)
+        fresh.save_now(model, "fresh")
+        stale._save(model, "stale")     # async submit
+        stale.flush()
+        errs = stale.errors()
+        assert errs and isinstance(errs[0],
+                                   ckpt_util.StaleIncarnationError)
+        stale.close()
+        fresh.close()
+        last = CheckpointListener.last_checkpoint(d)
+        assert last is not None and last.endswith("checkpoint_fresh.zip")
+
+
+class TestDiskBudgetRetention:
+    def test_max_total_bytes_gc_keeps_newest(self, tmp_path):
+        d = str(tmp_path)
+        payload = b"x" * 1000
+        for i in range(5):
+            ckpt_util.commit_checkpoint(d, f"iter_{i}", payload, i,
+                                        keep_last=0, max_total_bytes=2500)
+        names = [e["file"] for e in ckpt_util.read_manifest(d)]
+        # 2500-byte budget holds two 1000-byte checkpoints
+        assert names == ["checkpoint_iter_3.zip", "checkpoint_iter_4.zip"]
+        on_disk = sorted(f for f in os.listdir(d)
+                         if f.startswith("checkpoint_") and
+                         f.endswith(".zip"))
+        assert on_disk == names
+        # the newest always survives, even when alone it busts the budget
+        ckpt_util.commit_checkpoint(d, "big", b"y" * 5000, 9,
+                                    keep_last=0, max_total_bytes=2500)
+        names = [e["file"] for e in ckpt_util.read_manifest(d)]
+        assert names == ["checkpoint_big.zip"]
+        assert OpProfiler.get().counter_value("checkpoint/bytes_gc") >= 3
+
+    def test_listener_threads_byte_budget_through_async_writer(
+            self, tmp_path):
+        d = str(tmp_path)
+        set_default_seed(42)
+        model = make_model()
+        scores = CollectScoresIterationListener()
+        cl = CheckpointListener(d, save_every_n_iterations=2, keep_last=50,
+                                max_total_bytes=1)   # absurdly tight
+        model.set_listeners(scores, cl)
+        model.fit(make_it(), epochs=2, batch_size=16)
+        saved = cl.saved
+        cl.close()
+        # only ever the newest checkpoint retained
+        assert len(saved) == 1
+        files = [f for f in os.listdir(d)
+                 if f.startswith("checkpoint_") and f.endswith(".zip")]
+        assert len(files) == 1
+
+
+class TestMasterIntegration:
+    def test_master_preserves_user_listeners_and_supervises(self, tmp_path):
+        from deeplearning4j_tpu.parallel import SharedTrainingMaster
+
+        set_default_seed(42)
+        model = make_model()
+        scores = CollectScoresIterationListener()
+        model.set_listeners(scores)       # pre-supervisor: silently dropped
+        master = (SharedTrainingMaster.Builder(batch_size_per_worker=16)
+                  .checkpoint(str(tmp_path), every_n_iterations=4)
+                  .build())
+        master.fit(model, make_it(), epochs=2)
+        assert scores.scores, "user listener was dropped by master.fit"
+        assert master.last_result.status == "completed"
+        # model's own listener list untouched by the supervised run
+        assert model._listeners == [scores]
+
+    def test_wrapper_inherits_model_listeners(self):
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+
+        set_default_seed(42)
+        model = make_model()
+        scores = CollectScoresIterationListener()
+        model.set_listeners(scores)
+        pw = ParallelWrapper.Builder(model).workers(1).build()
+        pw.fit(make_it(), epochs=1, batch_size=16)
+        assert scores.scores, "wrapper dropped the model's listeners"
+
+    def test_supervised_wrapper_keeps_model_listeners(self, tmp_path):
+        """Supervising a ParallelWrapper must not displace listeners the
+        user attached to the underlying MODEL: they join the supervised
+        arrangement (and their state rides its checkpoints)."""
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+
+        set_default_seed(42)
+        model = make_model()
+        scores = CollectScoresIterationListener()
+        model.set_listeners(scores)
+        pw = ParallelWrapper.Builder(model).workers(1).build()
+        sup = TrainingSupervisor(pw, str(tmp_path),
+                                 save_every_n_iterations=4,
+                                 backoff_base_s=0.01)
+        res = sup.fit(make_it(), epochs=2, batch_size=16, resume="never")
+        assert res.status == "completed"
+        assert scores.scores, "supervisor displaced model listeners"
+
+
+class TestSupervisorTransparency:
+    def test_no_fault_supervised_run_is_bit_identical_and_rng_transparent(
+            self, tmp_path):
+        """Supervision must be numerically invisible: same losses as a
+        plain fit, and the caller's RNG stream ends where a plain fit
+        would have left it (a following draw matches)."""
+        from deeplearning4j_tpu.ndarray.rng import get_random
+
+        # inline baseline (not the cached helper): the post-fit RNG state
+        # of the CALLING thread is part of what this test pins
+        set_default_seed(42)
+        bmodel = make_model()
+        bscores = CollectScoresIterationListener()
+        bmodel.set_listeners(bscores)
+        bmodel.fit(make_it(), epochs=EPOCHS, batch_size=16)
+        base = [s for _, s in bscores.scores]
+        after_base = float(get_random().next_double())
+
+        set_default_seed(42)
+        model = make_model()
+        scores = CollectScoresIterationListener()
+        model.set_listeners(scores)
+        sup = TrainingSupervisor(model, str(tmp_path),
+                                 save_every_n_iterations=6,
+                                 backoff_base_s=0.01)
+        res = sup.fit(make_it(), epochs=EPOCHS, batch_size=16,
+                      resume="never")
+        assert res.status == "completed" and res.restarts == 0
+        assert [s for _, s in scores.scores] == base
+        assert float(get_random().next_double()) == after_base
+
+    def test_data_factory_gets_fresh_source_per_attempt(self, tmp_path):
+        """A zero-arg factory is called once per attempt — the restart
+        trains on a pristine source and stays bit-exact."""
+        base = baseline_scores()
+        calls = []
+
+        def factory():
+            calls.append(1)
+            return make_it()
+
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "train/step", "index": 9, "kind": "crash"}]))
+        set_default_seed(42)
+        model = make_model()
+        scores = CollectScoresIterationListener()
+        model.set_listeners(scores)
+        sup = TrainingSupervisor(model, str(tmp_path),
+                                 save_every_n_iterations=4,
+                                 backoff_base_s=0.01)
+        res = sup.fit(factory, epochs=EPOCHS, batch_size=16,
+                      resume="never")
+        assert res.status == "completed" and len(calls) == 2
+        assert [s for _, s in scores.scores] == base
+
+
+class TestReplicaResurrection:
+    def test_pool_capacity_recovers_after_dead_replica(self):
+        from deeplearning4j_tpu.parallel import ParallelInference
+
+        set_default_seed(42)
+        model = make_model()
+        pi = (ParallelInference.Builder(model).inference_mode("batched")
+              .workers(2).max_wait_ms(5).request_timeout_ms(5000)
+              .resurrect_dead_replicas(backoff_ms=20).build())
+        try:
+            assert pi.output(np.zeros((2, 4), np.float32)).shape == (2, 2)
+            faultinject.set_plan(faultinject.FaultPlan(
+                [{"site": "inference/worker", "kind": "dead_replica"}]))
+            with pytest.raises(faultinject.DeadReplicaFault):
+                pi.output(np.zeros((2, 4), np.float32))
+            faultinject.clear_plan()
+            deadline = time.monotonic() + 5.0
+            while pi.alive_replicas() < 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            stats = pi.pool_stats()
+            assert stats == {"workers": 2, "alive": 2, "retired": 1,
+                             "resurrected": 1}
+            assert pi.output(np.zeros((3, 4), np.float32)).shape == (3, 2)
+            prof = OpProfiler.get()
+            assert prof.counter_value("inference/replica_resurrected") == 1
+        finally:
+            pi.shutdown()
+
+    def test_failed_health_probe_backs_off_then_recovers(self):
+        from deeplearning4j_tpu.parallel import ParallelInference
+
+        set_default_seed(42)
+        model = make_model()
+        pi = (ParallelInference.Builder(model).inference_mode("batched")
+              .workers(1).max_wait_ms(5).request_timeout_ms(5000)
+              .resurrect_dead_replicas(backoff_ms=20).build())
+        try:
+            assert pi.output(np.zeros((2, 4), np.float32)).shape == (2, 2)
+            # kill the only replica AND fail its first health probe
+            faultinject.set_plan(faultinject.FaultPlan(
+                [{"site": "inference/worker", "kind": "dead_replica"},
+                 {"site": "inference/probe", "kind": "dead_replica"}]))
+            with pytest.raises(faultinject.DeadReplicaFault):
+                pi.output(np.zeros((2, 4), np.float32))
+            deadline = time.monotonic() + 5.0
+            while pi.alive_replicas() < 1 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            faultinject.clear_plan()
+            assert pi.pool_stats()["alive"] == 1
+            prof = OpProfiler.get()
+            assert prof.counter_value("inference/probe_failures") == 1
+            assert pi.output(np.zeros((1, 4), np.float32)).shape == (1, 2)
+        finally:
+            pi.shutdown()
+
+    def test_health_endpoint_reports_supervisor_and_pools(self):
+        from deeplearning4j_tpu.parallel.inference import pool_health
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        OpProfiler.get().count("supervisor/restarts", 2)
+        h = UIServer().health()
+        assert h["supervisor"]["restarts"] == 2
+        assert set(h["inference"]) == {"pools", "workers", "alive",
+                                       "retired", "resurrected"}
+        assert "faults" in h
+        assert pool_health()["pools"] == h["inference"]["pools"]
