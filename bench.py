@@ -1096,6 +1096,188 @@ def bench_supervisor_smoke(steps: int, batch: int = 64,
             shutil.rmtree(d, ignore_errors=True)
 
 
+def bench_zero1_smoke(steps: int, batch: int = 64, workers: int = 4) -> dict:
+    """CPU-friendly smoke of ZeRO-1 cross-replica weight-update sharding
+    (ISSUE 5; arXiv:2004.13336): the flagship LeNet config trained through
+    ParallelWrapper once with the dense all-reduce accumulator and once
+    with ReduceScatterAccumulator (reduce-scatter grads → sharded updater
+    apply → all-gather params), paired interleaved A/B. Self-validating
+    hard-fails:
+
+    - parity break: the sharded-updater loss sequence (and final params)
+      must be BITWISE-equal to the dense path's on CPU;
+    - any retrace delta between the two paths, or any retrace inside a
+      timed window (the sharded step must stay one-compile-per-config);
+    - per-replica updater-state bytes not ≈ 1/workers of the dense
+      footprint (asserted via the zero1/* memory ledger; the flat
+      bucketing may pad by at most one shard per dtype bucket);
+    - step-time regression > 5% vs dense (median of per-round ratios —
+      the ZeRO-1 point on one host is the memory/redundancy win, it must
+      not cost step time);
+    - encoded-exchange density/bytes counters empty after a short
+      EncodedGradientsAccumulator fit (the DCN-path ledger must populate).
+
+    Emits the collective-bytes ledger alongside the timing."""
+    import shutil  # noqa: F401  (parity with sibling smokes' imports)
+    import statistics as _stats
+
+    # a multi-replica mesh is the whole point: on single-device hosts
+    # (CPU build machines) request virtual CPU devices BEFORE jax loads
+    if "jax" not in sys.modules:
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    from deeplearning4j_tpu.common.profiler import OpProfiler
+    from deeplearning4j_tpu.data import NDArrayDataSetIterator
+    from deeplearning4j_tpu.ndarray.rng import set_default_seed
+    from deeplearning4j_tpu.optimize.listeners import (
+        CollectScoresIterationListener)
+    from deeplearning4j_tpu.parallel import (EncodedGradientsAccumulator,
+                                             ParallelWrapper,
+                                             ReduceScatterAccumulator)
+
+    def fail(msg, **extra):
+        print(json.dumps({"error": msg, **extra}))
+        sys.exit(1)
+
+    workers = min(workers, len(jax.devices()))
+    if workers < 2:
+        fail("zero1-smoke needs >= 2 devices (virtual CPU device request "
+             "came too late — is jax initialized before bench dispatch?)",
+             devices=len(jax.devices()))
+    rng = np.random.RandomState(0)
+    n = steps * batch
+    x = rng.randn(n, 1, 28, 28).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+
+    def make_it():
+        return NDArrayDataSetIterator(x, y, batch_size=batch)
+
+    def build(acc):
+        set_default_seed(99)
+        model = _lenet_model()
+        b = ParallelWrapper.Builder(model).workers(workers)
+        if acc is not None:
+            b.gradients_accumulator(acc)
+        return model, b.build()
+
+    prof = OpProfiler.get()
+    prof.reset()
+
+    # --- bitwise parity + compile footprint (one warmup epoch each) ----
+    seqs, models, wrappers, warm = {}, {}, {}, {}
+    for name, acc in (("dense", None), ("zero1", ReduceScatterAccumulator())):
+        model, pw = build(acc)
+        scores = CollectScoresIterationListener()
+        pw.set_listeners(scores)
+        prof.reset()
+        pw.fit(make_it(), epochs=1, batch_size=batch)
+        float(model._score_dev)
+        warm[name] = prof.trace_counts()
+        seqs[name] = [s for _, s in scores.scores]
+        models[name], wrappers[name] = model, pw
+    if seqs["zero1"] != seqs["dense"]:
+        diff = next((i for i, (a, b) in enumerate(
+            zip(seqs["dense"], seqs["zero1"])) if a != b),
+            min(len(seqs["dense"]), len(seqs["zero1"])))
+        fail("ZeRO-1 parity break: sharded-updater loss sequence is not "
+             "bitwise-identical to the dense path", first_diff_step=diff)
+    pd = jax.device_get(models["dense"]._params)
+    pz = jax.device_get(models["zero1"]._params)
+    if not all(np.array_equal(a, b) for a, b in
+               zip(jax.tree.leaves(pd), jax.tree.leaves(pz))):
+        fail("ZeRO-1 parity break: final params differ from the dense "
+             "path's")
+    if warm["zero1"] != warm["dense"]:
+        fail("retrace delta between dense and ZeRO-1 paths",
+             dense_traces=warm["dense"], zero1_traces=warm["zero1"])
+
+    # --- memory ledger: sharded updater state is ~1/workers of dense ---
+    dense_upd_bytes = int(sum(
+        l.size * l.dtype.itemsize
+        for l in jax.tree.leaves(jax.device_get(
+            models["dense"]._updater_state))))
+    per_replica = OpProfiler.get().counter_value(
+        "zero1/updater_state_bytes_per_replica")
+    # flat bucketing pads each dtype bucket to a multiple of `workers`
+    pad_slack = workers * 8 * 4
+    if not (0 < per_replica <= dense_upd_bytes // workers + pad_slack):
+        fail("sharded updater-state footprint is not ~1/workers of dense",
+             dense_bytes=dense_upd_bytes, per_replica_bytes=per_replica,
+             workers=workers)
+
+    # --- interleaved A/B step time (median of per-round ratios) --------
+    def timed_epoch(name):
+        t0 = time.perf_counter()
+        wrappers[name].fit(make_it(), epochs=1, batch_size=batch)
+        float(models[name]._score_dev)
+        return time.perf_counter() - t0
+
+    timed_epoch("zero1")
+    timed_epoch("dense")                 # settle round, untimed
+    prof.reset()
+    times = {"dense": [], "zero1": []}
+    ratios = []
+    for r in range(6):
+        order = ("zero1", "dense") if r % 2 == 0 else ("dense", "zero1")
+        round_t = {name: timed_epoch(name) for name in order}
+        times["dense"].append(round_t["dense"])
+        times["zero1"].append(round_t["zero1"])
+        ratios.append(round_t["zero1"] / round_t["dense"])
+    hot = prof.trace_counts()
+    if any(hot.values()):
+        fail("train step retraced inside a timed window", traces=hot)
+    coll_ledger = prof.collective_stats()
+    t_dense = _stats.median(times["dense"])
+    t_zero1 = _stats.median(times["zero1"])
+    regression = _stats.median(ratios) - 1.0
+    if regression > 0.05:
+        fail(f"ZeRO-1 step-time regression {regression:.1%} exceeds the "
+             "5% budget",
+             dense_s=round(t_dense, 4), zero1_s=round(t_zero1, 4),
+             zero1_times=[round(t, 4) for t in times["zero1"]],
+             dense_times=[round(t, 4) for t in times["dense"]])
+
+    # --- encoded-exchange ledger populates (short DCN-path fit) --------
+    prof.reset()
+    model_e, pw_e = build(EncodedGradientsAccumulator())
+    pw_e.fit(NDArrayDataSetIterator(x[:4 * batch], y[:4 * batch],
+                                    batch_size=batch), epochs=1,
+             batch_size=batch)
+    float(model_e._score_dev)
+    enc = prof.collective_stats()
+    if not (enc.get("encoded_steps") and enc.get("encoded_elems_total")
+            and "encoded_density" in enc and enc.get("encoded_bytes_est")):
+        fail("encoded-exchange ledger did not populate", ledger=enc)
+
+    return {
+        "metric": "zero1_smoke",
+        "value": n / t_zero1,
+        "unit": "images/sec",
+        "batch": batch,
+        "workers": workers,
+        "platform": jax.devices()[0].platform,
+        "traces": warm["zero1"],
+        "parity": "exact",
+        "parity_steps_compared": len(seqs["dense"]),
+        "step_time_ratio_zero1_vs_dense": round(1.0 + regression, 4),
+        "epoch_s_dense_median": round(t_dense, 4),
+        "epoch_s_zero1_median": round(t_zero1, 4),
+        "updater_state_bytes_dense": dense_upd_bytes,
+        "updater_state_bytes_per_replica": per_replica,
+        "collective_ledger": {k: (round(v, 5) if isinstance(v, float)
+                                  else v)
+                              for k, v in coll_ledger.items()},
+        "encoded_ledger": {k: (round(v, 5) if isinstance(v, float) else v)
+                           for k, v in enc.items()},
+        "data": "synthetic LeNet batches; dense vs ZeRO-1 sharded-updater "
+                "epochs interleaved, bitwise parity enforced",
+    }
+
+
 def bench_word2vec(steps: int) -> dict:
     """North-star config 4: Word2Vec skip-gram + negative sampling over a
     synthetic zipfian corpus; throughput = corpus words consumed / sec
@@ -1345,6 +1527,14 @@ def bench_fasttext(n_words: int = 1_000_000) -> dict:
 
 
 def main() -> None:
+    # zero1-smoke needs a multi-replica mesh: request virtual CPU devices
+    # BEFORE anything imports jax (the library import just below does).
+    # The flag only affects the host platform — harmless on TPU runs.
+    if "zero1-smoke" in sys.argv and "jax" not in sys.modules:
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=8").strip()
     # Persistent executable cache: compile each bench module once per
     # MACHINE, not once per process (the reference ships pre-built libnd4j
     # kernels; this is the XLA analog). First-ever run still pays the
@@ -1367,7 +1557,8 @@ def main() -> None:
                                  "paragraph-vectors", "glove", "fasttext",
                                  "resnet50-disk", "resnet50-predecoded",
                                  "pipeline-smoke", "telemetry-smoke",
-                                 "fault-smoke", "supervisor-smoke"])
+                                 "fault-smoke", "supervisor-smoke",
+                                 "zero1-smoke"])
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--batch", type=int, default=None,
                         help="per-config default: resnet50=128, bert=32")
@@ -1449,6 +1640,8 @@ def main() -> None:
         result = bench_fault_smoke(steps, batch=args.batch or 64)
     elif args.config == "supervisor-smoke":
         result = bench_supervisor_smoke(steps, batch=args.batch or 64)
+    elif args.config == "zero1-smoke":
+        result = bench_zero1_smoke(steps, batch=args.batch or 64)
     elif args.config == "resnet50-disk":
         result = bench_resnet50_disk(steps, batch=args.batch or 64)
     elif args.config == "resnet50-predecoded":

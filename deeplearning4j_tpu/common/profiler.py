@@ -172,6 +172,33 @@ class OpProfiler:
             out["backoff_count"] = s["count"]
         return out
 
+    def collective_stats(self) -> Dict[str, float]:
+        """Gradient-exchange ledger (``collective/*`` + ``zero1/*``
+        counters): bytes moved per collective kind (dense ``psum`` vs the
+        ZeRO-1 ``reduce_scatter``/``all_gather`` pair), the ZeRO-1 sharded
+        updater-state footprint, and the encoded-exchange element counters
+        with the derived density and the reference wire-format byte
+        estimate — ``ThresholdCompression``'s two encodings: 4-byte sparse
+        indices below 1/16 density, 2-bit bitmap above (the estimate takes
+        the cheaper per run). Empty when no ParallelWrapper fit ran."""
+        out: Dict[str, float] = {
+            k.split("/", 1)[1]: v for k, v in self._counters.items()
+            if k.startswith("collective/")}
+        for ctr, key in (("zero1/updater_state_bytes_total",
+                          "zero1_updater_state_bytes_total"),
+                         ("zero1/updater_state_bytes_per_replica",
+                          "zero1_updater_state_bytes_per_replica")):
+            n = self._counters.get(ctr)
+            if n:
+                out[key] = n
+        sent = out.get("encoded_elems_sent")
+        total = out.get("encoded_elems_total")
+        if total:
+            out["encoded_density"] = sent / total
+            out["encoded_bytes_est"] = int(min(4 * sent, total // 4))
+            out["encoded_dense_bytes_equiv"] = int(4 * total)
+        return out
+
     def fault_stats(self) -> Dict[str, float]:
         """Fault-tolerance ledger: injected-fault counters
         (``faults/<site>/<kind>``), pipeline retry count, and backoff wall

@@ -32,9 +32,22 @@ def _lr_at(lr: Union[float, ISchedule], iteration):
 
 
 class GradientUpdater:
-    """Base: stateless config; state is an explicit pytree."""
+    """Base: stateless config; state is an explicit pytree.
+
+    ``elementwise``: the updater computes each parameter element from only
+    that element's own gradient/state (plus scalar hyperparameters), so
+    applying it to any PERMUTATION or SLICE of the flattened parameter
+    vector is bit-identical to applying it leaf-by-leaf. That property is
+    what lets ``ParallelWrapper``'s ZeRO-1 path
+    (``ReduceScatterAccumulator``) run the updater on each replica's flat
+    1/N shard with sharded state. Every built-in sets it True explicitly;
+    the BASE default is False so a custom updater that couples elements
+    within a leaf (global-norm clipping, whitening, ...) is refused by the
+    sharded path unless its author opts in — never silently diverged
+    from the dense math."""
 
     learning_rate: Union[float, ISchedule]
+    elementwise: bool = False
 
     def init(self, params: Pytree) -> Pytree:
         return {}
@@ -49,6 +62,7 @@ class GradientUpdater:
 
 @dataclass
 class Sgd(GradientUpdater):
+    elementwise = True
     learning_rate: Union[float, ISchedule] = 1e-1
 
     def apply(self, grads, state, params, iteration):
@@ -59,6 +73,7 @@ class Sgd(GradientUpdater):
 
 @dataclass
 class NoOp(GradientUpdater):
+    elementwise = True
     learning_rate: Union[float, ISchedule] = 0.0
 
     def apply(self, grads, state, params, iteration):
@@ -67,6 +82,7 @@ class NoOp(GradientUpdater):
 
 @dataclass
 class Nesterovs(GradientUpdater):
+    elementwise = True
     learning_rate: Union[float, ISchedule] = 0.1
     momentum: float = 0.9
 
@@ -90,6 +106,7 @@ class Nesterovs(GradientUpdater):
 
 @dataclass
 class AdaGrad(GradientUpdater):
+    elementwise = True
     learning_rate: Union[float, ISchedule] = 1e-1
     epsilon: float = 1e-6
 
@@ -111,6 +128,7 @@ class AdaGrad(GradientUpdater):
 
 @dataclass
 class AdaDelta(GradientUpdater):
+    elementwise = True
     rho: float = 0.95
     epsilon: float = 1e-6
     learning_rate: Union[float, ISchedule] = 1.0  # AdaDelta is LR-free
@@ -135,6 +153,7 @@ class AdaDelta(GradientUpdater):
 
 @dataclass
 class RmsProp(GradientUpdater):
+    elementwise = True
     learning_rate: Union[float, ISchedule] = 1e-1
     rms_decay: float = 0.95
     epsilon: float = 1e-8
@@ -157,6 +176,7 @@ class RmsProp(GradientUpdater):
 
 @dataclass
 class Adam(GradientUpdater):
+    elementwise = True
     learning_rate: Union[float, ISchedule] = 1e-3
     beta1: float = 0.9
     beta2: float = 0.999

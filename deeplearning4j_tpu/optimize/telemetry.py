@@ -149,6 +149,52 @@ def layer_stats(params, new_params, grads, loss,
     }
 
 
+def sharded_layer_stats(loss, parts, n_layers: int, axis_name: str,
+                        nonfinite: Optional[jnp.ndarray] = None
+                        ) -> Dict[str, jnp.ndarray]:
+    """:func:`layer_stats` for the ZeRO-1 sharded-updater path: each
+    replica holds only its flat 1/N slice of the (mean) gradient, the
+    pre-step params and the updated params, so the per-layer norms are
+    assembled from shard-local ``segment_sum`` partial sums-of-squares
+    psum'd over the data axis — no full gradient or update tensor is ever
+    materialized just for telemetry, and the result is replicated (every
+    shard reports identical values, like the dense path's).
+
+    ``parts``: per flat bucket, ``(segment_ids, grad_shard, new_param_
+    shard, old_param_shard)`` where ``segment_ids`` maps each local flat
+    position to its telemetry layer slot (``n_layers`` = the pad-tail
+    drop bin). ``nonfinite`` comes from the RAW per-shard grads exactly as
+    in the dense path (the reduced shard would smear NaNs)."""
+    zeros = jnp.zeros((n_layers + 1,), jnp.float32)
+    g2, u2, p2 = zeros, zeros, zeros
+    for seg, g, pn, po in parts:
+        g32 = g.astype(jnp.float32)
+        d32 = (pn - po).astype(jnp.float32)
+        p32 = pn.astype(jnp.float32)
+        g2 = g2 + jax.ops.segment_sum(g32 * g32, seg, n_layers + 1,
+                                      indices_are_sorted=True)
+        u2 = u2 + jax.ops.segment_sum(d32 * d32, seg, n_layers + 1,
+                                      indices_are_sorted=True)
+        p2 = p2 + jax.ops.segment_sum(p32 * p32, seg, n_layers + 1,
+                                      indices_are_sorted=True)
+    g2, u2, p2 = (jax.lax.psum(v[:n_layers], axis_name)
+                  for v in (g2, u2, p2))
+    grad_norm, update_norm, param_norm = (jnp.sqrt(v) for v in (g2, u2, p2))
+    nf = (nonfinite if nonfinite is not None
+          else jnp.zeros((n_layers,), jnp.int32))
+    total = (jnp.sum(nf).astype(jnp.int32)
+             + (~jnp.isfinite(loss)).astype(jnp.int32))
+    return {
+        "loss": loss,
+        "grad_norm": grad_norm,
+        "update_norm": update_norm,
+        "param_norm": param_norm,
+        "update_ratio": update_norm / jnp.maximum(param_norm, 1e-12),
+        "nonfinite": nf,
+        "nonfinite_total": total,
+    }
+
+
 def apply_nan_guard(aux, new_params, params, new_states, states,
                     new_upd, upd_state):
     """The skip-update NAN_PANIC policy, compiled into the step: when the
@@ -181,7 +227,8 @@ class TelemetrySink(TrainingListener):
     pays, timed into the profiler's ``telemetry/drain`` section.
     ``keep_every_n`` subsamples iterations for long runs. Scalars emitted
     per drained iteration: ``loss``, ``nonfinite_total`` (and
-    ``skipped_updates`` under the nan guard), plus
+    ``skipped_updates`` under the nan guard, ``exchange_density`` under an
+    encoded gradient exchange), plus
     ``{grad_norm,update_norm,param_norm,update_ratio}/<layer>`` and —
     only when non-zero — ``nonfinite/<layer>``."""
 
@@ -225,6 +272,11 @@ class TelemetrySink(TrainingListener):
                 int(aux["nonfinite_total"]))
             if "skipped" in aux:
                 put(self.session, "skipped_updates", it, int(aux["skipped"]))
+            if "exchange_density" in aux:
+                # encoded gradient exchange: fraction of elements ≥ the
+                # threshold this step (see parallel/accumulator.py)
+                put(self.session, "exchange_density", it,
+                    float(aux["exchange_density"]))
             for series in ("grad_norm", "update_norm", "param_norm",
                            "update_ratio"):
                 vec = aux[series]

@@ -1,10 +1,12 @@
 from .mesh import make_mesh, replicated, data_sharded, shard_batch
 from .accumulator import (GradientsAccumulator, DenseAllReduceAccumulator,
-                          EncodedGradientsAccumulator, ThresholdAlgorithm,
+                          EncodedGradientsAccumulator,
+                          ReduceScatterAccumulator, ThresholdAlgorithm,
                           AdaptiveThresholdAlgorithm, FixedThresholdAlgorithm,
                           TargetSparsityThresholdAlgorithm)
 from .wrapper import ParallelWrapper
-from .sharding import tp_param_specs, tp_shardings, apply_tp
+from .sharding import (tp_param_specs, tp_shardings, apply_tp, Zero1Plan,
+                       unflatten_updater_state)
 from .inference import ParallelInference
 from .distributed import (SharedTrainingMaster, TrainingSupervisor,
                           SupervisedFitResult, RestartBudgetExceeded,

@@ -801,6 +801,7 @@ class SharedTrainingMaster:
             self._batch = batch_size_per_worker
             self._workers_per_node: Optional[int] = None
             self._threshold: Optional[Any] = None
+            self._accumulator: Optional[Any] = None
             self._checkpoint_dir: Optional[str] = None
             self._checkpoint_every = 0
 
@@ -809,9 +810,19 @@ class SharedTrainingMaster:
             return self
 
         def threshold_algorithm(self, alg) -> "SharedTrainingMaster.Builder":
-            # Recorded and forwarded to the accumulator for config parity;
-            # the exchange itself stays a dense psum (module doc / SURVEY §5.8)
+            # Selects the REAL threshold-encoded exchange (residual carry +
+            # adaptive threshold compiled into the step — the DCN/host-
+            # boundary path; over ICI the dense default is faster, see
+            # parallel/accumulator.py)
             self._threshold = alg
+            return self
+
+        def gradients_accumulator(self, acc) -> "SharedTrainingMaster.Builder":
+            """Explicit exchange strategy — e.g.
+            :class:`ReduceScatterAccumulator` for ZeRO-1 weight-update
+            sharding (sharded updater state, 1/N per replica). Takes
+            precedence over ``threshold_algorithm``."""
+            self._accumulator = acc
             return self
 
         def checkpoint(self, directory: str, every_n_iterations: int
@@ -823,17 +834,20 @@ class SharedTrainingMaster:
         def build(self) -> "SharedTrainingMaster":
             return SharedTrainingMaster(self._batch, self._workers_per_node,
                                         self._checkpoint_dir,
-                                        self._checkpoint_every, self._threshold)
+                                        self._checkpoint_every,
+                                        self._threshold, self._accumulator)
 
     def __init__(self, batch_size_per_worker: int,
                  workers_per_node: Optional[int],
                  checkpoint_dir: Optional[str], checkpoint_every: int,
-                 threshold_algorithm: Optional[Any] = None):
+                 threshold_algorithm: Optional[Any] = None,
+                 accumulator: Optional[Any] = None):
         self.batch_size_per_worker = batch_size_per_worker
         self.workers_per_node = workers_per_node
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = checkpoint_every
         self.threshold_algorithm = threshold_algorithm
+        self.accumulator = accumulator
         # the last supervised run's SupervisedFitResult (status/restarts/
         # failure history); None before any supervised fit
         self.last_result: Optional["SupervisedFitResult"] = None
@@ -883,7 +897,9 @@ class SharedTrainingMaster:
         builder = (ParallelWrapper.Builder(model)
                    .workers(self.workers())
                    .training_mode("shared_gradients"))
-        if self.threshold_algorithm is not None:
+        if self.accumulator is not None:
+            builder.gradients_accumulator(self.accumulator)
+        elif self.threshold_algorithm is not None:
             builder.gradients_accumulator(
                 EncodedGradientsAccumulator(threshold_algorithm=self.threshold_algorithm))
         pw = builder.build()

@@ -35,6 +35,7 @@ from ..ndarray.rng import get_random
 from ..nn.multilayer import _same_shapes
 from .accumulator import DenseAllReduceAccumulator, GradientsAccumulator
 from .mesh import make_mesh, shard_batch
+from .sharding import Zero1Plan, is_flat_state
 
 
 class ParallelWrapper:
@@ -107,6 +108,9 @@ class ParallelWrapper:
         self._chunk_step = None
         self._telemetry = None
         self._listeners: List[Any] = []
+        self._zero1_plan = None
+        self._coll_bytes: dict = {}       # static bytes per collective kind
+        self._drained_encoded = (0.0, 0.0, 0)   # nnz/elems/steps last drain
 
     def set_listeners(self, *ls) -> None:
         self._listeners = list(ls)
@@ -131,16 +135,36 @@ class ParallelWrapper:
     # ------------------------------------------------------------------
     def _local_core(self):
         """The per-shard train step, shared by the per-step shard_map and
-        the steps_per_dispatch scan (one definition, no drift)."""
+        the steps_per_dispatch scan (one definition, no drift).
+
+        Three gradient-exchange/updater layouts, selected by the
+        accumulator (see parallel/accumulator.py):
+
+        - dense (default): pmean the grads, every replica applies the full
+          updater redundantly;
+        - encoded (``stateful``): threshold-encode with residual carry,
+          psum the encoded update, dense updater apply — the accumulator
+          state pytree threads through the step (and scan chunks);
+        - ZeRO-1 (``zero1``): reduce-scatter the flat grads, apply the
+          updater to this replica's 1/N flat slice against SHARDED updater
+          state, all-gather the updated params. Bit-identical to dense on
+          the same replica count: the flat layout is a pure permutation,
+          the built-in updaters are elementwise, and psum_scatter's
+          accumulation order matches psum's.
+        """
         model = self.model
         updater = model.conf.global_conf.updater
         acc = self.accumulator
         axis = acc.axis_name
+        zero1 = acc.zero1
+        stateful = acc.stateful
+        plan = self._zero1_plan if zero1 else None
         is_graph = hasattr(model, "conf") and hasattr(model.conf, "network_inputs")
         tele = self._telemetry
         from ..optimize import telemetry as _tel
 
-        def local_step(params, states, upd_state, x, y, mask, w, key, it):
+        def local_step(params, states, upd_state, acc_state, x, y, mask, w,
+                       key, it):
             idx = jax.lax.axis_index(axis)
             key = jax.random.fold_in(key, idx)
             # Per-shard weighted data loss with a GLOBAL divisor: each shard
@@ -174,24 +198,58 @@ class ParallelWrapper:
                 # them) and aggregated with the same collective family as
                 # the weight update
                 raw_nf = jax.lax.psum(_tel.nonfinite_counts(grads), axis)
-            grads = acc.reduce_gradients(grads)
+            density = None
+            if stateful:
+                grads, acc_state, density = acc.exchange(grads, acc_state,
+                                                         axis)
             loss = jax.lax.pmean(loss, axis)
             # keep batchnorm running stats consistent across shards
             new_states = jax.tree.map(
                 lambda s: jax.lax.pmean(s, axis)
                 if jnp.issubdtype(s.dtype, jnp.floating) else s, new_states)
-            new_params, new_upd = updater.apply(grads, upd_state, params, it)
+            if zero1:
+                # ZeRO-1: mean-reduce-scatter the flat grads, update only
+                # this replica's even slice of params+state, gather back
+                flat_g = plan.flatten(grads)
+                g_sh = {k: jax.lax.psum_scatter(
+                    v, axis, scatter_dimension=0, tiled=True)
+                    / jnp.asarray(n_shards, v.dtype)
+                    for k, v in flat_g.items()}
+                p_sh = plan.shard_slice(plan.flatten(params), idx)
+                new_p_sh, new_upd = updater.apply(g_sh, upd_state, p_sh, it)
+                new_params = plan.unflatten(
+                    {k: jax.lax.all_gather(v, axis, tiled=True)
+                     for k, v in new_p_sh.items()})
+            else:
+                if not stateful:
+                    grads = acc.reduce_gradients(grads)
+                new_params, new_upd = updater.apply(grads, upd_state, params,
+                                                    it)
             if tele is None:
-                return new_params, new_states, new_upd, loss
-            # norms on the REDUCED grads / updated params: replicated
-            # values, identical on every shard
-            aux = _tel.layer_stats(params, new_params, grads, loss,
-                                   nonfinite=raw_nf)
+                return new_params, new_states, new_upd, acc_state, loss
+            if zero1:
+                # per-layer norms from the flat shards: segment-summed
+                # locally, psum'd across the data axis (the full gradient/
+                # update tensors are never materialized for telemetry)
+                parts = [(plan.shard_segment_ids(b.key, idx, b.shard),
+                          g_sh[b.key], new_p_sh[b.key], p_sh[b.key])
+                         for b in plan.buckets]
+                aux = _tel.sharded_layer_stats(loss, parts, plan.n_layers,
+                                               axis, nonfinite=raw_nf)
+            else:
+                # norms on the REDUCED grads / updated params: replicated
+                # values, identical on every shard
+                aux = _tel.layer_stats(params, new_params, grads, loss,
+                                       nonfinite=raw_nf)
+            if density is not None:
+                # encoded-exchange density rides the telemetry aux into
+                # the metrics bus alongside the profiler ledger
+                aux["exchange_density"] = density
             if tele.nan_guard:
                 aux, new_params, new_states, new_upd = _tel.apply_nan_guard(
                     aux, new_params, params, new_states, states, new_upd,
                     upd_state)
-            return new_params, new_states, new_upd, loss, aux
+            return new_params, new_states, new_upd, acc_state, loss, aux
 
         return local_step
 
@@ -199,13 +257,14 @@ class ParallelWrapper:
         local_step = self._local_core()
         pspec = self._param_specs()
         uspec = self._upd_specs(pspec)
-        out_specs = (pspec, P(), uspec, P())
+        aspec = self.accumulator.state_specs(self.model._params)
+        out_specs = (pspec, P(), uspec, aspec, P())
         if self._telemetry is not None:
             out_specs += (P(),)    # aux pytree: replicated device scalars
         sharded = shard_map(
             local_step, mesh=self.mesh,
-            in_specs=(pspec, P(), uspec, P("data"), P("data"), P("data"),
-                      P("data"), P(), P()),
+            in_specs=(pspec, P(), uspec, aspec, P("data"), P("data"),
+                      P("data"), P("data"), P(), P()),
             out_specs=out_specs,
             check_rep=False)
 
@@ -213,47 +272,53 @@ class ParallelWrapper:
             OpProfiler.get().count("trace/pw_fit_step")
             return sharded(*args)
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
     def _build_chunk_step(self):
         """steps_per_dispatch=K: each shard scans its K local slices of the
         stacked chunk inside ONE SPMD program — the per-step collectives
-        (gradient psum, loss/stats pmean) run inside the scan body, and
-        Python dispatch + listener sync amortize over K steps."""
+        (gradient psum/reduce-scatter, loss/stats pmean) run inside the
+        scan body, and Python dispatch + listener sync amortize over K
+        steps. The updater-state and accumulator-state layouts (sharded
+        flat buckets / residual carries) thread through the scan carry
+        unchanged."""
         local_step = self._local_core()
         tele = self._telemetry
 
-        def local_chunk(params, states, upd_state, xs, ys, masks, ws, keys,
-                        it0):
+        def local_chunk(params, states, upd_state, acc_state, xs, ys, masks,
+                        ws, keys, it0):
             def body(carry, inp):
-                params, states, upd_state, it = carry
+                params, states, upd_state, acc_state, it = carry
                 x, y, m, w, k = inp
-                out = local_step(params, states, upd_state, x, y, m, w, k,
-                                 it)
+                out = local_step(params, states, upd_state, acc_state, x, y,
+                                 m, w, k, it)
                 if tele is None:
-                    params, states, upd_state, loss = out
-                    return (params, states, upd_state, it + 1), loss
-                params, states, upd_state, loss, aux = out
-                return (params, states, upd_state, it + 1), (loss, aux)
+                    params, states, upd_state, acc_state, loss = out
+                    return (params, states, upd_state, acc_state,
+                            it + 1), loss
+                params, states, upd_state, acc_state, loss, aux = out
+                return (params, states, upd_state, acc_state,
+                        it + 1), (loss, aux)
 
-            (params, states, upd_state, _), ys_out = jax.lax.scan(
-                body, (params, states, upd_state, it0),
+            (params, states, upd_state, acc_state, _), ys_out = jax.lax.scan(
+                body, (params, states, upd_state, acc_state, it0),
                 (xs, ys, masks, ws, keys))
             if tele is None:
-                return params, states, upd_state, ys_out
+                return params, states, upd_state, acc_state, ys_out
             losses, auxes = ys_out
-            return params, states, upd_state, losses, auxes
+            return params, states, upd_state, acc_state, losses, auxes
 
         pspec = self._param_specs()
         uspec = self._upd_specs(pspec)
+        aspec = self.accumulator.state_specs(self.model._params)
         batch = P(None, "data")   # [K, B, ...]: stack axis whole, B sharded
-        out_specs = (pspec, P(), uspec, P())
+        out_specs = (pspec, P(), uspec, aspec, P())
         if tele is not None:
             out_specs += (P(),)
         sharded = shard_map(
             local_chunk, mesh=self.mesh,
-            in_specs=(pspec, P(), uspec, batch, batch, batch, batch, P(),
-                      P()),
+            in_specs=(pspec, P(), uspec, aspec, batch, batch, batch, batch,
+                      P(), P()),
             out_specs=out_specs,
             check_rep=False)
 
@@ -261,7 +326,7 @@ class ParallelWrapper:
             OpProfiler.get().count("trace/pw_fit_chunk")
             return sharded(*args)
 
-        return jax.jit(chunk, donate_argnums=(0, 1, 2))
+        return jax.jit(chunk, donate_argnums=(0, 1, 2, 3))
 
     def _param_specs(self):
         """Per-layer partition specs: replicated except row-sharded
@@ -295,13 +360,232 @@ class ParallelWrapper:
 
     def _upd_specs(self, pspec):
         """Updater state mirrors params per top-level key (Adam m/v,
-        Nesterov v, ...) — shard those subtrees like the params."""
+        Nesterov v, ...) — shard those subtrees like the params. Under
+        ZeRO-1 the state is flat buckets, every leaf split evenly over the
+        data axis (the whole point: 1/N of the state per replica)."""
         upd_state = self.model._updater_state
         if not isinstance(upd_state, dict) or not upd_state:
             return P()
+        if self.accumulator.zero1:
+            return jax.tree.map(lambda _: P("data"), upd_state)
         pstruct = jax.tree.structure(self.model._params)
         return {k: (pspec if jax.tree.structure(v) == pstruct else P())
                 for k, v in upd_state.items()}
+
+    # ------------------------------------------------------------------
+    # training-state layout (ZeRO-1 sharded updater / accumulator state)
+    # ------------------------------------------------------------------
+    def _place(self, tree, specs):
+        """Host/device tree → device arrays placed per spec. ``jnp.array``
+        first: an owning copy, never a view of numpy-owned memory — the
+        step DONATES these buffers (the PR-3 heap-corruption lesson)."""
+        from jax.sharding import NamedSharding
+
+        leaves, treedef = jax.tree.flatten(tree)
+        spec_leaves = jax.tree.flatten(
+            specs, is_leaf=lambda s: isinstance(s, P))[0]
+        placed = [jax.device_put(jnp.array(l), NamedSharding(self.mesh, s))
+                  for l, s in zip(leaves, spec_leaves)]
+        return jax.tree.unflatten(treedef, placed)
+
+    def _ensure_parallel_state(self) -> None:
+        """Bring the model's updater/accumulator state into THIS wrapper's
+        layout before the step is (re)built — fresh init, dense↔ZeRO-1
+        conversion, and resharding a flat state saved under a different
+        worker count (the flat layout is replica-count-independent, so
+        only the zero pad tail changes: exact resume across N)."""
+        import numpy as np
+
+        model = self.model
+        updater = model.conf.global_conf.updater
+        acc = self.accumulator
+        prof = OpProfiler.get()
+        if acc.zero1:
+            if not getattr(updater, "elementwise", False):
+                raise NotImplementedError(
+                    f"{type(updater).__name__} does not declare "
+                    "elementwise=True; ZeRO-1 weight-update sharding "
+                    "(ReduceScatterAccumulator) requires an elementwise "
+                    "updater — use the dense accumulator instead")
+            pspec = self._param_specs()
+            spec_leaves = ([] if pspec == P() else jax.tree.leaves(
+                pspec, is_leaf=lambda s: isinstance(s, P)))
+            if self.model_axis != 1 or any(s != P() for s in spec_leaves):
+                raise NotImplementedError(
+                    "ZeRO-1 sharding assumes replicated params: it cannot "
+                    "compose with model_axis/table_sharding yet")
+            if self._zero1_plan is None \
+                    or self._zero1_plan.n_shards != self.workers_count:
+                self._zero1_plan = Zero1Plan(model._params,
+                                             self.workers_count)
+            plan = self._zero1_plan
+            state = model._updater_state
+            if self._flat_state_matches_plan(state, plan):
+                # already this plan's device layout (a prior fit's step
+                # outputs) — re-placing it would be a needless host
+                # round-trip, and re-counting would inflate the gauges
+                return self._finish_parallel_state(acc, model)
+            if state is None:
+                # init DIRECTLY in the flat layout (zeros flatten to
+                # zeros, so this equals flatten(dense init) exactly)
+                flat_p = plan.flatten(jax.tree.map(np.asarray,
+                                                   jax.device_get(
+                                                       model._params)),
+                                      xp=np)
+                state = updater.init(flat_p)
+            elif is_flat_state(state) or isinstance(state, dict) and state:
+                # dense tree or differently-padded flat state → this
+                # plan's padding (host-side numpy; pure permutation)
+                state = plan.reshard_state(jax.device_get(state))
+            if isinstance(state, dict) and state:
+                uspecs = jax.tree.map(lambda _: P("data"), state)
+                state = self._place(state, uspecs)
+                total = sum(l.size * l.dtype.itemsize
+                            for l in jax.tree.leaves(state))
+                prof.count("zero1/updater_state_bytes_total", int(total))
+                prof.count("zero1/updater_state_bytes_per_replica",
+                           int(total // self.workers_count))
+            model._updater_state = state
+        else:
+            state = model._updater_state
+            if is_flat_state(state):
+                # ZeRO-1 → dense handoff (e.g. resumed under a dense
+                # accumulator): unflatten on host, rematerialize owned
+                from .sharding import unflatten_updater_state
+
+                state = unflatten_updater_state(
+                    jax.device_get(state),
+                    jax.device_get(model._params), xp=np)
+                state = jax.tree.map(lambda a: jnp.array(a), state)
+                model._updater_state = state
+            if model._updater_state is None:
+                model._updater_state = updater.init(model._params)
+        self._finish_parallel_state(acc, model)
+
+    def _flat_state_matches_plan(self, state, plan) -> bool:
+        """True when ``state`` is already this plan's PLACED flat layout:
+        every bucket leaf a device array of the plan's padded length. A
+        flat state from a different worker count fails on shape; host
+        (numpy) trees fail on the array type and go through placement."""
+        if not is_flat_state(state):
+            return False
+        for v in state.values():
+            if not (isinstance(v, dict) and v):
+                continue
+            for b in plan.buckets:
+                arr = v.get(b.key)
+                if not (isinstance(arr, jax.Array)
+                        and arr.shape == (b.padded,)):
+                    return False
+        return True
+
+    def _finish_parallel_state(self, acc, model) -> None:
+        """Accumulator-state layout + the static collective byte ledger
+        (the tail every `_ensure_parallel_state` path shares)."""
+        # accumulator state (encoded exchange: residual carry + threshold)
+        if acc.stateful:
+            st = getattr(model, "_acc_state", None)
+            if not self._acc_state_placed(st):
+                aspecs = acc.state_specs(model._params)
+                blob = getattr(model, "_acc_blob", None)
+                if st is None and blob is not None:
+                    st = self._load_acc_blob(blob, acc)
+                    model._acc_blob = None
+                if st is None:
+                    st = acc.init_state(model._params,
+                                        n_shards=self.workers_count)
+                else:
+                    st = self._reshape_acc_state(jax.device_get(st), acc)
+                model._acc_state = self._place(st, aspecs)
+        else:
+            model._acc_state = {}
+
+        # static per-step collective byte ledger (gradient exchange only)
+        param_bytes = int(sum(l.size * np.dtype(l.dtype).itemsize
+                              for l in jax.tree.leaves(model._params)))
+        if acc.zero1:
+            flat = self._zero1_plan.bucket_bytes()
+            self._coll_bytes = {"reduce_scatter_bytes": flat,
+                                "all_gather_bytes": flat}
+        else:
+            self._coll_bytes = {"psum_bytes": param_bytes}
+        self._coll_bytes["dense_grad_bytes"] = param_bytes
+
+    def _acc_state_placed(self, st) -> bool:
+        """True when the live accumulator state already carries this
+        wrapper's layout (device arrays, residual leading axis == this
+        worker count) — i.e. it came out of this wrapper's own step."""
+        if not (isinstance(st, dict) and st and "residual" in st):
+            return False
+        leaves = jax.tree.leaves(st["residual"])
+        return all(isinstance(l, jax.Array) and l.ndim >= 1
+                   and l.shape[0] == self.workers_count for l in leaves)
+
+    def _load_acc_blob(self, blob: bytes, acc):
+        """Checkpointed accumulator state (raw npz bytes restored by
+        util.checkpoint) → host tree against this accumulator's template."""
+        from ..util.model_serializer import _load_into_tree
+
+        template = acc.init_state(self.model._params,
+                                  n_shards=self.workers_count)
+        try:
+            return _load_into_tree(blob, template, "accumulator state")
+        except Exception:
+            import logging
+
+            logging.getLogger("deeplearning4j_tpu").warning(
+                "checkpointed accumulator state does not match this "
+                "accumulator; starting it fresh")
+            return None
+
+    def _reshape_acc_state(self, st, acc):
+        """Validate a restored/live accumulator state against this worker
+        count. Residuals are PER-REPLICA (leading replica axis): a changed
+        worker count makes them meaningless — reset to zero (warned);
+        replicated scalars (threshold, ledger counters) carry over."""
+        import numpy as np
+
+        res = st.get("residual") if isinstance(st, dict) else None
+        if res is None:
+            return st
+        lead = {l.shape[0] for l in jax.tree.leaves(res)}
+        if lead == {self.workers_count}:
+            return st
+        import logging
+
+        logging.getLogger("deeplearning4j_tpu").warning(
+            "encoded-accumulator residuals were saved for %s workers; "
+            "resetting them for %d (threshold and ledger carry over)",
+            sorted(lead), self.workers_count)
+        st = dict(st)
+        st["residual"] = jax.tree.map(
+            lambda p: np.zeros((self.workers_count,) + tuple(p.shape),
+                               np.dtype(p.dtype)), self.model._params)
+        return st
+
+    def _count_collectives(self, prof, k: int = 1) -> None:
+        prof.count("collective/steps", k)
+        for name, nbytes in self._coll_bytes.items():
+            prof.count(f"collective/{name}", nbytes * k)
+
+    def _drain_encoded_ledger(self, prof) -> None:
+        """One tiny host readback per epoch: fold the in-graph encoded-
+        exchange counters (elements sent / total / steps) into the
+        profiler's collective ledger as deltas since the last drain."""
+        st = getattr(self.model, "_acc_state", None)
+        if not (self.accumulator.stateful and isinstance(st, dict)) \
+                or "nnz_sum" not in st:
+            return
+        nnz, elems, steps = jax.device_get(
+            (st["nnz_sum"], st["elems_sum"], st["steps"]))
+        p_nnz, p_elems, p_steps = self._drained_encoded
+        if int(steps) > p_steps:
+            prof.count("collective/encoded_elems_sent",
+                       int(float(nnz) - p_nnz))
+            prof.count("collective/encoded_elems_total",
+                       int(float(elems) - p_elems))
+            prof.count("collective/encoded_steps", int(steps) - p_steps)
+        self._drained_encoded = (float(nnz), float(elems), int(steps))
 
     def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None,
             *, pad_partial: Optional[bool] = None,
@@ -331,14 +615,14 @@ class ParallelWrapper:
         from ..util.checkpoint import begin_fit_cursor
 
         skip = begin_fit_cursor(model, resume_from,
-                                listeners=self._listeners)
+                                listeners=self._listeners,
+                                keep_flat=self.accumulator.zero1)
         if skip is not None:
             # the wrapper's own compiled steps hold donated buffers of the
             # replaced params — rebuild them too
             self._step = None
             self._chunk_step = None
-        if model._updater_state is None:
-            model._updater_state = model.conf.global_conf.updater.init(model._params)
+        self._ensure_parallel_state()
         if self._step is None:
             self._step = self._build_step()
         if steps_per_dispatch > 1 and self._chunk_step is None:
@@ -348,6 +632,7 @@ class ParallelWrapper:
         def on_epoch():
             model._epoch += 1
             model._steps_in_epoch = 0
+            self._drain_encoded_ledger(prof)
             for lst in self._listeners:
                 if hasattr(lst, "epoch_done"):
                     lst.epoch_done(model, model._epoch)
@@ -388,9 +673,15 @@ class ParallelWrapper:
         key = get_random().next_key()
         with prof.time_section("pipeline/dispatch"):
             out = self._step(model._params, model._states,
-                             model._updater_state, xs, ys, ms, ws, key,
-                             jnp.asarray(model._iteration))
-        _pipe.note_dispatch(model, self._listeners, out,
+                             model._updater_state, model._acc_state, xs,
+                             ys, ms, ws, key, jnp.asarray(model._iteration))
+        # the accumulator state (residual carry / threshold / counters) is
+        # the wrapper's own training state — peel it off before the shared
+        # note_dispatch decodes the (params, states, upd, loss[, aux])
+        # contract every fit path uses
+        model._acc_state = out[3]
+        self._count_collectives(prof)
+        _pipe.note_dispatch(model, self._listeners, out[:3] + out[4:],
                             self._telemetry is not None)
 
     def _dispatch_chunk(self, group, prof) -> None:
@@ -402,12 +693,15 @@ class ParallelWrapper:
         keys = jnp.stack([get_random().next_key() for _ in group])
         with prof.time_section("pipeline/dispatch"):
             out = self._chunk_step(model._params, model._states,
-                                   model._updater_state, stack(0), stack(1),
-                                   stack(2), stack(3), keys,
-                                   jnp.asarray(model._iteration))
-        _pipe.note_dispatch(model, self._listeners, out,
+                                   model._updater_state, model._acc_state,
+                                   stack(0), stack(1), stack(2), stack(3),
+                                   keys, jnp.asarray(model._iteration))
+        model._acc_state = out[3]
+        self._count_collectives(prof, len(group))
+        _pipe.note_dispatch(model, self._listeners, out[:3] + out[4:],
                             self._telemetry is not None, len(group))
 
     def shutdown(self) -> None:
         self._step = None
         self._chunk_step = None
+        self._zero1_plan = None

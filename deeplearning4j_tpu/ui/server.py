@@ -295,8 +295,10 @@ class UIServer:
         from ``common.system_info.memory_summary`` (per-device PJRT stats
         + the jax live-buffer census), the self-healing ledger (supervisor
         restarts / watchdog fires / backoff waits + injected-fault
-        counters), and the inference-pool census
-        (live/retired/resurrected replicas)."""
+        counters), the collective-exchange ledger (bytes per collective
+        kind, ZeRO-1 sharded-updater footprint, encoded-exchange density),
+        and the inference-pool census (live/retired/resurrected
+        replicas)."""
         from ..common.profiler import OpProfiler
         from ..common.system_info import memory_summary
         from ..parallel.inference import pool_health
@@ -317,6 +319,7 @@ class UIServer:
                 "jsonl_cache": self._jsonl.stats(),
                 "supervisor": prof.supervisor_stats(),
                 "faults": prof.fault_stats(),
+                "collectives": prof.collective_stats(),
                 "inference": pool_health(),
                 **memory_summary()}
 

@@ -59,6 +59,7 @@ logger = logging.getLogger("deeplearning4j_tpu")
 
 MANIFEST_NAME = "checkpoint.json"
 RESUME_ENTRY = "resume.json"
+ACC_ENTRY = "accumulatorState.npz"
 MANIFEST_FORMAT = 2
 
 
@@ -88,16 +89,26 @@ def snapshot_training_state(model, listeners=None,
     from ..ndarray.rng import get_random
 
     state = rng_state if rng_state is not None else get_random().get_state()
+    acc_state = getattr(model, "_acc_state", None)
     with OpProfiler.get().time_section("checkpoint/snapshot"):
         host = jax.device_get(
             (model._params, model._states, model._updater_state,
-             state["key"]))
+             acc_state if acc_state else None, state["key"]))
         # device_get may return ZERO-COPY views of the device buffers on
         # the CPU backend — and the very next train step DONATES those
         # buffers, so the background writer would read freed memory
         # (observed as glibc heap corruption). Force owning copies; the
         # memcpy is trivial next to the serialize it feeds.
-        params, states, upd, key = jax.tree.map(np.array, host)
+        params, states, upd, acc, key = jax.tree.map(np.array, host)
+    # ZeRO-1 runs hold the updater state in the flat sharded layout; the
+    # ON-DISK layout is always the dense params-mirroring tree (a pure
+    # permutation), so a checkpoint restores into a single-device fit, a
+    # dense data-parallel fit, or a ZeRO-1 fit with a DIFFERENT worker
+    # count without any format negotiation — resharding is just
+    # re-flattening for the new count.
+    from ..parallel.sharding import unflatten_updater_state
+
+    upd = unflatten_updater_state(upd, params, xp=np)
     fit_epoch0 = getattr(model, "_fit_epoch0", model._epoch)
     # the configuration is immutable across a fit — serialize it once per
     # model, not once per checkpoint
@@ -111,6 +122,7 @@ def snapshot_training_state(model, listeners=None,
         "params": params,
         "states": states,
         "updater": upd,
+        "accumulator": acc,
         "iteration": int(model._iteration),
         "epoch": int(model._epoch),
         "rng": {"seed": int(state.get("seed", get_random().get_seed())),
@@ -171,6 +183,12 @@ def serialize_snapshot(snapshot: Dict[str, Any]) -> bytes:
         }))
         if snapshot["updater"] is not None:
             zf.writestr(_UPDATER_ENTRY, _savez_leaves(snapshot["updater"]))
+        if snapshot.get("accumulator"):
+            # stateful gradient-exchange state (encoded residual carry +
+            # threshold + ledger counters): restored lazily by the wrapper
+            # against ITS accumulator's template (the zip stays readable
+            # by consumers that know nothing about accumulators)
+            zf.writestr(ACC_ENTRY, _savez_leaves(snapshot["accumulator"]))
         zf.writestr(RESUME_ENTRY, json.dumps({
             "rng": snapshot["rng"],
             "cursor": snapshot["cursor"],
@@ -525,6 +543,13 @@ def restore_training_state(model, path: str, listeners=None,
         # shared with ModelSerializer._restore: zip-entry loading +
         # device materialization (donation safety) live in ONE place
         load_state_entries(zf, model, load_updater=True)
+        # accumulator state (encoded-exchange residuals etc.) restores
+        # LAZILY: the raw npz bytes ride on the model until a wrapper
+        # with the owning accumulator rebuilds the tree from its template
+        # (non-wrapper resumes simply never touch the blob)
+        model._acc_blob = (zf.read(ACC_ENTRY)
+                           if ACC_ENTRY in zf.namelist() else None)
+        model._acc_state = None
     # the restored params replace donated jit buffers — compiled steps
     # referencing the old ones must rebuild
     for attr in ("_fit_step", "_chunk_step", "_tbptt_step", "_infer_fn"):
@@ -541,7 +566,8 @@ def restore_training_state(model, path: str, listeners=None,
 
 
 def begin_fit_cursor(model, resume_from: Optional[str],
-                     listeners=None) -> Optional[tuple]:
+                     listeners=None, keep_flat: bool = False
+                     ) -> Optional[tuple]:
     """The one resume-cursor setup every fit path shares (MLN /
     ComputationGraph / ParallelWrapper): restore the checkpoint into the
     model (when resuming) and anchor the cursor bookkeeping —
@@ -549,7 +575,15 @@ def begin_fit_cursor(model, resume_from: Optional[str],
     checkpoint taken after a resume still records its cursor relative to
     the original call, and ``_steps_in_epoch`` counts dispatched steps
     for the snapshot. Returns the pipeline ``skip`` tuple, or None for a
-    fresh fit."""
+    fresh fit.
+
+    ``keep_flat``: a ZeRO-1 fit (ParallelWrapper + ReduceScatter
+    accumulator) keeps/accepts the flat sharded updater layout and does
+    its own (re)sharding; every OTHER fit path needs the dense tree, so a
+    model whose last fit left flat state (same-process handoff) is
+    normalized here before its step builder ever sees it."""
+    if not keep_flat:
+        _ensure_dense_updater_layout(model)
     if resume_from is None:
         model._fit_epoch0 = model._epoch
         model._steps_in_epoch = 0
@@ -558,6 +592,23 @@ def begin_fit_cursor(model, resume_from: Optional[str],
     model._fit_epoch0 = model._epoch - cursor["epochs_done"]
     model._steps_in_epoch = cursor["steps_in_epoch"]
     return (cursor["epochs_done"], cursor["steps_in_epoch"])
+
+
+def _ensure_dense_updater_layout(model) -> None:
+    """Flat (ZeRO-1) updater state → dense params-mirroring tree, device-
+    materialized with owning buffers (donation safety). No-op for dense
+    state/None."""
+    from ..parallel.sharding import is_flat_state, unflatten_updater_state
+
+    state = getattr(model, "_updater_state", None)
+    if not is_flat_state(state):
+        return
+    import jax
+    import jax.numpy as jnp
+
+    host = unflatten_updater_state(jax.device_get(state),
+                                   jax.device_get(model._params), xp=np)
+    model._updater_state = jax.tree.map(lambda a: jnp.array(a), host)
 
 
 # --------------------------------------------------------------------------

@@ -65,8 +65,15 @@ def write_model(model, path: str, save_updater: bool = False,
                 "kind": type(model).__name__, "format_version": 1,
             }))
             if save_updater and model._updater_state is not None:
-                zf.writestr(_UPDATER_ENTRY,
-                            _savez_leaves(model._updater_state))
+                # a ZeRO-1 fit leaves the updater state in the flat
+                # sharded layout; the container's layout is ALWAYS the
+                # dense params-mirroring tree (see util.checkpoint)
+                from ..parallel.sharding import unflatten_updater_state
+
+                upd = unflatten_updater_state(
+                    jax.device_get(model._updater_state),
+                    jax.device_get(model._params))
+                zf.writestr(_UPDATER_ENTRY, _savez_leaves(upd))
             if normalizer is not None:
                 zf.writestr(_NORMALIZER_ENTRY,
                             json.dumps(normalizer.to_json()))

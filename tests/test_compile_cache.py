@@ -16,6 +16,8 @@ import subprocess
 import sys
 import tempfile
 
+import pytest
+
 _FIT_SCRIPT = r"""
 import os, sys, time
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -63,6 +65,7 @@ def _cache_entries(cache_dir: str):
 
 
 class TestCompileCache:
+    @pytest.mark.slow
     def test_second_process_hits_cache(self):
         with tempfile.TemporaryDirectory() as cache:
             _run_fit(cache)
@@ -142,6 +145,7 @@ class TestMLNColdStart:
     train step too (the bench --cold-audit flagship path), asserted
     structurally like TestCompileCache."""
 
+    @pytest.mark.slow
     def test_mln_second_process_hits_cache(self):
         with tempfile.TemporaryDirectory() as cache:
             _run_mln_fit(cache)

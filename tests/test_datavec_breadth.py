@@ -187,6 +187,7 @@ class TestFetchers:
         with pytest.raises(ValueError, match="unknown EMNIST split"):
             EmnistDataSetIterator("nope", batch_size=8)
 
+    @pytest.mark.slow
     def test_cnn_trains_on_cifar_iterator(self):
         """e2e: small CNN + the CIFAR iterator learn above chance."""
         from deeplearning4j_tpu.learning import Adam

@@ -230,6 +230,7 @@ class TestZoo:
         out = m.output(np.zeros((2, 1, 28, 28), np.float32))
         assert out.shape == (2, 10)
 
+    @pytest.mark.slow
     def test_resnet50_structure(self):
         from deeplearning4j_tpu.models import ResNet50
 
@@ -239,6 +240,7 @@ class TestZoo:
         out = g.output(np.zeros((1, 3, 64, 64), np.float32))
         assert out[0].shape == (1, 1000)
 
+    @pytest.mark.slow
     def test_resnet50_trains(self):
         from deeplearning4j_tpu.models import ResNet50
 
@@ -320,6 +322,7 @@ class TestMixedPrecision:
 
 
 class TestVertexSerde:
+    @pytest.mark.slow
     def test_resnet_style_graph_round_trip(self, tmp_path):
         """Verify-found regression: vertices must survive config serde."""
         from deeplearning4j_tpu.models import ResNet50
@@ -337,6 +340,7 @@ class TestZooAdditions:
     """Round-2 zoo additions (round-1 VERDICT partial #24): TinyYOLO, YOLO2,
     Xception, InceptionResNetV1 — build, forward-shape, and one train step."""
 
+    @pytest.mark.slow
     def test_tiny_yolo_builds_and_steps(self):
         from deeplearning4j_tpu.models import TinyYOLO
 
@@ -368,6 +372,7 @@ class TestZooAdditions:
         assert any(isinstance(getattr(n, "layer", None), SpaceToDepthLayer)
                    for n in g.conf.nodes.values())
 
+    @pytest.mark.slow
     def test_xception_builds_and_forwards(self):
         from deeplearning4j_tpu.models import Xception
 
@@ -382,6 +387,7 @@ class TestZooAdditions:
                     for n in g.conf.nodes.values())
         assert n_sep >= 30   # 2*3 entry + 24 middle + 2 exit + 2 tail
 
+    @pytest.mark.slow
     def test_inception_resnet_v1_builds_and_forwards(self):
         from deeplearning4j_tpu.models import InceptionResNetV1
 
@@ -393,6 +399,7 @@ class TestZooAdditions:
 class TestZooCompletion:
     """Round-3: the final two reference zoo models — 16/16 coverage."""
 
+    @pytest.mark.slow
     def test_facenet_nn4small2_builds_and_steps(self):
         from deeplearning4j_tpu.models import FaceNetNN4Small2
         from deeplearning4j_tpu.nn.graph import L2NormalizeVertex
@@ -414,6 +421,7 @@ class TestZooCompletion:
         g.fit(DataSet(x, y), epochs=1)
         assert np.isfinite(float(g.score_value))
 
+    @pytest.mark.slow
     def test_facenet_embeddings_are_l2_normalized(self):
         from deeplearning4j_tpu.models import FaceNetNN4Small2
 
@@ -427,6 +435,7 @@ class TestZooCompletion:
         np.testing.assert_allclose(np.linalg.norm(emb, axis=1),
                                    np.ones(3), atol=1e-4)
 
+    @pytest.mark.slow
     def test_nasnet_builds_and_steps(self):
         from deeplearning4j_tpu.models import NASNet
         from deeplearning4j_tpu.nn.conf.layers import \

@@ -12,6 +12,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO_ROOT = str(Path(__file__).resolve().parents[1])
 
 _WORKER = r"""
@@ -87,6 +89,7 @@ def _run_worker(script, ckpt, log, mode, fault_plan=None):
                           timeout=300, cwd=REPO_ROOT)
 
 
+@pytest.mark.slow
 def test_kill_and_resume_exact_loss_parity(tmp_path):
     ckpt = tmp_path / "ckpts"
     script = tmp_path / "worker.py"

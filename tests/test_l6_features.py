@@ -147,6 +147,7 @@ class TestAttentionLayers:
         assert acts[1].shape == (3, 3, 6)   # [B, n_queries, n_out]
         _gradcheck_model(model, self._rnn_ds(rng))
 
+    @pytest.mark.slow
     def test_recurrent_attention_gradcheck(self):
         conf = self._conf(L.RecurrentAttentionLayer(n_out=4, n_heads=1))
         model = MultiLayerNetwork(conf).init()

@@ -155,6 +155,7 @@ class TestGradients:
 
         check_gradients(loss_fn, flat_params, flat_grads, sample=20)
 
+    @pytest.mark.slow
     def test_lstm_gradcheck(self):
         conf = (NeuralNetConfiguration.builder()
                 .seed(5).data_type("float64")

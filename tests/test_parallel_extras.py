@@ -430,6 +430,7 @@ class TestHeterogeneousPipeline:
                 .set_input_type(InputType.convolutional(8, 8, 2)).build())
         return MultiLayerNetwork(conf).init()
 
+    @pytest.mark.slow
     def test_conv_dense_cut_forward_and_grad_parity(self):
         import jax
         import jax.numpy as jnp
@@ -465,6 +466,7 @@ class TestHeterogeneousPipeline:
         np.testing.assert_allclose(np.asarray(g_pipe), np.asarray(g_seq),
                                    atol=2e-5)
 
+    @pytest.mark.slow
     def test_transformer_two_stage_split(self):
         import jax
         import jax.numpy as jnp

@@ -70,6 +70,7 @@ class TestEnvironments:
 
 
 class TestDQN:
+    @pytest.mark.slow
     def test_gridworld_converges_to_optimal_policy(self):
         env = GridWorld(size=6)
         net = _qnet(6, 2, hidden=24, lr=5e-3, seed=3)
@@ -92,6 +93,7 @@ class TestDQN:
             q = net.output(obs[None]).to_numpy()[0]
             assert q[1] > q[0], (pos, q)
 
+    @pytest.mark.slow
     def test_cartpole_improves(self):
         """Smoke-scale CartPole: mean episode length over the last quarter
         beats the first quarter (full convergence needs more steps than a

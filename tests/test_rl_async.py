@@ -32,6 +32,7 @@ class TestDuelingDQN:
         names = set(net.sd._vars)
         assert "value_w" in names and "adv_w" in names
 
+    @pytest.mark.slow
     def test_dueling_converges_on_gridworld(self):
         mdp = GridWorld(size=6)
         obs_dim = int(np.prod(mdp.observation_space.shape))
@@ -48,6 +49,7 @@ class TestDuelingDQN:
 
 
 class TestA3C:
+    @pytest.mark.slow
     def test_converges_on_gridworld(self):
         # single worker for the convergence ASSERTION (deterministic);
         # the 2-worker path is smoke-tested below
@@ -65,6 +67,7 @@ class TestA3C:
                  for _ in range(5)]
         assert np.mean(plays) > 0.5, plays
 
+    @pytest.mark.slow
     def test_two_workers_train_concurrently(self):
         mdp0 = GridWorld(size=6)
         obs_dim = int(np.prod(mdp0.observation_space.shape))
@@ -92,6 +95,7 @@ class TestA3C:
 
 
 class TestAsyncNStepQ:
+    @pytest.mark.slow
     def test_converges_on_gridworld(self):
         # single worker for the convergence ASSERTION: thread scheduling
         # makes multi-worker runs nondeterministic despite fixed seeds
@@ -109,6 +113,7 @@ class TestAsyncNStepQ:
         reward = learner.get_policy().play(GridWorld(size=6), max_steps=40)
         assert reward > 0.5, reward
 
+    @pytest.mark.slow
     def test_two_workers_train_concurrently(self):
         # multi-worker smoke: both threads contribute steps/episodes and
         # the shared net stays finite (no convergence assertion — async

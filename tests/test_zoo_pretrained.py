@@ -55,6 +55,7 @@ class TestInitPretrained:
             loaded.output(x.features.to_numpy()).to_numpy(),
             trained.output(x.features.to_numpy()).to_numpy(), atol=1e-6)
 
+    @pytest.mark.slow
     def test_transfer_learning_from_pretrained(self, cache):
         """The first thing transfer-learning users do: initPretrained →
         freeze the feature extractor → replace + train the head."""
