@@ -1278,6 +1278,199 @@ def bench_zero1_smoke(steps: int, batch: int = 64, workers: int = 4) -> dict:
     }
 
 
+def bench_elastic_smoke(steps: int, batch: int = 64, workers: int = 4) -> dict:
+    """CPU-friendly smoke of ONLINE elastic resize (ISSUE 6; ROADMAP item
+    4(b)): the flagship LeNet config through ParallelWrapper with the
+    ZeRO-1 accumulator, a deterministic ``device/loss`` fault mid-epoch,
+    shrink-and-continue in memory, then interleaved A/B epochs at N and
+    N-1 workers through the per-worker-count executable cache.
+    Self-validating hard-fails:
+
+    - parity break: the shrunk continuation's final params/updater state
+      must be BITWISE-equal to a fresh (N-1)-worker run handed the same
+      host-materialized state, pipeline cursor and RNG stream (the
+      resharding is a pure permutation — same guarantee as checkpoint
+      resharding, no disk involved);
+    - retrace: the whole elastic cycle (kill -> shrink -> continue) must
+      compile exactly once per worker count, and the interleaved timed
+      rounds (6 x resize N <-> N-1) must trigger ZERO further traces —
+      any retrace beyond one-recompile-per-worker-count fails;
+    - throughput: the post-shrink epoch must sustain at least
+      0.9 x (N-1)/N of the pre-shrink throughput (median of interleaved
+      rounds — losing a replica may cost its share of the axis, but the
+      resize itself must not tax the steady state);
+    - the ``elastic/*`` ledger (resize counts, worker gauge) must
+      populate — the /api/health section the drill is monitored by.
+
+    Emits the elastic ledger alongside the timing."""
+    import statistics as _stats
+
+    # a multi-replica mesh is the whole point: on single-device hosts
+    # (CPU build machines) request virtual CPU devices BEFORE jax loads
+    if "jax" not in sys.modules:
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.common import faultinject
+    from deeplearning4j_tpu.common.profiler import OpProfiler
+    from deeplearning4j_tpu.data import NDArrayDataSetIterator
+    from deeplearning4j_tpu.ndarray.rng import get_random, set_default_seed
+    from deeplearning4j_tpu.parallel import (ParallelWrapper,
+                                             ReduceScatterAccumulator)
+
+    def fail(msg, **extra):
+        print(json.dumps({"error": msg, **extra}))
+        sys.exit(1)
+
+    workers = min(workers, len(jax.devices()))
+    if workers < 2:
+        fail("elastic-smoke needs >= 2 devices (virtual CPU device request "
+             "came too late — is jax initialized before bench dispatch?)",
+             devices=len(jax.devices()))
+    rng_np = np.random.RandomState(0)
+    n = steps * batch
+    x = rng_np.randn(n, 1, 28, 28).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng_np.randint(0, 10, n)]
+
+    def make_it():
+        return NDArrayDataSetIterator(x, y, batch_size=batch)
+
+    def build(n_workers):
+        set_default_seed(99)
+        model = _lenet_model()
+        pw = (ParallelWrapper.Builder(model).workers(n_workers)
+              .gradients_accumulator(ReduceScatterAccumulator()).build())
+        return model, pw
+
+    def host_state(model):
+        # owning copies — the same moves resize() makes internally
+        return jax.tree.map(np.array, jax.device_get(
+            (model._params, model._states, model._updater_state,
+             getattr(model, "_acc_state", None) or None)))
+
+    prof = OpProfiler.get()
+    prof.reset()
+    faultinject.clear_plan()
+
+    # --- elastic run: N workers, device loss mid epoch 2, shrink -------
+    m1, pw = build(workers)
+    kill_at = steps + max(1, steps // 2)          # mid epoch 2 of 2
+    faultinject.set_plan(faultinject.FaultPlan(
+        [{"site": "device/loss", "index": kill_at, "kind": "device_loss",
+          "replica": 1}]))
+    try:
+        pw.fit(make_it(), epochs=2, batch_size=batch)
+        fail("device/loss fault plan did not fire", kill_at=kill_at)
+    except faultinject.DeviceLostError:
+        pass
+    faultinject.clear_plan()
+    cursor = (int(m1._epoch - m1._fit_epoch0), int(m1._steps_in_epoch))
+    snap = host_state(m1)
+    it_ep = (m1._iteration, m1._epoch)
+    rng_state = get_random().get_state()
+    removed = pw.resize(workers - 1, lost_replicas=[1])
+    if len(removed) != 1:
+        fail("shrink did not remove exactly the lost device",
+             removed=len(removed))
+    pw.fit(make_it(), epochs=2, batch_size=batch, resume_cursor=cursor)
+    float(m1._score_dev)
+    traces = prof.trace_counts()
+    if traces.get("trace/pw_fit_step") != 2:
+        fail("elastic cycle broke one-compile-per-worker-count",
+             traces=traces)
+
+    # --- reference: fresh (N-1)-worker run from the same state ---------
+    set_default_seed(99)
+    m2 = _lenet_model()
+    params, states, upd, acc = snap
+    m2._params = jax.tree.map(jnp.array, params)
+    m2._states = jax.tree.map(jnp.array, states)
+    m2._updater_state = upd                 # flat: reshards on placement
+    m2._acc_state = acc
+    m2._iteration, m2._epoch = it_ep
+    get_random().set_state(rng_state)
+    pw2 = (ParallelWrapper.Builder(m2).workers(workers - 1)
+           .gradients_accumulator(ReduceScatterAccumulator()).build())
+    pw2.fit(make_it(), epochs=2, batch_size=batch, resume_cursor=cursor)
+    float(m2._score_dev)
+    for name, a, b in (("params", m1._params, m2._params),
+                       ("updater state", m1._updater_state,
+                        m2._updater_state)):
+        la = jax.tree.leaves(jax.device_get(a))
+        lb = jax.tree.leaves(jax.device_get(b))
+        if len(la) != len(lb) or not all(
+                np.array_equal(np.asarray(p), np.asarray(q))
+                for p, q in zip(la, lb)):
+            fail(f"elastic parity break: post-shrink {name} differ from a "
+                 "fresh run resharded at the same step")
+
+    # --- interleaved A/B throughput via cached executables -------------
+    def timed_epoch():
+        t0 = time.perf_counter()
+        pw.fit(make_it(), epochs=1, batch_size=batch)
+        float(m1._score_dev)
+        return time.perf_counter() - t0
+
+    pw.resize(workers)                       # grow back: cached, no compile
+    timed_epoch()
+    pw.resize(workers - 1)
+    timed_epoch()                            # settle rounds, untimed
+    prof.reset()
+    times = {"pre": [], "post": []}
+    ratios = []
+    for r in range(6):
+        pw.resize(workers)
+        t_pre = timed_epoch()
+        pw.resize(workers - 1)
+        t_post = timed_epoch()
+        times["pre"].append(t_pre)
+        times["post"].append(t_post)
+        ratios.append(t_pre / t_post)        # = post/pre throughput ratio
+    hot = prof.trace_counts()
+    if any(hot.values()):
+        fail("resize retraced inside a timed window (executable cache "
+             "miss)", traces=hot)
+    floor = 0.9 * (workers - 1) / workers
+    ratio = _stats.median(ratios)
+    if ratio < floor:
+        fail(f"post-shrink throughput ratio {ratio:.3f} is below the "
+             f"0.9 x (N-1)/N floor {floor:.3f}",
+             pre_times=[round(t, 4) for t in times["pre"]],
+             post_times=[round(t, 4) for t in times["post"]])
+    ledger = prof.elastic_stats()
+    if not ledger.get("resizes") or "workers" not in ledger:
+        fail("elastic ledger did not populate", ledger=ledger)
+
+    t_pre = _stats.median(times["pre"])
+    t_post = _stats.median(times["post"])
+    return {
+        "metric": "elastic_smoke",
+        "value": n / t_post,
+        "unit": "images/sec",
+        "batch": batch,
+        "workers_pre": workers,
+        "workers_post": workers - 1,
+        "platform": jax.devices()[0].platform,
+        "parity": "exact",
+        "shrink_cursor": list(cursor),
+        "traces": traces,
+        "throughput_ratio_post_vs_pre": round(ratio, 4),
+        "throughput_floor": round(floor, 4),
+        "epoch_s_pre_median": round(t_pre, 4),
+        "epoch_s_post_median": round(t_post, 4),
+        "elastic_ledger": {k: (round(v, 5) if isinstance(v, float) else v)
+                           for k, v in ledger.items()},
+        "data": "synthetic LeNet batches; mid-epoch device/loss shrink "
+                "N->N-1 with bitwise parity vs a fresh (N-1)-worker run "
+                "from the same state, then interleaved N/(N-1) epochs "
+                "through the per-worker-count executable cache",
+    }
+
+
 def bench_word2vec(steps: int) -> dict:
     """North-star config 4: Word2Vec skip-gram + negative sampling over a
     synthetic zipfian corpus; throughput = corpus words consumed / sec
@@ -1527,10 +1720,12 @@ def bench_fasttext(n_words: int = 1_000_000) -> dict:
 
 
 def main() -> None:
-    # zero1-smoke needs a multi-replica mesh: request virtual CPU devices
-    # BEFORE anything imports jax (the library import just below does).
-    # The flag only affects the host platform — harmless on TPU runs.
-    if "zero1-smoke" in sys.argv and "jax" not in sys.modules:
+    # zero1-smoke / elastic-smoke need a multi-replica mesh: request
+    # virtual CPU devices BEFORE anything imports jax (the library import
+    # just below does). The flag only affects the host platform —
+    # harmless on TPU runs.
+    if ({"zero1-smoke", "elastic-smoke"} & set(sys.argv)) \
+            and "jax" not in sys.modules:
         _flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in _flags:
             os.environ["XLA_FLAGS"] = (
@@ -1558,7 +1753,7 @@ def main() -> None:
                                  "resnet50-disk", "resnet50-predecoded",
                                  "pipeline-smoke", "telemetry-smoke",
                                  "fault-smoke", "supervisor-smoke",
-                                 "zero1-smoke"])
+                                 "zero1-smoke", "elastic-smoke"])
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--batch", type=int, default=None,
                         help="per-config default: resnet50=128, bert=32")
@@ -1642,6 +1837,8 @@ def main() -> None:
         result = bench_supervisor_smoke(steps, batch=args.batch or 64)
     elif args.config == "zero1-smoke":
         result = bench_zero1_smoke(steps, batch=args.batch or 64)
+    elif args.config == "elastic-smoke":
+        result = bench_elastic_smoke(steps, batch=args.batch or 64)
     elif args.config == "resnet50-disk":
         result = bench_resnet50_disk(steps, batch=args.batch or 64)
     elif args.config == "resnet50-predecoded":

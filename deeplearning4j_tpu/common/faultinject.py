@@ -10,10 +10,11 @@ them a *deterministic, step-indexed* event:
 
 - A :class:`FaultPlan` is a list of fault specs, each bound to a SITE
   (``"pipeline/bind"``, ``"pipeline/place"``, ``"train/step"``,
-  ``"train/wedge"``, ``"supervisor/hang"``, ``"checkpoint/pre_rename"``,
-  ``"inference/worker"``, ``"inference/probe"``) and a zero-based
+  ``"train/wedge"``, ``"device/loss"``, ``"supervisor/hang"``,
+  ``"checkpoint/pre_rename"``, ``"inference/worker"``,
+  ``"inference/probe"``, ``"elastic/probe"``) and a zero-based
   INDEX at that site (batch ordinal within a fit call, checkpoint commit
-  sequence, inference request ordinal, supervisor attempt ordinal).
+  sequence, inference request ordinal, supervisor attempt/probe ordinal).
 - Instrumented code calls :func:`fault_point(site, index)` at the matching
   place. Raising kinds (``transient``, ``crash``, ``dead_replica``) raise
   there; ``slow`` sleeps in place; advisory kinds (``nan``) are returned
@@ -29,7 +30,11 @@ retry tests use ``times: 2`` to fail two attempts then recover),
 ``seconds`` (``slow``; for ``wedge`` the block's timeout ceiling),
 ``mode`` (``crash``: ``"raise"`` raises :class:`SimulatedCrash`,
 ``"exit"`` hard-kills the process via ``os._exit`` — the no-cleanup
-death a preempted worker sees), ``code`` (exit status, default 137).
+death a preempted worker sees), ``code`` (exit status, default 137),
+``replica`` (``device_loss``: which data-axis replica died — the
+step-indexed ``device/loss`` site raises :class:`DeviceLostError`
+carrying it, the deterministic input to the supervisor's online
+shrink-and-continue path).
 
 The ``wedge`` kind simulates a HUNG dispatch (a wedged device, a
 deadlocked collective): the calling thread blocks until
@@ -73,6 +78,21 @@ class SimulatedCrash(BaseException):
 class DeadReplicaFault(RuntimeError):
     """An inference replica dying mid-request (wedged device, OOM-killed
     worker). ParallelInference retires the worker that sees one."""
+
+
+class DeviceLostError(RuntimeError):
+    """A data-parallel TRAINING replica's device disappeared mid-run (ICE
+    link down, chip fault, host eviction of one accelerator). Unlike
+    :class:`SimulatedCrash` (the whole process dies) the surviving
+    replicas — and the holder's dispatch-boundary state — are intact, so
+    the supervisor's ``shrink_and_continue`` policy can resize the data
+    axis online instead of checkpoint-restarting. ``replica`` names the
+    lost data-axis index when known (the injected ``device_loss`` kind
+    carries it from the fault spec; real XLA failures usually don't)."""
+
+    def __init__(self, message: str, replica: Optional[int] = None):
+        super().__init__(message)
+        self.replica = replica
 
 
 class WedgeReleased(BaseException):
@@ -222,6 +242,15 @@ def fault_point(site: str, index: Optional[int] = None) -> List[Dict[str, Any]]:
         elif kind == "dead_replica":
             raise DeadReplicaFault(
                 f"injected replica death at {site}[{index}]")
+        elif kind == "device_loss":
+            # step-indexed, names a replica: the deterministic elastic
+            # drill (site "device/loss" in the dispatch loop; the
+            # supervisor's shrink-and-continue consumes .replica)
+            rep = spec.get("replica")
+            raise DeviceLostError(
+                f"injected device loss at {site}[{index}]"
+                + (f" (replica {rep})" if rep is not None else ""),
+                replica=rep)
         elif kind == "crash":
             if spec.get("mode", "raise") == "exit":
                 os._exit(int(spec.get("code", 137)))

@@ -94,6 +94,13 @@ class OpProfiler:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + n
 
+    def gauge(self, name: str, value: int) -> None:
+        """Set a counter to an absolute value (last-write-wins) — for
+        level quantities like the live elastic worker count, where adding
+        would be meaningless."""
+        with self._lock:
+            self._counters[name] = int(value)
+
     def counter_value(self, name: str) -> int:
         return self._counters.get(name, 0)
 
@@ -197,6 +204,24 @@ class OpProfiler:
             out["encoded_density"] = sent / total
             out["encoded_bytes_est"] = int(min(4 * sent, total // 4))
             out["encoded_dense_bytes_equiv"] = int(4 * total)
+        return out
+
+    def elastic_stats(self) -> Dict[str, float]:
+        """Online-resize ledger (``elastic/*`` counters): resizes split
+        into shrinks/grows, grow-back probe attempts and failures, the
+        live ``workers`` gauge, plus the resize wall-time section — the
+        /api/health and elastic-smoke view of what the elastic data axis
+        actually did. Empty until a parallel fit runs (every parallel fit
+        sets the ``workers`` gauge — the live data-axis width is a level,
+        not an elastic event); resize/probe counters appear only after an
+        actual elastic event."""
+        out: Dict[str, float] = {
+            k.split("/", 1)[1]: v for k, v in self._counters.items()
+            if k.startswith("elastic/")}
+        s = self._sections.get("elastic/resize")
+        if s:
+            out["resize_s"] = s["total_s"]
+            out["resize_count"] = s["count"]
         return out
 
     def fault_stats(self) -> Dict[str, float]:

@@ -261,10 +261,13 @@ def run_epochs(data: Any, epochs: int, batch_size: Optional[int],
     bounded exponential backoff, profiler-counted under
     ``pipeline/retries``. Fault-plan sites fire here deterministically:
     ``pipeline/bind`` (indexed by the fit call's batch ordinal; advisory
-    ``nan`` specs poison the bound batch), ``pipeline/place``, and
+    ``nan`` specs poison the bound batch), ``pipeline/place``,
     ``train/step`` (indexed by dispatch ordinal; a ``crash`` spec raises
     :class:`faultinject.SimulatedCrash` before the step dispatches — the
-    in-process stand-in for preemption).
+    in-process stand-in for preemption), and ``device/loss`` (same
+    indexing; a ``device_loss`` spec raises
+    :class:`faultinject.DeviceLostError` naming the lost replica — the
+    deterministic elastic shrink-and-continue drill).
 
     **Resume** (``skip=(epochs_done, steps_in_epoch)``): fast-forward a
     checkpoint cursor by REPLAYING the host side — completed epochs are
@@ -341,8 +344,13 @@ def run_epochs(data: Any, epochs: int, batch_size: Optional[int],
             for b in feed:
                 faultinject.fault_point("train/step", n_dispatched)
                 # a wedge here is a hung dispatch: the thread blocks until
-                # the supervisor's watchdog abandons it (release_wedges)
+                # the supervisor's watchdog abandons it (release_wedges);
+                # a device_loss here is a replica dying BETWEEN dispatches
+                # — the holder's state stays boundary-consistent, which is
+                # what lets the supervisor shrink the data axis online
+                # instead of checkpoint-restarting
                 faultinject.fault_point("train/wedge", n_dispatched)
+                faultinject.fault_point("device/loss", n_dispatched)
                 n_dispatched += 1
                 dispatch_one(b)
         else:
@@ -350,6 +358,7 @@ def run_epochs(data: Any, epochs: int, batch_size: Optional[int],
                 for j in range(len(group)):
                     faultinject.fault_point("train/step", n_dispatched + j)
                     faultinject.fault_point("train/wedge", n_dispatched + j)
+                    faultinject.fault_point("device/loss", n_dispatched + j)
                 n_dispatched += len(group)
                 if len(group) == k and stackable(group):
                     dispatch_chunk(group)

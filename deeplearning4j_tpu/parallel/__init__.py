@@ -1,4 +1,5 @@
-from .mesh import make_mesh, replicated, data_sharded, shard_batch
+from .mesh import (make_mesh, replicated, data_sharded, shard_batch,
+                   elastic_pool)
 from .accumulator import (GradientsAccumulator, DenseAllReduceAccumulator,
                           EncodedGradientsAccumulator,
                           ReduceScatterAccumulator, ThresholdAlgorithm,
@@ -11,7 +12,8 @@ from .inference import ParallelInference
 from .distributed import (SharedTrainingMaster, TrainingSupervisor,
                           SupervisedFitResult, RestartBudgetExceeded,
                           RestartStorm, Preempted, HangDetected,
-                          AbandonedAttempt, classify_failure,
+                          AbandonedAttempt, ElasticResizeRequested,
+                          classify_failure,
                           supervise_processes, initialize, shutdown)
 from .ring_attention import ring_attention, ring_self_attention
 from .sharded_embeddings import ShardedEmbedding

@@ -76,6 +76,14 @@ class GradientsAccumulator:
         return (self.reduce_gradients(grads), state,
                 jnp.asarray(1.0, jnp.float32))
 
+    def resize_state(self, state, old_n: int, new_n: int,
+                     lost_replicas=()):
+        """Carry accumulator state through an ONLINE elastic resize of
+        the data axis (host-side, dispatch boundary). Stateless
+        accumulators pass through; stateful ones override to remap their
+        per-replica leaves (see ``EncodedGradientsAccumulator``)."""
+        return state
+
 
 class DenseAllReduceAccumulator(GradientsAccumulator):
     """Mean all-reduce over the data axis (ICI collective)."""
@@ -183,9 +191,12 @@ class EncodedGradientsAccumulator(DenseAllReduceAccumulator):
     fallback above 1/16 density — the ledger's byte estimate). Over ICI
     the dense default is strictly faster; the wrapper runs this path with
     a dense psum of the thresholded tensor, which is mathematically the
-    decoded exchange. Residuals are PER-REPLICA state: a resume that
-    changes the worker count resets them (warned), everything else —
-    threshold, ledger counters — carries over exactly.
+    decoded exchange. Residuals are PER-REPLICA state: an ONLINE elastic
+    resize carries them (survivors keep theirs, lost rows fold into a
+    survivor — see :meth:`resize_state` for the numerics), while a
+    cross-worker-count checkpoint RESTORE still resets them (warned);
+    everything else — threshold, ledger counters — carries over exactly
+    in both cases.
     """
 
     stateful = True
@@ -227,6 +238,61 @@ class EncodedGradientsAccumulator(DenseAllReduceAccumulator):
             "elems_sum": P(),
             "steps": P(),
         }
+
+    def resize_state(self, state, old_n: int, new_n: int,
+                     lost_replicas=()):
+        """Carry the residual error-feedback state through an online
+        elastic resize (host-side numpy, dispatch boundary).
+
+        Shrink: the surviving replicas keep their residuals (compacted to
+        the new leading axis) and every LOST replica's residual is FOLDED
+        into the first survivor — one elementwise add per lost row, so no
+        gradient mass is silently dropped (the pre-elastic behavior reset
+        residuals, discarding it). Numerics: the total pending mass
+        ``Σᵢ rᵢ`` is preserved exactly (the fold is a plain float add of
+        the lost rows onto survivor 0); what changes is its *distribution*
+        across replicas, which only affects WHICH elements of survivor
+        0's next update cross the encode threshold — the same class of
+        per-replica perturbation a reshuffled data order produces, and
+        bounded by the threshold like any other residual. Grow: survivors
+        keep their rows, joining replicas start with a zero residual
+        (exactly a fresh replica's state). Threshold and ledger counters
+        are replicated scalars and carry over bit-exactly either way.
+
+        Cross-worker-count CHECKPOINT restores (no resize — a different
+        process picked different N) still reset residuals with a warning:
+        there the lost rows' owners never existed in the new run, so a
+        fold would mis-attribute mass with no continuity argument."""
+        import numpy as np
+
+        if not (isinstance(state, dict) and "residual" in state):
+            return state
+        lost = sorted({int(r) for r in (lost_replicas or ())})
+        old_n, new_n = int(old_n), int(new_n)
+
+        def remap(r):
+            r = np.asarray(r)
+            if r.ndim < 1 or r.shape[0] != old_n:
+                return np.zeros((new_n,) + tuple(r.shape[1:]), r.dtype)
+            keep = [i for i in range(old_n) if i not in lost]
+            # shrink below the survivor count (no explicit loss list, or
+            # an n smaller than old_n - len(lost)): fold the tail too
+            fold = lost + keep[new_n:]
+            keep = keep[:new_n]
+            out = np.zeros((new_n,) + tuple(r.shape[1:]), r.dtype)
+            if keep:
+                out[:len(keep)] = r[keep]
+            if fold:
+                # row 0 is the first survivor — or, when every old row
+                # was lost (all replicas replaced by spares), the first
+                # JOINING replica: either way the total pending mass
+                # Σᵢ rᵢ is preserved, never silently dropped
+                out[0] = out[0] + r[fold].sum(axis=0)
+            return out
+
+        st = dict(state)
+        st["residual"] = jax.tree.map(remap, state["residual"])
+        return st
 
     def exchange(self, grads, state, axis_name: str):
         thr = state["threshold"]

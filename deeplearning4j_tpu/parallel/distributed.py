@@ -106,11 +106,17 @@ CLASS_USER = "user_error"
 #: raise mode — the *skip* policy for poisoned numerics is its in-graph
 #: job, and user/config errors are deterministic: restarting cannot
 #: help); "exit" is the preemption path — flush-quality checkpoint, then
-#: a clean return with a resumable status.
+#: a clean return with a resumable status; "shrink_and_continue" (the
+#: device-failure default) resizes the elastic data axis over the
+#: surviving devices and continues IN MEMORY from the exact dispatch
+#: boundary — no disk, no replay — falling back to checkpoint-restart
+#: whenever the target cannot resize, the lost replica cannot be
+#: identified, or the holder's in-memory state is not
+#: boundary-consistent.
 DEFAULT_POLICIES: Dict[str, str] = {
     CLASS_TRANSIENT: "retry",
     CLASS_NUMERIC: "raise",
-    CLASS_DEVICE: "restart",
+    CLASS_DEVICE: "shrink_and_continue",
     CLASS_HANG: "restart",
     CLASS_PREEMPTION: "exit",
     CLASS_USER: "raise",
@@ -122,6 +128,16 @@ class Preempted(BaseException):
     listener, at a dispatch boundary) when a preemption signal arrived.
     BaseException so user ``except Exception`` recovery cannot swallow
     the shutdown request."""
+
+
+class ElasticResizeRequested(BaseException):
+    """Raised inside the training thread (by the supervisor's heartbeat
+    listener, at a dispatch boundary) when a grow-back probe found the
+    lost device healthy again: the fit unwinds with boundary-consistent
+    state, the supervisor resizes the data axis back up, and training
+    continues in memory from the same cursor. BaseException for the same
+    reason as :class:`Preempted` — user recovery code must not swallow
+    the control transfer."""
 
 
 class HangDetected(RuntimeError):
@@ -163,7 +179,8 @@ def classify_failure(exc: Optional[BaseException]) -> str:
     if isinstance(exc, FloatingPointError):
         return CLASS_NUMERIC
     if isinstance(exc, (faultinject.SimulatedCrash,
-                        faultinject.WedgeReleased)):
+                        faultinject.WedgeReleased,
+                        faultinject.DeviceLostError)):
         return CLASS_DEVICE
     if isinstance(exc, (TypeError, ValueError, KeyError, AttributeError,
                         IndexError, NotImplementedError, AssertionError)):
@@ -243,10 +260,17 @@ class _Heartbeat:
         self.steps += 1
         self.last_beat = time.monotonic()
         sup = self._sup
-        if sup._preempt.is_set() and \
-                getattr(model, "_at_dispatch_boundary", True):
+        boundary = getattr(model, "_at_dispatch_boundary", True)
+        if sup._preempt.is_set() and boundary:
             raise Preempted(
                 f"preemption signal {sup._preempt_signal} received")
+        if sup._resize_request is not None and boundary:
+            # a returning device rejoins HERE — the next dispatch
+            # boundary after the probe succeeded (the fit unwinds with
+            # published state complete; the supervisor resizes and
+            # continues in memory from this exact cursor)
+            raise ElasticResizeRequested(
+                f"grow data axis back to {sup._resize_request} workers")
 
     def epoch_done(self, model, epoch: int) -> None:
         self.last_beat = time.monotonic()
@@ -358,7 +382,11 @@ class TrainingSupervisor:
                  poll_s: float = 0.05,
                  preempt_grace_s: float = 10.0,
                  handle_signals: Optional[bool] = None,
-                 policies: Optional[Dict[str, str]] = None):
+                 policies: Optional[Dict[str, str]] = None,
+                 elastic_grow: bool = True,
+                 grow_probe_base_s: float = 2.0,
+                 grow_probe_max_s: float = 60.0,
+                 grow_failure_limit: int = 5):
         self.target = target
         self.holder = target if hasattr(target, "_params") else target.model
         self.dir = checkpoint_dir
@@ -384,11 +412,25 @@ class TrainingSupervisor:
         self.handle_signals = handle_signals
         self.policies = dict(DEFAULT_POLICIES)
         self.policies.update(policies or {})
+        # elastic grow-back: after a shrink-and-continue, probe the lost
+        # device(s) with exponential backoff (mirroring the inference
+        # replica resurrection machinery) and rejoin them at the next
+        # dispatch boundary when healthy
+        self.elastic_grow = elastic_grow
+        self.grow_probe_base_s = grow_probe_base_s
+        self.grow_probe_max_s = grow_probe_max_s
+        # consecutive failed grow RESIZES (probe-healthy device, resize
+        # raises) before abandoning grow-back and staying shrunk — each
+        # failed grow unwinds training, so it cannot retry forever
+        self.grow_failure_limit = grow_failure_limit
         self.incarnation: Optional[int] = None
         self._preempt = threading.Event()
         self._preempt_signal: Optional[int] = None
         self._fence = _AttemptFence()
         self._old_handlers: Dict[int, Any] = {}
+        self._grow: Optional[Dict[str, Any]] = None
+        self._resize_request: Optional[int] = None
+        self._probe_ordinal = 0
 
     # --- signals --------------------------------------------------------
     def _install_signals(self) -> None:
@@ -425,6 +467,140 @@ class TrainingSupervisor:
                 pass
         self._old_handlers = {}
 
+    # --- elastic shrink / grow ------------------------------------------
+    def _cursor_of(self) -> tuple:
+        """The holder's live pipeline cursor — the exact dispatch
+        boundary an in-memory continuation resumes from."""
+        h = self.holder
+        e0 = int(getattr(h, "_fit_epoch0", getattr(h, "_epoch", 0)))
+        return (int(getattr(h, "_epoch", 0)) - e0,
+                int(getattr(h, "_steps_in_epoch", 0)))
+
+    def _holder_state_intact(self) -> bool:
+        """True when the holder's published state is usable for an
+        in-memory continuation: it sits at a dispatch boundary and no
+        leaf was donated away (a failure INSIDE a dispatch leaves the
+        pre-step buffers deleted — checkpoint-restart owns that case)."""
+        import jax
+
+        h = self.holder
+        if not getattr(h, "_at_dispatch_boundary", True):
+            return False
+        try:
+            leaves = jax.tree.leaves(
+                (h._params, h._states, h._updater_state,
+                 getattr(h, "_acc_state", None)))
+        except Exception:
+            return False
+        return not any(isinstance(l, jax.Array) and l.is_deleted()
+                       for l in leaves)
+
+    def _shrink_plan(self, exc: BaseException) -> Optional[List[int]]:
+        """Which replicas to drop for shrink-and-continue, or None to
+        fall back to checkpoint-restart. A :class:`DeviceLostError`
+        names its replica; any other device-class failure is
+        ground-truthed by probing the mesh — an exception that merely
+        LOOKS like a device failure must not shrink a healthy axis."""
+        t = self.target
+        if not callable(getattr(t, "resize", None)) \
+                or getattr(t, "model_axis", 1) != 1:
+            return None
+        n = int(getattr(t, "workers_count", 0))
+        if n <= 1 or not self._holder_state_intact():
+            return None
+        if isinstance(exc, faultinject.DeviceLostError) \
+                and exc.replica is not None:
+            lost = [int(exc.replica)]
+        else:
+            # a DeviceLostError without a replica id (real XLA failures
+            # usually don't carry one) is ground-truthed the same way as
+            # any other device-class failure: probe the mesh — guessing
+            # could evict a healthy replica and keep the dead device
+            probe = getattr(t, "probe_replicas", None)
+            lost = list(probe()) if callable(probe) else []
+        lost = sorted({r for r in lost if 0 <= r < n})
+        if not lost or len(lost) >= n:
+            return None
+        return lost
+
+    def _apply_shrink(self, lost: List[int]) -> Optional[List[Any]]:
+        """Resize the target's data axis over the survivors; arm the
+        grow-back probe. Returns the removed devices, or None when the
+        resize itself failed (caller falls back to checkpoint-restart)."""
+        t = self.target
+        old = int(t.workers_count)
+        new = old - len(lost)
+        try:
+            removed = t.resize(new, lost_replicas=lost)
+        except Exception:
+            logger.warning("supervisor: online shrink to %d workers "
+                           "failed; falling back to checkpoint-restart",
+                           new, exc_info=True)
+            return None
+        logger.warning("supervisor: device loss — shrank the data axis "
+                       "%d -> %d (lost replicas %s); continuing in "
+                       "memory from the dispatch boundary", old, new, lost)
+        # a grow-back armed BEFORE this loss must not fire now: growing
+        # would reinstate a cached mesh that contains the newly-dead
+        # device — the merged probe below re-verifies EVERY lost device
+        # before any grow happens
+        self._resize_request = None
+        if self.elastic_grow and removed:
+            g = self._grow
+            if g is None:
+                self._grow = {"target": old, "devices": list(removed),
+                              "delay": self.grow_probe_base_s,
+                              "next": (time.monotonic()
+                                       + self.grow_probe_base_s)}
+            else:
+                # a SECOND loss while the first grow-back is pending:
+                # merge — probe every lost device, keep the original full
+                # count as the target (growing back means all the way)
+                g["devices"].extend(d for d in removed
+                                    if d not in g["devices"])
+                g["target"] = max(int(g["target"]), old)
+                g["failures"] = 0
+                g["delay"] = self.grow_probe_base_s
+                g["next"] = time.monotonic() + self.grow_probe_base_s
+        return removed
+
+    def _maybe_probe_grow(self) -> None:
+        """Grow-back probe with exponential backoff, run from the monitor
+        loop. Success arms ``_resize_request``; the heartbeat turns it
+        into an :class:`ElasticResizeRequested` at the next dispatch
+        boundary. The ``elastic/probe`` fault site makes drills
+        deterministic (a raising spec = the device is still dead)."""
+        g = self._grow
+        if g is None or self._resize_request is not None:
+            return
+        now = time.monotonic()
+        if now < g["next"]:
+            return
+        prof = OpProfiler.get()
+        prof.count("elastic/probes")
+        ordinal = self._probe_ordinal
+        self._probe_ordinal += 1
+        try:
+            faultinject.fault_point("elastic/probe", ordinal)
+            healthy = self._devices_healthy(g["devices"])
+        except Exception:
+            healthy = False
+        if healthy:
+            logger.warning("supervisor: lost device(s) answer probes "
+                           "again — growing the data axis back to %d at "
+                           "the next dispatch boundary", g["target"])
+            self._resize_request = int(g["target"])
+        else:
+            prof.count("elastic/probe_failures")
+            g["delay"] = min(g["delay"] * 2.0, self.grow_probe_max_s)
+            g["next"] = now + g["delay"]
+
+    @staticmethod
+    def _devices_healthy(devices) -> bool:
+        from .mesh import probe_device
+
+        return all(probe_device(d) for d in devices)
+
     # --- monitoring -----------------------------------------------------
     def _monitor(self, run: _Attempt) -> str:
         """Watch one attempt: returns ``"done"`` (thread finished, clean
@@ -439,6 +615,7 @@ class TrainingSupervisor:
         while True:
             if run.done.wait(self.poll_s):
                 return "done"
+            self._maybe_probe_grow()
             now = time.monotonic()
             if self._preempt.is_set():
                 if grace_deadline is None:
@@ -506,10 +683,17 @@ class TrainingSupervisor:
         entry_rng = get_random().get_state()
         self._preempt.clear()
         self._preempt_signal = None
+        self._grow = None
+        self._resize_request = None
+        self._probe_ordinal = 0
         self._install_signals()
         history: List[dict] = []
         restarts = 0
         consec_no_progress = 0
+        # armed by a successful shrink/grow: (pipeline cursor, rng state)
+        # for an IN-MEMORY continuation — the next attempt resumes from
+        # the holder's live state instead of a checkpoint
+        mem_resume: Optional[tuple] = None
         status = "completed"
         resume_path: Optional[str] = None
         final_exc: Optional[BaseException] = None
@@ -558,11 +742,21 @@ class TrainingSupervisor:
                     # just before the crash should not be replayed past
                     ckpt.close()
                     ckpt = new_attempt_listener()
-                    resume_from = _ckpt.last_checkpoint(self.dir)
+                    # in-memory continuation (post-shrink/grow): the
+                    # holder IS the resume point — no checkpoint restore
+                    resume_from = (None if mem_resume is not None
+                                   else _ckpt.last_checkpoint(self.dir))
                     if make_data:
                         src = make_data()
                     elif source_state is not None:
                         src.restore_source_state(source_state)
+                attempt_kwargs = fit_kwargs
+                attempt_rng = entry_rng
+                if mem_resume is not None:
+                    cursor, rng_state = mem_resume
+                    mem_resume = None
+                    attempt_kwargs = dict(fit_kwargs, resume_cursor=cursor)
+                    attempt_rng = rng_state
                 heartbeat = _Heartbeat(self)
                 # arrangement: the fence first (kills zombie threads
                 # before ANY listener sees their callbacks), user
@@ -573,12 +767,72 @@ class TrainingSupervisor:
                 self.target.set_listeners(self._fence, *user_listeners,
                                           ckpt, heartbeat)
                 run = _Attempt(self, attempt, src, epochs, resume_from,
-                               fit_kwargs, entry_rng, heartbeat)
+                               attempt_kwargs, attempt_rng, heartbeat)
                 self._fence.thread = run.thread
                 run.start()
                 outcome = self._monitor(run)
                 if outcome == "done" and run.error is None:
                     break
+                if outcome == "done" and \
+                        isinstance(run.error, ElasticResizeRequested):
+                    # grow-back: the probe found the lost device healthy
+                    # and the attempt unwound at a dispatch boundary —
+                    # resize up and continue in memory from that cursor
+                    target_n = self._resize_request
+                    self._resize_request = None
+                    grown = False
+                    if target_n:
+                        try:
+                            self.target.resize(int(target_n))
+                            grown = True
+                            self._grow = None
+                            prof.count("supervisor/grows")
+                            logger.warning("supervisor: data axis grown "
+                                           "back to %d workers", target_n)
+                        except Exception:
+                            g = self._grow
+                            fails = (g.get("failures", 0) + 1
+                                     if g is not None else 1)
+                            if g is not None and \
+                                    fails >= self.grow_failure_limit:
+                                # the device answers probes but the grow
+                                # resize keeps failing (e.g. it returned
+                                # degraded, placement OOMs): give up and
+                                # stay shrunk rather than unwinding
+                                # training every backoff period forever
+                                logger.warning(
+                                    "supervisor: grow-back resize to %s "
+                                    "failed %d times; giving up — "
+                                    "staying shrunk", target_n, fails,
+                                    exc_info=True)
+                                self._grow = None
+                                prof.count("elastic/grow_abandoned")
+                            else:
+                                logger.warning(
+                                    "supervisor: grow-back resize to %s "
+                                    "failed; staying shrunk and "
+                                    "re-arming the probe", target_n,
+                                    exc_info=True)
+                                if g is not None:
+                                    g["failures"] = fails
+                                    g["delay"] = min(
+                                        g["delay"] * 2.0,
+                                        self.grow_probe_max_s)
+                                    g["next"] = (time.monotonic()
+                                                 + g["delay"])
+                    history.append({
+                        "attempt": attempt, "class": "elastic_grow",
+                        "policy": ("grow_and_continue" if grown
+                                   else "grow_failed"),
+                        "error": repr(run.error),
+                        "steps": run.heartbeat.steps,
+                        "iteration": int(getattr(self.holder,
+                                                 "_iteration", 0)),
+                    })
+                    consec_no_progress = 0
+                    mem_resume = (self._cursor_of(),
+                                  run.rng_state or entry_rng)
+                    continue
                 watchdogged = outcome == "hang"
                 if watchdogged:
                     exc: BaseException = HangDetected(
@@ -590,6 +844,15 @@ class TrainingSupervisor:
                         f"attempt abandoned ({outcome})")
                 cls = CLASS_HANG if watchdogged else classify_failure(exc)
                 policy = self.policies.get(cls, "restart")
+                shrink_lost: Optional[List[int]] = None
+                if policy == "shrink_and_continue":
+                    # only a finished (non-abandoned) attempt left a
+                    # trustworthy dispatch-boundary state behind; a
+                    # wedged zombie might still be mutating the holder
+                    if outcome == "done" and not run.abandoned:
+                        shrink_lost = self._shrink_plan(exc)
+                    if shrink_lost is None:
+                        policy = "restart"   # the documented fallback
                 history.append({
                     "attempt": attempt, "class": cls, "policy": policy,
                     "error": repr(exc), "steps": run.heartbeat.steps,
@@ -619,6 +882,27 @@ class TrainingSupervisor:
                 if policy == "raise":
                     final_exc = exc
                     break
+                if policy == "shrink_and_continue":
+                    removed = self._apply_shrink(shrink_lost)
+                    if removed is None:
+                        # the resize itself failed — the documented
+                        # fallback (the plan already vetted everything
+                        # else, so this is rare: e.g. a survivor died
+                        # between plan and resize)
+                        history[-1]["policy"] = "shrink_failed_restart"
+                        policy = "restart"
+                    else:
+                        prof.count("supervisor/shrinks")
+                        # restart-budget accounting: a successful online
+                        # shrink IS progress — the axis is healthy again
+                        # and training continues from the same boundary —
+                        # so it consumes no restart and resets the storm
+                        # breaker: a single device loss can never
+                        # contribute to a RestartStorm trip
+                        consec_no_progress = 0
+                        mem_resume = (self._cursor_of(),
+                                      run.rng_state or entry_rng)
+                        continue
                 # checkpoint-restart
                 if cls == CLASS_PREEMPTION:
                     # a preemption override routed here: consume the

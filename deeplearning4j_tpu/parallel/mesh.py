@@ -32,6 +32,33 @@ def make_mesh(data: Optional[int] = None, model: int = 1,
     return Mesh(arr, ("data", "model"))
 
 
+def elastic_pool(mesh: Mesh, exclude: Sequence = (),
+                 devices: Optional[Sequence] = None) -> list:
+    """Device pool for an online elastic resize: the current mesh's
+    SURVIVING devices first (growing back reuses the positions — and the
+    per-worker-count compiled executables — the survivors already hold),
+    then every other available device (hot spares, a returning device),
+    with ``exclude`` (the lost devices) filtered throughout."""
+    excl = set(exclude)
+    pool = [d for d in mesh.devices.flat if d not in excl]
+    for d in (devices if devices is not None else jax.devices()):
+        if d not in excl and d not in pool:
+            pool.append(d)
+    return pool
+
+
+def probe_device(device) -> bool:
+    """Tiny host→device→host round-trip health probe: True when the
+    device accepts a placement and hands back finite data. The single
+    ground-truth check behind both the wrapper's ``probe_replicas`` and
+    the supervisor's grow-back probe."""
+    try:
+        x = jax.device_put(np.ones((2,), np.float32), device)
+        return bool(np.isfinite(float(np.asarray(x).sum())))
+    except Exception:
+        return False
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

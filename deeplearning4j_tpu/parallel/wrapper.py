@@ -28,14 +28,18 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
+import logging
+
 from ..common.profiler import OpProfiler
 from ..data import pipeline as _pipe
 from ..data.dataset import DataSet
 from ..ndarray.rng import get_random
 from ..nn.multilayer import _same_shapes
 from .accumulator import DenseAllReduceAccumulator, GradientsAccumulator
-from .mesh import make_mesh, shard_batch
+from .mesh import elastic_pool, make_mesh, probe_device, shard_batch
 from .sharding import Zero1Plan, is_flat_state
+
+logger = logging.getLogger("deeplearning4j_tpu")
 
 
 class ParallelWrapper:
@@ -109,6 +113,12 @@ class ParallelWrapper:
         self._telemetry = None
         self._listeners: List[Any] = []
         self._zero1_plan = None
+        # per-worker-count compiled artifacts (step, chunk step, plan,
+        # mesh), stashed/restored by resize(): growing back to a count
+        # already trained at must NOT recompile — the elastic contract is
+        # one compile per worker count per fit config
+        self._exec_cache: dict = {}
+        self._lost_devices: set = set()   # once-lost, not yet probed healthy
         self._coll_bytes: dict = {}       # static bytes per collective kind
         self._drained_encoded = (0.0, 0.0, 0)   # nnz/elems/steps last drain
 
@@ -131,6 +141,7 @@ class ParallelWrapper:
             self._telemetry = cfg
             self._step = None
             self._chunk_step = None
+            self._exec_cache.clear()   # telemetry is baked into the steps
 
     # ------------------------------------------------------------------
     def _local_core(self):
@@ -500,6 +511,12 @@ class ParallelWrapper:
         else:
             model._acc_state = {}
 
+        # the live worker count rides checkpoints (resume.json) and the
+        # elastic health gauge — an elastic run's resume metadata must
+        # say how many replicas were actually training
+        model._live_workers = self.workers_count
+        OpProfiler.get().gauge("elastic/workers", self.workers_count)
+
         # static per-step collective byte ledger (gradient exchange only)
         param_bytes = int(sum(l.size * np.dtype(l.dtype).itemsize
                               for l in jax.tree.leaves(model._params)))
@@ -563,6 +580,144 @@ class ParallelWrapper:
                                np.dtype(p.dtype)), self.model._params)
         return st
 
+    # ------------------------------------------------------------------
+    # online elastic resize (shrink/grow the data axis, no restart)
+    # ------------------------------------------------------------------
+    def resize(self, workers: int, *, lost_replicas=None) -> List[Any]:
+        """Online elastic resize of the data axis at a DISPATCH BOUNDARY:
+        rebuild the mesh over ``workers`` devices and re-shard the
+        training state in memory — no process restart, no disk.
+
+        The state moves are exact by construction: params and layer
+        states are replicated (a host-owning copy re-placed by the next
+        dispatch), ZeRO-1 flat updater/param buckets reshard through
+        ``Zero1Plan``'s replica-count-independent permutation layout (the
+        same guarantee as checkpoint resharding — only the zero pad tail
+        changes), and the encoded accumulator's per-replica residuals are
+        carried through ``resize_state`` (shrink folds the lost replica's
+        residual into a survivor so no gradient mass is dropped).
+        Compiled steps are stashed per worker count, so a grow-back to a
+        count already trained at reuses its executable — one compile per
+        worker count, total.
+
+        Consistency model: a resize can observe a partially-applied step
+        NEVER. It must only run between dispatches (or after a fit
+        unwound at a step boundary), where the holder's published state
+        is the complete output of the last compiled step; an in-flight
+        ``steps_per_dispatch`` chunk either completes or is abandoned
+        wholesale, and the pipeline cursor (`epochs_done`,
+        ``steps_in_epoch``) names the exact batch to continue from — pass
+        it back through ``fit(resume_cursor=...)``.
+
+        ``lost_replicas``: data-axis indices of replicas whose device is
+        gone (from :class:`faultinject.DeviceLostError` or a probe);
+        their devices are excluded from the new mesh and remembered
+        ACROSS calls — a later resize (even to a cached worker count)
+        re-probes every once-lost device and only lets it rejoin after it
+        answers, so a stashed mesh can never silently reinstate a
+        still-dead device. Returns the devices removed — the supervisor's
+        grow-back probe targets.
+        """
+        n = int(workers)
+        if n < 1:
+            raise ValueError(f"workers must be >= 1, got {workers}")
+        if self.model_axis != 1:
+            raise NotImplementedError(
+                "online elastic resize is a data-axis operation; it does "
+                "not compose with model_axis/table_sharding yet")
+        old_n = self.workers_count
+        lost = sorted({int(r) for r in (lost_replicas or ())})
+        if any(r < 0 or r >= old_n for r in lost):
+            raise ValueError(f"lost_replicas {lost} out of range for "
+                             f"{old_n} workers")
+        if n == old_n and not lost:
+            return []
+        prof = OpProfiler.get()
+        model = self.model
+        with prof.time_section("elastic/resize"):
+            # 1) host-materialize the training state with OWNING copies —
+            # the compiled steps donate their argument buffers, and on
+            # the CPU backend device_get returns zero-copy views (the
+            # PR-3 heap-corruption lesson)
+            params, states, upd, acc = jax.tree.map(
+                np.array, jax.device_get(
+                    (model._params, model._states, model._updater_state,
+                     getattr(model, "_acc_state", None) or None)))
+            # 2) per-replica accumulator state rides the permutation too
+            if acc is not None:
+                acc = self.accumulator.resize_state(acc, old_n, n,
+                                                    lost_replicas=lost)
+            # 3) stash this count's compiled artifacts, then reuse or
+            # rebuild the target count's mesh
+            mesh_devs = list(self.mesh.devices.flat)
+            lost_devs = [mesh_devs[r] for r in lost]
+            if self._step is not None or self._chunk_step is not None:
+                self._exec_cache[old_n] = {
+                    "step": self._step, "chunk": self._chunk_step,
+                    "plan": self._zero1_plan, "mesh": self.mesh}
+            # once-lost devices are remembered ACROSS calls and re-probed
+            # here: a later resize must not silently reinstate a
+            # still-dead device from a stashed mesh; a device that
+            # answers the probe again is healthy and may rejoin (keeping
+            # grow-back on the zero-recompile cached path)
+            self._lost_devices = {d for d in self._lost_devices
+                                  if not probe_device(d)}
+            self._lost_devices |= set(lost_devs)
+            excl = set(lost_devs) | self._lost_devices
+            cached = self._exec_cache.get(n)
+            if cached is not None and not (
+                    excl & set(cached["mesh"].devices.flat)):
+                self.mesh = cached["mesh"]
+                self._step = cached["step"]
+                self._chunk_step = cached["chunk"]
+                self._zero1_plan = cached["plan"]
+            else:
+                pool = elastic_pool(self.mesh, exclude=excl)
+                if n > len(pool):
+                    raise ValueError(
+                        f"resize to {n} workers needs {n} devices; only "
+                        f"{len(pool)} are available")
+                self.mesh = make_mesh(data=n, model=1, devices=pool[:n])
+                self._step = None
+                self._chunk_step = None
+                self._zero1_plan = None
+                self._exec_cache.pop(n, None)
+            # every old-mesh device NOT in the new mesh left the axis —
+            # the named lost devices, plus the tail a shrink without an
+            # explicit loss list drops (grow-back probes target them all)
+            new_devs = set(self.mesh.devices.flat)
+            removed = [d for d in mesh_devs if d not in new_devs]
+            self.workers_count = n
+            # 4) hand the host state back: replicated trees re-materialize
+            # as owning device arrays (the next dispatch places them per
+            # its in_specs); the FLAT zero1 updater state stays numpy so
+            # _ensure_parallel_state reshards it through the new plan's
+            # padding and places it explicitly
+            model._params = jax.tree.map(jnp.array, params)
+            model._states = jax.tree.map(jnp.array, states)
+            if upd is not None and not is_flat_state(upd):
+                upd = jax.tree.map(jnp.array, upd)
+            model._updater_state = upd
+            model._acc_state = acc
+            # _finish_parallel_state sets _live_workers + the workers gauge
+            self._ensure_parallel_state()
+        prof.count("elastic/resizes")
+        if n < old_n:
+            prof.count("elastic/shrinks")
+        elif n > old_n:
+            prof.count("elastic/grows")
+        logger.warning("elastic resize: data axis %d -> %d workers%s",
+                       old_n, n,
+                       f" (lost replicas {lost})" if lost else "")
+        return removed
+
+    def probe_replicas(self) -> List[int]:
+        """Data-axis indices whose device fails a tiny round-trip — the
+        ground-truth check behind shrink-and-continue when a failure did
+        not name the lost replica itself."""
+        return [i for i, d in enumerate(self.mesh.devices.flat)
+                if not probe_device(d)]
+
     def _count_collectives(self, prof, k: int = 1) -> None:
         prof.count("collective/steps", k)
         for name, nbytes in self._coll_bytes.items():
@@ -591,7 +746,8 @@ class ParallelWrapper:
             *, pad_partial: Optional[bool] = None,
             drop_remainder: bool = False, prefetch: Optional[int] = None,
             steps_per_dispatch: int = 1, host_prefetch: int = 0,
-            resume_from: Optional[str] = None) -> None:
+            resume_from: Optional[str] = None,
+            resume_cursor: Optional[tuple] = None) -> None:
         """Data-parallel training on the shared input/dispatch pipeline
         (data/pipeline.py): batches are padded BOTH to the configured batch
         size (one compile per fit config) and to a multiple of the worker
@@ -604,7 +760,12 @@ class ParallelWrapper:
         ``steps_per_dispatch=K`` scans K minibatches inside one SPMD
         dispatch. ``resume_from``: exact checkpoint resume — see
         MultiLayerNetwork.fit; the restored (host) params/updater are
-        re-placed by the SPMD step's sharding on first dispatch."""
+        re-placed by the SPMD step's sharding on first dispatch.
+        ``resume_cursor=(epochs_done, steps_in_epoch)``: IN-MEMORY
+        continuation — fast-forward the pipeline to the exact dispatch
+        boundary the holder's live state already sits at, touching no
+        disk (the supervisor's elastic shrink-and-continue path; the
+        cursor is the one the interrupted fit left on the holder)."""
         model = self.model
         model._check_init()
         if not self._listeners and getattr(model, "_listeners", None):
@@ -614,14 +775,27 @@ class ParallelWrapper:
             self.set_listeners(*model._listeners)
         from ..util.checkpoint import begin_fit_cursor
 
-        skip = begin_fit_cursor(model, resume_from,
-                                listeners=self._listeners,
-                                keep_flat=self.accumulator.zero1)
-        if skip is not None:
-            # the wrapper's own compiled steps hold donated buffers of the
-            # replaced params — rebuild them too
-            self._step = None
-            self._chunk_step = None
+        if resume_cursor is not None:
+            if resume_from is not None:
+                raise ValueError(
+                    "resume_from and resume_cursor are mutually exclusive")
+            # in-memory continuation: the holder IS the checkpoint — no
+            # restore, no step invalidation (a resize already rebuilt or
+            # cache-swapped the steps; live state matches their layout)
+            skip = (int(resume_cursor[0]), int(resume_cursor[1]))
+            model._fit_epoch0 = model._epoch - skip[0]
+            model._steps_in_epoch = skip[1]
+        else:
+            skip = begin_fit_cursor(model, resume_from,
+                                    listeners=self._listeners,
+                                    keep_flat=self.accumulator.zero1)
+            if skip is not None:
+                # the wrapper's own compiled steps hold donated buffers of
+                # the replaced params — rebuild them too (and drop the
+                # per-worker-count cache, which holds the same objects)
+                self._step = None
+                self._chunk_step = None
+                self._exec_cache.clear()
         self._ensure_parallel_state()
         if self._step is None:
             self._step = self._build_step()
@@ -705,3 +879,4 @@ class ParallelWrapper:
         self._step = None
         self._chunk_step = None
         self._zero1_plan = None
+        self._exec_cache.clear()

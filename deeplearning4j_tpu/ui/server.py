@@ -297,8 +297,9 @@ class UIServer:
         restarts / watchdog fires / backoff waits + injected-fault
         counters), the collective-exchange ledger (bytes per collective
         kind, ZeRO-1 sharded-updater footprint, encoded-exchange density),
-        and the inference-pool census (live/retired/resurrected
-        replicas)."""
+        the elastic ledger (online resizes, grow-back probes, the live
+        worker gauge), and the inference-pool census
+        (live/retired/resurrected replicas)."""
         from ..common.profiler import OpProfiler
         from ..common.system_info import memory_summary
         from ..parallel.inference import pool_health
@@ -320,6 +321,7 @@ class UIServer:
                 "supervisor": prof.supervisor_stats(),
                 "faults": prof.fault_stats(),
                 "collectives": prof.collective_stats(),
+                "elastic": prof.elastic_stats(),
                 "inference": pool_health(),
                 **memory_summary()}
 

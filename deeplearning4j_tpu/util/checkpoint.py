@@ -131,6 +131,12 @@ def snapshot_training_state(model, listeners=None,
         "cursor": {
             "epochs_done": int(model._epoch) - int(fit_epoch0),
             "steps_in_epoch": int(getattr(model, "_steps_in_epoch", 0)),
+            # the LIVE data-parallel worker count at snapshot time: an
+            # elastic run may be mid-shrink, and the resume metadata must
+            # say how many replicas were actually training (diagnostics +
+            # the resharding log line; the state itself is layout-
+            # independent, so restore works under any count)
+            "workers": int(getattr(model, "_live_workers", 1)),
         },
         "listener_state": gather_listener_state(listeners),
     }
@@ -561,6 +567,15 @@ def restore_training_state(model, path: str, listeners=None,
     if listeners and resume.get("listener_state"):
         restore_listener_state(listeners, resume["listener_state"])
     cursor = resume.get("cursor") or {}
+    saved_workers = cursor.get("workers")
+    if saved_workers is not None:
+        # purely informational (the on-disk layout is worker-count-
+        # independent) but load-bearing for elastic diagnostics: the
+        # restore log names the count the snapshot was training at, and
+        # the wrapper's resharding warning can compare against it
+        model._ckpt_workers = int(saved_workers)
+        logger.info("checkpoint %s was taken under %d data-parallel "
+                    "worker(s)", os.path.basename(path), saved_workers)
     return {"epochs_done": int(cursor.get("epochs_done", 0)),
             "steps_in_epoch": int(cursor.get("steps_in_epoch", 0))}
 
