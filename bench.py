@@ -1471,6 +1471,206 @@ def bench_elastic_smoke(steps: int, batch: int = 64, workers: int = 4) -> dict:
     }
 
 
+def bench_serving_smoke(steps: int, batch: int = 32,
+                        workers: int = 2) -> dict:
+    """SLO-gated serving load test (ISSUE 7; ROADMAP item 2): a
+    ServingEngine over a small MLP, warmed AOT bucket executables, then an
+    OPEN-LOOP Poisson load (arrivals scheduled by the clock, never gated
+    on completions — the arrival process a real front door sees) of
+    1-8-row requests. Self-validating hard-fails:
+
+    - **zero failed requests** in both phases — every future must resolve
+      with a result;
+    - **steady-state p99** <= SLO_P99_MS at the target QPS, and the
+      generator must actually sustain >= 90% of the target rate (an
+      open-loop generator that silently falls behind measures nothing);
+    - **zero traces after warmup**: the ``trace/serving_infer`` counter
+      must be exactly one-per-bucket from warmup and FLAT through both
+      load phases (``serving/traces_after_warmup`` == 0) — the
+      compile-once-run-many contract the bucket ladder exists for;
+    - **kill-a-replica drill**: a deterministic ``dead_replica`` fault at
+      a mid-load dispatch retires one of the two replicas under full
+      Poisson load; the in-flight batch REQUEUES (transparent
+      retirement), resurrection refills the pool, and the SLO must hold —
+      zero failed requests and p99 <= DEGRADED_P99_MS across the drill
+      phase.
+
+    Emits steady/degraded p50/p99/QPS plus the serving ledger (fill
+    ratio, pad waste, requeues, queue-depth high-water)."""
+    import threading
+
+    import jax
+
+    from deeplearning4j_tpu.common import faultinject
+    from deeplearning4j_tpu.common.profiler import OpProfiler
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.parallel import ServingEngine
+
+    TARGET_QPS = 100.0
+    SLO_P99_MS = 250.0          # steady-state bound (CPU build machines)
+    DEGRADED_P99_MS = 600.0     # bound while one of two replicas is dead
+    REQ_ROWS_MAX = 8
+
+    def fail(msg, **extra):
+        print(json.dumps({"error": msg, **extra}))
+        sys.exit(1)
+
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-3))
+            .activation("tanh").list()
+            .layer(L.DenseLayer(n_out=64))
+            .layer(L.DenseLayer(n_out=64))
+            .layer(L.OutputLayer(n_out=10))
+            .set_input_type(InputType.feed_forward(32)).build())
+    model = MultiLayerNetwork(conf).init()
+
+    prof = OpProfiler.get()
+    prof.reset()
+    faultinject.clear_plan()
+
+    t_warm0 = time.perf_counter()
+    eng = (ServingEngine.Builder(model)
+           .buckets([1, 2, 4, 8, 16, batch]).input_shape((32,))
+           .workers(workers).max_wait_ms(2.0)
+           .request_timeout_ms(15000)
+           .resurrect_dead_replicas(True, backoff_ms=100)
+           .build())
+    warmup_s = time.perf_counter() - t_warm0
+    traces_at_warmup = prof.counter_value("trace/serving_infer")
+    n_buckets = len(eng.ladder.batch_sizes)
+    if traces_at_warmup != n_buckets:
+        fail("warmup did not compile exactly one executable per bucket",
+             traces=traces_at_warmup, buckets=n_buckets)
+
+    rng = np.random.RandomState(0)
+    inputs = rng.randn(REQ_ROWS_MAX, 32).astype(np.float32)
+
+    def poisson_phase(n_requests, qps, seed):
+        """Open-loop: submit on the arrival schedule, collect completion
+        latency via done-callbacks. Returns (latencies_s, failures,
+        wall_s)."""
+        r = np.random.RandomState(seed)
+        gaps = r.exponential(1.0 / qps, n_requests)
+        sizes = r.randint(1, REQ_ROWS_MAX + 1, n_requests)
+        lat, failures, lock = [], [], threading.Lock()
+        done = threading.Semaphore(0)
+
+        def submit(i, t_sub):
+            fut = eng.output_async(inputs[:sizes[i]])
+
+            def on_done(f, t_sub=t_sub):
+                with lock:
+                    if f.exception() is not None:
+                        failures.append(str(f.exception()))
+                    else:
+                        lat.append(time.monotonic() - t_sub)
+                done.release()
+
+            fut.add_done_callback(on_done)
+
+        t0 = time.monotonic()
+        t_next = t0
+        for i in range(n_requests):
+            t_next += gaps[i]
+            delay = t_next - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            submit(i, t_next)      # latency from the SCHEDULED arrival
+        for _ in range(n_requests):
+            if not done.acquire(timeout=30):
+                fail("load phase hung: requests never resolved",
+                     resolved=len(lat) + len(failures), of=n_requests)
+        wall = time.monotonic() - t0
+        return lat, failures, wall
+
+    # --- steady-state phase -------------------------------------------
+    n_steady = max(300, steps * 10)
+    lat, failures, wall = poisson_phase(n_steady, TARGET_QPS, seed=1)
+    if failures:
+        fail("steady-state phase had failed requests",
+             n=len(failures), first=failures[0])
+    qps = n_steady / wall
+    p50 = float(np.percentile(np.asarray(lat) * 1e3, 50))
+    p99 = float(np.percentile(np.asarray(lat) * 1e3, 99))
+    if qps < 0.9 * TARGET_QPS:
+        fail(f"open-loop generator fell behind: {qps:.1f} qps vs target "
+             f"{TARGET_QPS}", wall_s=round(wall, 2))
+    if p99 > SLO_P99_MS:
+        fail(f"steady-state p99 {p99:.1f}ms violates the {SLO_P99_MS}ms "
+             f"SLO", p50_ms=round(p50, 2), qps=round(qps, 1))
+
+    # --- kill-a-replica drill -----------------------------------------
+    kill_batch = prof.counter_value("serving/batches") + 10
+    faultinject.set_plan(faultinject.FaultPlan(
+        [{"site": "serving/dispatch", "kind": "dead_replica",
+          "index": kill_batch}]))
+    n_drill = max(300, steps * 10)
+    dlat, dfail, dwall = poisson_phase(n_drill, TARGET_QPS, seed=2)
+    faultinject.clear_plan()
+    retired = prof.counter_value("inference/replica_retired")
+    if retired < 1:
+        fail("kill drill did not retire a replica (fault never fired)",
+             kill_batch=kill_batch,
+             batches=prof.counter_value("serving/batches"))
+    if dfail:
+        fail("kill drill had failed requests — retirement was not "
+             "transparent to in-flight load", n=len(dfail),
+             first=dfail[0])
+    dp50 = float(np.percentile(np.asarray(dlat) * 1e3, 50))
+    dp99 = float(np.percentile(np.asarray(dlat) * 1e3, 99))
+    if dp99 > DEGRADED_P99_MS:
+        fail(f"kill-drill p99 {dp99:.1f}ms violates the degraded-capacity "
+             f"{DEGRADED_P99_MS}ms bound", p50_ms=round(dp50, 2))
+
+    # --- retrace + ledger gates ---------------------------------------
+    traces = prof.counter_value("trace/serving_infer")
+    if traces != traces_at_warmup:
+        fail("serving traced AFTER warmup", warmup=traces_at_warmup,
+             now=traces)
+    if prof.counter_value("serving/traces_after_warmup"):
+        fail("serving/traces_after_warmup counter is non-zero",
+             n=prof.counter_value("serving/traces_after_warmup"))
+    ledger = prof.serving_stats()
+    if not ledger.get("requests") or "fill_ratio" not in ledger:
+        fail("serving ledger did not populate", ledger=ledger)
+    if not ledger.get("requeued"):
+        fail("kill drill retired a replica but nothing was requeued — "
+             "the in-flight batch was dropped or failed", ledger=ledger)
+
+    eng.shutdown()
+    return {
+        "metric": "serving_smoke",
+        "value": qps,
+        "unit": "req/sec",
+        "workers": workers,
+        "target_qps": TARGET_QPS,
+        "platform": jax.devices()[0].platform,
+        "requests_steady": n_steady,
+        "requests_drill": n_drill,
+        "p50_ms": round(p50, 2),
+        "p99_ms": round(p99, 2),
+        "slo_p99_ms": SLO_P99_MS,
+        "drill_p50_ms": round(dp50, 2),
+        "drill_p99_ms": round(dp99, 2),
+        "drill_slo_p99_ms": DEGRADED_P99_MS,
+        "drill_qps": round(n_drill / dwall, 1),
+        "replicas_retired": retired,
+        "replicas_resurrected":
+            prof.counter_value("inference/replica_resurrected"),
+        "warmup_s": round(warmup_s, 3),
+        "buckets": list(eng.ladder.batch_sizes),
+        "traces": traces,
+        "serving_ledger": {k: (round(v, 5) if isinstance(v, float) else v)
+                           for k, v in ledger.items()},
+        "data": "open-loop Poisson load of 1-8-row requests over AOT "
+                "bucket executables; SLO hard-fails on p99/QPS/failed "
+                "requests/retraces, incl. a kill-a-replica-mid-load "
+                "drill with transparent requeue",
+    }
+
+
 def bench_word2vec(steps: int) -> dict:
     """North-star config 4: Word2Vec skip-gram + negative sampling over a
     synthetic zipfian corpus; throughput = corpus words consumed / sec
@@ -1753,7 +1953,8 @@ def main() -> None:
                                  "resnet50-disk", "resnet50-predecoded",
                                  "pipeline-smoke", "telemetry-smoke",
                                  "fault-smoke", "supervisor-smoke",
-                                 "zero1-smoke", "elastic-smoke"])
+                                 "zero1-smoke", "elastic-smoke",
+                                 "serving-smoke"])
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--batch", type=int, default=None,
                         help="per-config default: resnet50=128, bert=32")
@@ -1839,6 +2040,8 @@ def main() -> None:
         result = bench_zero1_smoke(steps, batch=args.batch or 64)
     elif args.config == "elastic-smoke":
         result = bench_elastic_smoke(steps, batch=args.batch or 64)
+    elif args.config == "serving-smoke":
+        result = bench_serving_smoke(steps, batch=args.batch or 32)
     elif args.config == "resnet50-disk":
         result = bench_resnet50_disk(steps, batch=args.batch or 64)
     elif args.config == "resnet50-predecoded":

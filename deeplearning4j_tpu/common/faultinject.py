@@ -12,9 +12,16 @@ them a *deterministic, step-indexed* event:
   (``"pipeline/bind"``, ``"pipeline/place"``, ``"train/step"``,
   ``"train/wedge"``, ``"device/loss"``, ``"supervisor/hang"``,
   ``"checkpoint/pre_rename"``, ``"inference/worker"``,
-  ``"inference/probe"``, ``"elastic/probe"``) and a zero-based
+  ``"inference/probe"``, ``"elastic/probe"``, ``"serving/enqueue"``,
+  ``"serving/dispatch"``) and a zero-based
   INDEX at that site (batch ordinal within a fit call, checkpoint commit
-  sequence, inference request ordinal, supervisor attempt/probe ordinal).
+  sequence, inference request ordinal, supervisor attempt/probe ordinal,
+  serving request ordinal at enqueue / serving batch ordinal at
+  dispatch — the deterministic drills behind the serving-smoke bench's
+  kill-a-replica run and the wedged-replica deadline tests: ``slow``
+  delays a bucket dispatch, ``transient`` forces one requeue-and-retry,
+  ``dead_replica`` retires the dispatching replica with its in-flight
+  requests requeued).
 - Instrumented code calls :func:`fault_point(site, index)` at the matching
   place. Raising kinds (``transient``, ``crash``, ``dead_replica``) raise
   there; ``slow`` sleeps in place; advisory kinds (``nan``) are returned

@@ -224,6 +224,33 @@ class OpProfiler:
             out["resize_count"] = s["count"]
         return out
 
+    def serving_stats(self) -> Dict[str, float]:
+        """Serving-tier ledger (``serving/*`` counters + sections): request
+        and batch counts, bucket fill ratio (real rows / dispatched bucket
+        capacity) and its complement pad waste, queue-depth high-water,
+        requeues ridden through replica retirement, oversize admissions,
+        the traces-after-warmup counter (MUST stay 0 in steady state —
+        the serving-smoke bench hard-fails on it), and the dispatch /
+        warmup wall-time sections. Rolling p50/p99 request latency lives
+        on the engines themselves (``ServingEngine.latency_stats()`` — a
+        quantile is not a counter); ``parallel.serving.serving_health()``
+        merges both views for ``/api/health``. Empty when no ServingEngine
+        ever dispatched."""
+        out: Dict[str, float] = {
+            k.split("/", 1)[1]: v for k, v in self._counters.items()
+            if k.startswith("serving/")}
+        cap = out.get("capacity_rows")
+        if cap:
+            out["fill_ratio"] = out.get("rows", 0) / cap
+            out["pad_waste"] = out.get("pad_rows", 0) / cap
+        for sec, key in (("serving/dispatch", "dispatch_s"),
+                         ("serving/warmup", "warmup_s")):
+            s = self._sections.get(sec)
+            if s:
+                out[key] = s["total_s"]
+                out[key.replace("_s", "_count")] = s["count"]
+        return out
+
     def fault_stats(self) -> Dict[str, float]:
         """Fault-tolerance ledger: injected-fault counters
         (``faults/<site>/<kind>``), pipeline retry count, and backoff wall

@@ -23,5 +23,5 @@ from .sequence import (convert_to_sequence, window_sequence,
 from .analysis import AnalyzeLocal, DataAnalysis, ColumnAnalysis
 from .binary_records import (BinaryRecordWriter, BinaryRecordReader,
                              BinaryRecordDataSetIterator, write_records)
-from .pipeline import (stable_batches, pad_dataset, device_feed, chunked,
-                       resolve_batch_size)
+from .pipeline import (stable_batches, pad_dataset, pad_rows, device_feed,
+                       chunked, resolve_batch_size)

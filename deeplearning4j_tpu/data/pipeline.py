@@ -109,6 +109,31 @@ def _pad_nd(nd: Optional[NDArray], idx: np.ndarray) -> Optional[NDArray]:
     return NDArray(_wrap_rows(nd.value, idx))
 
 
+def pad_rows(arr: np.ndarray, target: int,
+             axis: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad ``arr`` to ``target`` entries along ``axis`` by WRAPPING real
+    rows (``row[i % n]``) — the same rule :func:`pad_dataset` applies to
+    training batches, host-side (numpy) for the serving tier's bucket
+    padding. Returns ``(padded, w)`` with ``w`` the [target] float32
+    example-weight vector (1 = real, 0 = pad).
+
+    The inertness argument is the same as training's: wrapped rows are
+    REAL rows, so any per-example computation produces for pad slots an
+    exact copy of a real slot's output, and the consumer discards them by
+    the mask / by slicing ``[:n]`` — nothing about the real rows' results
+    depends on the pad rows (proven bitwise in tests/test_serving.py for
+    the inference forward)."""
+    arr = np.asarray(arr)
+    n = arr.shape[axis]
+    if n > target:
+        raise ValueError(f"{n} rows exceed the pad target {target}")
+    w = (np.arange(target) < n).astype(np.float32)
+    if n == target:
+        return arr, w
+    idx = np.arange(target) % n
+    return np.take(arr, idx, axis=axis), w
+
+
 def pad_dataset(ds: Any, target: int) -> Tuple[Any, jnp.ndarray]:
     """Pad ``ds`` (DataSet or MultiDataSet) to ``target`` examples by
     wrapping real rows; returns ``(padded_ds, w)`` with the example-weight

@@ -1,5 +1,5 @@
 from .mesh import (make_mesh, replicated, data_sharded, shard_batch,
-                   elastic_pool)
+                   elastic_pool, serving_devices)
 from .accumulator import (GradientsAccumulator, DenseAllReduceAccumulator,
                           EncodedGradientsAccumulator,
                           ReduceScatterAccumulator, ThresholdAlgorithm,
@@ -9,6 +9,8 @@ from .wrapper import ParallelWrapper
 from .sharding import (tp_param_specs, tp_shardings, apply_tp, Zero1Plan,
                        unflatten_updater_state)
 from .inference import ParallelInference
+from .serving import (ServingEngine, BucketLadder, OversizeRequest,
+                      serving_health)
 from .distributed import (SharedTrainingMaster, TrainingSupervisor,
                           SupervisedFitResult, RestartBudgetExceeded,
                           RestartStorm, Preempted, HangDetected,

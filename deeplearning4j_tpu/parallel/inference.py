@@ -32,9 +32,20 @@ Failure contract (the §5.3 serving story):
   site) must pass, then a fresh worker thread joins the queue. Pool
   capacity recovers; ``pool_stats()`` / ``/api/health`` report
   live/retired/resurrected counts.
-- **Shutdown fails queued futures**: :meth:`shutdown` stops the workers,
+- **Shutdown drains, then fails queued futures**: :meth:`shutdown` stops
+  the workers, waits (bounded) for in-flight batches to resolve normally,
   then resolves every still-queued future with an error — no waiter is
-  left hanging on a future nobody will fulfil.
+  left hanging on a future nobody will fulfil, and no request a replica
+  already picked up is failed spuriously.
+- **True time-in-queue**: every ``output_async`` future carries its
+  queue-entry timestamp (``fut.enqueued_at``), so deadline errors report
+  how long the request actually sat, not a figure derived from
+  ``max_wait_ms`` at dispatch.
+
+The production SERVING tier — shape-bucketed continuous batching over
+AOT-compiled executables, an HTTP endpoint, and the SLO load bench — is
+:mod:`parallel.serving`'s :class:`ServingEngine`, a subclass of this pool
+(same retirement/resurrection machinery; bucket-aware coalescing).
 """
 
 from __future__ import annotations
@@ -71,6 +82,30 @@ def pool_health() -> Dict[str, int]:
         for k in ("workers", "alive", "retired", "resurrected"):
             agg[k] += stats[k]
     return agg
+
+
+class _Request:
+    """One queued inference request. Carries its queue-entry timestamp so
+    deadline errors can report TRUE time-in-queue (not a figure derived
+    from ``max_wait_ms`` at dispatch), and a requeue ``attempts`` counter
+    so a serving tier can re-enqueue the in-flight batch of a dying
+    replica a bounded number of times instead of failing it."""
+
+    __slots__ = ("arr", "fut", "seq", "t_enq", "attempts", "t_real")
+
+    def __init__(self, arr: np.ndarray, fut: Future, seq: int,
+                 t_enq: float, attempts: int = 0,
+                 t_real: Optional[int] = None):
+        self.arr = arr
+        self.fut = fut
+        self.seq = seq
+        self.t_enq = t_enq          # time.monotonic() at queue entry
+        self.attempts = attempts
+        self.t_real = t_real        # real sequence length before seq-pad
+
+    @property
+    def n(self) -> int:
+        return int(self.arr.shape[0])
 
 
 class ParallelInference:
@@ -168,6 +203,7 @@ class ParallelInference:
         self._workers: List[threading.Thread] = []
         self._resurrectors: List[threading.Thread] = []
         self._alive = 0
+        self._busy = 0               # workers mid-batch (shutdown drains)
         self._pool_size = 0          # configured capacity (drain threads)
         self._retired_total = 0
         self._resurrected_total = 0
@@ -199,14 +235,20 @@ class ParallelInference:
 
     def output(self, x) -> NDArray:
         """Synchronous single-request API (reference output()), bounded by
-        the per-request deadline."""
+        the per-request deadline. A timeout reports the request's TRUE
+        time-in-queue (from the queue-entry timestamp the future carries),
+        not a figure derived from ``max_wait_ms`` at dispatch."""
         fut = self.output_async(x)
         try:
             return fut.result(timeout=self.request_timeout_s)
         except concurrent.futures.TimeoutError:
+            t_enq = getattr(fut, "enqueued_at", None)
+            waited = (f"{time.monotonic() - t_enq:.1f}s in queue"
+                      if t_enq is not None
+                      else f"{self.request_timeout_s:.1f}s")
             raise TimeoutError(
-                f"inference request timed out after "
-                f"{self.request_timeout_s:.1f}s (queue depth "
+                f"inference request timed out after {waited} (deadline "
+                f"{self.request_timeout_s:.1f}s, queue depth "
                 f"{self._queue.qsize()}, {self.alive_replicas()}/"
                 f"{len(self._workers) or 1} replicas alive); a wedged "
                 f"replica or an overloaded queue — raise "
@@ -235,19 +277,25 @@ class ParallelInference:
         with self._lock:
             seq = self._req_seq
             self._req_seq += 1
+        self._enqueue(_Request(arr, fut, seq, time.monotonic()))
+        return fut
+
+    def _enqueue(self, req: _Request) -> None:
+        """Queue one request. The future carries the queue-entry timestamp
+        (``fut.enqueued_at``) so deadline errors report true time-in-queue."""
+        req.fut.enqueued_at = req.t_enq
         try:
             # the enqueue itself is bounded by the request deadline too:
             # a full queue behind a wedged replica must not turn the
             # "timeout instead of hang" contract into an untimed block
-            self._queue.put((arr, fut, seq),
-                            timeout=self.request_timeout_s)
+            self._queue.put(req, timeout=self.request_timeout_s)
         except queue.Full:
-            fut.set_exception(TimeoutError(
+            req.fut.set_exception(TimeoutError(
                 f"inference queue stayed full (depth "
                 f"{self._queue.qsize()}) for {self.request_timeout_s:.1f}s "
                 f"({self.alive_replicas()}/{len(self._workers) or 1} "
                 f"replicas alive)"))
-            return fut
+            return
         # re-check AFTER enqueueing: the last replica may have retired
         # between the alive check above and the put, in which case nobody
         # will ever drain this request — fail it now rather than hang
@@ -256,7 +304,6 @@ class ParallelInference:
                 "all inference replicas have been retired (fatal replica "
                 "failures); a resurrection may be pending — retry, or "
                 "restart the ParallelInference"))
-        return fut
 
     def _run(self, batch: np.ndarray) -> NDArray:
         out = self.model.output(batch)
@@ -392,62 +439,83 @@ class ParallelInference:
                     batch.append(self._queue.get(timeout=remaining))
                 except queue.Empty:
                     break
-            arrays = [b[0] for b in batch]
-            futures = [b[1] for b in batch]
-            sizes = [a.shape[0] for a in arrays]
+            with self._lock:
+                self._busy += 1
             try:
-                for _, _, seq in batch:
-                    faultinject.fault_point("inference/worker", seq)
-                merged = np.concatenate(arrays, axis=0)
-                result = self._run(merged).to_numpy()
-                # one-row sample of a known-good input: what the
-                # resurrection health probe replays (copy — a view would
-                # pin the whole merged batch in memory between requests)
-                self._probe_input = merged[:1].copy()
-                off = 0
-                for size, fut in zip(sizes, futures):
-                    fut.set_result(NDArray(result[off:off + size]))
-                    off += size
-            except faultinject.DeadReplicaFault as e:
-                # fatal: this replica is gone — fail its batch, retire
-                self._retire(worker_id, e, futures)
-                return
-            except Exception as e:  # scatter failure to every waiter
-                prof.count("inference/batch_errors")
-                for fut in futures:
-                    if not fut.done():
-                        fut.set_exception(e)
-            except BaseException as e:
-                # a BaseException (e.g. an injected SimulatedCrash) must
-                # not skip the bookkeeping: waiters would hang and the
-                # pool would over-report live replicas
-                self._retire(worker_id, e, futures)
-                raise
+                self._serve_batch(worker_id, batch, prof)
+            except faultinject.DeadReplicaFault:
+                return          # replica retired inside _serve_batch
+            finally:
+                with self._lock:
+                    self._busy -= 1
         with self._lock:
             self._alive -= 1
+
+    def _serve_batch(self, worker_id: int, batch: List[_Request],
+                     prof) -> None:
+        """Run one coalesced batch and scatter results. Raises
+        DeadReplicaFault after retiring the worker so ``_drain`` exits."""
+        futures = [r.fut for r in batch]
+        try:
+            for r in batch:
+                faultinject.fault_point("inference/worker", r.seq)
+            merged = np.concatenate([r.arr for r in batch], axis=0)
+            result = self._run(merged).to_numpy()
+            # one-row sample of a known-good input: what the
+            # resurrection health probe replays (copy — a view would
+            # pin the whole merged batch in memory between requests)
+            self._probe_input = merged[:1].copy()
+            off = 0
+            for r in batch:
+                r.fut.set_result(NDArray(result[off:off + r.n]))
+                off += r.n
+        except faultinject.DeadReplicaFault as e:
+            # fatal: this replica is gone — fail its batch, retire
+            self._retire(worker_id, e, futures)
+            raise
+        except Exception as e:  # scatter failure to every waiter
+            prof.count("inference/batch_errors")
+            for fut in futures:
+                if not fut.done():
+                    fut.set_exception(e)
+        except BaseException as e:
+            # a BaseException (e.g. an injected SimulatedCrash) must
+            # not skip the bookkeeping: waiters would hang and the
+            # pool would over-report live replicas
+            self._retire(worker_id, e, futures)
+            raise
 
     def _fail_queued(self, exc: Exception) -> int:
         n = 0
         while True:
             try:
-                _, fut, _ = self._queue.get_nowait()
+                req = self._queue.get_nowait()
             except queue.Empty:
                 return n
-            if not fut.done():
-                fut.set_exception(exc)
+            if not req.fut.done():
+                req.fut.set_exception(exc)
                 n += 1
 
-    def shutdown(self) -> None:
-        """Stop the workers and FAIL anything still queued — a waiter
-        blocked on ``future.result()`` gets an immediate error instead of
-        hanging on a future no worker will ever fulfil."""
+    def shutdown(self, drain_timeout_s: float = 2.0) -> None:
+        """Stop the workers, DRAIN in-flight batches, then FAIL anything
+        still queued. The order is the contract: a request a replica has
+        already picked up gets up to ``drain_timeout_s`` to finish and
+        resolve normally (its waiter sees a result, not a spurious
+        shutdown error), and only then does every still-QUEUED future get
+        an immediate error instead of hanging on a future no worker will
+        ever fulfil. A worker wedged past the drain window is abandoned
+        (daemon thread); its batch resolves whenever it does."""
         self._shutdown = True
-        for t in self._workers:
-            t.join(timeout=1.0)
-        for t in self._resurrectors:
-            t.join(timeout=1.0)
+        deadline = time.monotonic() + max(0.0, drain_timeout_s)
+        for t in self._workers + self._resurrectors:
+            t.join(timeout=max(0.05, deadline - time.monotonic()))
         with self._lock:
+            still_busy = self._busy
             self._alive = 0      # pool_health must not count the dead
+        if still_busy:
+            logger.warning("ParallelInference.shutdown: %d in-flight "
+                           "batch(es) did not drain within %.1fs",
+                           still_busy, drain_timeout_s)
         _POOLS.discard(self)
         n = self._fail_queued(RuntimeError(
             "ParallelInference shut down with this request still queued"))

@@ -47,6 +47,20 @@ def elastic_pool(mesh: Mesh, exclude: Sequence = (),
     return pool
 
 
+def serving_devices(workers: int,
+                    devices: Optional[Sequence] = None) -> list:
+    """Round-robin device assignment for an inference replica pool: the
+    serving analog of the training mesh (one coalescing replica per chip
+    when there are enough chips; replicas time-share otherwise). The
+    serving tier uses it to pin each replica's AOT executable arguments —
+    a replica's params live on its device, so concurrent replicas run on
+    DIFFERENT chips instead of contending for one XLA stream."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if not devs:
+        raise ValueError("no devices available for serving replicas")
+    return [devs[i % len(devs)] for i in range(max(1, int(workers)))]
+
+
 def probe_device(device) -> bool:
     """Tiny host→device→host round-trip health probe: True when the
     device accepts a placement and hands back finite data. The single

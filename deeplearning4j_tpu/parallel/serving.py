@@ -1,0 +1,838 @@
+"""Production inference serving: continuous batching over AOT shape buckets.
+
+The millions-of-users tier (ROADMAP item 2). :class:`ParallelInference`
+gives this stack a replica pool with health probes, retirement,
+resurrection and per-request deadlines — but it dispatches each coalesced
+batch AT ITS OWN SHAPE, so concurrent traffic at varying batch/sequence
+sizes retraces and serializes behind jit compiles. This module closes the
+gap with the compile-once-run-many recipe the whole-graph-compilation
+literature argues for (TVM, arXiv:1802.04799; nGraph, arXiv:1801.08058):
+
+- **Shape buckets** (:class:`BucketLadder`): a configurable batch-size
+  ladder (and optional sequence-length ladder). Every request is padded UP
+  to the smallest admitting bucket, so the set of shapes the model ever
+  sees is small, fixed, and known at startup.
+- **AOT executables per bucket**: each bucket's inference function is
+  ``jax.jit(...).lower(...).compile()``-d at pool startup
+  (:meth:`ServingEngine.warmup`), so steady-state serving NEVER traces —
+  the ``serving/traces_after_warmup`` counter must stay 0 and the
+  serving-smoke bench hard-fails when it doesn't. Warmup cost is paid
+  once, up front, per bucket (the ``serving/warmup`` profiler section
+  ledgers it).
+- **Pad-and-mask reuse**: bucket padding is :func:`data.pipeline.pad_rows`
+  — the SAME wrap-real-rows rule the training pipeline uses, so padding
+  rows are provably inert: a pad slot is an exact copy of a real row,
+  per-example inference computes for it exactly what it computed for the
+  real row, and the scatter slices it off. ``tests/test_serving.py``
+  proves the bucketed output BITWISE-equal to an unpadded direct
+  ``model.output``. (BatchNorm is no caveat here: inference-mode BN uses
+  running stats, which are per-example.)
+- **Continuous batching**: replica workers drain the shared request queue
+  into the largest fillable bucket under a ``max_wait_ms`` deadline — a
+  request that would overflow the largest bucket (or mismatch the batch's
+  non-batch shape) is stashed for the next batch, never dropped.
+- **bf16 inference params** (``Builder.bf16(True)``): one cast at startup
+  (and on :meth:`refresh_params`), halving weight bytes and engaging the
+  bf16 matmul units; inputs/outputs stay float32 at the API boundary.
+  Numerics change (~1e-2 relative) — the bitwise guarantee above is the
+  fp32 path's.
+- **Replica-pool integration**: ServingEngine IS a ParallelInference — it
+  inherits retirement, health-probe resurrection, deadlines and shutdown
+  draining. Retirement is additionally TRANSPARENT to in-flight requests:
+  a dying replica's batch is requeued (bounded by ``max_requeues``, true
+  queue-entry timestamps preserved) instead of failed, so the
+  kill-a-replica-mid-load drill completes with zero failed requests while
+  the PR-4 resurrection machinery refills the pool. When the LAST replica
+  dies, queued requests still fail fast (the pool's bounded-latency
+  contract outranks transparency).
+
+**Admission rule for oversize requests** (documented contract): a request
+with more rows than the largest batch bucket is, under
+``oversize="split"`` (the default), split into largest-bucket-sized
+chunks served independently and re-concatenated in order — its latency is
+then bounded by ``ceil(n/max_batch)`` bucket dispatches; under
+``oversize="reject"`` it raises :class:`OversizeRequest` synchronously at
+submission, before anything is queued. A sequence length over the ladder
+ALWAYS rejects — time steps cannot be split across executables by a
+serving layer that does not know the model's temporal semantics.
+
+HTTP serving lives on the existing UI server: ``UIServer.attach_serving``
+exposes ``POST /api/infer`` next to ``/api/health`` (whose ``serving``
+section is :func:`serving_health`). Load-test with
+``python bench.py --config serving-smoke`` — an open-loop Poisson
+generator with hard-fail p50/p99/QPS SLO gates and a
+kill-a-replica-mid-load drill.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+import weakref
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..common import faultinject
+from ..common.profiler import OpProfiler
+from ..data.pipeline import pad_rows
+from ..ndarray.ndarray import NDArray
+from ..ndarray.rng import get_random
+from .inference import ParallelInference, _Request, logger
+from .mesh import serving_devices
+
+# live engines, for the /api/health serving census (weak: dropped → gone)
+_ENGINES: "weakref.WeakSet" = weakref.WeakSet()
+
+_MISS = object()     # _exec sentinel: None is a real (generic-model) entry
+
+
+class OversizeRequest(ValueError):
+    """A request the bucket ladder refuses to admit: more rows than the
+    largest batch bucket under ``oversize="reject"``, or a sequence longer
+    than the largest sequence bucket (never splittable). Raised
+    synchronously at submission — nothing is queued."""
+
+
+class BucketLadder:
+    """The bucket policy: sorted batch-size ladder, optional sequence-
+    length ladder, and the oversize admission rule (see module docstring).
+
+    ``bucket_batch(n)`` / ``bucket_seq(t)`` return the smallest admitting
+    rung; ``admit(n)`` returns the chunk row-counts a request is served
+    as (``[n]`` for an in-ladder request)."""
+
+    def __init__(self, batch_sizes: Sequence[int] = (1, 2, 4, 8, 16, 32),
+                 seq_lens: Optional[Sequence[int]] = None,
+                 oversize: str = "split"):
+        sizes = sorted({int(b) for b in batch_sizes})
+        if not sizes or sizes[0] < 1:
+            raise ValueError(f"batch ladder needs positive sizes, got "
+                             f"{batch_sizes!r}")
+        self.batch_sizes: Tuple[int, ...] = tuple(sizes)
+        self.seq_lens: Optional[Tuple[int, ...]] = None
+        if seq_lens is not None:
+            sl = sorted({int(t) for t in seq_lens})
+            if not sl or sl[0] < 1:
+                raise ValueError(f"sequence ladder needs positive lengths, "
+                                 f"got {seq_lens!r}")
+            self.seq_lens = tuple(sl)
+        if oversize not in ("split", "reject"):
+            raise ValueError(f"oversize must be 'split' or 'reject', got "
+                             f"{oversize!r}")
+        self.oversize = oversize
+
+    @property
+    def max_batch(self) -> int:
+        return self.batch_sizes[-1]
+
+    def bucket_batch(self, n: int) -> Optional[int]:
+        """Smallest batch bucket >= n, or None when n exceeds the ladder."""
+        for b in self.batch_sizes:
+            if b >= n:
+                return b
+        return None
+
+    def bucket_seq(self, t: int) -> int:
+        """Smallest sequence bucket >= t. Oversize sequences ALWAYS
+        reject (module docstring: time steps cannot be split)."""
+        assert self.seq_lens is not None
+        for s in self.seq_lens:
+            if s >= t:
+                return s
+        raise OversizeRequest(
+            f"sequence length {t} exceeds the largest sequence bucket "
+            f"{self.seq_lens[-1]}; lengthen the ladder or truncate "
+            f"upstream")
+
+    def admit(self, n: int) -> List[int]:
+        """The admission rule. Raises :class:`OversizeRequest` under
+        ``oversize='reject'``; splits into max-bucket chunks (+ remainder)
+        under ``'split'``."""
+        if n < 1:
+            raise ValueError(f"a request needs at least one row, got {n}")
+        if n <= self.max_batch:
+            return [n]
+        if self.oversize == "reject":
+            raise OversizeRequest(
+                f"request of {n} rows exceeds the largest batch bucket "
+                f"{self.max_batch} (oversize='reject'); split it client-"
+                f"side or configure oversize='split'")
+        chunks = [self.max_batch] * (n // self.max_batch)
+        if n % self.max_batch:
+            chunks.append(n % self.max_batch)
+        return chunks
+
+    def shapes(self, feat: Tuple[int, ...]) -> List[Tuple[int, ...]]:
+        """Every input shape the ladder admits — the warmup compile set.
+        ``feat`` is the per-request feature shape (no batch dim); with a
+        sequence ladder its leading entry is the time axis and is replaced
+        by each sequence rung."""
+        if self.seq_lens is None:
+            return [(b,) + tuple(feat) for b in self.batch_sizes]
+        if not feat:
+            raise ValueError("a sequence ladder needs a feature shape "
+                             "with a leading time axis")
+        return [(b, t) + tuple(feat[1:])
+                for b in self.batch_sizes for t in self.seq_lens]
+
+
+def _cast_floating(tree, dtype):
+    def c(a):
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating):
+            return jnp.asarray(a, dtype)
+        return a
+
+    return jax.tree.map(c, tree)
+
+
+class ServingEngine(ParallelInference):
+    """The serving tier: a ParallelInference replica pool whose workers
+    drain the shared queue into padded shape buckets served by
+    AOT-compiled executables. See the module docstring for the policy
+    contract; see :class:`Builder` for knobs."""
+
+    class Builder(ParallelInference.Builder):
+        def __init__(self, model):
+            super().__init__(model)
+            self._max_wait_ms = 2.0      # serving default: tight window
+            self._ladder: Optional[BucketLadder] = None
+            self._input_shape: Optional[Tuple[int, ...]] = None
+            self._in_dtype = np.float32
+            self._bf16 = False
+            self._warmup = True
+            self._max_requeues = 2
+            self._pin_devices = False
+
+        def inference_mode(self, mode: str) -> "ServingEngine.Builder":
+            """Serving IS continuous batching — the drain loop, stash and
+            bucket fill only exist in batched mode, so anything else is
+            refused loudly instead of silently coerced."""
+            if mode.lower() != "batched":
+                raise ValueError(
+                    f"ServingEngine only serves in 'batched' mode (its "
+                    f"continuous-batching drain loop IS the engine), got "
+                    f"{mode!r}; use a plain ParallelInference for "
+                    f"sequential dispatch")
+            return self
+
+        inferenceMode = inference_mode
+
+        def buckets(self, batch_sizes: Sequence[int],
+                    seq_lens: Optional[Sequence[int]] = None,
+                    oversize: str = "split") -> "ServingEngine.Builder":
+            """The bucket ladder (see :class:`BucketLadder`)."""
+            self._ladder = BucketLadder(batch_sizes, seq_lens, oversize)
+            return self
+
+        def ladder(self, ladder: BucketLadder) -> "ServingEngine.Builder":
+            self._ladder = ladder
+            return self
+
+        def input_shape(self, shape: Sequence[int],
+                        dtype=np.float32) -> "ServingEngine.Builder":
+            """Per-request feature shape (WITHOUT the batch dim) — what
+            warmup compiles against. With a sequence ladder the leading
+            entry is the time axis (any value; the ladder replaces it)."""
+            self._input_shape = tuple(int(s) for s in shape)
+            self._in_dtype = np.dtype(dtype)
+            return self
+
+        def bf16(self, enabled: bool = True) -> "ServingEngine.Builder":
+            """Serve with bfloat16 params (one startup cast; float32 at
+            the API boundary). Numerics caveat in the module docstring."""
+            self._bf16 = enabled
+            return self
+
+        def warmup(self, enabled: bool) -> "ServingEngine.Builder":
+            """Compile the bucket set at build() (default). Disabling
+            defers each bucket's compile to its first hit — only for
+            tests; production startup should eat the cost up front."""
+            self._warmup = enabled
+            return self
+
+        def max_requeues(self, n: int) -> "ServingEngine.Builder":
+            """How many replica deaths one request may ride through
+            (requeue budget) before it fails like the replica did."""
+            self._max_requeues = max(0, int(n))
+            return self
+
+        def pin_devices(self, enabled: bool = True
+                        ) -> "ServingEngine.Builder":
+            """Pin replica workers round-robin across devices
+            (:func:`mesh.serving_devices`): each replica gets its own
+            device-resident param copy and per-device executables, so
+            replicas run on different chips instead of contending for one
+            XLA stream. Costs one param copy + one compile set per
+            distinct device."""
+            self._pin_devices = enabled
+            return self
+
+        def build(self) -> "ServingEngine":
+            if self._input_shape is None:
+                raise ValueError(
+                    "ServingEngine needs Builder.input_shape(...): the "
+                    "AOT bucket executables are compiled against it at "
+                    "warmup, before any request arrives")
+            return ServingEngine(
+                self._model, self._ladder or BucketLadder(),
+                self._input_shape, in_dtype=self._in_dtype,
+                bf16=self._bf16, warmup=self._warmup,
+                max_requeues=self._max_requeues,
+                pin_devices=self._pin_devices,
+                batch_limit=self._batch_limit,
+                queue_limit=self._queue_limit,
+                max_wait_ms=self._max_wait_ms, workers=self._workers,
+                request_timeout_ms=self._request_timeout_ms,
+                resurrect=self._resurrect,
+                resurrect_backoff_ms=self._resurrect_backoff_ms,
+                max_resurrections=self._max_resurrections)
+
+    def __init__(self, model, ladder: BucketLadder,
+                 input_shape: Tuple[int, ...], in_dtype=np.float32,
+                 bf16: bool = False, warmup: bool = True,
+                 max_requeues: int = 2, pin_devices: bool = False,
+                 **pool_kwargs):
+        # subclass state FIRST: super().__init__ starts the drain threads,
+        # which call into the overridden _drain immediately
+        self.ladder = ladder
+        self._feat = tuple(input_shape)
+        self._in_dtype = np.dtype(in_dtype)
+        self._bf16 = bf16
+        self.max_requeues = max_requeues
+        self._compute_dtype = jnp.bfloat16 if bf16 else None
+        self._devices = (serving_devices(pool_kwargs.get("workers", 1))
+                         if pin_devices else [None])
+        # worker -> pinned device slot; a retired worker's slot is freed
+        # for its replacement (resurrection mints NEW worker ids, so a
+        # plain worker_id % ndev would drift every pool generation onto
+        # the wrong chips)
+        self._dev_of: Dict[int, int] = {}
+        self._dev_free: List[int] = []
+        self._stash_lock = threading.Lock()
+        self._stashq: "collections.deque" = collections.deque()
+        self._exec: Dict[Any, Any] = {}     # (shape, dev_idx) -> runner
+        self._exec_lock = threading.Lock()
+        self._lat_lock = threading.Lock()
+        self._latencies: "collections.deque" = collections.deque(maxlen=4096)
+        self._batch_seq = 0
+        self._admit_seq = 0          # request ordinal (serving/enqueue)
+        self._hwm = 0
+        self._warm = False
+        # THIS engine's trace count (bumped trace-time in _make_infer):
+        # the after-warmup alarm must not fire on another engine's warmup
+        # bumping the shared trace/serving_infer ledger counter
+        self._trace_cell = [0]
+        self._traces_seen = 0
+        # None = unknown (shape heuristic), True/False once warmup has
+        # probed whether outputs carry a per-timestep axis to slice
+        self._seq_out_per_timestep: Optional[bool] = None
+        self._aot = (hasattr(model, "_forward")
+                     and hasattr(model, "_params"))
+        self._infer_jit = None
+        self._dev_params: Dict[int, Any] = {}
+        pool_kwargs.setdefault("mode", "batched")
+        super().__init__(model, **pool_kwargs)
+        if self._aot:
+            self._key = get_random().next_key()
+            self._snapshot_params()
+        if warmup:
+            self.warmup()
+        _ENGINES.add(self)
+
+    # --- params / executables -----------------------------------------
+    def _snapshot_params(self) -> None:
+        params, states = self.model._params, self.model._states
+        if self._bf16:
+            params = _cast_floating(params, jnp.bfloat16)
+            states = _cast_floating(states, jnp.bfloat16)
+        for i, dev in enumerate(self._devices):
+            if dev is None:
+                self._dev_params[i] = (params, states)
+            else:
+                self._dev_params[i] = jax.device_put((params, states), dev)
+
+    def refresh_params(self) -> None:
+        """Re-snapshot the model's (possibly retrained) params into the
+        serving copies. CHEAP: the AOT executables take params as
+        arguments, so same-shape updates swap in without any recompile
+        (bf16 pays its cast again)."""
+        if not self._aot:
+            return
+        self._snapshot_params()
+
+    def _make_infer(self):
+        model = self.model
+        cdt = self._compute_dtype
+        cell = self._trace_cell
+
+        def infer(params, states, x, key):
+            # trace-time only: the retrace ledger the serving SLO gates on
+            OpProfiler.get().count("trace/serving_infer")
+            cell[0] += 1
+            if cdt is not None:
+                x = x.astype(cdt)
+            out, _ = model._forward(params, states, x, False, key, None)
+            return out.astype(jnp.float32)
+
+        return infer
+
+    def _compile_bucket(self, shape: Tuple[int, ...],
+                        dev_idx: int = 0):
+        """AOT-compile (``.lower().compile()``) the bucket executable for
+        one input shape (and one pinned device, when pinning). Called for
+        the whole ladder at :meth:`warmup`; a lazy hit (warmup disabled)
+        compiles here on first use."""
+        key = (shape, dev_idx)
+        # lock-free hot path: every steady-state dispatch lands here, and
+        # it must not queue behind another worker's (lazy) compile
+        exe = self._exec.get(key, _MISS)
+        if exe is not _MISS:
+            return exe
+        with self._exec_lock:
+            if key in self._exec:
+                return self._exec[key]
+            if self._aot:
+                if self._infer_jit is None:
+                    self._infer_jit = jax.jit(self._make_infer())
+                params, states = self._dev_params[dev_idx]
+                aval = jax.ShapeDtypeStruct(shape, self._in_dtype)
+                exe = self._infer_jit.lower(
+                    params, states, aval, self._key).compile()
+            else:
+                # generic model (no jittable forward exposed): no AOT
+                # executable — the model.output call right after this in
+                # _run_bucket warms its jit cache at the bucket shape.
+                # "never traces in steady state" still holds (every
+                # later request reuses the shape), but the trace ledger
+                # cannot see inside
+                exe = None
+            self._exec[key] = exe
+            OpProfiler.get().count("serving/buckets_compiled")
+            return exe
+
+    def warmup(self) -> Dict[str, float]:
+        """Compile every ladder bucket (× pinned device) up front — pool
+        startup pays the whole trace/compile bill so steady-state serving
+        never does. Returns {shape: seconds}; total time is ledgered
+        under the ``serving/warmup`` profiler section."""
+        prof = OpProfiler.get()
+        timings: Dict[str, float] = {}
+        seq_out: Dict[int, Optional[int]] = {}
+        with prof.time_section("serving/warmup"):
+            for shape in self.ladder.shapes(self._feat):
+                for i in range(len(self._devices)):
+                    t0 = time.perf_counter()
+                    self._compile_bucket(shape, i)
+                    # execute once too: the first run of a fresh
+                    # executable pays allocator/dispatch setup that must
+                    # not land on the first real request's latency
+                    out = self._run_bucket(np.zeros(shape, self._in_dtype),
+                                           i)
+                    if i == 0 and self.ladder.seq_lens is not None:
+                        seq_out[shape[1]] = (out.shape[1]
+                                             if out.ndim >= 2 else None)
+                    timings[f"{shape}@{i}" if len(self._devices) > 1
+                            else str(shape)] = time.perf_counter() - t0
+        if len(seq_out) >= 2:
+            # ≥2 sequence rungs disambiguate per-timestep outputs (dim 1
+            # tracks the padded length) from pooled ones (constant dim 1
+            # that may coincide with ONE rung); a single rung stays on
+            # the dispatch-time shape heuristic
+            self._seq_out_per_timestep = all(w == t
+                                             for t, w in seq_out.items())
+        self._traces_seen = self._trace_cell[0]
+        self._warm = True
+        return timings
+
+    def _run_bucket(self, padded: np.ndarray,
+                    dev_idx: int = 0) -> np.ndarray:
+        exe = self._compile_bucket(tuple(padded.shape),
+                                   dev_idx % len(self._devices))
+        if exe is None:                       # generic-model fallback
+            out = self.model.output(padded)
+            out = out[0] if isinstance(out, list) else out
+            return out.to_numpy()
+        params, states = self._dev_params[dev_idx % len(self._devices)]
+        return np.asarray(exe(params, states,
+                              padded.astype(self._in_dtype, copy=False),
+                              self._key))
+
+    def _run(self, batch: np.ndarray) -> NDArray:
+        """Single-batch path (health probes, sequential mode): the same
+        bucket executables, padded and sliced like any served request."""
+        n = batch.shape[0]
+        bucket = self.ladder.bucket_batch(n)
+        if bucket is None:
+            return super()._run(batch)        # oversize probe: direct
+        padded, _w = pad_rows(batch, bucket)
+        return NDArray(self._run_bucket(padded)[:n])
+
+    # --- request admission ---------------------------------------------
+    def output_async(self, x) -> Future:
+        """Admit one request (see the module docstring's admission rule).
+        Oversize rejections and ladder violations raise SYNCHRONOUSLY —
+        nothing is queued; every admitted request resolves through its
+        future (deadline-bounded via :meth:`output`)."""
+        arr = np.asarray(x.value if isinstance(x, NDArray) else x)
+        if arr.ndim != len(self._feat) + 1:
+            raise ValueError(
+                f"request rank {arr.ndim} does not match the serving "
+                f"input shape (batch, *{self._feat})")
+        if arr.dtype != self._in_dtype:
+            arr = arr.astype(self._in_dtype)
+        prof = OpProfiler.get()
+        with self._lock:
+            # the documented serving REQUEST ordinal (0, 1, 2, ... per
+            # output_async call) — distinct from _req_seq, which ticks
+            # once per queued CHUNK and would leave enqueue-drill
+            # indices unreachable for split requests
+            admit_seq = self._admit_seq
+            self._admit_seq += 1
+        t_real = None
+        if self.ladder.seq_lens is not None:
+            t = int(arr.shape[1])
+            tb = self.ladder.bucket_seq(t)    # oversize seq: raises
+            if arr.shape[2:] != self._feat[1:]:
+                raise ValueError(
+                    f"request feature shape {arr.shape[2:]} does not "
+                    f"match the serving input shape {self._feat[1:]}")
+            if tb != t:
+                arr, _w = pad_rows(arr, tb, axis=1)
+                prof.count("serving/seq_padded")
+            t_real = t
+        elif arr.shape[1:] != self._feat:
+            raise ValueError(
+                f"request feature shape {arr.shape[1:]} does not match "
+                f"the serving input shape {self._feat}")
+        try:
+            chunks = self.ladder.admit(arr.shape[0])
+        except OversizeRequest:
+            prof.count("serving/oversize_rejected")
+            raise
+        fired = faultinject.fault_point("serving/enqueue", admit_seq)
+        del fired  # advisory kinds have no enqueue-side meaning (yet)
+        if len(chunks) == 1:
+            return self._submit(arr, t_real)
+        prof.count("serving/oversize_split")
+        futs, off = [], 0
+        for c in chunks:
+            futs.append(self._submit(arr[off:off + c], t_real))
+            off += c
+        return self._aggregate(futs)
+
+    def _submit(self, arr: np.ndarray, t_real: Optional[int]) -> Future:
+        fut: Future = Future()
+        if self._shutdown:
+            fut.set_exception(RuntimeError(
+                "ServingEngine is shut down; no replicas will serve this "
+                "request"))
+            return fut
+        if self.alive_replicas() == 0:
+            fut.set_exception(RuntimeError(
+                "all serving replicas have been retired; a resurrection "
+                "may be pending — retry, or rebuild the engine"))
+            return fut
+        with self._lock:
+            seq = self._req_seq
+            self._req_seq += 1
+            depth = self._queue.qsize() + 1
+            if depth > self._hwm:
+                self._hwm = depth
+                prof = OpProfiler.get()
+                # the shared gauge is the FLEET high-water: only ever
+                # raise it, or a lightly-loaded engine's write would
+                # mask another engine's backlog
+                if depth > prof.counter_value("serving/queue_depth_hwm"):
+                    prof.gauge("serving/queue_depth_hwm", depth)
+        self._enqueue(_Request(arr, fut, seq, time.monotonic(),
+                               t_real=t_real))
+        return fut
+
+    def _aggregate(self, futs: List[Future]) -> Future:
+        """Recombine a split oversize request: chunk results concatenate
+        in submission order; the first chunk failure fails the whole
+        request (partial answers are worse than retried ones)."""
+        parent: Future = Future()
+        parent.enqueued_at = min(getattr(f, "enqueued_at", time.monotonic())
+                                 for f in futs)
+        remaining = [len(futs)]
+        lock = threading.Lock()
+
+        def one_done(f: Future) -> None:
+            with lock:
+                if parent.done():
+                    return
+                exc = f.exception()
+                if exc is not None:
+                    parent.set_exception(exc)
+                    return
+                remaining[0] -= 1
+                if remaining[0]:
+                    return
+            parts = [fu.result().to_numpy() for fu in futs]
+            parent.set_result(NDArray(np.concatenate(parts, axis=0)))
+
+        for f in futs:
+            f.add_done_callback(one_done)
+        return parent
+
+    # --- continuous-batching drain --------------------------------------
+    def _next_request(self, timeout: float) -> Optional[_Request]:
+        with self._stash_lock:
+            if self._stashq:
+                return self._stashq.popleft()
+        try:
+            return self._queue.get(timeout=max(0.0, timeout))
+        except queue.Empty:
+            return None
+
+    def _stash(self, req: _Request) -> None:
+        """Hold a request this batch cannot take (bucket overflow or a
+        non-batch-shape mismatch) for the NEXT batch — stashed requests
+        outrank the queue, so nothing is starved or reordered past one
+        batch."""
+        with self._stash_lock:
+            self._stashq.append(req)
+
+    def _drain(self, worker_id: int) -> None:
+        prof = OpProfiler.get()
+        with self._lock:
+            if worker_id not in self._dev_of:
+                # claim a pinned-device slot: a retired worker's freed
+                # slot first (the replacement takes over its chip),
+                # round-robin otherwise (the startup pool)
+                self._dev_of[worker_id] = (
+                    self._dev_free.pop() if self._dev_free
+                    else worker_id % len(self._devices))
+        while not self._shutdown:
+            first = self._next_request(0.1)
+            if first is None:
+                continue
+            batch, rows = [first], first.n
+            shape_tail = first.arr.shape[1:]
+            # fill toward the LARGEST bucket under one absolute deadline
+            # (continuous batching: the window caps added latency, the
+            # ladder caps the fill)
+            deadline = time.monotonic() + self.max_wait_s
+            while rows < self.ladder.max_batch:
+                nxt = self._next_request(deadline - time.monotonic())
+                if nxt is None:
+                    break
+                if (nxt.arr.shape[1:] != shape_tail
+                        or rows + nxt.n > self.ladder.max_batch):
+                    self._stash(nxt)
+                    break
+                batch.append(nxt)
+                rows += nxt.n
+            with self._lock:
+                self._busy += 1
+            try:
+                self._dispatch(worker_id, batch, rows, prof)
+            except faultinject.DeadReplicaFault:
+                return          # replica retired inside _dispatch
+            finally:
+                with self._lock:
+                    self._busy -= 1
+        with self._lock:
+            self._alive -= 1
+
+    def _dispatch(self, worker_id: int, batch: List[_Request], rows: int,
+                  prof) -> None:
+        with self._lock:
+            ordinal = self._batch_seq
+            self._batch_seq += 1
+        try:
+            faultinject.fault_point("serving/dispatch", ordinal)
+        except faultinject.TransientFault:
+            # one deterministic requeue-and-retry (drill for the retry
+            # path); the requests keep their queue-entry timestamps
+            self._requeue(batch, faultinject.TransientFault(
+                "serving dispatch retry budget exhausted"))
+            return
+        except faultinject.DeadReplicaFault as e:
+            self._retire_serving(worker_id, e, batch)
+            raise
+        bucket = self.ladder.bucket_batch(rows)
+        merged = (batch[0].arr if len(batch) == 1
+                  else np.concatenate([r.arr for r in batch], axis=0))
+        padded, _w = pad_rows(merged, bucket)
+        try:
+            with prof.time_section("serving/dispatch"):
+                result = self._run_bucket(
+                    padded, self._dev_of.get(worker_id, 0))
+        except faultinject.DeadReplicaFault as e:
+            self._retire_serving(worker_id, e, batch)
+            raise
+        except Exception as e:
+            prof.count("serving/batch_errors")
+            for r in batch:
+                if not r.fut.done():
+                    r.fut.set_exception(e)
+            return
+        except BaseException as e:
+            # bookkeeping parity with ParallelInference._serve_batch: an
+            # injected SimulatedCrash must still retire cleanly
+            self._retire(worker_id, e, [r.fut for r in batch])
+            raise
+        self._probe_input = padded[:1].copy()
+        t_done = time.monotonic()
+        t_pad = padded.shape[1] if padded.ndim >= 2 else None
+        off = 0
+        lats = []
+        for r in batch:
+            out = result[off:off + r.n]
+            off += r.n
+            if (r.t_real is not None and out.ndim >= 2
+                    and out.shape[1] == t_pad
+                    and self._seq_out_per_timestep is not False):
+                # per-timestep output: slice the sequence pad back off.
+                # warmup probes the ladder to rule OUT pooled outputs
+                # whose width merely coincides with one sequence rung
+                out = out[:, :r.t_real]
+            lats.append(t_done - r.t_enq)
+            r.fut.set_result(NDArray(out))
+        with self._lat_lock:
+            self._latencies.extend(lats)
+        prof.count("serving/requests", len(batch))
+        prof.count("serving/batches")
+        prof.count("serving/rows", rows)
+        prof.count("serving/pad_rows", bucket - rows)
+        prof.count("serving/capacity_rows", bucket)
+        if self._warm:
+            traces = self._trace_cell[0]
+            if traces > self._traces_seen:
+                # the one thing steady-state serving must never do
+                prof.count("serving/traces_after_warmup",
+                           traces - self._traces_seen)
+                self._traces_seen = traces
+                logger.warning("serving traced AFTER warmup (shape %s) — "
+                               "a bucket escaped the warmup set",
+                               padded.shape)
+
+    def _requeue(self, batch: List[_Request], exhausted_exc) -> None:
+        prof = OpProfiler.get()
+        for r in batch:
+            r.attempts += 1
+            if r.attempts > self.max_requeues:
+                if not r.fut.done():
+                    r.fut.set_exception(exhausted_exc)
+                continue
+            try:
+                self._queue.put_nowait(r)
+            except queue.Full:
+                if not r.fut.done():
+                    r.fut.set_exception(TimeoutError(
+                        "serving queue full while requeueing a request "
+                        "from a retired replica"))
+                continue
+            # only a requeue that actually landed is a ride-through
+            prof.count("serving/requeued")
+
+    def _retire_serving(self, worker_id: int, exc: BaseException,
+                        batch: List[_Request]) -> None:
+        """Retirement TRANSPARENT to in-flight requests: requeue the
+        dying replica's batch (bounded by ``max_requeues``) so surviving
+        replicas serve it, then run the pool's shared retirement
+        bookkeeping (which fails whatever is queued if this was the LAST
+        replica — bounded latency outranks transparency — and schedules
+        resurrection)."""
+        self._requeue(batch, exc)
+        with self._lock:
+            # free the dead worker's pinned-device slot for its
+            # resurrected replacement
+            dev = self._dev_of.pop(worker_id, None)
+            if dev is not None:
+                self._dev_free.append(dev)
+        self._retire(worker_id, exc, [])      # casualties already failed
+
+    def _probe(self) -> None:
+        """Resurrection health probe on the device slot the REPLACEMENT
+        will claim — the base class probes through ``_run``, which always
+        dispatches on device 0 and would validate a healthy chip while
+        refilling a dead one's slot."""
+        faultinject.fault_point("inference/probe", self._next_probe_seq())
+        probe = self._probe_input
+        if probe is None:
+            return
+        with self._lock:
+            dev = self._dev_free[-1] if self._dev_free else 0
+        bucket = self.ladder.bucket_batch(probe.shape[0])
+        if bucket is None:
+            self._run(probe)
+            return
+        padded, _w = pad_rows(probe, bucket)
+        self._run_bucket(padded, dev)
+
+    def shutdown(self, drain_timeout_s: float = 2.0) -> None:
+        super().shutdown(drain_timeout_s)
+        # out of the health census: a shut-down engine must not report
+        # itself (or its stale latency window) as live serving capacity
+        _ENGINES.discard(self)
+
+    def _fail_queued(self, exc) -> int:
+        """The stash is queue too: a request held for the next batch must
+        fail with the rest when the pool dies or shuts down — the base
+        contract ('no waiter is left hanging') covers both stores."""
+        n = super()._fail_queued(exc)
+        while True:
+            with self._stash_lock:
+                if not self._stashq:
+                    return n
+                req = self._stashq.popleft()
+            if not req.fut.done():
+                req.fut.set_exception(exc)
+                n += 1
+
+    # --- stats ----------------------------------------------------------
+    def latency_stats(self) -> Dict[str, float]:
+        """Rolling p50/p99 over the last ≤4096 served requests, in ms."""
+        with self._lat_lock:
+            window = list(self._latencies)
+        if not window:
+            return {"window": 0}
+        arr = np.asarray(window) * 1e3
+        return {"window": len(window),
+                "p50_ms": float(np.percentile(arr, 50)),
+                "p99_ms": float(np.percentile(arr, 99)),
+                "max_ms": float(arr.max())}
+
+    def serving_stats(self) -> Dict[str, Any]:
+        """This engine's census for :func:`serving_health`: pool
+        live/retired/resurrected, bucket/warmup state, queue-depth
+        high-water, rolling latency quantiles."""
+        out: Dict[str, Any] = dict(self.pool_stats())
+        out.update(self.latency_stats())
+        with self._exec_lock:
+            out["buckets_compiled"] = len(self._exec)
+        out["warm"] = self._warm
+        out["queue_depth_hwm"] = self._hwm
+        out["bf16"] = self._bf16
+        return out
+
+
+def serving_health() -> Dict[str, Any]:
+    """The ``/api/health`` "serving" section: the profiler's
+    ``serving_stats()`` ledger (requests, batches, fill ratio, pad waste,
+    traces-after-warmup, dispatch/warmup time) merged with a per-engine
+    census and the rolling latency quantiles only the engines hold."""
+    out: Dict[str, Any] = dict(OpProfiler.get().serving_stats())
+    engines = list(_ENGINES)
+    out["engines"] = len(engines)
+    if engines:
+        out["engine_stats"] = [e.serving_stats() for e in engines]
+        samples: List[float] = []
+        for e in engines:
+            with e._lat_lock:
+                samples.extend(e._latencies)
+        if samples:
+            arr = np.asarray(samples) * 1e3
+            out["latency_p50_ms"] = float(np.percentile(arr, 50))
+            out["latency_p99_ms"] = float(np.percentile(arr, 99))
+    return out

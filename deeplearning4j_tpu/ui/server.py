@@ -11,6 +11,11 @@ A stdlib ``http.server`` on a background thread serving:
 - ``/api/tags``         — JSON list of scalar tags across attached stores
 - ``/api/series?tag=t`` — JSON ``[[step, value], ...]`` for one tag
 - ``/healthz``          — liveness
+- ``/api/infer``        — POST ``{"inputs": [[...], ...]}`` → the attached
+                          :class:`parallel.serving.ServingEngine` (bucketed,
+                          AOT-compiled, deadline-bounded); response carries
+                          outputs + server-side latency. 503 until
+                          ``attach_serving`` wires an engine.
 
 Any attached :class:`InMemoryStatsStorage` (queried live) or JSONL path
 written by :class:`FileStatsStorage` (re-read per request) feeds the
@@ -212,6 +217,7 @@ class UIServer:
         # records POSTed by RemoteUIStatsStorageRouter clients
         self._remote = InMemoryStatsStorage()
         self._stores.append(self._remote)
+        self._serving = None    # ServingEngine behind /api/infer
 
     @classmethod
     def get_instance(cls) -> "UIServer":
@@ -267,11 +273,20 @@ class UIServer:
             return read_graph_log(path)["graph"] or {}
         return getattr(self, "_graph", None) or {}
 
+    def attach_serving(self, engine) -> "UIServer":
+        """Expose a :class:`parallel.serving.ServingEngine` (or any object
+        with deadline-bounded ``output(ndarray)``) on ``POST /api/infer``.
+        Replica retirement/resurrection stays inside the engine — the
+        endpoint never needs to know a replica died."""
+        self._serving = engine
+        return self
+
     def detach_all(self) -> None:
         self._stores = [self._remote]
         self._paths = []
         self._graph = None
         self._graph_path = None
+        self._serving = None
 
     # -- data ------------------------------------------------------------
     def _records(self) -> List[Dict[str, Any]]:
@@ -298,11 +313,14 @@ class UIServer:
         counters), the collective-exchange ledger (bytes per collective
         kind, ZeRO-1 sharded-updater footprint, encoded-exchange density),
         the elastic ledger (online resizes, grow-back probes, the live
-        worker gauge), and the inference-pool census
-        (live/retired/resurrected replicas)."""
+        worker gauge), the inference-pool census
+        (live/retired/resurrected replicas), and the serving ledger
+        (requests/batches, bucket fill ratio, pad waste, queue-depth
+        high-water, rolling p50/p99 latency, traces-after-warmup)."""
         from ..common.profiler import OpProfiler
         from ..common.system_info import memory_summary
         from ..parallel.inference import pool_health
+        from ..parallel.serving import serving_health
 
         n = sum(len(getattr(s, "records", ())) for s in self._stores)
         for p in self._paths:
@@ -323,6 +341,7 @@ class UIServer:
                 "collectives": prof.collective_stats(),
                 "elastic": prof.elastic_stats(),
                 "inference": pool_health(),
+                "serving": serving_health(),
                 **memory_summary()}
 
     def sessions(self) -> List[str]:
@@ -408,11 +427,68 @@ class UIServer:
                 else:
                     self._send(b"not found", "text/plain", 404)
 
+            def _infer(self):
+                # the serving endpoint: one JSON request → one bucketed,
+                # deadline-bounded engine call. Thread-per-request
+                # (ThreadingHTTPServer) feeds the engine's continuous
+                # batcher, so concurrent HTTP clients coalesce into
+                # shared bucket dispatches exactly like direct callers.
+                import numpy as np
+
+                from ..parallel.serving import OversizeRequest
+
+                engine = getattr(ui, "_serving", None)
+                if engine is None:
+                    self._send(b"no serving engine attached "
+                               b"(UIServer.attach_serving)", "text/plain",
+                               503)
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n).decode())
+                    inputs = np.asarray(body["inputs"], dtype=np.float32)
+                except (ValueError, KeyError, TypeError) as e:
+                    self._send(f"bad request: {e}".encode(), "text/plain",
+                               400)
+                    return
+                t0 = time.monotonic()
+                try:
+                    out = engine.output(inputs)
+                except OversizeRequest as e:
+                    self._send(str(e).encode(), "text/plain", 413)
+                    return
+                except ValueError as e:      # shape/rank mismatch
+                    self._send(str(e).encode(), "text/plain", 400)
+                    return
+                except TimeoutError as e:    # deadline expired in queue
+                    self._send(str(e).encode(), "text/plain", 504)
+                    return
+                except RuntimeError as e:    # pool retired / shut down
+                    self._send(str(e).encode(), "text/plain", 503)
+                    return
+                except Exception as e:
+                    # a model/XLA failure scattered through the future
+                    # must reach the client as a status code, not a
+                    # dropped connection
+                    self._send(f"inference failed: "
+                               f"{type(e).__name__}: {e}".encode(),
+                               "text/plain", 500)
+                    return
+                payload = {"outputs": out.to_numpy().tolist(),
+                           "shape": list(out.shape),
+                           "latency_ms": round(
+                               (time.monotonic() - t0) * 1e3, 3)}
+                self._send(json.dumps(payload).encode(),
+                           "application/json")
+
             def do_POST(self):
                 # remote stats ingestion (reference
                 # RemoteUIStatsStorageRouter: workers POST their updates
                 # to the UI server)
                 u = urlparse(self.path)
+                if u.path == "/api/infer":
+                    self._infer()
+                    return
                 if u.path != "/api/post":
                     self._send(b"not found", "text/plain", 404)
                     return
