@@ -226,6 +226,12 @@ def bench_resnet50(steps: int, batch: int = 64, image_size: int = 224,
     from deeplearning4j_tpu.data import DataSet
 
     model = _resnet50_model(image_size)
+    # the in-graph MFU tier (ISSUE 8): flat-bucket fused weight update +
+    # bf16 updater state w/ stochastic rounding — the flagship trains
+    # with the full hot-path stack on (mfu-smoke A/B-gates the tier;
+    # here it reports the footprint win alongside throughput)
+    model.conf.global_conf.fused_update = True
+    model.conf.global_conf.updater.state_dtype = "bfloat16"
     if with_listener:
         from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
 
@@ -249,10 +255,22 @@ def bench_resnet50(steps: int, batch: int = 64, image_size: int = 224,
     flops = _flops_per_step(
         model, (model._params, model._states, model._updater_state, inputs,
                 labels, {}, jax.random.PRNGKey(0), jnp.asarray(0)))
+    from deeplearning4j_tpu.common.profiler import OpProfiler
+    from deeplearning4j_tpu.learning.precision import updater_state_bytes
+
+    state_bytes = updater_state_bytes(jax.device_get(model._updater_state))
+    pstats = OpProfiler.get().precision_stats()
     return _summarize(
         "resnet50_imagenet_train", times, batch, flops,
         jax.devices()[0].platform,
-        {"image_size": image_size, "dtype": "bf16 compute / fp32 params",
+        {"image_size": image_size,
+         "dtype": "bf16 compute / fp32 params / bf16 updater state "
+                  "(fused flat-bucket update)",
+         # the BENCH_r* trajectory captures the footprint win, not just
+         # img/s: state bytes by dtype + the fused-kernel hit ledger
+         "updater_state_bytes": state_bytes,
+         "fused_kernel": {k: int(v) for k, v in pstats.items()
+                          if k.startswith("fused_") or k == "sr_draws"},
          "data": "synthetic batch, device-resident (train-step config; the "
                  "disk-fed input pipeline is the resnet50-disk config)",
          "listener": with_listener})
@@ -1278,6 +1296,264 @@ def bench_zero1_smoke(steps: int, batch: int = 64, workers: int = 4) -> dict:
     }
 
 
+def bench_mfu_smoke(steps: int, batch: int = 64) -> dict:
+    """CPU-friendly smoke of the in-graph MFU tier (ISSUE 8): the
+    flagship LeNet config with an Adam updater trained three ways —
+    per-leaf fp32 baseline (A), fused flat-bucket update (B), fused +
+    bf16 updater state with stochastic rounding (C) — interleaved A/B
+    timing, same estimator as zero1-smoke. Self-validating hard-fails:
+
+    - fused fp32 kernel not BITWISE-identical to the per-leaf reference
+      at the kernel level (fused_apply vs updater.apply on the warmed
+      model's real param/grad trees, production mode);
+    - fit-level fused fp32 params drifting past the documented ulp bound
+      (1e-6 — XLA's fma contraction on the flat shape, nothing more);
+    - bf16-state parity outside the documented envelope
+      (|Δ| <= 1e-3 + 0.05*|ref| per step loss and final params);
+    - updater-state footprint above 0.55x fp32 (the halving is the
+      point: moments are the whole Adam state);
+    - any retrace delta between configs, or any retrace inside a timed
+      window;
+    - step-time regression (ratio of min-over-interleaved-rounds — the
+      additive-noise-robust estimator): fused fp32 > 12% over base on
+      CPU (quiet-box truth is +1-3%; shared runners resolve no finer
+      than ~±10%, and the budget still catches an accidental per-leaf
+      fallback), fused+bf16 > 20% on CPU (adds the software-threefry SR
+      draws); both 5% on TPU where timing is clean and the PRNG is
+      hardware;
+    - fused epilogue: inference parity break vs the dense ops on a
+      residual BN block, or an empty precision ledger.
+
+    Emits the precision ledger alongside the timing."""
+    import statistics as _stats
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.common.profiler import OpProfiler
+    from deeplearning4j_tpu.data import NDArrayDataSetIterator
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.learning.precision import updater_state_bytes
+    from deeplearning4j_tpu.ndarray.rng import set_default_seed
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.ops import pallas_update
+    from deeplearning4j_tpu.optimize.listeners import (
+        CollectScoresIterationListener)
+    from deeplearning4j_tpu.parallel import Zero1Plan
+
+    def fail(msg, **extra):
+        print(json.dumps({"error": msg, **extra}))
+        sys.exit(1)
+
+    rng = np.random.RandomState(0)
+    n = steps * batch
+    x = rng.randn(n, 1, 28, 28).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+
+    def make_it():
+        return NDArrayDataSetIterator(x, y, batch_size=batch)
+
+    def build(fused: bool, state_dtype):
+        set_default_seed(99)
+        upd = Adam(learning_rate=1e-3)
+        upd.state_dtype = state_dtype
+        b = (NeuralNetConfiguration.builder().seed(123).updater(upd)
+             .activation("relu").weight_init("xavier"))
+        if fused:
+            b = b.fused_update()
+        conf = (b.list()
+                .layer(L.ConvolutionLayer(n_out=20, kernel_size=(5, 5)))
+                .layer(L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(L.ConvolutionLayer(n_out=50, kernel_size=(5, 5)))
+                .layer(L.SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(L.DenseLayer(n_out=500))
+                .layer(L.OutputLayer(n_out=10, loss="mcxent",
+                                     activation="softmax"))
+                .set_input_type(InputType.convolutional(28, 28, 1))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    prof = OpProfiler.get()
+    configs = {"base": (False, None), "fused": (True, None),
+               "fused16": (True, "bfloat16")}
+    models, seqs, warm = {}, {}, {}
+    for name, (fused, sd) in configs.items():
+        m = build(fused, sd)
+        scores = CollectScoresIterationListener()
+        m.set_listeners(scores)
+        prof.reset()
+        m.fit(make_it(), epochs=1, batch_size=batch)
+        float(m._score_dev)
+        warm[name] = prof.trace_counts()
+        seqs[name] = [s for _, s in scores.scores]
+        models[name] = m
+
+    # the warm fits' trace-time precision counters (reset below wipes
+    # them before the timed windows)
+    fit_ledger = prof.precision_stats()
+
+    # --- gate 1: kernel-level bitwise (production mode, real trees) ----
+    base = models["base"]
+    params = jax.tree.map(jnp.asarray, jax.device_get(base._params))
+    grads = jax.tree.map(
+        lambda p: (jax.random.normal(jax.random.PRNGKey(7), p.shape)
+                   * 0.01).astype(p.dtype), params)
+    upd = Adam(learning_rate=1e-3)
+    state = upd.init(params)
+    ref_p, ref_s = upd.apply(grads, state, params, 5)
+    plan = Zero1Plan(params, 1)
+    # the bitwise invariant is mode-local to "xla" (pallas_update doc:
+    # the kernel's own compile may fma-contract, ulp-bounded) — pin the
+    # mode so the gate cannot flake on TPU where default is "pallas"
+    nf, ns = pallas_update.fused_apply(
+        upd, plan.flatten(params), plan.flatten(grads),
+        plan.flatten_state(state, xp=jnp), 5, None, mode="xla")
+    got_p = plan.unflatten(nf)
+    got_s = {k: plan.unflatten(v, xp=jnp) for k, v in ns.items()}
+    for a, b in zip(jax.tree.leaves(jax.device_get((ref_p, ref_s))),
+                    jax.tree.leaves(jax.device_get((got_p, got_s)))):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            fail("fused fp32 kernel (mode=xla) is not bitwise-identical "
+                 "to the per-leaf reference")
+
+    # --- gate 2: fit-level parity envelopes ----------------------------
+    for a, b in zip(jax.tree.leaves(jax.device_get(base._params)),
+                    jax.tree.leaves(jax.device_get(
+                        models["fused"]._params))):
+        d = float(np.max(np.abs(a - b)))
+        if d > 1e-6:
+            fail(f"fused fp32 fit-level param drift {d:.2e} exceeds the "
+                 "documented 1e-6 ulp bound")
+    for s_a, s_c in zip(seqs["base"], seqs["fused16"]):
+        if abs(s_a - s_c) > 1e-3 + 0.05 * abs(s_a):
+            fail("bf16-state loss parity outside the documented envelope",
+                 base=s_a, fused16=s_c)
+    for a, c in zip(jax.tree.leaves(jax.device_get(base._params)),
+                    jax.tree.leaves(jax.device_get(
+                        models["fused16"]._params))):
+        d = float(np.max(np.abs(a - c)))
+        # param trajectories accumulate zero-mean rounding noise and
+        # wander apart chaotically — the per-step loss envelope above is
+        # the numerics gate; this one only catches gross divergence
+        if d > 0.01 + 0.1 * float(np.max(np.abs(a))):
+            fail(f"bf16-state param divergence {d:.2e} is gross, not "
+                 "rounding noise")
+
+    # --- gate 3: compile footprint + state bytes -----------------------
+    if not (warm["base"] == warm["fused"] == warm["fused16"]):
+        fail("retrace delta between configs", traces=warm)
+    bytes_a = updater_state_bytes(jax.device_get(base._updater_state))
+    bytes_c = updater_state_bytes(
+        jax.device_get(models["fused16"]._updater_state))
+    if bytes_c["total"] > 0.55 * bytes_a["total"]:
+        fail("bf16 updater-state footprint above 0.55x fp32",
+             fp32_bytes=bytes_a["total"], bf16_bytes=bytes_c["total"])
+
+    # --- gate 4: interleaved A/B step time -----------------------------
+    # Two budgets: the FUSION must be free (fused fp32 vs base ≤5% —
+    # measured ~+1% CPU), while the bf16-state config additionally pays
+    # the stochastic-rounding draws (one threefry uint32 per state
+    # element per step — ~10% on CPU where the PRNG is software; on TPU
+    # the hardware PRNG makes it ~free) → ≤20% CPU budget, and its real
+    # win (0.5x state bytes) is gated above.
+    def timed_epoch(name):
+        t0 = time.perf_counter()
+        models[name].fit(make_it(), epochs=1, batch_size=batch)
+        float(models[name]._score_dev)
+        return time.perf_counter() - t0
+
+    for name in ("fused16", "fused", "base"):     # settle round, untimed
+        timed_epoch(name)
+    prof.reset()
+    times = {name: [] for name in configs}
+    for r in range(10):
+        order = (("fused16", "fused", "base") if r % 2 == 0
+                 else ("base", "fused", "fused16"))
+        for name in order:
+            times[name].append(timed_epoch(name))
+    hot = prof.trace_counts()
+    if any(hot.values()):
+        fail("train step retraced inside a timed window", traces=hot)
+    t_base = _stats.median(times["base"])
+    t_fused = _stats.median(times["fused16"])
+    # build boxes carry bursty background load (2x per-epoch swings
+    # observed); that noise is strictly ADDITIVE, so the min over rounds
+    # is the unloaded estimate — gate on min ratios, report medians
+    reg_fused = min(times["fused"]) / min(times["base"]) - 1.0
+    reg_16 = min(times["fused16"]) / min(times["base"]) - 1.0
+    # CPU budget calibration: quiet-box truth is fused ~+1-3%, but shared
+    # build runners resolve no finer than ~±10% even with min-over-rounds
+    # (measured: the same config's rounds spread 2x under load bursts).
+    # The budgets below catch gross regressions (an accidental per-leaf
+    # fallback roughly doubles update cost); the sharp gates in this
+    # smoke are parity / footprint / retrace. On TPU the timing floor is
+    # clean — hold both paths to 5%.
+    on_cpu = jax.devices()[0].platform == "cpu"
+    budget_fused = 0.12 if on_cpu else 0.05
+    if reg_fused > budget_fused:
+        fail(f"fused-update step-time regression {reg_fused:.1%} exceeds "
+             f"the {budget_fused:.0%} budget",
+             **{f"{k}_times": [round(t, 4) for t in v]
+                for k, v in times.items()})
+    budget_16 = 0.20 if on_cpu else 0.05
+    if reg_16 > budget_16:
+        fail(f"fused+bf16 step-time regression {reg_16:.1%} exceeds the "
+             f"{budget_16:.0%} budget (SR draws included)",
+             **{f"{k}_times": [round(t, 4) for t in v]
+                for k, v in times.items()})
+
+    # --- gate 5: fused epilogue (inference tier) -----------------------
+    prof.reset()
+    from deeplearning4j_tpu.ops import pallas_epilogue
+    from deeplearning4j_tpu.ops.registry import get_op
+
+    erng = np.random.default_rng(3)
+    ex = jnp.asarray(erng.normal(size=(4, 256, 7, 7)), jnp.float32)
+    em = jnp.asarray(erng.normal(size=256), jnp.float32)
+    ev = jnp.asarray(erng.uniform(0.5, 2.0, size=256), jnp.float32)
+    eg = jnp.asarray(erng.normal(size=256), jnp.float32)
+    eb = jnp.asarray(erng.normal(size=256), jnp.float32)
+    eres = jnp.asarray(erng.normal(size=(4, 256, 7, 7)), jnp.float32)
+    fused_out = pallas_epilogue.bn_act(ex, em, ev, eg, eb, axis=1,
+                                       act="relu", residual=eres)
+    dense_out = jnp.maximum(get_op("batchnorm").fn(
+        ex, em, ev, eg, eb, axis=1) + eres, 0)
+    if fused_out is None or not np.allclose(
+            np.asarray(fused_out), np.asarray(dense_out),
+            rtol=1e-5, atol=1e-5):
+        fail("fused epilogue parity break vs dense ops")
+    pstats = prof.precision_stats()
+    if not pstats.get("epilogue_hits"):
+        fail("precision ledger empty after epilogue run", ledger=pstats)
+
+    return {
+        "metric": "mfu_smoke",
+        "value": n / t_fused,
+        "unit": "images/sec",
+        "batch": batch,
+        "platform": jax.devices()[0].platform,
+        "traces": warm["fused16"],
+        "kernel_parity": "bitwise",
+        "fit_parity_fp32": "<=1e-6",
+        "bf16_envelope": "|d| <= 1e-3 + 0.05|ref|",
+        "parity_steps_compared": len(seqs["base"]),
+        "step_time_ratio_fused_vs_base": round(1.0 + reg_fused, 4),
+        "step_time_ratio_fused16_vs_base": round(1.0 + reg_16, 4),
+        "epoch_s_base_median": round(t_base, 4),
+        "epoch_s_fused16_median": round(t_fused, 4),
+        "updater_state_bytes_fp32": bytes_a["total"],
+        "updater_state_bytes_bf16": bytes_c["total"],
+        "state_bytes_ratio": round(bytes_c["total"] / bytes_a["total"], 4),
+        "precision_ledger": {k: (round(v, 5) if isinstance(v, float)
+                                 else v)
+                             for k, v in {**fit_ledger, **pstats}.items()},
+        "data": "synthetic LeNet batches; per-leaf fp32 vs fused vs "
+                "fused+bf16-state epochs interleaved",
+    }
+
+
 def bench_elastic_smoke(steps: int, batch: int = 64, workers: int = 4) -> dict:
     """CPU-friendly smoke of ONLINE elastic resize (ISSUE 6; ROADMAP item
     4(b)): the flagship LeNet config through ParallelWrapper with the
@@ -1954,7 +2230,7 @@ def main() -> None:
                                  "pipeline-smoke", "telemetry-smoke",
                                  "fault-smoke", "supervisor-smoke",
                                  "zero1-smoke", "elastic-smoke",
-                                 "serving-smoke"])
+                                 "serving-smoke", "mfu-smoke"])
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--batch", type=int, default=None,
                         help="per-config default: resnet50=128, bert=32")
@@ -2038,6 +2314,8 @@ def main() -> None:
         result = bench_supervisor_smoke(steps, batch=args.batch or 64)
     elif args.config == "zero1-smoke":
         result = bench_zero1_smoke(steps, batch=args.batch or 64)
+    elif args.config == "mfu-smoke":
+        result = bench_mfu_smoke(steps, batch=args.batch or 64)
     elif args.config == "elastic-smoke":
         result = bench_elastic_smoke(steps, batch=args.batch or 64)
     elif args.config == "serving-smoke":

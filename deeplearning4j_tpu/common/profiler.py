@@ -251,6 +251,21 @@ class OpProfiler:
                 out[key.replace("_s", "_count")] = s["count"]
         return out
 
+    def precision_stats(self) -> Dict[str, float]:
+        """Mixed-precision ledger (``precision/*`` counters): fused
+        update-kernel hits split by execution engine (``fused_buckets_
+        pallas`` vs ``fused_buckets_xla``) and the fallbacks onto the
+        per-leaf path, the fused BN epilogue hits / residual-chain hits /
+        shape-gate fallbacks, the stochastic-rounding draw count baked
+        into the compiled step (``sr_draws`` — uint32 per element per
+        trace), and the live updater-state byte gauges by dtype
+        (``updater_state_bytes_<dtype>`` + ``_total`` — the footprint
+        the bf16 state mode halves). Counters are trace-time (one bump
+        per compiled step, not per execution); byte gauges are levels.
+        Empty until a fit or fused inference runs."""
+        return {k.split("/", 1)[1]: v for k, v in self._counters.items()
+                if k.startswith("precision/")}
+
     def fault_stats(self) -> Dict[str, float]:
         """Fault-tolerance ledger: injected-fault counters
         (``faults/<site>/<kind>``), pipeline retry count, and backoff wall

@@ -1,0 +1,194 @@
+"""Mixed-precision policy for the training hot path.
+
+One module owns every dtype-boundary rule in the stack (the fp32-boundary
+doc the serving tier and the trainer used to state separately):
+
+- **Compute** may run in bfloat16 (``GlobalConf.compute_dtype``): params and
+  activations cast down for the MXU, loss head and reductions in float32,
+  gradients flow back to fp32 master params. (Implemented by the models;
+  this module is the shared cast helper.)
+- **Inference params** may be served in bfloat16
+  (``ServingEngine.Builder.bf16``): one cast at startup, float32 at the API
+  boundary. :func:`cast_floating` here is THE cast both sides use.
+- **Updater state** may be *stored* in bfloat16
+  (``updater.state_dtype = "bfloat16"``): moments live in bf16 (half the
+  optimizer HBM; under ZeRO-1 half of the already-1/N per-replica
+  footprint), the update math still runs in float32 (:func:`apply_updater`
+  upcasts, applies the untouched fp32 updater, and writes the new moments
+  back down with **stochastic rounding** driven by the step's existing RNG
+  stream), so the parameter update itself never sees bf16 arithmetic.
+
+Why stochastic rounding: deterministic round-to-nearest of a bf16
+accumulator loses every increment smaller than ~2^-8 of the stored value —
+an EMA like Adam's second moment simply stops moving once
+``(1-beta2)*g^2`` drops below the rounding ulp. Rounding *stochastically*
+(up with probability proportional to the dropped fraction) makes the
+stored moment an unbiased estimator of the fp32 one: E[SR(x)] == x, so
+the error is zero-mean noise instead of a systematic stall
+(tests/test_precision.py pins the unbiasedness).
+
+Documented numerics envelope (pinned by tests and the ``mfu-smoke``
+bench): with ``state_dtype="bfloat16"`` the per-step training loss tracks
+the fp32-state run within ``|Δ| <= 1e-3 + 0.05 * |loss|`` over the smoke
+horizon. Parameters stay fp32; their trajectories accumulate the
+zero-mean rounding noise and so wander apart chaotically rather than
+tracking element-wise — measured ≲1e-2 absolute over the smoke horizon,
+gated as gross-divergence-only (``0.01 + 0.1*|p|``). The fp32-state path
+is bit-identical to the per-leaf reference — ``state_dtype=None``
+changes NOTHING.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..common.profiler import OpProfiler
+
+Pytree = Any
+
+# fold_in tags deriving the stochastic-rounding stream from the step key —
+# distinct from the dropout splits (which use jax.random.split) and from
+# each other, so no RNG draw is ever consumed twice
+SR_STREAM_TAG = 0x5AD0
+
+
+def cast_floating(tree: Pytree, dtype) -> Pytree:
+    """Cast every floating leaf of ``tree`` to ``dtype`` (round-to-nearest),
+    leaving integer/bool leaves untouched. THE shared fp32-boundary cast:
+    serving's bf16 inference params and the trainer's updater-state
+    up/down casts all route through here."""
+    dt = jnp.dtype(dtype)
+
+    def c(a):
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating):
+            return jnp.asarray(a, dt)
+        return a
+
+    return jax.tree.map(c, tree)
+
+
+def stochastic_round(x, rbits, dtype=jnp.bfloat16):
+    """float32 ``x`` → ``dtype`` (bfloat16) with stochastic rounding.
+
+    ``rbits``: uint32 random bits, same shape as ``x`` — only the LOW 16
+    bits are consumed (callers holding one uint32 draw per element can
+    spend the high halfword on a second tensor; see
+    :func:`ops.pallas_update.fused_apply`).
+
+    Mechanics: bf16 is the top 16 bits of the fp32 pattern, and for a
+    fixed exponent the 2^16 droppable mantissa patterns are equidistant —
+    adding a uniform 16-bit integer to the fp32 bits and truncating
+    therefore rounds up with probability exactly (dropped bits)/2^16:
+    E[SR(x)] == x. Carries propagate into the exponent correctly (IEEE
+    ordering), overflow past the largest finite value rounds to ±inf (the
+    round-up neighbor), and non-finite inputs pass through untouched.
+
+    Pure jnp/lax elementwise — traces identically into XLA and into a
+    Pallas kernel body, so the fused and unfused paths agree bit-for-bit
+    given the same ``rbits``.
+    """
+    if jnp.dtype(dtype) != jnp.bfloat16:
+        raise NotImplementedError(
+            f"stochastic rounding targets bfloat16 (top half of the fp32 "
+            f"pattern); got {dtype}")
+    x32 = x.astype(jnp.float32)
+    u = lax.bitcast_convert_type(x32, jnp.uint32)
+    u = u + (rbits.astype(jnp.uint32) & jnp.uint32(0xFFFF))
+    u = u & jnp.uint32(0xFFFF0000)
+    rounded = lax.bitcast_convert_type(u, jnp.float32).astype(jnp.bfloat16)
+    return jnp.where(jnp.isfinite(x32), rounded, x32.astype(jnp.bfloat16))
+
+
+def random_bits_for(key, shape) -> jnp.ndarray:
+    """One uint32 of randomness per element, counted in the profiler's
+    ``precision/sr_draws`` ledger. The counter bumps at TRACE time (the
+    Python body only runs while jax traces), so it records the draws
+    baked into one compiled step — the per-execution draw count of every
+    step that executable runs."""
+    n = 1
+    for d in shape:
+        n *= int(d)
+    OpProfiler.get().count("precision/sr_draws", n)
+    return jax.random.bits(key, shape, dtype=jnp.uint32)
+
+
+def sr_cast_state(state: Pytree, dtype, key) -> Pytree:
+    """Stochastically round every floating leaf of an (fp32) updater-state
+    tree down to ``dtype``, each leaf on its own fold_in-derived stream."""
+    leaves, treedef = jax.tree.flatten(state)
+    out = []
+    for i, leaf in enumerate(leaves):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype,
+                                                     jnp.floating):
+            sub = jax.random.fold_in(key, i)
+            bits = random_bits_for(sub, leaf.shape)
+            out.append(stochastic_round(leaf, bits, dtype))
+        else:
+            out.append(leaf)
+    return jax.tree.unflatten(treedef, out)
+
+
+def state_dtype_of(updater) -> Optional[str]:
+    """The configured low-precision state dtype, or None for fp32."""
+    sd = getattr(updater, "state_dtype", None)
+    return str(jnp.dtype(sd)) if sd else None
+
+
+def apply_updater(updater, grads, state, params, iteration, key=None):
+    """THE updater dispatch every step core routes through.
+
+    fp32 state (``state_dtype`` unset): exactly ``updater.apply`` —
+    bit-identical to the historical path. Low-precision state: upcast the
+    stored moments to float32, run the unmodified fp32 updater math, and
+    stochastically round the NEW moments back down using ``key`` (the
+    step's RNG stream, fold_in-tagged so dropout draws are untouched).
+    Parameters stay fp32 throughout — only the stored state narrows.
+    """
+    sd = state_dtype_of(updater)
+    if not sd:
+        return updater.apply(grads, state, params, iteration)
+    if key is None:
+        raise ValueError(
+            f"{type(updater).__name__}(state_dtype={sd!r}) needs the step "
+            "RNG key for stochastic rounding — this fit path does not "
+            "thread one; unset state_dtype or use a pipeline fit")
+    wide = cast_floating(state, jnp.float32)
+    new_params, new_state = updater.apply(grads, wide, params, iteration)
+    sr_key = jax.random.fold_in(key, SR_STREAM_TAG)
+    new_state = sr_cast_state(new_state, jnp.dtype(sd), sr_key)
+    return new_params, new_state
+
+
+def updater_state_bytes(state) -> Dict[str, int]:
+    """Host-side footprint ledger: total bytes per leaf dtype (plus
+    ``total``). Empty dict for stateless updaters."""
+    out: Dict[str, int] = {}
+    for leaf in jax.tree.leaves(state or {}):
+        n = int(leaf.size) * jnp.dtype(leaf.dtype).itemsize
+        k = str(jnp.dtype(leaf.dtype))
+        out[k] = out.get(k, 0) + n
+    if out:
+        out["total"] = sum(out.values())
+    return out
+
+
+def note_state_bytes(state, prefix: str = "precision") -> None:
+    """Record the live updater-state footprint as profiler gauges
+    (``precision/updater_state_bytes_<dtype>`` + ``..._total``) — the
+    ``precision_stats()`` /api/health view of what the state actually
+    costs. Level quantities: gauges, not counters."""
+    prof = OpProfiler.get()
+    fresh = updater_state_bytes(state)
+    for k in list(prof.get_counters()):
+        # zero out stale per-dtype gauges from a previous state layout
+        # (the dtype SET changes when state_dtype flips)
+        if k.startswith(f"{prefix}/updater_state_bytes_") \
+                and k[len(prefix) + len("/updater_state_bytes_"):] \
+                not in fresh:
+            prof.gauge(k, 0)
+    for k, v in fresh.items():
+        prof.gauge(f"{prefix}/updater_state_bytes_{k}", v)

@@ -44,13 +44,39 @@ class GradientUpdater:
     the BASE default is False so a custom updater that couples elements
     within a leaf (global-norm clipping, whitening, ...) is refused by the
     sharded path unless its author opts in — never silently diverged
-    from the dense math."""
+    from the dense math.
+
+    ``state_dtype`` (opt-in, e.g. ``"bfloat16"``): STORE the updater
+    state (moments) in this dtype instead of the params'. The update math
+    still runs in float32 — ``learning.precision.apply_updater`` upcasts,
+    calls the unchanged ``apply``, and writes the new moments back down
+    with stochastic rounding on the step's RNG stream. Halves optimizer
+    HBM (and halves ZeRO-1's per-replica state again); numerics envelope
+    documented in ``learning/precision.py``. ``apply`` itself NEVER
+    consumes the field — handing it bf16 state directly just widens
+    through jnp promotion, so always go through ``apply_updater``."""
 
     learning_rate: Union[float, ISchedule]
     elementwise: bool = False
+    state_dtype: Optional[str] = None
 
     def init(self, params: Pytree) -> Pytree:
         return {}
+
+    def _zeros_like(self, params: Pytree) -> Pytree:
+        """Fresh state mirroring ``params`` — in ``state_dtype`` when set
+        (zeros are exactly representable, so low-precision init equals
+        round(fp32 init) bit-for-bit)."""
+        if not self.state_dtype:
+            return jax.tree.map(jnp.zeros_like, params)
+        dt = jnp.dtype(self.state_dtype)
+
+        def z(p):
+            if jnp.issubdtype(p.dtype, jnp.floating):
+                return jnp.zeros(p.shape, dt)
+            return jnp.zeros_like(p)
+
+        return jax.tree.map(z, params)
 
     def apply(self, grads: Pytree, state: Pytree, params: Pytree, iteration):
         raise NotImplementedError
@@ -87,7 +113,7 @@ class Nesterovs(GradientUpdater):
     momentum: float = 0.9
 
     def init(self, params):
-        return {"v": jax.tree.map(jnp.zeros_like, params)}
+        return {"v": self._zeros_like(params)}
 
     def apply(self, grads, state, params, iteration):
         lr = _lr_at(self.learning_rate, iteration)
@@ -111,7 +137,7 @@ class AdaGrad(GradientUpdater):
     epsilon: float = 1e-6
 
     def init(self, params):
-        return {"h": jax.tree.map(jnp.zeros_like, params)}
+        return {"h": self._zeros_like(params)}
 
     def apply(self, grads, state, params, iteration):
         lr = _lr_at(self.learning_rate, iteration)
@@ -134,8 +160,8 @@ class AdaDelta(GradientUpdater):
     learning_rate: Union[float, ISchedule] = 1.0  # AdaDelta is LR-free
 
     def init(self, params):
-        z = jax.tree.map(jnp.zeros_like, params)
-        return {"msg": z, "msdx": jax.tree.map(jnp.zeros_like, params)}
+        return {"msg": self._zeros_like(params),
+                "msdx": self._zeros_like(params)}
 
     def apply(self, grads, state, params, iteration):
         rho, eps = self.rho, self.epsilon
@@ -159,7 +185,7 @@ class RmsProp(GradientUpdater):
     epsilon: float = 1e-8
 
     def init(self, params):
-        return {"g2": jax.tree.map(jnp.zeros_like, params)}
+        return {"g2": self._zeros_like(params)}
 
     def apply(self, grads, state, params, iteration):
         lr = _lr_at(self.learning_rate, iteration)
@@ -183,8 +209,8 @@ class Adam(GradientUpdater):
     epsilon: float = 1e-8
 
     def init(self, params):
-        return {"m": jax.tree.map(jnp.zeros_like, params),
-                "v": jax.tree.map(jnp.zeros_like, params)}
+        return {"m": self._zeros_like(params),
+                "v": self._zeros_like(params)}
 
     def _moments(self, g, m, v):
         m_new = self.beta1 * m + (1 - self.beta1) * g
@@ -268,9 +294,9 @@ class Nadam(Adam):
 @dataclass
 class AMSGrad(Adam):
     def init(self, params):
-        z = jax.tree.map(jnp.zeros_like, params)
-        return {"m": z, "v": jax.tree.map(jnp.zeros_like, params),
-                "vhat": jax.tree.map(jnp.zeros_like, params)}
+        return {"m": self._zeros_like(params),
+                "v": self._zeros_like(params),
+                "vhat": self._zeros_like(params)}
 
     def apply(self, grads, state, params, iteration):
         lr = _lr_at(self.learning_rate, iteration)

@@ -318,6 +318,13 @@ class BatchNormalization(Layer):
     decay: float = 0.9
     eps: float = 1e-5
     lock_gamma_beta: bool = False
+    # Fused inference epilogue (ops/pallas_epilogue): collapse inference
+    # BN + relu/identity activation into one kernel. None → inherit
+    # GlobalConf.fused_epilogue (cascaded by apply_layer_defaults).
+    # Opt-in because the folded affine is a reassociation of the dense
+    # ops (tolerance-bounded, not bitwise); shape-gated with a dense
+    # fallback. Training mode is never fused (batch stats + hand VJP).
+    fused_epilogue: Optional[bool] = None
 
     def set_input_type(self, input_type):
         if isinstance(input_type, CNNInput):
@@ -356,6 +363,13 @@ class BatchNormalization(Layer):
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
+            if self.fused_epilogue:
+                from ...ops.pallas_epilogue import bn_act
+
+                fused = bn_act(x, mean, var, gamma, beta, epsilon=self.eps,
+                               axis=axis, act=self.activation)
+                if fused is not None:
+                    return fused, new_state
             out = get_op("batchnorm").fn(x, mean.astype(x.dtype),
                                          var.astype(x.dtype),
                                          gamma, beta, epsilon=self.eps, axis=axis)

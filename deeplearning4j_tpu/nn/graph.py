@@ -453,6 +453,69 @@ class ComputationGraph:
         return NDArray(jnp.concatenate([l.ravel() for l in leaves]))
 
     # --- forward ---------------------------------------------------------
+    def _epilogue_fusion_plan(self):
+        """The resnet-block-tail chains ``BN(identity) →
+        ElementWiseVertex(add, 2 inputs) → ActivationLayer(relu)`` that
+        inference ``_forward`` collapses into one fused BN+residual+relu
+        epilogue (ops/pallas_epilogue) when ``GlobalConf.fused_epilogue``
+        is on. Conservative: every interior node must have exactly one
+        consumer (the next link), no preprocessors on the add/act links,
+        and neither interior node may be a network output — so skipping
+        their dense materialization can never change any other node.
+        Returns None when the knob is off or nothing matches; the chain
+        falls back to the dense ops per call if the kernel's shape gate
+        refuses at trace time."""
+        if not getattr(self.conf.global_conf, "fused_epilogue", False):
+            return None
+        consumers: Dict[str, set] = {}
+        for name in self.conf.order:
+            for i in self.conf.nodes[name].inputs:
+                consumers.setdefault(i, set()).add(name)
+        outputs = set(self.conf.network_outputs)
+        bn_nodes, add_nodes, act_nodes = set(), {}, {}
+        for name in self.conf.order:
+            node = self.conf.nodes[name]
+            if (node.kind != "layer"
+                    or not isinstance(node.layer, L.ActivationLayer)
+                    or (node.layer.activation or "").lower() != "relu"
+                    or len(node.inputs) != 1 or node.preprocessors):
+                continue
+            add_name = node.inputs[0]
+            add_node = self.conf.nodes.get(add_name)
+            if (add_node is None or add_node.kind != "vertex"
+                    or not isinstance(add_node.vertex, ElementWiseVertex)
+                    or add_node.vertex.op.lower() != "add"
+                    or len(add_node.inputs) != 2
+                    or add_name in outputs
+                    or consumers.get(add_name) != {name}):
+                continue
+            if add_node.inputs[0] == add_node.inputs[1]:
+                # relu(bn(x) + bn(x)): deferring the BN would starve the
+                # "other" operand — leave the degenerate chain dense
+                continue
+            bn_name = None
+            for cand, oth in (add_node.inputs, reversed(add_node.inputs)):
+                bn = self.conf.nodes.get(cand)
+                if (bn is not None and bn.kind == "layer"
+                        and isinstance(bn.layer, L.BatchNormalization)
+                        # honor a per-layer fused_epilogue=False opt-out
+                        # even when the global knob is on
+                        and bn.layer.fused_epilogue
+                        and (bn.layer.activation
+                             or "identity").lower() == "identity"
+                        and cand not in outputs and cand not in bn_nodes
+                        and consumers.get(cand) == {add_name}):
+                    bn_name, other = cand, oth
+                    break
+            if bn_name is None:
+                continue
+            bn_nodes.add(bn_name)
+            add_nodes[add_name] = (bn_name, other)
+            act_nodes[name] = (bn_name, add_name)
+        if not act_nodes:
+            return None
+        return {"bn": bn_nodes, "add": add_nodes, "act": act_nodes}
+
     def _forward(self, params, states, inputs: Dict[str, jnp.ndarray],
                  training: bool, rng, to_preout: bool = False):
         cd = self.conf.global_conf.compute_dtype
@@ -465,6 +528,9 @@ class ComputationGraph:
         acts: Dict[str, jnp.ndarray] = {}
         new_states = dict(states)
         out_set = set(self.conf.network_outputs)
+        plan = None if training else self._epilogue_fusion_plan()
+        pending_bn: Dict[str, Any] = {}
+        pending_add: Dict[str, Any] = {}
         for name in self.conf.order:
             node = self.conf.nodes[name]
             if node.kind == "input":
@@ -472,6 +538,33 @@ class ComputationGraph:
                 if 0 in node.preprocessors:
                     x = node.preprocessors[0](x)
                 acts[name] = x
+                continue
+            if plan is not None and node.kind == "vertex" \
+                    and name in plan["add"]:
+                # fused-epilogue chain: defer the residual add to the relu
+                bn_name, other = plan["add"][name]
+                pending_add[name] = (pending_bn.pop(bn_name), acts[other])
+                continue
+            if plan is not None and name in plan["act"]:
+                # the fused BN+residual+relu launch (rng split mirrors the
+                # dense path's one-split-per-layer-node stream exactly)
+                rng, sub = jax.random.split(rng)
+                _, add_name = plan["act"][name]
+                (xbn, bnp, bns, bnl), other = pending_add.pop(add_name)
+                from ..ops.pallas_epilogue import bn_act
+
+                y = bn_act(xbn, bns["mean"], bns["var"], bnp.get("gamma"),
+                           bnp.get("beta"), epsilon=bnl.eps,
+                           axis=1 if xbn.ndim == 4 else -1, act="relu",
+                           residual=other)
+                if y is None:
+                    # shape gate refused: replay the dense chain verbatim
+                    bn_out, _ = bnl.apply(bnp, xbn, bns, training, sub)
+                    y, _ = node.layer.apply(params.get(name, {}),
+                                            bn_out + other,
+                                            states.get(name, {}),
+                                            training, sub)
+                acts[name] = y
                 continue
             ins = [acts[i] for i in node.inputs]
             if node.kind == "vertex":
@@ -481,6 +574,11 @@ class ComputationGraph:
             if 0 in node.preprocessors:
                 x = node.preprocessors[0](x)
             rng, sub = jax.random.split(rng)
+            if plan is not None and name in plan["bn"]:
+                # head of a fused chain: stash the raw input for the relu
+                pending_bn[name] = (x, params.get(name, {}),
+                                    states.get(name, {}), node.layer)
+                continue
             if to_preout and name in out_set and isinstance(node.layer, (L.OutputLayer, L.LossLayer)):
                 x = node.layer._maybe_dropout(x, training, sub)
                 head_params = params.get(name, {})
@@ -617,13 +715,21 @@ class ComputationGraph:
         return inputs, labels, masks
 
     # --- training --------------------------------------------------------
+    def _fused_flat_plan(self):
+        from .multilayer import _fused_flat_plan
+
+        return _fused_flat_plan(self.conf, self._params)
+
     def _step_core(self):
         """Single train-step computation, shared by the per-step jit and
         the multi-step lax.scan dispatch (see multilayer._step_core)."""
         gc = self.conf.global_conf
         updater = gc.updater
         tele = self._telemetry
+        fused_plan = self._fused_flat_plan()
+        from ..learning import precision as _prec
         from ..optimize import telemetry as _tel
+        from .multilayer import _apply_fused_flat
 
         def core(params, states, upd_state, inputs, labels, masks, key,
                  iteration, w):
@@ -638,7 +744,13 @@ class ComputationGraph:
 
                 grads = _normalize_gradients(grads, gc.grad_normalization,
                                              gc.grad_norm_threshold)
-            new_params, new_upd = updater.apply(grads, upd_state, params, iteration)
+            if fused_plan is not None:
+                new_params, new_upd = _apply_fused_flat(
+                    fused_plan, updater, grads, upd_state, params,
+                    iteration, key)
+            else:
+                new_params, new_upd = _prec.apply_updater(
+                    updater, grads, upd_state, params, iteration, key)
             if tele is None:
                 return new_params, new_states, new_upd, loss
             # per-node stats in sorted node-name order (telemetry.groups)
@@ -706,6 +818,9 @@ class ComputationGraph:
         skip = self._begin_fit(resume_from)
         if self._updater_state is None:
             self._updater_state = self.conf.global_conf.updater.init(self._params)
+        from ..learning.precision import note_state_bytes
+
+        note_state_bytes(self._updater_state)
         if self._fit_step is None:
             self._fit_step = self._build_fit_step()
         if isinstance(data, (DataSet, MultiDataSet)) and batch_size is None:

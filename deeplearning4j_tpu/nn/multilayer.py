@@ -360,6 +360,9 @@ class MultiLayerNetwork:
         return [i for i, l in enumerate(self.layers)
                 if isinstance(l, L.FrozenLayer)]
 
+    def _fused_flat_plan(self):
+        return _fused_flat_plan(self.conf, self._params)
+
     def _step_core(self):
         """The single train-step computation, shared verbatim by the
         per-step jit and the multi-step ``lax.scan`` dispatch so the two
@@ -371,6 +374,8 @@ class MultiLayerNetwork:
         updater = gc.updater
         frozen = self._frozen_indices()
         tele = self._telemetry
+        fused_plan = self._fused_flat_plan()
+        from ..learning import precision as _prec
         from ..optimize import telemetry as _tel
 
         def core(params, states, upd_state, x, y, mask, key, iteration,
@@ -384,7 +389,13 @@ class MultiLayerNetwork:
             if gc.grad_normalization:
                 grads = _normalize_gradients(grads, gc.grad_normalization,
                                              gc.grad_norm_threshold)
-            new_params, new_upd = updater.apply(grads, upd_state, params, iteration)
+            if fused_plan is not None:
+                new_params, new_upd = _apply_fused_flat(
+                    fused_plan, updater, grads, upd_state, params,
+                    iteration, key)
+            else:
+                new_params, new_upd = _prec.apply_updater(
+                    updater, grads, upd_state, params, iteration, key)
             for i in frozen:
                 # stop_gradient already zeroes their grads; restoring the
                 # original tensors also shields them from stateful-updater
@@ -474,6 +485,7 @@ class MultiLayerNetwork:
         updater = gc.updater
         frozen = self._frozen_indices()
         tele = self._telemetry
+        from ..learning import precision as _prec
         from ..optimize import telemetry as _tel
 
         def step(params, states, upd_state, rnn_states, x, y, mask, key,
@@ -487,7 +499,8 @@ class MultiLayerNetwork:
             if gc.grad_normalization:
                 grads = _normalize_gradients(grads, gc.grad_normalization,
                                              gc.grad_norm_threshold)
-            new_params, new_upd = updater.apply(grads, upd_state, params, iteration)
+            new_params, new_upd = _prec.apply_updater(
+                updater, grads, upd_state, params, iteration, key)
             for i in frozen:
                 new_params[i] = params[i]
             new_params = self._apply_constraints(new_params)
@@ -556,6 +569,9 @@ class MultiLayerNetwork:
         skip = self._begin_fit(resume_from)
         if self._updater_state is None:
             self._updater_state = self.conf.global_conf.updater.init(self._params)
+        from ..learning.precision import note_state_bytes
+
+        note_state_bytes(self._updater_state)
         if self._fit_step is None:
             self._fit_step = self._build_fit_step()
 
@@ -703,7 +719,10 @@ class MultiLayerNetwork:
                     return layer.pretrain_loss(p, feats, key)
 
                 loss, grads = jax.value_and_grad(loss_fn)(lp)
-                new_lp, new_upd = updater.apply(grads, upd_state, lp, it)
+                from ..learning.precision import apply_updater
+
+                new_lp, new_upd = apply_updater(updater, grads, upd_state,
+                                                lp, it, key)
                 return new_lp, new_upd, loss
 
             step = jax.jit(step, donate_argnums=(0, 1))
@@ -870,6 +889,54 @@ class MultiLayerNetwork:
         net._params = jax.tree.map(jnp.array, self._params)
         net._states = jax.tree.map(jnp.array, self._states)
         return net
+
+
+def _fused_flat_plan(conf, params):
+    """The ``Zero1Plan(params, 1)`` behind ``fused_update`` — the
+    single-device flat path shared by MultiLayerNetwork and
+    ComputationGraph (both flatten params the same way: a pytree-keyed
+    pure permutation): params/grads/updater state flatten into per-dtype
+    buckets inside the step and the update runs as ONE fused kernel per
+    bucket (ops/pallas_update) instead of per-leaf ops. None when the
+    knob is off or the updater is not elementwise (flat application of a
+    coupled updater would change the math — refuse and fall back,
+    ledgered + warned)."""
+    if not getattr(conf.global_conf, "fused_update", False):
+        return None
+    updater = conf.global_conf.updater
+    if not getattr(updater, "elementwise", False):
+        OpProfiler.get().count("precision/fused_fallbacks")
+        import logging
+
+        logging.getLogger("deeplearning4j_tpu").warning(
+            "fused_update requested but %s does not declare "
+            "elementwise=True; using the per-leaf updater path",
+            type(updater).__name__)
+        return None
+    from ..parallel.sharding import Zero1Plan
+
+    return Zero1Plan(params, 1)
+
+
+def _apply_fused_flat(plan, updater, grads, upd_state, params, iteration,
+                      key):
+    """The single-device fused-update body (traced into the step):
+    flatten params/grads/state through ``plan``'s pure-permutation bucket
+    layout, run one fused kernel per bucket, unflatten back. The model
+    keeps its DENSE layouts between steps — checkpointing, listeners and
+    the serializers see exactly what they always saw."""
+    from ..ops.pallas_update import apply_flat_updater
+
+    flat_p = plan.flatten(params)
+    flat_g = plan.flatten(grads)
+    flat_s = (plan.flatten_state(upd_state, xp=jnp)
+              if isinstance(upd_state, dict) else upd_state)
+    new_flat, new_flat_s = apply_flat_updater(updater, flat_p, flat_g,
+                                              flat_s, iteration, key)
+    new_params = plan.unflatten(new_flat)
+    new_upd = (plan.unflatten_state_inplan(new_flat_s)
+               if isinstance(new_flat_s, dict) else new_flat_s)
+    return new_params, new_upd
 
 
 def _fold_weights(mask, w):

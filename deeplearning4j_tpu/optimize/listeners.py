@@ -305,7 +305,8 @@ class CheckpointListener(TrainingListener):
                                        snapshot["iteration"],
                                        self.keep_last, seq=self._seq,
                                        max_total_bytes=self.max_total_bytes,
-                                       incarnation=self.incarnation)
+                                       incarnation=self.incarnation,
+                                       state_dtype=snapshot.get("state_dtype"))
         self._seq += 1
         self._note_commit(path)
         return path

@@ -183,13 +183,10 @@ class BucketLadder:
                 for b in self.batch_sizes for t in self.seq_lens]
 
 
-def _cast_floating(tree, dtype):
-    def c(a):
-        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating):
-            return jnp.asarray(a, dtype)
-        return a
-
-    return jax.tree.map(c, tree)
+# THE fp32-boundary cast, shared with the training side's low-precision
+# updater state — learning/precision.py owns the dtype-boundary rules
+# (one doc, one helper; this module used to carry its own copy).
+from ..learning.precision import cast_floating as _cast_floating
 
 
 class ServingEngine(ParallelInference):

@@ -144,7 +144,12 @@ class Zero1Plan:
         for b in self.buckets:
             parts = [xp.ravel(leaves[i]) for i in b.leaf_idx]
             if b.padded > b.total:
-                parts.append(xp.zeros((b.padded - b.total,), b.dtype))
+                # pad in the LEAVES' dtype, not the bucket key's: a
+                # low-precision updater-state tree (state_dtype=bfloat16)
+                # flattens through its params' f32-keyed buckets, and an
+                # f32 zero tail would silently promote the whole bucket
+                parts.append(xp.zeros((b.padded - b.total,),
+                                      parts[0].dtype))
             out[b.key] = xp.concatenate(parts) if len(parts) > 1 else parts[0]
         return out
 
@@ -191,6 +196,23 @@ class Zero1Plan:
         for k, v in state.items():
             if jax.tree.structure(v) == self.treedef:
                 out[k] = self.flatten(v, xp=xp)
+            else:
+                out[k] = v
+        return out
+
+    def unflatten_state_inplan(self, state, xp=jnp):
+        """Flat updater state already in THIS plan's exact padded layout →
+        dense tree. Unlike :meth:`unflatten_state` it never touches numpy
+        (no repad/validation), so it is safe to TRACE into a compiled
+        step — the single-device fused-update path densifies the state it
+        just updated with this."""
+        out = {}
+        for k, v in state.items():
+            if isinstance(v, dict) and v and all(
+                    str(kk).startswith(FLAT_PREFIX) for kk in v):
+                out[k] = self.unflatten(
+                    {b.key: v[b.key][:b.total] for b in self.buckets},
+                    xp=xp)
             else:
                 out[k] = v
         return out

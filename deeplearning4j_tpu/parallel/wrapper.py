@@ -172,6 +172,8 @@ class ParallelWrapper:
         plan = self._zero1_plan if zero1 else None
         is_graph = hasattr(model, "conf") and hasattr(model.conf, "network_inputs")
         tele = self._telemetry
+        from ..learning import precision as _prec
+        from ..ops import pallas_update as _pupd
         from ..optimize import telemetry as _tel
 
         def local_step(params, states, upd_state, acc_state, x, y, mask, w,
@@ -220,22 +222,29 @@ class ParallelWrapper:
                 if jnp.issubdtype(s.dtype, jnp.floating) else s, new_states)
             if zero1:
                 # ZeRO-1: mean-reduce-scatter the flat grads, update only
-                # this replica's even slice of params+state, gather back
+                # this replica's even slice of params+state, gather back.
+                # The update itself runs through the fused flat-bucket
+                # kernel (ops/pallas_update — one launch per dtype bucket;
+                # fp32 bitwise-identical to the per-leaf path) with the
+                # generic elementwise fallback for updaters it doesn't
+                # cover; `key` (already folded per-replica) drives the
+                # bf16-state stochastic rounding when state_dtype is set.
                 flat_g = plan.flatten(grads)
                 g_sh = {k: jax.lax.psum_scatter(
                     v, axis, scatter_dimension=0, tiled=True)
                     / jnp.asarray(n_shards, v.dtype)
                     for k, v in flat_g.items()}
                 p_sh = plan.shard_slice(plan.flatten(params), idx)
-                new_p_sh, new_upd = updater.apply(g_sh, upd_state, p_sh, it)
+                new_p_sh, new_upd = _pupd.apply_flat_updater(
+                    updater, p_sh, g_sh, upd_state, it, key)
                 new_params = plan.unflatten(
                     {k: jax.lax.all_gather(v, axis, tiled=True)
                      for k, v in new_p_sh.items()})
             else:
                 if not stateful:
                     grads = acc.reduce_gradients(grads)
-                new_params, new_upd = updater.apply(grads, upd_state, params,
-                                                    it)
+                new_params, new_upd = _prec.apply_updater(
+                    updater, grads, upd_state, params, it, key)
             if tele is None:
                 return new_params, new_states, new_upd, acc_state, loss
             if zero1:
@@ -438,8 +447,11 @@ class ParallelWrapper:
                 return self._finish_parallel_state(acc, model)
             if state is None:
                 # init DIRECTLY in the flat layout (zeros flatten to
-                # zeros, so this equals flatten(dense init) exactly)
-                flat_p = plan.flatten(jax.tree.map(np.asarray,
+                # zeros, so this equals flatten(dense init) exactly).
+                # np.array, not np.asarray: device_get views alias
+                # donatable buffers (the PR-3 lesson; tools/static_lint
+                # enforces the pattern)
+                flat_p = plan.flatten(jax.tree.map(np.array,
                                                    jax.device_get(
                                                        model._params)),
                                       xp=np)
@@ -456,6 +468,9 @@ class ParallelWrapper:
                 prof.count("zero1/updater_state_bytes_total", int(total))
                 prof.count("zero1/updater_state_bytes_per_replica",
                            int(total // self.workers_count))
+                from ..learning.precision import note_state_bytes
+
+                note_state_bytes(state)
             model._updater_state = state
         else:
             state = model._updater_state
@@ -471,6 +486,9 @@ class ParallelWrapper:
                 model._updater_state = state
             if model._updater_state is None:
                 model._updater_state = updater.init(model._params)
+            from ..learning.precision import note_state_bytes
+
+            note_state_bytes(model._updater_state)
         self._finish_parallel_state(acc, model)
 
     def _flat_state_matches_plan(self, state, plan) -> bool:

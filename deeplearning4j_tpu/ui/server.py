@@ -339,6 +339,7 @@ class UIServer:
                 "supervisor": prof.supervisor_stats(),
                 "faults": prof.fault_stats(),
                 "collectives": prof.collective_stats(),
+                "precision": prof.precision_stats(),
                 "elastic": prof.elastic_stats(),
                 "inference": pool_health(),
                 "serving": serving_health(),

@@ -116,12 +116,17 @@ def snapshot_training_state(model, listeners=None,
     if conf_json is None:
         conf_json = model.conf.to_json()
         model._ckpt_conf_json = conf_json
+    # the stored-moment dtype is part of the training numerics: record
+    # it in the meta + manifest so restore can refuse a silent flip
+    from ..learning.precision import state_dtype_of
+
     return {
         "kind": type(model).__name__,
         "conf_json": conf_json,
         "params": params,
         "states": states,
         "updater": upd,
+        "state_dtype": state_dtype_of(model.conf.global_conf.updater),
         "accumulator": acc,
         "iteration": int(model._iteration),
         "epoch": int(model._epoch),
@@ -186,6 +191,7 @@ def serialize_snapshot(snapshot: Dict[str, Any]) -> bytes:
         zf.writestr(_META_ENTRY, json.dumps({
             "iteration": snapshot["iteration"], "epoch": snapshot["epoch"],
             "kind": snapshot["kind"], "format_version": 2,
+            "updater_state_dtype": snapshot.get("state_dtype"),
         }))
         if snapshot["updater"] is not None:
             zf.writestr(_UPDATER_ENTRY, _savez_leaves(snapshot["updater"]))
@@ -316,7 +322,8 @@ def _entry_bytes(directory: str, e: Any) -> int:
 def _append_and_retain(directory: str, name: str, sha: str, iteration: int,
                        keep_last: int, size: Optional[int] = None,
                        max_total_bytes: Optional[int] = None,
-                       incarnation: Optional[int] = None) -> None:
+                       incarnation: Optional[int] = None,
+                       state_dtype: Optional[str] = None) -> None:
     """Fold one committed file into the manifest and apply retention —
     count-based (``keep_last``) then disk-budget (``max_total_bytes``:
     oldest committed entries drop until the total fits; the newest always
@@ -339,6 +346,11 @@ def _append_and_retain(directory: str, name: str, sha: str, iteration: int,
                              "tag": name[len("checkpoint_"):-len(".zip")]}
     if size is not None:
         entry["bytes"] = int(size)
+    if state_dtype is not None:
+        # low-precision updater state: surfaced in the manifest so ops
+        # tooling (and humans) can see the stored-moment dtype without
+        # opening the zip
+        entry["state_dtype"] = str(state_dtype)
     entries.append(entry)
     retained, dropped = entries, []
     if keep_last and len(entries) > keep_last:
@@ -365,7 +377,8 @@ def commit_checkpoint(directory: str, tag: str, data: bytes,
                       iteration: int, keep_last: int,
                       seq: Optional[int] = None,
                       max_total_bytes: Optional[int] = None,
-                      incarnation: Optional[int] = None) -> str:
+                      incarnation: Optional[int] = None,
+                      state_dtype: Optional[str] = None) -> str:
     """Atomically commit one checkpoint and fold it into the manifest;
     apply retention. Returns the committed path. Single-writer per
     directory (the listener's writer thread or the sync caller).
@@ -385,7 +398,7 @@ def commit_checkpoint(directory: str, tag: str, data: bytes,
         _append_and_retain(directory, name, hashlib.sha256(data).hexdigest(),
                            iteration, keep_last, size=len(data),
                            max_total_bytes=max_total_bytes,
-                           incarnation=incarnation)
+                           incarnation=incarnation, state_dtype=state_dtype)
     prof.count("checkpoint/committed")
     prof.count("checkpoint/bytes", len(data))
     return path
@@ -536,19 +549,27 @@ def read_resume_state(path: str) -> Dict[str, Any]:
 
 
 def restore_training_state(model, path: str, listeners=None,
-                           restore_rng: bool = True) -> Dict[str, int]:
+                           restore_rng: bool = True,
+                           convert_state_dtype: bool = False
+                           ) -> Dict[str, int]:
     """Load a checkpoint INTO an existing (init()-ed) model and return the
     pipeline cursor ``{"epochs_done": d, "steps_in_epoch": s}``. Restores
     params / states / updater state / iteration / epoch / the calling
     thread's RNG key / listener state — the full set a bit-identical
-    continuation needs."""
+    continuation needs.
+
+    ``convert_state_dtype``: a checkpoint whose stored updater moments
+    disagree with the configured ``updater.state_dtype`` is refused
+    (the dtype is part of the numerics); pass True to convert with one
+    explicit round-to-nearest cast instead."""
     from ..ndarray.rng import get_random
     from .model_serializer import load_state_entries
 
     with zipfile.ZipFile(path) as zf:
         # shared with ModelSerializer._restore: zip-entry loading +
         # device materialization (donation safety) live in ONE place
-        load_state_entries(zf, model, load_updater=True)
+        load_state_entries(zf, model, load_updater=True,
+                           convert_state_dtype=convert_state_dtype)
         # accumulator state (encoded-exchange residuals etc.) restores
         # LAZILY: the raw npz bytes ride on the model until a wrapper
         # with the owning accumulator rebuilds the tree from its template
@@ -678,7 +699,8 @@ class CheckpointWriter:
                                          snapshot["iteration"],
                                          self.keep_last, seq=seq,
                                          max_total_bytes=self.max_total_bytes,
-                                         incarnation=self.incarnation)
+                                         incarnation=self.incarnation,
+                                         state_dtype=snapshot.get("state_dtype"))
                 if self._on_commit is not None:
                     self._on_commit(path)
             except BaseException as e:     # incl. SimulatedCrash(raise)

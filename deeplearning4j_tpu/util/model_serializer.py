@@ -12,11 +12,14 @@ from __future__ import annotations
 
 import io
 import json
+import logging
 import zipfile
 from typing import Optional
 
 import jax
 import numpy as np
+
+logger = logging.getLogger("deeplearning4j_tpu")
 
 _CONF_ENTRY = "configuration.json"
 _COEFF_ENTRY = "coefficients.npz"
@@ -27,20 +30,45 @@ _META_ENTRY = "meta.json"
 
 
 def _savez_leaves(tree) -> bytes:
+    """Leaves → npz. ml_dtypes leaves (bfloat16 updater state) are not
+    native numpy dtypes and crash np.savez, so they ship as a same-width
+    integer view with the real dtype tagged into the entry name
+    (``<i>::bfloat16``); ``_load_into_tree`` views them back. Plain
+    ``<i>`` entries stay byte-identical to every pre-existing archive."""
     leaves, _ = jax.tree.flatten(tree)
+    entries = {}
+    for i, l in enumerate(leaves):
+        a = np.asarray(l)
+        if a.dtype.kind == "V" or a.dtype.name == "bfloat16":
+            entries[f"{i}::{a.dtype.name}"] = a.view(
+                np.dtype(f"u{a.dtype.itemsize}"))
+        else:
+            entries[str(i)] = a
     buf = io.BytesIO()
-    np.savez(buf, **{str(i): np.asarray(l) for i, l in enumerate(leaves)})
+    np.savez(buf, **entries)
     return buf.getvalue()
 
 
 def _load_into_tree(data: bytes, template, what: str, cast_to_template: bool = False):
     arrays = np.load(io.BytesIO(data))
+    names = {}
+    for n in arrays.files:
+        idx, _, tag = n.partition("::")
+        names[int(idx)] = (n, tag or None)
     leaves, treedef = jax.tree.flatten(template)
     if len(arrays.files) != len(leaves):
         raise ValueError(
             f"{what} count mismatch: archive has {len(arrays.files)}, "
             f"configuration implies {len(leaves)}")
-    restored = [np.asarray(arrays[str(i)]) for i in range(len(leaves))]
+    restored = []
+    for i in range(len(leaves)):
+        n, tag = names[i]
+        a = np.asarray(arrays[n])
+        if tag is not None:
+            import ml_dtypes
+
+            a = a.view(np.dtype(getattr(ml_dtypes, tag)))
+        restored.append(a)
     if cast_to_template:
         restored = [r.astype(np.asarray(t).dtype) for r, t in zip(restored, leaves)]
     return jax.tree.unflatten(treedef, restored)
@@ -99,12 +127,20 @@ def _materialize_on_device(tree):
 
 
 def load_state_entries(zf: zipfile.ZipFile, model,
-                       load_updater: bool = True) -> None:
+                       load_updater: bool = True,
+                       convert_state_dtype: bool = False) -> None:
     """Load the container's coefficient/state/meta(/updater) entries INTO
     an existing initialized model, device-materialized. Shared by
     :func:`_restore` (fresh model from the zip's conf) and
     ``util.checkpoint.restore_training_state`` (resume into a live model)
-    so the donation-safety materialization cannot drift between them."""
+    so the donation-safety materialization cannot drift between them.
+
+    The updater-state dtype is part of the training numerics
+    (``updater.state_dtype`` — bf16 moments round differently than
+    fp32), so an archive whose stored moments disagree with the current
+    configuration is REFUSED rather than silently widened/narrowed.
+    ``convert_state_dtype=True`` is the explicit opt-in: one
+    round-to-nearest cast onto the configured dtype, logged."""
     names = zf.namelist()
     model._params = _materialize_on_device(_load_into_tree(
         zf.read(_COEFF_ENTRY), model._params, "coefficient",
@@ -118,8 +154,38 @@ def load_state_entries(zf: zipfile.ZipFile, model,
     if load_updater:
         if _UPDATER_ENTRY in names:
             state0 = model.conf.global_conf.updater.init(model._params)
-            model._updater_state = _materialize_on_device(_load_into_tree(
-                zf.read(_UPDATER_ENTRY), state0, "updater state"))
+            restored = _load_into_tree(
+                zf.read(_UPDATER_ENTRY), state0, "updater state")
+            import jax.numpy as jnp
+
+            # jnp's dtype lattice, not numpy's: ml_dtypes bfloat16 is
+            # floating to jax but a void type to np.issubdtype
+            _floating = lambda d: jnp.issubdtype(d, jnp.floating)  # noqa: E731
+            mismatch = sorted({
+                f"{np.asarray(r).dtype}->{np.asarray(t).dtype}"
+                for r, t in zip(jax.tree.leaves(restored),
+                                jax.tree.leaves(state0))
+                if np.asarray(r).dtype != np.asarray(t).dtype
+                and _floating(np.asarray(t).dtype)})
+            if mismatch:
+                if not convert_state_dtype:
+                    sd = getattr(model.conf.global_conf.updater,
+                                 "state_dtype", None)
+                    raise ValueError(
+                        f"updater state dtype mismatch ({', '.join(mismatch)}): "
+                        f"the checkpoint's stored moments do not match the "
+                        f"configured state_dtype={sd!r}. A silent cast would "
+                        f"change training numerics — pass "
+                        f"convert_state_dtype=True (restore_training_state / "
+                        f"load_state_entries) to convert explicitly, or match "
+                        f"the updater's state_dtype to the checkpoint.")
+                logger.info("converting updater state dtype (%s) to the "
+                            "configured state_dtype", ", ".join(mismatch))
+                restored = jax.tree.map(
+                    lambda r, t: np.asarray(r).astype(np.asarray(t).dtype)
+                    if _floating(np.asarray(t).dtype)
+                    else np.asarray(r), restored, state0)
+            model._updater_state = _materialize_on_device(restored)
         else:
             model._updater_state = None
 

@@ -1,0 +1,80 @@
+"""tools/static_lint wired into tier-1: the two shipped-and-fixed bug
+classes (device_get-view donation aliasing; unguarded Pallas kernels)
+must never re-enter the package. Pure text scans — no jax imports, so
+this file costs milliseconds of the tier-1 budget."""
+
+import os
+import sys
+import tempfile
+import textwrap
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import static_lint  # noqa: E402
+
+
+class TestPackageClean:
+    def test_no_donation_aliases_in_package(self):
+        findings = static_lint.lint_donation_aliases(
+            static_lint.package_root())
+        assert findings == [], (
+            "device_get views aliased via np.asarray flow into donated "
+            f"jit args (the PR-3/PR-6 heap-corruption class): {findings}")
+
+    def test_all_pallas_kernels_guarded(self):
+        findings = static_lint.lint_pallas_guards(static_lint.package_root())
+        assert findings == [], (
+            f"pallas_call sites without interpret/backend gate: {findings}")
+
+
+class TestLintDetects:
+    """The lints must actually fire — a lint that can't see the original
+    sin would pass trivially forever."""
+
+    def _scan(self, src, fn):
+        with tempfile.TemporaryDirectory() as d:
+            with open(os.path.join(d, "mod.py"), "w") as f:
+                f.write(textwrap.dedent(src))
+            return fn(d)
+
+    def test_catches_direct_alias(self):
+        hits = self._scan(
+            "x = np.asarray(jax.device_get(model._params))\n",
+            static_lint.lint_donation_aliases)
+        assert len(hits) == 1 and hits[0][1] == 1
+
+    def test_catches_tree_map_alias(self):
+        # the exact PR-6 wrapper.py spelling, wrapped across lines
+        hits = self._scan(
+            """
+            flat = plan.flatten(jax.tree.map(np.asarray,
+                                             jax.device_get(params)))
+            """,
+            static_lint.lint_donation_aliases)
+        assert len(hits) == 1
+
+    def test_copying_spellings_pass(self):
+        hits = self._scan(
+            """
+            a = jax.tree.map(np.array, jax.device_get(p))
+            b = np.asarray(host_batch)
+            """,
+            static_lint.lint_donation_aliases)
+        assert hits == []
+
+    def test_catches_unguarded_pallas(self):
+        hits = self._scan(
+            "out = pl.pallas_call(kernel, grid=(1,))(x)\n",
+            static_lint.lint_pallas_guards)
+        assert len(hits) == 1
+
+    def test_guarded_pallas_passes(self):
+        hits = self._scan(
+            """
+            def mode():
+                return jax.default_backend()
+            out = pl.pallas_call(kernel, interpret=interp)(x)
+            """,
+            static_lint.lint_pallas_guards)
+        assert hits == []

@@ -1,0 +1,112 @@
+"""Static lints for the two failure classes this repo has actually
+shipped (and fixed) twice.
+
+**Donation aliasing** (the PR-3 / PR-6 heap-corruption class):
+``jax.device_get`` may return ZERO-COPY views of device buffers on the
+CPU backend, and ``np.asarray`` of such a view is still the same memory
+— hand either into a ``donate_argnums`` jit (or stash it across a step
+that donates) and the next dispatch frees the bytes under the reader:
+observed as glibc heap corruption, twice. The package-wide rule is
+therefore *copy before you keep*: ``np.array`` / ``jnp.asarray``-onto-
+device for anything coming out of ``device_get``. This lint greps the
+package for the two alias spellings (``np.asarray(jax.device_get(...)``
+and ``tree.map(np.asarray, jax.device_get(...)``) so the pattern cannot
+quietly return.
+
+**Unguarded Pallas kernels**: every ``pl.pallas_call`` site must carry
+an ``interpret=`` escape hatch and a backend gate (``default_backend``
+/ ``default_mode``) so the kernel (a) runs on the CPU test mesh through
+the interpreter and (b) never becomes the hot path on a backend it was
+not built for — the ``ops/pallas_attention.py`` recipe, made a rule.
+
+Run as a script (non-zero exit on findings) or through
+``tests/test_lint.py``, which wires both lints into tier-1 CI.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List, Tuple
+
+# spellings of "alias a device_get view instead of copying it";
+# whitespace-tolerant so a line wrap does not hide a finding
+_ALIAS_PATTERNS = [
+    re.compile(r"np\s*\.\s*asarray\s*\(\s*jax\s*\.\s*device_get"),
+    re.compile(r"tree\s*\.\s*map\s*\(\s*np\s*\.\s*asarray\s*,\s*"
+               r"jax\s*\.\s*device_get"),
+]
+
+_PALLAS_CALL = re.compile(r"\bpallas_call\s*\(")
+_PALLAS_GUARDS = ("interpret", "default_backend", "default_mode")
+
+
+def _py_files(root: str) -> List[str]:
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        out.extend(os.path.join(dirpath, f) for f in filenames
+                   if f.endswith(".py"))
+    return sorted(out)
+
+
+def _lineno(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+def lint_donation_aliases(root: str) -> List[Tuple[str, int, str]]:
+    """(path, line, match) for every device_get-view alias in ``root``."""
+    findings = []
+    for path in _py_files(root):
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        for pat in _ALIAS_PATTERNS:
+            for m in pat.finditer(text):
+                findings.append((path, _lineno(text, m.start()),
+                                 " ".join(m.group(0).split())))
+    return findings
+
+
+def lint_pallas_guards(root: str) -> List[Tuple[str, int, str]]:
+    """(path, line, reason) for every ``pallas_call`` site in a file that
+    lacks the interpret escape hatch or the backend gate."""
+    findings = []
+    for path in _py_files(root):
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        sites = list(_PALLAS_CALL.finditer(text))
+        if not sites:
+            continue
+        missing = [g for g in _PALLAS_GUARDS if g not in text]
+        # interpret= must appear; EITHER backend gate spelling suffices
+        missing = [g for g in missing
+                   if g == "interpret" or
+                   not ({"default_backend", "default_mode"} - set(missing))]
+        if missing:
+            for m in sites:
+                findings.append((path, _lineno(text, m.start()),
+                                 f"pallas_call without {'/'.join(missing)} "
+                                 "guard (see ops/pallas_attention.py)"))
+    return findings
+
+
+def package_root() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "deeplearning4j_tpu")
+
+
+def main() -> int:
+    root = sys.argv[1] if len(sys.argv) > 1 else package_root()
+    findings = [("donation-alias", *f) for f in lint_donation_aliases(root)]
+    findings += [("pallas-guard", *f) for f in lint_pallas_guards(root)]
+    for kind, path, line, detail in findings:
+        print(f"{path}:{line}: [{kind}] {detail}")
+    if findings:
+        print(f"{len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
