@@ -543,24 +543,47 @@ def bench_pipeline_smoke(steps: int, batch: int = 64,
     y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
     it = NDArrayDataSetIterator(x, y, batch_size=batch)
 
+    from deeplearning4j_tpu.common import tracecheck
+
     prof = OpProfiler.get()
     prof.reset()
     model.fit(it, epochs=1, steps_per_dispatch=steps_per_dispatch)  # warmup
     float(model._score_dev)
     prof.reset()
     t0 = time.perf_counter()
-    model.fit(it, epochs=1, steps_per_dispatch=steps_per_dispatch)
-    float(model._score_dev)             # value fence
+    try:
+        # the timed epoch is a DECLARED steady-state region: counters
+        # were reset after the warmup fit, so any trace/compile/device_get
+        # in here is a hot-loop regression and the sanitizer raises
+        with tracecheck.steady_state("pipeline-smoke timed epoch"):
+            model.fit(it, epochs=1, steps_per_dispatch=steps_per_dispatch)
+            float(model._score_dev)     # value fence
+    except tracecheck.SteadyStateViolation as e:
+        print(json.dumps({"error": "input pipeline violated steady state "
+                          "— shape-stable batching is broken",
+                          "violation": str(e).splitlines()[0],
+                          "report": {k: v for k, v in e.report.items()
+                                     if k != "first_stack"}}))
+        sys.exit(1)
     dt = time.perf_counter() - t0
     traces = prof.trace_counts()
-    # counters were reset AFTER the warmup fit: any trace in the timed
-    # window is a retrace of an already-compiled step
-    if traces.get("trace/mln_fit_step", 0) > 0 \
-            or traces.get("trace/mln_fit_chunk", 0) > 0:
-        print(json.dumps({"error": "input pipeline retraced the train step "
-                          "— shape-stable batching is broken",
-                          "traces": traces}))
+
+    # the sanitizer itself must be ARMED, not just quiet: inject a real
+    # retrace (a fit at a different batch size re-traces the step) inside
+    # a declared region and require the hard failure
+    xs = rng.randn(batch, 784).astype(np.float32)
+    ys = np.eye(10, dtype=np.float32)[rng.randint(0, 10, batch)]
+    try:
+        with tracecheck.steady_state("injected-retrace drill",
+                                     max_host_syncs=None):
+            model.fit(NDArrayDataSetIterator(xs, ys,
+                                             batch_size=batch // 2),
+                      epochs=1)
+        print(json.dumps({"error": "trace sanitizer FAILED to detect an "
+                          "injected steady-state retrace"}))
         sys.exit(1)
+    except tracecheck.SteadyStateViolation:
+        pass                            # armed and firing
     images = n + (batch - n % batch) % batch    # padded count actually run
     return {
         "metric": "input_pipeline_smoke",
@@ -571,6 +594,7 @@ def bench_pipeline_smoke(steps: int, batch: int = 64,
         "steps_per_dispatch": steps_per_dispatch,
         "platform": jax.devices()[0].platform,
         "traces": traces,
+        "tracecheck": prof.tracecheck_stats(),   # 2 regions, 1 violation
         "padded_batches": prof.counter_value("pipeline/padded_batches"),
         "overlap": {k: (round(v, 4) if isinstance(v, float) else v)
                     for k, v in prof.overlap_stats().items()},
@@ -640,19 +664,27 @@ def bench_telemetry_smoke(steps: int, batch: int = 64,
         fail("telemetry changed the compile footprint (retrace delta)",
              off_traces=warm["off"], on_traces=warm["on"])
 
+    from deeplearning4j_tpu.common import tracecheck
+
     prof.reset()
     times = {"off": [], "on": []}
-    for _ in range(5):                  # interleaved rounds
-        for name, model in models.items():
-            t0 = time.perf_counter()
-            model.fit(it, epochs=1,
-                      steps_per_dispatch=steps_per_dispatch)
-            float(model._score_dev)     # value fence
-            times[name].append(time.perf_counter() - t0)
-    hot = prof.trace_counts()
-    if any(hot.values()):
+    try:
+        # the interleaved timed rounds are one steady-state region; the
+        # telemetry drain's batched device_get cadence is data-dependent
+        # by design, so host syncs are counted but not policed here
+        with tracecheck.steady_state("telemetry-smoke timed rounds",
+                                     max_host_syncs=None):
+            for _ in range(5):          # interleaved rounds
+                for name, model in models.items():
+                    t0 = time.perf_counter()
+                    model.fit(it, epochs=1,
+                              steps_per_dispatch=steps_per_dispatch)
+                    float(model._score_dev)     # value fence
+                    times[name].append(time.perf_counter() - t0)
+    except tracecheck.SteadyStateViolation as e:
         fail("train step retraced inside a timed window — telemetry or "
-             "pipeline shape stability is broken", traces=hot)
+             "pipeline shape stability is broken",
+             violation=str(e).splitlines()[0])
     t_off = _stats.median(times["off"])
     t_on = _stats.median(times["on"])
     overhead = (t_on - t_off) / t_off
@@ -2252,6 +2284,26 @@ def main() -> None:
         else:
             cold_audit(tuple(args.cold_audit.split(",")))
         return
+
+    if args.config.endswith("-smoke"):
+        # dirty lint refuses to bench: the smoke configs assert hot-loop
+        # invariants (no retraces, no host syncs, fault sites firing) —
+        # running them over a package that fails the static versions of
+        # those same invariants produces numbers nobody should trust
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from tools import graftlint
+
+        lint = graftlint.lint(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "deeplearning4j_tpu"))
+        if not lint.clean:
+            for f in lint.findings:
+                print(f.render(), file=sys.stderr)
+            print(json.dumps({"error": "graftlint preflight failed — fix "
+                              "or suppress (with a reason) before "
+                              "benching",
+                              "findings": len(lint.findings)}))
+            sys.exit(1)
 
     steps = args.steps or 30
 

@@ -53,6 +53,44 @@ resuming training concurrently with its restarted replacement.
 Every fired fault bumps an ``OpProfiler`` counter
 (``faults/<site>/<kind>``), so a run can assert both that injected faults
 actually fired and that zero fired in production configs.
+
+Site registry
+-------------
+The table below is generated-checked against :data:`FAULT_SITES` by
+graftlint's ``fault-site-registry`` rule: every site must appear here, in
+the registry, at ≥1 ``fault_point`` call site, and in ≥1 test/bench
+drill — adding or removing a site without updating all four is a lint
+failure, so drills and docs cannot silently drift.
+
+====================  ======================  ==============================
+site                  kinds accepted          drill that exercises it
+====================  ======================  ==============================
+pipeline/bind         transient, slow, nan    test_fault_tolerance retry /
+                                              NaN-poison drills; fault-smoke
+pipeline/place        transient, slow         test_fault_tolerance H2D
+                                              placement-retry drills
+train/step            crash                   test_kill_resume exact-parity
+                                              kill (exit mode); supervisor
+                                              restart drills; fault-smoke
+train/wedge           wedge                   test_supervisor watchdog
+                                              abandonment drill
+device/loss           device_loss             test_elastic shrink drills;
+                                              elastic-smoke bench
+supervisor/hang       wedge, slow             test_supervisor pre-heartbeat
+                                              hang drill
+checkpoint/pre_rename crash                   test_fault_tolerance
+                                              torn-write drills
+inference/worker      dead_replica            test_fault_tolerance replica
+                                              retirement / pool drills
+inference/probe       transient               test_supervisor resurrection
+                                              failed-probe backoff
+elastic/probe         transient               test_elastic grow-back
+                                              probe-failure backoff
+serving/enqueue       transient, slow         test_serving admission drills
+serving/dispatch      slow, transient,        test_serving wedged-dispatch /
+                      dead_replica            requeue / kill drills;
+                                              serving-smoke kill drill
+====================  ======================  ==============================
 """
 
 from __future__ import annotations
@@ -67,6 +105,50 @@ from typing import Any, Dict, List, Optional
 logger = logging.getLogger("deeplearning4j_tpu")
 
 ENV_PLAN = "DL4J_TPU_FAULT_PLAN"
+
+# The central site registry (see the module docstring table, which the
+# fault-site-registry lint keeps in sync with this dict): site name ->
+# accepted kinds + the drill that exercises it. FaultPlan validates spec
+# sites against it so a typo'd site fails at plan construction instead of
+# silently never firing.
+FAULT_SITES = {
+    "pipeline/bind": {
+        "kinds": ("transient", "slow", "nan"),
+        "drill": "test_fault_tolerance retry/NaN-poison; fault-smoke"},
+    "pipeline/place": {
+        "kinds": ("transient", "slow"),
+        "drill": "test_fault_tolerance H2D placement-retry"},
+    "train/step": {
+        "kinds": ("crash",),
+        "drill": "test_kill_resume exact-parity kill; supervisor restarts"},
+    "train/wedge": {
+        "kinds": ("wedge",),
+        "drill": "test_supervisor watchdog abandonment"},
+    "device/loss": {
+        "kinds": ("device_loss",),
+        "drill": "test_elastic shrink; elastic-smoke"},
+    "supervisor/hang": {
+        "kinds": ("wedge", "slow"),
+        "drill": "test_supervisor pre-heartbeat hang"},
+    "checkpoint/pre_rename": {
+        "kinds": ("crash",),
+        "drill": "test_fault_tolerance torn-write"},
+    "inference/worker": {
+        "kinds": ("dead_replica",),
+        "drill": "test_fault_tolerance replica retirement"},
+    "inference/probe": {
+        "kinds": ("transient",),
+        "drill": "test_supervisor resurrection probe backoff"},
+    "elastic/probe": {
+        "kinds": ("transient",),
+        "drill": "test_elastic grow-back probe failure"},
+    "serving/enqueue": {
+        "kinds": ("transient", "slow"),
+        "drill": "test_serving admission drills"},
+    "serving/dispatch": {
+        "kinds": ("slow", "transient", "dead_replica"),
+        "drill": "test_serving wedge/requeue/kill; serving-smoke"},
+}
 
 
 class TransientFault(RuntimeError):
@@ -144,6 +226,10 @@ class FaultPlan:
             spec["_fired"] = 0
             if "site" not in spec or "kind" not in spec:
                 raise ValueError(f"fault spec needs site and kind: {f!r}")
+            if spec["site"] not in FAULT_SITES:
+                raise ValueError(
+                    f"unknown fault site {spec['site']!r} — register it "
+                    "in FAULT_SITES (and the docstring table) first")
             self._specs.append(spec)
 
     @classmethod

@@ -40,10 +40,18 @@ class OpProfiler:
     def start(self, logdir: str) -> None:
         import jax
 
-        if self._trace_dir is not None:
-            raise RuntimeError("profiler already tracing")
-        jax.profiler.start_trace(logdir)
-        self._trace_dir = logdir
+        with self._lock:
+            if self._trace_dir is not None:
+                raise RuntimeError("profiler already tracing")
+            self._trace_dir = logdir
+        try:
+            jax.profiler.start_trace(logdir)
+        except BaseException:
+            # a failed start (unwritable logdir) must not wedge the
+            # profiler in "already tracing" with no trace to stop
+            with self._lock:
+                self._trace_dir = None
+            raise
         from .environment import Environment
 
         Environment.get().set_profiling(True)
@@ -51,10 +59,11 @@ class OpProfiler:
     def stop(self) -> None:
         import jax
 
-        if self._trace_dir is None:
-            return
+        with self._lock:
+            if self._trace_dir is None:
+                return
+            self._trace_dir = None
         jax.profiler.stop_trace()
-        self._trace_dir = None
         from .environment import Environment
 
         Environment.get().set_profiling(False)
@@ -75,14 +84,19 @@ class OpProfiler:
             yield
         finally:
             dt = time.perf_counter() - t0
-            s = self._sections.setdefault(
-                name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
-            s["count"] += 1
-            s["total_s"] += dt
-            s["max_s"] = max(s["max_s"], dt)
+            # under the lock: sections are bumped from the training
+            # thread, the checkpoint writer and inference workers alike —
+            # unlocked read-modify-write drops updates
+            with self._lock:
+                s = self._sections.setdefault(
+                    name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+                s["count"] += 1
+                s["total_s"] += dt
+                s["max_s"] = max(s["max_s"], dt)
 
     def get_statistics(self) -> Dict[str, Dict[str, float]]:
-        return {k: dict(v) for k, v in self._sections.items()}
+        with self._lock:
+            return {k: dict(v) for k, v in self._sections.items()}
 
     # --- event counters (compile/retrace accounting) --------------------
     # The train-step builders bump ``trace/<name>`` INSIDE the function
@@ -105,7 +119,8 @@ class OpProfiler:
         return self._counters.get(name, 0)
 
     def get_counters(self) -> Dict[str, int]:
-        return dict(self._counters)
+        with self._lock:
+            return dict(self._counters)
 
     def trace_counts(self) -> Dict[str, int]:
         """Just the ``trace/*`` counters (the retrace ledger)."""
@@ -266,6 +281,15 @@ class OpProfiler:
         return {k.split("/", 1)[1]: v for k, v in self._counters.items()
                 if k.startswith("precision/")}
 
+    def tracecheck_stats(self) -> Dict[str, float]:
+        """Steady-state sanitizer ledger (``tracecheck/*`` counters):
+        regions armed and regions that tripped. The bench smoke configs
+        assert both directions — clean runs arm without tripping, the
+        injected-retrace drill must trip. Empty until a
+        ``tracecheck.steady_state`` region runs."""
+        return {k.split("/", 1)[1]: v for k, v in self._counters.items()
+                if k.startswith("tracecheck/")}
+
     def fault_stats(self) -> Dict[str, float]:
         """Fault-tolerance ledger: injected-fault counters
         (``faults/<site>/<kind>``), pipeline retry count, and backoff wall
@@ -295,5 +319,6 @@ class OpProfiler:
         return out
 
     def reset(self) -> None:
-        self._sections.clear()
-        self._counters.clear()
+        with self._lock:
+            self._sections.clear()
+            self._counters.clear()

@@ -1,0 +1,209 @@
+"""Runtime trace sanitizer: hard-fail on retraces / host syncs in a
+declared steady-state region.
+
+graftlint (tools/graftlint) is the static half of the trace-boundary
+discipline; this is the runtime half. The whole-graph-compilation line
+of work (nGraph, the Julia-to-TPU compiler — PAPERS.md) and this repo's
+own PR 1/2 both land on the same invariant: after warmup, a training or
+serving hot loop must be *replay* — no new traces, no new XLA compiles,
+no surprise device→host round-trips. The repo already measures that
+invariant (the ``trace/*`` profiler counters the step builders bump at
+trace time); :func:`steady_state` turns it into an armed tripwire:
+
+    with tracecheck.steady_state("timed fit"):
+        model.fit(it, epochs=1)
+    # SteadyStateViolation if anything (re)traced, compiled, or called
+    # jax.device_get inside the region
+
+Three independent detectors, because each sees through a different
+blind spot:
+
+- **jax monitoring hooks** — ``/jax/core/compile/backend_compile_duration``
+  events count real XLA compiles and ``jaxpr_trace_duration`` events
+  count traces, including jits this repo did not write (the first
+  offending event records a host stack snapshot for the report);
+- **``trace/*`` counters** — the step builders bump these inside their
+  jitted Python bodies, so a retrace served from the persistent
+  compilation cache (no backend compile!) is still caught;
+- **``jax.device_get`` hook** — the region wraps the function and counts
+  calls against ``max_host_syncs`` (default 0). On TPU/GPU an optional
+  transfer guard (``jax.transfer_guard_device_to_host("disallow")``)
+  additionally catches *implicit* D2H transfers; on the CPU test mesh
+  that guard never fires (host arrays are zero-copy views — the very
+  aliasing the donation-alias lint exists for), which is why the
+  explicit hook exists.
+
+Violations raise at region EXIT (raising from inside jax's monitoring
+callback would unwind through the middle of a compile), carrying every
+detector's evidence. Every region bumps ``tracecheck/regions``; every
+violating region bumps ``tracecheck/violations`` — the bench smoke
+configs assert on both sides (clean runs arm it silently, the injected
+retrace drill must trip it).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import traceback
+from typing import Dict, List, Optional
+
+from .profiler import OpProfiler
+
+
+class SteadyStateViolation(RuntimeError):
+    """The declared steady-state region (re)traced, compiled, or blocked
+    on the host. ``report`` carries the per-detector evidence."""
+
+    def __init__(self, message: str, report: Dict):
+        super().__init__(message)
+        self.report = report
+
+
+class _Region:
+    """Mutable state of one armed region (returned by steady_state)."""
+
+    def __init__(self, label: str):
+        self.label = label
+        self.compiles = 0
+        self.traces = 0
+        self.host_syncs = 0
+        self.first_stack: Optional[str] = None
+        self.counter_deltas: Dict[str, int] = {}
+
+    def report(self) -> Dict:
+        return {"label": self.label, "compiles": self.compiles,
+                "traces": self.traces, "host_syncs": self.host_syncs,
+                "counter_deltas": dict(self.counter_deltas),
+                "first_stack": self.first_stack}
+
+
+_active_lock = threading.Lock()
+_active_region: Optional[_Region] = None
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+
+
+@contextlib.contextmanager
+def steady_state(label: str = "steady-state", *, allow_compiles: int = 0,
+                 max_host_syncs: Optional[int] = 0,
+                 watch_prefixes=("trace/",),
+                 transfer_guard: bool = False):
+    """Declare everything inside the ``with`` to be steady state.
+
+    ``allow_compiles``: new traces/compiles tolerated, counted as the
+    max over the detectors so one real retrace isn't multiply billed.
+    At the default 0 the jaxpr-trace events are policed too (nothing may
+    trace); with a nonzero budget only backend compiles and watched
+    counters count, because one logical compile emits several trace
+    events. ``max_host_syncs``: explicit ``jax.device_get`` calls
+    tolerated (a declared once-per-window telemetry drain belongs in
+    this budget, not hidden); ``None`` counts but does not police —
+    for regions whose sync cadence is data-dependent by design. ``watch_prefixes``: profiler counter
+    prefixes that must not move. ``transfer_guard``: also arm jax's
+    device-to-host transfer guard (meaningful on TPU/GPU only).
+
+    Yields the region object (``.compiles`` / ``.traces`` /
+    ``.host_syncs`` so far); raises :class:`SteadyStateViolation` at
+    exit when any budget is exceeded. Regions do not nest — the inner
+    declaration would silently re-budget the outer one.
+    """
+    global _active_region
+    import jax
+    from jax._src import monitoring
+
+    region = _Region(label)
+    with _active_lock:
+        if _active_region is not None:
+            raise RuntimeError(
+                f"steady_state regions do not nest (active: "
+                f"{_active_region.label!r})")
+        _active_region = region
+
+    prof = OpProfiler.get()
+    prof.count("tracecheck/regions")
+    counters_before = {k: v for k, v in prof.get_counters().items()
+                       if any(k.startswith(p) for p in watch_prefixes)}
+
+    armed = True
+
+    def on_event(name: str, **kw) -> None:
+        # duration listener: fires for compile-pipeline stages
+        if not armed:
+            return
+        if name == _COMPILE_EVENT:
+            region.compiles += 1
+        elif name == _TRACE_EVENT:
+            region.traces += 1
+        else:
+            return
+        if region.first_stack is None:
+            region.first_stack = "".join(traceback.format_stack(limit=18))
+
+    def on_duration(name: str, duration: float, **kw) -> None:
+        on_event(name)
+
+    orig_device_get = jax.device_get
+
+    def counting_device_get(*args, **kw):
+        if armed:
+            region.host_syncs += 1
+            if region.first_stack is None and max_host_syncs is not None \
+                    and region.host_syncs > max_host_syncs:
+                region.first_stack = "".join(
+                    traceback.format_stack(limit=18))
+        return orig_device_get(*args, **kw)
+
+    monitoring.register_event_duration_secs_listener(on_duration)
+    jax.device_get = counting_device_get
+    guard = jax.transfer_guard_device_to_host("disallow") \
+        if transfer_guard else contextlib.nullcontext()
+    try:
+        with guard:
+            yield region
+    finally:
+        armed = False
+        jax.device_get = orig_device_get
+        try:
+            monitoring._unregister_event_duration_listener_by_callback(
+                on_duration)
+        except Exception:       # pragma: no cover - private API moved;
+            pass                # the armed flag keeps the leak inert
+        with _active_lock:
+            _active_region = None
+
+    counters_after = {k: v for k, v in prof.get_counters().items()
+                      if any(k.startswith(p) for p in watch_prefixes)}
+    region.counter_deltas = {
+        k: counters_after[k] - counters_before.get(k, 0)
+        for k in counters_after
+        if counters_after[k] != counters_before.get(k, 0)}
+
+    problems: List[str] = []
+    retraces = max(region.compiles, sum(region.counter_deltas.values()))
+    if allow_compiles == 0:
+        # the jaxpr-trace detector closes the persistent-compile-cache
+        # blind spot: a cache-served retrace of a jit with no trace/*
+        # counter emits ONLY trace events (no backend compile). One
+        # logical compile emits SEVERAL trace events (inner jaxprs), so
+        # the event count is unusable against a nonzero budget — it only
+        # polices the strict "nothing may trace at all" case.
+        retraces = max(retraces, region.traces)
+    if retraces > allow_compiles:
+        moved = ", ".join(f"{k}+{v}" for k, v in
+                          sorted(region.counter_deltas.items())) or \
+            f"{region.compiles} backend compile(s), {region.traces} " \
+            "jaxpr trace(s)"
+        problems.append(f"retraced/compiled inside steady state: {moved} "
+                        f"(allowed {allow_compiles})")
+    if max_host_syncs is not None and region.host_syncs > max_host_syncs:
+        problems.append(f"{region.host_syncs} jax.device_get host "
+                        f"sync(s) (allowed {max_host_syncs})")
+    if problems:
+        prof.count("tracecheck/violations")
+        stack = f"\nfirst offender stack:\n{region.first_stack}" \
+            if region.first_stack else ""
+        raise SteadyStateViolation(
+            f"steady-state region {label!r}: " + "; ".join(problems)
+            + stack, region.report())

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from typing import Any, List, Optional
 
@@ -236,6 +237,10 @@ class CheckpointListener(TrainingListener):
         self._group: Optional[List[Any]] = None
         self._pending_tag: Optional[str] = None
         self._seq = len(self._saved)
+        # guards the writer handle and the committed-paths mirror: the
+        # background writer's on_commit callback mutates _saved from the
+        # writer thread while the training thread reads/saves
+        self._lock = threading.Lock()
 
     @property
     def saved(self) -> List[str]:
@@ -249,30 +254,36 @@ class CheckpointListener(TrainingListener):
     def bind_group(self, listeners: List[Any]) -> None:
         """set_listeners hands the full listener list over so snapshots
         can capture peer listeners' ``state_dict`` for exact resume."""
+        # graftlint: disable=lock-discipline -- wiring step: set_listeners
+        # runs on the training thread before any fit/writer activity
         self._group = list(listeners)
 
     def _note_commit(self, path: str) -> None:
         # mirror the retention the commit just applied, WITHOUT re-reading
         # the manifest from disk on every commit (the writer thread calls
-        # this once per checkpoint)
-        saved = [p for p in self._saved if p != path] + [path]
-        if self.keep_last and len(saved) > self.keep_last:
-            saved = saved[-self.keep_last:]
-        if self.max_total_bytes:
-            # the byte-budget GC already unlinked its victims — one stat
-            # per survivor keeps the mirror honest without a manifest read
-            saved = [p for p in saved if os.path.exists(p)]
-        self._saved = saved
+        # this once per checkpoint; sync commits call it from the
+        # training thread — hence the lock)
+        with self._lock:
+            saved = [p for p in self._saved if p != path] + [path]
+            if self.keep_last and len(saved) > self.keep_last:
+                saved = saved[-self.keep_last:]
+            if self.max_total_bytes:
+                # the byte-budget GC already unlinked its victims — one
+                # stat per survivor keeps the mirror honest without a
+                # manifest read
+                saved = [p for p in saved if os.path.exists(p)]
+            self._saved = saved
 
     def _get_writer(self):
         from ..util import checkpoint as _ckpt
 
-        if self._writer is None:
-            self._writer = _ckpt.CheckpointWriter(
-                self.dir, self.keep_last, on_commit=self._note_commit,
-                max_total_bytes=self.max_total_bytes,
-                incarnation=self.incarnation)
-        return self._writer
+        with self._lock:
+            if self._writer is None:
+                self._writer = _ckpt.CheckpointWriter(
+                    self.dir, self.keep_last, on_commit=self._note_commit,
+                    max_total_bytes=self.max_total_bytes,
+                    incarnation=self.incarnation)
+            return self._writer
 
     # --- saving ---------------------------------------------------------
     def _save(self, model, tag: str, sync: bool = False) -> Optional[str]:
@@ -307,6 +318,8 @@ class CheckpointListener(TrainingListener):
                                        max_total_bytes=self.max_total_bytes,
                                        incarnation=self.incarnation,
                                        state_dtype=snapshot.get("state_dtype"))
+        # graftlint: disable=lock-discipline -- training-thread-only: sync
+        # commits never overlap the async writer (save_now flushes first)
         self._seq += 1
         self._note_commit(path)
         return path
@@ -332,6 +345,8 @@ class CheckpointListener(TrainingListener):
 
     def iteration_done(self, model, iteration, score):
         if self.every_iter and iteration % self.every_iter == 0:
+            # graftlint: disable=lock-discipline -- listener-bus state:
+            # iteration_done only ever runs on the training thread
             self._pending_tag = f"iter_{iteration}"
         if self._pending_tag is not None and \
                 getattr(model, "_at_dispatch_boundary", True):
@@ -339,6 +354,8 @@ class CheckpointListener(TrainingListener):
             # consistent with the LAST step of the chunk — tag that one
             tag = (f"iter_{iteration}" if self._pending_tag.startswith("iter_")
                    else self._pending_tag)
+            # graftlint: disable=lock-discipline -- same training-thread
+            # ownership as the arm above
             self._pending_tag = None
             self._save(model, tag)
 
@@ -358,10 +375,14 @@ class CheckpointListener(TrainingListener):
             self._writer.flush(timeout)
 
     def close(self) -> None:
-        if self._writer is not None:
-            self._writer.close()
-            self._closed_errors = list(self._writer.errors)
-            self._writer = None
+        with self._lock:
+            writer, self._writer = self._writer, None
+        if writer is not None:
+            writer.close()
+            # graftlint: disable=lock-discipline -- written after
+            # writer.close() joined the background thread; no concurrent
+            # reader exists past that point
+            self._closed_errors = list(writer.errors)
 
     def errors(self) -> List[BaseException]:
         """Write failures recorded by the async writer (a failed write

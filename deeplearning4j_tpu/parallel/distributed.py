@@ -257,7 +257,11 @@ class _Heartbeat:
         self.last_beat = time.monotonic()
 
     def iteration_done(self, model, iteration: int, score) -> None:
+        # graftlint: disable=lock-discipline -- single-writer: only the
+        # training thread beats; the watchdog reads monotonic values
+        # racily by design (a torn read is at worst one stale poll)
         self.steps += 1
+        # graftlint: disable=lock-discipline -- same single-writer pulse
         self.last_beat = time.monotonic()
         sup = self._sup
         boundary = getattr(model, "_at_dispatch_boundary", True)
@@ -273,6 +277,7 @@ class _Heartbeat:
                 f"grow data axis back to {sup._resize_request} workers")
 
     def epoch_done(self, model, epoch: int) -> None:
+        # graftlint: disable=lock-discipline -- same single-writer pulse
         self.last_beat = time.monotonic()
 
 
@@ -319,9 +324,14 @@ class _Attempt:
                                  resume_from=self._resume_from,
                                  **self._fit_kwargs)
         except BaseException as e:          # incl. SimulatedCrash/Preempted
+            # graftlint: disable=lock-discipline -- happens-before via
+            # done.set(): written by the attempt thread, read only after
+            # done.wait() returns
             self.error = e
         finally:
             try:
+                # graftlint: disable=lock-discipline -- same done.set()
+                # happens-before edge as error above
                 self.rng_state = get_random().get_state()
             finally:
                 self.done.set()

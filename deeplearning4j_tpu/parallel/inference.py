@@ -464,6 +464,9 @@ class ParallelInference:
             # one-row sample of a known-good input: what the
             # resurrection health probe replays (copy — a view would
             # pin the whole merged batch in memory between requests)
+            # graftlint: disable=lock-discipline -- last-write-wins slot:
+            # one atomic reference store of a fresh owning copy; probes
+            # read whichever sample is newest
             self._probe_input = merged[:1].copy()
             off = 0
             for r in batch:
@@ -505,6 +508,9 @@ class ParallelInference:
         an immediate error instead of hanging on a future no worker will
         ever fulfil. A worker wedged past the drain window is abandoned
         (daemon thread); its batch resolves whenever it does."""
+        # graftlint: disable=lock-discipline -- stop flag: one False->True
+        # transition; workers poll it racily by design (a lock would only
+        # delay the observation, not change it)
         self._shutdown = True
         deadline = time.monotonic() + max(0.0, drain_timeout_s)
         for t in self._workers + self._resurrectors:

@@ -442,9 +442,15 @@ class ServingEngine(ParallelInference):
             # tracks the padded length) from pooled ones (constant dim 1
             # that may coincide with ONE rung); a single rung stays on
             # the dispatch-time shape heuristic
+            # graftlint: disable=lock-discipline -- startup phase: warmup
+            # completes before the pool serves; _warm below is the fence
             self._seq_out_per_timestep = all(w == t
                                              for t, w in seq_out.items())
+        # graftlint: disable=lock-discipline -- startup publication:
+        # workers only consult the trace alarm once _warm flips, and both
+        # stores happen-before any dispatch observes _warm=True
         self._traces_seen = self._trace_cell[0]
+        # graftlint: disable=lock-discipline -- same startup publication
         self._warm = True
         return timings
 
@@ -678,6 +684,9 @@ class ServingEngine(ParallelInference):
             # injected SimulatedCrash must still retire cleanly
             self._retire(worker_id, e, [r.fut for r in batch])
             raise
+        # graftlint: disable=lock-discipline -- last-write-wins slot: one
+        # atomic reference store of a fresh owning copy (same contract as
+        # ParallelInference._serve_batch)
         self._probe_input = padded[:1].copy()
         t_done = time.monotonic()
         t_pad = padded.shape[1] if padded.ndim >= 2 else None
@@ -705,13 +714,18 @@ class ServingEngine(ParallelInference):
         if self._warm:
             traces = self._trace_cell[0]
             if traces > self._traces_seen:
-                # the one thing steady-state serving must never do
-                prof.count("serving/traces_after_warmup",
-                           traces - self._traces_seen)
-                self._traces_seen = traces
-                logger.warning("serving traced AFTER warmup (shape %s) — "
-                               "a bucket escaped the warmup set",
-                               padded.shape)
+                # the one thing steady-state serving must never do. Under
+                # the pool lock: concurrent workers racing the unlocked
+                # read-modify-write would double-count the alarm delta
+                with self._lock:
+                    delta = traces - self._traces_seen
+                    if delta > 0:
+                        prof.count("serving/traces_after_warmup", delta)
+                        self._traces_seen = traces
+                if delta > 0:
+                    logger.warning("serving traced AFTER warmup (shape "
+                                   "%s) — a bucket escaped the warmup "
+                                   "set", padded.shape)
 
     def _requeue(self, batch: List[_Request], exhausted_exc) -> None:
         prof = OpProfiler.get()

@@ -449,7 +449,7 @@ class ParallelWrapper:
                 # init DIRECTLY in the flat layout (zeros flatten to
                 # zeros, so this equals flatten(dense init) exactly).
                 # np.array, not np.asarray: device_get views alias
-                # donatable buffers (the PR-3 lesson; tools/static_lint
+                # donatable buffers (the PR-3 lesson; tools/graftlint
                 # enforces the pattern)
                 flat_p = plan.flatten(jax.tree.map(np.array,
                                                    jax.device_get(

@@ -185,7 +185,11 @@ class StatsListener(TrainingListener):
                 params = jax.device_get(params)
             for i, lp in enumerate(params):
                 for name, w in lp.items():
-                    arr = np.asarray(w)
+                    # np.array, not np.asarray: on the CPU backend the
+                    # batched device_get above returns zero-copy views of
+                    # donatable buffers, and put_histogram STORES the
+                    # array — it must own its bytes
+                    arr = np.array(w)
                     if self.collect_param_norms:
                         self.storage.put_scalar(
                             self.session, f"param_mean_magnitude/{i}_{name}",

@@ -684,8 +684,9 @@ class CheckpointWriter:
     def submit(self, snapshot: Dict[str, Any], tag: str) -> None:
         with self._cond:
             self._pending += 1
-        self._q.put((snapshot, tag, self._seq))
-        self._seq += 1
+            seq = self._seq
+            self._seq += 1
+        self._q.put((snapshot, tag, seq))
 
     def _run(self) -> None:
         while True:

@@ -1,0 +1,27 @@
+"""Seeded regressions for donation-alias: every shape this repo has
+actually shipped (PR-3 checkpoint snapshot, PR-6 wrapper reshard, the
+renamed-variable flow the old grep could not see)."""
+import jax
+import numpy as np
+
+
+def direct_alias(model):
+    return np.asarray(jax.device_get(model._params))        # finding
+
+
+def tree_map_alias(plan, params):
+    return plan.flatten(jax.tree.map(np.asarray,
+                                     jax.device_get(params)))  # finding
+
+
+def renamed_flow(params):
+    host = jax.device_get(params)
+    arrs = []
+    for layer in host:
+        arrs.append(np.asarray(layer))                      # finding
+    return arrs
+
+
+class Holder:
+    def stash(self, params):
+        self._snapshot = jax.device_get(params)             # finding

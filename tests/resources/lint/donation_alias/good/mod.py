@@ -1,0 +1,28 @@
+"""Owning-copy spellings: every one of these is the FIX for the bad
+fixture's corresponding finding — none may fire."""
+import jax
+import numpy as np
+
+
+def direct_copy(model):
+    return np.array(jax.device_get(model._params))
+
+
+def tree_map_copy(plan, params):
+    return plan.flatten(jax.tree.map(np.array, jax.device_get(params)))
+
+
+def renamed_flow_copy(params):
+    host = jax.device_get(params)
+    return [np.array(layer) for layer in host]
+
+
+def asarray_of_host_data(batch):
+    # np.asarray over plain host data is fine — no device buffer involved
+    return np.asarray(batch)
+
+
+def rebound_name(params, batch):
+    host = jax.device_get(params)
+    host = np.array(host[0])         # rebinding clears the taint
+    return np.asarray(host)

@@ -1,0 +1,11 @@
+"""A justified alias: read-only use inside one listener callback, freed
+before the next dispatch — the suppression documents the ownership."""
+import jax
+import numpy as np
+
+
+def transient_readonly_view(params):
+    # graftlint: disable=donation-alias -- read-only mean over the view,
+    # consumed before the next dispatch can free the donated buffer
+    view = np.asarray(jax.device_get(params))
+    return float(view.mean())

@@ -1,0 +1,8 @@
+from faultinject import fault_point
+
+
+def bind(batch, ordinal, site_name):
+    fault_point("pipeline/bind", ordinal)
+    fault_point("pipeline/typo_site", ordinal)   # finding: unregistered
+    fault_point(site_name, ordinal)              # finding: non-literal
+    return batch

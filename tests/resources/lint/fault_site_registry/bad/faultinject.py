@@ -1,0 +1,17 @@
+"""Mini faultinject with drift: the docstring table below only knows
+one site — the second registry entry is undocumented, uncalled and
+undrilled.
+
+Site registry
+-------------
+pipeline/bind: transient — the retry drill.
+"""
+
+FAULT_SITES = {
+    "pipeline/bind": {"kinds": ("transient",), "drill": "retry drill"},
+    "drill/dead": {"kinds": ("crash",), "drill": "nothing uses this"},
+}
+
+
+def fault_point(site, index=None):
+    return []

@@ -1,0 +1,3 @@
+# reference corpus: only pipeline/bind has a drill
+def test_bind_retries():
+    assert "pipeline/bind"

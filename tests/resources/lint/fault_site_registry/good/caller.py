@@ -1,0 +1,6 @@
+from faultinject import fault_point
+
+
+def bind(batch, ordinal):
+    fault_point("pipeline/bind", ordinal)
+    return batch

@@ -1,0 +1,14 @@
+"""Mini faultinject, fully in sync.
+
+Site registry
+-------------
+pipeline/bind: transient — the retry drill (test_drills.py).
+"""
+
+FAULT_SITES = {
+    "pipeline/bind": {"kinds": ("transient",), "drill": "retry drill"},
+}
+
+
+def fault_point(site, index=None):
+    return []

@@ -1,0 +1,2 @@
+def test_bind_retries():
+    assert "pipeline/bind"

@@ -1,0 +1,9 @@
+from faultinject import fault_point
+
+
+def bind(batch, ordinal):
+    fault_point("pipeline/bind", ordinal)
+    # graftlint: disable=fault-site-registry -- staging site for the next
+    # PR's drill; registered there together with its test
+    fault_point("pipeline/staged_site", ordinal)
+    return batch

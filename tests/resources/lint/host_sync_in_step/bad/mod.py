@@ -1,0 +1,31 @@
+"""Seeded regressions for host-sync-in-step: direct syncs in a jitted
+step, a scan body, and the repo's step->core closure idiom (the
+call-graph edge a decorator-only check would miss)."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def decorated_step(x):
+    print("loss", x)                 # finding
+    return float(x) * 2              # finding
+
+
+def build_step():
+    def core(params, x):
+        np.asarray(x)                # finding (reached via step -> core)
+        return params
+
+    def step(params, x):
+        return core(params, x)
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def scan_body_sync(xs):
+    def body(carry, x):
+        v = x.item()                 # finding
+        host = jax.device_get(x)     # finding
+        return carry + v, host
+
+    return jax.lax.scan(body, 0.0, xs)

@@ -1,0 +1,24 @@
+"""Device-side spellings and static conversions — none may fire."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def clean_step(params, x):
+    n = int(x.shape[0])              # static at trace time: exempt
+    jax.debug.print("rows {}", n)    # device-side print: fine
+    return params * jnp.mean(x)
+
+
+def host_loop(model, batches):
+    # NOT jitted: host syncs are this function's whole job
+    for b in batches:
+        print(float(np.mean(np.asarray(b))))
+
+
+def build_step():
+    def step(params, x):
+        return params - 0.1 * jnp.mean(x)
+
+    return jax.jit(step, donate_argnums=(0,))

@@ -1,0 +1,11 @@
+"""A justified trace-time constant inside a jitted step."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def step_with_constant(ids):
+    # graftlint: disable=host-sync-in-step -- trace-time constant:
+    # iinfo folds into the trace, no runtime host work
+    sentinel = np.iinfo(np.uint16).max
+    return ids == sentinel

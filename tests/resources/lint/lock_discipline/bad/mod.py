@@ -1,0 +1,27 @@
+"""Seeded regressions for lock-discipline: unlocked mutations on classes
+from the shared registry (worker-thread pool state, writer bookkeeping)."""
+import threading
+
+
+class ParallelInference:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._alive = 0
+
+    def retire(self, worker_id):
+        self._alive -= 1                 # finding: no lock held
+
+    def note(self, n):
+        with self._lock:
+            self._alive = n
+        self._retired = True             # finding: outside the with
+
+
+class CheckpointWriter:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._seq = 0
+
+    def submit(self, job):
+        self._seq += 1                   # finding
+        return job, self._seq

@@ -1,0 +1,36 @@
+"""Locked mutations (and __init__ construction) — none may fire."""
+import threading
+
+
+class ParallelInference:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._alive = 0                  # construction: exempt
+
+    def retire(self, worker_id):
+        with self._lock:
+            self._alive -= 1
+
+    def note(self, n):
+        with self._lock:
+            self._alive = n
+            self._retired = True
+
+
+class CheckpointWriter:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._seq = 0
+
+    def submit(self, job):
+        with self._cond:
+            self._seq += 1
+            seq = self._seq
+        return job, seq
+
+
+class NotShared:
+    """Not in the registry: free to mutate unlocked."""
+
+    def bump(self):
+        self.n = getattr(self, "n", 0) + 1

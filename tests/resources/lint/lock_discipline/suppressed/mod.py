@@ -1,0 +1,13 @@
+"""A justified unlocked mutation: single-writer stop flag."""
+import threading
+
+
+class ParallelInference:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._shutdown = False
+
+    def shutdown(self):
+        # graftlint: disable=lock-discipline -- stop flag: one
+        # False->True transition, workers poll racily by design
+        self._shutdown = True

@@ -1,0 +1,14 @@
+"""Seeded regressions for pallas-guard: a bare kernel launch (no
+interpret, no gate) and the per-site case the old per-file grep missed —
+one guarded call shadowing a later unguarded one."""
+from jax.experimental import pallas as pl
+
+
+def bare_launch(kernel, x):
+    return pl.pallas_call(kernel, grid=(1,))(x)      # 2 findings
+
+
+def guarded_then_unguarded(kernel, x, interp):
+    a = pl.pallas_call(kernel, grid=(1,), interpret=interp)(x)
+    b = pl.pallas_call(kernel, grid=(1,))(a)         # finding (no interpret)
+    return b

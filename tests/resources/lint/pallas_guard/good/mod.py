@@ -1,0 +1,16 @@
+"""The ops/pallas_attention.py recipe: interpret= on every call, module
+gated on the backend."""
+import jax
+from jax.experimental import pallas as pl
+
+
+def _interp():
+    return jax.default_backend() != "tpu"
+
+
+def gated_launch(kernel, x):
+    return pl.pallas_call(kernel, grid=(1,), interpret=_interp())(x)
+
+
+def second_site(kernel, x):
+    return pl.pallas_call(kernel, grid=(1,), interpret=_interp())(x)

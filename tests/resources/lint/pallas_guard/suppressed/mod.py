@@ -1,0 +1,9 @@
+"""A justified unguarded kernel: interpreter-only reference kernel that
+never runs compiled."""
+from jax.experimental import pallas as pl
+
+
+def reference_kernel(kernel, x):
+    # graftlint: disable=pallas-guard -- interpreter-only numerics
+    # reference; never dispatched on a real backend (test helper)
+    return pl.pallas_call(kernel, grid=(1,))(x)

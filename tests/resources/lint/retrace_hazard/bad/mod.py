@@ -1,0 +1,26 @@
+"""Seeded regressions for retrace-hazard: Python literals threaded as
+traced jit args, containers through the boundary, and the attribute-held
+executable variant."""
+import jax
+
+
+def g(x, training, k):
+    return x * k if training else x
+
+
+step = jax.jit(g)
+
+
+def call_sites(x):
+    a = step(x, True, 3)             # 2 findings (bool + int traced)
+    b = step(x, training=False, k=2)  # 2 findings (kwargs traced)
+    c = step(x, True, [1, 2])        # 2 findings (bool + list literal)
+    return a, b, c
+
+
+class Model:
+    def __init__(self):
+        self._step = jax.jit(g)
+
+    def fit(self, x):
+        return self._step(x, True, 1)    # 2 findings

@@ -1,0 +1,27 @@
+"""Static slots, closures and device values — none may fire."""
+import jax
+import jax.numpy as jnp
+
+
+def g(x, training, k):
+    return x * k if training else x
+
+
+step = jax.jit(g, static_argnums=(1, 2))
+step_kw = jax.jit(g, static_argnames=("training", "k"))
+
+
+def call_sites(x, flag):
+    a = step(x, True, 3)                      # static slots: fine
+    b = step_kw(x, training=True, k=2)        # static names: fine
+    c = step(x, flag, 3)                      # name, not literal
+    d = step_kw(x, training=flag, k=jnp.int32(2))   # device value
+    return a, b, c, d
+
+
+def closure_config(training):
+    # config in a closure, not an argument: the RIGHT spelling
+    def f(x):
+        return x * 2 if training else x
+
+    return jax.jit(f)

@@ -1,0 +1,15 @@
+"""A justified traced literal: a one-off warmup call outside any loop."""
+import jax
+
+
+def g(x, k):
+    return x * k
+
+
+step = jax.jit(g)
+
+
+def warmup(x):
+    # graftlint: disable=retrace-hazard -- warmup: single priming call,
+    # the steady-state loop always passes the same device scalar
+    return step(x, 1)

@@ -1,62 +1,161 @@
-"""tools/static_lint wired into tier-1: the two shipped-and-fixed bug
-classes (device_get-view donation aliasing; unguarded Pallas kernels)
-must never re-enter the package. Pure text scans — no jax imports, so
-this file costs milliseconds of the tier-1 budget."""
+"""graftlint wired into tier-1: the shipped-and-fixed bug classes
+(device_get donation aliasing, unguarded Pallas kernels, host syncs in
+compiled steps, retrace hazards, unlocked shared-state mutation, fault-
+site drift) must never re-enter the package — and the rules themselves
+must demonstrably fire, stay quiet, and honor justified suppressions on
+the seeded fixtures under tests/resources/lint/.
 
+Pure stdlib-AST scans — no jax import, so this file costs tier-1
+milliseconds (the runtime half, tracecheck, is exercised from
+tests/test_observability.py where jax is already paid for)."""
+
+import json
 import os
 import sys
 import tempfile
 import textwrap
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "tools"))
+import pytest
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+# legacy import path (tools dir on sys.path, `import static_lint`) must
+# keep working — PR-8 era scripts and docs use it
+sys.path.insert(0, os.path.join(_REPO_ROOT, "tools"))
 
 import static_lint  # noqa: E402
+from tools import graftlint  # noqa: E402
+from tools.graftlint.__main__ import main as graftlint_main  # noqa: E402
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "resources", "lint")
+
+RULES = {
+    "donation_alias": "donation-alias",
+    "pallas_guard": "pallas-guard",
+    "host_sync_in_step": "host-sync-in-step",
+    "retrace_hazard": "retrace-hazard",
+    "lock_discipline": "lock-discipline",
+    "fault_site_registry": "fault-site-registry",
+}
 
 
 class TestPackageClean:
+    """The acceptance gate: the whole package under ALL six rules, zero
+    unexplained findings, every suppression carrying a reason."""
+
+    def test_package_clean_all_rules(self):
+        result = graftlint.lint(static_lint.package_root())
+        assert result.findings == [], "\n".join(
+            f.render() for f in result.findings)
+
+    def test_every_suppression_has_reason(self):
+        result = graftlint.lint(static_lint.package_root())
+        assert result.suppressed, \
+            "the package carries documented suppressions — zero means " \
+            "the suppression scan broke"
+        for f in result.suppressed:
+            assert f.reason.strip(), f.render()
+
+    def test_six_rules_active(self):
+        assert len(graftlint.RULE_NAMES) >= 6
+        assert set(RULES.values()) <= set(graftlint.RULE_NAMES)
+
+    # the PR-8 entry points, now shim-backed
     def test_no_donation_aliases_in_package(self):
-        findings = static_lint.lint_donation_aliases(
-            static_lint.package_root())
-        assert findings == [], (
-            "device_get views aliased via np.asarray flow into donated "
-            f"jit args (the PR-3/PR-6 heap-corruption class): {findings}")
+        assert static_lint.lint_donation_aliases(
+            static_lint.package_root()) == []
 
     def test_all_pallas_kernels_guarded(self):
-        findings = static_lint.lint_pallas_guards(static_lint.package_root())
-        assert findings == [], (
-            f"pallas_call sites without interpret/backend gate: {findings}")
+        assert static_lint.lint_pallas_guards(
+            static_lint.package_root()) == []
+
+
+class TestRuleFixtures:
+    """Every rule proven on its seeded fixtures: fires on bad/, stays
+    quiet on good/, honors a justified suppression on suppressed/."""
+
+    @pytest.mark.parametrize("fixture,rule", sorted(RULES.items()))
+    def test_fires_on_bad(self, fixture, rule):
+        res = graftlint.lint(os.path.join(FIXTURES, fixture, "bad"),
+                             [rule])
+        assert len(res.findings) >= 1
+        assert all(f.rule == rule for f in res.findings)
+
+    @pytest.mark.parametrize("fixture,rule", sorted(RULES.items()))
+    def test_quiet_on_good(self, fixture, rule):
+        res = graftlint.lint(os.path.join(FIXTURES, fixture, "good"),
+                             [rule])
+        assert res.findings == [], "\n".join(
+            f.render() for f in res.findings)
+        assert res.suppressed == []
+
+    @pytest.mark.parametrize("fixture,rule", sorted(RULES.items()))
+    def test_suppression_honored(self, fixture, rule):
+        res = graftlint.lint(os.path.join(FIXTURES, fixture,
+                                          "suppressed"), [rule])
+        assert res.findings == []
+        assert len(res.suppressed) >= 1
+        assert all(f.reason.strip() for f in res.suppressed)
+
+    def test_bad_counts(self):
+        """The seeded regressions are counted one finding per seeded
+        sin — a rule that collapses or explodes findings is broken."""
+        expect = {"donation_alias": 4, "pallas_guard": 5,
+                  "host_sync_in_step": 5, "retrace_hazard": 8,
+                  "lock_discipline": 3, "fault_site_registry": 5}
+        for fixture, rule in RULES.items():
+            res = graftlint.lint(os.path.join(FIXTURES, fixture, "bad"),
+                                 [rule])
+            assert len(res.findings) == expect[fixture], \
+                (fixture, [f.render() for f in res.findings])
+
+
+def _scan(src, fn):
+    with tempfile.TemporaryDirectory() as d:
+        with open(os.path.join(d, "mod.py"), "w") as f:
+            f.write(textwrap.dedent(src))
+        return fn(d)
 
 
 class TestLintDetects:
-    """The lints must actually fire — a lint that can't see the original
-    sin would pass trivially forever."""
-
-    def _scan(self, src, fn):
-        with tempfile.TemporaryDirectory() as d:
-            with open(os.path.join(d, "mod.py"), "w") as f:
-                f.write(textwrap.dedent(src))
-            return fn(d)
+    """The PR-8 seed cases through the legacy shim — plus the
+    renamed-variable flow the old regex could not see."""
 
     def test_catches_direct_alias(self):
-        hits = self._scan(
-            "x = np.asarray(jax.device_get(model._params))\n",
-            static_lint.lint_donation_aliases)
-        assert len(hits) == 1 and hits[0][1] == 1
+        hits = _scan("import jax, numpy as np\n"
+                     "x = np.asarray(jax.device_get(mp))\n",
+                     static_lint.lint_donation_aliases)
+        assert len(hits) == 1 and hits[0][1] == 2
 
     def test_catches_tree_map_alias(self):
-        # the exact PR-6 wrapper.py spelling, wrapped across lines
-        hits = self._scan(
+        hits = _scan(
             """
+            import jax, numpy as np
             flat = plan.flatten(jax.tree.map(np.asarray,
                                              jax.device_get(params)))
             """,
             static_lint.lint_donation_aliases)
         assert len(hits) == 1
 
-    def test_copying_spellings_pass(self):
-        hits = self._scan(
+    def test_catches_renamed_alias(self):
+        # the flow PR-8's grep missed: device_get result renamed, then
+        # aliased two statements later
+        hits = _scan(
             """
+            import jax, numpy as np
+            def snap(params):
+                host = jax.device_get(params)
+                keep = host
+                return np.asarray(keep)
+            """,
+            static_lint.lint_donation_aliases)
+        assert len(hits) == 1
+
+    def test_copying_spellings_pass(self):
+        hits = _scan(
+            """
+            import jax, numpy as np
             a = jax.tree.map(np.array, jax.device_get(p))
             b = np.asarray(host_batch)
             """,
@@ -64,13 +163,13 @@ class TestLintDetects:
         assert hits == []
 
     def test_catches_unguarded_pallas(self):
-        hits = self._scan(
-            "out = pl.pallas_call(kernel, grid=(1,))(x)\n",
-            static_lint.lint_pallas_guards)
-        assert len(hits) == 1
+        # per-call-site now: a bare call is missing BOTH guards
+        hits = _scan("out = pl.pallas_call(kernel, grid=(1,))(x)\n",
+                     static_lint.lint_pallas_guards)
+        assert len(hits) == 2 and all(h[1] == 1 for h in hits)
 
     def test_guarded_pallas_passes(self):
-        hits = self._scan(
+        hits = _scan(
             """
             def mode():
                 return jax.default_backend()
@@ -78,3 +177,183 @@ class TestLintDetects:
             """,
             static_lint.lint_pallas_guards)
         assert hits == []
+
+    def test_per_site_not_per_file(self):
+        # one guarded call must NOT shadow a later unguarded one (the
+        # old per-file grep's blind spot)
+        hits = _scan(
+            """
+            def mode():
+                return jax.default_backend()
+            a = pl.pallas_call(k, interpret=interp)(x)
+            b = pl.pallas_call(k, grid=(1,))(a)
+            """,
+            static_lint.lint_pallas_guards)
+        assert len(hits) == 1 and hits[0][1] == 5
+
+
+class TestSuppressionDiscipline:
+    def test_suppression_without_reason_is_a_finding(self):
+        res = _scan(
+            """
+            import jax, numpy as np
+            # graftlint: disable=donation-alias
+            x = np.asarray(jax.device_get(p))
+            """,
+            lambda d: graftlint.lint(d, ["donation-alias"]))
+        rules = sorted(f.rule for f in res.findings)
+        # the bare disable suppresses NOTHING and is itself flagged
+        assert rules == ["bad-suppression", "donation-alias"]
+
+    def test_wrong_rule_name_does_not_suppress(self):
+        res = _scan(
+            """
+            import jax, numpy as np
+            # graftlint: disable=pallas-guard -- wrong rule entirely
+            x = np.asarray(jax.device_get(p))
+            """,
+            lambda d: graftlint.lint(d, ["donation-alias"]))
+        assert [f.rule for f in res.findings] == ["donation-alias"]
+
+    def test_stale_suppression_is_a_finding(self):
+        # a justified suppression guarding nothing is ledger rot
+        res = _scan(
+            """
+            import numpy as np
+            # graftlint: disable=donation-alias -- guarded code was here
+            x = np.asarray(host_batch)
+            """,
+            lambda d: graftlint.lint(d, ["donation-alias"]))
+        assert [f.rule for f in res.findings] == ["unused-suppression"]
+
+    def test_other_rules_suppressions_not_judged_in_subset_runs(self):
+        # running --rules donation-alias must not flag a lock-discipline
+        # suppression as stale — that rule never ran
+        res = _scan(
+            """
+            import numpy as np
+            # graftlint: disable=lock-discipline -- owner-thread only
+            x = np.asarray(host_batch)
+            """,
+            lambda d: graftlint.lint(d, ["donation-alias"]))
+        assert res.findings == []
+
+    def test_attribute_stash_does_not_taint_self(self):
+        # `self.x = device_get(...)` is flagged as a stash, but must not
+        # taint `self` — unrelated self attributes stay clean, and later
+        # self assignments must not clear real taint
+        res = _scan(
+            """
+            import jax, numpy as np
+            class H:
+                def collect(self, p):
+                    self._stash = jax.device_get(p)
+                    return np.asarray(self.config)
+            """,
+            lambda d: graftlint.lint(d, ["donation-alias"]))
+        assert len(res.findings) == 1
+        assert "no owning copy" in res.findings[0].message
+
+    def test_disable_all_with_reason(self):
+        res = _scan(
+            """
+            import jax, numpy as np
+            # graftlint: disable=all -- generated file, audited upstream
+            x = np.asarray(jax.device_get(p))
+            """,
+            lambda d: graftlint.lint(d, ["donation-alias"]))
+        assert res.findings == [] and len(res.suppressed) == 1
+
+    def test_multiline_justification_attaches(self):
+        res = _scan(
+            """
+            import jax, numpy as np
+            # graftlint: disable=donation-alias -- read-only view,
+            # consumed before the next dispatch frees the buffer
+            x = np.asarray(jax.device_get(p))
+            """,
+            lambda d: graftlint.lint(d, ["donation-alias"]))
+        assert res.findings == [] and len(res.suppressed) == 1
+        assert "read-only view" in res.suppressed[0].reason
+
+
+class TestEngineOutput:
+    def test_json_shape(self):
+        res = graftlint.lint(os.path.join(FIXTURES, "donation_alias",
+                                          "bad"))
+        blob = json.loads(graftlint.render_json(res))
+        assert set(blob) == {"root", "rules", "findings", "suppressed"}
+        f = blob["findings"][0]
+        assert {"rule", "path", "line", "col", "message"} <= set(f)
+
+    def test_human_output_has_locations_and_hints(self):
+        res = graftlint.lint(os.path.join(FIXTURES, "donation_alias",
+                                          "bad"))
+        out = graftlint.render_human(res)
+        assert "mod.py:" in out and "hint:" in out
+        assert out.strip().endswith(
+            f"[{len(graftlint.RULE_NAMES)} rules]")
+
+    def test_cli_exit_codes(self, capsys):
+        bad = os.path.join(FIXTURES, "lock_discipline", "bad")
+        good = os.path.join(FIXTURES, "lock_discipline", "good")
+        assert graftlint_main([bad, "--rules", "lock-discipline"]) == 1
+        assert graftlint_main([good, "--rules", "lock-discipline"]) == 0
+        capsys.readouterr()
+
+    def test_list_rules(self, capsys):
+        assert graftlint_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in RULES.values():
+            assert rule in out
+
+    def test_unknown_rule_refused(self):
+        with pytest.raises(ValueError):
+            graftlint.lint(FIXTURES, ["no-such-rule"])
+
+    def test_missing_path_is_an_error_not_clean(self, capsys):
+        # a typo'd path must not report "clean" with exit 0 — CI and the
+        # bench preflight key off the exit code
+        with pytest.raises(FileNotFoundError):
+            graftlint.lint("no/such/path")
+        assert graftlint_main(["no/such/path"]) == 2
+        capsys.readouterr()
+
+    def test_subtree_scan_stays_quiet(self):
+        # linting just common/ pulls FAULT_SITES into scope without the
+        # package's call sites — registry completeness is a whole-package
+        # property and must not mass-fire here
+        res = graftlint.lint(os.path.join(static_lint.package_root(),
+                                          "common"))
+        assert res.findings == [], "\n".join(
+            f.render() for f in res.findings)
+
+    def test_parse_error_is_a_finding(self):
+        res = _scan("def broken(:\n", lambda d: graftlint.lint(d))
+        assert [f.rule for f in res.findings] == ["parse-error"]
+
+
+class TestFaultSiteRegistryLive:
+    """The real registry, not the fixture: FaultPlan validates sites and
+    the package's own drills stay in sync (the package-clean test above
+    already proves call-sites/docstring/tests agree)."""
+
+    def test_fault_plan_refuses_unregistered_site(self):
+        from deeplearning4j_tpu.common.faultinject import FaultPlan
+
+        with pytest.raises(ValueError, match="unknown fault site"):
+            FaultPlan([{"site": "bogus/site", "kind": "crash"}])
+
+    def test_registry_covers_every_docstring_site(self):
+        from deeplearning4j_tpu.common import faultinject
+
+        for site in faultinject.FAULT_SITES:
+            assert site in (faultinject.__doc__ or "")
+
+    def test_registry_entries_carry_kinds_and_drill(self):
+        from deeplearning4j_tpu.common.faultinject import FAULT_SITES
+
+        assert len(FAULT_SITES) >= 12
+        for site, meta in FAULT_SITES.items():
+            assert meta["kinds"], site
+            assert meta["drill"], site

@@ -237,3 +237,109 @@ class TestSystemInfoAndCrashReport:
         assert "memory status report" in text
         assert "ConvolutionLayer" in text and "activation[" in text
         assert "total parameters" in text
+
+
+class TestTraceCheck:
+    """The runtime trace sanitizer (common/tracecheck.py): a declared
+    steady-state region must stay quiet on replay and HARD-FAIL on
+    retraces and unbudgeted host syncs — the armed version of the
+    trace/* counter checks the benches used to do by hand."""
+
+    def _model(self):
+        from deeplearning4j_tpu.learning import Sgd
+        from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf import layers as L
+
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .updater(Sgd(learning_rate=0.1)).activation("tanh").list()
+                .layer(L.DenseLayer(n_out=8))
+                .layer(L.OutputLayer(n_out=2, loss="mcxent",
+                                     activation="softmax"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def _batch(self, n=16):
+        rng = np.random.RandomState(3)
+        x = rng.randn(n, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+        from deeplearning4j_tpu.data import DataSet
+        return DataSet(x, y)
+
+    def test_clean_steady_state_passes(self):
+        from deeplearning4j_tpu.common import tracecheck
+
+        model = self._model()
+        ds = self._batch()
+        model.fit(ds)                        # warmup traces/compiles
+        before = OpProfiler.get().counter_value("tracecheck/regions")
+        with tracecheck.steady_state("clean replay") as region:
+            for _ in range(3):
+                model.fit(ds)
+        assert region.counter_deltas == {}
+        assert OpProfiler.get().counter_value("tracecheck/regions") \
+            == before + 1
+
+    def test_injected_retrace_hard_fails(self):
+        from deeplearning4j_tpu.common import tracecheck
+
+        model = self._model()
+        model.fit(self._batch(16))           # warmup at batch 16
+        before = OpProfiler.get().counter_value("tracecheck/violations")
+        with pytest.raises(tracecheck.SteadyStateViolation) as ei:
+            with tracecheck.steady_state("injected retrace"):
+                model.fit(self._batch(24))   # new shape -> retrace
+        assert any(k.startswith("trace/")
+                   for k in ei.value.report["counter_deltas"])
+        assert OpProfiler.get().counter_value("tracecheck/violations") \
+            == before + 1
+
+    def test_host_sync_budget(self):
+        import jax
+
+        from deeplearning4j_tpu.common import tracecheck
+
+        model = self._model()
+        model.fit(self._batch())
+        with pytest.raises(tracecheck.SteadyStateViolation,
+                           match="host sync"):
+            with tracecheck.steady_state("no syncs"):
+                jax.device_get(model._params)
+        # the same sync inside a declared budget is fine
+        with tracecheck.steady_state("one sync", max_host_syncs=1) as r:
+            jax.device_get(model._params)
+        assert r.host_syncs == 1
+        # and None counts without policing
+        with tracecheck.steady_state("counted", max_host_syncs=None) as r:
+            jax.device_get(model._params)
+            jax.device_get(model._params)
+        assert r.host_syncs == 2
+
+    def test_device_get_restored_after_region(self):
+        import jax
+
+        from deeplearning4j_tpu.common import tracecheck
+
+        orig = jax.device_get
+        try:
+            with tracecheck.steady_state("x", max_host_syncs=None):
+                assert jax.device_get is not orig
+        finally:
+            pass
+        assert jax.device_get is orig
+
+    def test_regions_do_not_nest(self):
+        from deeplearning4j_tpu.common import tracecheck
+
+        with tracecheck.steady_state("outer", max_host_syncs=None):
+            with pytest.raises(RuntimeError, match="do not nest"):
+                with tracecheck.steady_state("inner"):
+                    pass
+
+    def test_stats_ledger(self):
+        from deeplearning4j_tpu.common import tracecheck
+
+        with tracecheck.steady_state("ledger", max_host_syncs=None):
+            pass
+        stats = OpProfiler.get().tracecheck_stats()
+        assert stats["regions"] >= 1

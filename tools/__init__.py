@@ -1,0 +1,2 @@
+# Makes tools/ importable so `python -m tools.graftlint` works from the
+# repo root (the scripts in here still run standalone).

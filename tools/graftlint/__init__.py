@@ -1,0 +1,61 @@
+"""graftlint: AST lints for the bug classes this repo actually shipped.
+
+Replaces the two ``tools/static_lint.py`` greps with a proper rule
+engine. Six rules, each motivated by a fixed-and-regressed (or nearly)
+bug:
+
+==================== ===================================================
+donation-alias       device_get zero-copy views kept without an owning
+                     copy (PR-3/PR-6 glibc heap corruption), found by
+                     dataflow — renames don't hide it
+pallas-guard         pallas_call without interpret= (per call site) or a
+                     backend gate (per module)
+host-sync-in-step    float()/int()/.item()/np.*/print/device_get inside
+                     jitted / shard_mapped / lax-loop-body functions,
+                     found by decorator + call-graph walk
+retrace-hazard       Python bool/int literals as traced jit args;
+                     dict/list literals through jit boundaries
+lock-discipline      mutation of thread-shared class attributes outside
+                     `with self._lock` (profiler ledgers, inference/
+                     serving pools, checkpoint writer, supervisor)
+fault-site-registry  fault_point sites vs the FAULT_SITES registry vs
+                     the docstring table vs test/bench drills — all four
+                     must agree
+==================== ===================================================
+
+Run: ``python -m tools.graftlint [paths...] [--json] [--rules a,b]``.
+Suppress: ``# graftlint: disable=<rule> -- <required justification>``.
+Exit is non-zero iff unsuppressed findings remain.
+
+The runtime half of the same discipline lives in
+``deeplearning4j_tpu/common/tracecheck.py`` (the steady-state trace
+sanitizer); this package is static-only and never imports jax.
+"""
+
+from . import engine
+from .engine import (Finding, LintResult, ModuleContext, Project, Rule,
+                     render_human, render_json, run)
+from .rules import RULE_NAMES, all_rules
+
+__all__ = ["Finding", "LintResult", "ModuleContext", "Project", "Rule",
+           "RULE_NAMES", "all_rules", "engine", "lint", "render_human",
+           "render_json", "run"]
+
+
+def lint(root: str, rule_names=None) -> LintResult:
+    """Run graftlint over ``root`` with all rules (or the named subset)."""
+    import os
+
+    if not os.path.exists(root):
+        # a typo'd path must not lint as "clean" — exit-code consumers
+        # (CI, the bench preflight) would silently pass without scanning
+        raise FileNotFoundError(f"graftlint: no such path: {root}")
+    rules = all_rules()
+    if rule_names is not None:
+        wanted = set(rule_names)
+        unknown = wanted - {r.name for r in rules}
+        if unknown:
+            raise ValueError(f"unknown rule(s): {sorted(unknown)} "
+                             f"(have: {RULE_NAMES})")
+        rules = [r for r in rules if r.name in wanted]
+    return run(root, rules)

@@ -1,0 +1,60 @@
+"""CLI: ``python -m tools.graftlint [paths...]``. Non-zero exit iff
+unsuppressed findings remain (the bench preflight and CI key off it)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import all_rules, lint, render_human, render_json
+
+
+def default_root() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "deeplearning4j_tpu")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftlint",
+        description="AST lints for this repo's shipped bug classes")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files or directories (default: the "
+                             "deeplearning4j_tpu package)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable output")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated subset of rules to run")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="also print suppressed findings with their "
+                             "justifications")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.name}: {rule.description}")
+        return 0
+
+    rule_names = [r.strip() for r in args.rules.split(",")] \
+        if args.rules else None
+    paths = args.paths or [default_root()]
+    exit_code = 0
+    for path in paths:
+        try:
+            result = lint(path, rule_names)
+        except FileNotFoundError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        if args.as_json:
+            print(render_json(result))
+        else:
+            print(render_human(result, show_suppressed=args.show_suppressed))
+        if not result.clean:
+            exit_code = 1
+    return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

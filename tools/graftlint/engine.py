@@ -1,0 +1,355 @@
+"""graftlint core: module loading, suppression accounting, rule driving.
+
+The engine is deliberately dependency-free (stdlib ``ast`` only — no jax
+import) so the whole package lints in well under a second and the lint
+tests cost tier-1 milliseconds.
+
+Model
+-----
+- A :class:`ModuleContext` is one parsed file: source text, AST with
+  parent links, and the per-line suppression table.
+- A :class:`Project` is the set of modules under the scanned root plus a
+  *reference corpus* (the sibling ``tests/`` tree and ``bench.py``, when
+  they exist next to the scanned root) for rules that cross-check
+  non-package files without linting them.
+- A :class:`Rule` sees each module (``check``) and gets one project-wide
+  pass at the end (``finalize``) for cross-file invariants.
+
+Suppressions
+------------
+``# graftlint: disable=<rule>[,<rule>] -- <reason>`` on the offending
+line, any line the offending statement spans, or the line directly above
+it. The justification after ``--`` is REQUIRED: a bare disable is itself
+a finding (``bad-suppression``) and suppresses nothing. ``disable=all``
+matches every rule. Suppressed findings are kept (and shown with
+``--show-suppressed`` / in JSON) so the ledger of accepted risks stays
+visible — and a justified suppression that matches nothing is flagged
+(``unused-suppression``) when every rule it names actually ran, so
+stale entries can't linger after the guarded code moves.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional
+
+SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\-\s]+?)"
+    r"(?:\s*--\s*(\S.*?))?\s*$")
+
+# engine-emitted pseudo-rules (never suppressible)
+BAD_SUPPRESSION = "bad-suppression"
+UNUSED_SUPPRESSION = "unused-suppression"
+PARSE_ERROR = "parse-error"
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    hint: str = ""
+    end_line: Optional[int] = None
+    suppressed: bool = False
+    reason: str = ""
+
+    def to_json(self) -> dict:
+        out = {"rule": self.rule, "path": self.path, "line": self.line,
+               "col": self.col, "message": self.message}
+        if self.hint:
+            out["hint"] = self.hint
+        if self.suppressed:
+            out["suppressed"] = True
+            out["reason"] = self.reason
+        return out
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}"
+        tail = f"  (hint: {self.hint})" if self.hint else ""
+        sup = f"  [suppressed: {self.reason}]" if self.suppressed else ""
+        return f"{loc}: [{self.rule}] {self.message}{tail}{sup}"
+
+
+class _Suppression:
+    __slots__ = ("rules", "reason", "line", "used")
+
+    def __init__(self, rules, reason, line):
+        self.rules = rules          # set of rule names, or {"all"}
+        self.reason = reason        # None → invalid (bad-suppression)
+        self.line = line
+        self.used = False
+
+    def matches(self, rule: str) -> bool:
+        return self.reason is not None and \
+            ("all" in self.rules or rule in self.rules)
+
+
+class ModuleContext:
+    """One parsed source file. ``tree`` is None when the file failed to
+    parse (the engine emits a parse-error finding instead of crashing the
+    whole run on one bad file)."""
+
+    def __init__(self, path: str, text: str):
+        self.path = path
+        self.text = text
+        self.lines = text.splitlines()
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree: Optional[ast.Module] = ast.parse(text)
+        except SyntaxError as e:
+            self.tree = None
+            self.parse_error = e
+        if self.tree is not None:
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    child.graftlint_parent = node  # type: ignore[attr-defined]
+        self.suppressions: Dict[int, _Suppression] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = SUPPRESS_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                self.suppressions[i] = _Suppression(rules, m.group(2), i)
+
+    # -- helpers rules lean on -------------------------------------------
+    def parents(self, node: ast.AST) -> Iterable[ast.AST]:
+        while True:
+            node = getattr(node, "graftlint_parent", None)
+            if node is None:
+                return
+            yield node
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        for p in self.parents(node):
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return p
+        return None
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        for p in self.parents(node):
+            if isinstance(p, ast.ClassDef):
+                return p
+        return None
+
+    def segment(self, node: ast.AST) -> str:
+        return ast.get_source_segment(self.text, node) or ""
+
+
+class Project:
+    """Everything a cross-file rule can see: the scanned modules plus the
+    read-only reference corpus (tests + bench next to the scanned root)."""
+
+    def __init__(self, root: str, modules: List[ModuleContext],
+                 reference_texts: Dict[str, str]):
+        self.root = root
+        self.modules = modules
+        self.reference_texts = reference_texts
+
+    def module_named(self, basename: str) -> Optional[ModuleContext]:
+        for mod in self.modules:
+            if os.path.basename(mod.path) == basename:
+                return mod
+        return None
+
+
+class Rule:
+    """Base class. ``name`` is the suppression/CLI identifier; ``hint``
+    is the default fix hint attached to findings."""
+
+    name = ""
+    description = ""
+    hint = ""
+
+    def check(self, mod: ModuleContext, project: Project) -> List[Finding]:
+        return []
+
+    def finalize(self, project: Project) -> List[Finding]:
+        return []
+
+    def finding(self, mod: ModuleContext, node: ast.AST, message: str,
+                hint: Optional[str] = None) -> Finding:
+        return Finding(rule=self.name, path=mod.path,
+                       line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0),
+                       end_line=getattr(node, "end_lineno", None),
+                       message=message,
+                       hint=self.hint if hint is None else hint)
+
+
+# -- AST spelling helpers shared by the rules ----------------------------
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.tree.map' for the func of a call, '' when not a name chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_name(call: ast.Call) -> str:
+    return dotted_name(call.func)
+
+
+def is_device_get(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and \
+        call_name(node).split(".")[-1] == "device_get"
+
+
+def names_in(node: ast.AST) -> List[str]:
+    return [n.id for n in ast.walk(node) if isinstance(n, ast.Name)]
+
+
+# -- file walking --------------------------------------------------------
+
+def iter_py_files(root: str) -> List[str]:
+    if os.path.isfile(root):
+        return [root]
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        out.extend(os.path.join(dirpath, f) for f in filenames
+                   if f.endswith(".py"))
+    return sorted(out)
+
+
+def _collect_references(root: str,
+                        module_paths: List[str]) -> Dict[str, str]:
+    """tests/ + bench.py living NEXT TO the scanned root (the repo
+    layout), plus any scanned file that is itself a test or bench (the
+    fixture layout)."""
+    refs: Dict[str, str] = {}
+    parent = os.path.dirname(os.path.abspath(root)) \
+        if not os.path.isfile(root) else os.path.dirname(
+            os.path.dirname(os.path.abspath(root)))
+    for cand in (os.path.join(parent, "bench.py"),):
+        if os.path.isfile(cand):
+            with open(cand, encoding="utf-8") as f:
+                refs[cand] = f.read()
+    tests_dir = os.path.join(parent, "tests")
+    if os.path.isdir(tests_dir):
+        for path in iter_py_files(tests_dir):
+            with open(path, encoding="utf-8") as f:
+                refs[path] = f.read()
+    for path in module_paths:
+        base = os.path.basename(path)
+        if base.startswith("test_") or base.startswith("bench"):
+            with open(path, encoding="utf-8") as f:
+                refs[path] = f.read()
+    return refs
+
+
+class LintResult:
+    def __init__(self, findings: List[Finding], root: str,
+                 rule_names: List[str]):
+        self.root = root
+        self.rule_names = rule_names
+        self.findings = [f for f in findings if not f.suppressed]
+        self.suppressed = [f for f in findings if f.suppressed]
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+    def to_json(self) -> dict:
+        return {"root": self.root, "rules": self.rule_names,
+                "findings": [f.to_json() for f in self.findings],
+                "suppressed": [f.to_json() for f in self.suppressed]}
+
+
+def run(root: str, rules: List[Rule]) -> LintResult:
+    paths = iter_py_files(root)
+    modules = [ModuleContext(p, open(p, encoding="utf-8").read())
+               for p in paths]
+    project = Project(root, modules,
+                      _collect_references(root, paths))
+
+    findings: List[Finding] = []
+    for mod in modules:
+        if mod.parse_error is not None:
+            findings.append(Finding(
+                rule=PARSE_ERROR, path=mod.path,
+                line=mod.parse_error.lineno or 1, col=0,
+                message=f"file does not parse: {mod.parse_error.msg}"))
+            continue
+        for rule in rules:
+            findings.extend(rule.check(mod, project))
+    for rule in rules:
+        findings.extend(rule.finalize(project))
+
+    # apply suppressions: offending line, any line the node spans, or the
+    # contiguous comment block directly above the finding (justifications
+    # routinely wrap over several comment lines)
+    for f in findings:
+        mod = next((m for m in modules if m.path == f.path), None)
+        if mod is None or f.rule in (BAD_SUPPRESSION, UNUSED_SUPPRESSION,
+                                     PARSE_ERROR):
+            continue
+        last = f.end_line or f.line
+        candidates = list(range(f.line, last + 1))
+        line = f.line - 1
+        while line >= 1 and f.line - line <= 12 and \
+                line <= len(mod.lines) and \
+                mod.lines[line - 1].lstrip().startswith("#"):
+            candidates.append(line)
+            line -= 1
+        for line in candidates:
+            sup = mod.suppressions.get(line)
+            if sup is not None and sup.matches(f.rule):
+                f.suppressed = True
+                f.reason = sup.reason or ""
+                sup.used = True
+                break
+
+    # a disable with no justification suppresses nothing and is itself a
+    # finding — the whole point is that accepted risks carry a WHY; and
+    # a justified suppression that matched nothing is a stale ledger
+    # entry (the guarded code moved or the risk is gone) — flag it so
+    # the accepted-risk list cannot silently rot
+    active = {r.name for r in rules}
+    for mod in modules:
+        for sup in mod.suppressions.values():
+            if sup.reason is None:
+                findings.append(Finding(
+                    rule=BAD_SUPPRESSION, path=mod.path, line=sup.line,
+                    col=0,
+                    message="suppression without a justification "
+                            "(write: # graftlint: disable=<rule> -- "
+                            "<why this is safe>)"))
+            elif not sup.used and "all" not in sup.rules \
+                    and sup.rules <= active:
+                # judged only when every named rule actually ran — a
+                # subset run (--rules x) cannot tell whether another
+                # rule's suppression is stale; "all" is never judgeable
+                findings.append(Finding(
+                    rule=UNUSED_SUPPRESSION, path=mod.path, line=sup.line,
+                    col=0,
+                    message="suppression for "
+                            f"{'/'.join(sorted(sup.rules))} matched no "
+                            "finding — delete the stale entry or fix "
+                            "the rule name"))
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return LintResult(findings, root, [r.name for r in rules])
+
+
+def render_human(result: LintResult, show_suppressed: bool = False) -> str:
+    lines = [f.render() for f in result.findings]
+    if show_suppressed:
+        lines += [f.render() for f in result.suppressed]
+    lines.append(f"{len(result.findings)} finding(s), "
+                 f"{len(result.suppressed)} suppressed "
+                 f"[{len(result.rule_names)} rules]")
+    return "\n".join(lines)
+
+
+def render_json(result: LintResult) -> str:
+    return json.dumps(result.to_json(), indent=2)

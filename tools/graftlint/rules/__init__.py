@@ -1,0 +1,18 @@
+"""The graftlint rule set — one module per shipped bug class."""
+
+from .donation_alias import DonationAliasRule
+from .fault_registry import FaultSiteRegistryRule
+from .host_sync import HostSyncRule
+from .lock_discipline import LockDisciplineRule
+from .pallas_guard import PallasGuardRule
+from .retrace_hazard import RetraceHazardRule
+
+
+def all_rules():
+    """Fresh instances — rules may keep per-run state in finalize()."""
+    return [DonationAliasRule(), PallasGuardRule(), HostSyncRule(),
+            RetraceHazardRule(), LockDisciplineRule(),
+            FaultSiteRegistryRule()]
+
+
+RULE_NAMES = [r.name for r in all_rules()]

@@ -1,0 +1,195 @@
+"""donation-alias: device_get views must be copied before they are kept.
+
+The PR-3 / PR-6 heap-corruption class: on the CPU backend
+``jax.device_get`` returns ZERO-COPY views of device buffers, and
+``np.asarray`` of such a view is still the same memory. Hand the view
+into (or stash it across) a ``donate_argnums`` step and the next
+dispatch frees the bytes under the reader — observed as glibc heap
+corruption, twice. The grep lint caught the two literal spellings; this
+rule follows the dataflow, so a view laundered through a rename
+
+    host = jax.device_get(params)
+    ...
+    arr = np.asarray(host[0])          # still the same device bytes
+
+is a finding too. Flagged shapes (per function scope, statement order):
+
+- ``np.asarray(<device_get or tainted name>)``
+- ``<tree>.map(np.asarray, <device_get or tainted name>)``
+- ``self.<attr> = <device_get call>`` / ``x[k] = <device_get call>`` —
+  the result escapes the statement with no owning copy at all
+
+Taint propagates through plain renames, tuple unpacking and ``for``
+targets whose iterable is tainted; it clears when the name is rebound to
+anything else (``np.array(...)`` of a view is an owning copy).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from ..engine import (Finding, ModuleContext, Project, Rule, call_name,
+                      is_device_get)
+
+_NP_BASES = {"np", "numpy", "onp"}
+
+
+def _is_np_asarray(node: ast.AST) -> bool:
+    return isinstance(node, ast.Attribute) and node.attr == "asarray" and \
+        isinstance(node.value, ast.Name) and node.value.id in _NP_BASES
+
+
+def _is_tree_map(call: ast.Call) -> bool:
+    name = call_name(call)
+    return (name.endswith(".map") and "tree" in name) or \
+        name.split(".")[-1] in ("tree_map", "tree_multimap")
+
+
+class DonationAliasRule(Rule):
+    name = "donation-alias"
+    description = ("dataflow from jax.device_get into np.asarray or a "
+                   "bare escaping assignment — a zero-copy view kept "
+                   "without an owning copy")
+    hint = ("copy before you keep: np.array(...) / jax.tree.map(np.array, "
+            "...) — device_get views alias donatable buffers "
+            "(PR-3/PR-6 heap corruption)")
+
+    def check(self, mod: ModuleContext, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        scopes = [mod.tree] + [
+            n for n in ast.walk(mod.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for scope in scopes:
+            findings.extend(self._check_scope(mod, scope))
+        return findings
+
+    # -- one lexical scope, statements in source order -------------------
+    def _check_scope(self, mod: ModuleContext,
+                     scope: ast.AST) -> List[Finding]:
+        findings: List[Finding] = []
+        tainted: Dict[str, int] = {}    # name -> line it was tainted at
+
+        def is_tainted(expr: ast.AST) -> bool:
+            if is_device_get(expr):
+                return True
+            if isinstance(expr, ast.Name):
+                return expr.id in tainted
+            if isinstance(expr, (ast.Subscript, ast.Attribute, ast.Starred)):
+                return is_tainted(expr.value)
+            if isinstance(expr, ast.Call):
+                # <tainted>.items() / enumerate(<tainted>) / zip(...)
+                fn = expr.func
+                if isinstance(fn, ast.Attribute) and is_tainted(fn.value):
+                    return True
+                if call_name(expr) in ("enumerate", "zip", "iter",
+                                      "reversed", "list", "tuple"):
+                    return any(is_tainted(a) for a in expr.args)
+            return False
+
+        def _bound_names(target: ast.AST):
+            """Names BOUND by an assignment target. Attribute/subscript
+            targets bind nothing — `self.x = ...` must neither taint nor
+            clear `self` (the base object is not the assigned value)."""
+            if isinstance(target, ast.Name):
+                yield target.id
+            elif isinstance(target, ast.Starred):
+                yield from _bound_names(target.value)
+            elif isinstance(target, (ast.Tuple, ast.List)):
+                for elt in target.elts:
+                    yield from _bound_names(elt)
+
+        def taint_target(target: ast.AST, line: int) -> None:
+            for name in _bound_names(target):
+                tainted[name] = line
+
+        def clear_target(target: ast.AST) -> None:
+            for name in _bound_names(target):
+                tainted.pop(name, None)
+
+        def scan_expr(expr: ast.AST) -> None:
+            """Flag alias-producing calls anywhere inside ``expr``
+            (expressions have no nested statement scopes to double-count;
+            lambdas close over the same taint environment)."""
+            if expr is None:
+                return
+            for node in ast.walk(expr):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _is_np_asarray(node.func) and node.args and \
+                        is_tainted(node.args[0]):
+                    findings.append(self.finding(
+                        mod, node,
+                        "np.asarray over a jax.device_get result keeps a "
+                        "zero-copy view of a device buffer"))
+                elif _is_tree_map(node) and len(node.args) >= 2 and \
+                        _is_np_asarray(node.args[0]) and \
+                        any(is_tainted(a) for a in node.args[1:]):
+                    findings.append(self.finding(
+                        mod, node,
+                        "tree.map(np.asarray, ...) over a jax.device_get "
+                        "result keeps zero-copy views of device buffers"))
+
+        def visit_stmt(stmt: ast.AST) -> None:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                return  # nested scopes are scanned separately
+            if isinstance(stmt, ast.Assign):
+                scan_expr(stmt.value)
+                self._check_assign(mod, stmt, stmt.targets, stmt.value,
+                                   is_tainted, taint_target, clear_target,
+                                   findings)
+            elif isinstance(stmt, ast.AnnAssign):
+                scan_expr(stmt.value)
+                if stmt.value is not None:
+                    self._check_assign(mod, stmt, [stmt.target], stmt.value,
+                                       is_tainted, taint_target,
+                                       clear_target, findings)
+            elif isinstance(stmt, ast.AugAssign):
+                scan_expr(stmt.value)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                scan_expr(stmt.iter)
+                if is_tainted(stmt.iter):
+                    taint_target(stmt.target, stmt.lineno)
+                else:
+                    clear_target(stmt.target)
+                for s in stmt.body + stmt.orelse:
+                    visit_stmt(s)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                scan_expr(stmt.test)
+                for s in stmt.body + stmt.orelse:
+                    visit_stmt(s)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    scan_expr(item.context_expr)
+                for s in stmt.body:
+                    visit_stmt(s)
+            elif isinstance(stmt, ast.Try):
+                for s in (stmt.body + stmt.orelse + stmt.finalbody
+                          + [h2 for h in stmt.handlers for h2 in h.body]):
+                    visit_stmt(s)
+            else:
+                # Expr, Return, Raise, Assert, Delete, ... — flat scan
+                scan_expr(stmt)
+
+        body = scope.body if hasattr(scope, "body") else []
+        for stmt in body:
+            visit_stmt(stmt)
+        return findings
+
+    def _check_assign(self, mod, stmt, targets, value, is_tainted,
+                      taint_target, clear_target, findings) -> None:
+        escaping = [t for t in targets
+                    if isinstance(t, (ast.Attribute, ast.Subscript))]
+        if escaping and is_device_get(value):
+            findings.append(self.finding(
+                mod, stmt,
+                "jax.device_get result stored on "
+                f"`{mod.segment(escaping[0])}` with no owning copy — "
+                "the view outlives the statement"))
+        if is_tainted(value):
+            for t in targets:
+                taint_target(t, stmt.lineno)
+        else:
+            for t in targets:
+                clear_target(t)

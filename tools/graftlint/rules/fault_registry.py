@@ -1,0 +1,135 @@
+"""fault-site-registry: no silent drift between drills, code and docs.
+
+``common/faultinject.py`` owns a central ``FAULT_SITES`` registry (site
+name -> accepted kinds + which drill uses it). This rule closes the loop
+project-wide:
+
+- every ``fault_point("site", ...)`` call site must name a registered
+  site, with a LITERAL string (a computed site can't be audited);
+- every registered site must have at least one ``fault_point`` call site
+  in the scanned tree (a registry entry with no instrumentation is a
+  drill that silently stopped existing);
+- every registered site must be referenced by at least one test or bench
+  file (the sibling ``tests/`` + ``bench.py`` corpus) — a site no drill
+  exercises is dead documentation;
+- every registered site must appear in the faultinject module docstring
+  (the human-readable table is generated-checked, not trusted).
+
+When the scanned tree has no ``FAULT_SITES`` at all the rule only
+reports call sites as unregistered if a faultinject module IS present —
+so linting a subpackage stays quiet, while linting the real package (or
+a fixture with a mini registry) checks everything.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..engine import Finding, ModuleContext, Project, Rule, call_name
+
+
+def _parse_registry(mod: ModuleContext) -> Optional[Dict[str, ast.AST]]:
+    """FAULT_SITES = {"site": {...}} at module level -> {site: key node}."""
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and \
+                any(isinstance(t, ast.Name) and t.id == "FAULT_SITES"
+                    for t in node.targets) and \
+                isinstance(node.value, ast.Dict):
+            out: Dict[str, ast.AST] = {}
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    out[k.value] = k
+            return out
+    return None
+
+
+class FaultSiteRegistryRule(Rule):
+    name = "fault-site-registry"
+    description = ("every fault_point site string registered in "
+                   "common/faultinject.py FAULT_SITES, every registered "
+                   "site instrumented, drilled (tests/bench) and "
+                   "documented in the module docstring")
+    hint = ("add the site to FAULT_SITES (name, kinds, drill) and to the "
+            "faultinject docstring table; dead entries come out instead")
+
+    def finalize(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        reg_mod = project.module_named("faultinject.py")
+        registry: Optional[Dict[str, ast.AST]] = None
+        if reg_mod is not None and reg_mod.tree is not None:
+            registry = _parse_registry(reg_mod)
+
+        # collect every fault_point call site in the scanned tree
+        calls: List[Tuple[ModuleContext, ast.Call, Optional[str]]] = []
+        for mod in project.modules:
+            if mod.tree is None:
+                continue
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) and \
+                        call_name(node).split(".")[-1] == "fault_point":
+                    site: Optional[str] = None
+                    if node.args and isinstance(node.args[0], ast.Constant) \
+                            and isinstance(node.args[0].value, str):
+                        site = node.args[0].value
+                    calls.append((mod, node, site))
+
+        if reg_mod is None:
+            return findings      # nothing to check against in this tree
+
+        if registry is None:
+            if calls:
+                findings.append(Finding(
+                    rule=self.name, path=reg_mod.path, line=1, col=0,
+                    message="faultinject module has no FAULT_SITES "
+                            "registry but fault_point sites exist",
+                    hint=self.hint))
+            return findings
+
+        seen: Dict[str, int] = {}
+        for mod, node, site in calls:
+            if mod is reg_mod:
+                continue        # the hook's own definition/docs
+            if site is None:
+                findings.append(self.finding(
+                    mod, node,
+                    "fault_point called with a non-literal site — the "
+                    "registry cannot audit it",
+                    hint="pass the site as a string literal"))
+                continue
+            seen[site] = seen.get(site, 0) + 1
+            if site not in registry:
+                findings.append(self.finding(
+                    mod, node,
+                    f"fault_point site '{site}' is not registered in "
+                    "common.faultinject.FAULT_SITES"))
+
+        # registry COMPLETENESS (every site called / documented / drilled)
+        # is a whole-package property: a subtree scan that happens to
+        # include faultinject.py but not the callers (e.g. linting
+        # common/ alone) must not report every site as dead. Per-call
+        # checks above still ran; completeness needs callers in scope.
+        if not seen:
+            return findings
+
+        docstring = ast.get_docstring(reg_mod.tree) or ""
+        refs = project.reference_texts
+        for site, key_node in registry.items():
+            f_at = lambda msg: Finding(   # noqa: E731
+                rule=self.name, path=reg_mod.path,
+                line=getattr(key_node, "lineno", 1),
+                col=getattr(key_node, "col_offset", 0),
+                message=msg, hint=self.hint)
+            if site not in seen:
+                findings.append(f_at(
+                    f"registered fault site '{site}' has no fault_point "
+                    "call site in the scanned tree"))
+            if site not in docstring:
+                findings.append(f_at(
+                    f"registered fault site '{site}' is missing from the "
+                    "faultinject module docstring table"))
+            if refs and not any(site in text for text in refs.values()):
+                findings.append(f_at(
+                    f"registered fault site '{site}' has no test or "
+                    "bench reference — no drill exercises it"))
+        return findings

@@ -1,0 +1,188 @@
+"""host-sync-in-step: no host blocking inside compiled step functions.
+
+PR 1/2's whole point: the training hot loop stays on device — a
+``float()`` / ``.item()`` / ``np.*`` / ``print`` / ``jax.device_get``
+inside a jitted step either forces a device→host sync per call (killing
+dispatch overlap) or silently burns a traced value into a trace-time
+constant. This rule finds the step functions the way the repo builds
+them — a decorator / call-graph walk:
+
+- roots: functions decorated with ``jit`` / ``shard_map`` / ``pmap``
+  (bare or via ``partial``), functions passed by name to
+  ``jax.jit(...)`` / ``shard_map(...)`` / ``pmap(...)``, and functions
+  used as ``lax.scan`` / ``lax.while_loop`` / ``lax.fori_loop`` bodies;
+- edges: calls to a name that matches a ``def`` anywhere in the module
+  (the ``step -> core`` closure idiom in nn/multilayer.py, nn/graph.py,
+  parallel/wrapper.py) and ``self.<method>`` calls resolved within the
+  enclosing class.
+
+Inside the marked set the rule flags host-sync constructs. ``float``/
+``int`` over shape/len/constant expressions are exempt (static at trace
+time); everything else is a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..engine import (Finding, ModuleContext, Project, Rule, call_name,
+                      dotted_name)
+
+_TRACER_ENTRY = ("jit", "shard_map", "pmap", "pjit")
+_BODY_CONSUMERS = ("scan", "while_loop", "fori_loop", "cond", "switch",
+                   "custom_vjp", "checkpoint", "remat")
+_NP_BASES = {"np", "numpy", "onp"}
+
+
+def _func_name_of(call: ast.Call) -> str:
+    return call_name(call).split(".")[-1]
+
+
+def _static_conversion(arg: ast.AST) -> bool:
+    """float()/int() of shapes, lens and constants folds at trace time."""
+    if isinstance(arg, ast.Constant):
+        return True
+    for node in ast.walk(arg):
+        if isinstance(node, ast.Attribute) and node.attr in ("shape",
+                                                             "ndim",
+                                                             "size"):
+            return True
+        if isinstance(node, ast.Call) and call_name(node) == "len":
+            return True
+    return False
+
+
+class HostSyncRule(Rule):
+    name = "host-sync-in-step"
+    description = ("float()/int()/.item()/np.*/print/device_get inside "
+                   "functions that are jitted, shard_mapped, or used as "
+                   "lax loop bodies (call-graph walk)")
+    hint = ("keep host conversions outside the compiled step (drain via "
+            "one batched device_get per window) or use device-side jnp "
+            "ops; trace-time-only constructs need a suppression saying so")
+
+    def check(self, mod: ModuleContext, project: Project) -> List[Finding]:
+        defs: Dict[str, List[ast.AST]] = {}
+        methods: Dict[Tuple[str, str], ast.AST] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+                cls = mod.enclosing_class(node)
+                if cls is not None:
+                    methods[(cls.name, node.name)] = node
+
+        roots: Dict[ast.AST, str] = {}   # def node -> why it's marked
+
+        # decorated defs
+        for fns in defs.values():
+            for fn in fns:
+                for dec in fn.decorator_list:
+                    names = dotted_name(dec) if not isinstance(dec, ast.Call) \
+                        else call_name(dec)
+                    parts = set(names.split("."))
+                    if isinstance(dec, ast.Call):
+                        # partial(jax.jit, ...) / jax.jit(static_argnums=..)
+                        for a in list(dec.args):
+                            parts |= set(dotted_name(a).split("."))
+                    if parts & set(_TRACER_ENTRY):
+                        roots[fn] = f"decorated `{fn.name}`"
+
+        # functions passed by name to jit/shard_map/scan/...
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _func_name_of(node)
+            if fname in _TRACER_ENTRY or fname in _BODY_CONSUMERS:
+                for arg in node.args[:2]:
+                    if isinstance(arg, ast.Name) and arg.id in defs:
+                        for fn in defs[arg.id]:
+                            roots.setdefault(
+                                fn, f"`{fn.name}` passed to {fname}")
+                    elif isinstance(arg, ast.Call) and \
+                            _func_name_of(arg) == "partial":
+                        for pa in arg.args:
+                            if isinstance(pa, ast.Name) and pa.id in defs:
+                                for fn in defs[pa.id]:
+                                    roots.setdefault(
+                                        fn,
+                                        f"`{fn.name}` passed to {fname}")
+
+        if not roots:
+            return []
+
+        # transitive closure over same-module calls (name + self.method)
+        marked: Dict[ast.AST, str] = dict(roots)
+        work = list(roots)
+        while work:
+            fn = work.pop()
+            why = marked[fn]
+            cls = mod.enclosing_class(fn)
+            for node in self._own_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee: Optional[List[ast.AST]] = None
+                if isinstance(node.func, ast.Name) and node.func.id in defs:
+                    callee = defs[node.func.id]
+                elif isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == "self" and cls is not None:
+                    m = methods.get((cls.name, node.func.attr))
+                    callee = [m] if m is not None else None
+                for c in callee or []:
+                    if c not in marked:
+                        marked[c] = why
+                        work.append(c)
+
+        findings: List[Finding] = []
+        for fn, why in marked.items():
+            findings.extend(self._scan_body(mod, fn, why))
+        return findings
+
+    def _own_nodes(self, fn: ast.AST) -> List[ast.AST]:
+        """The function's nodes EXCLUDING nested def bodies (nested defs
+        are marked separately when actually called)."""
+        out: List[ast.AST] = []
+        stack = list(fn.body)
+        while stack:
+            node = stack.pop()
+            out.append(node)
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue
+                stack.append(child)
+        return out
+
+    def _scan_body(self, mod: ModuleContext, fn: ast.AST,
+                   why: str) -> List[Finding]:
+        findings: List[Finding] = []
+        where = f"in compiled step `{fn.name}` ({why})"
+        for node in self._own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            last = name.split(".")[-1]
+            if name in ("float", "int") and node.args and \
+                    not _static_conversion(node.args[0]):
+                findings.append(self.finding(
+                    mod, node,
+                    f"host conversion {name}() on a traced value {where}"))
+            elif last == "item" and isinstance(node.func, ast.Attribute):
+                findings.append(self.finding(
+                    mod, node, f".item() host sync {where}"))
+            elif name == "print":
+                findings.append(self.finding(
+                    mod, node,
+                    f"print() {where} — runs at trace time only (or "
+                    "syncs if fed a traced value); use jax.debug.print"))
+            elif last == "device_get":
+                findings.append(self.finding(
+                    mod, node, f"jax.device_get {where} — device->host "
+                    "round-trip inside the compiled region"))
+            elif name.split(".")[0] in _NP_BASES:
+                findings.append(self.finding(
+                    mod, node,
+                    f"numpy call `{name}` {where} — executes on host at "
+                    "trace time and freezes its result into the trace"))
+        return findings

@@ -1,0 +1,136 @@
+"""lock-discipline: shared-state mutation outside the owning lock.
+
+The supervisor/serving/checkpoint-writer tier (PRs 3-7) is genuinely
+multi-threaded: the training thread, the checkpoint writer, inference
+workers, the watchdog, and HTTP handlers all touch the same objects. The
+repo's convention is one owning lock per shared object (``self._lock`` /
+``self._cond``), held for every mutation. This rule enforces it over a
+declared REGISTRY of thread-shared classes: inside their bodies, any
+``self.<attr> = ...`` / ``self.<attr> += ...`` outside a
+``with self.<lock>`` block (and outside ``__init__``, which runs before
+publication) is a finding.
+
+Single-writer attributes that are deliberately unlocked (a monotonic
+heartbeat the watchdog reads racily, by design) are exactly what the
+justified-suppression syntax is for — the reason string documents the
+ownership argument right at the mutation site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from ..engine import Finding, ModuleContext, Project, Rule
+
+# class name -> {"locks": owning lock attrs, "allow": attrs exempt by
+# design (document WHY here when adding one)}. Fixtures and future
+# shared classes participate by name.
+SHARED_CLASSES: Dict[str, Dict[str, Set[str]]] = {
+    # profiler ledgers: bumped from the training thread, the checkpoint
+    # writer, inference workers and the telemetry drain alike
+    "OpProfiler": {"locks": {"_lock"}, "allow": set()},
+    # inference/serving pools: worker threads + callers + health probes.
+    # ServingEngine splits its locking: _exec_lock guards the AOT
+    # executable cache, _lat_lock the latency ring — both are owning
+    # locks in their domains
+    "ParallelInference": {"locks": {"_lock"}, "allow": set()},
+    "ServingEngine": {"locks": {"_lock", "_exec_lock", "_lat_lock"},
+                      "allow": set()},
+    # checkpoint writer: training thread submits, daemon thread commits
+    "CheckpointWriter": {"locks": {"_cond", "_lock"}, "allow": set()},
+    "CheckpointListener": {"locks": {"_lock"}, "allow": set()},
+    # supervisor heartbeats: training thread beats, watchdog reads.
+    # The allowed attributes are the supervisor's DESIGNED lock-free
+    # single-slot signals: written as one reference assignment (atomic
+    # under the GIL), consumed at step/dispatch boundaries, and one of
+    # them (_preempt_signal) is written from a signal handler where
+    # taking a lock can deadlock the interrupted thread. New supervisor
+    # state does NOT get a free pass — extend this set only with the
+    # same ownership argument.
+    "TrainingSupervisor": {"locks": {"_lock"},
+                           "allow": {"_preempt_signal", "_resize_request",
+                                     "_grow", "_probe_ordinal",
+                                     "_old_handlers", "incarnation"}},
+    "_Heartbeat": {"locks": {"_lock"}, "allow": set()},
+    "_Attempt": {"locks": {"_lock"}, "allow": set()},
+}
+
+
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    description = ("attribute mutation on a registered thread-shared "
+                   "class outside a `with self.<lock>` block")
+    hint = ("hold the owning lock for every mutation of shared state; a "
+            "deliberate single-writer attribute needs a suppression "
+            "naming the owning thread")
+
+    def check(self, mod: ModuleContext, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            reg = SHARED_CLASSES.get(cls.name)
+            if reg is None:
+                continue
+            findings.extend(self._check_class(mod, cls, reg))
+        return findings
+
+    def _check_class(self, mod: ModuleContext, cls: ast.ClassDef,
+                     reg: Dict[str, Set[str]]) -> List[Finding]:
+        findings: List[Finding] = []
+        locks = reg["locks"]
+        allow = reg["allow"]
+        for node in ast.walk(cls):
+            targets: List[ast.Attribute] = []
+            if isinstance(node, ast.Assign):
+                targets = [t for t in node.targets
+                           if self._is_self_attr(t)]
+            elif isinstance(node, ast.AugAssign) and \
+                    self._is_self_attr(node.target):
+                targets = [node.target]
+            if not targets:
+                continue
+            fn = mod.enclosing_function(node)
+            if fn is None or fn.name == "__init__":
+                continue   # class body / construction happens-before
+            # the mutation may live in a nested class with its own rules
+            if mod.enclosing_class(node) is not cls:
+                continue
+            for t in targets:
+                if t.attr in allow or t.attr in locks:
+                    continue
+                if self._under_lock(mod, node, locks):
+                    continue
+                findings.append(self.finding(
+                    mod, node,
+                    f"`self.{t.attr}` of thread-shared class "
+                    f"`{cls.name}` mutated in `{fn.name}` outside "
+                    f"`with self.{'/'.join(sorted(locks))}`"))
+        return findings
+
+    @staticmethod
+    def _is_self_attr(node: ast.AST) -> bool:
+        return isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self"
+
+    def _under_lock(self, mod: ModuleContext, node: ast.AST,
+                    locks: Set[str]) -> bool:
+        for p in mod.parents(node):
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False   # a nested def runs later, outside the with
+            if not isinstance(p, (ast.With, ast.AsyncWith)):
+                continue
+            for item in p.items:
+                ctx = item.context_expr
+                # `with self._lock:` / `with cls._lock:` /
+                # `with self._cond:` — also accept .acquire-style
+                # wrappers spelled as calls on the lock attr
+                if isinstance(ctx, ast.Call):
+                    ctx = ctx.func
+                if isinstance(ctx, ast.Attribute) and \
+                        isinstance(ctx.value, ast.Name) and \
+                        ctx.value.id in ("self", "cls") and \
+                        ctx.attr in locks:
+                    return True
+        return False

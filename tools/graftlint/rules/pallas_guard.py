@@ -1,0 +1,51 @@
+"""pallas-guard: every pallas_call site needs its escape hatches.
+
+The ``ops/pallas_attention.py`` recipe, made a per-call-site rule: a
+``pl.pallas_call`` must (a) carry an ``interpret=`` keyword AT THE CALL
+so the kernel runs on the CPU test mesh through the interpreter, and
+(b) live in a module that gates on the backend (``default_backend`` /
+``default_mode``) so a TPU-shaped kernel never becomes the hot path on
+a backend it was not built for. The old grep checked (a) per FILE — one
+guarded call could shadow an unguarded one added later; this checks the
+keyword on each call node.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..engine import Finding, ModuleContext, Project, Rule, call_name
+
+_GATES = ("default_backend", "default_mode")
+
+
+class PallasGuardRule(Rule):
+    name = "pallas-guard"
+    description = ("pallas_call sites missing the interpret= escape hatch "
+                   "(per call) or a backend gate (per module)")
+    hint = ("thread interpret= from a jax.default_backend() != 'tpu' gate "
+            "(see ops/pallas_attention.py)")
+
+    def check(self, mod: ModuleContext, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        sites = [n for n in ast.walk(mod.tree)
+                 if isinstance(n, ast.Call)
+                 and call_name(n).split(".")[-1] == "pallas_call"]
+        if not sites:
+            return findings
+        has_gate = any(g in mod.text for g in _GATES)
+        for call in sites:
+            kw_names = {kw.arg for kw in call.keywords}
+            if "interpret" not in kw_names:
+                findings.append(self.finding(
+                    mod, call,
+                    "pallas_call without interpret= at the call site — "
+                    "the kernel cannot run on the CPU test mesh"))
+            if not has_gate:
+                findings.append(self.finding(
+                    mod, call,
+                    "pallas_call in a module with no backend gate "
+                    f"({'/'.join(_GATES)}) — the kernel path is "
+                    "unconditional on every backend"))
+        return findings

@@ -1,0 +1,164 @@
+"""retrace-hazard: hash-unstable Python values crossing jit boundaries.
+
+The PR-1/PR-2 failure class in its call-site form: a Python ``bool`` /
+``int`` literal handed to a jitted function is a TRACED argument — every
+distinct value is a fresh trace and an XLA compile (the repo's own
+steady-state contract is one compile per fit config). A ``dict`` /
+``list`` literal crossing the boundary is a fresh container each call
+whose leaves are Python scalars — same hazard, plus weak-ref cache
+misses. Either the value is genuinely dynamic (then it should be a
+device array) or it is configuration (then it belongs in
+``static_argnums`` / ``static_argnames`` or a closure).
+
+The rule resolves jitted callables module-locally: names bound via
+``f = jax.jit(g, ...)``, ``self._step = jax.jit(...)`` attributes
+(checked within the binding class), and defs decorated with ``jit``.
+``static_argnums`` / ``static_argnames`` on the binding are honored —
+a literal in a static slot is exactly right and stays quiet.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..engine import (Finding, ModuleContext, Project, Rule, call_name,
+                      dotted_name)
+
+
+def _static_slots(call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            if isinstance(kw.value, ast.Constant) and \
+                    isinstance(kw.value.value, int):
+                nums.add(kw.value.value)
+            elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                for e in kw.value.elts:
+                    if isinstance(e, ast.Constant) and \
+                            isinstance(e.value, int):
+                        nums.add(e.value)
+        elif kw.arg == "static_argnames":
+            if isinstance(kw.value, ast.Constant) and \
+                    isinstance(kw.value.value, str):
+                names.add(kw.value.value)
+            elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                for e in kw.value.elts:
+                    if isinstance(e, ast.Constant) and \
+                            isinstance(e.value, str):
+                        names.add(e.value)
+    return nums, names
+
+
+class _Jitted:
+    __slots__ = ("static_nums", "static_names", "label")
+
+    def __init__(self, static_nums, static_names, label):
+        self.static_nums = static_nums
+        self.static_names = static_names
+        self.label = label
+
+
+class RetraceHazardRule(Rule):
+    name = "retrace-hazard"
+    description = ("Python bool/int literals threaded as traced jit args "
+                   "where static_argnums or a closure is intended; "
+                   "dict/list literals crossing jit boundaries")
+    hint = ("every distinct Python value is a fresh trace+compile: mark "
+            "config args static (static_argnums/static_argnames), close "
+            "over them, or pass a device array for genuinely dynamic "
+            "values")
+
+    def check(self, mod: ModuleContext, project: Project) -> List[Finding]:
+        jitted_names: Dict[str, _Jitted] = {}
+        jitted_attrs: Dict[Tuple[str, str], _Jitted] = {}
+
+        def is_jit_call(call: ast.Call) -> bool:
+            return call_name(call).split(".")[-1] in ("jit", "pjit")
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    is_jit_call(node.value):
+                nums, names = _static_slots(node.value)
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        jitted_names[t.id] = _Jitted(nums, names, t.id)
+                    elif isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        cls = mod.enclosing_class(node)
+                        if cls is not None:
+                            jitted_attrs[(cls.name, t.attr)] = _Jitted(
+                                nums, names, f"self.{t.attr}")
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    dec_call = dec if isinstance(dec, ast.Call) else None
+                    dec_name = call_name(dec_call) if dec_call \
+                        else dotted_name(dec)
+                    if dec_name.split(".")[-1] in ("jit", "pjit"):
+                        nums, names = _static_slots(dec_call) \
+                            if dec_call else (set(), set())
+                        jitted_names[node.name] = _Jitted(
+                            nums, names, node.name)
+
+        if not jitted_names and not jitted_attrs:
+            return []
+
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target: Optional[_Jitted] = None
+            if isinstance(node.func, ast.Name):
+                target = jitted_names.get(node.func.id)
+            elif isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "self":
+                cls = mod.enclosing_class(node)
+                if cls is not None:
+                    target = jitted_attrs.get((cls.name, node.func.attr))
+            if target is None:
+                continue
+            findings.extend(self._check_call(mod, node, target))
+        return findings
+
+    def _check_call(self, mod: ModuleContext, call: ast.Call,
+                    target: _Jitted) -> List[Finding]:
+        findings: List[Finding] = []
+        for pos, arg in enumerate(call.args):
+            if pos in target.static_nums:
+                continue
+            findings.extend(self._check_arg(
+                mod, arg, f"positional arg {pos}", target))
+        for kw in call.keywords:
+            if kw.arg is None or kw.arg in target.static_names:
+                continue
+            findings.extend(self._check_arg(
+                mod, kw.value, f"keyword arg `{kw.arg}`", target))
+        return findings
+
+    def _check_arg(self, mod: ModuleContext, arg: ast.AST, slot: str,
+                   target: _Jitted) -> List[Finding]:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, bool):
+            return [self.finding(
+                mod, arg,
+                f"Python bool literal as traced {slot} of jitted "
+                f"`{target.label}` — flips retrace the whole step")]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, int):
+            return [self.finding(
+                mod, arg,
+                f"Python int literal as traced {slot} of jitted "
+                f"`{target.label}` — every distinct value is a fresh "
+                "compile")]
+        if isinstance(arg, (ast.Dict, ast.List, ast.DictComp,
+                            ast.ListComp)):
+            kind = "dict" if isinstance(arg, (ast.Dict, ast.DictComp)) \
+                else "list"
+            return [self.finding(
+                mod, arg,
+                f"{kind} literal crosses the jit boundary as {slot} of "
+                f"`{target.label}` — Python leaves inside retrace on "
+                "every value change")]
+        return []
