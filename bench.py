@@ -1979,6 +1979,204 @@ def bench_serving_smoke(steps: int, batch: int = 32,
     }
 
 
+def bench_obs_smoke(steps: int, batch: int = 64) -> dict:
+    """CPU-friendly smoke of the observability layer (ISSUE 10). Three
+    self-validating phases, every gate a hard fail:
+
+    1. **Correlated supervised-restart drill** with the flight recorder
+       ON: a deterministic crash mid-run, the supervisor heals it, and
+       the exported Chrome trace must schema-validate AND contain spans
+       (B/E pairs or profiler-section X slices) from >= 3 subsystems
+       carrying the drill's ``incN.aM`` correlation ids; the black-box
+       JSONL beside the checkpoints must reconstruct the
+       fault → classify → restart → resume chain.
+    2. **Interleaved A/B overhead** (recorder off vs on) inside a
+       ``tracecheck.steady_state`` region: median recorder-on step-time
+       overhead > 5% fails, any retrace delta fails.
+    3. **``/api/metrics``** must parse as Prometheus text exposition
+       (TYPE-before-samples, well-formed sample lines) and carry the
+       counter/ledger/flight-recorder families.
+    """
+    import re
+    import statistics as _stats
+    import tempfile
+    import urllib.request
+
+    import jax
+
+    from deeplearning4j_tpu.common import faultinject, flightrec, tracecheck
+    from deeplearning4j_tpu.common.profiler import OpProfiler
+    from deeplearning4j_tpu.data import NDArrayDataSetIterator
+    from deeplearning4j_tpu.parallel import TrainingSupervisor
+    from deeplearning4j_tpu.ui.server import UIServer
+
+    prof = OpProfiler.get()
+    rng = np.random.RandomState(0)
+    n = steps * batch + batch // 2      # partial tail like the other smokes
+    x = rng.randn(n, 1, 28, 28).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+
+    def make_it():
+        return NDArrayDataSetIterator(x, y, batch_size=batch)
+
+    def fail(msg, **extra):
+        print(json.dumps({"error": msg, **extra}))
+        sys.exit(1)
+
+    # ---- phase 1: correlated supervised-restart drill ------------------
+    flightrec.configure(enabled=True)
+    flightrec.reset()
+    tmpdir = tempfile.mkdtemp(prefix="obs_smoke_ckpt_")
+    faultinject.set_plan(faultinject.FaultPlan(
+        [{"site": "train/step", "index": max(2, steps // 2),
+          "kind": "crash"}]))
+    model = _lenet_model()
+    sup = TrainingSupervisor(model, tmpdir,
+                             save_every_n_iterations=max(2, steps // 3),
+                             backoff_base_s=0.01)
+    res = sup.fit(make_it, epochs=1, resume="never")
+    faultinject.clear_plan()
+    if res.status != "completed" or res.restarts != 1:
+        fail("supervised-restart drill did not heal as scripted",
+             status=res.status, restarts=res.restarts)
+    bb_path = sup.blackbox_path()
+    if not os.path.exists(bb_path):
+        fail("no black box beside the checkpoints after the drill",
+             expected=bb_path)
+    bb_names = [json.loads(l)["name"] for l in open(bb_path)]
+    chain = ("fault/fired", "supervisor/attempt_failed",
+             "supervisor/restart", "supervisor/attempt_start",
+             "checkpoint/commit", "checkpoint/restore",
+             "supervisor/completed")
+    missing = [c for c in chain if c not in bb_names]
+    if missing:
+        fail("black box does not reconstruct the incident chain",
+             missing=missing)
+
+    trace_path = os.path.join(tmpdir, "drill_trace.json")
+    flightrec.export_chrome_trace(trace_path)
+    blob = json.load(open(trace_path))
+    trace_events = blob.get("traceEvents")
+    if not isinstance(trace_events, list) or not trace_events:
+        fail("chrome trace export is empty or malformed")
+    depth: dict = {}
+    # B/E balance is only a valid invariant when the ring evicted
+    # nothing — a long drill can legitimately drop a span's B while its
+    # E survives (Perfetto tolerates the orphan; a gate must not)
+    check_balance = flightrec.stats()["dropped"] == 0
+    for ev in trace_events:
+        if not {"ph", "pid", "tid", "name"} <= set(ev):
+            fail("chrome trace event missing required keys", event=ev)
+        if ev["ph"] != "M" and not isinstance(ev.get("ts"), (int, float)):
+            fail("chrome trace event missing ts", event=ev)
+        if ev["ph"] == "X" and not isinstance(ev.get("dur"), (int, float)):
+            fail("X event without dur", event=ev)
+        if not check_balance:
+            continue
+        if ev["ph"] == "B":
+            depth[ev["tid"]] = depth.get(ev["tid"], 0) + 1
+        elif ev["ph"] == "E":
+            depth[ev["tid"]] = depth.get(ev["tid"], 0) - 1
+            if depth[ev["tid"]] < 0:
+                fail("unbalanced E before B in chrome trace",
+                     tid=ev["tid"])
+    if any(v != 0 for v in depth.values()):
+        fail("unbalanced B/E pairs in chrome trace", depth=depth)
+    corr_re = re.compile(r"inc\d+\.a\d+")
+    drill_span_cats = {ev["cat"] for ev in trace_events
+                      if ev["ph"] in ("B", "X")
+                      and corr_re.fullmatch(
+                          str(ev.get("args", {}).get("corr", "")))}
+    if len(drill_span_cats) < 3:
+        fail("chrome trace spans cover < 3 subsystems of the correlated "
+             "drill", subsystems=sorted(drill_span_cats))
+
+    # ---- phase 2: interleaved A/B recorder overhead --------------------
+    models = {"off": _lenet_model(), "on": _lenet_model()}
+    for m in models.values():       # warmup compile outside the region
+        m.fit(make_it(), epochs=1)
+        float(m._score_dev)
+    prof.reset()
+    times = {"off": [], "on": []}
+    try:
+        with tracecheck.steady_state("obs-smoke timed rounds",
+                                     max_host_syncs=None):
+            for _ in range(5):
+                for name, m in models.items():
+                    flightrec.configure(enabled=(name == "on"))
+                    t0 = time.perf_counter()
+                    m.fit(make_it(), epochs=1)
+                    float(m._score_dev)     # value fence
+                    times[name].append(time.perf_counter() - t0)
+    except tracecheck.SteadyStateViolation as e:
+        flightrec.configure(enabled=True)
+        fail("train step retraced inside a timed window — the recorder "
+             "must not destabilize shapes",
+             violation=str(e).splitlines()[0])
+    finally:
+        flightrec.configure(enabled=True)
+    t_off = _stats.median(times["off"])
+    t_on = _stats.median(times["on"])
+    overhead = (t_on - t_off) / t_off
+    if overhead > 0.05:
+        fail(f"flight-recorder overhead {overhead:.1%} exceeds the 5% "
+             "budget", off_s=round(t_off, 4), on_s=round(t_on, 4),
+             off_times=[round(t, 4) for t in times["off"]],
+             on_times=[round(t, 4) for t in times["on"]])
+
+    # ---- phase 3: /api/metrics conformance -----------------------------
+    ui = UIServer()
+    port = ui.enable(0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/metrics", timeout=10) as r:
+            metrics_text = r.read().decode()
+    finally:
+        ui.stop()
+    families: dict = {}
+    typed = None
+    sample_re = re.compile(
+        r'([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(-?[\d.eE+-]+)$')
+    for line in metrics_text.splitlines():
+        if not line.strip() or line.startswith("# HELP"):
+            continue
+        if line.startswith("# TYPE"):
+            _h, _t, fam, mtype = line.split(None, 3)
+            families[fam] = {"type": mtype, "samples": 0}
+            typed = fam
+            continue
+        m = sample_re.match(line)
+        if not m or m.group(1) not in families or m.group(1) != typed:
+            fail("non-conformant /api/metrics line", line=line)
+        families[m.group(1)]["samples"] += 1
+    for fam in ("dl4j_counter_total", "dl4j_section_seconds_total",
+                "dl4j_ledger", "dl4j_flightrec_events_total"):
+        if families.get(fam, {}).get("samples", 0) < 1:
+            fail(f"/api/metrics missing the {fam} family",
+                 families=sorted(families))
+
+    images = n + (batch - n % batch) % batch
+    return {
+        "metric": "obs_smoke",
+        "value": images / t_on,
+        "unit": "images/sec",
+        "batch": batch,
+        "platform": jax.devices()[0].platform,
+        "recorder_overhead_frac": round(overhead, 4),
+        "epoch_s_off_median": round(t_off, 4),
+        "epoch_s_on_median": round(t_on, 4),
+        "drill_restarts": res.restarts,
+        "blackbox_events": len(bb_names),
+        "trace_events": len(trace_events),
+        "drill_span_subsystems": sorted(drill_span_cats),
+        "metrics_families": len(families),
+        "flightrec": flightrec.stats(),
+        "data": "synthetic LeNet batches; supervised crash drill with "
+                "correlated chrome-trace/blackbox gates, recorder "
+                "off/on interleaved A/B, /api/metrics conformance",
+    }
+
+
 def bench_word2vec(steps: int) -> dict:
     """North-star config 4: Word2Vec skip-gram + negative sampling over a
     synthetic zipfian corpus; throughput = corpus words consumed / sec
@@ -2262,7 +2460,8 @@ def main() -> None:
                                  "pipeline-smoke", "telemetry-smoke",
                                  "fault-smoke", "supervisor-smoke",
                                  "zero1-smoke", "elastic-smoke",
-                                 "serving-smoke", "mfu-smoke"])
+                                 "serving-smoke", "mfu-smoke",
+                                 "obs-smoke"])
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--batch", type=int, default=None,
                         help="per-config default: resnet50=128, bert=32")
@@ -2372,6 +2571,8 @@ def main() -> None:
         result = bench_elastic_smoke(steps, batch=args.batch or 64)
     elif args.config == "serving-smoke":
         result = bench_serving_smoke(steps, batch=args.batch or 32)
+    elif args.config == "obs-smoke":
+        result = bench_obs_smoke(steps, batch=args.batch or 64)
     elif args.config == "resnet50-disk":
         result = bench_resnet50_disk(steps, batch=args.batch or 64)
     elif args.config == "resnet50-predecoded":

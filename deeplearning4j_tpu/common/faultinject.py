@@ -102,6 +102,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from . import flightrec
+
 logger = logging.getLogger("deeplearning4j_tpu")
 
 ENV_PLAN = "DL4J_TPU_FAULT_PLAN"
@@ -322,6 +324,10 @@ def fault_point(site: str, index: Optional[int] = None) -> List[Dict[str, Any]]:
     for spec in fired:
         kind = spec["kind"]
         prof.count(f"faults/{site}/{kind}")
+        # timeline entry BEFORE the fault acts: a crash/wedge that
+        # unwinds from here is already on the record for the black box
+        flightrec.event("fault/fired", severity="warn", site=site,
+                        kind=kind, index=index)
         logger.warning("faultinject: firing %s at %s[%s]", kind, site, index)
         if kind == "slow":
             time.sleep(float(spec.get("seconds", 0.1)))

@@ -1,0 +1,496 @@
+"""Flight recorder: correlated cross-subsystem event tracing.
+
+The runtime spans seven interacting subsystems (pipeline, supervisor,
+checkpointing, elastic resize, ZeRO-1 exchange, serving, fault
+injection); before this module their observability was a dozen
+disconnected pull-based ledgers on ``OpProfiler`` plus ``/api/health``
+snapshots — no single timeline showed *what happened in what order
+across threads*, and nothing survived a crash. This is the reference
+stack's ``PerformanceListener``/``SystemInfo``/UIServer remote-telemetry
+role (SURVEY §5.5) rebuilt as a black box: a **thread-safe bounded ring
+buffer of structured events** every subsystem appends to, cheap enough
+to leave on in production and small enough to dump whole at a crash.
+
+Event model
+-----------
+One event = one dict: monotonic + wall timestamps, a registered ``name``
+(``subsystem/what``, see the registry below), severity (``info`` /
+``warn`` / ``error``), free-form ``attrs``, the emitting thread, an
+optional **correlation id** and optional **span id / parent span id**.
+
+- **Correlation ids** stitch one logical incident across subsystems and
+  threads: the supervisor sets an ambient ``incN.aM``
+  (incarnation.attempt) id for each supervised attempt, which every
+  event emitted meanwhile inherits (checkpoint commits from the writer
+  thread, fault firings, pipeline epochs, elastic resizes); serving
+  requests carry their own explicit ``req<ordinal>`` id through
+  enqueue → batch → dispatch → reply. One grep of the timeline for a
+  correlation id reconstructs a kill-restart-resume or a
+  kill-a-replica-mid-load incident end to end.
+- **Spans** (:func:`span`) are nestable begin/end pairs with per-thread
+  parent tracking — each thread keeps its own span stack, so spans nest
+  correctly across concurrent threads.
+- The **disabled path is near-zero cost**: one global read plus one
+  attribute check, no allocation, no lock.
+
+Consumers
+---------
+1. :func:`export_chrome_trace` — Chrome trace event format (loadable in
+   Perfetto / ``chrome://tracing``): spans as B/E pairs, instants as
+   ``i``, and ``OpProfiler.time_section`` durations (recorded as
+   ``profiler/section`` events carrying ``dur_s``) as complete ``X``
+   events, all mapped onto real thread lanes with thread-name metadata.
+2. ``GET /api/metrics`` on :class:`ui.server.UIServer` — Prometheus
+   text exposition of every profiler counter/gauge/ledger plus the
+   recorder's own totals (the pull half; this module is the push half).
+3. :func:`dump_blackbox` — the crash black box: the last-N events as
+   JSONL. The supervisor dumps it beside the checkpoints on every
+   failure classification and on the SIGTERM preemption path, and
+   attaches the tail to ``RestartBudgetExceeded`` — postmortems need no
+   live process.
+
+Event-name registry
+-------------------
+Emitted names must come from :data:`EVENT_SITES` — enforced project-wide
+by graftlint's ``event-name-registry`` rule (every emitted literal
+registered; every registered name emitted, documented in the table
+below, and referenced by a test/bench drill). The table is
+generated-checked against the registry, like faultinject's.
+
+=========================  ==========  =================================
+event name                 severity    emitted by / drill
+=========================  ==========  =================================
+supervisor/attempt_start   info        TrainingSupervisor.fit attempt
+                                       loop; blackbox drill
+supervisor/attempt         info        span around each supervised
+                                       attempt (B/E); obs-smoke trace
+supervisor/attempt_failed  error       failure classification; blackbox
+                                       drill
+supervisor/restart         warn        checkpoint-restart decision;
+                                       blackbox drill
+supervisor/watchdog_fire   warn        hang watchdog; test_supervisor
+                                       wedge drill
+supervisor/preempted       warn        SIGTERM/SIGINT flush path;
+                                       test_supervisor SIGTERM drill
+supervisor/give_up         error       budget/storm exhaustion; blackbox
+                                       drill
+supervisor/completed       info        supervised fit completion
+checkpoint/commit          info        util.checkpoint.commit_checkpoint
+checkpoint/restore         info        util.checkpoint.
+                                       restore_training_state
+fault/fired                warn        faultinject.fault_point
+pipeline/epoch             info        span around each training epoch
+                                       (data.pipeline.run_epochs)
+pipeline/dispatch          info        per-dispatch instant (ordinal)
+elastic/resize             warn        span around ParallelWrapper.
+                                       resize; test_elastic drill
+serving/enqueue            info        ServingEngine request admission
+serving/batch              info        continuous-batching batch formed
+                                       (request ids listed)
+serving/reply              info        per-request completion + latency
+serving/retire             warn        serving replica retirement
+inference/resurrected      info        replica resurrection landing
+tracecheck/violation       error       steady-state region tripped
+profiler/section           info        OpProfiler.time_section duration
+                                       (Chrome ``X`` lane)
+perf/rate                  info        PerformanceListener throughput
+                                       sample
+=========================  ==========  =================================
+
+Deliberately stdlib-only (no jax, no profiler import) so every
+subsystem — including the profiler itself — can emit without import
+cycles, and the crash path has no heavy dependencies.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional
+
+#: The central event-name registry (generated-checked against the module
+#: docstring table by graftlint's ``event-name-registry`` rule): name ->
+#: what emits it + the drill that proves it fires. Emitting an
+#: unregistered literal is a lint finding.
+EVENT_SITES: Dict[str, Dict[str, str]] = {
+    "supervisor/attempt_start": {
+        "desc": "supervised attempt begins (resume point named)",
+        "drill": "test_observability blackbox drill"},
+    "supervisor/attempt": {
+        "desc": "span around one supervised attempt",
+        "drill": "obs-smoke chrome-trace gate"},
+    "supervisor/attempt_failed": {
+        "desc": "failure classified (class, policy, error)",
+        "drill": "test_observability blackbox drill"},
+    "supervisor/restart": {
+        "desc": "checkpoint-restart decision + backoff",
+        "drill": "test_observability blackbox drill"},
+    "supervisor/watchdog_fire": {
+        "desc": "hang watchdog abandoned a wedged attempt",
+        "drill": "test_supervisor watchdog drill"},
+    "supervisor/preempted": {
+        "desc": "preemption signal -> flush checkpoint + resumable exit",
+        "drill": "test_supervisor SIGTERM drill"},
+    "supervisor/give_up": {
+        "desc": "restart budget / storm breaker exhausted",
+        "drill": "test_observability give-up drill"},
+    "supervisor/completed": {
+        "desc": "supervised fit ran to completion",
+        "drill": "test_observability blackbox drill"},
+    "checkpoint/commit": {
+        "desc": "checkpoint atomically committed to the manifest",
+        "drill": "test_observability blackbox drill"},
+    "checkpoint/restore": {
+        "desc": "checkpoint restored into a model (resume)",
+        "drill": "test_observability blackbox drill"},
+    "fault/fired": {
+        "desc": "an injected fault fired (site, kind, index)",
+        "drill": "test_observability blackbox drill"},
+    "pipeline/epoch": {
+        "desc": "span around one training epoch",
+        "drill": "test_observability chrome-trace test; obs-smoke"},
+    "pipeline/dispatch": {
+        "desc": "one train-step dispatch (ordinal)",
+        "drill": "test_observability chrome-trace test"},
+    "elastic/resize": {
+        "desc": "span around an online data-axis resize",
+        "drill": "test_elastic resize drill"},
+    "serving/enqueue": {
+        "desc": "request admitted to the serving queue (req ordinal)",
+        "drill": "test_observability serving lifecycle test"},
+    "serving/batch": {
+        "desc": "continuous-batching batch formed (request ids)",
+        "drill": "test_observability serving lifecycle test"},
+    "serving/reply": {
+        "desc": "request completed (latency attached)",
+        "drill": "test_observability serving lifecycle test"},
+    "serving/retire": {
+        "desc": "serving replica retired mid-load (batch requeued)",
+        "drill": "test_observability serving kill drill"},
+    "inference/resurrected": {
+        "desc": "a retired replica's replacement joined the pool",
+        "drill": "test_observability serving kill drill"},
+    "tracecheck/violation": {
+        "desc": "a declared steady-state region retraced/synced",
+        "drill": "test_observability injected-retrace test"},
+    "profiler/section": {
+        "desc": "one OpProfiler.time_section duration (Chrome X event)",
+        "drill": "test_observability chrome-trace test"},
+    "perf/rate": {
+        "desc": "PerformanceListener throughput/latency sample",
+        "drill": "test_observability PerformanceListener test"},
+}
+
+DEFAULT_CAPACITY = 4096
+
+
+class FlightRecorder:
+    """Thread-safe bounded ring buffer of structured events with a
+    nestable span API. Enabled by default; :meth:`configure` flips it
+    (the disabled path is one attribute check). Instantiable for tests;
+    the process-wide instance is :func:`get`."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 enabled: bool = True):
+        self._lock = threading.Lock()
+        self._buf: "deque" = deque(maxlen=max(1, int(capacity)))
+        self._enabled = bool(enabled)
+        self._total = 0          # events ever appended (== next seq)
+        self._dropped = 0        # ring-overflow evictions
+        self._span_seq = 0
+        self._corr: Optional[str] = None    # ambient correlation id
+        self._tls = threading.local()       # per-thread span stack
+
+    # -- config / introspection ------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def configure(self, enabled: Optional[bool] = None,
+                  capacity: Optional[int] = None) -> "FlightRecorder":
+        with self._lock:
+            if enabled is not None:
+                self._enabled = bool(enabled)
+            if capacity is not None and capacity != self._buf.maxlen:
+                cap = max(1, int(capacity))
+                # a shrink evicts the oldest buffered events — they count
+                # as drops, or consumers trusting dropped==0 (the chrome
+                # B/E-balance gate) would read a truncated ring as whole
+                self._dropped += max(0, len(self._buf) - cap)
+                self._buf = deque(self._buf, maxlen=cap)
+        return self
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"enabled": self._enabled,
+                    "capacity": self._buf.maxlen,
+                    "buffered": len(self._buf),
+                    "events_total": self._total,
+                    "dropped": self._dropped}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self._total = 0
+            self._dropped = 0
+            self._corr = None
+
+    # -- correlation ------------------------------------------------------
+    def set_correlation(self, corr: Optional[str]) -> None:
+        """Set the AMBIENT correlation id every subsequent event (from
+        any thread) inherits unless it passes an explicit ``corr``. The
+        supervisor owns this slot during supervised runs (one run at a
+        time); explicit per-event ids (serving requests) always win."""
+        with self._lock:
+            self._corr = corr
+
+    def correlation(self) -> Optional[str]:
+        return self._corr
+
+    @contextlib.contextmanager
+    def correlate(self, corr: Optional[str]) -> Iterator[None]:
+        prev = self._corr
+        self.set_correlation(corr)
+        try:
+            yield
+        finally:
+            self.set_correlation(prev)
+
+    # -- emission ---------------------------------------------------------
+    def record(self, name: str, severity: str = "info",
+               corr: Optional[str] = None,
+               attrs: Optional[Dict[str, Any]] = None,
+               phase: str = "i", span_id: Optional[int] = None,
+               parent_id: Optional[int] = None,
+               force: bool = False) -> None:
+        """Append one event. Near-zero when disabled (one attribute
+        check, nothing allocated). ``force`` records even while
+        disabled — only span close uses it, so a mid-span disable cannot
+        orphan a recorded B."""
+        if not self._enabled and not force:
+            return
+        t = threading.current_thread()
+        ev = {"t": time.time(), "m": time.monotonic(), "name": name,
+              "sev": severity, "corr": corr, "ph": phase,
+              "span": span_id, "parent": parent_id,
+              "thread": t.name, "tid": t.ident,
+              "attrs": attrs or {}}
+        with self._lock:
+            if corr is None:
+                ev["corr"] = self._corr
+            ev["seq"] = self._total
+            if len(self._buf) == self._buf.maxlen:
+                self._dropped += 1
+            self._buf.append(ev)
+            self._total += 1
+
+    def event(self, name: str, severity: str = "info",
+              corr: Optional[str] = None, **attrs) -> None:
+        self.record(name, severity=severity, corr=corr, attrs=attrs)
+
+    def _stack(self) -> List[int]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    @contextlib.contextmanager
+    def span(self, name: str, severity: str = "info",
+             corr: Optional[str] = None, **attrs) -> Iterator[Optional[int]]:
+        """Nestable begin/end span: emits a ``B`` event on entry and an
+        ``E`` event on exit (exceptions included), parented on the
+        calling thread's innermost open span."""
+        if not self._enabled:
+            yield None
+            return
+        stack = self._stack()
+        with self._lock:
+            self._span_seq += 1
+            sid = self._span_seq
+            if corr is None:
+                # resolve the ambient id ONCE, at open: a span that
+                # outlives a correlation change (a zombie attempt's epoch
+                # unwinding after its replacement started) must close
+                # under the incident it opened under
+                corr = self._corr
+        parent = stack[-1] if stack else None
+        self.record(name, severity=severity, corr=corr, attrs=attrs,
+                    phase="B", span_id=sid, parent_id=parent)
+        stack.append(sid)
+        try:
+            yield sid
+        finally:
+            stack.pop()
+            # force: a recorded B must get its E even if the recorder was
+            # disabled mid-span, or the trace carries a never-ending
+            # slice while dropped==0 claims the ring is whole
+            self.record(name, severity=severity, corr=corr, phase="E",
+                        span_id=sid, parent_id=parent, force=True)
+
+    # -- reading ----------------------------------------------------------
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """Owning copy of the ring, oldest first."""
+        with self._lock:
+            return list(self._buf)
+
+    def events(self, prefix: Optional[str] = None,
+               corr: Optional[str] = None) -> List[Dict[str, Any]]:
+        evs = self.snapshot()
+        if prefix is not None:
+            evs = [e for e in evs if e["name"].startswith(prefix)]
+        if corr is not None:
+            evs = [e for e in evs if e["corr"] == corr]
+        return evs
+
+    def tail(self, n: int) -> List[Dict[str, Any]]:
+        return self.snapshot()[-max(0, int(n)):]
+
+    # -- consumers --------------------------------------------------------
+    def export_chrome_trace(self, path: str) -> int:
+        """Write the ring as Chrome trace event format (Perfetto /
+        ``chrome://tracing`` loadable). Spans map to ``B``/``E`` pairs,
+        instants to ``i``, events carrying a ``dur_s`` attr (the
+        profiler's ``time_section`` durations) to complete ``X`` events
+        named after their section; each emitting thread gets its own
+        lane with a ``thread_name`` metadata record. Returns the number
+        of trace events written."""
+        evs = self.snapshot()
+        pid = os.getpid()
+        out: List[Dict[str, Any]] = []
+        threads: Dict[int, str] = {}
+        for e in evs:
+            tid = e["tid"] or 0
+            threads.setdefault(tid, e["thread"])
+            args = dict(e["attrs"])
+            if e["corr"]:
+                args["corr"] = e["corr"]
+            if e["span"] is not None:
+                args["span"] = e["span"]
+                if e["parent"] is not None:
+                    args["parent_span"] = e["parent"]
+            name, cat = e["name"], e["name"].split("/", 1)[0]
+            base = {"pid": pid, "tid": tid, "cat": cat, "args": args}
+            dur = e["attrs"].get("dur_s")
+            if e["ph"] in ("B", "E"):
+                out.append({**base, "ph": e["ph"], "name": name,
+                            "ts": e["m"] * 1e6})
+            elif dur is not None:
+                sec = e["attrs"].get("section", name)
+                out.append({**base, "ph": "X",
+                            "name": sec, "cat": str(sec).split("/", 1)[0],
+                            "ts": (e["m"] - float(dur)) * 1e6,
+                            "dur": float(dur) * 1e6})
+            else:
+                out.append({**base, "ph": "i", "s": "t", "name": name,
+                            "ts": e["m"] * 1e6})
+        for tid, tname in threads.items():
+            out.append({"ph": "M", "pid": pid, "tid": tid,
+                        "name": "thread_name", "args": {"name": tname}})
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"traceEvents": out, "displayTimeUnit": "ms"}, f)
+        os.replace(tmp, path)
+        return len(out)
+
+    def dump_blackbox(self, path: str,
+                      last_n: Optional[int] = None) -> str:
+        """Write the last-N events (whole ring by default) as JSONL, one
+        event per line, atomically (tmp + rename — a crash mid-dump
+        leaves the previous black box intact). The postmortem artifact:
+        readable with no live process, greppable by correlation id."""
+        evs = self.snapshot()
+        if last_n is not None:
+            evs = evs[-max(0, int(last_n)):]
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for e in evs:
+                f.write(json.dumps(e, default=str) + "\n")
+        os.replace(tmp, path)
+        return path
+
+
+# -- the process-wide recorder + module-level facade ----------------------
+
+_REC: Optional[FlightRecorder] = None
+_rec_lock = threading.Lock()
+
+
+def get() -> FlightRecorder:
+    global _REC
+    rec = _REC
+    if rec is None:
+        with _rec_lock:
+            if _REC is None:
+                _REC = FlightRecorder()
+            rec = _REC
+    return rec
+
+
+def configure(enabled: Optional[bool] = None,
+              capacity: Optional[int] = None) -> FlightRecorder:
+    return get().configure(enabled=enabled, capacity=capacity)
+
+
+def enabled() -> bool:
+    """Cheapest possible recording check — for call sites whose event
+    ATTRS are themselves expensive to build (list comprehensions over a
+    batch, latency math): guard them so the disabled path allocates
+    nothing. A not-yet-created recorder reports True (it is born
+    enabled; the first event() call creates it)."""
+    rec = _REC
+    return rec is None or rec._enabled
+
+
+def event(name: str, severity: str = "info", corr: Optional[str] = None,
+          **attrs) -> None:
+    """Emit one instant event (the hot-path entry point — when the
+    recorder is disabled this is one global read + one attribute
+    check)."""
+    rec = _REC
+    if rec is None:
+        rec = get()
+    if not rec._enabled:
+        return
+    rec.record(name, severity=severity, corr=corr, attrs=attrs)
+
+
+def span(name: str, severity: str = "info", corr: Optional[str] = None,
+         **attrs):
+    return get().span(name, severity=severity, corr=corr, **attrs)
+
+
+def set_correlation(corr: Optional[str]) -> None:
+    get().set_correlation(corr)
+
+
+def correlate(corr: Optional[str]):
+    return get().correlate(corr)
+
+
+def events(prefix: Optional[str] = None,
+           corr: Optional[str] = None) -> List[Dict[str, Any]]:
+    return get().events(prefix=prefix, corr=corr)
+
+
+def tail(n: int) -> List[Dict[str, Any]]:
+    return get().tail(n)
+
+
+def stats() -> Dict[str, Any]:
+    return get().stats()
+
+
+def reset() -> None:
+    get().reset()
+
+
+def export_chrome_trace(path: str) -> int:
+    return get().export_chrome_trace(path)
+
+
+def dump_blackbox(path: str, last_n: Optional[int] = None) -> str:
+    return get().dump_blackbox(path, last_n=last_n)

@@ -17,17 +17,37 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
+
+from . import flightrec
 
 
 class OpProfiler:
     _instance: Optional["OpProfiler"] = None
     _lock = threading.Lock()
 
+    #: every derived ledger the profiler exposes, by (label, method
+    #: name) — the one list ``print_statistics``, ``/api/health`` and
+    #: the ``/api/metrics`` Prometheus renderer all iterate, so a new
+    #: ledger can never be health-only or metrics-only by accident.
+    LEDGERS: Tuple[Tuple[str, str], ...] = (
+        ("overlap", "overlap_stats"),
+        ("telemetry", "telemetry_stats"),
+        ("checkpoint", "checkpoint_stats"),
+        ("supervisor", "supervisor_stats"),
+        ("collectives", "collective_stats"),
+        ("elastic", "elastic_stats"),
+        ("serving", "serving_stats"),
+        ("precision", "precision_stats"),
+        ("tracecheck", "tracecheck_stats"),
+        ("faults", "fault_stats"),
+    )
+
     def __init__(self) -> None:
         self._trace_dir: Optional[str] = None
         self._sections: Dict[str, Dict[str, float]] = {}
         self._counters: Dict[str, int] = {}
+        self._gauge_names: set = set()
 
     @classmethod
     def get(cls) -> "OpProfiler":
@@ -93,6 +113,12 @@ class OpProfiler:
                 s["count"] += 1
                 s["total_s"] += dt
                 s["max_s"] = max(s["max_s"], dt)
+            # individual durations feed the flight recorder's timeline
+            # (Chrome-trace X events on the emitting thread's lane);
+            # the aggregate above stays the ledger source of truth.
+            # Emitted OUTSIDE the profiler lock — the recorder has its
+            # own, and nesting them would order the two locks.
+            flightrec.event("profiler/section", section=name, dur_s=dt)
 
     def get_statistics(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
@@ -114,6 +140,14 @@ class OpProfiler:
         would be meaningless."""
         with self._lock:
             self._counters[name] = int(value)
+            # remembered so /api/metrics can render levels as Prometheus
+            # gauges instead of (monotonicity-implying) counters
+            self._gauge_names.add(name)
+
+    def gauge_names(self) -> set:
+        """Counter names set via :meth:`gauge` (levels, not totals)."""
+        with self._lock:
+            return set(self._gauge_names)
 
     def counter_value(self, name: str) -> int:
         return self._counters.get(name, 0)
@@ -305,15 +339,31 @@ class OpProfiler:
             out["retry_backoff_s"] = s["total_s"]
         return out
 
+    def ledger_stats(self) -> Dict[str, Dict[str, float]]:
+        """Every non-empty derived ledger (:data:`LEDGERS`), keyed by
+        label — the same set ``print_statistics`` renders and
+        ``/api/metrics`` exports."""
+        out: Dict[str, Dict[str, float]] = {}
+        for label, attr in self.LEDGERS:
+            stats = getattr(self, attr)()
+            if stats:
+                out[label] = stats
+        return out
+
     def print_statistics(self) -> str:
         lines = [f"{'section':<32}{'count':>8}{'total ms':>12}"
                  f"{'mean ms':>12}{'max ms':>12}"]
-        for name, s in sorted(self._sections.items(),
+        for name, s in sorted(self.get_statistics().items(),
                               key=lambda kv: -kv[1]["total_s"]):
             mean = s["total_s"] / max(s["count"], 1)
             lines.append(f"{name:<32}{s['count']:>8}"
                          f"{s['total_s'] * 1e3:>12.2f}"
                          f"{mean * 1e3:>12.2f}{s['max_s'] * 1e3:>12.2f}")
+        for label, stats in self.ledger_stats().items():
+            lines.append(f"[{label}] " + "  ".join(
+                f"{k}={round(v, 6) if isinstance(v, float) else v}"
+                for k, v in sorted(stats.items())
+                if isinstance(v, (int, float))))
         out = "\n".join(lines)
         print(out)
         return out
@@ -322,3 +372,4 @@ class OpProfiler:
         with self._lock:
             self._sections.clear()
             self._counters.clear()
+            self._gauge_names.clear()

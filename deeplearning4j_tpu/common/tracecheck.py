@@ -48,6 +48,7 @@ import threading
 import traceback
 from typing import Dict, List, Optional
 
+from . import flightrec
 from .profiler import OpProfiler
 
 
@@ -202,6 +203,8 @@ def steady_state(label: str = "steady-state", *, allow_compiles: int = 0,
                         f"sync(s) (allowed {max_host_syncs})")
     if problems:
         prof.count("tracecheck/violations")
+        flightrec.event("tracecheck/violation", severity="error",
+                        label=label, problems="; ".join(problems))
         stack = f"\nfirst offender stack:\n{region.first_stack}" \
             if region.first_stack else ""
         raise SteadyStateViolation(

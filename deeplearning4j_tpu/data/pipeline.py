@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..common import faultinject
+from ..common import faultinject, flightrec
 from ..common.background import staged_iter
 from ..common.profiler import OpProfiler
 from ..ndarray.ndarray import NDArray
@@ -344,53 +344,63 @@ def run_epochs(data: Any, epochs: int, batch_size: Optional[int],
                                    allow_multi=allow_multi):
                 pass
             continue
-        gen = stable_batches(data, batch_size, pad_partial=pad_partial,
-                             drop_remainder=drop_remainder,
-                             round_to_multiple_of=round_to_multiple_of,
-                             allow_multi=allow_multi)
-        if e == skip_epochs and skip_steps:
-            skipped = 0
-            for _ in gen:
-                skipped += 1
-                if skipped >= skip_steps:
-                    break
-            if skipped < skip_steps:
-                import logging
+        with flightrec.span("pipeline/epoch", epoch=e):
+            gen = stable_batches(data, batch_size, pad_partial=pad_partial,
+                                 drop_remainder=drop_remainder,
+                                 round_to_multiple_of=round_to_multiple_of,
+                                 allow_multi=allow_multi)
+            if e == skip_epochs and skip_steps:
+                skipped = 0
+                for _ in gen:
+                    skipped += 1
+                    if skipped >= skip_steps:
+                        break
+                if skipped < skip_steps:
+                    import logging
 
-                logging.getLogger("deeplearning4j_tpu").warning(
-                    "resume cursor wants %d steps into the epoch but the "
-                    "source produced %d batches — did the data change "
-                    "since the checkpoint?", skip_steps, skipped)
-        bound = (guarded_bind(ds, w) for ds, w, _n in gen)
-        feed = timed_iter(device_feed(bound, place=guarded_place,
-                                      depth=max(0, int(prefetch)),
-                                      host_prefetch=max(0, int(host_prefetch))))
-        if k == 1:
-            for b in feed:
-                faultinject.fault_point("train/step", n_dispatched)
-                # a wedge here is a hung dispatch: the thread blocks until
-                # the supervisor's watchdog abandons it (release_wedges);
-                # a device_loss here is a replica dying BETWEEN dispatches
-                # — the holder's state stays boundary-consistent, which is
-                # what lets the supervisor shrink the data axis online
-                # instead of checkpoint-restarting
-                faultinject.fault_point("train/wedge", n_dispatched)
-                faultinject.fault_point("device/loss", n_dispatched)
-                n_dispatched += 1
-                dispatch_one(b)
-        else:
-            for group in chunked(feed, k):
-                for j in range(len(group)):
-                    faultinject.fault_point("train/step", n_dispatched + j)
-                    faultinject.fault_point("train/wedge", n_dispatched + j)
-                    faultinject.fault_point("device/loss", n_dispatched + j)
-                n_dispatched += len(group)
-                if len(group) == k and stackable(group):
-                    dispatch_chunk(group)
-                else:
-                    for b in group:
-                        dispatch_one(b)
-        on_epoch()
+                    logging.getLogger("deeplearning4j_tpu").warning(
+                        "resume cursor wants %d steps into the epoch but "
+                        "the source produced %d batches — did the data "
+                        "change since the checkpoint?", skip_steps, skipped)
+            bound = (guarded_bind(ds, w) for ds, w, _n in gen)
+            feed = timed_iter(device_feed(
+                bound, place=guarded_place, depth=max(0, int(prefetch)),
+                host_prefetch=max(0, int(host_prefetch))))
+            if k == 1:
+                for b in feed:
+                    faultinject.fault_point("train/step", n_dispatched)
+                    # a wedge here is a hung dispatch: the thread blocks
+                    # until the supervisor's watchdog abandons it
+                    # (release_wedges); a device_loss here is a replica
+                    # dying BETWEEN dispatches — the holder's state stays
+                    # boundary-consistent, which is what lets the
+                    # supervisor shrink the data axis online instead of
+                    # checkpoint-restarting
+                    faultinject.fault_point("train/wedge", n_dispatched)
+                    faultinject.fault_point("device/loss", n_dispatched)
+                    flightrec.event("pipeline/dispatch",
+                                    ordinal=n_dispatched)
+                    n_dispatched += 1
+                    dispatch_one(b)
+            else:
+                for group in chunked(feed, k):
+                    for j in range(len(group)):
+                        faultinject.fault_point("train/step",
+                                                n_dispatched + j)
+                        faultinject.fault_point("train/wedge",
+                                                n_dispatched + j)
+                        faultinject.fault_point("device/loss",
+                                                n_dispatched + j)
+                    flightrec.event("pipeline/dispatch",
+                                    ordinal=n_dispatched,
+                                    steps=len(group))
+                    n_dispatched += len(group)
+                    if len(group) == k and stackable(group):
+                        dispatch_chunk(group)
+                    else:
+                        for b in group:
+                            dispatch_one(b)
+            on_epoch()
 
 
 def note_steps(holder: Any, listeners: Iterable, losses,

@@ -695,6 +695,13 @@ class ComputationGraph:
         self.score_value = float(loss)
         return grads, self.score_value
 
+    def _bind_fit_batch(self, ds, w):
+        """The fit-loop bind: the training tuple plus the bookkeeping
+        only fit needs (PerformanceListener derives samples/sec from the
+        bound batch size; evaluate() shares _bind_dataset without it)."""
+        self._last_batch_size = ds.num_examples()
+        return self._bind_dataset(ds) + (w,)
+
     def _bind_dataset(self, ds):
         in_names = self.conf.network_inputs
         out_names = [o for o in self.conf.network_outputs
@@ -842,7 +849,7 @@ class ComputationGraph:
             pad_partial=True if pad_partial is None else pad_partial,
             drop_remainder=drop_remainder, prefetch=prefetch,
             steps_per_dispatch=steps_per_dispatch,
-            bind=lambda ds, w: self._bind_dataset(ds) + (w,),
+            bind=lambda ds, w: self._bind_fit_batch(ds, w),
             place=jax.device_put,
             dispatch_one=lambda b: self._dispatch_one(b, prof),
             dispatch_chunk=lambda g: self._dispatch_chunk(g, prof),

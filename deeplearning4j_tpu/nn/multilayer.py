@@ -613,6 +613,8 @@ class MultiLayerNetwork:
 
     def _bind_batch(self, ds: DataSet, w):
         """DataSet → the jit argument tuple (x, y, mask, fmask, w)."""
+        # PerformanceListener derives samples/sec from this
+        self._last_batch_size = ds.num_examples()
         return (jnp.asarray(ds.features.value),
                 jnp.asarray(ds.labels.value),
                 jnp.asarray(ds.labels_mask.value)

@@ -61,25 +61,65 @@ class CollectScoresIterationListener(TrainingListener):
 
 
 class PerformanceListener(TrainingListener):
-    """Samples/sec + iteration latency (reference PerformanceListener)."""
+    """Samples/sec + iteration latency (reference PerformanceListener).
 
-    def __init__(self, frequency: int = 10, report_batch: bool = True):
+    Beyond the reference's log line, each sample is PUBLISHED: through an
+    attached :class:`StatsStorage` (``storage=``) as the scalars
+    ``iterations_per_sec`` / ``iteration_ms`` / ``samples_per_sec`` — so
+    throughput charts on the dashboard beside loss — and as a
+    ``perf/rate`` flight-recorder event on the shared timeline.
+    Samples/sec uses the batch size the fit loop bound last
+    (``model._last_batch_size``); absent that, only the iteration-based
+    figures are reported."""
+
+    def __init__(self, frequency: int = 10, report_batch: bool = True,
+                 storage=None, session_id: str = "performance"):
         self.frequency = max(1, frequency)
         self.report_batch = report_batch
+        self.storage = storage
+        self.session_id = session_id
         self._last_time = None
         self._last_iter = None
         self.last_iterations_per_sec = 0.0
+        self.last_iteration_ms = 0.0
+        self.last_samples_per_sec = 0.0
 
     def iteration_done(self, model, iteration, score):
         now = time.time()
         if self._last_time is not None and iteration % self.frequency == 0:
             dt = now - self._last_time
             iters = iteration - self._last_iter
-            if dt > 0:
-                self.last_iterations_per_sec = iters / dt
+            if dt > 0 and iters > 0:
+                ips = iters / dt
+                self.last_iterations_per_sec = ips
+                self.last_iteration_ms = dt / iters * 1e3
+                batch = getattr(model, "_last_batch_size", None)
+                if batch:
+                    self.last_samples_per_sec = ips * batch
                 if logger.isEnabledFor(logging.INFO):
-                    logger.info("iteration %d: %.1f iter/s, score=%s", iteration,
-                                self.last_iterations_per_sec, float(score))
+                    logger.info("iteration %d: %.1f iter/s, score=%s",
+                                iteration, ips, float(score))
+                if self.storage is not None:
+                    self.storage.put_scalar(self.session_id,
+                                            "iterations_per_sec",
+                                            iteration, ips)
+                    self.storage.put_scalar(self.session_id,
+                                            "iteration_ms", iteration,
+                                            self.last_iteration_ms)
+                    if batch:
+                        self.storage.put_scalar(self.session_id,
+                                                "samples_per_sec",
+                                                iteration,
+                                                self.last_samples_per_sec)
+                from ..common import flightrec
+
+                flightrec.event(
+                    "perf/rate", iteration=iteration,
+                    iterations_per_sec=round(ips, 3),
+                    iteration_ms=round(self.last_iteration_ms, 3),
+                    **({"samples_per_sec":
+                        round(self.last_samples_per_sec, 1)}
+                       if batch else {}))
             self._last_time = now
             self._last_iter = iteration
         elif self._last_time is None:

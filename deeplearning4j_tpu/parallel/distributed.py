@@ -53,10 +53,15 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
-from ..common import faultinject
+from ..common import faultinject, flightrec
 from ..common.profiler import OpProfiler
 
 logger = logging.getLogger("deeplearning4j_tpu")
+
+#: the crash black box, dumped into the checkpoint directory on every
+#: failure classification and on the preemption path — the last-N flight
+#: recorder events as JSONL, readable with no live process
+BLACKBOX_NAME = "blackbox.jsonl"
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -611,6 +616,33 @@ class TrainingSupervisor:
 
         return all(probe_device(d) for d in devices)
 
+    # --- crash black box -------------------------------------------------
+    def blackbox_path(self) -> str:
+        return os.path.join(self.dir, BLACKBOX_NAME)
+
+    def _dump_blackbox(self) -> Optional[str]:
+        """Dump the flight recorder's tail beside the checkpoints —
+        called on every failure classification, restart, preemption and
+        give-up, so the newest dump always tells the latest story (and a
+        process killed right after still leaves the previous one)."""
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            return flightrec.dump_blackbox(self.blackbox_path())
+        except OSError:
+            logger.warning("supervisor: black-box dump to %s failed",
+                           self.blackbox_path(), exc_info=True)
+            return None
+
+    def _attach_blackbox(self, exc: "RestartBudgetExceeded",
+                         reason: str) -> None:
+        """Give-up path: record the verdict, dump the black box, and
+        attach its tail to the exception — the caller's stack trace
+        alone then carries the timeline that led here."""
+        flightrec.event("supervisor/give_up", severity="error",
+                        reason=reason)
+        exc.blackbox_path = self._dump_blackbox()
+        exc.blackbox_tail = flightrec.tail(64)
+
     # --- monitoring -----------------------------------------------------
     def _monitor(self, run: _Attempt) -> str:
         """Watch one attempt: returns ``"done"`` (thread finished, clean
@@ -640,6 +672,8 @@ class TrainingSupervisor:
             if deadline is not None and \
                     now - heartbeat.last_beat > deadline:
                 prof.count("supervisor/watchdog_fires")
+                flightrec.event("supervisor/watchdog_fire", severity="warn",
+                                deadline_s=deadline, steps=heartbeat.steps)
                 logger.warning(
                     "supervisor: watchdog — no step within %.2fs (last "
                     "heartbeat %d steps in); abandoning the wedged "
@@ -767,6 +801,22 @@ class TrainingSupervisor:
                     mem_resume = None
                     attempt_kwargs = dict(fit_kwargs, resume_cursor=cursor)
                     attempt_rng = rng_state
+                # the incarnation.attempt correlation id every event
+                # emitted during this attempt inherits — checkpoint
+                # commits (writer thread), fault firings, pipeline
+                # epochs, elastic resizes: one grep reconstructs the
+                # whole kill-restart-resume incident
+                flightrec.set_correlation(
+                    f"inc{self.incarnation}.a{attempt}")
+                flightrec.event(
+                    "supervisor/attempt_start", attempt=attempt,
+                    resume=("cursor%s" % (attempt_kwargs["resume_cursor"],)
+                            if "resume_cursor" in attempt_kwargs
+                            else resume_from))
+                if attempt > 1:
+                    # the black box now holds the full
+                    # fault → classify → restart → resume chain
+                    self._dump_blackbox()
                 heartbeat = _Heartbeat(self)
                 # arrangement: the fence first (kills zombie threads
                 # before ANY listener sees their callbacks), user
@@ -779,8 +829,9 @@ class TrainingSupervisor:
                 run = _Attempt(self, attempt, src, epochs, resume_from,
                                attempt_kwargs, attempt_rng, heartbeat)
                 self._fence.thread = run.thread
-                run.start()
-                outcome = self._monitor(run)
+                with flightrec.span("supervisor/attempt", attempt=attempt):
+                    run.start()
+                    outcome = self._monitor(run)
                 if outcome == "done" and run.error is None:
                     break
                 if outcome == "done" and \
@@ -870,6 +921,15 @@ class TrainingSupervisor:
                 })
                 logger.warning("supervisor: attempt %d failed [%s → %s]: "
                                "%r", attempt, cls, policy, exc)
+                # classification on the record, then the black box: a
+                # postmortem reads fault site, class and restart decision
+                # from the JSONL alone
+                flightrec.event("supervisor/attempt_failed",
+                                severity="error", attempt=attempt,
+                                failure_class=cls, policy=policy,
+                                error=repr(exc)[:300],
+                                steps=run.heartbeat.steps)
+                self._dump_blackbox()
                 # the POLICY decides (so a policies={"preemption":
                 # "restart"} override is honored); a grace-window timeout
                 # always exits — the environment is reclaiming us
@@ -888,6 +948,10 @@ class TrainingSupervisor:
                         # committed
                         ckpt.flush()
                         resume_path = _ckpt.last_checkpoint(self.dir)
+                    flightrec.event("supervisor/preempted", severity="warn",
+                                    signal=self._preempt_signal,
+                                    resume_from=resume_path)
+                    self._dump_blackbox()
                     break
                 if policy == "raise":
                     final_exc = exc
@@ -928,18 +992,23 @@ class TrainingSupervisor:
                     final_exc = RestartStorm(
                         f"restart storm: {consec_no_progress} consecutive "
                         f"restarts with zero steps of progress", history)
+                    self._attach_blackbox(final_exc, "storm")
                     break
                 if restarts >= self.max_restarts:
                     prof.count("supervisor/giveups")
                     final_exc = RestartBudgetExceeded(
                         f"restart budget ({self.max_restarts}) exhausted",
                         history)
+                    self._attach_blackbox(final_exc, "budget")
                     break
                 restarts += 1
                 prof.count("supervisor/restarts")
                 delay = (self.backoff_base_s if policy == "retry" else
                          min(self.backoff_base_s * (2 ** (restarts - 1)),
                              self.backoff_max_s))
+                flightrec.event("supervisor/restart", severity="warn",
+                                restarts=restarts, policy=policy,
+                                backoff_s=delay)
                 with prof.time_section("supervisor/backoff"):
                     # interruptible: a preemption signal during backoff
                     # must not wait the backoff out
@@ -949,17 +1018,30 @@ class TrainingSupervisor:
                     status = "preempted"
                     ckpt.flush()
                     resume_path = _ckpt.last_checkpoint(self.dir)
+                    flightrec.event("supervisor/preempted", severity="warn",
+                                    signal=self._preempt_signal,
+                                    resume_from=resume_path)
+                    self._dump_blackbox()
                     break
         finally:
             self._restore_signals()
             self._fence.thread = None
             try:
                 if ckpt is not None:
+                    # drains the async writer — its final commits still
+                    # belong to the last attempt, so the ambient
+                    # correlation is cleared only AFTER they land
                     ckpt.close()
             finally:
                 self.target.set_listeners(*target_restore)
+                flightrec.set_correlation(None)
         if final_exc is not None:
             raise final_exc
+        if status == "completed":
+            flightrec.event("supervisor/completed",
+                            corr=f"inc{self.incarnation}.a{attempt}",
+                            attempts=attempt, restarts=restarts)
+            self._dump_blackbox()
         if status == "completed" and run is not None \
                 and run.rng_state is not None:
             # RNG transparency: the caller's stream ends where a plain

@@ -61,7 +61,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from ..common import faultinject
+from ..common import faultinject, flightrec
 from ..common.profiler import OpProfiler
 from ..ndarray.ndarray import NDArray
 
@@ -413,6 +413,8 @@ class ParallelInference:
                 self._resurrected_total += 1
             t.start()
             OpProfiler.get().count("inference/replica_resurrected")
+            flightrec.event("inference/resurrected", worker=worker_id,
+                            alive=self.alive_replicas())
             logger.warning("inference replica %d resurrected; %d/%d "
                            "replicas alive", worker_id,
                            self.alive_replicas(), self._pool_size)

@@ -79,7 +79,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..common import faultinject
+from ..common import faultinject, flightrec
 from ..common.profiler import OpProfiler
 from ..data.pipeline import pad_rows
 from ..ndarray.ndarray import NDArray
@@ -554,6 +554,15 @@ class ServingEngine(ParallelInference):
                 # mask another engine's backlog
                 if depth > prof.counter_value("serving/queue_depth_hwm"):
                     prof.gauge("serving/queue_depth_hwm", depth)
+        # request lifecycle, leg 1 of enqueue → batch → dispatch → reply;
+        # the request ordinal IS the correlation id, so one grep follows
+        # a request through replica deaths and requeues. Emitted BEFORE
+        # the queue put: once a worker can see the request, its batch/
+        # reply events must not be able to precede this one. Guarded
+        # like legs 2/4: per-request kwargs stay off the disabled path
+        if flightrec.enabled():
+            flightrec.event("serving/enqueue", corr=f"req{seq}", req=seq,
+                            rows=int(arr.shape[0]))
         self._enqueue(_Request(arr, fut, seq, time.monotonic(),
                                t_real=t_real))
         return fut
@@ -651,6 +660,15 @@ class ServingEngine(ParallelInference):
         with self._lock:
             ordinal = self._batch_seq
             self._batch_seq += 1
+        # leg 2: the batch formed by continuous batching — emitted BEFORE
+        # the dispatch drill site, so a killed dispatch still shows which
+        # requests were aboard (the incident-reconstruction contract).
+        # enabled() guard: the reqs list is per-batch hot-path allocation
+        # that must not be built just to be discarded
+        if flightrec.enabled():
+            flightrec.event("serving/batch", batch=ordinal, rows=rows,
+                            worker=worker_id,
+                            reqs=[int(r.seq) for r in batch])
         try:
             faultinject.fault_point("serving/dispatch", ordinal)
         except faultinject.TransientFault:
@@ -704,6 +722,15 @@ class ServingEngine(ParallelInference):
                 out = out[:, :r.t_real]
             lats.append(t_done - r.t_enq)
             r.fut.set_result(NDArray(out))
+            # leg 4 (leg 3, the dispatch itself, is the profiler's
+            # serving/dispatch section — an X lane in the Chrome trace);
+            # guarded: per-request latency math + kwargs stay off the
+            # disabled hot path
+            if flightrec.enabled():
+                flightrec.event(
+                    "serving/reply", corr=f"req{r.seq}", req=int(r.seq),
+                    batch=ordinal,
+                    latency_ms=round((t_done - r.t_enq) * 1e3, 3))
         with self._lat_lock:
             self._latencies.extend(lats)
         prof.count("serving/requests", len(batch))
@@ -754,6 +781,9 @@ class ServingEngine(ParallelInference):
         bookkeeping (which fails whatever is queued if this was the LAST
         replica — bounded latency outranks transparency — and schedules
         resurrection)."""
+        flightrec.event("serving/retire", severity="warn",
+                        worker=worker_id, error=repr(exc)[:200],
+                        requeued=[int(r.seq) for r in batch])
         self._requeue(batch, exc)
         with self._lock:
             # free the dead worker's pinned-device slot for its

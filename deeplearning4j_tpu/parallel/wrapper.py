@@ -30,6 +30,7 @@ from jax.experimental.shard_map import shard_map
 
 import logging
 
+from ..common import flightrec
 from ..common.profiler import OpProfiler
 from ..data import pipeline as _pipe
 from ..data.dataset import DataSet
@@ -652,7 +653,9 @@ class ParallelWrapper:
             return []
         prof = OpProfiler.get()
         model = self.model
-        with prof.time_section("elastic/resize"):
+        with flightrec.span("elastic/resize", severity="warn",
+                            workers_from=old_n, workers_to=n, lost=lost), \
+                prof.time_section("elastic/resize"):
             # 1) host-materialize the training state with OWNING copies —
             # the compiled steps donate their argument buffers, and on
             # the CPU backend device_get returns zero-copy views (the
@@ -857,6 +860,9 @@ class ParallelWrapper:
         mask = (np.asarray(ds.labels_mask.to_numpy(), np.float32)
                 if ds.labels_mask is not None
                 else np.ones((x.shape[0],), np.float32))
+        # PerformanceListener derives samples/sec from this (the holder
+        # the listener bus sees is the wrapped model)
+        self.model._last_batch_size = int(x.shape[0])
         return x, y, mask, np.asarray(w, np.float32)
 
     def _dispatch_one(self, b, prof) -> None:

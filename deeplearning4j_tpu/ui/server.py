@@ -11,6 +11,10 @@ A stdlib ``http.server`` on a background thread serving:
 - ``/api/tags``         — JSON list of scalar tags across attached stores
 - ``/api/series?tag=t`` — JSON ``[[step, value], ...]`` for one tag
 - ``/healthz``          — liveness
+- ``/api/metrics``      — Prometheus text exposition of every profiler
+                          counter/gauge/ledger, serving latency
+                          quantiles, and the flight-recorder totals
+                          (:func:`prometheus_text`)
 - ``/api/infer``        — POST ``{"inputs": [[...], ...]}`` → the attached
                           :class:`parallel.serving.ServingEngine` (bucketed,
                           AOT-compiled, deadline-bounded); response carries
@@ -36,6 +40,99 @@ from urllib.parse import parse_qs, urlparse
 
 from .stats import (FileStatsStorage, InMemoryStatsStorage,
                     StatsStorage)
+
+
+def _prom_escape(value: Any) -> str:
+    return (str(value).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def prometheus_text() -> str:
+    """The ``GET /api/metrics`` payload: Prometheus text exposition
+    (format 0.0.4) of the whole observability surface — every
+    ``OpProfiler`` counter (and the gauge-set subset as real gauges),
+    every timing section, every derived ledger (``OpProfiler.LEDGERS`` —
+    the same list ``/api/health`` and ``print_statistics`` render), the
+    serving tier's rolling latency quantiles, and the flight recorder's
+    own totals. Label values carry the repo-internal slash-names
+    (``trace/mln_fit_step``) verbatim; metric names are fixed conformant
+    families, so any Prometheus scraper ingests this without config."""
+    from ..common import flightrec
+    from ..common.profiler import OpProfiler
+
+    prof = OpProfiler.get()
+    lines: List[str] = []
+
+    def family(name: str, mtype: str, help_text: str, samples) -> None:
+        samples = list(samples)
+        if not samples:
+            return
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for labels, value in samples:
+            if isinstance(value, float):
+                value = round(value, 9)
+            if labels:
+                lab = ",".join(f'{k}="{_prom_escape(v)}"'
+                               for k, v in sorted(labels.items()))
+                lines.append(f"{name}{{{lab}}} {value}")
+            else:
+                lines.append(f"{name} {value}")
+
+    counters = prof.get_counters()
+    gauges = prof.gauge_names()
+    family("dl4j_counter_total", "counter",
+           "OpProfiler event counters, labeled by counter name",
+           (({"name": k}, v) for k, v in sorted(counters.items())
+            if k not in gauges))
+    family("dl4j_gauge", "gauge",
+           "OpProfiler level gauges (absolute, last-write-wins)",
+           (({"name": k}, v) for k, v in sorted(counters.items())
+            if k in gauges))
+    sections = prof.get_statistics()
+    family("dl4j_section_seconds_total", "counter",
+           "cumulative wall time per OpProfiler section",
+           (({"section": k}, s["total_s"])
+            for k, s in sorted(sections.items())))
+    family("dl4j_section_count_total", "counter",
+           "entry count per OpProfiler section",
+           (({"section": k}, s["count"])
+            for k, s in sorted(sections.items())))
+    family("dl4j_section_max_seconds", "gauge",
+           "longest single entry per OpProfiler section",
+           (({"section": k}, s["max_s"])
+            for k, s in sorted(sections.items())))
+    ledger_samples = []
+    for label, stats in prof.ledger_stats().items():
+        for k, v in sorted(stats.items()):
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            ledger_samples.append(({"ledger": label, "key": k}, v))
+    family("dl4j_ledger", "gauge",
+           "derived ledger values (OpProfiler *_stats())", ledger_samples)
+    try:
+        from ..parallel.serving import serving_health
+
+        health = serving_health()
+    except Exception:          # serving tier absent/unimportable: no rows
+        health = {}
+    family("dl4j_serving_latency_ms", "gauge",
+           "rolling serving latency quantiles across live engines",
+           ((({"quantile": q}, health[key]))
+            for q, key in (("0.5", "latency_p50_ms"),
+                           ("0.99", "latency_p99_ms")) if key in health))
+    fr = flightrec.stats()
+    family("dl4j_flightrec_events_total", "counter",
+           "flight-recorder events ever appended", [({}, fr["events_total"])])
+    family("dl4j_flightrec_dropped_total", "counter",
+           "flight-recorder events evicted by ring overflow",
+           [({}, fr["dropped"])])
+    family("dl4j_flightrec_enabled", "gauge",
+           "1 when the flight recorder is recording",
+           [({}, int(fr["enabled"]))])
+    family("dl4j_flightrec_buffered", "gauge",
+           "events currently held in the ring", [({}, fr["buffered"])])
+    return "\n".join(lines) + "\n"
 
 
 class _JsonlTailCache:
@@ -329,6 +426,8 @@ class UIServer:
                 n += sum(1 for r in self._jsonl.read(p) if "value" in r)
             except (OSError, ValueError):
                 pass
+        from ..common import flightrec
+
         prof = OpProfiler.get()
         return {"status": "ok",
                 "uptime_s": round(time.time() - self._t0, 1),
@@ -341,6 +440,8 @@ class UIServer:
                 "collectives": prof.collective_stats(),
                 "precision": prof.precision_stats(),
                 "elastic": prof.elastic_stats(),
+                "tracecheck": prof.tracecheck_stats(),
+                "flightrec": flightrec.stats(),
                 "inference": pool_health(),
                 "serving": serving_health(),
                 **memory_summary()}
@@ -409,6 +510,9 @@ class UIServer:
                 elif u.path == "/api/health":
                     self._send(json.dumps(ui.health()).encode(),
                                "application/json")
+                elif u.path == "/api/metrics":
+                    self._send(prometheus_text().encode(),
+                               "text/plain; version=0.0.4; charset=utf-8")
                 elif u.path == "/api/tags":
                     self._send(json.dumps(ui.tags()).encode(),
                                "application/json")

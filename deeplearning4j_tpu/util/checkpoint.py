@@ -52,7 +52,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from ..common import faultinject
+from ..common import faultinject, flightrec
 from ..common.profiler import OpProfiler
 
 logger = logging.getLogger("deeplearning4j_tpu")
@@ -401,6 +401,11 @@ def commit_checkpoint(directory: str, tag: str, data: bytes,
                            incarnation=incarnation, state_dtype=state_dtype)
     prof.count("checkpoint/committed")
     prof.count("checkpoint/bytes", len(data))
+    # committed on the writer thread in the async path: the ambient
+    # correlation id (the supervisor's attempt) rides along, so the
+    # timeline shows WHICH attempt's save this durability point belongs to
+    flightrec.event("checkpoint/commit", tag=tag, file=name,
+                    iteration=int(iteration), bytes=len(data))
     return path
 
 
@@ -597,6 +602,9 @@ def restore_training_state(model, path: str, listeners=None,
         model._ckpt_workers = int(saved_workers)
         logger.info("checkpoint %s was taken under %d data-parallel "
                     "worker(s)", os.path.basename(path), saved_workers)
+    flightrec.event("checkpoint/restore", file=os.path.basename(path),
+                    epochs_done=int(cursor.get("epochs_done", 0)),
+                    steps_in_epoch=int(cursor.get("steps_in_epoch", 0)))
     return {"epochs_done": int(cursor.get("epochs_done", 0)),
             "steps_in_epoch": int(cursor.get("steps_in_epoch", 0))}
 

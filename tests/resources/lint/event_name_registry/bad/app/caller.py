@@ -1,0 +1,7 @@
+from flightrec import event, span
+
+
+def work(step, name):
+    event("pipeline/step", ordinal=step)
+    event("ui/typo_event", ordinal=step)     # finding: unregistered
+    span(name)                               # finding: non-literal

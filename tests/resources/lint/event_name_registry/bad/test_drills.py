@@ -1,0 +1,3 @@
+# reference corpus: only pipeline/step has a drill
+def test_step_emits():
+    assert "pipeline/step"

@@ -1,0 +1,5 @@
+from common import flightrec
+
+
+def work(step):
+    flightrec.event("pipeline/step", ordinal=step)

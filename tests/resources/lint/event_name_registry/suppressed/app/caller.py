@@ -1,0 +1,8 @@
+from flightrec import event
+
+
+def work(step):
+    event("pipeline/step", ordinal=step)
+    # graftlint: disable=event-name-registry -- vendor-prefixed event
+    # consumed by an external collector, deliberately outside the table
+    event("vendor/heartbeat", ordinal=step)

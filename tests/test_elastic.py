@@ -14,7 +14,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.common import faultinject
+from deeplearning4j_tpu.common import faultinject, flightrec
 from deeplearning4j_tpu.common.profiler import OpProfiler
 from deeplearning4j_tpu.data import NDArrayDataSetIterator
 from deeplearning4j_tpu.learning import Adam, Sgd
@@ -240,6 +240,13 @@ class TestResizeParity:
         it, ep = m1._iteration, m1._epoch
         removed = pw.resize(3, lost_replicas=[1])
         assert len(removed) == 1
+        # the resize is a span on the flight-recorder timeline, with the
+        # from/to counts a postmortem needs
+        ev = [e for e in flightrec.events("elastic/resize")
+              if e["ph"] == "B"][-1]
+        assert ev["attrs"]["workers_from"] == 4
+        assert ev["attrs"]["workers_to"] == 3
+        assert ev["attrs"]["lost"] == [1]
         pw.fit(make_iter(), epochs=3, resume_cursor=cursor)
 
         set_default_seed(99)

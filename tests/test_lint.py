@@ -37,6 +37,7 @@ RULES = {
     "retrace_hazard": "retrace-hazard",
     "lock_discipline": "lock-discipline",
     "fault_site_registry": "fault-site-registry",
+    "event_name_registry": "event-name-registry",
 }
 
 
@@ -57,8 +58,8 @@ class TestPackageClean:
         for f in result.suppressed:
             assert f.reason.strip(), f.render()
 
-    def test_six_rules_active(self):
-        assert len(graftlint.RULE_NAMES) >= 6
+    def test_seven_rules_active(self):
+        assert len(graftlint.RULE_NAMES) >= 7
         assert set(RULES.values()) <= set(graftlint.RULE_NAMES)
 
     # the PR-8 entry points, now shim-backed
@@ -103,7 +104,8 @@ class TestRuleFixtures:
         sin — a rule that collapses or explodes findings is broken."""
         expect = {"donation_alias": 4, "pallas_guard": 5,
                   "host_sync_in_step": 5, "retrace_hazard": 8,
-                  "lock_discipline": 3, "fault_site_registry": 5}
+                  "lock_discipline": 3, "fault_site_registry": 5,
+                  "event_name_registry": 5}
         for fixture, rule in RULES.items():
             res = graftlint.lint(os.path.join(FIXTURES, fixture, "bad"),
                                  [rule])
@@ -357,3 +359,22 @@ class TestFaultSiteRegistryLive:
         for site, meta in FAULT_SITES.items():
             assert meta["kinds"], site
             assert meta["drill"], site
+
+
+class TestEventSiteRegistryLive:
+    """The real flight-recorder registry (the package-clean test above
+    already proves emit-sites/docstring/corpus agree project-wide)."""
+
+    def test_registry_covers_every_docstring_event(self):
+        from deeplearning4j_tpu.common import flightrec
+
+        for name in flightrec.EVENT_SITES:
+            assert name in (flightrec.__doc__ or "")
+
+    def test_registry_entries_carry_desc_and_drill(self):
+        from deeplearning4j_tpu.common.flightrec import EVENT_SITES
+
+        assert len(EVENT_SITES) >= 20
+        for name, meta in EVENT_SITES.items():
+            assert meta["desc"], name
+            assert meta["drill"], name

@@ -14,7 +14,7 @@ import time
 import numpy as np
 import pytest
 
-from deeplearning4j_tpu.common import faultinject
+from deeplearning4j_tpu.common import faultinject, flightrec
 from deeplearning4j_tpu.common.profiler import OpProfiler
 from deeplearning4j_tpu.data import NDArrayDataSetIterator
 from deeplearning4j_tpu.learning import Sgd
@@ -150,6 +150,8 @@ class TestWatchdog:
         assert res.history[0]["class"] == CLASS_HANG
         assert [s for _, s in scores.scores] == base
         assert OpProfiler.get().supervisor_stats()["watchdog_fires"] == 1
+        # the watchdog verdict is on the flight-recorder timeline too
+        assert flightrec.events("supervisor/watchdog_fire")
 
     def test_hang_before_first_heartbeat(self, tmp_path):
         """The supervisor/hang drill site wedges the attempt before ANY
@@ -266,6 +268,11 @@ class TestPreemption:
             "checkpoint_preempt_")
         assert res.history[0]["class"] == CLASS_PREEMPTION
         assert OpProfiler.get().supervisor_stats()["preemptions"] == 1
+        # the preemption (and its resume point) is on the timeline, and
+        # the flush path left a black box beside the checkpoints
+        pre = flightrec.events("supervisor/preempted")
+        assert pre and pre[-1]["attrs"]["resume_from"] == res.resume_from
+        assert os.path.exists(sup.blackbox_path())
 
         # "new process": fresh model + listeners, resume="auto"
         set_default_seed(42)

@@ -1,6 +1,7 @@
 """The graftlint rule set — one module per shipped bug class."""
 
 from .donation_alias import DonationAliasRule
+from .event_registry import EventNameRegistryRule
 from .fault_registry import FaultSiteRegistryRule
 from .host_sync import HostSyncRule
 from .lock_discipline import LockDisciplineRule
@@ -12,7 +13,7 @@ def all_rules():
     """Fresh instances — rules may keep per-run state in finalize()."""
     return [DonationAliasRule(), PallasGuardRule(), HostSyncRule(),
             RetraceHazardRule(), LockDisciplineRule(),
-            FaultSiteRegistryRule()]
+            FaultSiteRegistryRule(), EventNameRegistryRule()]
 
 
 RULE_NAMES = [r.name for r in all_rules()]

@@ -1,0 +1,173 @@
+"""event-name-registry: flight-recorder event names audited end to end.
+
+``common/flightrec.py`` owns a central ``EVENT_SITES`` registry (event
+name -> description + the drill that proves it fires), mirroring
+faultinject's ``FAULT_SITES``. This rule closes the same loop
+project-wide for the event timeline:
+
+- every ``flightrec.event("name", ...)`` / ``flightrec.span("name", ...)``
+  call (module-attribute spelling, or the bare names when imported with
+  ``from ...flightrec import event, span``) must name a registered event
+  with a LITERAL string — a computed name cannot be audited;
+- every registered name must be emitted somewhere in the scanned tree
+  (a registry entry nothing emits is a timeline that silently stopped
+  existing);
+- every registered name must appear in the flightrec module docstring
+  (the human-readable table is generated-checked, not trusted);
+- every registered name must be referenced by at least one test or
+  bench file (the sibling ``tests/`` + ``bench.py`` corpus) — an event
+  no drill ever asserts on is dead observability.
+
+Completeness (the last three checks) runs only when the scan reaches
+BEYOND the registry module's own directory: a subtree scan of
+``common/`` alone sees the common-owned emit sites (profiler sections,
+fault firings, tracecheck violations) but not the rest of the package's,
+and must not report every other subsystem's names as dead. Per-call
+checks (unregistered / non-literal names) always run.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..engine import Finding, ModuleContext, Project, Rule, call_name
+
+_EMIT_FUNCS = ("event", "span")
+
+
+def _parse_registry(mod: ModuleContext) -> Optional[Dict[str, ast.AST]]:
+    """EVENT_SITES = {"name": {...}} at module level -> {name: key node}.
+    Accepts the plain and the annotated (``EVENT_SITES: Dict[...] =``)
+    assignment spellings."""
+    for node in mod.tree.body:
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target] if isinstance(node, ast.AnnAssign)
+                   else [])
+        if targets and \
+                any(isinstance(t, ast.Name) and t.id == "EVENT_SITES"
+                    for t in targets) and \
+                isinstance(getattr(node, "value", None), ast.Dict):
+            out: Dict[str, ast.AST] = {}
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    out[k.value] = k
+            return out
+    return None
+
+
+def _emit_aliases(mod: ModuleContext) -> Tuple[Set[str], Dict[str, str]]:
+    """(module aliases of flightrec, {bare function alias: event|span})."""
+    mod_aliases: Set[str] = set()
+    func_aliases: Dict[str, str] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == "flightrec":
+                    mod_aliases.add(alias.asname or "flightrec")
+                elif (node.module or "").split(".")[-1] == "flightrec" \
+                        and alias.name in _EMIT_FUNCS:
+                    func_aliases[alias.asname or alias.name] = alias.name
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.split(".")[-1] == "flightrec":
+                    mod_aliases.add(alias.asname
+                                    or alias.name.split(".")[0])
+    return mod_aliases, func_aliases
+
+
+class EventNameRegistryRule(Rule):
+    name = "event-name-registry"
+    description = ("every flightrec.event/span name literal and "
+                   "registered in common/flightrec.py EVENT_SITES; every "
+                   "registered name emitted, documented in the module "
+                   "docstring table and drilled (tests/bench)")
+    hint = ("add the name to EVENT_SITES (desc, drill) and the flightrec "
+            "docstring table; dead entries come out instead")
+
+    def finalize(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        reg_mod = project.module_named("flightrec.py")
+        if reg_mod is None or reg_mod.tree is None:
+            return findings          # nothing to check against
+        registry = _parse_registry(reg_mod)
+
+        calls: List[Tuple[ModuleContext, ast.Call, Optional[str]]] = []
+        for mod in project.modules:
+            if mod.tree is None or mod is reg_mod:
+                continue
+            mod_aliases, func_aliases = _emit_aliases(mod)
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                dn = call_name(node)
+                parts = dn.split(".")
+                is_emit = (len(parts) >= 2 and parts[-1] in _EMIT_FUNCS
+                           and parts[-2] in (mod_aliases | {"flightrec"})) \
+                    or (len(parts) == 1 and dn in func_aliases)
+                if not is_emit:
+                    continue
+                event_name: Optional[str] = None
+                if node.args and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    event_name = node.args[0].value
+                calls.append((mod, node, event_name))
+
+        if registry is None:
+            if calls:
+                findings.append(Finding(
+                    rule=self.name, path=reg_mod.path, line=1, col=0,
+                    message="flightrec module has no EVENT_SITES registry "
+                            "but event emissions exist",
+                    hint=self.hint))
+            return findings
+
+        seen: Dict[str, int] = {}
+        for mod, node, event_name in calls:
+            if event_name is None:
+                findings.append(self.finding(
+                    mod, node,
+                    "flight-recorder event emitted with a non-literal "
+                    "name — the registry cannot audit it",
+                    hint="pass the event name as a string literal"))
+                continue
+            seen[event_name] = seen.get(event_name, 0) + 1
+            if event_name not in registry:
+                findings.append(self.finding(
+                    mod, node,
+                    f"flight-recorder event name '{event_name}' is not "
+                    "registered in common.flightrec.EVENT_SITES"))
+
+        # registry completeness is a whole-package property — see the
+        # module docstring: only judged when the scan reaches beyond the
+        # registry module's own directory AND at least one emit exists
+        reg_dir = os.path.dirname(os.path.abspath(reg_mod.path))
+        beyond = any(
+            os.path.dirname(os.path.abspath(m.path)) != reg_dir
+            for m, _n, _e in calls)
+        if not seen or not beyond:
+            return findings
+
+        docstring = ast.get_docstring(reg_mod.tree) or ""
+        refs = project.reference_texts
+        for event_name, key_node in registry.items():
+            f_at = lambda msg: Finding(   # noqa: E731
+                rule=self.name, path=reg_mod.path,
+                line=getattr(key_node, "lineno", 1),
+                col=getattr(key_node, "col_offset", 0),
+                message=msg, hint=self.hint)
+            if event_name not in seen:
+                findings.append(f_at(
+                    f"registered event '{event_name}' is never emitted "
+                    "in the scanned tree"))
+            if event_name not in docstring:
+                findings.append(f_at(
+                    f"registered event '{event_name}' is missing from "
+                    "the flightrec module docstring table"))
+            if refs and not any(event_name in text
+                                for text in refs.values()):
+                findings.append(f_at(
+                    f"registered event '{event_name}' has no test or "
+                    "bench reference — no drill asserts it fires"))
+        return findings

@@ -30,6 +30,10 @@ SHARED_CLASSES: Dict[str, Dict[str, Set[str]]] = {
     # profiler ledgers: bumped from the training thread, the checkpoint
     # writer, inference workers and the telemetry drain alike
     "OpProfiler": {"locks": {"_lock"}, "allow": set()},
+    # flight recorder: every subsystem's threads append to the ring;
+    # the ambient correlation slot is written by the supervisor while
+    # the checkpoint writer reads it at event time
+    "FlightRecorder": {"locks": {"_lock"}, "allow": set()},
     # inference/serving pools: worker threads + callers + health probes.
     # ServingEngine splits its locking: _exec_lock guards the AOT
     # executable cache, _lat_lock the latency ring — both are owning
