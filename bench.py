@@ -137,6 +137,53 @@ def _summarize(metric: str, times, batch: int, flops_per_step, platform: str,
     return result
 
 
+def _ab_rounds(timed_epoch, rounds: int = 6):
+    """Interleaved A/B rounds with alternating order (time-correlated
+    host-load drift hits both halves of each pair equally); returns
+    per-config times and per-round on/off ratios."""
+    times = {"off": [], "on": []}
+    ratios = []
+    for r in range(rounds):
+        order = ("on", "off") if r % 2 == 0 else ("off", "on")
+        round_t = {name: timed_epoch(name) for name in order}
+        times["on"].append(round_t["on"])
+        times["off"].append(round_t["off"])
+        ratios.append(round_t["on"] / round_t["off"])
+    return times, ratios
+
+
+def _ab_overhead_gate(what: str, budget: float, run_rounds, fail):
+    """De-noised A/B overhead gate, shared by every overhead smoke
+    (telemetry/fault/supervisor/obs — ISSUE 11 satellite). The estimator
+    is the MIN over per-round on/off ratios: host-load noise on this box
+    can only INFLATE a ratio (the measured effects are small and
+    additive), so the min is the tightest honest bound — the same
+    estimator mfu-smoke already uses. A gate breach automatically
+    re-runs the WHOLE A/B pair once before hard-failing, and both
+    measurements are logged either way (in the emitted JSON on pass, in
+    the failure payload on fail). ``run_rounds() -> (times, ratios)``;
+    returns ``(overhead, times, runs)`` of the passing (or last) run."""
+    runs = []
+    for attempt in (1, 2):
+        times, ratios = run_rounds()
+        overhead = min(ratios) - 1.0
+        runs.append({"attempt": attempt,
+                     "overhead_frac": round(overhead, 4),
+                     "ratios": [round(r, 4) for r in ratios],
+                     "off_s": [round(t, 4) for t in times["off"]],
+                     "on_s": [round(t, 4) for t in times["on"]]})
+        if overhead <= budget:
+            return overhead, times, runs
+        if attempt == 1:
+            print(json.dumps({"warning": f"{what} overhead "
+                              f"{overhead:.1%} over the {budget:.0%} "
+                              f"budget — re-running the A/B pair once "
+                              f"before failing", "measurement": runs[-1]}),
+                  file=sys.stderr, flush=True)
+    fail(f"{what} overhead {overhead:.1%} exceeds the {budget:.0%} "
+         f"budget in both A/B runs", measurements=runs)
+
+
 def _resnet50_model(image_size: int = 224):
     """The flagship ResNet-50 exactly as benched (bf16 compute / fp32
     params) — shared by the throughput bench and the cold-start audit so
@@ -619,12 +666,16 @@ def bench_telemetry_smoke(steps: int, batch: int = 64,
       trace each step kind exactly once);
     - telemetry step-time overhead > 10%.
 
-    Timing methodology: the off/on epochs are INTERLEAVED round-robin and
-    compared by median, so host-load drift (this box swings >20%
-    run-to-run) hits both configs equally instead of masquerading as
-    telemetry overhead. The emitted JSON carries the overlap ledger and
-    the telemetry drain ledger (batched-readback time — the only host
-    sync telemetry pays)."""
+    Timing methodology (shared by every overhead smoke via
+    ``_ab_overhead_gate``): the off/on epochs are INTERLEAVED with
+    alternating order and the overhead estimator is the MIN over
+    per-round ratios, so host-load drift (this box swings >20%
+    run-to-run, and noise can only inflate a ratio) hits both configs
+    equally instead of masquerading as telemetry overhead; a gate breach
+    re-runs the whole A/B pair once, logging both measurements. The
+    emitted JSON carries the overlap ledger and the telemetry drain
+    ledger (batched-readback time — the only host sync telemetry
+    pays)."""
     import statistics as _stats
 
     import jax
@@ -667,32 +718,29 @@ def bench_telemetry_smoke(steps: int, batch: int = 64,
     from deeplearning4j_tpu.common import tracecheck
 
     prof.reset()
-    times = {"off": [], "on": []}
+
+    def timed_epoch(name):
+        model = models[name]
+        t0 = time.perf_counter()
+        model.fit(it, epochs=1, steps_per_dispatch=steps_per_dispatch)
+        float(model._score_dev)         # value fence
+        return time.perf_counter() - t0
+
     try:
         # the interleaved timed rounds are one steady-state region; the
         # telemetry drain's batched device_get cadence is data-dependent
         # by design, so host syncs are counted but not policed here
         with tracecheck.steady_state("telemetry-smoke timed rounds",
                                      max_host_syncs=None):
-            for _ in range(5):          # interleaved rounds
-                for name, model in models.items():
-                    t0 = time.perf_counter()
-                    model.fit(it, epochs=1,
-                              steps_per_dispatch=steps_per_dispatch)
-                    float(model._score_dev)     # value fence
-                    times[name].append(time.perf_counter() - t0)
+            overhead, times, overhead_runs = _ab_overhead_gate(
+                "telemetry step-time", 0.10,
+                lambda: _ab_rounds(timed_epoch, rounds=5), fail)
     except tracecheck.SteadyStateViolation as e:
         fail("train step retraced inside a timed window — telemetry or "
              "pipeline shape stability is broken",
              violation=str(e).splitlines()[0])
     t_off = _stats.median(times["off"])
     t_on = _stats.median(times["on"])
-    overhead = (t_on - t_off) / t_off
-    if overhead > 0.10:
-        fail(f"telemetry step-time overhead {overhead:.1%} exceeds the 10% "
-             "budget", off_s=round(t_off, 4), on_s=round(t_on, 4),
-             off_times=[round(t, 4) for t in times["off"]],
-             on_times=[round(t, 4) for t in times["on"]])
     if not storage.series("loss") \
             or not any(t.startswith("grad_norm/") for t in storage.tags()):
         fail("telemetry enabled but no grad-norm series reached the "
@@ -708,6 +756,7 @@ def bench_telemetry_smoke(steps: int, batch: int = 64,
         "platform": jax.devices()[0].platform,
         "traces": warm["on"],
         "telemetry_overhead_frac": round(overhead, 4),
+        "overhead_runs": overhead_runs,
         "epoch_s_off_median": round(t_off, 4),
         "epoch_s_on_median": round(t_on, 4),
         "overlap": {k: (round(v, 4) if isinstance(v, float) else v)
@@ -743,7 +792,8 @@ def bench_fault_smoke(steps: int, batch: int = 64,
     - injected transient fault not retried/recovered (retry counter must
       read exactly the injected count and training must complete);
     - async checkpointing step-time overhead > 10% vs checkpoint-off
-      (interleaved A/B medians, same methodology as telemetry-smoke).
+      (interleaved A/B min-over-ratios with one automatic re-run, the
+      shared ``_ab_overhead_gate`` methodology).
 
     Emits the checkpoint ledger (snapshot readback time — the only
     hot-loop cost — plus background write time and bytes) and the fault
@@ -802,10 +852,9 @@ def bench_fault_smoke(steps: int, batch: int = 64,
         # concurrent serialize/commit contention; the residual in-flight
         # tail is drained BETWEEN windows (untimed) so the "off" windows
         # stay clean. Host-load drift on this box is time-correlated and
-        # larger than the effect measured, so the estimator is the MEDIAN
-        # OF PER-ROUND RATIOS (each round pairs an on and an off epoch
-        # back-to-back, order alternating) after one untimed warmup
-        # round — the drift hits both halves of a pair equally.
+        # larger than the effect measured, so the shared
+        # _ab_overhead_gate estimator applies: interleaved rounds,
+        # min-over-ratios, one automatic A/B re-run before failing.
         def timed_epoch(name):
             t0 = time.perf_counter()
             models[name].fit(make_it(), epochs=1, batch_size=batch)
@@ -818,26 +867,15 @@ def bench_fault_smoke(steps: int, batch: int = 64,
         timed_epoch("on")                       # untimed settle-in round
         timed_epoch("off")
         prof.reset()
-        times = {"off": [], "on": []}
-        ratios = []
-        for r in range(6):
-            order = ("on", "off") if r % 2 == 0 else ("off", "on")
-            round_t = {name: timed_epoch(name) for name in order}
-            times["on"].append(round_t["on"])
-            times["off"].append(round_t["off"])
-            ratios.append(round_t["on"] / round_t["off"])
+        overhead, times, overhead_runs = _ab_overhead_gate(
+            "async checkpoint", 0.10,
+            lambda: _ab_rounds(timed_epoch, rounds=6), fail)
         hot = prof.trace_counts()
         if any(hot.values()):
             fail("train step retraced inside a timed window", traces=hot)
         ckpt_ledger = prof.checkpoint_stats()
         t_off = _stats.median(times["off"])
         t_on = _stats.median(times["on"])
-        overhead = _stats.median(ratios) - 1.0
-        if overhead > 0.10:
-            fail(f"async checkpoint overhead {overhead:.1%} exceeds the "
-                 "10% budget", off_s=round(t_off, 4), on_s=round(t_on, 4),
-                 off_times=[round(t, 4) for t in times["off"]],
-                 on_times=[round(t, 4) for t in times["on"]])
 
         # one injected transient input fault: retried, recovered, counted
         prof.reset()
@@ -919,6 +957,7 @@ def bench_fault_smoke(steps: int, batch: int = 64,
             "platform": jax.devices()[0].platform,
             "traces": warm["on"],
             "checkpoint_overhead_frac": round(overhead, 4),
+            "overhead_runs": overhead_runs,
             "epoch_s_off_median": round(t_off, 4),
             "epoch_s_on_median": round(t_on, 4),
             "checkpoint_ledger": {k: (round(v, 5) if isinstance(v, float)
@@ -951,8 +990,9 @@ def bench_supervisor_smoke(steps: int, batch: int = 64,
       (bit-identical float equality, CPU);
     - any retrace inside a timed no-fault window (supervision must not
       perturb the compile story);
-    - supervision overhead > 10% in the no-fault case (median of
-      per-round on/off ratios, same estimator as fault-smoke; the "on"
+    - supervision overhead > 10% in the no-fault case (min over
+      per-round on/off ratios with one automatic A/B re-run, the shared
+      ``_ab_overhead_gate`` estimator; the "on"
       window deliberately pays the supervisor's FULL per-fit cost —
       incarnation claim, anchor save_now, writer drain on close — and
       each timed window spans several epochs so that fixed per-fit cost
@@ -1042,26 +1082,15 @@ def bench_supervisor_smoke(steps: int, batch: int = 64,
         timed_epoch("on")
         timed_epoch("off")
         prof.reset()
-        times = {"off": [], "on": []}
-        ratios = []
-        for r in range(6):
-            order = ("on", "off") if r % 2 == 0 else ("off", "on")
-            round_t = {name: timed_epoch(name) for name in order}
-            times["on"].append(round_t["on"])
-            times["off"].append(round_t["off"])
-            ratios.append(round_t["on"] / round_t["off"])
+        overhead, times, overhead_runs = _ab_overhead_gate(
+            "supervision", 0.10,
+            lambda: _ab_rounds(timed_epoch, rounds=6), fail)
         hot = prof.trace_counts()
         if any(hot.values()):
             fail("train step retraced inside a timed window", traces=hot)
         ckpt_ledger = prof.checkpoint_stats()
         t_off = _stats.median(times["off"])
         t_on = _stats.median(times["on"])
-        overhead = _stats.median(ratios) - 1.0
-        if overhead > 0.10:
-            fail(f"supervision overhead {overhead:.1%} exceeds the 10% "
-                 "budget", off_s=round(t_off, 4), on_s=round(t_on, 4),
-                 on_times=[round(t, 4) for t in times["on"]],
-                 off_times=[round(t, 4) for t in times["off"]])
         off_ckpt.close()
 
         # injected restart: crash mid-epoch-2, supervisor heals, loss
@@ -1126,6 +1155,7 @@ def bench_supervisor_smoke(steps: int, batch: int = 64,
             "platform": jax.devices()[0].platform,
             "traces": warm["on"],
             "supervision_overhead_frac": round(overhead, 4),
+            "overhead_runs": overhead_runs,
             "epoch_s_off_median": round(t_off, 4),
             "epoch_s_on_median": round(t_on, 4),
             "supervisor_ledger": {k: (round(v, 5) if isinstance(v, float)
@@ -1979,6 +2009,416 @@ def bench_serving_smoke(steps: int, batch: int = 32,
     }
 
 
+def bench_autoscale_smoke(steps: int, batch: int = 32) -> dict:
+    """Overload-safe serving smoke (ISSUE 11; ROADMAP item 4): a diurnal
+    + spike traffic replay at >= 5x the serving-smoke rate over an
+    SLO-classed ServingEngine with the closed-loop autoscaler attached,
+    inside a ``tracecheck.steady_state`` region after warmup.
+    Self-validating hard-fails:
+
+    - **gold p99 within SLO through the spike**, with **sheds strictly
+      bottom-up by class**: zero gold sheds ever, batch sheds first (the
+      spike must actually shed — an un-overloaded "overload test"
+      measures nothing), every brownout level transition one step;
+    - **scale-up reacts** within SCALE_UP_GATE_S of the spike start
+      (read off the flight recorder's ``autoscale/scale`` events) and
+      **scale-down fires when idle** (fleet back at min within
+      SCALE_DOWN_GATE_S after the load stops) — zero process restarts;
+    - **recompiles stay at one-per-(bucket x replica count)**: the
+      trace counter is FLAT from warmup through every resize
+      (``serving/traces_after_warmup`` == 0);
+    - **canary -> promote** and **forced-violation -> rollback** drills
+      each leave a complete correlation chain in the flight recorder
+      (train-commit -> canary -> promote[/rollback] under one ``pub<N>``
+      id), the promote serves the checkpoint weights bitwise, the
+      rollback restores the prior params bitwise, and BOTH drills
+      complete with zero failed gold requests.
+
+    The spike's overload is made deterministic with an injected ``slow``
+    dispatch fault (+20ms per dispatch) — this box would otherwise
+    absorb 500 qps of toy-MLP traffic without ever shedding."""
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+
+    from deeplearning4j_tpu.common import faultinject, flightrec, tracecheck
+    from deeplearning4j_tpu.common.profiler import OpProfiler
+    from deeplearning4j_tpu.data import NDArrayDataSetIterator
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.optimize.listeners import CheckpointListener
+    from deeplearning4j_tpu.parallel import (AutoscalePolicy, Autoscaler,
+                                             Overloaded, ServingEngine,
+                                             SLOClass)
+    from deeplearning4j_tpu.parallel.serving import next_publication_ordinal
+    from deeplearning4j_tpu.util.checkpoint import (committed_checkpoints,
+                                                    read_checkpoint_params)
+
+    PEAK_QPS = 500.0            # 5x serving-smoke's 100-qps target
+    GOLD_SLO_P99_MS = 500.0     # the budget the brownout defends (CPU box)
+    SCALE_UP_GATE_S = 4.0
+    SCALE_DOWN_GATE_S = 15.0
+    REQ_ROWS_MAX = 8
+
+    def fail(msg, **extra):
+        faultinject.clear_plan()
+        print(json.dumps({"error": msg, **extra}))
+        sys.exit(1)
+
+    def build_model(seed):
+        conf = (NeuralNetConfiguration.builder().seed(seed)
+                .updater(Adam(1e-3)).activation("tanh").list()
+                .layer(L.DenseLayer(n_out=64))
+                .layer(L.DenseLayer(n_out=64))
+                .layer(L.OutputLayer(n_out=10))
+                .set_input_type(InputType.feed_forward(32)).build())
+        return MultiLayerNetwork(conf).init()
+
+    prof = OpProfiler.get()
+    prof.reset()
+    faultinject.clear_plan()
+    # the whole bench timeline in ONE ring: the correlation-chain gates
+    # grep it end to end, exactly like a real postmortem would
+    flightrec.configure(capacity=65536)
+    flightrec.reset()
+
+    # ---- train-commit leg: two committed checkpoints (compiles happen
+    # here, before the steady-state region) ------------------------------
+    ckdir = tempfile.mkdtemp(prefix="dl4j_autoscale_smoke_")
+    try:
+        trainee = build_model(seed=11)
+        rng = np.random.RandomState(0)
+        xs = rng.randn(8 * batch, 32).astype(np.float32)
+        ys = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8 * batch)]
+        cl = CheckpointListener(ckdir, save_every_n_iterations=4,
+                                keep_last=4)
+        trainee.set_listeners(cl)
+        trainee.fit(NDArrayDataSetIterator(xs, ys, batch_size=batch),
+                    epochs=2)
+        cl.close()
+        ckpts = committed_checkpoints(ckdir)
+        if len(ckpts) < 2:
+            fail("training produced fewer than 2 committed checkpoints",
+                 n=len(ckpts))
+        ck_promote, ck_rollback = ckpts[-2], ckpts[-1]
+
+        # ---- engine + autoscaler --------------------------------------
+        model = build_model(seed=7)
+        t_warm0 = time.perf_counter()
+        eng = (ServingEngine.Builder(model)
+               .buckets([1, 2, 4, 8, 16, batch]).input_shape((32,))
+               .workers(1).max_wait_ms(2.0).queue_limit(512)
+               .request_timeout_ms(15000)
+               .slo_classes([SLOClass("gold", 2, GOLD_SLO_P99_MS,
+                                      queue_budget=256),
+                             SLOClass("silver", 1, 800.0, queue_budget=64),
+                             SLOClass("batch", 0, 2000.0, queue_budget=64)])
+               .brownout(interval_s=0.1, depth_trigger=24, clear_ticks=5)
+               .queue_hwm_window(1.5)
+               .resurrect_dead_replicas(True, backoff_ms=100)
+               .build())
+        warmup_s = time.perf_counter() - t_warm0
+        traces_at_warmup = prof.counter_value("trace/serving_infer")
+        n_buckets = len(eng.ladder.batch_sizes)
+        if traces_at_warmup != n_buckets:
+            fail("warmup did not compile exactly one executable per "
+                 "bucket", traces=traces_at_warmup, buckets=n_buckets)
+        scaler = Autoscaler(eng, AutoscalePolicy(
+            min_workers=1, max_workers=4, interval_s=0.1,
+            up_queue_depth=8, up_p99_frac=0.8, down_queue_depth=0,
+            down_idle_s=0.8, down_fill_frac=0.25,
+            cooldown_up_s=0.4, cooldown_down_s=0.8)).start()
+
+        inputs = np.random.RandomState(1).randn(
+            REQ_ROWS_MAX, 32).astype(np.float32)
+        CLASS_MIX = ["batch"] * 5 + ["silver"] * 3 + ["gold"] * 2
+
+        def phase(n_requests, qps, seed):
+            """Open-loop Poisson replay of class-mixed 1-8-row requests.
+            Sheds resolve synchronously (Overloaded) and are counted per
+            class; admitted requests resolve via done-callbacks."""
+            r = np.random.RandomState(seed)
+            gaps = r.exponential(1.0 / qps, n_requests)
+            sizes = r.randint(1, REQ_ROWS_MAX + 1, n_requests)
+            classes = [CLASS_MIX[i] for i in r.randint(0, len(CLASS_MIX),
+                                                       n_requests)]
+            lat = {c: [] for c in ("gold", "silver", "batch")}
+            shed = {c: 0 for c in ("gold", "silver", "batch")}
+            failures = []
+            lock = threading.Lock()
+            done = threading.Semaphore(0)
+            admitted = 0
+            t0 = time.monotonic()
+            t_next = t0
+            for i in range(n_requests):
+                t_next += gaps[i]
+                delay = t_next - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                cls = classes[i]
+                try:
+                    fut = eng.output_async(inputs[:sizes[i]],
+                                           slo_class=cls)
+                except Overloaded:
+                    shed[cls] += 1
+                    continue
+                admitted += 1
+
+                def on_done(f, t_sub=t_next, c=cls):
+                    with lock:
+                        if f.exception() is not None:
+                            failures.append(f"{c}: {f.exception()}")
+                        else:
+                            lat[c].append(time.monotonic() - t_sub)
+                    done.release()
+
+                fut.add_done_callback(on_done)
+            for _ in range(admitted):
+                if not done.acquire(timeout=30):
+                    fail("load phase hung: requests never resolved",
+                         resolved=sum(len(v) for v in lat.values())
+                         + len(failures), of=admitted)
+            wall = time.monotonic() - t0
+            return {"lat": lat, "shed": shed, "failures": failures,
+                    "wall": wall, "n": n_requests, "admitted": admitted}
+
+        def p99_ms(lats):
+            return (float(np.percentile(np.asarray(lats) * 1e3, 99))
+                    if lats else 0.0)
+
+        # ---- the replay: one steady-state region after warmup ---------
+        try:
+            with tracecheck.steady_state("autoscale-smoke replay",
+                                         max_host_syncs=None):
+                # diurnal day: low -> mid -> low (the mid leg may already
+                # grow the fleet — that is the controller working)
+                diurnal = [phase(250, 50.0, seed=1),
+                           phase(450, 150.0, seed=2),
+                           phase(150, 50.0, seed=3)]
+                # diurnal night: traffic stops — the fleet must return
+                # to min (the first scale-down gate), which also resets
+                # the spike-reaction measurement to a 1-replica start
+                t_night0 = time.monotonic()
+                while time.monotonic() - t_night0 < SCALE_DOWN_GATE_S \
+                        and eng.alive_replicas() > 1:
+                    time.sleep(0.2)
+                night_scale_down_s = time.monotonic() - t_night0
+                if eng.alive_replicas() != 1:
+                    fail("fleet did not scale down to min during the "
+                         "idle night", alive=eng.alive_replicas(),
+                         ledger=prof.autoscale_stats())
+                # spike at 5x serving-smoke, overload made deterministic
+                faultinject.set_plan(faultinject.FaultPlan(
+                    [{"site": "serving/dispatch", "kind": "slow",
+                      "seconds": 0.02, "times": 10 ** 6}]))
+                t_spike = time.monotonic()
+                spike = phase(int(5 * PEAK_QPS), PEAK_QPS, seed=4)
+                faultinject.clear_plan()
+        except tracecheck.SteadyStateViolation as e:
+            fail("serving retraced/compiled inside the replay — the "
+                 "compile-once contract broke under resize or shed",
+                 violation=str(e).splitlines()[0])
+
+        # ---- SLO + shed-order gates -----------------------------------
+        for name, ph in [("diurnal-low", diurnal[0]),
+                         ("diurnal-mid", diurnal[1]),
+                         ("diurnal-low2", diurnal[2]),
+                         ("spike", spike)]:
+            if ph["failures"]:
+                fail(f"{name} phase had failed requests",
+                     n=len(ph["failures"]), first=ph["failures"][0])
+            if ph["shed"]["gold"] != 0:
+                fail(f"{name} phase shed gold requests — shed order is "
+                     "not bottom-up", shed=ph["shed"])
+        if prof.counter_value("serving/shed/gold") != 0:
+            fail("gold sheds counted in the ledger",
+                 n=prof.counter_value("serving/shed/gold"))
+        if spike["shed"]["batch"] == 0:
+            fail("the spike never shed batch-class traffic — no overload "
+                 "was exercised", shed=spike["shed"],
+                 qps=round(spike["n"] / spike["wall"], 1))
+        shed_events = flightrec.events("serving/shed")
+        for e in shed_events:
+            # lowest-class-first is a SET property of every level: no
+            # transition may ever shed silver while batch is admitted
+            if "silver" in e["attrs"]["shed"] \
+                    and "batch" not in e["attrs"]["shed"]:
+                fail("a brownout level shed silver while batch was "
+                     "still admitted — not lowest-class-first",
+                     transition=e["attrs"])
+        levels = [e["attrs"]["level"] for e in shed_events]
+        prevs = [e["attrs"]["prev"] for e in shed_events]
+        if not levels:
+            fail("no serving/shed level transitions recorded")
+        if any(abs(lv - pv) != 1 for lv, pv in zip(levels, prevs)):
+            fail("brownout level jumped more than one step",
+                 transitions=list(zip(prevs, levels)))
+        first_shed = next(e for e in shed_events
+                          if e["attrs"]["level"] > e["attrs"]["prev"])
+        if first_shed["attrs"]["shed"] != ["batch"]:
+            fail("first brownout step did not shed exactly the batch "
+                 "class", shed=first_shed["attrs"]["shed"])
+        spike_qps = spike["n"] / spike["wall"]
+        if spike_qps < 0.9 * PEAK_QPS:
+            fail(f"open-loop generator fell behind: {spike_qps:.0f} qps "
+                 f"vs target {PEAK_QPS:.0f}", wall_s=round(spike["wall"], 2))
+        gold_spike_p99 = p99_ms(spike["lat"]["gold"])
+        if gold_spike_p99 > GOLD_SLO_P99_MS:
+            fail(f"gold p99 {gold_spike_p99:.1f}ms violated the "
+                 f"{GOLD_SLO_P99_MS:.0f}ms SLO through the spike",
+                 gold_requests=len(spike["lat"]["gold"]))
+
+        # ---- autoscale reaction gates ---------------------------------
+        scale_ups = [e for e in flightrec.events("autoscale/scale")
+                     if e["attrs"]["to"] > e["attrs"]["frm"]
+                     and e["m"] >= t_spike]
+        if not scale_ups:
+            fail("the autoscaler never scaled up during the spike",
+                 alive=eng.alive_replicas(),
+                 ledger=prof.autoscale_stats())
+        scale_up_latency = scale_ups[0]["m"] - t_spike
+        if scale_up_latency > SCALE_UP_GATE_S:
+            fail(f"scale-up reacted in {scale_up_latency:.1f}s — over "
+                 f"the {SCALE_UP_GATE_S}s gate")
+        replicas_peak = max(e["attrs"]["to"] for e in scale_ups)
+        t_idle0 = time.monotonic()
+        while time.monotonic() - t_idle0 < SCALE_DOWN_GATE_S:
+            if eng.alive_replicas() == 1:
+                break
+            time.sleep(0.2)
+        scale_down_s = time.monotonic() - t_idle0
+        if eng.alive_replicas() != 1:
+            fail(f"scale-down did not return the fleet to min within "
+                 f"{SCALE_DOWN_GATE_S}s of going idle",
+                 alive=eng.alive_replicas(),
+                 ledger=prof.autoscale_stats())
+        if prof.counter_value("autoscale/scale_downs") < 1:
+            fail("no scale-down was ever counted",
+                 ledger=prof.autoscale_stats())
+
+        # ---- recompile gate -------------------------------------------
+        traces = prof.counter_value("trace/serving_infer")
+        if traces != traces_at_warmup:
+            fail("serving traced after warmup across resizes",
+                 warmup=traces_at_warmup, now=traces)
+        if prof.counter_value("serving/traces_after_warmup"):
+            fail("serving/traces_after_warmup is non-zero",
+                 n=prof.counter_value("serving/traces_after_warmup"))
+
+        # ---- canary -> promote drill ----------------------------------
+        gold_x = inputs[:2]
+
+        def gold_load_until(handle):
+            failures = []
+            while not handle.done:
+                try:
+                    eng.output(gold_x, slo_class="gold")
+                except Exception as e:      # census, not control flow
+                    failures.append(str(e))
+            return failures
+
+        h1 = eng.publish_checkpoint(ck_promote, canary_window_s=0.8,
+                                    confirm_window_s=0.8,
+                                    check_interval_s=0.1)
+        gold_failures = gold_load_until(h1)
+        if h1.result(timeout=15) != "promoted" or gold_failures:
+            fail("canary->promote drill failed",
+                 outcome=h1.phase, gold_failures=gold_failures[:3])
+        want = jax.tree.leaves(read_checkpoint_params(
+            ck_promote, model._params, model._states))
+        got = jax.tree.leaves(eng._dev_params[0])
+        if not all(np.array_equal(np.asarray(g), np.asarray(w))
+                   for g, w in zip(got, want)):
+            fail("promoted fleet params are not bitwise the checkpoint's")
+        chain1 = [e["name"] for e in flightrec.events(corr=h1.corr)]
+        commit_files = {e["attrs"].get("file")
+                        for e in flightrec.events("checkpoint/commit")}
+        if os.path.basename(ck_promote) not in commit_files:
+            fail("train-commit leg missing from the recorder",
+                 commits=sorted(commit_files))
+        if not ("serving/canary" in chain1 and "serving/promote" in chain1
+                and chain1.index("serving/canary")
+                < chain1.index("serving/promote")):
+            fail("promote correlation chain incomplete", chain=chain1,
+                 corr=h1.corr)
+
+        # ---- forced-violation -> rollback drill -----------------------
+        prior = [np.array(a) for a in jax.tree.leaves(eng._dev_params[0])]
+        ordinal = next_publication_ordinal()
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "serving/promote", "kind": "transient",
+              "index": ordinal}]))
+        h2 = eng.publish_checkpoint(ck_rollback, canary_window_s=0.5,
+                                    confirm_window_s=5.0,
+                                    check_interval_s=0.1)
+        gold_failures = gold_load_until(h2)
+        faultinject.clear_plan()
+        if h2.result(timeout=15) != "rolled_back" or gold_failures:
+            fail("forced-violation drill did not roll back cleanly",
+                 outcome=h2.phase, gold_failures=gold_failures[:3])
+        after = [np.array(a) for a in jax.tree.leaves(eng._dev_params[0])]
+        if not all(np.array_equal(a, b) for a, b in zip(after, prior)):
+            fail("rollback did not restore the prior params bitwise")
+        chain2 = [e["name"] for e in flightrec.events(corr=h2.corr)]
+        if not ("serving/canary" in chain2 and "serving/promote" in chain2
+                and "serving/rollback" in chain2):
+            fail("rollback correlation chain incomplete", chain=chain2,
+                 corr=h2.corr)
+        if prof.counter_value("serving/shed/gold") != 0:
+            fail("gold sheds during the canary drills",
+                 n=prof.counter_value("serving/shed/gold"))
+
+        serving_ledger = prof.serving_stats()
+        autoscale_ledger = prof.autoscale_stats()
+        scaler.stop()
+        eng.shutdown()
+        return {
+            "metric": "autoscale_smoke",
+            "value": spike_qps,
+            "unit": "req/sec",
+            "platform": jax.devices()[0].platform,
+            "peak_qps_target": PEAK_QPS,
+            "gold_slo_p99_ms": GOLD_SLO_P99_MS,
+            "gold_spike_p99_ms": round(gold_spike_p99, 2),
+            "gold_diurnal_p99_ms": round(
+                p99_ms([v for ph in diurnal
+                        for v in ph["lat"]["gold"]]), 2),
+            "spike_shed": spike["shed"],
+            "diurnal_shed": {c: sum(ph["shed"][c] for ph in diurnal)
+                             for c in ("gold", "silver", "batch")},
+            "brownout_transitions": list(zip(prevs, levels)),
+            "scale_up_latency_s": round(scale_up_latency, 2),
+            "scale_up_gate_s": SCALE_UP_GATE_S,
+            "night_scale_down_s": round(night_scale_down_s, 2),
+            "scale_down_s": round(scale_down_s, 2),
+            "replicas_peak": replicas_peak,
+            "canary_promote": {"corr": h1.corr, "outcome": "promoted",
+                               "file": os.path.basename(ck_promote)},
+            "canary_rollback": {"corr": h2.corr, "outcome": "rolled_back",
+                                "file": os.path.basename(ck_rollback)},
+            "warmup_s": round(warmup_s, 3),
+            "traces": traces,
+            "serving_ledger": {k: (round(v, 5) if isinstance(v, float)
+                                   else v)
+                               for k, v in serving_ledger.items()
+                               if isinstance(v, (int, float))},
+            "autoscale_ledger": autoscale_ledger,
+            "data": "diurnal+spike open-loop Poisson replay of class-"
+                    "mixed 1-8-row requests at 5x serving-smoke rate; "
+                    "hard gates on gold SLO, bottom-up sheds, scale "
+                    "up/down latency, flat recompiles, canaried "
+                    "promote/rollback correlation chains",
+        }
+    finally:
+        faultinject.clear_plan()
+        flightrec.configure(capacity=4096)
+        shutil.rmtree(ckdir, ignore_errors=True)
+
+
 def bench_obs_smoke(steps: int, batch: int = 64) -> dict:
     """CPU-friendly smoke of the observability layer (ISSUE 10). Three
     self-validating phases, every gate a hard fail:
@@ -1991,8 +2431,9 @@ def bench_obs_smoke(steps: int, batch: int = 64) -> dict:
        JSONL beside the checkpoints must reconstruct the
        fault → classify → restart → resume chain.
     2. **Interleaved A/B overhead** (recorder off vs on) inside a
-       ``tracecheck.steady_state`` region: median recorder-on step-time
-       overhead > 5% fails, any retrace delta fails.
+       ``tracecheck.steady_state`` region: recorder-on step-time
+       overhead > 5% (min-over-ratios, one automatic A/B re-run — the
+       shared ``_ab_overhead_gate``) fails, any retrace delta fails.
     3. **``/api/metrics``** must parse as Prometheus text exposition
        (TYPE-before-samples, well-formed sample lines) and carry the
        counter/ledger/flight-recorder families.
@@ -2097,19 +2538,22 @@ def bench_obs_smoke(steps: int, batch: int = 64) -> dict:
         m.fit(make_it(), epochs=1)
         float(m._score_dev)
     prof.reset()
-    times = {"off": [], "on": []}
+
+    def timed_epoch(name):
+        m = models[name]
+        flightrec.configure(enabled=(name == "on"))
+        t0 = time.perf_counter()
+        m.fit(make_it(), epochs=1)
+        float(m._score_dev)         # value fence
+        return time.perf_counter() - t0
+
     try:
         with tracecheck.steady_state("obs-smoke timed rounds",
                                      max_host_syncs=None):
-            for _ in range(5):
-                for name, m in models.items():
-                    flightrec.configure(enabled=(name == "on"))
-                    t0 = time.perf_counter()
-                    m.fit(make_it(), epochs=1)
-                    float(m._score_dev)     # value fence
-                    times[name].append(time.perf_counter() - t0)
+            overhead, times, overhead_runs = _ab_overhead_gate(
+                "flight-recorder", 0.05,
+                lambda: _ab_rounds(timed_epoch, rounds=5), fail)
     except tracecheck.SteadyStateViolation as e:
-        flightrec.configure(enabled=True)
         fail("train step retraced inside a timed window — the recorder "
              "must not destabilize shapes",
              violation=str(e).splitlines()[0])
@@ -2117,12 +2561,6 @@ def bench_obs_smoke(steps: int, batch: int = 64) -> dict:
         flightrec.configure(enabled=True)
     t_off = _stats.median(times["off"])
     t_on = _stats.median(times["on"])
-    overhead = (t_on - t_off) / t_off
-    if overhead > 0.05:
-        fail(f"flight-recorder overhead {overhead:.1%} exceeds the 5% "
-             "budget", off_s=round(t_off, 4), on_s=round(t_on, 4),
-             off_times=[round(t, 4) for t in times["off"]],
-             on_times=[round(t, 4) for t in times["on"]])
 
     # ---- phase 3: /api/metrics conformance -----------------------------
     ui = UIServer()
@@ -2163,6 +2601,7 @@ def bench_obs_smoke(steps: int, batch: int = 64) -> dict:
         "batch": batch,
         "platform": jax.devices()[0].platform,
         "recorder_overhead_frac": round(overhead, 4),
+        "overhead_runs": overhead_runs,
         "epoch_s_off_median": round(t_off, 4),
         "epoch_s_on_median": round(t_on, 4),
         "drill_restarts": res.restarts,
@@ -2460,8 +2899,8 @@ def main() -> None:
                                  "pipeline-smoke", "telemetry-smoke",
                                  "fault-smoke", "supervisor-smoke",
                                  "zero1-smoke", "elastic-smoke",
-                                 "serving-smoke", "mfu-smoke",
-                                 "obs-smoke"])
+                                 "serving-smoke", "autoscale-smoke",
+                                 "mfu-smoke", "obs-smoke"])
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--batch", type=int, default=None,
                         help="per-config default: resnet50=128, bert=32")
@@ -2571,6 +3010,8 @@ def main() -> None:
         result = bench_elastic_smoke(steps, batch=args.batch or 64)
     elif args.config == "serving-smoke":
         result = bench_serving_smoke(steps, batch=args.batch or 32)
+    elif args.config == "autoscale-smoke":
+        result = bench_autoscale_smoke(steps, batch=args.batch or 32)
     elif args.config == "obs-smoke":
         result = bench_obs_smoke(steps, batch=args.batch or 64)
     elif args.config == "resnet50-disk":

@@ -13,7 +13,8 @@ them a *deterministic, step-indexed* event:
   ``"train/wedge"``, ``"device/loss"``, ``"supervisor/hang"``,
   ``"checkpoint/pre_rename"``, ``"inference/worker"``,
   ``"inference/probe"``, ``"elastic/probe"``, ``"serving/enqueue"``,
-  ``"serving/dispatch"``) and a zero-based
+  ``"serving/dispatch"``, ``"serving/admission"``,
+  ``"autoscale/decide"``, ``"serving/promote"``) and a zero-based
   INDEX at that site (batch ordinal within a fit call, checkpoint commit
   sequence, inference request ordinal, supervisor attempt/probe ordinal,
   serving request ordinal at enqueue / serving batch ordinal at
@@ -90,6 +91,17 @@ serving/enqueue       transient, slow         test_serving admission drills
 serving/dispatch      slow, transient,        test_serving wedged-dispatch /
                       dead_replica            requeue / kill drills;
                                               serving-smoke kill drill
+serving/admission     transient, slow         test_autoscale deterministic
+                                              429 shed drill (transient =
+                                              this request is shed; slow =
+                                              admission decision stalls)
+autoscale/decide      transient               test_autoscale skipped-tick
+                                              drill (one controller tick
+                                              fails, loop carries on)
+serving/promote       transient               test_autoscale / autoscale-
+                                              smoke forced-violation drill
+                                              (promoted weights "violate"
+                                              -> bitwise auto-rollback)
 ====================  ======================  ==============================
 """
 
@@ -150,6 +162,16 @@ FAULT_SITES = {
     "serving/dispatch": {
         "kinds": ("slow", "transient", "dead_replica"),
         "drill": "test_serving wedge/requeue/kill; serving-smoke"},
+    "serving/admission": {
+        "kinds": ("transient", "slow"),
+        "drill": "test_autoscale deterministic-429 shed drill"},
+    "autoscale/decide": {
+        "kinds": ("transient",),
+        "drill": "test_autoscale skipped-tick drill"},
+    "serving/promote": {
+        "kinds": ("transient",),
+        "drill": "test_autoscale forced-violation rollback; "
+                 "autoscale-smoke"},
 }
 
 

@@ -89,6 +89,17 @@ serving/batch              info        continuous-batching batch formed
                                        (request ids listed)
 serving/reply              info        per-request completion + latency
 serving/retire             warn        serving replica retirement
+serving/shed               warn        brownout shed-level change
+                                       (per transition, never per
+                                       request); test_autoscale
+serving/canary             info        candidate weights on the canary
+                                       replica (corr ``pub<N>``)
+serving/promote            info        canary promoted fleet-wide
+serving/rollback           warn        violation rollback (prior params
+                                       restored bitwise)
+autoscale/decide           warn        span around one autoscale
+                                       decision, signals as attrs
+autoscale/scale            info        replica-count change actuated
 inference/resurrected      info        replica resurrection landing
 tracecheck/violation       error       steady-state region tripped
 profiler/section           info        OpProfiler.time_section duration
@@ -171,6 +182,24 @@ EVENT_SITES: Dict[str, Dict[str, str]] = {
     "serving/retire": {
         "desc": "serving replica retired mid-load (batch requeued)",
         "drill": "test_observability serving kill drill"},
+    "serving/shed": {
+        "desc": "brownout shed-level change (classes shed, reason)",
+        "drill": "test_autoscale brownout drills; autoscale-smoke"},
+    "serving/canary": {
+        "desc": "candidate weights landed on the canary replica",
+        "drill": "test_autoscale canary drills; autoscale-smoke"},
+    "serving/promote": {
+        "desc": "canary promoted fleet-wide after an SLO-clean window",
+        "drill": "test_autoscale canary drills; autoscale-smoke"},
+    "serving/rollback": {
+        "desc": "violation rollback restored the prior params bitwise",
+        "drill": "test_autoscale rollback drill; autoscale-smoke"},
+    "autoscale/decide": {
+        "desc": "span around one scale decision (input signals as attrs)",
+        "drill": "test_autoscale controller drills; autoscale-smoke"},
+    "autoscale/scale": {
+        "desc": "replica-count change actuated (from, to, reason)",
+        "drill": "test_autoscale controller drills; autoscale-smoke"},
     "inference/resurrected": {
         "desc": "a retired replica's replacement joined the pool",
         "drill": "test_observability serving kill drill"},

@@ -38,6 +38,7 @@ class OpProfiler:
         ("collectives", "collective_stats"),
         ("elastic", "elastic_stats"),
         ("serving", "serving_stats"),
+        ("autoscale", "autoscale_stats"),
         ("precision", "precision_stats"),
         ("tracecheck", "tracecheck_stats"),
         ("faults", "fault_stats"),
@@ -299,6 +300,16 @@ class OpProfiler:
                 out[key] = s["total_s"]
                 out[key.replace("_s", "_count")] = s["count"]
         return out
+
+    def autoscale_stats(self) -> Dict[str, float]:
+        """Closed-loop autoscaler ledger (``autoscale/*`` counters):
+        controller ticks, scale-ups/downs actuated, held decisions,
+        skipped (drilled) evaluations, and the live ``replicas`` gauge —
+        the /api/health and autoscale-smoke view of what the controller
+        actually did. Empty until an :class:`parallel.autoscale.
+        Autoscaler` ticks."""
+        return {k.split("/", 1)[1]: v for k, v in self._counters.items()
+                if k.startswith("autoscale/")}
 
     def precision_stats(self) -> Dict[str, float]:
         """Mixed-precision ledger (``precision/*`` counters): fused

@@ -10,7 +10,9 @@ from .sharding import (tp_param_specs, tp_shardings, apply_tp, Zero1Plan,
                        unflatten_updater_state)
 from .inference import ParallelInference
 from .serving import (ServingEngine, BucketLadder, OversizeRequest,
-                      serving_health)
+                      Overloaded, SLOClass, AdmissionController,
+                      BrownoutController, PublishHandle, serving_health)
+from .autoscale import Autoscaler, AutoscalePolicy
 from .distributed import (SharedTrainingMaster, TrainingSupervisor,
                           SupervisedFitResult, RestartBudgetExceeded,
                           RestartStorm, Preempted, HangDetected,

@@ -1,0 +1,255 @@
+"""Closed-loop serving autoscaler (ISSUE 11; ROADMAP item 4).
+
+PR 6 made the worker set elastic (online resize, no process restart) and
+PR 7's serving tier already EXPORTS every signal a controller needs —
+queue-depth high-water, rolling p99, bucket fill ratio. This module
+closes the loop: a controller thread samples those signals at a fixed
+cadence and drives :meth:`ServingEngine.scale_to` — replicas grow on a
+traffic spike and shrink when idle, with cooldowns and min/max bounds so
+the controller itself cannot oscillate the fleet. Zero process restarts:
+scale-up spawns drain threads against the already-compiled AOT bucket
+executables (recompiles stay at one per bucket x device slot at ANY
+replica count), scale-down retires surplus workers at a batch boundary.
+
+Control law (:meth:`Autoscaler.decide` — a pure function, so tests and
+drills exercise it without threads):
+
+- **Scale UP** when the decaying/windowed queue-depth HWM crosses
+  ``up_queue_depth`` OR recent p99 crosses ``up_p99_frac`` x the top SLO
+  class's budget (latency pressure before the queue visibly backs up),
+  stepping ``step`` replicas toward ``max_workers``, at most once per
+  ``cooldown_up_s``.
+- **Scale DOWN** one replica toward ``min_workers`` when the windowed
+  HWM has decayed to ``down_queue_depth`` AND the engine has been idle
+  ``down_idle_s`` (or the recent bucket fill ratio sits under
+  ``down_fill_frac`` — capacity provably exceeds demand), at most once
+  per ``cooldown_down_s`` and never within ``cooldown_down_s`` of a
+  scale-up (a spike's tail must not trigger an immediate shrink).
+
+Every scale decision is a flight-recorder ``autoscale/decide`` span
+carrying its INPUT SIGNALS as attrs (the incident-reconstruction
+contract: why did the fleet grow at 14:03?) plus an ``autoscale/scale``
+instant with from/to/reason; held ticks are counters only. The
+``autoscale/decide`` fault site makes a failed controller evaluation a
+deterministic drill — a transient there skips one tick (counted), it
+never kills the loop. State is exported three ways: ``autoscale/*``
+counters + the ``autoscale/replicas`` gauge (Prometheus ``/api/metrics``
+via the profiler's ledger list), ``profiler.autoscale_stats()``
+(``/api/health``), and :meth:`Autoscaler.stats`.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ..common import faultinject, flightrec
+from ..common.profiler import OpProfiler
+from .mesh import serving_capacity
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class AutoscalePolicy:
+    """Bounds and thresholds for the control law (module docstring).
+    ``max_workers`` defaults to 2x the device count
+    (:func:`mesh.serving_capacity`) — beyond that, replicas only contend
+    for XLA streams that are already saturated."""
+
+    def __init__(self, min_workers: int = 1,
+                 max_workers: Optional[int] = None,
+                 interval_s: float = 0.25,
+                 up_queue_depth: int = 8,
+                 up_p99_frac: float = 0.8,
+                 down_queue_depth: int = 0,
+                 down_idle_s: float = 2.0,
+                 down_fill_frac: float = 0.25,
+                 cooldown_up_s: float = 1.0,
+                 cooldown_down_s: float = 3.0,
+                 step: int = 1):
+        self.min_workers = max(1, int(min_workers))
+        self.max_workers = (int(max_workers) if max_workers is not None
+                            else 2 * serving_capacity())
+        if self.max_workers < self.min_workers:
+            raise ValueError(
+                f"max_workers {self.max_workers} < min_workers "
+                f"{self.min_workers}")
+        self.interval_s = max(0.01, float(interval_s))
+        self.up_queue_depth = int(up_queue_depth)
+        self.up_p99_frac = float(up_p99_frac)
+        self.down_queue_depth = int(down_queue_depth)
+        self.down_idle_s = float(down_idle_s)
+        self.down_fill_frac = float(down_fill_frac)
+        self.cooldown_up_s = float(cooldown_up_s)
+        self.cooldown_down_s = float(cooldown_down_s)
+        self.step = max(1, int(step))
+
+
+class Autoscaler:
+    """The controller: samples the engine's load signals every
+    ``policy.interval_s`` and actuates ``engine.scale_to``. ``start()``
+    runs it on a daemon thread; ``tick()`` is public so drills and tests
+    drive single deterministic evaluations."""
+
+    def __init__(self, engine, policy: Optional[AutoscalePolicy] = None):
+        self.engine = engine
+        self.policy = policy or AutoscalePolicy()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._ticks = 0
+        self._last_up_t: Optional[float] = None
+        self._last_down_t: Optional[float] = None
+        self._prev_rows = 0
+        self._prev_cap = 0
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "Autoscaler":
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="dl4j-autoscaler")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+        with self._lock:
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.policy.interval_s):
+            if getattr(self.engine, "_shutdown", False):
+                return
+            try:
+                self.tick()
+            except Exception:
+                OpProfiler.get().count("autoscale/decide_errors")
+                logger.warning("autoscale tick failed", exc_info=True)
+
+    # -- signals ---------------------------------------------------------
+    def _signals(self) -> Dict[str, Any]:
+        eng = self.engine
+        prof = OpProfiler.get()
+        rows = prof.counter_value("serving/rows")
+        cap = prof.counter_value("serving/capacity_rows")
+        with self._lock:
+            d_rows = rows - self._prev_rows
+            d_cap = cap - self._prev_cap
+            self._prev_rows = rows
+            self._prev_cap = cap
+        top_budget = None
+        adm = getattr(eng, "_adm", None)
+        if adm is not None:
+            top_budget = adm.top.p99_ms
+        return {
+            "alive": eng.alive_replicas(),
+            "queue_hwm": eng.queue_depth_hwm(),
+            "p99_ms": eng.recent_p99_ms(),
+            "top_budget_ms": top_budget,
+            "idle_s": eng.idle_seconds(),
+            "fill_ratio": (d_rows / d_cap) if d_cap else None,
+        }
+
+    # -- control law -----------------------------------------------------
+    def decide(self, sig: Dict[str, Any], now: Optional[float] = None
+               ) -> Dict[str, Any]:
+        """The pure control law: signals -> {"target", "reason"}. A
+        target equal to ``sig["alive"]`` means hold. Cooldown state is
+        read but not written — :meth:`tick` commits it when it actuates."""
+        p = self.policy
+        now = time.monotonic() if now is None else now
+        alive = sig["alive"]
+        with self._lock:
+            last_up, last_down = self._last_up_t, self._last_down_t
+        hot_queue = sig["queue_hwm"] >= p.up_queue_depth
+        hot_p99 = (sig["p99_ms"] is not None
+                   and sig["top_budget_ms"] is not None
+                   and sig["p99_ms"] >= p.up_p99_frac
+                   * sig["top_budget_ms"])
+        if (hot_queue or hot_p99) and alive < p.max_workers:
+            if last_up is not None and now - last_up < p.cooldown_up_s:
+                return {"target": alive, "reason": "cooldown_up"}
+            return {"target": min(alive + p.step, p.max_workers),
+                    "reason": ("queue_hwm=%d" % sig["queue_hwm"]
+                               if hot_queue else
+                               "p99=%.0fms" % sig["p99_ms"])}
+        cold_queue = sig["queue_hwm"] <= p.down_queue_depth
+        cold = cold_queue and (
+            sig["idle_s"] >= p.down_idle_s
+            or (sig["fill_ratio"] is not None
+                and sig["fill_ratio"] < p.down_fill_frac))
+        if cold and alive > p.min_workers:
+            last_any = max(t for t in (last_up, last_down, -1e18)
+                           if t is not None)
+            if last_any > -1e17 and now - last_any < p.cooldown_down_s:
+                return {"target": alive, "reason": "cooldown_down"}
+            return {"target": max(alive - 1, p.min_workers),
+                    "reason": ("idle=%.1fs" % sig["idle_s"]
+                               if sig["idle_s"] >= p.down_idle_s
+                               else "fill=%.2f" % sig["fill_ratio"])}
+        return {"target": alive, "reason": "steady"}
+
+    # -- one evaluation --------------------------------------------------
+    def tick(self) -> Optional[int]:
+        """One controller evaluation: sample, decide, actuate. Returns
+        the new target when a scale action was taken, None on hold. The
+        ``autoscale/decide`` fault site turns a failed evaluation into a
+        deterministic drill: a transient skips THIS tick (counted under
+        ``autoscale/decide_errors``) and the loop carries on."""
+        prof = OpProfiler.get()
+        with self._lock:
+            ordinal = self._ticks
+            self._ticks += 1
+        prof.count("autoscale/ticks")
+        try:
+            faultinject.fault_point("autoscale/decide", ordinal)
+        except faultinject.TransientFault:
+            prof.count("autoscale/decide_errors")
+            return None
+        sig = self._signals()
+        prof.gauge("autoscale/replicas", sig["alive"])
+        decision = self.decide(sig)
+        target = decision["target"]
+        if target == sig["alive"]:
+            prof.count("autoscale/held")
+            return None
+        now = time.monotonic()
+        attrs = {k: (round(v, 3) if isinstance(v, float) else v)
+                 for k, v in sig.items() if v is not None}
+        # the decision IS the span: its inputs ride as attrs so the
+        # timeline answers "why did the fleet resize" without logs
+        with flightrec.span("autoscale/decide", severity="warn",
+                            target=target, reason=decision["reason"],
+                            **attrs):
+            self.engine.scale_to(target, reason=decision["reason"])
+        up = target > sig["alive"]
+        prof.count("autoscale/scale_ups" if up else "autoscale/scale_downs")
+        prof.gauge("autoscale/replicas", target)
+        with self._lock:
+            if up:
+                self._last_up_t = now
+            else:
+                self._last_down_t = now
+        flightrec.event("autoscale/scale", frm=sig["alive"], to=target,
+                        reason=decision["reason"])
+        logger.info("autoscaled %d -> %d (%s)", sig["alive"], target,
+                    decision["reason"])
+        return target
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            ticks = self._ticks
+        out = dict(OpProfiler.get().autoscale_stats())
+        out["ticks_local"] = ticks
+        out["policy"] = {"min": self.policy.min_workers,
+                        "max": self.policy.max_workers,
+                        "interval_s": self.policy.interval_s}
+        return out

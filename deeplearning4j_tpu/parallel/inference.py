@@ -91,17 +91,19 @@ class _Request:
     so a serving tier can re-enqueue the in-flight batch of a dying
     replica a bounded number of times instead of failing it."""
 
-    __slots__ = ("arr", "fut", "seq", "t_enq", "attempts", "t_real")
+    __slots__ = ("arr", "fut", "seq", "t_enq", "attempts", "t_real", "slo")
 
     def __init__(self, arr: np.ndarray, fut: Future, seq: int,
                  t_enq: float, attempts: int = 0,
-                 t_real: Optional[int] = None):
+                 t_real: Optional[int] = None,
+                 slo: Optional[str] = None):
         self.arr = arr
         self.fut = fut
         self.seq = seq
         self.t_enq = t_enq          # time.monotonic() at queue entry
         self.attempts = attempts
         self.t_real = t_real        # real sequence length before seq-pad
+        self.slo = slo              # SLO class name (admission-controlled)
 
     @property
     def n(self) -> int:
@@ -205,6 +207,9 @@ class ParallelInference:
         self._alive = 0
         self._busy = 0               # workers mid-batch (shutdown drains)
         self._pool_size = 0          # configured capacity (drain threads)
+        self._target_alive = 0       # scale_to target (autoscaler-driven)
+        self._scale_down_pending = 0  # workers asked to exit at a boundary
+        self._scaled_down_total = 0
         self._retired_total = 0
         self._resurrected_total = 0
         self._resurrections_started = 0
@@ -213,6 +218,7 @@ class ParallelInference:
         if self.mode == "batched":
             self._alive = max(1, int(workers))
             self._pool_size = self._alive
+            self._target_alive = self._alive
             for i in range(self._alive):
                 t = threading.Thread(target=self._drain, args=(i,),
                                      daemon=True,
@@ -230,15 +236,95 @@ class ParallelInference:
         """Live/retired/resurrected census (the /api/health line)."""
         with self._lock:
             return {"workers": self._pool_size, "alive": self._alive,
+                    "target": self._target_alive,
+                    "scaled_down": self._scaled_down_total,
                     "retired": self._retired_total,
                     "resurrected": self._resurrected_total}
 
-    def output(self, x) -> NDArray:
+    # --- online scaling -------------------------------------------------
+    def scale_to(self, n: int, reason: str = "manual") -> int:
+        """Resize the worker pool ONLINE, no process restart: scale UP
+        spawns fresh drain threads against the shared queue (on the
+        serving tier they reuse the already-compiled bucket executables,
+        so a grow never traces); scale DOWN marks the excess and each
+        surplus worker exits at its next batch boundary — a worker never
+        abandons a batch it already picked up. The closed-loop autoscaler
+        (:mod:`parallel.autoscale`) drives this from queue/latency
+        signals; it is also the manual capacity knob. Returns the new
+        target."""
+        if self.mode != "batched":
+            raise RuntimeError("scale_to needs a batched worker pool "
+                               "(sequential mode has no workers)")
+        n = max(1, int(n))
+        started: List[threading.Thread] = []
+        with self._lock:
+            if self._shutdown:
+                return self._alive
+            self._target_alive = n
+            pending = self._scale_down_pending
+            effective = self._alive - pending
+            if n > effective:
+                # cancel queued scale-downs before spawning new threads
+                cancel = min(pending, n - effective)
+                self._scale_down_pending -= cancel
+                effective += cancel
+                for _ in range(n - effective):
+                    worker_id = len(self._workers)
+                    t = threading.Thread(target=self._drain,
+                                         args=(worker_id,), daemon=True,
+                                         name=f"dl4j-inference-{worker_id}")
+                    self._workers.append(t)
+                    self._alive += 1
+                    started.append(t)
+            elif n < effective:
+                self._scale_down_pending += effective - n
+            self._pool_size = n
+        for t in started:
+            t.start()
+        prof = OpProfiler.get()
+        if started:
+            prof.count("inference/workers_started", len(started))
+        logger.info("inference pool scaled to %d workers (%s)", n, reason)
+        return n
+
+    def _take_scale_down(self, worker_id: int) -> bool:
+        """Boundary check a drain worker runs between batches: True means
+        THIS worker absorbs one pending scale-down and must exit. The
+        lock-free fast read keeps the no-scaling hot path at one attribute
+        check; the decision itself is taken under the pool lock."""
+        if not self._scale_down_pending:
+            return False
+        with self._lock:
+            if self._scale_down_pending <= 0:
+                return False
+            if self._alive <= self._target_alive:
+                # a retirement already shrank the pool to (or below) the
+                # target since this scale-down was queued — absorbing it
+                # too would underflow the fleet (down to zero workers)
+                self._scale_down_pending = 0
+                return False
+            self._scale_down_pending -= 1
+            self._alive -= 1
+            self._scaled_down_total += 1
+            alive = self._alive
+        self._on_scaled_out(worker_id)
+        OpProfiler.get().count("inference/workers_stopped")
+        logger.info("inference replica %d scaled out; %d workers remain",
+                    worker_id, alive)
+        return True
+
+    def _on_scaled_out(self, worker_id: int) -> None:
+        """Subclass hook: bookkeeping when a worker exits via scale-down
+        (the serving tier frees the worker's pinned-device slot here)."""
+
+    def output(self, x, **kwargs) -> NDArray:
         """Synchronous single-request API (reference output()), bounded by
         the per-request deadline. A timeout reports the request's TRUE
         time-in-queue (from the queue-entry timestamp the future carries),
-        not a figure derived from ``max_wait_ms`` at dispatch."""
-        fut = self.output_async(x)
+        not a figure derived from ``max_wait_ms`` at dispatch. Keyword
+        arguments pass through to ``output_async`` (the serving tier's
+        ``slo_class``)."""
+        fut = self.output_async(x, **kwargs)
         try:
             return fut.result(timeout=self.request_timeout_s)
         except concurrent.futures.TimeoutError:
@@ -401,6 +487,17 @@ class ParallelInference:
             with self._lock:
                 if self._shutdown:
                     return
+                superseded = (self._alive - self._scale_down_pending
+                              >= self._target_alive)
+            if superseded:
+                # the pool has since been scaled down past this
+                # resurrection — a replacement would only be asked to
+                # exit again at its first boundary
+                OpProfiler.get().count("inference/resurrection_superseded")
+                return
+            with self._lock:
+                if self._shutdown:
+                    return
                 # id + append under ONE lock: two resurrectors racing
                 # (two near-simultaneous retirements) must not mint the
                 # same replica id
@@ -423,6 +520,8 @@ class ParallelInference:
     def _drain(self, worker_id: int) -> None:
         prof = OpProfiler.get()
         while not self._shutdown:
+            if self._take_scale_down(worker_id):
+                return            # scaled out at a batch boundary
             try:
                 first = self._queue.get(timeout=0.1)
             except queue.Empty:

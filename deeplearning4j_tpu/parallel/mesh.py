@@ -61,6 +61,15 @@ def serving_devices(workers: int,
     return [devs[i % len(devs)] for i in range(max(1, int(workers)))]
 
 
+def serving_capacity(devices: Optional[Sequence] = None) -> int:
+    """How many serving replicas the topology supports before they only
+    time-share chips: the device count. The autoscaler's default
+    ``max_workers`` is a small multiple of this — replicas beyond it add
+    queueing, not throughput."""
+    devs = list(devices) if devices is not None else jax.devices()
+    return max(1, len(devs))
+
+
 def probe_device(device) -> bool:
     """Tiny host→device→host round-trip health probe: True when the
     device accepts a placement and hands back finite data. The single

@@ -56,17 +56,49 @@ submission, before anything is queued. A sequence length over the ladder
 ALWAYS rejects — time steps cannot be split across executables by a
 serving layer that does not know the model's temporal semantics.
 
+**Overload safety** (ISSUE 11): requests optionally carry an SLO CLASS
+(:class:`SLOClass` — e.g. ``gold``/``silver``/``batch``, each with a
+priority, a p99 budget, and a per-class queue budget). Admission is
+synchronous: a shed request gets :class:`Overloaded` (HTTP 429) with a
+``Retry-After`` derived from the MEASURED queue drain rate, never a slot
+in a queue it would time out of. Under overload the
+:class:`BrownoutController` sheds classes strictly
+lowest-priority-first — one level step per controller tick, cleared only
+after several consecutive clean evaluations (hysteresis; a request is
+never flapped) — defending the top class's p99 budget. The queue-depth
+signal is a decaying WINDOWED high-water mark (``queue_depth_hwm``; the
+lifetime max lives separately in ``queue_depth_peak``), so it can drive
+scale-DOWN as well as scale-up.
+
+**Elastic capacity**: ``scale_to(n)`` grows/shrinks the worker pool
+online — new workers reuse the already-compiled bucket executables
+(recompiles stay at one per bucket x device slot at ANY replica count),
+surplus workers exit at a batch boundary. The closed-loop autoscaler
+driving it from the windowed HWM / rolling p99 / fill-ratio signals is
+:class:`parallel.autoscale.Autoscaler`.
+
+**Canaried train-to-serve handoff**: :meth:`ServingEngine.
+publish_checkpoint` hot-swaps retrained weights onto ONE canary replica
+(zero recompiles — the executables take params as arguments), promotes
+fleet-wide after an SLO-clean window, and auto-rollbacks BITWISE (the
+exact prior device arrays are restored) on violation; the ``pub<N>``
+correlation id chains train-commit -> canary -> promote/rollback in the
+flight recorder.
+
 HTTP serving lives on the existing UI server: ``UIServer.attach_serving``
 exposes ``POST /api/infer`` next to ``/api/health`` (whose ``serving``
-section is :func:`serving_health`). Load-test with
-``python bench.py --config serving-smoke`` — an open-loop Poisson
-generator with hard-fail p50/p99/QPS SLO gates and a
-kill-a-replica-mid-load drill.
+section is :func:`serving_health`); sheds map to ``429`` +
+``Retry-After``. Load-test with ``python bench.py --config
+serving-smoke`` (open-loop Poisson, hard-fail p50/p99/QPS SLO gates,
+kill-a-replica drill) and ``--config autoscale-smoke`` (diurnal + spike
+replay at 5x the serving-smoke rate, shed-order/scale-latency/canary
+gates).
 """
 
 from __future__ import annotations
 
 import collections
+import os
 import queue
 import threading
 import time
@@ -89,6 +121,21 @@ from .mesh import serving_devices
 
 # live engines, for the /api/health serving census (weak: dropped → gone)
 _ENGINES: "weakref.WeakSet" = weakref.WeakSet()
+
+# process-global publication ordinal: the pub<N> correlation id must be
+# unique across every engine's lifetime or one grep of the timeline
+# could conflate two publications (it is also the serving/promote fault
+# drill index — see next_publication_ordinal)
+_pub_lock = threading.Lock()
+_pub_next = [0]
+
+
+def next_publication_ordinal() -> int:
+    """The ordinal (= ``serving/promote`` fault index, = the N in the
+    ``pub<N>`` correlation id) the NEXT ``publish_checkpoint`` call will
+    get — how drills target a specific publication deterministically."""
+    with _pub_lock:
+        return _pub_next[0]
 
 _MISS = object()     # _exec sentinel: None is a real (generic-model) entry
 
@@ -189,6 +236,320 @@ class BucketLadder:
 from ..learning.precision import cast_floating as _cast_floating
 
 
+class Overloaded(RuntimeError):
+    """Synchronous load-shed rejection (the HTTP tier maps it to 429):
+    the engine is inside a brownout and this request's SLO class is
+    currently shed, or the class's queue budget is exhausted. Carries
+    ``retry_after_s`` derived from the MEASURED queue drain rate (the
+    ``Retry-After`` header), so clients back off proportionally to the
+    actual backlog instead of a fixed guess. Raised at submission —
+    nothing is queued."""
+
+    def __init__(self, message: str, slo_class: str, reason: str,
+                 retry_after_s: float):
+        super().__init__(message)
+        self.slo_class = slo_class
+        self.reason = reason          # "brownout" | "queue_budget" | "fault"
+        self.retry_after_s = float(retry_after_s)
+
+
+class SLOClass:
+    """One admission class. ``priority`` orders shedding — strictly
+    lowest-priority-first, and the top class is NEVER shed. ``p99_ms``
+    is the class's latency budget: the top class's budget is what the
+    brownout controller defends and what the canary publication's
+    SLO-clean window defaults to. ``queue_budget`` bounds how many
+    requests of this class may be outstanding at once (per-class
+    backpressure: one flooding tenant cannot fill the shared queue for
+    everyone else)."""
+
+    def __init__(self, name: str, priority: int, p99_ms: float,
+                 queue_budget: int = 128):
+        self.name = str(name)
+        self.priority = int(priority)
+        self.p99_ms = float(p99_ms)
+        self.queue_budget = int(queue_budget)
+        if not self.name:
+            raise ValueError("an SLO class needs a non-empty name")
+        if self.p99_ms <= 0 or self.queue_budget < 1:
+            raise ValueError(f"SLO class {name!r} needs p99_ms > 0 and "
+                             f"queue_budget >= 1")
+
+    def __repr__(self) -> str:
+        return (f"SLOClass({self.name!r}, priority={self.priority}, "
+                f"p99_ms={self.p99_ms}, queue_budget={self.queue_budget})")
+
+
+class AdmissionController:
+    """Per-class admission state: outstanding counts against queue
+    budgets, the brownout shed LEVEL (0 admits everything; level k sheds
+    the k lowest-priority classes), completion-rate tracking for
+    ``Retry-After``, and the per-class shed counters
+    (``serving/shed/<class>``). Shedding is strictly bottom-up BY CLASS
+    and the level only moves at controller cadence with hysteresis
+    (:class:`BrownoutController`) — an individual request is never
+    flapped: its class is either shed right now or it is not."""
+
+    DRAIN_WINDOW_S = 5.0
+
+    def __init__(self, classes: Sequence[SLOClass],
+                 default: Optional[str] = None):
+        classes = list(classes)
+        if not classes:
+            raise ValueError("admission control needs >= 1 SLO class")
+        names = [c.name for c in classes]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate SLO class names: {names}")
+        if len({c.priority for c in classes}) != len(classes):
+            raise ValueError("SLO class priorities must be unique — they "
+                             "define the shed order")
+        self._lock = threading.Lock()
+        # ascending priority: index 0 sheds first, the last never sheds
+        self.by_shed_order: Tuple[SLOClass, ...] = tuple(
+            sorted(classes, key=lambda c: c.priority))
+        self.top = self.by_shed_order[-1]
+        self.by_name = {c.name: c for c in classes}
+        self._rank = {c.name: i for i, c in enumerate(self.by_shed_order)}
+        self.default = default if default is not None else self.top.name
+        if self.default not in self.by_name:
+            raise ValueError(f"default class {self.default!r} is not one "
+                             f"of the configured SLO classes {names}")
+        self._level = 0
+        self._outstanding: Dict[str, int] = {c.name: 0 for c in classes}
+        self._done: "collections.deque" = collections.deque(maxlen=4096)
+
+    def resolve(self, name: Optional[str]) -> SLOClass:
+        if name is None:
+            name = self.default
+        cls = self.by_name.get(name)
+        if cls is None:
+            raise ValueError(f"unknown SLO class {name!r}; configured: "
+                             f"{sorted(self.by_name)}")
+        return cls
+
+    def level(self) -> int:
+        with self._lock:
+            return self._level
+
+    def shed_names(self) -> List[str]:
+        with self._lock:
+            return [c.name for c in self.by_shed_order[:self._level]]
+
+    def set_level(self, level: int, reason: str = "manual") -> int:
+        """Move the shed level (the brownout controller's actuator, and
+        the deterministic overload drill hook). Clamped so the top class
+        is never shed. A CHANGE emits one ``serving/shed`` event and
+        updates the ``serving/shed_level`` gauge — per level transition,
+        never per request."""
+        level = max(0, min(int(level), len(self.by_shed_order) - 1))
+        with self._lock:
+            prev = self._level
+            self._level = level
+        if level != prev:
+            prof = OpProfiler.get()
+            prof.gauge("serving/shed_level", level)
+            prof.count("serving/brownout_raise" if level > prev
+                       else "serving/brownout_lower")
+            flightrec.event(
+                "serving/shed", severity="warn", level=level, prev=prev,
+                shed=[c.name for c in self.by_shed_order[:level]],
+                reason=str(reason)[:200])
+            logger.warning("serving brownout level %d -> %d (%s)", prev,
+                           level, reason)
+        return level
+
+    def note_queued(self, name: str) -> None:
+        with self._lock:
+            self._outstanding[name] = self._outstanding.get(name, 0) + 1
+
+    def release(self, name: str, n: int = 1) -> None:
+        """Return ``n`` reserved slots WITHOUT recording completions —
+        for an admitted request that never reached the queue (an
+        injected enqueue fault); completions go through note_done so
+        the drain rate only counts work that actually drained."""
+        with self._lock:
+            self._outstanding[name] = max(
+                0, self._outstanding.get(name, 0) - n)
+
+    def note_done(self, name: str) -> None:
+        with self._lock:
+            self._outstanding[name] = max(
+                0, self._outstanding.get(name, 0) - 1)
+            self._done.append(time.monotonic())
+
+    def _drain_rate_locked(self, now: float) -> float:
+        recent = sum(1 for t in self._done
+                     if now - t <= self.DRAIN_WINDOW_S)
+        return recent / self.DRAIN_WINDOW_S
+
+    def retry_after_s(self) -> float:
+        """Backlog / measured drain rate, clamped to [0.1s, 30s] — how
+        long a shed client should wait before the queue has plausibly
+        drained. With no completions observed yet the estimate falls
+        back to a per-request pessimistic constant."""
+        now = time.monotonic()
+        with self._lock:
+            outstanding = sum(self._outstanding.values())
+            rate = self._drain_rate_locked(now)
+        if rate <= 0:
+            return min(30.0, 1.0 + outstanding * 0.05)
+        return float(min(30.0, max(0.1, outstanding / rate)))
+
+    def admit(self, cls: SLOClass, n_chunks: int = 1) -> None:
+        """The admission decision: raises :class:`Overloaded` when the
+        class is inside the brownout shed set or its queue budget is
+        exhausted; otherwise RESERVES ``n_chunks`` outstanding slots
+        under the same lock (check-then-reserve atomically — concurrent
+        HTTP threads must not all pass the same budget headroom) and
+        returns. The caller releases the reservation via the per-chunk
+        completion callbacks (:meth:`note_done`) or, for a submission
+        that never reaches the queue, :meth:`release`."""
+        with self._lock:
+            if self._rank[cls.name] < self._level:
+                reason = "brownout"
+            elif self._outstanding.get(cls.name, 0) + n_chunks \
+                    > cls.queue_budget:
+                reason = "queue_budget"
+            else:
+                self._outstanding[cls.name] = \
+                    self._outstanding.get(cls.name, 0) + n_chunks
+                return
+        self.count_shed(cls.name)
+        ra = self.retry_after_s()
+        raise Overloaded(
+            f"request shed ({reason}): class {cls.name!r} "
+            + ("is inside the brownout shed set"
+               if reason == "brownout" else
+               f"already has {cls.queue_budget} request(s) outstanding "
+               f"(its queue budget)")
+            + f"; retry after {ra:.2f}s", cls.name, reason, ra)
+
+    @staticmethod
+    def count_shed(name: str) -> None:
+        prof = OpProfiler.get()
+        prof.count(f"serving/shed/{name}")
+        prof.count("serving/shed_total")
+
+    def stats(self) -> Dict[str, Any]:
+        now = time.monotonic()
+        with self._lock:
+            return {
+                "level": self._level,
+                "shed": [c.name for c in self.by_shed_order[:self._level]],
+                "classes": [c.name for c in reversed(self.by_shed_order)],
+                "outstanding": dict(self._outstanding),
+                "drain_rate_rps": round(self._drain_rate_locked(now), 3),
+            }
+
+
+class BrownoutController:
+    """Keeps the TOP class inside its p99 budget by progressively
+    shedding lower classes. Evaluates at a fixed cadence (never
+    per-request): the level RAISES one step when the top class's recent
+    p99 exceeds its budget or the windowed queue-depth HWM crosses the
+    depth trigger, and LOWERS one step only after ``clear_ticks``
+    consecutive clean evaluations (p99 under ``hysteresis_frac`` x
+    budget AND depth back under half the trigger). The asymmetry is the
+    hysteresis: overload sheds within one controller interval, recovery
+    un-sheds slowly enough that an oscillating load cannot flap a class
+    in and out of admission."""
+
+    def __init__(self, engine: "ServingEngine", adm: AdmissionController,
+                 interval_s: float = 0.2,
+                 depth_trigger: Optional[int] = None,
+                 clear_ticks: int = 5, hysteresis_frac: float = 0.7):
+        self.engine = engine
+        self.adm = adm
+        self.interval_s = float(interval_s)
+        self.depth_trigger = (int(depth_trigger) if depth_trigger
+                              else max(8, engine._queue.maxsize // 4))
+        self.clear_ticks = max(1, int(clear_ticks))
+        self.hysteresis_frac = float(hysteresis_frac)
+        self._clean = 0           # single-writer: the controller thread
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dl4j-serving-brownout")
+        self._thread.start()
+
+    def evaluate(self, p99_ms: Optional[float], depth: int) -> int:
+        """One control decision from measured signals (split out so
+        tests and drills drive the hysteresis deterministically).
+        Returns the level in force after the decision."""
+        top = self.adm.top
+        over = ((p99_ms is not None and p99_ms > top.p99_ms)
+                or depth >= self.depth_trigger)
+        level = self.adm.level()
+        if over:
+            self._clean = 0
+            if level < len(self.adm.by_shed_order) - 1:
+                return self.adm.set_level(
+                    level + 1,
+                    reason=f"overload: top p99={p99_ms and round(p99_ms, 1)}"
+                           f"ms (budget {top.p99_ms}ms), depth={depth} "
+                           f"(trigger {self.depth_trigger})")
+            return level
+        clean = ((p99_ms is None
+                  or p99_ms <= self.hysteresis_frac * top.p99_ms)
+                 and depth <= self.depth_trigger // 2)
+        if not clean:
+            self._clean = 0
+            return level
+        if level > 0:
+            self._clean += 1
+            if self._clean >= self.clear_ticks:
+                self._clean = 0
+                return self.adm.set_level(
+                    level - 1, reason=f"recovered: {self.clear_ticks} "
+                                      f"clean evaluations")
+        return self.adm.level()
+
+    def _run(self) -> None:
+        eng = self.engine
+        while not eng._shutdown:
+            time.sleep(self.interval_s)
+            if eng._shutdown:
+                return
+            try:
+                self.evaluate(
+                    eng._class_recent_p99(self.adm.top.name),
+                    eng.queue_depth_hwm())
+            except Exception:
+                logger.warning("brownout evaluation failed", exc_info=True)
+
+
+class PublishHandle:
+    """Tracks one canaried weight publication to its terminal state.
+    ``result(timeout)`` blocks for ``"promoted"`` (SLO-clean canary +
+    confirm windows; the fleet serves the new weights) or
+    ``"rolled_back"`` (a violation anywhere restored the prior params
+    bitwise). ``corr`` is the flight-recorder correlation id chaining
+    train-commit -> canary -> promote/rollback."""
+
+    def __init__(self, corr: str, path: str):
+        self.corr = corr
+        self.path = path
+        self.phase = "canary"
+        self._done = threading.Event()
+        self._outcome: Optional[str] = None
+
+    def _finish(self, outcome: str) -> None:
+        self._outcome = outcome
+        self.phase = outcome
+        self._done.set()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> str:
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"publication {self.corr} still in phase "
+                               f"{self.phase!r}")
+        return self._outcome
+
+
 class ServingEngine(ParallelInference):
     """The serving tier: a ParallelInference replica pool whose workers
     drain the shared queue into padded shape buckets served by
@@ -206,6 +567,10 @@ class ServingEngine(ParallelInference):
             self._warmup = True
             self._max_requeues = 2
             self._pin_devices = False
+            self._slo_classes: Optional[List[SLOClass]] = None
+            self._default_class: Optional[str] = None
+            self._brownout_kw: Dict[str, Any] = {}
+            self._qwin_window_s = 5.0
 
         def inference_mode(self, mode: str) -> "ServingEngine.Builder":
             """Serving IS continuous batching — the drain loop, stash and
@@ -260,6 +625,45 @@ class ServingEngine(ParallelInference):
             self._max_requeues = max(0, int(n))
             return self
 
+        def slo_classes(self, classes: Sequence[SLOClass],
+                        default: Optional[str] = None
+                        ) -> "ServingEngine.Builder":
+            """Enable SLO-class admission control: requests carry a
+            class (``output_async(x, slo_class="gold")``; ``default``
+            names the class an unclassified request gets — the TOP class
+            when omitted). Under overload the brownout controller sheds
+            classes strictly lowest-priority-first with a synchronous
+            :class:`Overloaded` (HTTP 429 + Retry-After); each class's
+            ``queue_budget`` bounds its outstanding requests."""
+            self._slo_classes = [c if isinstance(c, SLOClass)
+                                 else SLOClass(*c) for c in classes]
+            self._default_class = default
+            return self
+
+        def queue_hwm_window(self, seconds: float
+                             ) -> "ServingEngine.Builder":
+            """Window length of the decaying queue-depth high-water mark
+            (it decays to 0 within ~2 windows of the backlog clearing);
+            the autoscaler's scale-down latency is bounded below by it."""
+            self._qwin_window_s = float(seconds)
+            return self
+
+        def brownout(self, interval_s: Optional[float] = None,
+                     depth_trigger: Optional[int] = None,
+                     clear_ticks: Optional[int] = None,
+                     hysteresis_frac: Optional[float] = None
+                     ) -> "ServingEngine.Builder":
+            """Tune the brownout controller (only meaningful with
+            :meth:`slo_classes`); see :class:`BrownoutController` for
+            the semantics of each knob."""
+            for k, v in (("interval_s", interval_s),
+                         ("depth_trigger", depth_trigger),
+                         ("clear_ticks", clear_ticks),
+                         ("hysteresis_frac", hysteresis_frac)):
+                if v is not None:
+                    self._brownout_kw[k] = v
+            return self
+
         def pin_devices(self, enabled: bool = True
                         ) -> "ServingEngine.Builder":
             """Pin replica workers round-robin across devices
@@ -283,6 +687,10 @@ class ServingEngine(ParallelInference):
                 bf16=self._bf16, warmup=self._warmup,
                 max_requeues=self._max_requeues,
                 pin_devices=self._pin_devices,
+                slo_classes=self._slo_classes,
+                default_class=self._default_class,
+                brownout_kw=self._brownout_kw,
+                queue_hwm_window_s=self._qwin_window_s,
                 batch_limit=self._batch_limit,
                 queue_limit=self._queue_limit,
                 max_wait_ms=self._max_wait_ms, workers=self._workers,
@@ -295,6 +703,10 @@ class ServingEngine(ParallelInference):
                  input_shape: Tuple[int, ...], in_dtype=np.float32,
                  bf16: bool = False, warmup: bool = True,
                  max_requeues: int = 2, pin_devices: bool = False,
+                 slo_classes: Optional[Sequence[SLOClass]] = None,
+                 default_class: Optional[str] = None,
+                 brownout_kw: Optional[Dict[str, Any]] = None,
+                 queue_hwm_window_s: float = 5.0,
                  **pool_kwargs):
         # subclass state FIRST: super().__init__ starts the drain threads,
         # which call into the overridden _drain immediately
@@ -304,6 +716,22 @@ class ServingEngine(ParallelInference):
         self._bf16 = bf16
         self.max_requeues = max_requeues
         self._compute_dtype = jnp.bfloat16 if bf16 else None
+        self._adm = (AdmissionController(slo_classes, default=default_class)
+                     if slo_classes else None)
+        # decaying/windowed queue-depth high-water mark (two rolling
+        # windows; the scale-down-capable signal) + the lifetime peak
+        self._qwin_s = float(queue_hwm_window_s)
+        self._qwin_start = time.monotonic()
+        self._qwin_max = 0
+        self._qwin_prev = 0
+        self._q_peak = 0
+        self._last_dispatch_t = time.monotonic()
+        self._lat_recent: "collections.deque" = collections.deque(
+            maxlen=2048)                 # (t_done, latency_s), all classes
+        self._class_lats: Dict[str, "collections.deque"] = {}
+        self._canary: Optional[Dict[str, Any]] = None
+        self._pub_threads: List[threading.Thread] = []
+        self._brownout: Optional[BrownoutController] = None
         self._devices = (serving_devices(pool_kwargs.get("workers", 1))
                          if pin_devices else [None])
         # worker -> pinned device slot; a retired worker's slot is freed
@@ -320,7 +748,6 @@ class ServingEngine(ParallelInference):
         self._latencies: "collections.deque" = collections.deque(maxlen=4096)
         self._batch_seq = 0
         self._admit_seq = 0          # request ordinal (serving/enqueue)
-        self._hwm = 0
         self._warm = False
         # THIS engine's trace count (bumped trace-time in _make_infer):
         # the after-warmup alarm must not fire on another engine's warmup
@@ -341,27 +768,69 @@ class ServingEngine(ParallelInference):
             self._snapshot_params()
         if warmup:
             self.warmup()
+        if self._adm is not None:
+            self._brownout = BrownoutController(self, self._adm,
+                                                **(brownout_kw or {}))
+            self._brownout.start()
         _ENGINES.add(self)
 
     # --- params / executables -----------------------------------------
-    def _snapshot_params(self) -> None:
-        params, states = self.model._params, self.model._states
+    def _cast_serving(self, params, states):
         if self._bf16:
             params = _cast_floating(params, jnp.bfloat16)
             states = _cast_floating(states, jnp.bfloat16)
+        return params, states
+
+    def _place_params(self, params, states) -> Dict[int, Any]:
+        """One (params, states) copy per device slot — the argument set
+        every AOT bucket executable takes, so swapping a slot's entry
+        (refresh, canary, promote, rollback) never recompiles."""
+        placed: Dict[int, Any] = {}
         for i, dev in enumerate(self._devices):
             if dev is None:
-                self._dev_params[i] = (params, states)
+                placed[i] = (params, states)
             else:
-                self._dev_params[i] = jax.device_put((params, states), dev)
+                placed[i] = jax.device_put((params, states), dev)
+        return placed
+
+    def _snapshot_params(self) -> None:
+        params, states = self._cast_serving(self.model._params,
+                                            self.model._states)
+        placed = self._place_params(params, states)
+        with self._lock:
+            self._dev_params = placed
+
+    def _params_for(self, worker_id: Optional[int], dev_slot: int):
+        """The params a dispatch uses: the canary replica reads the
+        candidate weights while a publication is in its canary phase;
+        everyone else reads the fleet set. One racy dict read by design
+        — a phase transition swaps whole dicts under the pool lock, and
+        a batch that catches the old reference simply serves the
+        previous (complete, consistent) weight set."""
+        can = self._canary
+        if can is not None and worker_id is not None \
+                and can.get("phase") == "canary" \
+                and can.get("worker") == worker_id:
+            return can["canary_params"]
+        return self._dev_params[dev_slot]
 
     def refresh_params(self) -> None:
         """Re-snapshot the model's (possibly retrained) params into the
         serving copies. CHEAP: the AOT executables take params as
         arguments, so same-shape updates swap in without any recompile
-        (bf16 pays its cast again)."""
+        (bf16 pays its cast again). Refused while a canaried publication
+        is in flight — :meth:`publish_checkpoint` owns the param set
+        until it resolves, or a rollback could restore weights the
+        refresh already replaced."""
         if not self._aot:
             return
+        with self._lock:
+            if self._canary is not None:
+                raise RuntimeError(
+                    f"refresh_params refused: publication "
+                    f"{self._canary['corr']} is in flight (phase "
+                    f"{self._canary['phase']!r}); wait for it to resolve "
+                    f"or use publish_checkpoint for the next weights")
         self._snapshot_params()
 
     def _make_infer(self):
@@ -454,15 +923,16 @@ class ServingEngine(ParallelInference):
         self._warm = True
         return timings
 
-    def _run_bucket(self, padded: np.ndarray,
-                    dev_idx: int = 0) -> np.ndarray:
+    def _run_bucket(self, padded: np.ndarray, dev_idx: int = 0,
+                    worker_id: Optional[int] = None) -> np.ndarray:
         exe = self._compile_bucket(tuple(padded.shape),
                                    dev_idx % len(self._devices))
         if exe is None:                       # generic-model fallback
             out = self.model.output(padded)
             out = out[0] if isinstance(out, list) else out
             return out.to_numpy()
-        params, states = self._dev_params[dev_idx % len(self._devices)]
+        params, states = self._params_for(worker_id,
+                                          dev_idx % len(self._devices))
         return np.asarray(exe(params, states,
                               padded.astype(self._in_dtype, copy=False),
                               self._key))
@@ -478,11 +948,15 @@ class ServingEngine(ParallelInference):
         return NDArray(self._run_bucket(padded)[:n])
 
     # --- request admission ---------------------------------------------
-    def output_async(self, x) -> Future:
+    def output_async(self, x, slo_class: Optional[str] = None) -> Future:
         """Admit one request (see the module docstring's admission rule).
-        Oversize rejections and ladder violations raise SYNCHRONOUSLY —
-        nothing is queued; every admitted request resolves through its
-        future (deadline-bounded via :meth:`output`)."""
+        Oversize rejections, ladder violations and SLO-class sheds
+        (:class:`Overloaded` — brownout or queue budget, HTTP 429) raise
+        SYNCHRONOUSLY — nothing is queued; every admitted request
+        resolves through its future (deadline-bounded via
+        :meth:`output`). ``slo_class`` names the request's admission
+        class when classes are configured; ``None`` takes the default
+        class."""
         arr = np.asarray(x.value if isinstance(x, NDArray) else x)
         if arr.ndim != len(self._feat) + 1:
             raise ValueError(
@@ -498,6 +972,24 @@ class ServingEngine(ParallelInference):
             # indices unreachable for split requests
             admit_seq = self._admit_seq
             self._admit_seq += 1
+        cls = None
+        if self._adm is not None:
+            cls = self._adm.resolve(slo_class)
+            try:
+                # the admission drill site (request ordinal): `slow`
+                # stalls the decision, `transient` forces THIS request
+                # shed — the deterministic 429 drill
+                faultinject.fault_point("serving/admission", admit_seq)
+            except faultinject.TransientFault as e:
+                AdmissionController.count_shed(cls.name)
+                raise Overloaded(
+                    f"injected admission fault shed request {admit_seq} "
+                    f"(class {cls.name!r})", cls.name, "fault",
+                    self._adm.retry_after_s()) from e
+        elif slo_class is not None:
+            raise ValueError(
+                f"slo_class={slo_class!r} given but no SLO classes are "
+                f"configured (Builder.slo_classes)")
         t_real = None
         if self.ladder.seq_lens is not None:
             t = int(arr.shape[1])
@@ -519,19 +1011,37 @@ class ServingEngine(ParallelInference):
         except OversizeRequest:
             prof.count("serving/oversize_rejected")
             raise
-        fired = faultinject.fault_point("serving/enqueue", admit_seq)
-        del fired  # advisory kinds have no enqueue-side meaning (yet)
+        if cls is not None:
+            self._adm.admit(cls, len(chunks))     # Overloaded: sheds here;
+            #                                       reserves the chunk slots
+        try:
+            fired = faultinject.fault_point("serving/enqueue", admit_seq)
+            del fired  # advisory kinds have no enqueue-side meaning (yet)
+        except BaseException:
+            if cls is not None:     # reservation must not leak on a drill
+                self._adm.release(cls.name, len(chunks))
+            raise
+        slo = cls.name if cls is not None else None
         if len(chunks) == 1:
-            return self._submit(arr, t_real)
+            return self._submit(arr, t_real, slo=slo)
         prof.count("serving/oversize_split")
         futs, off = [], 0
         for c in chunks:
-            futs.append(self._submit(arr[off:off + c], t_real))
+            futs.append(self._submit(arr[off:off + c], t_real, slo=slo))
             off += c
         return self._aggregate(futs)
 
-    def _submit(self, arr: np.ndarray, t_real: Optional[int]) -> Future:
+    def _submit(self, arr: np.ndarray, t_real: Optional[int],
+                slo: Optional[str] = None) -> Future:
         fut: Future = Future()
+        if slo is not None:
+            # the slot was RESERVED in admit(); the done-callback returns
+            # it on every resolution path (result, batch error, requeue
+            # exhaustion, the fast-fail exits just below, shutdown — a
+            # callback added after set_exception fires immediately), so
+            # the per-class budget can never leak
+            fut.add_done_callback(
+                lambda f, _n=slo: self._adm.note_done(_n))
         if self._shutdown:
             fut.set_exception(RuntimeError(
                 "ServingEngine is shut down; no replicas will serve this "
@@ -546,14 +1056,8 @@ class ServingEngine(ParallelInference):
             seq = self._req_seq
             self._req_seq += 1
             depth = self._queue.qsize() + 1
-            if depth > self._hwm:
-                self._hwm = depth
-                prof = OpProfiler.get()
-                # the shared gauge is the FLEET high-water: only ever
-                # raise it, or a lightly-loaded engine's write would
-                # mask another engine's backlog
-                if depth > prof.counter_value("serving/queue_depth_hwm"):
-                    prof.gauge("serving/queue_depth_hwm", depth)
+        self._qwin_update(depth)
+        self._publish_queue_gauges()
         # request lifecycle, leg 1 of enqueue → batch → dispatch → reply;
         # the request ordinal IS the correlation id, so one grep follows
         # a request through replica deaths and requeues. Emitted BEFORE
@@ -564,7 +1068,7 @@ class ServingEngine(ParallelInference):
             flightrec.event("serving/enqueue", corr=f"req{seq}", req=seq,
                             rows=int(arr.shape[0]))
         self._enqueue(_Request(arr, fut, seq, time.monotonic(),
-                               t_real=t_real))
+                               t_real=t_real, slo=slo))
         return fut
 
     def _aggregate(self, futs: List[Future]) -> Future:
@@ -595,6 +1099,93 @@ class ServingEngine(ParallelInference):
             f.add_done_callback(one_done)
         return parent
 
+    # --- load signals ---------------------------------------------------
+    def _qwin_update(self, depth: Optional[int] = None) -> int:
+        """Roll the two-window queue-depth high-water state (and fold in
+        a new sample); returns the current WINDOWED high-water mark —
+        max over the current and previous windows, so it decays to 0
+        within ~2 windows of the backlog clearing (the scale-DOWN-capable
+        signal the old only-rising fleet gauge could never be). The
+        lifetime maximum is kept separately (:attr:`queue_depth_peak`)."""
+        now = time.monotonic()
+        with self._lock:
+            elapsed = now - self._qwin_start
+            if elapsed >= 2 * self._qwin_s:
+                self._qwin_prev = 0
+                self._qwin_max = 0
+                self._qwin_start = now
+            elif elapsed >= self._qwin_s:
+                self._qwin_prev = self._qwin_max
+                self._qwin_max = 0
+                self._qwin_start = now
+            if depth is not None:
+                if depth > self._qwin_max:
+                    self._qwin_max = depth
+                if depth > self._q_peak:
+                    self._q_peak = depth
+            return max(self._qwin_max, self._qwin_prev)
+
+    def queue_depth_hwm(self) -> int:
+        """The decaying/windowed queue-depth high-water mark."""
+        return self._qwin_update()
+
+    @property
+    def queue_depth_peak(self) -> int:
+        """Lifetime queue-depth maximum (only ever rises)."""
+        return self._q_peak
+
+    def _publish_queue_gauges(self) -> None:
+        """Fleet gauges: ``serving/queue_depth_hwm`` = max WINDOWED
+        high-water over live engines (falls when backlogs clear);
+        ``serving/queue_depth_peak`` = lifetime fleet max (only rises).
+        Computed outside any engine lock — each read takes its owner's."""
+        prof = OpProfiler.get()
+        win, peak = 0, 0
+        for e in list(_ENGINES):
+            win = max(win, e.queue_depth_hwm())
+            peak = max(peak, e._q_peak)
+        prof.gauge("serving/queue_depth_hwm", win)
+        if peak > prof.counter_value("serving/queue_depth_peak"):
+            prof.gauge("serving/queue_depth_peak", peak)
+
+    def idle_seconds(self) -> float:
+        """Seconds since the last batch dispatch (autoscaler scale-down
+        signal)."""
+        return time.monotonic() - self._last_dispatch_t
+
+    def recent_p99_ms(self, window_s: float = 5.0,
+                      min_samples: int = 5) -> Optional[float]:
+        """p99 latency over requests completed in the trailing window
+        (all classes) — the autoscaler's reactive latency signal; the
+        engine-lifetime rolling quantiles stay in
+        :meth:`latency_stats`."""
+        now = time.monotonic()
+        with self._lat_lock:
+            vals = [lat for t, lat in self._lat_recent
+                    if now - t <= window_s]
+        if len(vals) < min_samples:
+            return None
+        return float(np.percentile(np.asarray(vals) * 1e3, 99))
+
+    def _class_recent_p99(self, name: str, window_s: float = 5.0,
+                          min_samples: int = 5) -> Optional[float]:
+        now = time.monotonic()
+        with self._lat_lock:
+            dq = self._class_lats.get(name)
+            vals = ([lat for t, lat in dq if now - t <= window_s]
+                    if dq else [])
+        if len(vals) < min_samples:
+            return None
+        return float(np.percentile(np.asarray(vals) * 1e3, 99))
+
+    def _on_scaled_out(self, worker_id: int) -> None:
+        """A worker exiting via scale-down frees its pinned-device slot
+        for whatever scale-up (or resurrection) comes next."""
+        with self._lock:
+            dev = self._dev_of.pop(worker_id, None)
+            if dev is not None:
+                self._dev_free.append(dev)
+
     # --- continuous-batching drain --------------------------------------
     def _next_request(self, timeout: float) -> Optional[_Request]:
         with self._stash_lock:
@@ -624,6 +1215,8 @@ class ServingEngine(ParallelInference):
                     self._dev_free.pop() if self._dev_free
                     else worker_id % len(self._devices))
         while not self._shutdown:
+            if self._take_scale_down(worker_id):
+                return     # scaled out at a batch boundary, nothing held
             first = self._next_request(0.1)
             if first is None:
                 continue
@@ -660,6 +1253,7 @@ class ServingEngine(ParallelInference):
         with self._lock:
             ordinal = self._batch_seq
             self._batch_seq += 1
+            self._last_dispatch_t = time.monotonic()
         # leg 2: the batch formed by continuous batching — emitted BEFORE
         # the dispatch drill site, so a killed dispatch still shows which
         # requests were aboard (the incident-reconstruction contract).
@@ -687,12 +1281,14 @@ class ServingEngine(ParallelInference):
         try:
             with prof.time_section("serving/dispatch"):
                 result = self._run_bucket(
-                    padded, self._dev_of.get(worker_id, 0))
+                    padded, self._dev_of.get(worker_id, 0),
+                    worker_id=worker_id)
         except faultinject.DeadReplicaFault as e:
             self._retire_serving(worker_id, e, batch)
             raise
         except Exception as e:
             prof.count("serving/batch_errors")
+            self._note_canary_result(worker_id, error=True)
             for r in batch:
                 if not r.fut.done():
                     r.fut.set_exception(e)
@@ -733,6 +1329,13 @@ class ServingEngine(ParallelInference):
                     latency_ms=round((t_done - r.t_enq) * 1e3, 3))
         with self._lat_lock:
             self._latencies.extend(lats)
+            self._lat_recent.extend((t_done, lat) for lat in lats)
+            for r, lat in zip(batch, lats):
+                if r.slo is not None:
+                    self._class_lats.setdefault(
+                        r.slo, collections.deque(maxlen=2048)
+                    ).append((t_done, lat))
+        self._note_canary_result(worker_id, lats=lats)
         prof.count("serving/requests", len(batch))
         prof.count("serving/batches")
         prof.count("serving/rows", rows)
@@ -811,8 +1414,248 @@ class ServingEngine(ParallelInference):
         padded, _w = pad_rows(probe, bucket)
         self._run_bucket(padded, dev)
 
+    # --- canaried train-to-serve handoff --------------------------------
+    _CANARY_PHASES = {"idle": 0, "canary": 1, "confirm": 2}
+
+    def _note_canary_result(self, worker_id: int, lats: Sequence[float] = (),
+                            error: bool = False) -> None:
+        """Feed one dispatch outcome into the live publication's SLO
+        evidence: during the canary phase only the canary replica's
+        samples count; after promote every replica serves the candidate
+        weights, so the whole fleet's do."""
+        can = self._canary
+        if can is None:
+            return
+        with self._lock:
+            can = self._canary
+            if can is None:
+                return
+            if can["phase"] == "canary" and can.get("worker") != worker_id:
+                return
+            if error:
+                can["errors"] += 1
+            else:
+                can["lats"].extend(lats)
+
+    def _set_canary_phase(self, phase: str) -> None:
+        OpProfiler.get().gauge("serving/canary_phase",
+                               self._CANARY_PHASES[phase])
+
+    def publish_checkpoint(self, path: str, canary_window_s: float = 3.0,
+                           confirm_window_s: Optional[float] = None,
+                           check_interval_s: float = 0.25,
+                           min_samples: int = 8,
+                           violation_p99_ms: Optional[float] = None
+                           ) -> PublishHandle:
+        """Canaried train-to-serve handoff: load retrained weights from a
+        committed checkpoint and hot-swap them — zero recompiles, the AOT
+        executables take params as arguments — onto ONE canary replica.
+        After an SLO-clean ``canary_window_s`` the weights PROMOTE
+        fleet-wide; a ``confirm_window_s`` watch follows, and any
+        violation (serving errors on the new weights, p99 over
+        ``violation_p99_ms`` — default: the top SLO class's budget — or
+        an injected ``serving/promote`` fault) AUTO-ROLLBACKS by
+        restoring the prior param set bitwise (the exact prior device
+        arrays, not a re-cast copy). When a p99 budget is in force the
+        promote additionally REQUIRES ``min_samples`` of canary evidence
+        — a canary replica that served nothing (retired, scaled out, or
+        simply idle) rolls back rather than promoting untested weights;
+        budget-less publications keep the time-based promote with
+        error-only violation detection. The returned handle's ``corr``
+        id (``pub<N>``) chains train-commit -> canary -> promote/
+        rollback in the flight recorder. One publication may be in
+        flight at a time; ``refresh_params()`` during a publication is
+        refused for the same reason."""
+        if not self._aot:
+            raise RuntimeError(
+                "publish_checkpoint needs an AOT-served model (the "
+                "generic-model fallback serves through model.output and "
+                "owns its own weights)")
+        # claim the publication slot FIRST (a refused publish must not
+        # burn a pub ordinal — drills arm fault plans against
+        # next_publication_ordinal() — nor pay the checkpoint read)
+        with self._lock:
+            if self._canary is not None:
+                raise RuntimeError(
+                    f"publication {self._canary['corr']} is still in "
+                    f"flight (phase {self._canary['phase']!r})")
+            self._canary = {"phase": "loading", "corr": "pending",
+                            "worker": None, "errors": 0, "lats": []}
+        try:
+            from ..util.checkpoint import read_checkpoint_params
+
+            params, states = read_checkpoint_params(
+                path, self.model._params, self.model._states)
+            params, states = self._cast_serving(params, states)
+            new_placed = self._place_params(params, states)
+            # the canary replica: any live worker that has claimed a
+            # device slot (they all do on their first drain iteration)
+            deadline = time.monotonic() + 5.0
+            worker = None
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if self._dev_of:
+                        worker = next(iter(self._dev_of))
+                        break
+                time.sleep(0.01)
+            if worker is None:
+                raise RuntimeError("no live serving worker to canary "
+                                   "onto")
+        except BaseException:
+            with self._lock:
+                self._canary = None
+            raise
+        prof = OpProfiler.get()
+        with _pub_lock:
+            ordinal = _pub_next[0]
+            _pub_next[0] += 1
+        with self._lock:
+            corr = f"pub{ordinal}"
+            handle = PublishHandle(corr, path)
+            slot = self._dev_of.get(worker, 0)
+            budget = violation_p99_ms
+            if budget is None and self._adm is not None:
+                budget = self._adm.top.p99_ms
+            self._canary = {
+                "ordinal": ordinal, "corr": corr,
+                "file": os.path.basename(path), "phase": "canary",
+                "worker": worker, "canary_params": new_placed[slot],
+                "new": new_placed, "prior": dict(self._dev_params),
+                "lats": [], "errors": 0, "budget_ms": budget,
+                "min_samples": int(min_samples), "handle": handle,
+            }
+        prof.count("serving/publications")
+        self._set_canary_phase("canary")
+        flightrec.event("serving/canary", corr=corr,
+                        file=os.path.basename(path), worker=worker,
+                        window_s=canary_window_s,
+                        budget_ms=budget)
+        t = threading.Thread(
+            target=self._canary_monitor,
+            args=(canary_window_s,
+                  canary_window_s if confirm_window_s is None
+                  else confirm_window_s,
+                  max(0.01, float(check_interval_s))),
+            daemon=True, name=f"dl4j-serving-canary-{ordinal}")
+        with self._lock:
+            # only one publication is ever in flight — drop the finished
+            # monitors so a long-lived engine with periodic publishes
+            # does not accumulate dead Thread objects
+            self._pub_threads = [x for x in self._pub_threads
+                                 if x.is_alive()]
+            self._pub_threads.append(t)
+        t.start()
+        return handle
+
+    def _canary_monitor(self, canary_window_s: float,
+                        confirm_window_s: float, interval_s: float) -> None:
+        with self._lock:
+            can = self._canary
+        if can is None:
+            return
+        deadline = time.monotonic() + canary_window_s
+        while time.monotonic() < deadline:
+            if self._shutdown:
+                self._rollback(can, "canary", "engine shutdown")
+                return
+            time.sleep(interval_s)
+            v = self._canary_violation(can)
+            if v:
+                self._rollback(can, "canary", v)
+                return
+        with self._lock:
+            evidence = len(can["lats"]) + can["errors"]
+            budget = can["budget_ms"]
+        if budget is not None and evidence < can["min_samples"]:
+            # an SLO budget is in force but the canary replica produced
+            # no judgeable evidence (no traffic reached it — e.g. it was
+            # retired or scaled out mid-window): promoting would ship
+            # UNTESTED weights, the exact failure the canary exists to
+            # prevent. Roll back instead; error-only publications (no
+            # budget) keep their time-based promote.
+            self._rollback(can, "canary",
+                           f"insufficient canary evidence: {evidence} "
+                           f"sample(s), need {can['min_samples']}")
+            return
+        # SLO-clean canary window: PROMOTE fleet-wide (atomic dict swap —
+        # in-flight batches finish on whichever complete set they read)
+        with self._lock:
+            self._dev_params = can["new"]
+            can["phase"] = "confirm"
+            can["lats"] = []         # confirm judges fresh fleet evidence
+            can["errors"] = 0
+        can["handle"].phase = "confirm"
+        self._set_canary_phase("confirm")
+        flightrec.event("serving/promote", corr=can["corr"],
+                        file=can["file"], replicas=self.alive_replicas())
+        deadline = time.monotonic() + confirm_window_s
+        while time.monotonic() < deadline:
+            if self._shutdown:
+                self._rollback(can, "confirm", "engine shutdown")
+                return
+            time.sleep(interval_s)
+            try:
+                # the forced-violation drill site: a transient here is
+                # "the promoted weights are violating" (publication
+                # ordinal-indexed, so drills pick their publication)
+                faultinject.fault_point("serving/promote", can["ordinal"])
+            except faultinject.TransientFault as e:
+                self._rollback(can, "confirm", f"injected violation: {e}")
+                return
+            v = self._canary_violation(can)
+            if v:
+                self._rollback(can, "confirm", v)
+                return
+        with self._lock:
+            self._canary = None
+        prof = OpProfiler.get()
+        prof.count("serving/promotions")
+        self._set_canary_phase("idle")
+        can["handle"]._finish("promoted")
+        logger.info("serving publication %s promoted fleet-wide (%s)",
+                    can["corr"], can["file"])
+
+    def _canary_violation(self, can: Dict[str, Any]) -> Optional[str]:
+        with self._lock:
+            errors = can["errors"]
+            lats = list(can["lats"])
+            budget = can["budget_ms"]
+            need = can["min_samples"]
+        if errors:
+            return f"{errors} serving error(s) on the candidate weights"
+        if budget is not None and len(lats) >= need:
+            p99 = float(np.percentile(np.asarray(lats) * 1e3, 99))
+            if p99 > budget:
+                return (f"p99 {p99:.1f}ms over the {budget:.0f}ms budget "
+                        f"({len(lats)} samples)")
+        return None
+
+    def _rollback(self, can: Dict[str, Any], phase: str,
+                  reason: str) -> None:
+        """Restore the prior param set BITWISE: the rollback re-installs
+        the exact prior device arrays (kept, not re-derived), so a
+        post-rollback read is indistinguishable from never publishing."""
+        with self._lock:
+            self._dev_params = can["prior"]
+            self._canary = None
+        prof = OpProfiler.get()
+        prof.count("serving/rollbacks")
+        self._set_canary_phase("idle")
+        flightrec.event("serving/rollback", severity="warn",
+                        corr=can["corr"], file=can["file"], phase=phase,
+                        reason=str(reason)[:200])
+        logger.warning("serving publication %s rolled back during %s: %s",
+                       can["corr"], phase, reason)
+        can["handle"]._finish("rolled_back")
+
     def shutdown(self, drain_timeout_s: float = 2.0) -> None:
         super().shutdown(drain_timeout_s)
+        # canary monitors observe _shutdown and resolve their handles
+        for t in list(self._pub_threads):
+            t.join(timeout=1.0)
+        bt = self._brownout._thread if self._brownout else None
+        if bt is not None:
+            bt.join(timeout=1.0)
         # out of the health census: a shut-down engine must not report
         # itself (or its stale latency window) as live serving capacity
         _ENGINES.discard(self)
@@ -846,15 +1689,25 @@ class ServingEngine(ParallelInference):
 
     def serving_stats(self) -> Dict[str, Any]:
         """This engine's census for :func:`serving_health`: pool
-        live/retired/resurrected, bucket/warmup state, queue-depth
-        high-water, rolling latency quantiles."""
+        live/retired/resurrected, bucket/warmup state, the windowed
+        queue-depth high-water + lifetime peak, admission/brownout state,
+        the canary phase, rolling latency quantiles."""
         out: Dict[str, Any] = dict(self.pool_stats())
         out.update(self.latency_stats())
         with self._exec_lock:
             out["buckets_compiled"] = len(self._exec)
         out["warm"] = self._warm
-        out["queue_depth_hwm"] = self._hwm
+        out["queue_depth_hwm"] = self.queue_depth_hwm()   # windowed
+        out["queue_depth_peak"] = self._q_peak            # lifetime
         out["bf16"] = self._bf16
+        if self._adm is not None:
+            out["admission"] = self._adm.stats()
+        with self._lock:
+            can = self._canary
+            out["canary_phase"] = can["phase"] if can else "idle"
+            if can:
+                out["canary_corr"] = can["corr"]
+        self._publish_queue_gauges()    # reads refresh the fleet gauges
         return out
 
 

@@ -15,11 +15,15 @@ A stdlib ``http.server`` on a background thread serving:
                           counter/gauge/ledger, serving latency
                           quantiles, and the flight-recorder totals
                           (:func:`prometheus_text`)
-- ``/api/infer``        — POST ``{"inputs": [[...], ...]}`` → the attached
+- ``/api/infer``        — POST ``{"inputs": [[...], ...]}`` (optional
+                          ``"slo_class"``) → the attached
                           :class:`parallel.serving.ServingEngine` (bucketed,
                           AOT-compiled, deadline-bounded); response carries
                           outputs + server-side latency. 503 until
-                          ``attach_serving`` wires an engine.
+                          ``attach_serving`` wires an engine; a load shed
+                          (brownout / class queue budget) is a synchronous
+                          429 with ``Retry-After`` from the measured queue
+                          drain rate.
 
 Any attached :class:`InMemoryStatsStorage` (queried live) or JSONL path
 written by :class:`FileStatsStorage` (re-read per request) feeds the
@@ -494,10 +498,13 @@ class UIServer:
             def log_message(self, *a):    # quiet
                 pass
 
-            def _send(self, body: bytes, ctype: str, code: int = 200):
+            def _send(self, body: bytes, ctype: str, code: int = 200,
+                      headers: Optional[Dict[str, str]] = None):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -538,9 +545,11 @@ class UIServer:
                 # (ThreadingHTTPServer) feeds the engine's continuous
                 # batcher, so concurrent HTTP clients coalesce into
                 # shared bucket dispatches exactly like direct callers.
+                import math
+
                 import numpy as np
 
-                from ..parallel.serving import OversizeRequest
+                from ..parallel.serving import Overloaded, OversizeRequest
 
                 engine = getattr(ui, "_serving", None)
                 if engine is None:
@@ -552,13 +561,27 @@ class UIServer:
                     n = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(n).decode())
                     inputs = np.asarray(body["inputs"], dtype=np.float32)
+                    slo = body.get("slo_class")
                 except (ValueError, KeyError, TypeError) as e:
                     self._send(f"bad request: {e}".encode(), "text/plain",
                                400)
                     return
                 t0 = time.monotonic()
                 try:
-                    out = engine.output(inputs)
+                    # kwarg only when classified: a plain
+                    # ParallelInference behind this endpoint accepts no
+                    # slo_class, and must keep working unclassified
+                    out = (engine.output(inputs, slo_class=slo)
+                           if slo is not None else engine.output(inputs))
+                except Overloaded as e:
+                    # the load-shed contract: synchronous 429 with a
+                    # Retry-After derived from the measured queue drain
+                    # rate (integer seconds per RFC 9110, rounded up)
+                    self._send(
+                        str(e).encode(), "text/plain", 429,
+                        headers={"Retry-After":
+                                 str(max(1, math.ceil(e.retry_after_s)))})
+                    return
                 except OversizeRequest as e:
                     self._send(str(e).encode(), "text/plain", 413)
                     return

@@ -48,7 +48,7 @@ import os
 import queue
 import threading
 import zipfile
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -551,6 +551,25 @@ def read_resume_state(path: str) -> Dict[str, Any]:
         if RESUME_ENTRY not in zf.namelist():
             return {}
         return json.loads(zf.read(RESUME_ENTRY))
+
+
+def read_checkpoint_params(path: str, params_template, states_template
+                           ) -> Tuple[Any, Any]:
+    """Read JUST (params, states) from a checkpoint/model zip against
+    the given templates — the serving tier's canaried
+    ``publish_checkpoint`` loads candidate weights WITHOUT constructing
+    or mutating a training model (and without touching the RNG stream,
+    updater state, or pipeline cursor a full restore carries). Host
+    trees; the caller owns device placement."""
+    from .model_serializer import (_COEFF_ENTRY, _STATES_ENTRY,
+                                   _load_into_tree)
+
+    with zipfile.ZipFile(path) as zf:
+        params = _load_into_tree(zf.read(_COEFF_ENTRY), params_template,
+                                 "coefficient")
+        states = _load_into_tree(zf.read(_STATES_ENTRY), states_template,
+                                 "state")
+    return params, states
 
 
 def restore_training_state(model, path: str, listeners=None,

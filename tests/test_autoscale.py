@@ -1,0 +1,571 @@
+"""Overload-safe serving tests (ISSUE 11): SLO-class admission control
+(shed order strictly lowest-class-first, synchronous 429 + Retry-After
+from the measured drain rate, per-class queue budgets), brownout
+hysteresis, online worker scaling (``scale_to`` + the closed-loop
+Autoscaler), and the canaried train-to-serve handoff
+(``publish_checkpoint``: canary -> promote on an SLO-clean window,
+forced-violation -> BITWISE rollback with zero failed gold requests).
+The load-replay version with hard SLO gates is ``bench.py --config
+autoscale-smoke``.
+
+Deterministic drills for the three new fault sites live here:
+``serving/admission`` (transient = that request is shed — the 429
+drill), ``autoscale/decide`` (transient = one controller tick skipped),
+``serving/promote`` (transient = the promoted weights "violate" ->
+auto-rollback).
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common import faultinject, flightrec
+from deeplearning4j_tpu.common.profiler import OpProfiler
+from deeplearning4j_tpu.data import NDArrayDataSetIterator
+from deeplearning4j_tpu.learning import Adam
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.parallel import (AutoscalePolicy, Autoscaler,
+                                         BrownoutController, Overloaded,
+                                         ServingEngine, SLOClass)
+from deeplearning4j_tpu.optimize.listeners import CheckpointListener
+from deeplearning4j_tpu.parallel.serving import AdmissionController
+from deeplearning4j_tpu.util.checkpoint import (committed_checkpoints,
+                                                read_checkpoint_params)
+
+
+def mlp(seed=1, n_in=4, n_out=3):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(0.05))
+            .activation("tanh").list()
+            .layer(L.DenseLayer(n_out=16))
+            .layer(L.OutputLayer(n_out=n_out))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+CLASSES = [SLOClass("gold", 2, 250.0, queue_budget=64),
+           SLOClass("silver", 1, 400.0, queue_budget=32),
+           SLOClass("batch", 0, 1000.0, queue_budget=32)]
+
+
+def build_engine(model=None, workers=1, classes=True, **kw):
+    b = (ServingEngine.Builder(model or mlp())
+         .buckets(kw.pop("buckets", (1, 2, 4, 8)))
+         .input_shape((4,))
+         .workers(workers).max_wait_ms(kw.pop("max_wait_ms", 2.0))
+         .request_timeout_ms(kw.pop("request_timeout_ms", 15000)))
+    if classes:
+        b.slo_classes([SLOClass(c.name, c.priority, c.p99_ms,
+                                c.queue_budget) for c in CLASSES],
+                      default=kw.pop("default", None))
+        # a LONG controller interval: tests drive shed levels and
+        # evaluations deterministically, the background thread must not
+        # fight them mid-assert
+        b.brownout(interval_s=kw.pop("brownout_interval_s", 60.0))
+    assert not kw, kw
+    return b.build()
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faultinject.clear_plan()
+    yield
+    faultinject.clear_plan()
+
+
+@pytest.fixture(scope="module")
+def ckpts(tmp_path_factory):
+    """Two committed checkpoints of the serving MLP's configuration with
+    DIFFERENT trained weights — the publish drills' candidates."""
+    d = str(tmp_path_factory.mktemp("autoscale_ckpts"))
+    m = mlp(seed=9)
+    rng = np.random.RandomState(3)
+    x = rng.randn(32, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 32)]
+    it = NDArrayDataSetIterator(x, y, batch_size=8)
+    cl = CheckpointListener(d, save_every_n_iterations=2, keep_last=4)
+    m.set_listeners(cl)
+    m.fit(it, epochs=2)
+    cl.close()
+    paths = committed_checkpoints(d)
+    assert len(paths) >= 2
+    return paths[-2:]
+
+
+def leaves_of(dev_params):
+    """Owning host copies of one (params, states) slot's leaves."""
+    return [np.array(a) for a in jax.tree.leaves(dev_params)]
+
+
+class TestSLOClassValidation:
+    def test_class_and_controller_validation(self):
+        with pytest.raises(ValueError, match="p99_ms"):
+            SLOClass("x", 0, 0.0)
+        with pytest.raises(ValueError, match="duplicate"):
+            AdmissionController([SLOClass("a", 0, 1), SLOClass("a", 1, 1)])
+        with pytest.raises(ValueError, match="priorities must be unique"):
+            AdmissionController([SLOClass("a", 0, 1), SLOClass("b", 0, 1)])
+        with pytest.raises(ValueError, match="default"):
+            AdmissionController([SLOClass("a", 0, 1)], default="nope")
+        adm = AdmissionController([SLOClass(c.name, c.priority, c.p99_ms)
+                                   for c in CLASSES])
+        assert adm.top.name == "gold"
+        assert adm.default == "gold"      # unclassified -> top class
+        with pytest.raises(ValueError, match="unknown SLO class"):
+            adm.resolve("platinum")
+
+    def test_slo_class_without_config_is_refused(self):
+        eng = build_engine(classes=False)
+        try:
+            with pytest.raises(ValueError, match="no SLO classes"):
+                eng.output_async(np.zeros((1, 4), np.float32),
+                                 slo_class="gold")
+        finally:
+            eng.shutdown()
+
+
+class TestAdmission:
+    def test_shed_order_strictly_lowest_class_first(self):
+        """Level 1 sheds ONLY batch; level 2 sheds batch+silver; gold is
+        never shed (levels clamp below the top class)."""
+        prof = OpProfiler.get()
+        eng = build_engine()
+        x = np.zeros((1, 4), np.float32)
+        try:
+            adm = eng._adm
+            assert adm.set_level(1, reason="drill") == 1
+            assert flightrec.events("serving/shed"), \
+                "level change must emit a serving/shed event"
+            with pytest.raises(Overloaded) as ei:
+                eng.output(x, slo_class="batch")
+            assert ei.value.reason == "brownout"
+            assert ei.value.retry_after_s > 0
+            eng.output(x, slo_class="silver")           # still admitted
+            eng.output(x, slo_class="gold")
+            assert adm.set_level(2, reason="drill") == 2
+            with pytest.raises(Overloaded):
+                eng.output(x, slo_class="batch")
+            with pytest.raises(Overloaded):
+                eng.output(x, slo_class="silver")
+            eng.output(x, slo_class="gold")             # never shed
+            assert adm.set_level(99, reason="drill") == 2   # clamped
+            eng.output(x)                               # default = gold
+            assert prof.counter_value("serving/shed/batch") >= 2
+            assert prof.counter_value("serving/shed/silver") >= 1
+            assert prof.counter_value("serving/shed/gold") == 0
+            stats = eng.serving_stats()
+            assert stats["admission"]["level"] == 2
+            assert stats["admission"]["shed"] == ["batch", "silver"]
+            adm.set_level(0, reason="drill over")
+        finally:
+            eng.shutdown()
+
+    def test_queue_budget_backpressure(self):
+        """A class at its queue budget sheds ITS OWN next request
+        synchronously (reason queue_budget) instead of flooding the
+        shared queue; completions free the budget again."""
+        eng = build_engine()
+        x = np.zeros((1, 4), np.float32)
+        try:
+            small = eng._adm.by_name["batch"]
+            small.queue_budget = 2
+            # wedge dispatches so submissions stay outstanding
+            faultinject.set_plan(faultinject.FaultPlan(
+                [{"site": "serving/dispatch", "kind": "slow",
+                  "seconds": 0.25, "times": 8}]))
+            futs = [eng.output_async(x, slo_class="batch")
+                    for _ in range(2)]
+            with pytest.raises(Overloaded) as ei:
+                eng.output_async(x, slo_class="batch")
+            assert ei.value.reason == "queue_budget"
+            eng.output_async(x, slo_class="gold")   # other budgets intact
+            for f in futs:
+                f.result(timeout=15)
+            faultinject.clear_plan()
+            eng.output(x, slo_class="batch")        # budget freed
+        finally:
+            faultinject.clear_plan()
+            eng.shutdown()
+
+    def test_retry_after_tracks_backlog_over_drain_rate(self):
+        adm = AdmissionController([SLOClass(c.name, c.priority, c.p99_ms)
+                                   for c in CLASSES])
+        # no completions observed: pessimistic fallback, bounded
+        assert 0 < adm.retry_after_s() <= 30.0
+        now = time.monotonic()
+        for _ in range(50):                 # 50 completions in-window
+            adm._done.append(now)
+        for _ in range(20):
+            adm.note_queued("gold")         # 20 outstanding
+        ra = adm.retry_after_s()            # ~20 / (50/5s) = ~2s
+        assert 1.0 <= ra <= 4.0
+        for _ in range(20):
+            adm.note_queued("silver")       # deeper backlog -> longer
+        assert adm.retry_after_s() > ra * 1.5
+
+    def test_admission_fault_drill_is_deterministic(self):
+        """The ``serving/admission`` drill: a transient at request
+        ordinal k sheds exactly request k with a synchronous Overloaded
+        (what the HTTP tier maps to 429)."""
+        prof = OpProfiler.get()
+        eng = build_engine()
+        x = np.zeros((1, 4), np.float32)
+        try:
+            base = eng._admit_seq
+            faultinject.set_plan(faultinject.FaultPlan(
+                [{"site": "serving/admission", "kind": "transient",
+                  "index": base + 1}]))
+            eng.output(x, slo_class="gold")             # ordinal base: ok
+            with pytest.raises(Overloaded) as ei:       # base+1: shed
+                eng.output(x, slo_class="gold")
+            assert ei.value.reason == "fault"
+            eng.output(x, slo_class="gold")             # base+2: ok
+            assert prof.counter_value(
+                "faults/serving/admission/transient") >= 1
+        finally:
+            faultinject.clear_plan()
+            eng.shutdown()
+
+    def test_http_429_with_retry_after_header(self):
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        eng = build_engine()
+        ui = UIServer().attach_serving(eng)
+        port = ui.enable(0)
+        base = f"http://127.0.0.1:{port}"
+
+        def post(payload):
+            req = urllib.request.Request(
+                base + "/api/infer", data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            return urllib.request.urlopen(req, timeout=15)
+
+        x = np.zeros((1, 4), np.float32).tolist()
+        try:
+            eng._adm.set_level(2, reason="http drill")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post({"inputs": x, "slo_class": "batch"})
+            assert ei.value.code == 429
+            assert int(ei.value.headers["Retry-After"]) >= 1
+            assert "shed" in ei.value.read().decode()
+            # gold still serves through the same brownout
+            with post({"inputs": x, "slo_class": "gold"}) as r:
+                assert json.loads(r.read())["shape"] == [1, 3]
+            # unknown class is a client error, not a shed
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post({"inputs": x, "slo_class": "platinum"})
+            assert ei.value.code == 400
+        finally:
+            eng._adm.set_level(0, reason="http drill over")
+            ui.stop()
+            ui.detach_all()
+            eng.shutdown()
+
+
+class TestBrownout:
+    def test_hysteresis_raises_fast_clears_slow_never_flaps(self):
+        eng = build_engine()
+        try:
+            ctl = BrownoutController(eng, eng._adm, depth_trigger=10,
+                                     clear_ticks=3, hysteresis_frac=0.7)
+            adm = eng._adm
+            budget = adm.top.p99_ms                      # gold: 250ms
+            # overload: one level per evaluation, bottom-up
+            assert ctl.evaluate(p99_ms=budget * 2, depth=0) == 1
+            assert adm.shed_names() == ["batch"]
+            assert ctl.evaluate(p99_ms=None, depth=50) == 2
+            assert adm.shed_names() == ["batch", "silver"]
+            # the top class is NEVER shed, however hard it is violated
+            assert ctl.evaluate(p99_ms=budget * 10, depth=999) == 2
+            # recovery needs clear_ticks CONSECUTIVE clean evaluations
+            assert ctl.evaluate(p99_ms=budget * 0.5, depth=0) == 2
+            assert ctl.evaluate(p99_ms=budget * 0.5, depth=0) == 2
+            # a dirty tick in between resets the clean streak
+            assert ctl.evaluate(p99_ms=budget * 0.9, depth=0) == 2
+            assert ctl.evaluate(p99_ms=budget * 0.5, depth=0) == 2
+            assert ctl.evaluate(p99_ms=budget * 0.5, depth=0) == 2
+            assert ctl.evaluate(p99_ms=budget * 0.5, depth=0) == 1
+            assert adm.shed_names() == ["batch"]
+        finally:
+            eng.shutdown()
+
+
+class TestScaleTo:
+    def test_scale_up_and_down_online_zero_recompiles(self):
+        prof = OpProfiler.get()
+        eng = build_engine(workers=1, classes=False)
+        x = np.random.randn(3, 4).astype(np.float32)
+        try:
+            eng.output(x)
+            traces0 = prof.counter_value("trace/serving_infer")
+            assert eng.scale_to(3, reason="test") == 3
+            deadline = time.monotonic() + 5
+            while eng.alive_replicas() != 3 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert eng.alive_replicas() == 3
+            for _ in range(6):
+                eng.output(x)
+            # grown workers reuse the SAME AOT executables: recompiles
+            # stay at one-per-bucket at any replica count
+            assert prof.counter_value("trace/serving_infer") == traces0
+            assert prof.counter_value("serving/traces_after_warmup") == 0
+            eng.scale_to(1, reason="test")
+            deadline = time.monotonic() + 5
+            while eng.alive_replicas() != 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            stats = eng.pool_stats()
+            assert stats["alive"] == 1 and stats["target"] == 1
+            assert stats["scaled_down"] == 2
+            eng.output(x)                      # the survivor still serves
+        finally:
+            eng.shutdown()
+
+
+class TestAutoscaler:
+    SIG = {"alive": 2, "queue_hwm": 0, "p99_ms": None,
+           "top_budget_ms": 250.0, "idle_s": 0.0, "fill_ratio": 0.9}
+
+    def test_decide_control_law(self):
+        eng = build_engine(workers=1, classes=False)
+        try:
+            pol = AutoscalePolicy(min_workers=1, max_workers=4,
+                                  up_queue_depth=8, up_p99_frac=0.8,
+                                  down_idle_s=2.0, cooldown_up_s=1.0,
+                                  cooldown_down_s=3.0)
+            a = Autoscaler(eng, pol)
+            d = dict(self.SIG)
+            assert a.decide(d)["target"] == 2                 # steady
+            assert a.decide({**d, "queue_hwm": 8})["target"] == 3
+            assert a.decide({**d, "p99_ms": 240.0})["target"] == 3
+            assert a.decide({**d, "queue_hwm": 8,
+                             "alive": 4})["target"] == 4      # max clamp
+            assert a.decide({**d, "idle_s": 3.0})["target"] == 1
+            assert a.decide({**d, "idle_s": 3.0,
+                             "alive": 1})["target"] == 1      # min clamp
+            # fill-ratio scale-down: capacity provably exceeds demand
+            assert a.decide({**d, "fill_ratio": 0.1})["target"] == 1
+            # cooldowns hold the line right after an action
+            now = time.monotonic()
+            a._last_up_t = now
+            assert a.decide({**d, "queue_hwm": 8},
+                            now=now + 0.5)["reason"] == "cooldown_up"
+            assert a.decide({**d, "idle_s": 3.0},
+                            now=now + 1.0)["reason"] == "cooldown_down"
+            assert a.decide({**d, "queue_hwm": 8},
+                            now=now + 1.5)["target"] == 3
+        finally:
+            eng.shutdown()
+
+    def test_tick_scales_up_on_backlog_then_down_when_idle(self):
+        prof = OpProfiler.get()
+        eng = build_engine(workers=1, classes=False)
+        try:
+            eng._qwin_s = 0.1
+            pol = AutoscalePolicy(min_workers=1, max_workers=2,
+                                  up_queue_depth=4, down_idle_s=0.1,
+                                  cooldown_up_s=0.0, cooldown_down_s=0.0)
+            a = Autoscaler(eng, pol)
+            eng._qwin_update(6)             # a measured backlog spike
+            flightrec.reset()
+            assert a.tick() == 2            # autoscale/decide span + scale
+            deadline = time.monotonic() + 5
+            while eng.alive_replicas() != 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert eng.alive_replicas() == 2
+            evs = {e["name"] for e in flightrec.events()}
+            assert "autoscale/decide" in evs and "autoscale/scale" in evs
+            dec = flightrec.events("autoscale/decide")[0]
+            assert dec["attrs"]["queue_hwm"] == 6   # inputs ride as attrs
+            assert prof.counter_value("autoscale/replicas") == 2
+            assert prof.counter_value("autoscale/scale_ups") >= 1
+            time.sleep(0.25)                # hwm decays + engine idles
+            assert a.tick() == 1
+            assert prof.counter_value("autoscale/scale_downs") >= 1
+            ledger = prof.autoscale_stats()
+            assert ledger["ticks"] >= 2 and ledger["replicas"] == 1
+            assert "autoscale" in prof.ledger_stats()
+        finally:
+            eng.shutdown()
+
+    def test_decide_fault_drill_skips_one_tick(self):
+        prof = OpProfiler.get()
+        eng = build_engine(workers=1, classes=False)
+        try:
+            a = Autoscaler(eng, AutoscalePolicy(min_workers=1,
+                                                max_workers=2))
+            errs0 = prof.counter_value("autoscale/decide_errors")
+            faultinject.set_plan(faultinject.FaultPlan(
+                [{"site": "autoscale/decide", "kind": "transient",
+                  "index": 0}]))
+            assert a.tick() is None         # drilled tick: skipped, counted
+            assert prof.counter_value("autoscale/decide_errors") == errs0 + 1
+            assert a.tick() is None         # next tick evaluates normally
+            assert prof.counter_value("autoscale/ticks") >= 2
+        finally:
+            faultinject.clear_plan()
+            eng.shutdown()
+
+    def test_metrics_export_replicas_sheds_canary_phase(self):
+        """ISSUE 11 satellite: autoscaler state is on /api/metrics —
+        the replica gauge, per-class shed counters, canary phase."""
+        from deeplearning4j_tpu.ui.server import prometheus_text
+
+        prof = OpProfiler.get()
+        prof.gauge("autoscale/replicas", 2)
+        prof.count("serving/shed/batch")
+        prof.gauge("serving/canary_phase", 0)
+        text = prometheus_text()
+        assert 'dl4j_gauge{name="autoscale/replicas"} 2' in text
+        assert 'name="serving/shed/batch"' in text
+        assert 'name="serving/canary_phase"' in text
+
+
+class TestCanaryPublish:
+    def test_canary_promote_leaves_correlation_chain(self, ckpts):
+        prof = OpProfiler.get()
+        eng = build_engine(workers=2, classes=True)
+        x = np.random.randn(2, 4).astype(np.float32)
+        try:
+            eng.output(x)
+            traces0 = prof.counter_value("trace/serving_infer")
+            flightrec.reset()
+            h = eng.publish_checkpoint(ckpts[0], canary_window_s=0.3,
+                                       confirm_window_s=0.3,
+                                       check_interval_s=0.05)
+            assert h.corr.startswith("pub")
+            # serving continues (and feeds SLO evidence) mid-canary
+            while not h.done:
+                eng.output(x, slo_class="gold")
+            assert h.result(timeout=10) == "promoted"
+            # the promoted fleet serves the CHECKPOINT weights, bitwise
+            want_p, want_s = read_checkpoint_params(
+                ckpts[0], eng.model._params, eng.model._states)
+            got = jax.tree.leaves(eng._dev_params[0])
+            want = jax.tree.leaves((want_p, want_s))
+            assert all(np.array_equal(np.asarray(g), np.asarray(w))
+                       for g, w in zip(got, want))
+            # zero recompiles: publication swaps executable ARGUMENTS
+            assert prof.counter_value("trace/serving_infer") == traces0
+            # correlation chain: canary -> promote under one pub id,
+            # naming the checkpoint file (which chains to the
+            # checkpoint/commit event the training run emitted)
+            chain = [e["name"] for e in flightrec.events(corr=h.corr)]
+            assert chain.index("serving/canary") \
+                < chain.index("serving/promote")
+            canary_ev = flightrec.events("serving/canary", corr=h.corr)[0]
+            assert canary_ev["attrs"]["file"] == os.path.basename(ckpts[0])
+            assert eng.serving_stats()["canary_phase"] == "idle"
+            assert prof.counter_value("serving/promotions") >= 1
+            eng.refresh_params()       # allowed again once resolved
+        finally:
+            eng.shutdown()
+
+    def test_forced_violation_rolls_back_bitwise_zero_gold_failures(
+            self, ckpts):
+        """The rollback drill: an injected ``serving/promote`` transient
+        marks the promoted weights as violating; rollback must restore
+        the prior params BITWISE while concurrent gold traffic sees zero
+        failures and zero sheds."""
+        prof = OpProfiler.get()
+        eng = build_engine(workers=2, classes=True)
+        x = np.random.randn(2, 4).astype(np.float32)
+        try:
+            eng.output(x, slo_class="gold")
+            prior = leaves_of(eng._dev_params[0])
+            gold_shed0 = prof.counter_value("serving/shed/gold")
+            from deeplearning4j_tpu.parallel.serving import \
+                next_publication_ordinal
+            ordinal = next_publication_ordinal()
+            faultinject.set_plan(faultinject.FaultPlan(
+                [{"site": "serving/promote", "kind": "transient",
+                  "index": ordinal}]))
+            flightrec.reset()
+            h = eng.publish_checkpoint(ckpts[1], canary_window_s=0.25,
+                                       confirm_window_s=2.0,
+                                       check_interval_s=0.05)
+            failures = []
+            while not h.done:
+                try:
+                    eng.output(x, slo_class="gold")
+                except Exception as e:       # noqa: BLE001 — drill census
+                    failures.append(e)
+            assert h.result(timeout=10) == "rolled_back"
+            assert not failures, f"gold requests failed: {failures[:3]}"
+            assert prof.counter_value("serving/shed/gold") == gold_shed0
+            # BITWISE: the exact prior arrays are back
+            after = leaves_of(eng._dev_params[0])
+            assert len(after) == len(prior)
+            assert all(np.array_equal(a, b)
+                       for a, b in zip(after, prior))
+            names = [e["name"] for e in flightrec.events(corr=h.corr)]
+            assert "serving/canary" in names
+            assert "serving/promote" in names     # it DID promote first
+            assert "serving/rollback" in names
+            rb = flightrec.events("serving/rollback", corr=h.corr)[0]
+            assert rb["attrs"]["phase"] == "confirm"
+            assert prof.counter_value("serving/rollbacks") >= 1
+            assert prof.counter_value(
+                "faults/serving/promote/transient") >= 1
+        finally:
+            faultinject.clear_plan()
+            eng.shutdown()
+
+    def test_canary_phase_violation_aborts_before_promote(self, ckpts):
+        """A violation DURING the canary window (here: an impossible p99
+        budget) rolls back without ever touching the fleet params."""
+        eng = build_engine(workers=1, classes=True)
+        x = np.random.randn(1, 4).astype(np.float32)
+        try:
+            fleet_before = eng._dev_params[0]
+            h = eng.publish_checkpoint(ckpts[0], canary_window_s=5.0,
+                                       check_interval_s=0.05,
+                                       min_samples=1,
+                                       violation_p99_ms=1e-6)
+            while not h.done:                # canary serves -> violates
+                eng.output(x, slo_class="gold")
+            assert h.result(timeout=10) == "rolled_back"
+            # never promoted: the fleet slot still holds the EXACT prior
+            # (params, states) object, not a restored copy of it
+            assert eng._dev_params[0] is fleet_before
+            rb = flightrec.events("serving/rollback", corr=h.corr)[0]
+            assert rb["attrs"]["phase"] == "canary"
+        finally:
+            eng.shutdown()
+
+    def test_idle_canary_rolls_back_instead_of_promoting_untested(
+            self, ckpts):
+        """With an SLO budget in force, a canary that served NOTHING
+        (idle engine — same evidence picture as a retired canary
+        replica) must roll back, not promote untested weights."""
+        eng = build_engine(workers=1, classes=True)
+        try:
+            before = eng._dev_params[0]
+            h = eng.publish_checkpoint(ckpts[0], canary_window_s=0.2,
+                                       check_interval_s=0.05)
+            assert h.result(timeout=10) == "rolled_back"
+            assert eng._dev_params[0] is before
+            rb = flightrec.events("serving/rollback", corr=h.corr)[0]
+            assert "insufficient canary evidence" in rb["attrs"]["reason"]
+        finally:
+            eng.shutdown()
+
+    def test_refresh_params_refused_mid_publication(self, ckpts):
+        eng = build_engine(workers=1, classes=False)
+        try:
+            h = eng.publish_checkpoint(ckpts[0], canary_window_s=0.4,
+                                       confirm_window_s=0.1,
+                                       check_interval_s=0.05)
+            with pytest.raises(RuntimeError, match="refresh_params "
+                                                   "refused"):
+                eng.refresh_params()
+            assert h.result(timeout=10) == "promoted"
+        finally:
+            eng.shutdown()

@@ -464,17 +464,36 @@ class TestServingEngine:
         eng.shutdown()
         assert serving_health()["engines"] == n0 - 1
 
-    def test_queue_depth_gauge_is_fleet_max(self):
-        """The shared queue-depth gauge only RISES: a lightly-loaded
-        engine must not overwrite another engine's backlog high-water."""
+    def test_queue_depth_hwm_is_windowed_and_peak_is_lifetime(self):
+        """ISSUE 11 satellite: the queue-depth high-water mark is a
+        DECAYING windowed signal (usable for scale-down — the old
+        only-rising fleet max could never fall), while the lifetime
+        maximum survives separately as ``queue_depth_peak``."""
         prof = OpProfiler.get()
-        prof.gauge("serving/queue_depth_hwm", 50)     # engine A's backlog
         eng = build_engine(buckets=(1,))
         try:
-            eng.output(np.zeros((1, 4), np.float32))  # this engine: HWM 1
+            eng._qwin_s = 0.05          # tiny windows so decay is fast
+            eng._qwin_update(50)        # a backlog spike
+            assert eng.queue_depth_hwm() == 50
+            assert eng.queue_depth_peak == 50
+            stats = eng.serving_stats()
+            assert stats["queue_depth_hwm"] == 50
+            assert stats["queue_depth_peak"] == 50
+            # the fleet gauges reflect it (windowed gauge = fleet max of
+            # windowed values; peak gauge only ever rises)
             assert prof.counter_value("serving/queue_depth_hwm") == 50
+            assert prof.counter_value("serving/queue_depth_peak") >= 50
+            time.sleep(0.12)            # > 2 windows: the spike ages out
+            assert eng.queue_depth_hwm() == 0
+            assert eng.queue_depth_peak == 50      # lifetime max persists
+            stats = eng.serving_stats()
+            assert stats["queue_depth_hwm"] == 0
+            assert stats["queue_depth_peak"] == 50
+            # the shared windowed gauge FELL with the backlog...
+            assert prof.counter_value("serving/queue_depth_hwm") < 50
+            # ...and the lifetime peak gauge did not
+            assert prof.counter_value("serving/queue_depth_peak") >= 50
         finally:
-            prof.gauge("serving/queue_depth_hwm", 0)
             eng.shutdown()
 
     def test_resurrected_replica_reclaims_freed_device_slot(self):

@@ -500,7 +500,8 @@ class TestReplicaResurrection:
                 time.sleep(0.02)
             stats = pi.pool_stats()
             assert stats == {"workers": 2, "alive": 2, "retired": 1,
-                             "resurrected": 1}
+                             "resurrected": 1, "target": 2,
+                             "scaled_down": 0}
             assert pi.output(np.zeros((3, 4), np.float32)).shape == (3, 2)
             prof = OpProfiler.get()
             assert prof.counter_value("inference/replica_resurrected") == 1

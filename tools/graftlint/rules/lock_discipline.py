@@ -41,6 +41,12 @@ SHARED_CLASSES: Dict[str, Dict[str, Set[str]]] = {
     "ParallelInference": {"locks": {"_lock"}, "allow": set()},
     "ServingEngine": {"locks": {"_lock", "_exec_lock", "_lat_lock"},
                       "allow": set()},
+    # admission controller: request threads admit/complete while the
+    # brownout controller moves the shed level
+    "AdmissionController": {"locks": {"_lock"}, "allow": set()},
+    # autoscaler: the controller thread ticks while callers read stats
+    # and drills call tick() directly
+    "Autoscaler": {"locks": {"_lock"}, "allow": set()},
     # checkpoint writer: training thread submits, daemon thread commits
     "CheckpointWriter": {"locks": {"_cond", "_lock"}, "allow": set()},
     "CheckpointListener": {"locks": {"_lock"}, "allow": set()},
