@@ -2616,6 +2616,221 @@ def bench_obs_smoke(steps: int, batch: int = 64) -> dict:
     }
 
 
+def _fleet_mlp(seed=7, n_in=64, n_out=10, hidden=32, lr=1e-3):
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf import layers as L
+
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(learning_rate=lr)).activation("tanh")
+            .weight_init("xavier").list()
+            .layer(L.DenseLayer(n_out=hidden))
+            .layer(L.OutputLayer(n_out=n_out, loss="mse",
+                                 activation="identity"))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def bench_fleet_smoke(steps: int, batch: int = 64,
+                      members: int = 8) -> dict:
+    """CPU-friendly smoke of fleet training (parallel.fleet): an M-member
+    stacked MLP population trained through ONE vmapped+jitted step.
+    Self-validating hard gates:
+
+    - **bitwise member parity**: member 3 of the fleet equals the same
+      model trained SOLO with the same RNG stream (``solo_twin``), every
+      param leaf bit-for-bit, after the full timed run;
+    - **one compile for the whole fleet**: ``trace/fleet_step`` moves by
+      exactly 1 per fleet instance, and the lifecycle phase — steps, a
+      mid-run cull, a spawn, a per-member NaN injection, a telemetry
+      drain — runs inside ``tracecheck.steady_state`` (any retrace
+      fails the run; the drain's batched device_get is the declared
+      sync budget);
+    - **cull drill**: the culled member's params are bit-frozen while
+      the rest keep training;
+    - **per-member NaN drill**: a NaN batch fed to ONE member flips only
+      that member's alive bit (``fleet/nan_cull``), and every OTHER
+      member's params are bitwise identical to a clean control run;
+    - **throughput**: the fleet trains M=8 members at >= 3x the summed
+      per-model sequential baseline (one model's timed epoch x M).
+    """
+    import statistics as _stats
+
+    import jax
+
+    from deeplearning4j_tpu.common import flightrec, tracecheck
+    from deeplearning4j_tpu.common.profiler import OpProfiler
+    from deeplearning4j_tpu.optimize import NanSentinelListener
+    from deeplearning4j_tpu.parallel import FleetTrainer
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, 64).astype(np.float32)
+    y = rng.randn(batch, 10).astype(np.float32)
+    prof = OpProfiler.get()
+
+    def fail(msg, **extra):
+        print(json.dumps({"error": msg, **extra}))
+        sys.exit(1)
+
+    # ---- phase 1: parity + throughput (no telemetry, the hot shape) ----
+    fleet = FleetTrainer(_fleet_mlp(), members, seed=7)
+    solo = fleet.solo_twin(3)
+    from deeplearning4j_tpu.data.dataset import DataSet
+
+    ds = DataSet(x, y)
+    t0 = prof.counter_value("trace/fleet_step")
+    fleet.step(x, y)                      # warmup (the one compile)
+    solo.fit(ds, epochs=1)
+    jax.block_until_ready(fleet._params)
+
+    t_start = time.perf_counter()
+    for _ in range(steps):
+        fleet.step(x, y)
+    jax.block_until_ready(fleet._params)
+    fleet_s = time.perf_counter() - t_start
+
+    # first solo epoch lands on the SAME step count as the fleet — the
+    # parity gate compares here; two more epochs refine the timing median
+    solo_times = []
+    t_start = time.perf_counter()
+    for _ in range(steps):
+        solo.fit(ds, epochs=1)
+    jax.block_until_ready(solo._params)
+    solo_times.append(time.perf_counter() - t_start)
+
+    if prof.counter_value("trace/fleet_step") - t0 != 1:
+        fail("fleet step traced more than once",
+             traces=prof.trace_counts())
+    p_f = jax.tree.leaves(jax.tree.map(lambda a: np.array(a[3]),
+                                       fleet._params))
+    p_s = jax.tree.leaves(jax.tree.map(np.array, solo._params))
+    if not all(np.array_equal(a, b) for a, b in zip(p_f, p_s)):
+        md = max(float(np.max(np.abs(a - b))) for a, b in zip(p_f, p_s))
+        fail("fleet member 3 is not bitwise identical to its solo twin",
+             max_abs_diff=md)
+
+    for _ in range(2):
+        t_start = time.perf_counter()
+        for _ in range(steps):
+            solo.fit(ds, epochs=1)
+        jax.block_until_ready(solo._params)
+        solo_times.append(time.perf_counter() - t_start)
+    solo_s = _stats.median(solo_times)
+    speedup = (members * solo_s) / fleet_s
+    if speedup < 3.0:
+        fail(f"fleet throughput {speedup:.2f}x the summed sequential "
+             f"baseline (gate: >= 3x at M={members})",
+             fleet_s=round(fleet_s, 4), solo_s=round(solo_s, 4))
+
+    # ---- phase 2: lifecycle under steady_state (sweep + cull + NaN) ----
+    def lifecycle(inject_nan: bool):
+        """One deterministic lifecycle run; the drill and its clean
+        control share everything but the poisoned batch."""
+        fl = FleetTrainer.from_sweep(
+            _fleet_mlp(), {"lr": [1e-3] * (members // 2)
+                           + [3e-3] * (members - members // 2)},
+            seed=7, drain_every_n=4)
+        fl.set_listeners(NanSentinelListener("cull", check_every_n=4))
+        # warmup: trace the step, warm the cull/spawn dispatch paths and
+        # the per-member batch shape, then drain
+        fl.step(x, y)
+        xs = np.broadcast_to(x, (members,) + x.shape).copy()
+        ys = np.broadcast_to(y, (members,) + y.shape).copy()
+        fl.step(xs, ys, per_member=True)
+        fl.cull(0, reason="warmup")
+        fl.step(x, y)
+        fl.spawn(0)
+        fl.drain()
+        return fl, xs, ys
+
+    fl, xs, ys = lifecycle(False)
+    ctrl, _, _ = lifecycle(False)
+    flightrec.reset()
+    try:
+        with tracecheck.steady_state("fleet lifecycle",
+                                     max_host_syncs=None):
+            for s in range(6):
+                fl.step(x, y)
+                ctrl.step(x, y)
+            # cull drill: member 5 freezes mid-run (both runs)
+            fl.cull(5, reason="drill")
+            ctrl.cull(5, reason="drill")
+            frozen_at = jax.tree.map(lambda a: np.array(a[5]),
+                                     fl._params)
+            for s in range(4):
+                fl.step(x, y)
+                ctrl.step(x, y)
+            # NaN drill: poison member 2's batch in the drill run only
+            bad = xs.copy()
+            bad[2] = np.nan
+            fl.step(bad, ys, per_member=True)
+            ctrl.step(xs, ys, per_member=True)
+            for s in range(4):
+                fl.step(x, y)
+                ctrl.step(x, y)
+            frozen_check = jax.tree.map(lambda a: np.array(a[5]),
+                                        fl._params)
+            fl.spawn(5)
+            ctrl.spawn(5)
+            fl.step(x, y)
+            ctrl.step(x, y)
+            fl.drain()
+            ctrl.drain()
+    except tracecheck.SteadyStateViolation as e:
+        fail("fleet lifecycle retraced inside the steady-state region",
+             violation=str(e).splitlines()[0])
+
+    alive = fl.alive_mask()
+    if alive[2] != 0:
+        fail("per-member NaN drill did not cull the poisoned member",
+             alive=alive.tolist())
+    if not flightrec.events("fleet/nan_cull"):
+        fail("no fleet/nan_cull event on the timeline")
+    # cull drill: between its cull and its spawn, member 5's slice must
+    # not have moved a single bit while the rest of the fleet trained on
+    if not all(np.array_equal(a, b)
+               for a, b in zip(jax.tree.leaves(frozen_at),
+                               jax.tree.leaves(frozen_check))):
+        fail("cull drill: the culled member's params moved")
+    for m in range(members):
+        if m == 2:
+            continue
+        a = jax.tree.leaves(jax.tree.map(lambda t: np.array(t[m]),
+                                         fl._params))
+        b = jax.tree.leaves(jax.tree.map(lambda t: np.array(t[m]),
+                                         ctrl._params))
+        if not all(np.array_equal(u, v) for u, v in zip(a, b)):
+            fail(f"NaN drill perturbed member {m} (must be "
+                 f"bit-unaffected)", member=m)
+
+    images = steps * batch * members
+    return {
+        "metric": "fleet_smoke",
+        "value": images / fleet_s,
+        "unit": "member-images/sec",
+        "batch": batch,
+        "members": members,
+        "platform": jax.devices()[0].platform,
+        "fleet_epoch_s": round(fleet_s, 4),
+        "solo_epoch_s": round(solo_s, 4),
+        "speedup_vs_sequential": round(speedup, 2),
+        "speedup_gate": 3.0,
+        "traces": prof.trace_counts(),
+        "bitwise_member_parity": True,
+        "nan_cull_events": len(flightrec.events("fleet/nan_cull")),
+        "cull_events": len(flightrec.events("fleet/cull")),
+        "spawn_events": len(flightrec.events("fleet/spawn")),
+        "alive_after_drills": alive.tolist(),
+        "fleet_ledger": prof.fleet_stats(),
+        "telemetry_drain": {k: (round(v, 5) if isinstance(v, float) else v)
+                            for k, v in prof.telemetry_stats().items()},
+        "data": "synthetic 64-feature MLP batches; M-member vmapped "
+                "fleet vs solo-twin bitwise parity, cull/spawn/NaN "
+                "drills inside one steady_state region",
+    }
+
+
 def bench_word2vec(steps: int) -> dict:
     """North-star config 4: Word2Vec skip-gram + negative sampling over a
     synthetic zipfian corpus; throughput = corpus words consumed / sec
@@ -2900,7 +3115,7 @@ def main() -> None:
                                  "fault-smoke", "supervisor-smoke",
                                  "zero1-smoke", "elastic-smoke",
                                  "serving-smoke", "autoscale-smoke",
-                                 "mfu-smoke", "obs-smoke"])
+                                 "mfu-smoke", "obs-smoke", "fleet-smoke"])
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--batch", type=int, default=None,
                         help="per-config default: resnet50=128, bert=32")
@@ -3014,6 +3229,8 @@ def main() -> None:
         result = bench_autoscale_smoke(steps, batch=args.batch or 32)
     elif args.config == "obs-smoke":
         result = bench_obs_smoke(steps, batch=args.batch or 64)
+    elif args.config == "fleet-smoke":
+        result = bench_fleet_smoke(steps, batch=args.batch or 64)
     elif args.config == "resnet50-disk":
         result = bench_resnet50_disk(steps, batch=args.batch or 64)
     elif args.config == "resnet50-predecoded":

@@ -101,6 +101,16 @@ autoscale/decide           warn        span around one autoscale
                                        decision, signals as attrs
 autoscale/scale            info        replica-count change actuated
 inference/resurrected      info        replica resurrection landing
+fleet/cull                 warn        FleetTrainer.cull froze a member
+                                       slice in-graph; test_fleet +
+                                       fleet-smoke cull drill
+fleet/spawn                info        FleetTrainer.spawn re-initialized
+                                       a member slice in place;
+                                       test_fleet spawn drill
+fleet/nan_cull             warn        per-member NaN isolation flipped
+                                       one member's alive bit in-graph;
+                                       test_fleet + fleet-smoke NaN
+                                       drill
 tracecheck/violation       error       steady-state region tripped
 profiler/section           info        OpProfiler.time_section duration
                                        (Chrome ``X`` lane)
@@ -203,6 +213,18 @@ EVENT_SITES: Dict[str, Dict[str, str]] = {
     "inference/resurrected": {
         "desc": "a retired replica's replacement joined the pool",
         "drill": "test_observability serving kill drill"},
+    "fleet/cull": {
+        "desc": "a fleet member's alive bit dropped (updates freeze "
+                "in-graph; reason attached)",
+        "drill": "test_fleet cull drills; fleet-smoke"},
+    "fleet/spawn": {
+        "desc": "a fleet member slice re-initialized in place (params/"
+                "updater/stream key fresh, alive restored)",
+        "drill": "test_fleet spawn drills; fleet-smoke"},
+    "fleet/nan_cull": {
+        "desc": "per-member NaN isolation flipped one member's alive "
+                "bit in-graph (other members' updates landed)",
+        "drill": "test_fleet NaN drills; fleet-smoke"},
     "tracecheck/violation": {
         "desc": "a declared steady-state region retraced/synced",
         "drill": "test_observability injected-retrace test"},

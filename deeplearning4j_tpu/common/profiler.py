@@ -39,6 +39,7 @@ class OpProfiler:
         ("elastic", "elastic_stats"),
         ("serving", "serving_stats"),
         ("autoscale", "autoscale_stats"),
+        ("fleet", "fleet_stats"),
         ("precision", "precision_stats"),
         ("tracecheck", "tracecheck_stats"),
         ("faults", "fault_stats"),
@@ -310,6 +311,17 @@ class OpProfiler:
         Autoscaler` ticks."""
         return {k.split("/", 1)[1]: v for k, v in self._counters.items()
                 if k.startswith("autoscale/")}
+
+    def fleet_stats(self) -> Dict[str, float]:
+        """Vmapped-fleet ledger (``fleet/*`` counters): culls, spawns,
+        per-member NaN culls, telemetry-window drains, and the live
+        ``members`` gauge (alive count — every FleetTrainer sets it at
+        construction and on every lifecycle change). The /api/health,
+        /api/metrics and fleet-smoke view of what the population
+        actually did. Empty until a :class:`parallel.fleet.FleetTrainer`
+        exists."""
+        return {k.split("/", 1)[1]: v for k, v in self._counters.items()
+                if k.startswith("fleet/")}
 
     def precision_stats(self) -> Dict[str, float]:
         """Mixed-precision ledger (``precision/*`` counters): fused

@@ -15,6 +15,8 @@ where ``state`` carries batchnorm running stats (the only stateful layer).
 
 from __future__ import annotations
 
+import contextlib
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional, Sequence, Tuple, Union
 
@@ -31,6 +33,34 @@ from .inputs import CNNInput, FFInput, InputType, RNNInput
 
 def _pair(v):
     return (v, v) if isinstance(v, int) else tuple(v)
+
+
+# --- trace-time dropout-rate override (fleet hyperparameter sweeps) --------
+#
+# A vmapped model population (parallel.fleet) sweeps the INPUT-dropout
+# rate per member by threading a traced scalar through the one compiled
+# step. The rate cannot live on the layer dataclass (it is a Python float
+# baked at trace time), so the fleet core installs the traced value here
+# for the duration of its loss trace; ``_maybe_dropout`` picks it up.
+# Per-thread (concurrent traces stay independent) and trace-time only —
+# a compiled step never reads it again. The gate (is dropout configured
+# at all?) stays on the layer's own Python float, so only layers that
+# already drop out participate in the sweep.
+_DROPOUT_OVERRIDE = threading.local()
+
+
+@contextlib.contextmanager
+def dropout_rate_override(rate):
+    """Install a traced input-dropout RATE override for every
+    dropout-configured layer traced inside the block. The value must be
+    float64 (weak-Python-float matching under x64) for an override equal
+    to the configured rate to be bitwise identical."""
+    prev = getattr(_DROPOUT_OVERRIDE, "rate", None)
+    _DROPOUT_OVERRIDE.rate = rate
+    try:
+        yield
+    finally:
+        _DROPOUT_OVERRIDE.rate = prev
 
 
 @dataclass
@@ -71,7 +101,10 @@ class Layer:
 
     def _maybe_dropout(self, x, training: bool, rng):
         if training and self.dropout and self.dropout > 0.0:
-            return get_op("dropout").fn(x, rng, rate=self.dropout)
+            rate = getattr(_DROPOUT_OVERRIDE, "rate", None)
+            if rate is None:
+                rate = self.dropout
+            return get_op("dropout").fn(x, rng, rate=rate)
         return x
 
     @property

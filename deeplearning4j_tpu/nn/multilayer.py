@@ -11,6 +11,7 @@ with donated buffers, executed once per minibatch (SURVEY.md §7.1.1).
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -369,7 +370,18 @@ class MultiLayerNetwork:
         paths cannot drift numerically. When telemetry is enabled the core
         additionally returns the in-graph aux pytree (per-layer grad/
         update/param norms, update:param ratio, non-finite counts — see
-        optimize.telemetry) computed inside the same compiled module."""
+        optimize.telemetry) computed inside the same compiled module.
+
+        ``hyper`` (keyword-only, default None — the solo paths never pass
+        it): a dict of TRACED per-call scalar hyperparameter overrides,
+        the vmapped-fleet sweep hook (parallel.fleet). Recognized keys:
+        ``lr`` replaces the updater's learning rate, ``l2`` replaces
+        every layer's effective l2 (an additive delta on the loss under
+        the same exclusions the base regularization applies), and
+        ``dropout`` replaces the rate of every layer whose input dropout
+        is configured on. Scalars must be float64 (weak-Python-float
+        matching under x64) so an override equal to the baked value is
+        bitwise identical to the solo step."""
         gc = self.conf.global_conf
         updater = gc.updater
         frozen = self._frozen_indices()
@@ -379,10 +391,23 @@ class MultiLayerNetwork:
         from ..optimize import telemetry as _tel
 
         def core(params, states, upd_state, x, y, mask, key, iteration,
-                 fmask, w):
+                 fmask, w, hyper=None):
+            hp = {k: _weak_scalar(v) for k, v in (hyper or {}).items()}
+            up = (dataclasses.replace(updater, learning_rate=hp["lr"])
+                  if "lr" in hp else updater)
+
             def loss_fn(p):
-                loss, new_states = self._loss(p, states, x, y, mask, True,
-                                              key, fmask, w=w)
+                if "dropout" in hp:
+                    with L.dropout_rate_override(hp["dropout"]):
+                        loss, new_states = self._loss(p, states, x, y,
+                                                      mask, True, key,
+                                                      fmask, w=w)
+                else:
+                    loss, new_states = self._loss(p, states, x, y, mask,
+                                                  True, key, fmask, w=w)
+                if "l2" in hp:
+                    loss = loss + _l2_delta(self.conf, self.layers, p,
+                                            hp["l2"])
                 return loss, new_states
 
             (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -391,11 +416,11 @@ class MultiLayerNetwork:
                                              gc.grad_norm_threshold)
             if fused_plan is not None:
                 new_params, new_upd = _apply_fused_flat(
-                    fused_plan, updater, grads, upd_state, params,
+                    fused_plan, up, grads, upd_state, params,
                     iteration, key)
             else:
                 new_params, new_upd = _prec.apply_updater(
-                    updater, grads, upd_state, params, iteration, key)
+                    up, grads, upd_state, params, iteration, key)
             for i in frozen:
                 # stop_gradient already zeroes their grads; restoring the
                 # original tensors also shields them from stateful-updater
@@ -939,6 +964,44 @@ def _apply_fused_flat(plan, updater, grads, upd_state, params, iteration,
     new_upd = (plan.unflatten_state_inplan(new_flat_s)
                if isinstance(new_flat_s, dict) else new_flat_s)
     return new_params, new_upd
+
+
+def _weak_scalar(v):
+    """Re-weak-type a traced f64 hyperparameter scalar so it promotes
+    EXACTLY like the Python float it overrides (a strong f64 tracer
+    would widen f32 updater math to f64 — a different computation, not
+    just different bits). Uses jax's internal weak-type convert — the
+    same mechanism jnp uses for Python scalars; if the private API moves,
+    the override still works strong-typed with ulp-level (documented)
+    deviation from the baked-constant run."""
+    try:
+        from jax._src.lax.lax import _convert_element_type
+
+        return _convert_element_type(v, jnp.float64, weak_type=True)
+    except (ImportError, TypeError):    # pragma: no cover - jax internals
+        return v
+
+
+def _l2_delta(conf, layers, params, l2_m):
+    """A traced per-member l2 override as an ADDITIVE delta on the solo
+    loss: replacing every layer's effective l2 with ``l2_m`` equals
+    adding ``0.5*(l2_m - base_l2)*sum(w^2)`` per layer under the same
+    exclusions ``_loss`` applies (biases/norm params out, FrozenLayers
+    take no decay). With a zero base l2 this is bitwise identical to a
+    solo model configured with ``l2=l2_m`` (0.5*x and x-0 are exact);
+    over a nonzero base it is mathematically equal but may differ in the
+    last ulp from the directly-configured run."""
+    gc = conf.global_conf
+    delta = 0.0
+    for lp, layer in zip(params, layers):
+        if isinstance(layer, L.FrozenLayer):
+            continue
+        base = layer.l2 if layer.l2 is not None else gc.l2
+        for name, wt in lp.items():
+            if name in ("b", "beta", "mean", "var"):
+                continue
+            delta = delta + (0.5 * (l2_m - base)) * jnp.sum(jnp.square(wt))
+    return delta
 
 
 def _fold_weights(mask, w):

@@ -53,19 +53,29 @@ logger = logging.getLogger("deeplearning4j_tpu")
 class TelemetryConfig:
     """Build-time switch captured by the train-step builders. ``nan_guard``
     additionally compiles the skip-update policy into the step (see
-    :func:`apply_nan_guard`)."""
+    :func:`apply_nan_guard`). ``member_cull`` is consumed only by the
+    vmapped fleet step (parallel.fleet): a member whose update the nan
+    guard skipped additionally has its alive-mask bit flipped in-graph —
+    permanent isolation instead of a transient skip. Solo step builders
+    ignore it (a ``"cull"`` sentinel on a solo model degrades to
+    ``"skip"``)."""
 
     nan_guard: bool = False
+    member_cull: bool = False
 
 
 def config_for(listeners) -> Optional[TelemetryConfig]:
     """The telemetry config a listener set implies (None = aux disabled).
     Listeners opt in with a ``wants_telemetry`` attribute; a skip-policy
-    ``NanSentinelListener`` additionally sets ``wants_nan_guard``."""
+    ``NanSentinelListener`` additionally sets ``wants_nan_guard``, and the
+    fleet ``"cull"`` policy sets ``wants_member_cull`` on top."""
     if not any(getattr(l, "wants_telemetry", False) for l in listeners):
         return None
-    return TelemetryConfig(nan_guard=any(getattr(l, "wants_nan_guard", False)
-                                         for l in listeners))
+    return TelemetryConfig(
+        nan_guard=any(getattr(l, "wants_nan_guard", False)
+                      for l in listeners),
+        member_cull=any(getattr(l, "wants_member_cull", False)
+                        for l in listeners))
 
 
 # --- in-graph statistics (called inside the jitted step) --------------------
@@ -304,22 +314,29 @@ class NanSentinelListener(TrainingListener):
     - ``"skip"``  — the poisoned update is dropped IN-GRAPH (the step is
       built with :func:`apply_nan_guard`, so params stay finite and equal
       to the pre-NaN step); the listener reports what was skipped;
+    - ``"cull"``  — ``"skip"`` plus PERMANENT per-member isolation under a
+      vmapped fleet (parallel.fleet): the poisoned member's alive-mask
+      bit flips in-graph (event ``fleet/nan_cull``) and it takes no
+      further updates while the other M-1 members' updates land intact.
+      On a solo model this behaves exactly like ``"skip"``;
     - ``"raise"`` — raise ``FloatingPointError`` naming the layer.
 
     Detection is asynchronous: device non-finite counts buffer and one
     batched readback runs every ``check_every_n`` iterations (and at epoch
     end) — a poisoned step is caught within one drain window without ever
-    syncing the hot loop per-iteration."""
+    syncing the hot loop per-iteration. (Under a fleet the trainer owns
+    the drain; this listener then only carries the policy.)"""
 
     wants_telemetry = True
-    POLICIES = ("warn", "skip", "raise")
+    POLICIES = ("warn", "skip", "cull", "raise")
 
     def __init__(self, policy: str = "warn", check_every_n: int = 10):
         if policy not in self.POLICIES:
             raise ValueError(f"policy must be one of {self.POLICIES}, "
                              f"got {policy!r}")
         self.policy = policy
-        self.wants_nan_guard = policy == "skip"
+        self.wants_nan_guard = policy in ("skip", "cull")
+        self.wants_member_cull = policy == "cull"
         self.every = max(1, check_every_n)
         self._buf: List[tuple] = []
         self._names: Optional[List[str]] = None
@@ -353,7 +370,7 @@ class NanSentinelListener(TrainingListener):
             if self.policy == "raise":
                 raise FloatingPointError(
                     f"non-finite gradients at iteration {it}: {where}")
-            if self.policy == "skip":
+            if self.policy in ("skip", "cull"):
                 logger.warning("NanSentinel: skipped poisoned update at "
                                "iteration %d (%s)", it, where)
             else:

@@ -6,6 +6,7 @@ from .accumulator import (GradientsAccumulator, DenseAllReduceAccumulator,
                           AdaptiveThresholdAlgorithm, FixedThresholdAlgorithm,
                           TargetSparsityThresholdAlgorithm)
 from .wrapper import ParallelWrapper
+from .fleet import (FleetTrainer, FleetEarlyStop, FleetStatsSink)
 from .sharding import (tp_param_specs, tp_shardings, apply_tp, Zero1Plan,
                        unflatten_updater_state)
 from .inference import ParallelInference

@@ -6,6 +6,9 @@
 - ``async_learning``  A3CDiscreteDense, AsyncNStepQLearningDiscreteDense,
                       ACPolicy
 - ``history``         HistoryProcessor (crop/rescale/skip/stack)
+- ``population``      FleetDQNPopulation — M agents' Q-networks as ONE
+                      vmapped fleet (parallel.fleet), per-member
+                      telemetry/early-stop/NaN-cull
 """
 
 from .async_learning import (A3CConfiguration, A3CDiscreteDense, ACPolicy,
@@ -16,11 +19,13 @@ from .dqn import (DQNPolicy, EpsGreedy, ExpReplay, QLConfiguration,
 from .history import HistoryProcessor, HistoryProcessorConfiguration
 from .mdp import MDP, CartPole, DiscreteSpace, GridWorld, ObservationSpace
 from .networks import (ActorCriticNetwork, DuelingQNetwork, SameDiffQNetwork)
+from .population import FleetDQNPopulation
 
 __all__ = ["A3CConfiguration", "A3CDiscreteDense", "ACPolicy",
            "ActorCriticNetwork", "AsyncNStepQLearningDiscreteDense",
            "AsyncQLConfiguration", "CartPole", "DQNPolicy", "DiscreteSpace",
-           "DuelingQNetwork", "EpsGreedy", "ExpReplay", "GridWorld",
+           "DuelingQNetwork", "EpsGreedy", "ExpReplay",
+           "FleetDQNPopulation", "GridWorld",
            "HistoryProcessor", "HistoryProcessorConfiguration", "MDP",
            "ObservationSpace", "QLConfiguration", "QLearningDiscreteDense",
            "SameDiffQNetwork"]

@@ -1,0 +1,158 @@
+"""RL population training over a vmapped fleet (ROADMAP 5(a), rl hook).
+
+The reference's rl4j trains one agent per process; its async family
+(``async_learning``) multiplies HOST threads against one shared network.
+This module multiplies the NETWORKS instead: M DQN agents — separate
+environments, separate replay buffers, separate exploration streams —
+whose Q-networks are ONE :class:`parallel.fleet.FleetTrainer` population.
+Every TD update for all M agents is a single vmapped+jitted step, action
+selection batches all M observations through one vmapped inference
+dispatch, and the per-member telemetry bus drives early-stop/NaN-cull of
+diverged members without touching the others (bit-isolation proven in
+tests/test_fleet.py).
+
+Env stepping and replay stay on host per agent (SURVEY §7.3.6: RL env
+stepping is the canonical host-loop workload) — the device cost of the
+population is one step dispatch regardless of M.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..data.dataset import DataSet  # noqa: F401  (re-export convenience)
+from ..parallel.fleet import FleetTrainer
+from .dqn import EpsGreedy, ExpReplay, QLConfiguration
+from .mdp import MDP
+
+
+class FleetDQNPopulation:
+    """M independent DQN agents over one fleet-trained Q-network stack.
+
+    ``mdp_factory(i)`` builds agent i's environment; ``base_net`` is the
+    shared Q-network architecture (an init()-ed MultiLayerNetwork with an
+    identity-activation MSE head, exactly as ``QLearningDiscreteDense``
+    takes); ``grid`` optionally sweeps per-member hyperparameters (lr /
+    l2 / dropout) so a population IS a hyperparameter search. Listeners
+    (``NanSentinelListener("cull")``, :class:`FleetEarlyStop`, sinks)
+    attach straight onto the underlying fleet.
+    """
+
+    def __init__(self, mdp_factory: Callable[[int], MDP], base_net,
+                 config: QLConfiguration, n_members: int,
+                 grid=None, listeners=()):
+        self.conf = config
+        if grid is not None:
+            self.fleet = FleetTrainer.from_sweep(base_net, grid,
+                                                 seed=config.seed)
+            if self.fleet.n_members != n_members:
+                raise ValueError(
+                    f"grid implies {self.fleet.n_members} members, "
+                    f"n_members says {n_members}")
+        else:
+            self.fleet = FleetTrainer(base_net, n_members,
+                                      seed=config.seed)
+        if listeners:
+            self.fleet.set_listeners(*listeners)
+        M = self.fleet.n_members
+        self.envs = [mdp_factory(i) for i in range(M)]
+        obs_dim = int(np.prod(self.envs[0].observation_space.shape))
+        self.n_actions = self.envs[0].action_space.n
+        self.replays = [ExpReplay(config.exp_rep_max_size, obs_dim,
+                                  seed=config.seed + i) for i in range(M)]
+        self._eps = [EpsGreedy(config,
+                               np.random.default_rng(config.seed + i))
+                     for i in range(M)]
+        # per-member frozen target stack, synced every
+        # target_dqn_update_freq steps (reference QLearning.setTarget)
+        self._target = self.fleet.stacked_state()
+        self.episode_rewards: List[List[float]] = [[] for _ in range(M)]
+        self.step_count = 0
+
+    # -- stacked Q evaluation ---------------------------------------------
+    def _q_all(self, obs: np.ndarray, target: bool = False) -> np.ndarray:
+        """[M, B, obs] observations → [M, B, A] Q values through ONE
+        vmapped dispatch (live or frozen-target params)."""
+        params = self._target if target else None
+        return np.asarray(self.fleet.output(obs, params=params))
+
+    # -- one synchronized population step ---------------------------------
+    def _learn(self) -> None:
+        c = self.conf
+        M = self.fleet.n_members
+        cols = [r.sample(c.batch_size) for r in self.replays]
+        obs = np.stack([col[0] for col in cols])
+        action = np.stack([col[1] for col in cols])
+        reward = np.stack([col[2] for col in cols])
+        nxt = np.stack([col[3] for col in cols])
+        done = np.stack([col[4] for col in cols])
+        q_cur = self._q_all(obs)
+        q_next_t = self._q_all(nxt, target=True)
+        if c.double_dqn:
+            best = np.argmax(self._q_all(nxt), axis=2)
+        else:
+            best = np.argmax(q_next_t, axis=2)
+        rows = np.arange(c.batch_size)
+        next_val = np.stack([q_next_t[m, rows, best[m]] for m in range(M)])
+        td = reward * c.reward_factor + c.gamma * next_val * (1 - done)
+        if c.error_clamp > 0:
+            cur = np.stack([q_cur[m, rows, action[m]] for m in range(M)])
+            td = cur + np.clip(td - cur, -c.error_clamp, c.error_clamp)
+        y = q_cur.copy()
+        for m in range(M):
+            y[m, rows, action[m]] = td[m]
+        # non-taken actions keep their current Q -> zero gradient: the
+        # reference setTarget construction, all M agents in one step
+        self.fleet.step(obs.astype(np.float32), y.astype(np.float32),
+                        per_member=True)
+
+    def train(self, max_steps: Optional[int] = None) -> List[List[float]]:
+        """Synchronized population loop: all M envs step together (a
+        culled member's env keeps playing its frozen policy — its
+        learning is what stopped). Returns per-member episode rewards."""
+        c = self.conf
+        M = self.fleet.n_members
+        limit = max_steps if max_steps is not None else c.max_step
+        obs = [env.reset() for env in self.envs]
+        ep_reward = [0.0] * M
+        ep_len = [0] * M
+        while self.step_count < limit:
+            stacked = np.stack(obs).astype(np.float32)[:, None, :]
+            q = self._q_all(stacked)[:, 0, :]
+            for m in range(M):
+                a = self._eps[m].next_action(q[m], self.step_count,
+                                             self.n_actions)
+                nxt, r, done, _ = self.envs[m].step(a)
+                self.replays[m].store(obs[m], a, r, nxt, done)
+                ep_reward[m] += r
+                ep_len[m] += 1
+                if done or ep_len[m] >= c.max_epoch_step:
+                    self.episode_rewards[m].append(ep_reward[m])
+                    ep_reward[m] = 0.0
+                    ep_len[m] = 0
+                    obs[m] = self.envs[m].reset()
+                else:
+                    obs[m] = nxt
+            self.step_count += 1
+            if self.step_count >= c.update_start and \
+                    all(len(r) >= c.batch_size for r in self.replays):
+                self._learn()
+            if self.step_count % c.target_dqn_update_freq == 0:
+                self._target = self.fleet.stacked_state()
+        self.fleet.drain()
+        return self.episode_rewards
+
+    # -- winners -----------------------------------------------------------
+    def best_member(self) -> int:
+        """Alive member with the lowest last-drained TD loss (telemetry
+        bus required — attach a telemetry listener)."""
+        return self.fleet.best_member()
+
+    def policy_of(self, member: int):
+        """Greedy play policy of one member (exported solo — serveable
+        through ServingEngine / publish_checkpoint like any model)."""
+        from .dqn import DQNPolicy
+
+        return DQNPolicy(self.fleet.export_member(member))

@@ -201,11 +201,19 @@ def serialize_snapshot(snapshot: Dict[str, Any]) -> bytes:
             # against ITS accumulator's template (the zip stays readable
             # by consumers that know nothing about accumulators)
             zf.writestr(ACC_ENTRY, _savez_leaves(snapshot["accumulator"]))
-        zf.writestr(RESUME_ENTRY, json.dumps({
+        resume = {
             "rng": snapshot["rng"],
             "cursor": snapshot["cursor"],
             "listener_state": snapshot["listener_state"],
-        }))
+        }
+        if snapshot.get("fleet") is not None:
+            # stacked-fleet extras (parallel.fleet): alive mask, carried
+            # per-member stream keys, hyper grid, member seeds — what a
+            # bit-exact fleet resume needs beyond the stacked trees.
+            # Solo readers never look for the key, so member and plain
+            # checkpoints are untouched.
+            resume["fleet"] = snapshot["fleet"]
+        zf.writestr(RESUME_ENTRY, json.dumps(resume))
     return buf.getvalue()
 
 
@@ -323,7 +331,8 @@ def _append_and_retain(directory: str, name: str, sha: str, iteration: int,
                        keep_last: int, size: Optional[int] = None,
                        max_total_bytes: Optional[int] = None,
                        incarnation: Optional[int] = None,
-                       state_dtype: Optional[str] = None) -> None:
+                       state_dtype: Optional[str] = None,
+                       fleet: Optional[Dict[str, Any]] = None) -> None:
     """Fold one committed file into the manifest and apply retention —
     count-based (``keep_last``) then disk-budget (``max_total_bytes``:
     oldest committed entries drop until the total fits; the newest always
@@ -351,6 +360,12 @@ def _append_and_retain(directory: str, name: str, sha: str, iteration: int,
         # tooling (and humans) can see the stored-moment dtype without
         # opening the zip
         entry["state_dtype"] = str(state_dtype)
+    if fleet is not None:
+        # fleet provenance (parallel.fleet): {"members": M} for a stacked
+        # fleet checkpoint, plus {"member": k} for a sliced single-member
+        # one — ops tooling can tell a member export from a solo run and
+        # a stacked state from a dense one without opening the zip
+        entry["fleet"] = {k: int(v) for k, v in fleet.items()}
     entries.append(entry)
     retained, dropped = entries, []
     if keep_last and len(entries) > keep_last:
@@ -378,13 +393,15 @@ def commit_checkpoint(directory: str, tag: str, data: bytes,
                       seq: Optional[int] = None,
                       max_total_bytes: Optional[int] = None,
                       incarnation: Optional[int] = None,
-                      state_dtype: Optional[str] = None) -> str:
+                      state_dtype: Optional[str] = None,
+                      fleet: Optional[Dict[str, Any]] = None) -> str:
     """Atomically commit one checkpoint and fold it into the manifest;
     apply retention. Returns the committed path. Single-writer per
     directory (the listener's writer thread or the sync caller).
     ``incarnation``: the writer's fence id — checked BEFORE the file is
     written (so a stale writer leaves no orphan zip either) and again
-    under the manifest fold."""
+    under the manifest fold. ``fleet``: provenance metadata for stacked-
+    fleet / sliced-member commits, recorded on the manifest entry."""
     prof = OpProfiler.get()
     if incarnation is not None \
             and manifest_incarnation(directory) > int(incarnation):
@@ -398,7 +415,8 @@ def commit_checkpoint(directory: str, tag: str, data: bytes,
         _append_and_retain(directory, name, hashlib.sha256(data).hexdigest(),
                            iteration, keep_last, size=len(data),
                            max_total_bytes=max_total_bytes,
-                           incarnation=incarnation, state_dtype=state_dtype)
+                           incarnation=incarnation, state_dtype=state_dtype,
+                           fleet=fleet)
     prof.count("checkpoint/committed")
     prof.count("checkpoint/bytes", len(data))
     # committed on the writer thread in the async path: the ambient

@@ -1,0 +1,628 @@
+"""Fleet training tests (ISSUE 13): vmapped model populations through ONE
+compiled step — bitwise member-vs-solo parity at fixed RNG, one compile
+for any M, the shape-stable cull/spawn lifecycle (events ``fleet/cull``,
+``fleet/spawn``), per-member telemetry through the aux bus, per-member
+NaN isolation (``fleet/nan_cull``), hyperparameter-sweep constructor,
+checkpoint slicing through the PR-3 atomic machinery, and the
+train-to-serve handoff onto a live ServingEngine. The load-bearing
+drills also gate in ``bench.py --config fleet-smoke``.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common import flightrec
+from deeplearning4j_tpu.common.profiler import OpProfiler
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.learning import Adam, Nesterovs, Sgd
+from deeplearning4j_tpu.ndarray.rng import get_random
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.optimize import NanSentinelListener
+from deeplearning4j_tpu.parallel import (FleetEarlyStop, FleetStatsSink,
+                                         FleetTrainer)
+from deeplearning4j_tpu.ui import InMemoryStatsStorage
+
+N_IN, N_OUT = 8, 4
+
+
+def mlp(updater=None, seed=7, l2=0.0, dropout=0.0):
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater(updater if updater is not None else Adam(1e-3))
+         .activation("tanh").weight_init("xavier"))
+    if l2:
+        b = b.l2(l2)
+    if dropout:
+        b = b.dropout(dropout)
+    conf = (b.list()
+            .layer(L.DenseLayer(n_out=16))
+            .layer(L.OutputLayer(n_out=N_OUT, loss="mse",
+                                 activation="identity"))
+            .set_input_type(InputType.feed_forward(N_IN)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+@pytest.fixture
+def batch():
+    rng = np.random.RandomState(0)
+    return (rng.randn(16, N_IN).astype(np.float32),
+            rng.randn(16, N_OUT).astype(np.float32))
+
+
+def member_leaves(fleet, m):
+    return jax.tree.leaves(jax.tree.map(lambda a: np.array(a[m]),
+                                        fleet._params))
+
+
+def solo_leaves(model):
+    return jax.tree.leaves(jax.tree.map(np.array, model._params))
+
+
+def bitwise(a, b):
+    return all(np.array_equal(u, v) for u, v in zip(a, b))
+
+
+class TestLifecycle:
+    def test_init_stacks_members_with_solo_init_bits(self, batch):
+        fleet = FleetTrainer(mlp(), 3, seed=7)
+        for leaf in jax.tree.leaves(fleet._params):
+            assert leaf.shape[0] == 3
+        # member 1's slice IS MultiLayerNetwork.init(seed+1), bit-for-bit
+        solo = mlp(seed=8)
+        assert bitwise(member_leaves(fleet, 1), solo_leaves(solo))
+
+    def test_member_count_validation(self):
+        with pytest.raises(ValueError, match="ambiguous or missing"):
+            FleetTrainer(mlp())
+        with pytest.raises(ValueError, match="ambiguous or missing"):
+            FleetTrainer(mlp(), 3, member_seeds=[1, 2])
+        with pytest.raises(ValueError, match="at least one"):
+            FleetTrainer(mlp(), 0)
+
+    @pytest.mark.parametrize("updater", [Sgd(0.05), Nesterovs(0.05),
+                                         Adam(1e-3)],
+                             ids=["sgd", "nesterovs", "adam"])
+    def test_member_vs_solo_bitwise_parity(self, batch, updater):
+        """THE headline gate: member k of a vmapped fleet is bit-identical
+        to the same model trained solo with the same RNG stream — params,
+        updater state and loss, for every updater family."""
+        x, y = batch
+        fleet = FleetTrainer(mlp(updater), 4, seed=7)
+        solo = fleet.solo_twin(2)
+        ds = DataSet(x, y)
+        for _ in range(5):
+            fleet.step(x, y)
+            solo.fit(ds, epochs=1)
+        assert bitwise(member_leaves(fleet, 2), solo_leaves(solo))
+        assert bitwise(
+            jax.tree.leaves(jax.tree.map(lambda a: np.array(a[2]),
+                                         fleet._updater_state)),
+            jax.tree.leaves(jax.tree.map(np.array, solo._updater_state)))
+        assert float(np.array(fleet._score_dev)[2]) == solo.score_value
+
+    def test_one_compile_for_the_whole_fleet(self, batch):
+        x, y = batch
+        prof = OpProfiler.get()
+        before = prof.counter_value("trace/fleet_step")
+        fleet = FleetTrainer(mlp(), 6, seed=7)
+        for _ in range(4):
+            fleet.step(x, y)
+        assert prof.counter_value("trace/fleet_step") - before == 1
+
+    def test_cull_freezes_member_others_continue(self, batch):
+        x, y = batch
+        fleet = FleetTrainer(mlp(), 3, seed=7)
+        fleet.step(x, y)
+        flightrec.reset()
+        fleet.cull(1, reason="test")
+        frozen = member_leaves(fleet, 1)
+        moving = member_leaves(fleet, 0)
+        fleet.step(x, y)
+        fleet.step(x, y)
+        assert bitwise(member_leaves(fleet, 1), frozen)
+        assert not bitwise(member_leaves(fleet, 0), moving)
+        assert fleet.alive_mask().tolist() == [1, 0, 1]
+        ev = flightrec.events("fleet/cull")
+        assert ev and ev[0]["attrs"] == {"member": 1, "reason": "test"}
+
+    def test_culled_member_key_stream_freezes_too(self, batch):
+        x, y = batch
+        fleet = FleetTrainer(mlp(), 2, seed=7)
+        fleet.cull(0)
+        k0 = np.array(fleet._keys)[0]
+        fleet.step(x, y)
+        assert np.array_equal(np.array(fleet._keys)[0], k0)
+        assert not np.array_equal(np.array(fleet._keys)[1], k0)
+
+    def test_cull_and_spawn_do_not_retrace(self, batch):
+        from deeplearning4j_tpu.common import tracecheck
+
+        x, y = batch
+        fleet = FleetTrainer(mlp(), 3, seed=7)
+        # warmup: the one trace + the cull/spawn dispatch paths
+        fleet.step(x, y)
+        fleet.cull(2)
+        fleet.step(x, y)
+        fleet.spawn(2)
+        fleet.step(x, y)
+        with tracecheck.steady_state("fleet cull/spawn"):
+            fleet.step(x, y)
+            fleet.cull(1)
+            fleet.step(x, y)
+            fleet.spawn(1)
+            fleet.step(x, y)
+
+    def test_spawn_reinitializes_slice_in_place(self, batch):
+        x, y = batch
+        fleet = FleetTrainer(mlp(), 3, seed=7)
+        for _ in range(2):
+            fleet.step(x, y)
+        fleet.cull(1)
+        flightrec.reset()
+        fleet.spawn(1, seed=99)
+        # fresh init bits = MultiLayerNetwork.init(99)
+        assert bitwise(member_leaves(fleet, 1), solo_leaves(mlp(seed=99)))
+        # updater moments zeroed for the slice
+        for leaf in jax.tree.leaves(jax.tree.map(
+                lambda a: np.array(a[1]), fleet._updater_state)):
+            assert not np.any(leaf)
+        assert fleet.alive_mask().tolist() == [1, 1, 1]
+        assert flightrec.events("fleet/spawn")
+        # the spawned member trains again
+        p = member_leaves(fleet, 1)
+        fleet.step(x, y)
+        assert not bitwise(member_leaves(fleet, 1), p)
+
+    def test_members_gauge_tracks_lifecycle(self, batch):
+        prof = OpProfiler.get()
+        fleet = FleetTrainer(mlp(), 5, seed=7)
+        assert prof.counter_value("fleet/members") == 5
+        fleet.cull(0)
+        fleet.cull(3)
+        assert prof.counter_value("fleet/members") == 3
+        fleet.spawn(0)
+        assert prof.counter_value("fleet/members") == 4
+        assert prof.fleet_stats()["members"] == 4
+        assert "fleet" in dict(OpProfiler.LEDGERS)
+
+    def test_fit_broadcasts_shared_iterator(self, batch):
+        x, y = batch
+        fleet = FleetTrainer(mlp(), 3, seed=7)
+        fleet.fit(DataSet(x, y), epochs=2)
+        assert fleet._iteration == 2
+        assert fleet._epoch == 2
+
+    def test_per_member_batch_shape_validation(self, batch):
+        x, y = batch
+        fleet = FleetTrainer(mlp(), 3, seed=7)
+        with pytest.raises(ValueError, match="leading axis"):
+            fleet.step(np.stack([x, x]), np.stack([y, y]),
+                       per_member=True)
+
+
+class TestTelemetry:
+    def test_aux_carries_member_axis(self, batch):
+        x, y = batch
+        fleet = FleetTrainer(mlp(), 4, seed=7, drain_every_n=100)
+        fleet.set_listeners(NanSentinelListener("warn"))
+        fleet.step(x, y)
+        _, aux = fleet._aux_buf[0]
+        assert aux["loss"].shape == (4,)
+        assert aux["grad_norm"].shape == (4, 2)       # [M, L]
+        assert aux["nonfinite"].shape == (4, 2)
+        assert aux["alive"].shape == (4,)
+
+    def test_one_device_get_per_drain_window(self, batch):
+        x, y = batch
+        prof = OpProfiler.get()
+        fleet = FleetTrainer(mlp(), 4, seed=7, drain_every_n=5)
+        fleet.set_listeners(NanSentinelListener("warn"))
+        drains0 = prof.get_statistics().get("telemetry/drain",
+                                            {}).get("count", 0)
+        for _ in range(10):
+            fleet.step(x, y)
+        drains = prof.get_statistics()["telemetry/drain"]["count"]
+        assert drains - drains0 == 2      # 10 steps / window of 5
+
+    def test_stats_sink_per_member_series(self, batch):
+        x, y = batch
+        storage = InMemoryStatsStorage()
+        fleet = FleetTrainer(mlp(), 3, seed=7, drain_every_n=2)
+        fleet.set_listeners(FleetStatsSink(storage))
+        for _ in range(4):
+            fleet.step(x, y)
+        tags = storage.tags()
+        for m in range(3):
+            assert f"fleet/loss/m{m}" in tags
+            assert f"fleet/grad_norm/m{m}" in tags
+            assert f"fleet/alive/m{m}" in tags
+        assert len(storage.series("fleet/loss/m0")) == 4
+
+    def test_per_member_nan_isolation_skip_policy(self, batch):
+        """A NaN in ONE member drops only that member's update (pre-step
+        bits carried forward) while the other members' updates land
+        bit-identically to a clean control run."""
+        x, y = batch
+
+        def run(poison):
+            fleet = FleetTrainer(mlp(), 3, seed=7, drain_every_n=50)
+            fleet.set_listeners(NanSentinelListener("skip"))
+            fleet.step(x, y)
+            pre = member_leaves(fleet, 1)
+            xs = np.broadcast_to(x, (3,) + x.shape).copy()
+            ys = np.broadcast_to(y, (3,) + y.shape).copy()
+            if poison:
+                xs[1] = np.nan
+            fleet.step(xs, ys, per_member=True)
+            fleet.step(x, y)
+            return fleet, pre
+
+        clean, _ = run(False)
+        drill, pre = run(True)
+        for m in (0, 2):
+            assert bitwise(member_leaves(clean, m),
+                           member_leaves(drill, m))
+        # skip is transient: the poisoned step dropped, the next landed
+        assert all(np.isfinite(a).all()
+                   for a in member_leaves(drill, 1))
+        assert not bitwise(member_leaves(drill, 1), pre)
+        assert drill.alive_mask().tolist() == [1, 1, 1]
+
+    def test_nan_cull_policy_flips_alive_bit_in_graph(self, batch):
+        x, y = batch
+        flightrec.reset()
+        fleet = FleetTrainer(mlp(), 3, seed=7, drain_every_n=2)
+        fleet.set_listeners(NanSentinelListener("cull", check_every_n=2))
+        fleet.step(x, y)
+        pre = member_leaves(fleet, 1)
+        xs = np.broadcast_to(x, (3,) + x.shape).copy()
+        ys = np.broadcast_to(y, (3,) + y.shape).copy()
+        xs[1] = np.nan
+        fleet.step(xs, ys, per_member=True)
+        fleet.step(x, y)
+        fleet.drain()
+        assert fleet.alive_mask().tolist() == [1, 0, 1]
+        # frozen at its pre-NaN bits — permanently
+        assert bitwise(member_leaves(fleet, 1), pre)
+        ev = flightrec.events("fleet/nan_cull")
+        assert ev and ev[0]["attrs"]["member"] == 1
+        assert OpProfiler.get().counter_value("fleet/nan_culls") >= 1
+
+    def test_solo_model_accepts_cull_policy_as_skip(self, batch):
+        """Solo-path behavior unchanged: NanSentinelListener("cull") on a
+        plain MultiLayerNetwork degrades to the skip policy."""
+        x, y = batch
+        model = mlp()
+        model.set_listeners(NanSentinelListener("cull", check_every_n=1))
+        model.fit(DataSet(x, y), epochs=1)
+        before = solo_leaves(model)
+        bad = x.copy()
+        bad[0] = np.nan
+        model.fit(DataSet(bad, y), epochs=1)
+        assert bitwise(solo_leaves(model), before)   # update skipped
+        model.fit(DataSet(x, y), epochs=1)
+        assert not bitwise(solo_leaves(model), before)
+
+    def test_early_stop_culls_from_telemetry_bus(self, batch):
+        x, y = batch
+        fleet = FleetTrainer(mlp(Sgd(0.0)), 3, seed=7, drain_every_n=3)
+        # lr=0 -> losses never improve -> every member goes stale; the
+        # early stop may only cull ALIVE members (no double culls)
+        fleet.set_listeners(NanSentinelListener("warn"),
+                            FleetEarlyStop(patience=2))
+        for _ in range(9):
+            fleet.step(x, y)
+        fleet.drain()
+        assert fleet.alive_mask().tolist() == [0, 0, 0]
+        evs = flightrec.events("fleet/cull")
+        assert {e["attrs"]["reason"] for e in evs} == {"early_stop"}
+
+    def test_spawn_resets_early_stop_history(self, batch):
+        """A respawned member must get a FRESH patience window — not its
+        dead predecessor's staleness — or it is re-culled within one
+        drain window."""
+        x, y = batch
+        fleet = FleetTrainer(mlp(Sgd(0.0)), 2, seed=7, drain_every_n=3)
+        stopper = FleetEarlyStop(patience=2)
+        fleet.set_listeners(NanSentinelListener("warn"), stopper)
+        for _ in range(6):
+            fleet.step(x, y)
+        fleet.drain()
+        assert fleet.alive_mask().tolist() == [0, 0]
+        fleet.spawn(0)
+        assert stopper._stale[0] == 0 and np.isinf(stopper._best[0])
+        # one more window: the fresh member survives its full patience
+        for _ in range(3):
+            fleet.step(x, y)
+        fleet.drain()
+        assert fleet.alive_mask().tolist()[0] == 1
+
+    def test_best_member_needs_telemetry(self, batch):
+        x, y = batch
+        fleet = FleetTrainer(mlp(), 2, seed=7)
+        fleet.step(x, y)
+        with pytest.raises(RuntimeError, match="telemetry"):
+            fleet.best_member()
+        fleet.set_listeners(NanSentinelListener("warn"))
+        fleet.step(x, y)
+        assert fleet.best_member() in (0, 1)
+
+
+class TestSweep:
+    def test_grid_validation(self):
+        with pytest.raises(ValueError, match="unknown sweep field"):
+            FleetTrainer.from_sweep(mlp(), {"momentum": [0.9, 0.99]})
+        with pytest.raises(ValueError, match="disagree"):
+            FleetTrainer.from_sweep(mlp(), {"lr": [1e-3], "l2": [0, 1]})
+        with pytest.raises(ValueError, match="same hyperparameters"):
+            FleetTrainer.from_sweep(mlp(), [{"lr": 1e-3}, {"l2": 0.1}])
+
+    def test_same_init_sweep_shares_init_bits(self):
+        fleet = FleetTrainer.from_sweep(mlp(), {"lr": [1e-3, 1e-2]},
+                                        seed=7)
+        assert bitwise(member_leaves(fleet, 0), member_leaves(fleet, 1))
+
+    def test_lr_sweep_member_matches_solo_with_that_lr(self, batch):
+        """A swept lr is bitwise the baked-constant run: member i of an
+        lr grid equals a solo model CONFIGURED with that lr."""
+        x, y = batch
+        fleet = FleetTrainer.from_sweep(mlp(Sgd(0.05)),
+                                        {"lr": [0.05, 0.1, 0.2]}, seed=7)
+        for _ in range(3):
+            fleet.step(x, y)
+        solo = mlp(Sgd(0.2), seed=7)
+        get_random().set_state(fleet.member_stream_state(2))
+        for _ in range(3):
+            solo.fit(DataSet(x, y), epochs=1)
+        assert bitwise(member_leaves(fleet, 2), solo_leaves(solo))
+
+    def test_l2_sweep_member_matches_solo_with_that_l2(self, batch):
+        x, y = batch
+        fleet = FleetTrainer.from_sweep(mlp(), {"l2": [0.0, 1e-2]},
+                                        seed=7)
+        for _ in range(3):
+            fleet.step(x, y)
+        solo = mlp(l2=1e-2, seed=7)
+        get_random().set_state(fleet.member_stream_state(1))
+        for _ in range(3):
+            solo.fit(DataSet(x, y), epochs=1)
+        assert bitwise(member_leaves(fleet, 1), solo_leaves(solo))
+        # and the l2=0 member matches the plain model
+        solo0 = mlp(seed=7)
+        get_random().set_state(fleet.member_stream_state(0))
+        for _ in range(3):
+            solo0.fit(DataSet(x, y), epochs=1)
+        assert bitwise(member_leaves(fleet, 0), solo_leaves(solo0))
+
+    def test_dropout_sweep_member_matches_solo_with_that_rate(self, batch):
+        x, y = batch
+        fleet = FleetTrainer.from_sweep(mlp(dropout=0.3),
+                                        {"dropout": [0.3, 0.5]}, seed=7)
+        for _ in range(3):
+            fleet.step(x, y)
+        solo = mlp(dropout=0.5, seed=7)
+        get_random().set_state(fleet.member_stream_state(1))
+        for _ in range(3):
+            solo.fit(DataSet(x, y), epochs=1)
+        assert bitwise(member_leaves(fleet, 1), solo_leaves(solo))
+
+    def test_sweep_is_one_trace(self, batch):
+        x, y = batch
+        prof = OpProfiler.get()
+        before = prof.counter_value("trace/fleet_step")
+        fleet = FleetTrainer.from_sweep(
+            mlp(), {"lr": [1e-3, 3e-3, 1e-2, 3e-2]}, seed=7)
+        for _ in range(4):
+            fleet.step(x, y)
+        assert prof.counter_value("trace/fleet_step") - before == 1
+
+    def test_list_of_dicts_grid(self, batch):
+        x, y = batch
+        fleet = FleetTrainer.from_sweep(
+            mlp(), [{"lr": 1e-3, "l2": 0.0}, {"lr": 1e-2, "l2": 1e-3}])
+        fleet.step(x, y)
+        assert fleet.n_members == 2
+
+    def test_population_hook_trains_rl_agents_as_fleet(self):
+        """The rl/ hook: existing test_rl-style agents train as one
+        fleet — per-member envs/replays, one vmapped TD step, telemetry
+        cull available, winner exportable as a playable policy."""
+        from deeplearning4j_tpu.rl import (FleetDQNPopulation, GridWorld,
+                                           QLConfiguration)
+
+        def qnet(seed=3):
+            conf = (NeuralNetConfiguration.builder().seed(seed)
+                    .updater(Adam(learning_rate=5e-3)).activation("relu")
+                    .weight_init("xavier").list()
+                    .layer(L.DenseLayer(n_out=16))
+                    .layer(L.OutputLayer(n_out=2, loss="mse",
+                                         activation="identity"))
+                    .set_input_type(InputType.feed_forward(4)).build())
+            return MultiLayerNetwork(conf).init()
+
+        conf = QLConfiguration(seed=3, max_step=120, max_epoch_step=20,
+                               batch_size=8, update_start=30,
+                               target_dqn_update_freq=25,
+                               epsilon_nb_step=80, min_epsilon=0.1)
+        prof = OpProfiler.get()
+        before = prof.counter_value("trace/fleet_step")
+        pop = FleetDQNPopulation(
+            lambda i: GridWorld(size=4), qnet(), conf, n_members=3,
+            grid={"lr": [1e-3, 5e-3, 1e-2]},
+            listeners=(NanSentinelListener("cull", check_every_n=10),))
+        rewards = pop.train()
+        assert all(len(r) > 0 for r in rewards)
+        # the whole population learned through ONE compiled step
+        assert prof.counter_value("trace/fleet_step") - before == 1
+        best = pop.best_member()
+        policy = pop.policy_of(best)
+        assert policy.play(GridWorld(size=4), max_steps=12) > 0
+
+
+class TestCheckpointSlicing:
+    def test_save_member_restores_into_solo_bitwise(self, batch,
+                                                    tmp_path):
+        from deeplearning4j_tpu.util.checkpoint import \
+            restore_training_state
+
+        x, y = batch
+        fleet = FleetTrainer(mlp(), 3, seed=7)
+        for _ in range(3):
+            fleet.step(x, y)
+        path = fleet.save_member(2, str(tmp_path))
+        solo = mlp()
+        restore_training_state(solo, path)
+        assert bitwise(member_leaves(fleet, 2), solo_leaves(solo))
+        assert bitwise(
+            jax.tree.leaves(jax.tree.map(lambda a: np.array(a[2]),
+                                         fleet._updater_state)),
+            jax.tree.leaves(jax.tree.map(np.array, solo._updater_state)))
+        assert solo._iteration == 3
+
+    def test_sliced_member_solo_continuation_is_bit_exact(self, batch,
+                                                          tmp_path):
+        """The restore carries the member's LIVE stream key: a solo
+        continuation reproduces the member's fleet future bit-for-bit."""
+        from deeplearning4j_tpu.util.checkpoint import \
+            restore_training_state
+
+        x, y = batch
+        fleet = FleetTrainer(mlp(), 3, seed=7)
+        for _ in range(3):
+            fleet.step(x, y)
+        path = fleet.save_member(1, str(tmp_path))
+        solo = mlp()
+        restore_training_state(solo, path)
+        for _ in range(3):
+            fleet.step(x, y)
+            solo.fit(DataSet(x, y), epochs=1)
+        assert bitwise(member_leaves(fleet, 1), solo_leaves(solo))
+
+    def test_fleet_kill_resume_exact_parity(self, batch, tmp_path):
+        x, y = batch
+        run_a = FleetTrainer(mlp(), 4, seed=7)
+        for _ in range(2):
+            run_a.step(x, y)
+        path = run_a.save(str(tmp_path))
+        for _ in range(3):
+            run_a.step(x, y)
+
+        run_b = FleetTrainer(mlp(), 4, seed=7)
+        run_b.restore(path)
+        assert run_b._iteration == 2
+        for _ in range(3):
+            run_b.step(x, y)
+        assert bitwise(jax.tree.leaves(jax.tree.map(np.array,
+                                                    run_a._params)),
+                       jax.tree.leaves(jax.tree.map(np.array,
+                                                    run_b._params)))
+
+    def test_cull_then_resume_keeps_alive_mask(self, batch, tmp_path):
+        x, y = batch
+        run_a = FleetTrainer(mlp(), 3, seed=7)
+        run_a.step(x, y)
+        run_a.cull(0)
+        path = run_a.save(str(tmp_path))
+        run_b = FleetTrainer(mlp(), 3, seed=7)
+        run_b.restore(path)
+        assert run_b.alive_mask().tolist() == [0, 1, 1]
+        frozen = member_leaves(run_b, 0)
+        run_b.step(x, y)
+        assert bitwise(member_leaves(run_b, 0), frozen)
+
+    def test_manifest_carries_fleet_metadata(self, batch, tmp_path):
+        from deeplearning4j_tpu.util.checkpoint import read_manifest
+
+        x, y = batch
+        fleet = FleetTrainer(mlp(), 3, seed=7)
+        fleet.step(x, y)
+        fleet.save_member(1, str(tmp_path))
+        fleet.save(str(tmp_path))
+        entries = read_manifest(str(tmp_path))
+        metas = [e.get("fleet") for e in entries]
+        assert {"member": 1, "members": 3} in metas
+        assert {"members": 3} in metas
+
+    def test_restore_refuses_wrong_shape(self, batch, tmp_path):
+        x, y = batch
+        fleet = FleetTrainer(mlp(), 3, seed=7)
+        fleet.step(x, y)
+        member_path = fleet.save_member(0, str(tmp_path))
+        fleet_path = fleet.save(str(tmp_path))
+        with pytest.raises(ValueError, match="not a fleet checkpoint"):
+            fleet.restore(member_path)
+        other = FleetTrainer(mlp(), 2, seed=7)
+        with pytest.raises(ValueError, match="members"):
+            other.restore(fleet_path)
+
+    def test_sweep_hyper_rides_resume(self, batch, tmp_path):
+        x, y = batch
+        run_a = FleetTrainer.from_sweep(mlp(Sgd(0.05)),
+                                        {"lr": [0.05, 0.2]}, seed=7)
+        run_a.step(x, y)
+        path = run_a.save(str(tmp_path))
+        run_b = FleetTrainer.from_sweep(mlp(Sgd(0.05)),
+                                        {"lr": [0.05, 0.2]}, seed=7)
+        run_b.restore(path)
+        run_a.step(x, y)
+        run_b.step(x, y)
+        assert bitwise(member_leaves(run_a, 1), member_leaves(run_b, 1))
+
+
+class TestServingHandoff:
+    def test_export_member_serves_the_member_outputs(self, batch):
+        x, y = batch
+        fleet = FleetTrainer(mlp(), 3, seed=7)
+        for _ in range(2):
+            fleet.step(x, y)
+        net = fleet.export_member(1)
+        stacked = np.asarray(fleet.output(x, per_member=False))
+        solo_out = net.output(x).to_numpy()
+        assert np.array_equal(stacked[1], solo_out)
+
+    def test_fleet_member_canaries_onto_live_engine_zero_recompiles(
+            self, batch, tmp_path):
+        """export/save the winning member -> PR-11 publish_checkpoint:
+        the fleet-trained weights canary onto a live ServingEngine and
+        promote with ZERO recompiles (AOT executables take params as
+        arguments)."""
+        from deeplearning4j_tpu.parallel import ServingEngine
+        from deeplearning4j_tpu.util.checkpoint import \
+            read_checkpoint_params
+
+        x, y = batch
+        fleet = FleetTrainer(mlp(), 3, seed=7, drain_every_n=2)
+        fleet.set_listeners(NanSentinelListener("warn"))
+        for _ in range(4):
+            fleet.step(x, y)
+        best = fleet.best_member()
+        path = fleet.save_member(best, str(tmp_path))
+
+        engine = (ServingEngine.Builder(mlp(seed=123))
+                  .buckets((1, 4, 16)).input_shape((N_IN,))
+                  .workers(1).max_wait_ms(2.0).build())
+        try:
+            prof = OpProfiler.get()
+            engine.output(x[:4])                       # warm
+            traces0 = prof.counter_value("trace/serving_infer")
+            handle = engine.publish_checkpoint(path, canary_window_s=0.2,
+                                               confirm_window_s=0.2,
+                                               check_interval_s=0.05)
+            while not handle.done:
+                engine.output(x[:4])
+            assert handle.result(timeout=10) == "promoted"
+            # zero recompiles across the whole handoff
+            assert prof.counter_value("trace/serving_infer") == traces0
+            # the engine serves the fleet member's exact bits
+            want_p, want_s = read_checkpoint_params(
+                path, engine.model._params, engine.model._states)
+            got = jax.tree.leaves(engine._dev_params[0])
+            want = jax.tree.leaves((want_p, want_s))
+            assert all(np.array_equal(np.asarray(g), np.asarray(w))
+                       for g, w in zip(got, want))
+        finally:
+            engine.shutdown()

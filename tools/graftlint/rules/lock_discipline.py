@@ -47,6 +47,10 @@ SHARED_CLASSES: Dict[str, Dict[str, Set[str]]] = {
     # autoscaler: the controller thread ticks while callers read stats
     # and drills call tick() directly
     "Autoscaler": {"locks": {"_lock"}, "allow": set()},
+    # vmapped-fleet trainer: the training thread swaps carried stacked
+    # state per step while sinks/serving handoffs read exports and a
+    # supervisor-style controller may cull/spawn — one owning lock
+    "FleetTrainer": {"locks": {"_lock"}, "allow": set()},
     # checkpoint writer: training thread submits, daemon thread commits
     "CheckpointWriter": {"locks": {"_cond", "_lock"}, "allow": set()},
     "CheckpointListener": {"locks": {"_lock"}, "allow": set()},
