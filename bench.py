@@ -1809,6 +1809,206 @@ def bench_elastic_smoke(steps: int, batch: int = 64, workers: int = 4) -> dict:
     }
 
 
+def bench_pipeline_parallel_smoke(steps: int, batch: int = 64) -> dict:
+    """Self-healing pipeline-parallel smoke (ISSUE 14; ROADMAP item 2):
+    a 12-layer homogeneous dense stack through ``PipelineTrainer`` as
+    4-stage 1F1B x 2-way data on the CPU mesh. Self-validating
+    hard-fails:
+
+    - bubble: the schedule-accounted bubble fraction (``pipeline``
+      ledger — tick occupancy of the very mask tables the compiled step
+      executes) must be <= the analytic (S-1)/(M+S-1) bound + 10%. This
+      polices the SCHEDULE TABLES against the closed-form bound (a
+      schedule_meta regression that pads extra ticks or drops ops
+      fails it); it is not a wall-clock measurement — wall-clock
+      efficiency is what the throughput gate below owns;
+    - retrace flatness: the whole warmup -> kill -> remap -> grow cycle
+      compiles exactly once per (stage-count, schedule), and the timed
+      interleaved rounds run under ``tracecheck.steady_state`` — any
+      trace/compile/host-sync hard-fails;
+    - recovery: a mid-epoch ``pipeline/stage`` device_loss drill
+      recovers by ``remap_and_continue`` (4 -> 3 stages) with ZERO lost
+      microbatches (ledger-counted against the clean expectation) and a
+      finite post-remap loss;
+    - throughput: the post-remap (3-stage) epoch must sustain at least
+      0.9 x (S-1)/S of the 4-stage throughput (median of interleaved
+      rounds through the per-stage-count executable cache).
+
+    Emits the pipeline ledger alongside the timing."""
+    import statistics as _stats
+
+    if "jax" not in sys.modules:
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    from deeplearning4j_tpu.common import faultinject, tracecheck
+    from deeplearning4j_tpu.common.profiler import OpProfiler
+    from deeplearning4j_tpu.data import NDArrayDataSetIterator
+    from deeplearning4j_tpu.learning import Sgd
+    from deeplearning4j_tpu.ndarray.rng import set_default_seed
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf import layers as NL
+    from deeplearning4j_tpu.parallel import PipelineTrainer
+
+    def fail(msg, **extra):
+        print(json.dumps({"error": msg, **extra}))
+        sys.exit(1)
+
+    S, M, D, n_layers, feat = 4, 8, 2, 12, 32
+    if len(jax.devices()) < S * D:
+        fail("pipeline-parallel-smoke needs >= 8 devices (virtual CPU "
+             "device request came too late?)", devices=len(jax.devices()))
+    if batch % (D * M):
+        fail(f"batch {batch} must divide by data*micro = {D * M}")
+    if steps < 2:
+        fail("pipeline-parallel-smoke needs --steps >= 2 (the mid-epoch "
+             "kill ordinal must land inside the drill fit)", steps=steps)
+    rng_np = np.random.RandomState(0)
+    n = steps * batch
+    x = rng_np.randn(n, feat).astype(np.float32)
+    y = np.tanh(x) * 0.5
+
+    def make_it():
+        return NDArrayDataSetIterator(x, y, batch_size=batch)
+
+    def build(stages):
+        set_default_seed(77)
+        b = (NeuralNetConfiguration.builder().seed(77)
+             .updater(Sgd(learning_rate=0.02)).list())
+        for _ in range(n_layers):
+            b.layer(NL.DenseLayer(n_out=feat, activation="tanh"))
+        model = MultiLayerNetwork(
+            b.set_input_type(InputType.feed_forward(feat)).build()).init()
+        return model, PipelineTrainer(model, stages=stages, n_micro=M,
+                                      schedule="1f1b", data=D)
+
+    prof = OpProfiler.get()
+    prof.reset()
+    faultinject.clear_plan()
+    model, tr = build(S)
+
+    # --- warmup + bubble gate (4-stage 1F1B) ---------------------------
+    busy0 = prof.counter_value("pipeline/busy_ticks")
+    slots0 = prof.counter_value("pipeline/tick_slots")
+    tr.fit(make_it(), epochs=1, batch_size=batch)
+    float(np.asarray(model._score_dev))
+    traces = prof.trace_counts()
+    if traces.get("trace/pipeline_fit_step") != 1:
+        fail("warmup epoch compiled more than once", traces=traces)
+    busy = prof.counter_value("pipeline/busy_ticks") - busy0
+    slots = prof.counter_value("pipeline/tick_slots") - slots0
+    bubble = 1.0 - busy / slots
+    bound = (S - 1) / (M + S - 1)
+    if bubble > bound * 1.10:
+        fail(f"measured bubble fraction {bubble:.4f} exceeds the "
+             f"analytic (S-1)/(M+S-1) bound {bound:.4f} + 10%",
+             bubble=bubble, bound=bound)
+
+    # --- kill-a-stage drill: remap, zero lost microbatches -------------
+    micro0 = prof.counter_value("pipeline/microbatches")
+    kill_at = steps + max(1, steps // 2)       # mid epoch 2 of 2
+    faultinject.set_plan(faultinject.FaultPlan(
+        [{"site": "pipeline/stage", "kind": "device_loss",
+          "index": kill_at, "stage": 1}]))
+    try:
+        tr.fit(make_it(), epochs=2, batch_size=batch)
+        fail("pipeline/stage fault plan did not fire", kill_at=kill_at)
+    except faultinject.DeviceLostError:
+        pass
+    faultinject.clear_plan()
+    cursor = (int(model._epoch - model._fit_epoch0),
+              int(model._steps_in_epoch))
+    removed = tr.remap(S - 1, lost_stages=[1])
+    if len(removed) != D:
+        fail("remap did not retire exactly the lost stage column",
+             removed=len(removed))
+    tr.fit(make_it(), epochs=2, batch_size=batch, resume_cursor=cursor)
+    drill_loss = float(np.asarray(model._score_dev))
+    if not np.isfinite(drill_loss):
+        fail("post-remap loss went non-finite", loss=drill_loss)
+    micro_seen = prof.counter_value("pipeline/microbatches") - micro0
+    if micro_seen != 2 * steps * M:
+        fail("kill-a-stage drill lost microbatches",
+             dispatched=micro_seen, expected=2 * steps * M)
+    traces = prof.trace_counts()
+    if traces.get("trace/pipeline_fit_step") != 2:
+        fail("kill->remap cycle broke one-compile-per-(stage-count, "
+             "schedule)", traces=traces)
+
+    # --- interleaved A/B throughput via cached executables -------------
+    def timed_epoch():
+        t0 = time.perf_counter()
+        tr.fit(make_it(), epochs=1, batch_size=batch)
+        float(np.asarray(model._score_dev))
+        return time.perf_counter() - t0
+
+    tr.remap(S)                     # grow back: cached, no compile
+    timed_epoch()
+    tr.remap(S - 1)
+    timed_epoch()                   # settle rounds, untimed
+    times = {"pre": [], "post": []}
+    ratios = []
+    with tracecheck.steady_state("pipeline timed rounds",
+                                 max_host_syncs=None):
+        for _ in range(6):
+            tr.remap(S)
+            t_pre = timed_epoch()
+            tr.remap(S - 1)
+            t_post = timed_epoch()
+            times["pre"].append(t_pre)
+            times["post"].append(t_post)
+            ratios.append(t_pre / t_post)   # = post/pre throughput ratio
+    traces = prof.trace_counts()
+    if traces.get("trace/pipeline_fit_step") != 2:
+        fail("timed rounds retraced (executable cache miss)",
+             traces=traces)
+    floor = 0.9 * (S - 1) / S
+    ratio = _stats.median(ratios)
+    if ratio < floor:
+        fail(f"post-remap throughput ratio {ratio:.3f} is below the "
+             f"0.9 x (S-1)/S floor {floor:.3f}",
+             pre_times=[round(t, 4) for t in times["pre"]],
+             post_times=[round(t, 4) for t in times["post"]])
+    ledger = prof.pipeline_stats()
+    if not ledger.get("remaps") or ledger.get("stages") != S - 1:
+        fail("pipeline ledger did not populate", ledger=ledger)
+
+    t_pre = _stats.median(times["pre"])
+    t_post = _stats.median(times["post"])
+    return {
+        "metric": "pipeline_parallel_smoke",
+        "value": n / t_pre,
+        "unit": "examples/sec",
+        "batch": batch,
+        "schedule": "1f1b",
+        "stages": S,
+        "data_axis": D,
+        "n_micro": M,
+        "layers": n_layers,
+        "platform": jax.devices()[0].platform,
+        "bubble_fraction": round(bubble, 4),
+        "bubble_bound": round(bound, 4),
+        "drill": {"kill_at": kill_at, "cursor": list(cursor),
+                  "microbatches": micro_seen, "lost": 0},
+        "traces": traces,
+        "throughput_ratio_post_vs_pre": round(ratio, 4),
+        "throughput_floor": round(floor, 4),
+        "epoch_s_pre_median": round(t_pre, 4),
+        "epoch_s_post_median": round(t_post, 4),
+        "pipeline_ledger": {k: (round(v, 5) if isinstance(v, float) else v)
+                            for k, v in ledger.items()},
+        "data": "synthetic dense-stack batches; 4-stage 1F1B x 2-way "
+                "data, mid-epoch pipeline/stage kill recovered by remap "
+                "to 3 stages with zero lost microbatches, interleaved "
+                "4/3-stage epochs through the per-stage-count executable "
+                "cache",
+    }
+
+
 def bench_serving_smoke(steps: int, batch: int = 32,
                         workers: int = 2) -> dict:
     """SLO-gated serving load test (ISSUE 7; ROADMAP item 2): a
@@ -3084,8 +3284,8 @@ def main() -> None:
     # virtual CPU devices BEFORE anything imports jax (the library import
     # just below does). The flag only affects the host platform —
     # harmless on TPU runs.
-    if ({"zero1-smoke", "elastic-smoke"} & set(sys.argv)) \
-            and "jax" not in sys.modules:
+    if ({"zero1-smoke", "elastic-smoke", "pipeline-parallel-smoke"}
+            & set(sys.argv)) and "jax" not in sys.modules:
         _flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in _flags:
             os.environ["XLA_FLAGS"] = (
@@ -3114,6 +3314,7 @@ def main() -> None:
                                  "pipeline-smoke", "telemetry-smoke",
                                  "fault-smoke", "supervisor-smoke",
                                  "zero1-smoke", "elastic-smoke",
+                                 "pipeline-parallel-smoke",
                                  "serving-smoke", "autoscale-smoke",
                                  "mfu-smoke", "obs-smoke", "fleet-smoke"])
     parser.add_argument("--steps", type=int, default=None)
@@ -3223,6 +3424,8 @@ def main() -> None:
         result = bench_mfu_smoke(steps, batch=args.batch or 64)
     elif args.config == "elastic-smoke":
         result = bench_elastic_smoke(steps, batch=args.batch or 64)
+    elif args.config == "pipeline-parallel-smoke":
+        result = bench_pipeline_parallel_smoke(steps, batch=args.batch or 64)
     elif args.config == "serving-smoke":
         result = bench_serving_smoke(steps, batch=args.batch or 32)
     elif args.config == "autoscale-smoke":

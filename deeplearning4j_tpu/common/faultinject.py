@@ -102,6 +102,13 @@ serving/promote       transient               test_autoscale / autoscale-
                                               smoke forced-violation drill
                                               (promoted weights "violate"
                                               -> bitwise auto-rollback)
+pipeline/stage        device_loss, slow,      test_pipeline_parallel
+                      wedge                   kill-a-stage remap drills;
+                                              pipeline-parallel-smoke
+                                              (``device_loss`` names the
+                                              lost STAGE via ``stage``;
+                                              ``slow`` = straggler stage;
+                                              ``wedge`` = hung schedule)
 ====================  ======================  ==============================
 """
 
@@ -172,6 +179,10 @@ FAULT_SITES = {
         "kinds": ("transient",),
         "drill": "test_autoscale forced-violation rollback; "
                  "autoscale-smoke"},
+    "pipeline/stage": {
+        "kinds": ("device_loss", "slow", "wedge"),
+        "drill": "test_pipeline_parallel kill-a-stage remap; "
+                 "pipeline-parallel-smoke"},
 }
 
 
@@ -201,11 +212,16 @@ class DeviceLostError(RuntimeError):
     the supervisor's ``shrink_and_continue`` policy can resize the data
     axis online instead of checkpoint-restarting. ``replica`` names the
     lost data-axis index when known (the injected ``device_loss`` kind
-    carries it from the fault spec; real XLA failures usually don't)."""
+    carries it from the fault spec; real XLA failures usually don't);
+    ``stage`` likewise names the lost PIPELINE stage — the
+    ``pipeline/stage`` site's drills carry it, and the supervisor's
+    ``remap_and_continue`` policy consumes it."""
 
-    def __init__(self, message: str, replica: Optional[int] = None):
+    def __init__(self, message: str, replica: Optional[int] = None,
+                 stage: Optional[int] = None):
         super().__init__(message)
         self.replica = replica
+        self.stage = stage
 
 
 class WedgeReleased(BaseException):
@@ -364,14 +380,17 @@ def fault_point(site: str, index: Optional[int] = None) -> List[Dict[str, Any]]:
             raise DeadReplicaFault(
                 f"injected replica death at {site}[{index}]")
         elif kind == "device_loss":
-            # step-indexed, names a replica: the deterministic elastic
-            # drill (site "device/loss" in the dispatch loop; the
-            # supervisor's shrink-and-continue consumes .replica)
+            # step-indexed, names a replica or a pipeline stage: the
+            # deterministic elastic drills (site "device/loss" feeds the
+            # supervisor's shrink-and-continue via .replica; site
+            # "pipeline/stage" feeds remap-and-continue via .stage)
             rep = spec.get("replica")
+            stg = spec.get("stage")
             raise DeviceLostError(
                 f"injected device loss at {site}[{index}]"
-                + (f" (replica {rep})" if rep is not None else ""),
-                replica=rep)
+                + (f" (replica {rep})" if rep is not None else "")
+                + (f" (stage {stg})" if stg is not None else ""),
+                replica=rep, stage=stg)
         elif kind == "crash":
             if spec.get("mode", "raise") == "exit":
                 os._exit(int(spec.get("code", 137)))

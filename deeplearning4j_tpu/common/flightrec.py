@@ -82,6 +82,19 @@ fault/fired                warn        faultinject.fault_point
 pipeline/epoch             info        span around each training epoch
                                        (data.pipeline.run_epochs)
 pipeline/dispatch          info        per-dispatch instant (ordinal)
+pipeline/stage_fwd         info        PipelineTrainer per-stage forward
+                                       window slice (Chrome ``X`` on a
+                                       ``pipeline/stage<k>/fwd`` lane;
+                                       warmup/cooldown bubbles are the
+                                       gaps); test_pipeline_parallel
+pipeline/stage_bwd         info        PipelineTrainer per-stage backward
+                                       window slice (its own ``/bwd``
+                                       lane — 1F1B windows interleave);
+                                       test_pipeline_parallel
+pipeline/remap             warn        span around an online stage-count
+                                       remap (from/to stage counts +
+                                       lost stages as attrs);
+                                       test_pipeline_parallel drill
 elastic/resize             warn        span around ParallelWrapper.
                                        resize; test_elastic drill
 serving/enqueue            info        ServingEngine request admission
@@ -177,6 +190,21 @@ EVENT_SITES: Dict[str, Dict[str, str]] = {
     "pipeline/dispatch": {
         "desc": "one train-step dispatch (ordinal)",
         "drill": "test_observability chrome-trace test"},
+    "pipeline/stage_fwd": {
+        "desc": "per-stage forward schedule window (Chrome X on its own "
+                "pipeline/stage<k>/fwd lane; bubbles are the gaps)",
+        "drill": "test_pipeline_parallel lanes test; "
+                 "pipeline-parallel-smoke"},
+    "pipeline/stage_bwd": {
+        "desc": "per-stage backward schedule window (its own /bwd lane "
+                "— 1F1B fwd/bwd windows interleave)",
+        "drill": "test_pipeline_parallel lanes test; "
+                 "pipeline-parallel-smoke"},
+    "pipeline/remap": {
+        "desc": "span around an online stage-count remap (stages_from/"
+                "stages_to + lost stages as attrs)",
+        "drill": "test_pipeline_parallel remap drills; "
+                 "pipeline-parallel-smoke"},
     "elastic/resize": {
         "desc": "span around an online data-axis resize",
         "drill": "test_elastic resize drill"},
@@ -321,11 +349,22 @@ class FlightRecorder:
         """Append one event. Near-zero when disabled (one attribute
         check, nothing allocated). ``force`` records even while
         disabled — only span close uses it, so a mid-span disable cannot
-        orphan a recorded B."""
+        orphan a recorded B.
+
+        Two reserved attr keys serve DERIVED timeline slices (events
+        reconstructed after the fact, e.g. the pipeline trainer's
+        per-stage schedule lanes): ``ts_mono`` overrides the event's
+        monotonic timestamp (popped, not stored), and ``lane`` makes the
+        Chrome exporter render the event on its own named synthetic lane
+        instead of the emitting thread's."""
         if not self._enabled and not force:
             return
+        m = time.monotonic()
+        if attrs and "ts_mono" in attrs:
+            attrs = dict(attrs)
+            m = float(attrs.pop("ts_mono"))
         t = threading.current_thread()
-        ev = {"t": time.time(), "m": time.monotonic(), "name": name,
+        ev = {"t": time.time(), "m": m, "name": name,
               "sev": severity, "corr": corr, "ph": phase,
               "span": span_id, "parent": parent_id,
               "thread": t.name, "tid": t.ident,
@@ -413,10 +452,19 @@ class FlightRecorder:
         pid = os.getpid()
         out: List[Dict[str, Any]] = []
         threads: Dict[int, str] = {}
+        lane_tids: Dict[str, int] = {}
         for e in evs:
-            tid = e["tid"] or 0
-            threads.setdefault(tid, e["thread"])
             args = dict(e["attrs"])
+            lane = args.pop("lane", None)
+            if lane is not None:
+                # named synthetic lane (per-stage pipeline schedule
+                # slices): negative tids can't collide with OS threads
+                tid = lane_tids.setdefault(str(lane),
+                                           -(len(lane_tids) + 1))
+                threads.setdefault(tid, str(lane))
+            else:
+                tid = e["tid"] or 0
+                threads.setdefault(tid, e["thread"])
             if e["corr"]:
                 args["corr"] = e["corr"]
             if e["span"] is not None:

@@ -37,6 +37,7 @@ class OpProfiler:
         ("supervisor", "supervisor_stats"),
         ("collectives", "collective_stats"),
         ("elastic", "elastic_stats"),
+        ("pipeline", "pipeline_stats"),
         ("serving", "serving_stats"),
         ("autoscale", "autoscale_stats"),
         ("fleet", "fleet_stats"),
@@ -273,6 +274,34 @@ class OpProfiler:
         if s:
             out["resize_s"] = s["total_s"]
             out["resize_count"] = s["count"]
+        return out
+
+    def pipeline_stats(self) -> Dict[str, float]:
+        """Pipeline-parallel ledger (the PipelineTrainer's counters —
+        NOT the input pipeline's, which live on the overlap/fault
+        ledgers): live ``stages`` gauge, ``remaps`` + remap wall time,
+        ``microbatches`` dispatched, schedule tick occupancy
+        (``busy_ticks``/``tick_slots`` from the same mask tables the
+        compiled step executes) with the derived ``bubble_fraction`` —
+        the /api/health, /api/metrics and pipeline-parallel-smoke view
+        of what the stage axis actually did. Empty until a
+        PipelineTrainer fit runs."""
+        out: Dict[str, float] = {}
+        for ctr, key in (("pipeline/stages", "stages"),
+                         ("pipeline/remaps", "remaps"),
+                         ("pipeline/microbatches", "microbatches"),
+                         ("pipeline/busy_ticks", "busy_ticks"),
+                         ("pipeline/tick_slots", "tick_slots")):
+            n = self._counters.get(ctr)
+            if n:
+                out[key] = n
+        slots = out.get("tick_slots")
+        if slots:
+            out["bubble_fraction"] = 1.0 - out.get("busy_ticks", 0) / slots
+        s = self._sections.get("pipeline/remap")
+        if s:
+            out["remap_s"] = s["total_s"]
+            out["remap_count"] = s["count"]
         return out
 
     def serving_stats(self) -> Dict[str, float]:

@@ -271,7 +271,8 @@ def run_epochs(data: Any, epochs: int, batch_size: Optional[int],
                round_to_multiple_of: int = 1,
                allow_multi: bool = False,
                host_prefetch: int = 0,
-               skip: Optional[Tuple[int, int]] = None) -> None:
+               skip: Optional[Tuple[int, int]] = None,
+               pre_dispatch=None) -> None:
     """The one training-loop skeleton shared by MultiLayerNetwork.fit,
     ComputationGraph.fit, and ParallelWrapper.fit: per epoch, stable
     batches are bound (``bind(ds, w)`` → jit argument tuple), staged
@@ -302,7 +303,13 @@ def run_epochs(data: Any, epochs: int, batch_size: Optional[int],
     discarded. Dispatch then continues with the restored params/updater/
     RNG key, making the continuation bit-identical to the uninterrupted
     run. The post-checkpoint remainder of the resume epoch replays fully,
-    including its ``on_epoch`` boundary."""
+    including its ``on_epoch`` boundary.
+
+    ``pre_dispatch(ordinal)``: optional per-dispatch hook run after the
+    generic fault points and before the dispatch — path-specific fault
+    sites (the pipeline trainer's ``pipeline/stage`` stage-loss/straggler
+    drills) fire here sharing the fit call's dispatch ordinal, so a drill
+    plan indexes one counter regardless of which fit path runs it."""
     k = max(1, int(steps_per_dispatch))
     skip_epochs, skip_steps = skip if skip is not None else (0, 0)
     n_bound = 0       # batch ordinal within this fit call (fault indexing)
@@ -378,6 +385,8 @@ def run_epochs(data: Any, epochs: int, batch_size: Optional[int],
                     # checkpoint-restarting
                     faultinject.fault_point("train/wedge", n_dispatched)
                     faultinject.fault_point("device/loss", n_dispatched)
+                    if pre_dispatch is not None:
+                        pre_dispatch(n_dispatched)
                     flightrec.event("pipeline/dispatch",
                                     ordinal=n_dispatched)
                     n_dispatched += 1
@@ -391,6 +400,8 @@ def run_epochs(data: Any, epochs: int, batch_size: Optional[int],
                                                 n_dispatched + j)
                         faultinject.fault_point("device/loss",
                                                 n_dispatched + j)
+                        if pre_dispatch is not None:
+                            pre_dispatch(n_dispatched + j)
                     flightrec.event("pipeline/dispatch",
                                     ordinal=n_dispatched,
                                     steps=len(group))

@@ -1,5 +1,5 @@
-from .mesh import (make_mesh, replicated, data_sharded, shard_batch,
-                   elastic_pool, serving_devices)
+from .mesh import (make_mesh, make_pipeline_mesh, replicated, data_sharded,
+                   shard_batch, elastic_pool, serving_devices)
 from .accumulator import (GradientsAccumulator, DenseAllReduceAccumulator,
                           EncodedGradientsAccumulator,
                           ReduceScatterAccumulator, ThresholdAlgorithm,
@@ -23,5 +23,5 @@ from .distributed import (SharedTrainingMaster, TrainingSupervisor,
 from .ring_attention import ring_attention, ring_self_attention
 from .sharded_embeddings import ShardedEmbedding
 from .pipeline import (HeterogeneousPipeline, PipelineParallel,
-                       pipeline_apply, pipeline_from_mln,
-                       stack_stage_params)
+                       PipelineTrainer, pipeline_apply, pipeline_from_mln,
+                       schedule_meta, stack_stage_params, stage_partition)

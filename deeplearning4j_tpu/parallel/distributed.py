@@ -117,7 +117,14 @@ CLASS_USER = "user_error"
 #: boundary — no disk, no replay — falling back to checkpoint-restart
 #: whenever the target cannot resize, the lost replica cannot be
 #: identified, or the holder's in-memory state is not
-#: boundary-consistent.
+#: boundary-consistent. For PIPELINE targets (anything exposing
+#: ``remap``/``stages_count`` — parallel.pipeline.PipelineTrainer) the
+#: device-failure policy resolves to "remap_and_continue": the layer
+#: partition is re-cut over the surviving stage devices at the dispatch
+#: boundary and training continues in memory from the exact cursor, with
+#: the same checkpoint-restart fallback whenever the remap gate refuses
+#: (surviving stages < 2, unidentifiable stage, state not
+#: boundary-consistent).
 DEFAULT_POLICIES: Dict[str, str] = {
     CLASS_TRANSIENT: "retry",
     CLASS_NUMERIC: "raise",
@@ -555,28 +562,82 @@ class TrainingSupervisor:
         logger.warning("supervisor: device loss — shrank the data axis "
                        "%d -> %d (lost replicas %s); continuing in "
                        "memory from the dispatch boundary", old, new, lost)
-        # a grow-back armed BEFORE this loss must not fire now: growing
-        # would reinstate a cached mesh that contains the newly-dead
-        # device — the merged probe below re-verifies EVERY lost device
-        # before any grow happens
+        self._arm_grow(old, removed)
+        return removed
+
+    def _arm_grow(self, old: int, removed) -> None:
+        """Arm (or merge into) the grow-back probe after a successful
+        online shrink/remap. A grow-back armed BEFORE this loss must not
+        fire now: growing would reinstate a cached mesh that contains the
+        newly-dead device — the merged probe re-verifies EVERY lost
+        device before any grow happens."""
         self._resize_request = None
-        if self.elastic_grow and removed:
-            g = self._grow
-            if g is None:
-                self._grow = {"target": old, "devices": list(removed),
-                              "delay": self.grow_probe_base_s,
-                              "next": (time.monotonic()
-                                       + self.grow_probe_base_s)}
-            else:
-                # a SECOND loss while the first grow-back is pending:
-                # merge — probe every lost device, keep the original full
-                # count as the target (growing back means all the way)
-                g["devices"].extend(d for d in removed
-                                    if d not in g["devices"])
-                g["target"] = max(int(g["target"]), old)
-                g["failures"] = 0
-                g["delay"] = self.grow_probe_base_s
-                g["next"] = time.monotonic() + self.grow_probe_base_s
+        if not (self.elastic_grow and removed):
+            return
+        g = self._grow
+        if g is None:
+            self._grow = {"target": old, "devices": list(removed),
+                          "delay": self.grow_probe_base_s,
+                          "next": (time.monotonic()
+                                   + self.grow_probe_base_s)}
+        else:
+            # a SECOND loss while the first grow-back is pending:
+            # merge — probe every lost device, keep the original full
+            # count as the target (growing back means all the way)
+            g["devices"].extend(d for d in removed
+                                if d not in g["devices"])
+            g["target"] = max(int(g["target"]), old)
+            g["failures"] = 0
+            g["delay"] = self.grow_probe_base_s
+            g["next"] = time.monotonic() + self.grow_probe_base_s
+
+    # --- elastic pipeline remap (stage axis) -----------------------------
+    def _remap_plan(self, exc: BaseException) -> Optional[List[int]]:
+        """Which pipeline stages to drop for remap-and-continue, or None
+        to fall back to checkpoint-restart. The remap GATE: the target
+        must expose the remap surface, the holder's published state must
+        be boundary-consistent, the lost stage must be identifiable
+        (named by :class:`faultinject.DeviceLostError` or found by
+        probing the stage columns), and >= 2 stages must survive — a
+        1-stage 'pipeline' is a plain fit, which checkpoint-restart
+        owns."""
+        t = self.target
+        if not callable(getattr(t, "remap", None)):
+            return None
+        n = int(getattr(t, "stages_count", 0))
+        if n < 2 or not self._holder_state_intact():
+            return None
+        if isinstance(exc, faultinject.DeviceLostError) \
+                and getattr(exc, "stage", None) is not None:
+            lost = [int(exc.stage)]
+        else:
+            probe = getattr(t, "probe_stages", None)
+            lost = list(probe()) if callable(probe) else []
+        lost = sorted({s for s in lost if 0 <= s < n})
+        if not lost or n - len(lost) < 2:
+            return None
+        return lost
+
+    def _apply_remap(self, lost: List[int]) -> Optional[List[Any]]:
+        """Re-cut the pipeline over the surviving stage devices; arm the
+        grow-back probe (growing back = remapping to the full stage
+        count, through the per-stage-count executable cache). Returns
+        the removed devices, or None when the remap itself failed
+        (caller falls back to checkpoint-restart)."""
+        t = self.target
+        old = int(t.stages_count)
+        new = old - len(lost)
+        try:
+            removed = t.remap(new, lost_stages=lost)
+        except Exception:
+            logger.warning("supervisor: online remap to %d stages "
+                           "failed; falling back to checkpoint-restart",
+                           new, exc_info=True)
+            return None
+        logger.warning("supervisor: stage loss — remapped the pipeline "
+                       "%d -> %d stages (lost stages %s); continuing in "
+                       "memory from the dispatch boundary", old, new, lost)
+        self._arm_grow(old, removed)
         return removed
 
     def _maybe_probe_grow(self) -> None:
@@ -905,7 +966,13 @@ class TrainingSupervisor:
                         f"attempt abandoned ({outcome})")
                 cls = CLASS_HANG if watchdogged else classify_failure(exc)
                 policy = self.policies.get(cls, "restart")
+                if policy == "shrink_and_continue" \
+                        and callable(getattr(self.target, "remap", None)):
+                    # pipeline targets heal the STAGE axis: the
+                    # device-failure default resolves to elastic remap
+                    policy = "remap_and_continue"
                 shrink_lost: Optional[List[int]] = None
+                remap_lost: Optional[List[int]] = None
                 if policy == "shrink_and_continue":
                     # only a finished (non-abandoned) attempt left a
                     # trustworthy dispatch-boundary state behind; a
@@ -914,6 +981,13 @@ class TrainingSupervisor:
                         shrink_lost = self._shrink_plan(exc)
                     if shrink_lost is None:
                         policy = "restart"   # the documented fallback
+                if policy == "remap_and_continue":
+                    # same boundary-trust rule as shrink; the remap gate
+                    # (_remap_plan) refusing = checkpoint-restart fallback
+                    if outcome == "done" and not run.abandoned:
+                        remap_lost = self._remap_plan(exc)
+                    if remap_lost is None:
+                        policy = "restart"
                 history.append({
                     "attempt": attempt, "class": cls, "policy": policy,
                     "error": repr(exc), "steps": run.heartbeat.steps,
@@ -956,6 +1030,22 @@ class TrainingSupervisor:
                 if policy == "raise":
                     final_exc = exc
                     break
+                if policy == "remap_and_continue":
+                    removed = self._apply_remap(remap_lost)
+                    if removed is None:
+                        # the remap itself failed mid-flight — rare (the
+                        # plan vetted the gate); checkpoint-restart owns it
+                        history[-1]["policy"] = "remap_failed_restart"
+                        policy = "restart"
+                    else:
+                        prof.count("supervisor/remaps")
+                        # same budget accounting as shrink: a successful
+                        # online remap IS progress — no restart consumed,
+                        # storm breaker reset
+                        consec_no_progress = 0
+                        mem_resume = (self._cursor_of(),
+                                      run.rng_state or entry_rng)
+                        continue
                 if policy == "shrink_and_continue":
                     removed = self._apply_shrink(shrink_lost)
                     if removed is None:

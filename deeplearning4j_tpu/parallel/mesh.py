@@ -32,6 +32,27 @@ def make_mesh(data: Optional[int] = None, model: int = 1,
     return Mesh(arr, ("data", "model"))
 
 
+def make_pipeline_mesh(data: int, stages: int,
+                       devices: Optional[Sequence] = None) -> Mesh:
+    """Build a (data, stage) mesh for pipeline-parallel training: stage
+    columns hold the layer-partition rows, the data axis replicates the
+    pipeline over batch shards. The third mesh axis of the scaling
+    recipe (data × model × pipeline); kept as its own constructor
+    because the stage axis resizes by REMAP (parallel.pipeline), not by
+    the data-axis elastic path."""
+    devs = list(devices) if devices is not None else jax.devices()
+    data, stages = int(data), int(stages)
+    if data < 1 or stages < 1:
+        raise ValueError(f"need data >= 1 and stages >= 1, got "
+                         f"({data}, {stages})")
+    n = data * stages
+    if n > len(devs):
+        raise ValueError(f"need {n} devices for a ({data} x {stages}) "
+                         f"pipeline mesh, have {len(devs)}")
+    arr = np.asarray(devs[:n]).reshape(data, stages)
+    return Mesh(arr, ("data", "stage"))
+
+
 def elastic_pool(mesh: Mesh, exclude: Sequence = (),
                  devices: Optional[Sequence] = None) -> list:
     """Device pool for an online elastic resize: the current mesh's

@@ -414,7 +414,8 @@ class UIServer:
         counters), the collective-exchange ledger (bytes per collective
         kind, ZeRO-1 sharded-updater footprint, encoded-exchange density),
         the elastic ledger (online resizes, grow-back probes, the live
-        worker gauge), the inference-pool census
+        worker gauge), the pipeline ledger (live stage gauge, remaps,
+        microbatches, measured bubble fraction), the inference-pool census
         (live/retired/resurrected replicas), and the serving ledger
         (requests/batches, bucket fill ratio, pad waste, queue-depth
         high-water, rolling p50/p99 latency, traces-after-warmup)."""
@@ -444,6 +445,7 @@ class UIServer:
                 "collectives": prof.collective_stats(),
                 "precision": prof.precision_stats(),
                 "elastic": prof.elastic_stats(),
+                "pipeline": prof.pipeline_stats(),
                 "tracecheck": prof.tracecheck_stats(),
                 "flightrec": flightrec.stats(),
                 "inference": pool_health(),

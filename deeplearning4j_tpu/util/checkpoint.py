@@ -133,16 +133,24 @@ def snapshot_training_state(model, listeners=None,
         "rng": {"seed": int(state.get("seed", get_random().get_seed())),
                 "key": np.asarray(key).tolist(),
                 "key_dtype": str(np.asarray(key).dtype)},
-        "cursor": {
-            "epochs_done": int(model._epoch) - int(fit_epoch0),
-            "steps_in_epoch": int(getattr(model, "_steps_in_epoch", 0)),
-            # the LIVE data-parallel worker count at snapshot time: an
-            # elastic run may be mid-shrink, and the resume metadata must
-            # say how many replicas were actually training (diagnostics +
-            # the resharding log line; the state itself is layout-
-            # independent, so restore works under any count)
-            "workers": int(getattr(model, "_live_workers", 1)),
-        },
+        "cursor": dict(
+            {
+                "epochs_done": int(model._epoch) - int(fit_epoch0),
+                "steps_in_epoch": int(getattr(model, "_steps_in_epoch", 0)),
+                # the LIVE data-parallel worker count at snapshot time: an
+                # elastic run may be mid-shrink, and the resume metadata
+                # must say how many replicas were actually training
+                # (diagnostics + the resharding log line; the state itself
+                # is layout-independent, so restore works under any count)
+                "workers": int(getattr(model, "_live_workers", 1)),
+            },
+            # the LIVE pipeline stage count, same story as workers: a
+            # remapped run's snapshot names the count it was training at
+            # (the per-layer on-disk layout restores under ANY stage
+            # count). Only pipeline fits set the attr, so every other
+            # path's resume.json bytes are unchanged.
+            **({"stages": int(model._live_stages)}
+               if hasattr(model, "_live_stages") else {})),
         "listener_state": gather_listener_state(listeners),
     }
 
@@ -639,6 +647,14 @@ def restore_training_state(model, path: str, listeners=None,
         model._ckpt_workers = int(saved_workers)
         logger.info("checkpoint %s was taken under %d data-parallel "
                     "worker(s)", os.path.basename(path), saved_workers)
+    saved_stages = cursor.get("stages")
+    if saved_stages is not None:
+        # informational, like workers: the pipeline layout on disk is
+        # per-layer and stage-count-independent, but diagnostics should
+        # name the stage count the snapshot was training at
+        model._ckpt_stages = int(saved_stages)
+        logger.info("checkpoint %s was taken under %d pipeline stage(s)",
+                    os.path.basename(path), saved_stages)
     flightrec.event("checkpoint/restore", file=os.path.basename(path),
                     epochs_done=int(cursor.get("epochs_done", 0)),
                     steps_in_epoch=int(cursor.get("steps_in_epoch", 0)))
@@ -665,6 +681,12 @@ def begin_fit_cursor(model, resume_from: Optional[str],
     normalized here before its step builder ever sees it."""
     if not keep_flat:
         _ensure_dense_updater_layout(model)
+    # liveness metadata is per-fit: a model that last trained on a
+    # pipeline must not stamp a stale stage count into a later
+    # non-pipeline fit's checkpoints (PipelineTrainer.fit re-sets the
+    # attr right after this anchor)
+    if hasattr(model, "_live_stages"):
+        del model._live_stages
     if resume_from is None:
         model._fit_epoch0 = model._epoch
         model._steps_in_epoch = 0

@@ -1,0 +1,635 @@
+"""Self-healing N-stage 1F1B/GPipe pipeline parallelism (ISSUE 14).
+
+Covers the production pipeline trainer end to end:
+
+- schedule tables (:func:`schedule_meta`) and the re-cuttable layer
+  partition (:func:`stage_partition`);
+- 1F1B vs GPipe vs a single-device microbatched reference — loss
+  sequence AND final params BITWISE on the CPU mesh;
+- one compile per (stage-count, schedule) under
+  ``tracecheck.steady_state`` (remap/grow cycles ride the executable
+  cache);
+- the kill-a-stage drill: an env-plan ``pipeline/stage`` device_loss
+  recovers by ``remap_and_continue`` (manually and under the
+  supervisor), post-remap losses bitwise vs a fresh run at the
+  surviving stage count; the remap-refused case (1 survivor) falls
+  back to checkpoint-restart;
+- ``pipeline/stage`` ``slow`` (straggler) and ``wedge`` (hung
+  schedule) fault kinds;
+- checkpoint integration: kill+resume bit-exact through the standard
+  machinery, the ``stages`` cursor field, and the legacy
+  PipelineParallel / HeterogeneousPipeline snapshot()/restore()
+  routing;
+- observability: the ``pipeline`` profiler ledger (bubble fraction)
+  and the ``pipeline/stage_fwd`` / ``pipeline/stage_bwd`` Chrome-trace
+  lanes + the ``pipeline/remap`` span.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common import faultinject, flightrec, tracecheck
+from deeplearning4j_tpu.common.profiler import OpProfiler
+from deeplearning4j_tpu.data import NDArrayDataSetIterator
+from deeplearning4j_tpu.learning import Sgd
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.parallel import (PipelineTrainer,
+                                         TrainingSupervisor,
+                                         pipeline_from_mln, schedule_meta,
+                                         stage_partition)
+from deeplearning4j_tpu.parallel.mesh import make_pipeline_mesh
+
+FEAT = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.clear_plan()
+    yield
+    faultinject.clear_plan()
+    os.environ.pop(faultinject.ENV_PLAN, None)
+
+
+def dense_stack(n_layers=4, feat=FEAT, seed=2, lr=0.05):
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater(Sgd(learning_rate=lr)).list())
+    for _ in range(n_layers):
+        b.layer(L.DenseLayer(n_out=feat, activation="tanh"))
+    conf = b.set_input_type(InputType.feed_forward(feat)).build()
+    return MultiLayerNetwork(conf).init()
+
+
+def synth(n, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, FEAT)).astype(np.float32)
+    return x, np.tanh(x) * 0.5
+
+
+class Collect:
+    """Loss collector (synced per step — test-only)."""
+
+    def __init__(self):
+        self.losses = []
+
+    def iteration_done(self, model, iteration, score):
+        self.losses.append(float(np.asarray(score)))
+
+
+def params_equal(a, b):
+    la = jax.tree.leaves(jax.device_get(a))
+    lb = jax.tree.leaves(jax.device_get(b))
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(p), np.asarray(q))
+        for p, q in zip(la, lb))
+
+
+def host_state(model):
+    return jax.tree.map(np.array, jax.device_get(
+        (model._params, model._updater_state)))
+
+
+def reference_losses(n_layers, seed, x, y, batch, data_axis, n_micro,
+                     steps, start_params=None, start_iter=0):
+    """Single-device microbatched reference with the pipeline's exact
+    accumulation topology: per data shard, per-microbatch grads/losses
+    accumulate in ascending order; shards then combine (the data-axis
+    psum). Returns (losses, params)."""
+    ref = dense_stack(n_layers, seed=seed)
+    key0 = jax.random.PRNGKey(0)
+    l0 = ref.conf.layers[0]
+
+    def block(p, xx):
+        out, _ = l0.apply(p, xx, {}, False, key0)
+        return out
+
+    upd = ref.conf.global_conf.updater
+    params = (start_params if start_params is not None
+              else [ref._params[i] for i in range(n_layers)])
+    state = upd.init(params)
+
+    @jax.jit
+    def ref_step(params, state, xb, yb, wb, it):
+        denom = jnp.maximum(jnp.sum(wb), 1.0)
+        bl = xb.shape[0] // data_axis
+        mb = bl // n_micro
+
+        def micro(pl, xm, ym, wm):
+            def lf(pl):
+                xx = xm
+                for p in pl:
+                    xx = block(p, xx)
+                per = jnp.mean(jnp.square(xx - ym),
+                               axis=tuple(range(1, xx.ndim)))
+                return jnp.sum(per * wm) / denom
+
+            return jax.value_and_grad(lf)(pl)
+
+        dps, losses = [], []
+        for d in range(data_axis):
+            dp_d = jax.tree.map(jnp.zeros_like, params)
+            loss_d = jnp.float32(0.0)
+            for m in range(n_micro):
+                sl = slice(d * bl + m * mb, d * bl + (m + 1) * mb)
+                l_m, dpm = micro(params, xb[sl], yb[sl], wb[sl])
+                dp_d = jax.tree.map(lambda a, b: a + b, dp_d, dpm)
+                loss_d = loss_d + l_m
+            dps.append(dp_d)
+            losses.append(loss_d)
+        dp, loss = dps[0], losses[0]
+        for d in range(1, data_axis):
+            dp = jax.tree.map(lambda a, b: a + b, dp, dps[d])
+            loss = loss + losses[d]
+        new_p, new_s = upd.apply(dp, state, params, it)
+        return new_p, new_s, loss
+
+    out = []
+    for i in range(steps):
+        xb = jnp.asarray(x[i * batch:(i + 1) * batch])
+        yb = jnp.asarray(y[i * batch:(i + 1) * batch])
+        wb = jnp.ones((batch,), jnp.float32)
+        params, state, lv = ref_step(params, state, xb, yb, wb,
+                                     jnp.asarray(start_iter + i))
+        out.append(float(lv))
+    return out, params
+
+
+class TestSchedules:
+    def test_partition(self):
+        assert stage_partition(10, 4) == [(0, 3), (3, 6), (6, 8), (8, 10)]
+        assert stage_partition(4, 4) == [(0, 1), (1, 2), (2, 3), (3, 4)]
+        with pytest.raises(ValueError, match="cut"):
+            stage_partition(3, 4)
+
+    @pytest.mark.parametrize("schedule", ["1f1b", "gpipe"])
+    @pytest.mark.parametrize("S,M", [(2, 1), (3, 4), (4, 8)])
+    def test_meta_invariants(self, schedule, S, M):
+        meta = schedule_meta(schedule, S, M)
+        fwd, bwd = meta["fwd"], meta["bwd"]
+        assert meta["T"] == 2 * (M + S - 1)
+        assert not (fwd & bwd).any()
+        assert fwd.sum() == bwd.sum() == M * S
+        # both schedules hit the textbook bubble exactly
+        assert meta["bubble_fraction"] == pytest.approx(
+            (S - 1) / (M + S - 1))
+        # the 1F1B point: stash bounded by S, not M
+        if schedule == "1f1b":
+            assert meta["stash"] == min(S, M)
+        else:
+            assert meta["stash"] == M
+        # dependency sanity: stage s+1's fwd(m) is one tick after stage
+        # s's; bwd flows the other way
+        for m in range(M):
+            f = [int(np.where(fwd[:, s] & (meta["m_f"][:, s] == m))[0][0])
+                 for s in range(S)]
+            b = [int(np.where(bwd[:, s] & (meta["m_b"][:, s] == m))[0][0])
+                 for s in range(S)]
+            assert f == [f[0] + s for s in range(S)]
+            assert b == [b[0] - s for s in range(S)]
+            assert b[-1] > f[-1]
+
+    def test_schedules_bitwise_vs_reference(self):
+        """1F1B and GPipe loss sequences + final params are BITWISE
+        equal to each other and to the single-device microbatched
+        reference (CPU)."""
+        n_layers, batch, steps, D, M = 4, 32, 4, 2, 4
+        x, y = synth(steps * batch)
+        runs = {}
+        for schedule in ("1f1b", "gpipe"):
+            model = dense_stack(n_layers)
+            tr = PipelineTrainer(model, stages=4, n_micro=M,
+                                 schedule=schedule, data=D)
+            c = Collect()
+            tr.set_listeners(c)
+            tr.fit(NDArrayDataSetIterator(x, y, batch_size=batch),
+                   epochs=1, batch_size=batch)
+            runs[schedule] = (c.losses, model._params)
+        ref_losses, ref_params = reference_losses(
+            n_layers, 2, x, y, batch, D, M, steps)
+        assert runs["1f1b"][0] == runs["gpipe"][0] == ref_losses
+        assert params_equal(runs["1f1b"][1], ref_params)
+        assert params_equal(runs["gpipe"][1], ref_params)
+
+    def test_padded_batch_rows_inert(self):
+        """The shared input pipeline's pad rows (w=0) contribute nothing
+        to the pipeline loss."""
+        x, y = synth(8)
+        model = dense_stack(4)
+        tr = PipelineTrainer(model, stages=4, n_micro=4, data=2)
+        c = Collect()
+        tr.set_listeners(c)
+        # 8 real rows pad up to the 32-row stable batch
+        tr.fit(NDArrayDataSetIterator(x, y, batch_size=32), epochs=1,
+               batch_size=32)
+        ref = dense_stack(4)
+        key0 = jax.random.PRNGKey(0)
+        xx = jnp.asarray(x)
+        for i in range(4):
+            xx, _ = ref.conf.layers[0].apply(ref._params[i], xx, {},
+                                             False, key0)
+        want = float(jnp.sum(jnp.mean(jnp.square(xx - y), axis=1)) / 8.0)
+        assert c.losses[0] == pytest.approx(want, rel=1e-6)
+
+
+class TestFitSurface:
+    def test_one_compile_per_stage_count_and_schedule(self):
+        prof = OpProfiler.get()
+        x, y = synth(2 * 32)
+        model = dense_stack(4)
+        tr = PipelineTrainer(model, stages=4, n_micro=4, data=2)
+        before = prof.counter_value("trace/pipeline_fit_step")
+        tr.fit(NDArrayDataSetIterator(x, y, batch_size=32), epochs=1,
+               batch_size=32)
+        assert prof.counter_value("trace/pipeline_fit_step") == before + 1
+        # steady state: a second fit (and the epoch after a remap cycle
+        # back to a cached count) must not trace or sync
+        tr.remap(3)
+        tr.fit(NDArrayDataSetIterator(x, y, batch_size=32), epochs=1,
+               batch_size=32)
+        assert prof.counter_value("trace/pipeline_fit_step") == before + 2
+        tr.remap(4)   # grow back: cached executable + mesh
+        with tracecheck.steady_state("pipeline steady"):
+            tr.fit(NDArrayDataSetIterator(x, y, batch_size=32), epochs=1,
+                   batch_size=32)
+        assert prof.counter_value("trace/pipeline_fit_step") == before + 2
+
+    def test_telemetry_aux(self):
+        class Sink:
+            wants_telemetry = True
+
+            def __init__(self):
+                self.aux = []
+
+            def iteration_done(self, model, iteration, score):
+                pass
+
+            def telemetry_done(self, model, iteration, aux):
+                self.aux.append(aux)
+
+        x, y = synth(2 * 32)
+        model = dense_stack(4)
+        tr = PipelineTrainer(model, stages=4, n_micro=4, data=2)
+        sink = Sink()
+        tr.set_listeners(sink)
+        tr.fit(NDArrayDataSetIterator(x, y, batch_size=32), epochs=1,
+               batch_size=32)
+        assert len(sink.aux) == 2
+        aux = jax.device_get(sink.aux[0])
+        for k in ("loss", "grad_norm", "update_norm", "param_norm",
+                  "update_ratio", "nonfinite", "nonfinite_total"):
+            assert k in aux
+        assert aux["grad_norm"].shape == (4,)
+        assert np.isfinite(aux["grad_norm"]).all()
+        assert (aux["grad_norm"] > 0).all()
+        assert int(aux["nonfinite_total"]) == 0
+
+    def test_labels_mask_refused(self):
+        from deeplearning4j_tpu.data.dataset import DataSet
+
+        x, y = synth(32)
+        ds = DataSet(x, y)
+        ds.labels_mask = ds.labels
+        model = dense_stack(4)
+        tr = PipelineTrainer(model, stages=4, n_micro=4, data=2)
+        with pytest.raises(ValueError, match="masks"):
+            tr.fit(ds, epochs=1, batch_size=32)
+
+    def test_model_contract_refusals(self):
+        model = dense_stack(3)
+        with pytest.raises(ValueError, match="layers"):
+            PipelineTrainer(model, stages=4, n_micro=4)
+        with pytest.raises(ValueError, match=">= 2 stages"):
+            PipelineTrainer(model, stages=1, n_micro=4)
+        b = (NeuralNetConfiguration.builder().seed(1)
+             .updater(Sgd(learning_rate=0.1)).list()
+             .layer(L.DenseLayer(n_out=FEAT, activation="tanh"))
+             .layer(L.DenseLayer(n_out=FEAT, activation="relu")))
+        mixed = MultiLayerNetwork(
+            b.set_input_type(InputType.feed_forward(FEAT)).build()).init()
+        with pytest.raises(ValueError, match="identical"):
+            PipelineTrainer(mixed, stages=2, n_micro=4)
+
+
+class TestKillAStage:
+    """The drill the issue is named after: a ``pipeline/stage``
+    device_loss recovers by elastic remap, not restart."""
+
+    def test_manual_remap_bitwise_vs_fresh_run(self):
+        """Env fault plan kills stage 2 mid-epoch; remap to 3 stages and
+        the continuation's loss sequence + final params are BITWISE
+        equal to a fresh 3-stage run handed the same state/cursor."""
+        n_layers, batch, D, M = 4, 32, 2, 4
+        x, y = synth(6 * batch)
+
+        def make_it():
+            return NDArrayDataSetIterator(x, y, batch_size=batch)
+
+        os.environ[faultinject.ENV_PLAN] = json.dumps(
+            [{"site": "pipeline/stage", "kind": "device_loss",
+              "index": 3, "stage": 2}])
+        faultinject.clear_plan()   # re-read from env
+        model = dense_stack(n_layers)
+        tr = PipelineTrainer(model, stages=4, n_micro=M, data=D)
+        c = Collect()
+        tr.set_listeners(c)
+        with pytest.raises(faultinject.DeviceLostError) as ei:
+            tr.fit(make_it(), epochs=2, batch_size=batch)
+        assert ei.value.stage == 2
+        faultinject.clear_plan()
+        os.environ.pop(faultinject.ENV_PLAN)
+        assert len(c.losses) == 3     # dispatches 0..2 landed
+        cursor = (int(model._epoch - model._fit_epoch0),
+                  int(model._steps_in_epoch))
+        snap_p, snap_u = host_state(model)
+        it_ep = (model._iteration, model._epoch)
+
+        removed = tr.remap(3, lost_stages=[2])
+        assert len(removed) == D      # the stage's device column left
+        assert not (set(removed) & set(tr.mesh.devices.flat))
+        tr.fit(make_it(), epochs=2, batch_size=batch,
+               resume_cursor=cursor)
+        post = c.losses[3:]
+        assert len(post) == 2 * 6 - 3   # zero lost batches
+
+        # fresh 3-stage run from the same host state + cursor
+        model2 = dense_stack(n_layers)
+        model2._params = [jax.tree.map(jnp.array, t) for t in snap_p]
+        model2._updater_state = jax.tree.map(jnp.array, snap_u)
+        model2._iteration, model2._epoch = it_ep
+        tr2 = PipelineTrainer(model2, stages=3, n_micro=M, data=D)
+        c2 = Collect()
+        tr2.set_listeners(c2)
+        tr2.fit(make_it(), epochs=2, batch_size=batch,
+                resume_cursor=cursor)
+        assert post == c2.losses
+        assert params_equal(model._params, model2._params)
+
+    def test_supervised_remap_and_continue(self, tmp_path):
+        x, y = synth(4 * 32)
+
+        def make_it():
+            return NDArrayDataSetIterator(x, y, batch_size=32)
+
+        os.environ[faultinject.ENV_PLAN] = json.dumps(
+            [{"site": "pipeline/stage", "kind": "device_loss",
+              "index": 2, "stage": 1}])
+        faultinject.clear_plan()
+        model = dense_stack(4)
+        tr = PipelineTrainer(model, stages=4, n_micro=4, data=2)
+        sup = TrainingSupervisor(tr, str(tmp_path),
+                                 save_every_n_iterations=2,
+                                 elastic_grow=False)
+        res = sup.fit(make_it, epochs=2)
+        assert res.status == "completed"
+        assert res.restarts == 0      # a remap consumes no restart
+        assert [h["policy"] for h in res.history] == ["remap_and_continue"]
+        assert res.history[0]["class"] == "device_failure"
+        assert tr.stages_count == 3
+        prof = OpProfiler.get()
+        assert prof.counter_value("supervisor/remaps") >= 1
+        assert prof.counter_value("pipeline/remaps") >= 1
+        spans = flightrec.events(prefix="pipeline/remap")
+        assert any(e["ph"] == "B" and e["attrs"].get("stages_to") == 3
+                   for e in spans)
+
+    def test_remap_refused_falls_back_to_restart(self, tmp_path):
+        """1 surviving stage is below the remap gate — checkpoint-restart
+        owns the recovery (the documented fallback)."""
+        x, y = synth(4 * 32)
+
+        def make_it():
+            return NDArrayDataSetIterator(x, y, batch_size=32)
+
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "pipeline/stage", "kind": "device_loss",
+              "index": 2, "stage": 1}]))
+        model = dense_stack(4)
+        tr = PipelineTrainer(model, stages=2, n_micro=4, data=1)
+        sup = TrainingSupervisor(tr, str(tmp_path),
+                                 save_every_n_iterations=2,
+                                 elastic_grow=False)
+        res = sup.fit(make_it, epochs=1)
+        assert res.status == "completed"
+        assert res.restarts == 1
+        assert [h["policy"] for h in res.history] == ["restart"]
+        assert tr.stages_count == 2   # never remapped
+
+    def test_slow_and_wedge_stage_kinds(self):
+        x, y = synth(2 * 32)
+        model = dense_stack(4)
+        tr = PipelineTrainer(model, stages=4, n_micro=4, data=2)
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "pipeline/stage", "kind": "slow", "index": 0,
+              "seconds": 0.01}]))
+        tr.fit(NDArrayDataSetIterator(x, y, batch_size=32), epochs=1,
+               batch_size=32)   # straggler stage: slow, not fatal
+        prof = OpProfiler.get()
+        assert prof.counter_value("faults/pipeline/stage/slow") >= 1
+        # a wedged schedule blocks until released/timeout, then the
+        # thread dies (the supervisor watchdog's drill contract)
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "pipeline/stage", "kind": "wedge", "index": 0,
+              "seconds": 0.05}]))
+        with pytest.raises(faultinject.WedgeReleased):
+            tr.fit(NDArrayDataSetIterator(x, y, batch_size=32), epochs=1,
+                   batch_size=32)
+
+
+class TestCheckpoint:
+    def test_kill_resume_bit_exact(self, tmp_path):
+        """A pipeline fit killed mid-epoch resumes from the last
+        committed checkpoint with a loss sequence + final params bitwise
+        equal to the uninterrupted run — the standard PR-3 contract, now
+        for the pipeline path."""
+        from deeplearning4j_tpu.optimize.listeners import CheckpointListener
+        from deeplearning4j_tpu.util import checkpoint as _ckpt
+
+        batch, steps = 32, 6
+        x, y = synth(steps * batch)
+
+        def make_it():
+            return NDArrayDataSetIterator(x, y, batch_size=batch)
+
+        # clean run
+        model_a = dense_stack(4)
+        tr_a = PipelineTrainer(model_a, stages=4, n_micro=4, data=2)
+        c_a = Collect()
+        tr_a.set_listeners(c_a)
+        tr_a.fit(make_it(), epochs=1, batch_size=batch)
+
+        # killed run: checkpoint every 2 iterations, crash at dispatch 4
+        d = str(tmp_path)
+        model_b = dense_stack(4)
+        tr_b = PipelineTrainer(model_b, stages=4, n_micro=4, data=2)
+        c_b = Collect()
+        ckpt = CheckpointListener(d, save_every_n_iterations=2)
+        tr_b.set_listeners(c_b, ckpt)
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "train/step", "kind": "crash", "index": 4}]))
+        with pytest.raises(faultinject.SimulatedCrash):
+            tr_b.fit(make_it(), epochs=1, batch_size=batch)
+        faultinject.clear_plan()
+        ckpt.close()
+        resume = _ckpt.last_checkpoint(d)
+        assert resume is not None
+
+        # resumed run: fresh trainer, exact continuation
+        model_c = dense_stack(4)
+        tr_c = PipelineTrainer(model_c, stages=4, n_micro=4, data=2)
+        c_c = Collect()
+        tr_c.set_listeners(c_c)
+        tr_c.fit(make_it(), epochs=1, batch_size=batch,
+                 resume_from=resume)
+        resumed_from = steps - len(c_c.losses)
+        assert 0 < resumed_from <= 4
+        assert c_b.losses[:resumed_from] == c_a.losses[:resumed_from]
+        assert c_c.losses == c_a.losses[resumed_from:]
+        assert params_equal(model_c._params, model_a._params)
+        assert params_equal(model_c._updater_state,
+                            model_a._updater_state)
+
+    def test_cursor_records_stages(self):
+        from deeplearning4j_tpu.util.checkpoint import (
+            snapshot_training_state)
+
+        x, y = synth(32)
+        model = dense_stack(4)
+        tr = PipelineTrainer(model, stages=4, n_micro=4, data=2)
+        tr.fit(NDArrayDataSetIterator(x, y, batch_size=32), epochs=1,
+               batch_size=32)
+        assert snapshot_training_state(model)["cursor"]["stages"] == 4
+        tr.remap(3)
+        assert snapshot_training_state(model)["cursor"]["stages"] == 3
+        # non-pipeline models keep their resume payload unchanged
+        plain = dense_stack(2)
+        assert "stages" not in snapshot_training_state(plain)["cursor"]
+
+    def _commit(self, directory, snapshot, tag):
+        from deeplearning4j_tpu.util.checkpoint import (commit_checkpoint,
+                                                        serialize_snapshot)
+
+        return commit_checkpoint(directory, tag,
+                                 serialize_snapshot(snapshot),
+                                 snapshot["iteration"], keep_last=3)
+
+    def test_legacy_homogeneous_checkpoint_roundtrip(self, tmp_path):
+        """PipelineParallel routes its state through
+        snapshot_training_state/restore (the ISSUE 14 satellite bugfix):
+        train, checkpoint, diverge, restore → bitwise replay."""
+        S = 4
+        mesh = make_pipeline_mesh(1, S, devices=jax.devices()[:S])
+        pmesh = jax.sharding.Mesh(
+            np.asarray(jax.devices()[:S]), ("stage",))
+        model = dense_stack(S)
+        pp = pipeline_from_mln(model, pmesh, n_micro=4)
+        assert pp.model is model
+        x, y = synth(16, seed=3)
+        pp.train_step(x, y, lr=0.1)
+        path = self._commit(str(tmp_path), pp.snapshot(), "t1")
+        l_after = [float(pp.train_step(x, y, lr=0.1)) for _ in range(2)]
+        p_after = np.array(jax.device_get(
+            jax.tree.leaves(pp.params)[0]))
+        # restore into a FRESH model+pipeline and replay
+        model2 = dense_stack(S, seed=7)
+        pp2 = pipeline_from_mln(model2, pmesh, n_micro=4)
+        pp2.restore(path)
+        l_replay = [float(pp2.train_step(x, y, lr=0.1)) for _ in range(2)]
+        assert l_replay == l_after
+        assert np.array_equal(
+            np.array(jax.device_get(jax.tree.leaves(pp2.params)[0])),
+            p_after)
+        assert mesh.shape["stage"] == S
+
+    def test_legacy_heterogeneous_checkpoint_roundtrip(self, tmp_path):
+        b = (NeuralNetConfiguration.builder().seed(5)
+             .updater(Sgd(learning_rate=0.05)).list()
+             .layer(L.DenseLayer(n_out=12, activation="tanh"))
+             .layer(L.DenseLayer(n_out=6, activation="tanh"))
+             .layer(L.DenseLayer(n_out=4, activation="identity")))
+        model = MultiLayerNetwork(
+            b.set_input_type(InputType.feed_forward(FEAT)).build()).init()
+        pmesh = jax.sharding.Mesh(
+            np.asarray(jax.devices()[:2]), ("stage",))
+        pp = pipeline_from_mln(model, pmesh, n_micro=4, cuts=[2],
+                               example_input=(8, FEAT))
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((8, FEAT)).astype(np.float32)
+        y = rng.standard_normal((8, 4)).astype(np.float32)
+        pp.train_step(x, y, lr=0.1)
+        path = self._commit(str(tmp_path), pp.snapshot(), "t1")
+        l_after = [float(pp.train_step(x, y, lr=0.1)) for _ in range(2)]
+        model2 = MultiLayerNetwork(model.conf).init()
+        pp2 = pipeline_from_mln(model2, pmesh, n_micro=4, cuts=[2],
+                                example_input=(8, FEAT))
+        pp2.restore(path)
+        l_replay = [float(pp2.train_step(x, y, lr=0.1)) for _ in range(2)]
+        assert l_replay == l_after
+        # stage_params hands back host copies decoupled from the live
+        # payload: mutating them must not touch the pipeline's params
+        sp = pp2.stage_params(0)
+        leaf = jax.tree.leaves(sp)[0]
+        assert isinstance(leaf, np.ndarray)
+        before = np.array(jax.device_get(pp2.params))
+        leaf[...] = 1e9
+        assert np.array_equal(np.array(jax.device_get(pp2.params)),
+                              before)
+
+
+class TestObservability:
+    def test_ledger_and_stage_lanes(self, tmp_path):
+        prof = OpProfiler.get()
+        flightrec.reset()
+        x, y = synth(2 * 32)
+        model = dense_stack(4)
+        S, M = 4, 4
+        tr = PipelineTrainer(model, stages=S, n_micro=M, data=2)
+        before = prof.counter_value("pipeline/microbatches")
+        tr.fit(NDArrayDataSetIterator(x, y, batch_size=32), epochs=1,
+               batch_size=32)
+        ledger = prof.pipeline_stats()
+        assert ledger["stages"] == S
+        assert ledger["microbatches"] - (before and 0) >= 2 * M
+        assert 0.0 < ledger["bubble_fraction"] < 1.0
+        assert ("pipeline", "pipeline_stats") in OpProfiler.LEDGERS
+        # per-stage schedule lanes landed on the recorder...
+        fwd = flightrec.events(prefix="pipeline/stage_fwd")
+        bwd = flightrec.events(prefix="pipeline/stage_bwd")
+        assert len(fwd) == len(bwd) == 2 * S
+        assert {e["attrs"]["stage"] for e in fwd} == set(range(S))
+        # ...and export as named synthetic Chrome lanes with X slices
+        out = os.path.join(str(tmp_path), "trace.json")
+        flightrec.export_chrome_trace(out)
+        with open(out) as f:
+            doc = json.load(f)
+        names = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e["ph"] == "M"}
+        # fwd/bwd ride separate sub-lanes: 1F1B windows interleave, and
+        # partially-overlapping X slices on one Perfetto track mis-render
+        assert {f"pipeline/stage{s}/{d}" for s in range(S)
+                for d in ("fwd", "bwd")} <= names
+        xs = [e for e in doc["traceEvents"]
+              if e["ph"] == "X" and e["name"] == "pipeline/stage_fwd"]
+        assert xs and all(e["dur"] > 0 for e in xs)
+
+    def test_bubble_fraction_tracks_analytic_bound(self):
+        prof = OpProfiler.get()
+        x, y = synth(2 * 48)
+        model = dense_stack(4)
+        S, M = 4, 8
+        tr = PipelineTrainer(model, stages=S, n_micro=M, data=1)
+        # isolate this run's tick accounting
+        busy0 = prof.counter_value("pipeline/busy_ticks")
+        slots0 = prof.counter_value("pipeline/tick_slots")
+        tr.fit(NDArrayDataSetIterator(x, y, batch_size=48), epochs=1,
+               batch_size=48)
+        busy = prof.counter_value("pipeline/busy_ticks") - busy0
+        slots = prof.counter_value("pipeline/tick_slots") - slots0
+        measured = 1.0 - busy / slots
+        assert measured == pytest.approx((S - 1) / (M + S - 1))
