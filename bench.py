@@ -302,11 +302,37 @@ def bench_resnet50(steps: int, batch: int = 64, image_size: int = 224,
     flops = _flops_per_step(
         model, (model._params, model._states, model._updater_state, inputs,
                 labels, {}, jax.random.PRNGKey(0), jnp.asarray(0)))
+    from deeplearning4j_tpu.common import xprof
     from deeplearning4j_tpu.common.profiler import OpProfiler
     from deeplearning4j_tpu.learning.precision import updater_state_bytes
 
     state_bytes = updater_state_bytes(jax.device_get(model._updater_state))
     pstats = OpProfiler.get().precision_stats()
+    # the performance observatory (ISSUE 15): join the value-fenced step
+    # median onto the census and attach the per-executable roofline —
+    # the cost/MFU/bound fields the BENCH_r06+ trajectory carries.
+    # analyze(compile=False): cost analysis from the lowering only — an
+    # AOT re-compile here would double the bench's compile bill.
+    xprof.note_measured("graph/fit_step", statistics.median(times))
+    xprof.analyze(compile=False)
+    # single-DataSet fits ride the serial path (no run_epochs epoch
+    # boundary), so sample the steady-state HBM watermark explicitly —
+    # one live-buffer census at the end of the timed loop
+    xprof.memory_watermark("fit")
+    roofline = {}
+    for name, row in xprof.roofline().items():
+        if not (row.get("calls") or row.get("generations")):
+            continue
+        out_row = {k: (round(v, 6) if isinstance(v, float) else v)
+                   for k, v in row.items()
+                   if k in ("calls", "generations", "step_s", "mfu",
+                            "arithmetic_intensity", "bound",
+                            "cost_source")}
+        cost = row.get("cost", {})
+        if cost:
+            out_row["flops"] = cost.get("flops")
+            out_row["bytes"] = cost.get("bytes_accessed")
+        roofline[name] = out_row
     return _summarize(
         "resnet50_imagenet_train", times, batch, flops,
         jax.devices()[0].platform,
@@ -318,6 +344,8 @@ def bench_resnet50(steps: int, batch: int = 64, image_size: int = 224,
          "updater_state_bytes": state_bytes,
          "fused_kernel": {k: int(v) for k, v in pstats.items()
                           if k.startswith("fused_") or k == "sr_draws"},
+         "xla_roofline": roofline,
+         "hbm_watermarks": xprof.watermarks(),
          "data": "synthetic batch, device-resident (train-step config; the "
                  "disk-fed input pipeline is the resnet50-disk config)",
          "listener": with_listener})
@@ -2816,6 +2844,205 @@ def bench_obs_smoke(steps: int, batch: int = 64) -> dict:
     }
 
 
+def bench_xprof_smoke(steps: int, batch: int = 64) -> dict:
+    """CPU-friendly smoke of the XLA performance observatory (ISSUE 15).
+    Five self-validating phases, every gate a hard fail:
+
+    1. **Census coverage**: a LeNet-class fit (per-step jit + infer jit)
+       and a warmed ServingEngine bucket ladder; after
+       ``xprof.analyze()`` every executable the smoke compiled must
+       appear in the census with non-empty cost fields (flops/bytes) or
+       an explicit counted fallback — a compiled-but-invisible
+       executable is the bug class the census exists for.
+    2. **Interleaved A/B census overhead** (census off vs on) inside a
+       ``tracecheck.steady_state`` region: >5% min-over-ratios overhead
+       (one automatic A/B re-run — the shared ``_ab_overhead_gate``)
+       fails, any retrace delta fails (flipping the census must never
+       rebuild a step).
+    3. **Roofline ledger**: the ``xla`` entry of ``ledger_stats`` must
+       carry per-executable flops/MFU/bound rows, and ``/api/metrics``
+       (``prometheus_text``) must expose them.
+    4. **Regression gate drill**: a deliberately-regressed synthetic
+       record (step time +20%) against this run's own record must TRIP
+       ``benchtrack.compare_records``; the clean copy must pass.
+    5. **HBM watermarks**: the per-epoch ``fit`` phase must have
+       sampled, and ``dump_memory_census`` must write a parseable
+       census (the crash-blackbox companion).
+    """
+    import statistics as _stats
+    import tempfile
+
+    import jax
+
+    from deeplearning4j_tpu.common import tracecheck, xprof
+    from deeplearning4j_tpu.common.profiler import OpProfiler
+    from deeplearning4j_tpu.data import NDArrayDataSetIterator
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.parallel import ServingEngine
+    from deeplearning4j_tpu.ui.server import prometheus_text
+    from tools import benchtrack
+
+    prof = OpProfiler.get()
+    rng = np.random.RandomState(0)
+    n = steps * batch + batch // 2
+    x = rng.randn(n, 1, 28, 28).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+
+    def make_it():
+        return NDArrayDataSetIterator(x, y, batch_size=batch)
+
+    def fail(msg, **extra):
+        print(json.dumps({"error": msg, **extra}, default=str))
+        sys.exit(1)
+
+    # ---- phase 1: census coverage (fit + infer + serving ladder) -------
+    xprof.reset()
+    xprof.configure(enabled=True)
+    prof.reset()
+    model = _lenet_model()
+    model.fit(make_it(), epochs=1)
+    float(model._score_dev)
+    model.output(x[:batch])                      # mln/infer executable
+
+    sconf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-3))
+             .activation("tanh").list()
+             .layer(L.DenseLayer(n_out=32))
+             .layer(L.OutputLayer(n_out=10))
+             .set_input_type(InputType.feed_forward(16)).build())
+    smodel = MultiLayerNetwork(sconf).init()
+    eng = (ServingEngine.Builder(smodel)
+           .buckets([1, 4, 8]).input_shape((16,))
+           .workers(1).max_wait_ms(1.0).build())
+    try:
+        analyzed = xprof.analyze()
+        census = xprof.census()
+        compiled_here = ["mln/fit_step", "mln/infer", "serving/bucket"]
+        missing = [name for name in compiled_here if name not in census]
+        if missing:
+            fail("compiled executables missing from the census",
+                 missing=missing, census=sorted(census))
+        for name in compiled_here:
+            entry = census[name]
+            cost = entry.get("cost") or {}
+            if entry.get("cost_source") == "xla" and not cost:
+                fail(f"census entry {name} claims xla analysis but "
+                     "carries no cost fields", entry=entry)
+            if entry.get("cost_source") is None:
+                fail(f"census entry {name} was never analyzed (no cost, "
+                     "no counted fallback)", entry=entry)
+        if census["serving/bucket"]["variants"] \
+                != len(eng.ladder.batch_sizes):
+            fail("serving bucket census variants != ladder size",
+                 variants=census["serving/bucket"]["variants"],
+                 buckets=len(eng.ladder.batch_sizes))
+    finally:
+        eng.shutdown()
+
+    # ---- phase 2: interleaved A/B census on/off ------------------------
+    models = {"off": _lenet_model(), "on": _lenet_model()}
+    for m in models.values():
+        m.fit(make_it(), epochs=1)               # warmup compile
+        float(m._score_dev)
+    prof.reset()
+
+    def timed_epoch(name):
+        m = models[name]
+        xprof.configure(enabled=(name == "on"))
+        t0 = time.perf_counter()
+        m.fit(make_it(), epochs=1)
+        float(m._score_dev)
+        return time.perf_counter() - t0
+
+    try:
+        with tracecheck.steady_state("xprof-smoke timed rounds",
+                                     max_host_syncs=None):
+            overhead, times, overhead_runs = _ab_overhead_gate(
+                "executable-census", 0.05,
+                lambda: _ab_rounds(timed_epoch, rounds=5), fail)
+    except tracecheck.SteadyStateViolation as e:
+        fail("train step retraced inside a timed window — flipping the "
+             "census must not destabilize shapes",
+             violation=str(e).splitlines()[0])
+    finally:
+        xprof.configure(enabled=True)
+    t_off = _stats.median(times["off"])
+    t_on = _stats.median(times["on"])
+
+    # ---- phase 3: xla roofline ledger + Prometheus exposition ----------
+    ledgers = prof.ledger_stats()
+    xla = ledgers.get("xla", {})
+    if not any(k.endswith("/flops") for k in xla):
+        fail("xla ledger carries no per-executable flops rows",
+             keys=sorted(xla)[:20])
+    if not any(k.endswith("/compute_bound") for k in xla):
+        fail("xla ledger carries no bound-classification rows",
+             keys=sorted(xla)[:20])
+    metrics_text = prometheus_text()
+    if 'ledger="xla"' not in metrics_text:
+        fail("/api/metrics exposition is missing the xla ledger family")
+
+    # ---- phase 4: the --compare-to regression gate drill ---------------
+    epoch_steps = -(-len(x) // batch)
+    step_ms = t_on / epoch_steps * 1e3
+    base_rec = {"metric": "xprof_smoke", "value": len(x) / t_on,
+                "unit": "images/sec", "batch": batch,
+                "platform": jax.devices()[0].platform,
+                "step_ms_median": round(step_ms, 3),
+                "step_ms_p10": round(step_ms * 0.97, 3)}
+    regressed = dict(base_rec)
+    regressed["step_ms_median"] = round(step_ms * 1.2, 3)
+    regressed["step_ms_p10"] = round(step_ms * 1.18, 3)
+    regressed["value"] = base_rec["value"] / 1.2
+    tripped = benchtrack.compare_records(
+        {"xprof_smoke": base_rec}, {"xprof_smoke": regressed})
+    if not tripped["violations"]:
+        fail("the regression gate FAILED to flag a 20% step-time "
+             "regression", result=tripped)
+    clean = benchtrack.compare_records(
+        {"xprof_smoke": base_rec}, {"xprof_smoke": dict(base_rec)})
+    if clean["violations"]:
+        fail("the regression gate flagged an identical re-run",
+             result=clean)
+
+    # ---- phase 5: HBM watermarks + memory-census dump ------------------
+    wms = xprof.watermarks()
+    if "fit" not in wms or wms["fit"]["samples"] < 1:
+        fail("per-epoch fit watermark never sampled", watermarks=wms)
+    if wms["fit"]["peak_live_bytes"] <= 0:
+        fail("fit watermark peak is zero", watermarks=wms)
+    dump_path = os.path.join(tempfile.mkdtemp(prefix="xprof_smoke_"),
+                             "memcensus.json")
+    xprof.dump_memory_census(dump_path)
+    blob = json.load(open(dump_path))
+    if "watermarks" not in blob or "census" not in blob:
+        fail("memory-census dump is malformed", keys=sorted(blob))
+
+    images = n + (batch - n % batch) % batch
+    return {
+        "metric": "xprof_smoke",
+        "value": images / t_on,
+        "unit": "images/sec",
+        "batch": batch,
+        "platform": jax.devices()[0].platform,
+        "census_overhead_frac": round(overhead, 4),
+        "overhead_runs": overhead_runs,
+        "epoch_s_off_median": round(t_off, 4),
+        "epoch_s_on_median": round(t_on, 4),
+        "census_executables": len(census),
+        "analyzed": sorted(analyzed),
+        "xla_ledger_rows": len(xla),
+        "fit_watermark": wms.get("fit"),
+        "gate_drill_violations": tripped["violations"],
+        "data": "synthetic LeNet batches + a warmed 3-bucket serving "
+                "ladder; census coverage, A/B census overhead, xla "
+                "roofline/Prometheus, regression-gate drill, HBM "
+                "watermark + memcensus dump",
+    }
+
+
 def _fleet_mlp(seed=7, n_in=64, n_out=10, hidden=32, lr=1e-3):
     from deeplearning4j_tpu.learning import Adam
     from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
@@ -3316,10 +3543,22 @@ def main() -> None:
                                  "zero1-smoke", "elastic-smoke",
                                  "pipeline-parallel-smoke",
                                  "serving-smoke", "autoscale-smoke",
-                                 "mfu-smoke", "obs-smoke", "fleet-smoke"])
+                                 "mfu-smoke", "obs-smoke", "fleet-smoke",
+                                 "xprof-smoke"])
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--batch", type=int, default=None,
                         help="per-config default: resnet50=128, bert=32")
+    parser.add_argument("--image-size", type=int, default=None,
+                        help="resnet50 input resolution (default 224; "
+                             "smaller sizes make CPU re-baselines "
+                             "tractable — the emitted record pins it)")
+    parser.add_argument("--compare-to", default=None, metavar="ROUND",
+                        help="regression gate: after the run, hold every "
+                             "emitted record against the same metric in "
+                             "this BENCH_r*.json round (tools/benchtrack "
+                             "min-over-rounds gates: step time, "
+                             "throughput, MFU, compile counts, state "
+                             "bytes); exit non-zero on any violation")
     parser.add_argument("--with-listener", action="store_true",
                         help="attach a ScoreIterationListener during the timed "
                              "run (validates the listener bus does not tax the "
@@ -3360,6 +3599,7 @@ def main() -> None:
             sys.exit(1)
 
     steps = args.steps or 30
+    emitted: list = []
 
     def emit(result: dict) -> None:
         base = BASELINES.get(result["metric"], {}).get("value")
@@ -3369,7 +3609,26 @@ def main() -> None:
                    "unit": result.pop("unit"),
                    "vs_baseline": round(vs, 3)}
         ordered.update(result)
+        emitted.append(ordered)
         print(json.dumps(ordered), flush=True)
+
+    def finish() -> None:
+        """The --compare-to regression gate (ISSUE 15): every emitted
+        record is held against the baseline round's same-metric record;
+        any violation is a hard non-zero exit. Cross-platform records
+        are skipped (reported, never failed)."""
+        if not args.compare_to:
+            return
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from tools import benchtrack
+
+        baseline = benchtrack.parse_round(args.compare_to)
+        current = {r["metric"]: r for r in emitted}
+        result = benchtrack.compare_records(baseline["records"], current)
+        print(json.dumps({"compare_to": args.compare_to, **result}),
+              flush=True)
+        if result["violations"]:
+            sys.exit(1)
 
     if args.config == "flagships":
         # The default run tells the WHOLE flagship story (round-3 verdict
@@ -3389,7 +3648,9 @@ def main() -> None:
         emit(bench_glove())
         emit(bench_fasttext())
         emit(bench_resnet50(args.steps or 80, batch=args.batch or 128,
+                            image_size=args.image_size or 224,
                             with_listener=args.with_listener))
+        finish()
         return
     if args.config == "lenet":
         result = bench_lenet(steps, with_listener=args.with_listener)
@@ -3434,14 +3695,21 @@ def main() -> None:
         result = bench_obs_smoke(steps, batch=args.batch or 64)
     elif args.config == "fleet-smoke":
         result = bench_fleet_smoke(steps, batch=args.batch or 64)
+    elif args.config == "xprof-smoke":
+        result = bench_xprof_smoke(steps, batch=args.batch or 64)
     elif args.config == "resnet50-disk":
-        result = bench_resnet50_disk(steps, batch=args.batch or 64)
+        result = bench_resnet50_disk(steps, batch=args.batch or 64,
+                                     image_size=args.image_size or 224)
     elif args.config == "resnet50-predecoded":
-        result = bench_resnet50_predecoded(steps, batch=args.batch or 64)
+        result = bench_resnet50_predecoded(
+            steps, batch=args.batch or 64,
+            image_size=args.image_size or 224)
     else:
         result = bench_resnet50(steps, batch=args.batch or 128,
+                                image_size=args.image_size or 224,
                                 with_listener=args.with_listener)
     emit(result)
+    finish()
 
 
 if __name__ == "__main__":

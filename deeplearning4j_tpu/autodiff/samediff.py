@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..common import xprof
 from ..common.dtypes import DataType
 from ..ndarray.ndarray import NDArray
 from ..ndarray.rng import get_random
@@ -584,7 +585,8 @@ class SameDiff:
         cache_key = (outputs, training)
         if cache_key not in self._fn_cache:
             fn = self._make_fn(outputs, training)
-            self._fn_cache[cache_key] = jax.jit(fn)
+            self._fn_cache[cache_key] = xprof.register_jit(
+                "samediff/exec", jax.jit(fn))
         return self._fn_cache[cache_key]
 
     # --- execution -------------------------------------------------------
@@ -623,7 +625,8 @@ class SameDiff:
 
                 return jax.grad(loss_fn)(sub)
 
-            self._fn_cache[cache_key] = jax.jit(grad_fn)
+            self._fn_cache[cache_key] = xprof.register_jit(
+                "samediff/grad", jax.jit(grad_fn))
         params = self._params()
         sub = {n: params.pop(n) for n in wrt}
         grads = self._fn_cache[cache_key](sub, params, ph, jax.random.PRNGKey(0))
@@ -701,7 +704,9 @@ class SameDiff:
             new_params, new_state = updater.apply(grads, upd_state, params, iteration)
             return new_params, new_state, loss
 
-        jitted = jax.jit(step, donate_argnums=(0, 1))
+        jitted = xprof.register_jit(
+            "samediff/fit_step", jax.jit(step, donate_argnums=(0, 1)),
+            donate=(0, 1))
         self._fn_cache[cache_key] = jitted
         return jitted
 

@@ -129,6 +129,13 @@ profiler/section           info        OpProfiler.time_section duration
                                        (Chrome ``X`` lane)
 perf/rate                  info        PerformanceListener throughput
                                        sample
+xprof/exec                 info        executable census: a new compiled
+                                       generation landed (jit retrace,
+                                       AOT bucket, or counted
+                                       sub-executable); test_xprof
+xprof/hbm                  info        HBM watermark: a phase's live-
+                                       buffer peak rose (census bytes
+                                       attached); test_xprof
 =========================  ==========  =================================
 
 Deliberately stdlib-only (no jax, no profiler import) so every
@@ -262,6 +269,14 @@ EVENT_SITES: Dict[str, Dict[str, str]] = {
     "perf/rate": {
         "desc": "PerformanceListener throughput/latency sample",
         "drill": "test_observability PerformanceListener test"},
+    "xprof/exec": {
+        "desc": "executable census generation (jit retrace / AOT bucket "
+                "/ counted sub-executable, compile wall attached)",
+        "drill": "test_xprof census events; xprof-smoke"},
+    "xprof/hbm": {
+        "desc": "HBM watermark peak rose for a phase (live/device bytes "
+                "attached)",
+        "drill": "test_xprof watermark test; xprof-smoke"},
 }
 
 DEFAULT_CAPACITY = 4096

@@ -42,6 +42,7 @@ class OpProfiler:
         ("autoscale", "autoscale_stats"),
         ("fleet", "fleet_stats"),
         ("precision", "precision_stats"),
+        ("xla", "xla_stats"),
         ("tracecheck", "tracecheck_stats"),
         ("faults", "fault_stats"),
     )
@@ -366,6 +367,23 @@ class OpProfiler:
         Empty until a fit or fused inference runs."""
         return {k.split("/", 1)[1]: v for k, v in self._counters.items()
                 if k.startswith("precision/")}
+
+    def xla_stats(self) -> Dict[str, float]:
+        """XLA performance-observatory ledger (``common.xprof``): the
+        per-executable roofline rows — calls, mean dispatch ms, retrace
+        generations, compile wall, analytic flops/bytes, arithmetic
+        intensity, MFU and the compute-vs-HBM-bound verdict — plus the
+        census totals and the per-phase HBM watermark gauges, flattened
+        under slash-keys. Cost fields appear after ``xprof.analyze()``
+        ran (analysis re-traces, so it is explicit — never per step);
+        everything else accrues live. Empty until an executable
+        registers with the census."""
+        try:
+            from . import xprof
+
+            return xprof.ledger()
+        except Exception:       # census import/jax failure: ledger-silent
+            return {}
 
     def tracecheck_stats(self) -> Dict[str, float]:
         """Steady-state sanitizer ledger (``tracecheck/*`` counters):

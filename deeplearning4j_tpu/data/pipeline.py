@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..common import faultinject, flightrec
+from ..common import faultinject, flightrec, xprof
 from ..common.background import staged_iter
 from ..common.profiler import OpProfiler
 from ..ndarray.ndarray import NDArray
@@ -412,6 +412,10 @@ def run_epochs(data: Any, epochs: int, batch_size: Optional[int],
                         for b in group:
                             dispatch_one(b)
             on_epoch()
+            # HBM watermark: one live-buffer census per epoch (the same
+            # walk /api/health serves) feeds the per-phase peak gauges —
+            # epoch cadence, never per dispatch
+            xprof.memory_watermark("fit")
 
 
 def note_steps(holder: Any, listeners: Iterable, losses,

@@ -196,9 +196,14 @@ class AsyncDataSetIterator(DataSetIterator):
         if feature_transform is not None and not device_prefetch:
             raise ValueError("feature_transform is applied on device and "
                              "requires device_prefetch=True")
-        self._feature_transform = (None if feature_transform is None
-                                   else __import__("jax").jit(
-                                       feature_transform))
+        if feature_transform is None:
+            self._feature_transform = None
+        else:
+            from ..common import xprof
+
+            self._feature_transform = xprof.register_jit(
+                "data/feature_transform",
+                __import__("jax").jit(feature_transform))
 
     def batch(self) -> int:
         return self.base.batch()

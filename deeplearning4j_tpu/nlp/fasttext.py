@@ -25,6 +25,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..common import xprof
 from .lookup_table import InMemoryLookupTable
 from .vocab import VocabConstructor
 from .word2vec import SequenceVectors
@@ -201,7 +202,8 @@ class FastText(SequenceVectors):
             _, syn0, syn1, lsum, wsum = lax.while_loop(cond, body, init)
             return (syn0, syn1, lsum / jnp.maximum(wsum, 1.0), wsum)
 
-        return block
+        return xprof.register_jit("nlp/fasttext_block", block,
+                                  donate=(0, 1))
 
     def fit(self) -> None:
         if len(self.vocab) == 0 or self.lookup_table.syn0 is None:

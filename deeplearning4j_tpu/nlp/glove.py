@@ -29,6 +29,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ..common import xprof
 from .lookup_table import InMemoryLookupTable
 from .text import (CollectionSentenceIterator, DefaultTokenizerFactory,
                    SentenceIterator, TokenizerFactory)
@@ -199,7 +200,8 @@ class Glove(WordVectors):
                 body, (w, wc, b, bc, gw, gwc, gb, gbc), cols)
             return carry + (losses.mean(),)
 
-        return block
+        return xprof.register_jit("nlp/glove_block", block,
+                                  donate=tuple(range(8)))
 
     def fit(self) -> None:
         import jax

@@ -26,6 +26,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from ..common import xprof
 from .text import DefaultTokenizerFactory, LabelAwareIterator, TokenizerFactory
 from .word2vec import SequenceVectors, _derive_windows, _pool_negs
 from .vocab import subsample_keep_probs
@@ -244,7 +245,8 @@ class ParagraphVectors(SequenceVectors):
                     (losses * ns).sum() / jnp.maximum(ns.sum(), 1.0),
                     ns.sum())
 
-        return block
+        return xprof.register_jit("nlp/pv_dbow_block", block,
+                                  donate=(0, 1))
 
     def _make_dm_window_block(self, hs_dev=None, ntable_dev=None):
         """Device PV-DM block: the CBOW windowed block with the doc-label
@@ -314,7 +316,7 @@ class ParagraphVectors(SequenceVectors):
                     (losses * ns).sum() / jnp.maximum(ns.sum(), 1.0),
                     ns.sum())
 
-        return block
+        return xprof.register_jit("nlp/pv_dm_block", block, donate=(0, 1))
 
     def _pos_map_fn(self, pos_len: int):
         """Per-epoch jitted builder of the DBOW pair-order shuffle: a
@@ -336,7 +338,7 @@ class ParagraphVectors(SequenceVectors):
                                  2.0 + iota.astype(jnp.float32))
                 return jnp.argsort(rank).astype(jnp.int32)
 
-            cache[pos_len] = fn
+            cache[pos_len] = xprof.register_jit("nlp/pv_pos_map", fn)
         return cache[pos_len]
 
     def _subsample3_fn(self):
@@ -372,6 +374,7 @@ class ParagraphVectors(SequenceVectors):
                 labs, mode="drop")
             return ids_sub, sent_sub, labs_sub, dest[-1] + 1
 
+        fn = xprof.register_jit("nlp/pv_subsample", fn)
         self._subsample3_jit = (W, fn)
         return fn
 
@@ -592,6 +595,9 @@ class ParagraphVectors(SequenceVectors):
                        + (1 - labels) * jnp.log(1 - sig + eps)) * m
                 return xe.sum() / jnp.maximum(m.sum(), 1.0)
 
+            # graftlint: disable=executable-census -- fresh jit per
+            # infer_vector call over a per-call closure; the census
+            # tracks long-lived executables, not per-call wrappers
             grad = jax.jit(jax.grad(loss_fn))
             v = jnp.asarray(vec)
             for step in range(steps):
@@ -609,6 +615,8 @@ class ParagraphVectors(SequenceVectors):
                    + (1 - lab) * jnp.log(1 - sig + eps))
             return xe.mean()
 
+        # graftlint: disable=executable-census -- fresh jit per
+        # infer_vector call over a per-call closure (see above)
         grad = jax.jit(jax.grad(loss_fn))
         v = jnp.asarray(vec)
         ctxmean = jnp.mean(syn0[ids], axis=0)

@@ -35,6 +35,7 @@ from typing import Callable, Iterable, List, Optional, Sequence
 
 import numpy as np
 
+from ..common import xprof
 from .lookup_table import InMemoryLookupTable
 from .text import (CollectionSentenceIterator, DefaultTokenizerFactory,
                    SentenceIterator, TokenizerFactory)
@@ -345,6 +346,7 @@ class SequenceVectors(WordVectors):
                     sent.dtype).at[slot].set(sent, mode="drop")
                 return ids_sub, sent_sub, dest[-1] + 1
 
+            fn = xprof.register_jit("nlp/w2v_subsample", fn)
             self._subsample_jit = (self.window, fn)
         return fn
 
@@ -454,7 +456,7 @@ class SequenceVectors(WordVectors):
             return (syn0, syn1,
                     (losses * ns).sum() / jnp.maximum(ns.sum(), 1.0))
 
-        return block
+        return xprof.register_jit("nlp/w2v_sg_block", block, donate=(0, 1))
 
     def _make_window_block(self, hs_dev=None, ntable_dev=None):
         """Packed device-windowed skip-gram block: the corpus lives ON
@@ -567,7 +569,9 @@ class SequenceVectors(WordVectors):
             return (syn0, syn1, lsum / jnp.maximum(wsum, 1.0), wsum)
 
         if shard_axis is None:
-            return jax.jit(block_fn, donate_argnums=(0, 1))
+            return xprof.register_jit(
+                "nlp/w2v_table_block",
+                jax.jit(block_fn, donate_argnums=(0, 1)), donate=(0, 1))
         # sharded tables: the pack + negatives run REPLICATED (all inputs
         # replicated, deterministic ops), only table rows live split
         from jax.experimental.shard_map import shard_map
@@ -579,7 +583,9 @@ class SequenceVectors(WordVectors):
             in_specs=(tspec, tspec, P(), P(), P(), P(), P(), P(), P(), P()),
             out_specs=(tspec, tspec, P(), P()),
             check_rep=False)
-        return jax.jit(sharded, donate_argnums=(0, 1))
+        return xprof.register_jit(
+            "nlp/w2v_table_block",
+            jax.jit(sharded, donate_argnums=(0, 1)), donate=(0, 1))
 
     @property
     def _cbow_centers(self) -> int:
@@ -677,7 +683,8 @@ class SequenceVectors(WordVectors):
                     (losses * ns).sum() / jnp.maximum(ns.sum(), 1.0),
                     ns.sum())
 
-        return block
+        return xprof.register_jit("nlp/w2v_cbow_block", block,
+                                  donate=(0, 1))
 
     def _block_for(self, tag: str, make: Callable, *extra):
         """Shared block-function cache: rebuild (re-trace) only when the

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..common import xprof
 from ..common.profiler import OpProfiler
 from ..data import pipeline as _pipe
 from ..data.dataset import DataSet, MultiDataSet
@@ -613,7 +614,9 @@ class ComputationGraph:
                 acts, _ = self._forward(params, states, ins, train, key)
                 return tuple(acts[o] for o in self.conf.network_outputs)
 
-            self._infer_fn = jax.jit(infer, static_argnames=("train",))
+            self._infer_fn = xprof.register_jit(
+                "graph/infer", jax.jit(infer, static_argnames=("train",)),
+                static_argnames=("train",))
         outs = self._infer_fn(self._params, self._states, feed,
                               get_random().next_key(), train=training)
         return [NDArray(o) for o in outs]
@@ -779,7 +782,9 @@ class ComputationGraph:
             return core(params, states, upd_state, inputs, labels, masks,
                         key, iteration, w)
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return xprof.register_jit(
+            "graph/fit_step", jax.jit(step, donate_argnums=(0, 1, 2)),
+            donate=(0, 1, 2))
 
     def _build_chunk_step(self):
         """steps_per_dispatch=K device loop (see multilayer)."""
@@ -808,7 +813,9 @@ class ComputationGraph:
             losses, auxes = ys_out
             return params, states, upd_state, losses, auxes
 
-        return jax.jit(chunk, donate_argnums=(0, 1, 2))
+        return xprof.register_jit(
+            "graph/fit_chunk", jax.jit(chunk, donate_argnums=(0, 1, 2)),
+            donate=(0, 1, 2))
 
     def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None,
             *, pad_partial: Optional[bool] = None,

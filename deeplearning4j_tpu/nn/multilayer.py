@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..common import xprof
 from ..common.profiler import OpProfiler
 from ..data import pipeline as _pipe
 from ..data.dataset import DataSet
@@ -230,7 +231,8 @@ class MultiLayerNetwork:
                 out, _ = self._forward(params, states, xin, False, key, fm)
                 return out
 
-            self._infer_fn = jax.jit(infer)
+            self._infer_fn = xprof.register_jit("mln/infer",
+                                                jax.jit(infer))
         out = self._infer_fn(self._params, self._states, xv,
                              get_random().next_key(), fmask)
         return NDArray(out)
@@ -447,7 +449,9 @@ class MultiLayerNetwork:
             return core(params, states, upd_state, x, y, mask, key,
                         iteration, fmask, w)
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return xprof.register_jit(
+            "mln/fit_step", jax.jit(step, donate_argnums=(0, 1, 2)),
+            donate=(0, 1, 2))
 
     def _build_chunk_step(self):
         """Multi-step dispatch (``steps_per_dispatch=K``): one jitted
@@ -480,7 +484,9 @@ class MultiLayerNetwork:
             losses, auxes = ys_out
             return params, states, upd_state, losses, auxes
 
-        return jax.jit(chunk, donate_argnums=(0, 1, 2))
+        return xprof.register_jit(
+            "mln/fit_chunk", jax.jit(chunk, donate_argnums=(0, 1, 2)),
+            donate=(0, 1, 2))
 
     def _apply_constraints(self, params):
         """Project weights after each update (reference BaseConstraint —
@@ -543,7 +549,9 @@ class MultiLayerNetwork:
                                        new_rnn, rnn_states)
             return new_params, new_states, new_upd, new_rnn, loss, aux
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return xprof.register_jit(
+            "mln/tbptt_step", jax.jit(step, donate_argnums=(0, 1, 2)),
+            donate=(0, 1, 2))
 
     def fit(self, data, epochs: int = 1, batch_size: Optional[int] = None,
             *, pad_partial: Optional[bool] = None,
@@ -752,7 +760,9 @@ class MultiLayerNetwork:
                                                 lp, it, key)
                 return new_lp, new_upd, loss
 
-            step = jax.jit(step, donate_argnums=(0, 1))
+            step = xprof.register_jit(
+                "mln/pretrain_step",
+                jax.jit(step, donate_argnums=(0, 1)), donate=(0, 1))
             lp = self._params[idx]
             upd_state = updater.init(lp)
             it = 0

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..common import xprof
 from ..data.dataset import DataSet
 from .conf import layers as L
 from .conf.builder import (GlobalConf, MultiLayerConfiguration,
@@ -224,7 +225,8 @@ class TransferLearningHelper:
                     x, _ = layer.apply(params[i], x, states[i], False, sub)
                 return x
 
-            self._featurize_fn = jax.jit(bottom)
+            self._featurize_fn = xprof.register_jit("transfer/featurize",
+                                                    jax.jit(bottom))
         feats = self._featurize_fn(model._params, model._states,
                                    jnp.asarray(ds.features.value),
                                    jax.random.PRNGKey(0))

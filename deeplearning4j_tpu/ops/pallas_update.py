@@ -64,6 +64,10 @@ LANES = 128               # per buffer in VMEM; 8 buffers stay well inside)
 _KINDS = {Sgd: "sgd", Nesterovs: "nesterovs", Adam: "adam", AdamW: "adamw"}
 _SLOTS = {"sgd": (), "nesterovs": ("v",), "adam": ("m", "v"),
           "adamw": ("m", "v")}
+# analytic flops per element for the census's counted sub-executable
+# entry (rough op counts of _update_math, SR excluded — order-of-
+# magnitude roofline inputs, not a cycle model)
+_FLOPS_PER_ELEM = {"sgd": 2, "nesterovs": 5, "adam": 12, "adamw": 14}
 
 
 def supports_fused(updater) -> bool:
@@ -282,6 +286,23 @@ def fused_apply(updater, flat_params: Dict[str, Any],
         new_flat[bkey] = np_
         for n in slot_names:
             new_state[n][bkey] = ns[n]
+    # executable census, counted sub-executable: the fused kernels
+    # dispatch INSIDE the parent step, so their cost rides the parent's
+    # measured time — record analytic flops/bytes here at trace time
+    # (once per parent compile, like the precision/* counters above)
+    elems = sum(p.size for p in flat_params.values())
+    nbytes = sum(3 * p.size * p.dtype.itemsize      # read p,g + write p
+                 for p in flat_params.values())
+    for n in slot_names:
+        nbytes += sum(2 * v.size * v.dtype.itemsize  # read + write slots
+                      for v in state[n].values())
+    from ..common import xprof
+
+    xprof.note_subexec("pallas/update_bucket",
+                       flops=float(_FLOPS_PER_ELEM.get(kind, 4) * elems),
+                       bytes_accessed=float(nbytes),
+                       kind=kind, mode=mode,
+                       buckets=len(flat_params))
     return new_flat, ({} if not slot_names else new_state)
 
 

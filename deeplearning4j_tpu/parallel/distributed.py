@@ -62,6 +62,7 @@ logger = logging.getLogger("deeplearning4j_tpu")
 #: failure classification and on the preemption path — the last-N flight
 #: recorder events as JSONL, readable with no live process
 BLACKBOX_NAME = "blackbox.jsonl"
+MEMCENSUS_NAME = "memcensus.json"
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -681,18 +682,32 @@ class TrainingSupervisor:
     def blackbox_path(self) -> str:
         return os.path.join(self.dir, BLACKBOX_NAME)
 
+    def memcensus_path(self) -> str:
+        return os.path.join(self.dir, MEMCENSUS_NAME)
+
     def _dump_blackbox(self) -> Optional[str]:
         """Dump the flight recorder's tail beside the checkpoints —
         called on every failure classification, restart, preemption and
         give-up, so the newest dump always tells the latest story (and a
-        process killed right after still leaves the previous one)."""
+        process killed right after still leaves the previous one). The
+        memory census (per-phase HBM watermarks + a fresh live-buffer
+        walk) rides along as ``memcensus.json``, so OOM-class failures
+        carry the memory picture beside the event tail."""
         try:
             os.makedirs(self.dir, exist_ok=True)
-            return flightrec.dump_blackbox(self.blackbox_path())
+            path = flightrec.dump_blackbox(self.blackbox_path())
         except OSError:
             logger.warning("supervisor: black-box dump to %s failed",
                            self.blackbox_path(), exc_info=True)
             return None
+        try:
+            from ..common import xprof
+
+            xprof.dump_memory_census(self.memcensus_path())
+        except Exception:   # census failure must not mask the blackbox
+            logger.warning("supervisor: memory-census dump to %s failed",
+                           self.memcensus_path(), exc_info=True)
+        return path
 
     def _attach_blackbox(self, exc: "RestartBudgetExceeded",
                          reason: str) -> None:

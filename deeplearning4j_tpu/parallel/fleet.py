@@ -67,7 +67,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..common import flightrec
+from ..common import flightrec, xprof
 from ..common.profiler import OpProfiler
 from ..data import pipeline as _pipe
 from ..optimize.telemetry import config_for
@@ -443,7 +443,7 @@ class FleetTrainer:
         # reports them unusable anyway), and the SMALL carried buffers
         # (keys, alive) WOULD donate — deleting arrays a concurrent
         # cull()/alive_mask()/_member_rng_state() may still be reading.
-        return jax.jit(fleet_step)
+        return xprof.register_jit("fleet/step", jax.jit(fleet_step))
 
     # -- training ----------------------------------------------------------
     def step(self, x, y, per_member: bool = False):
@@ -812,8 +812,9 @@ class FleetTrainer:
                     out, _ = self.model._forward(p, s, xin, False, key)
                     return out
 
-                self._infer_fn = jax.jit(jax.vmap(infer,
-                                                  in_axes=(0, 0, 0, None)))
+                self._infer_fn = xprof.register_jit(
+                    "fleet/infer",
+                    jax.jit(jax.vmap(infer, in_axes=(0, 0, 0, None))))
             fn = self._infer_fn
             p, s = ((self._params, self._states) if params is None
                     else params)

@@ -47,7 +47,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..common import faultinject, flightrec
+from ..common import faultinject, flightrec, xprof
 from ..common.profiler import OpProfiler
 
 logger = logging.getLogger("deeplearning4j_tpu")
@@ -303,7 +303,7 @@ class HeterogeneousPipeline:
                 "batch must divide into microbatches"
             mb = B // self.n_micro
             pipe = self._build(mb)
-            fwd = jax.jit(pipe)
+            fwd = xprof.register_jit("pipeline/hetero_fwd", jax.jit(pipe))
             loss_fn = self._loss_fn
 
             @jax.jit
@@ -315,6 +315,7 @@ class HeterogeneousPipeline:
                 return jax.tree.map(lambda p, g: p - lr * g, params,
                                     grads), loss
 
+            step = xprof.register_jit("pipeline/hetero_step", step)
             cache[B] = (fwd, step)
         return cache[B]
 
@@ -481,7 +482,7 @@ class PipelineParallel:
             return pipeline_apply(self.stage_fn, params, x, self.mesh,
                                   self.n_micro, self.axis)
 
-        self._fwd = fwd
+        self._fwd = xprof.register_jit("pipeline/legacy_fwd", fwd)
 
         @jax.jit
         def step(params, x, y, lr):
@@ -494,7 +495,7 @@ class PipelineParallel:
             new = jax.tree.map(lambda p, g: p - lr * g, params, grads)
             return new, loss
 
-        self._step = step
+        self._step = xprof.register_jit("pipeline/legacy_step", step)
 
     def forward(self, x) -> jnp.ndarray:
         return self._fwd(self.params, jnp.asarray(x))
@@ -1072,7 +1073,7 @@ class PipelineTrainer:
             OpProfiler.get().count("trace/pipeline_fit_step")
             return sharded(*args)
 
-        return jax.jit(step)
+        return xprof.register_jit("pipeline/fit_step", jax.jit(step))
 
     # --- fit surface -----------------------------------------------------
     def set_listeners(self, *ls) -> None:

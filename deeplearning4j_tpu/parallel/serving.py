@@ -111,7 +111,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..common import faultinject, flightrec
+from ..common import faultinject, flightrec, xprof
 from ..common.profiler import OpProfiler
 from ..data.pipeline import pad_rows
 from ..ndarray.ndarray import NDArray
@@ -869,8 +869,16 @@ class ServingEngine(ParallelInference):
                     self._infer_jit = jax.jit(self._make_infer())
                 params, states = self._dev_params[dev_idx]
                 aval = jax.ShapeDtypeStruct(shape, self._in_dtype)
+                t0 = time.monotonic()
                 exe = self._infer_jit.lower(
                     params, states, aval, self._key).compile()
+                # executable census: the bucket ladder's AOT executables
+                # feed the xla roofline ledger (cost/memory analysis is
+                # extracted from the ALREADY-compiled object — nothing
+                # retraces here)
+                xprof.register_aot("serving/bucket", exe,
+                                   variant=f"{shape}/dev{dev_idx}",
+                                   compile_s=time.monotonic() - t0)
             else:
                 # generic model (no jittable forward exposed): no AOT
                 # executable — the model.output call right after this in
@@ -921,6 +929,10 @@ class ServingEngine(ParallelInference):
         self._traces_seen = self._trace_cell[0]
         # graftlint: disable=lock-discipline -- same startup publication
         self._warm = True
+        # HBM watermark: the warmup just materialized every bucket
+        # executable + per-device param copies — the serving tier's
+        # steady-state memory footprint starts here
+        xprof.memory_watermark("serving_warmup")
         return timings
 
     def _run_bucket(self, padded: np.ndarray, dev_idx: int = 0,

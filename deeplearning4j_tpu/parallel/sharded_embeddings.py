@@ -71,13 +71,20 @@ class ShardedEmbedding:
             return sharded_rows_add(table_l, aux, grads)
 
         repl = P()
-        self._lookup = jax.jit(shard_map(
-            local_lookup, mesh=self.mesh,
-            in_specs=(P(axis, None), repl), out_specs=repl))
-        self._update = jax.jit(shard_map(
-            local_update, mesh=self.mesh,
-            in_specs=(P(axis, None), repl, repl),
-            out_specs=P(axis, None)), donate_argnums=(0,))
+        from ..common import xprof
+
+        self._lookup = xprof.register_jit(
+            "embeddings/lookup",
+            jax.jit(shard_map(
+                local_lookup, mesh=self.mesh,
+                in_specs=(P(axis, None), repl), out_specs=repl)))
+        self._update = xprof.register_jit(
+            "embeddings/update",
+            jax.jit(shard_map(
+                local_update, mesh=self.mesh,
+                in_specs=(P(axis, None), repl, repl),
+                out_specs=P(axis, None)), donate_argnums=(0,)),
+            donate=(0,))
 
     # -- API ---------------------------------------------------------------
     def lookup(self, ids) -> jnp.ndarray:

@@ -30,7 +30,7 @@ from jax.experimental.shard_map import shard_map
 
 import logging
 
-from ..common import flightrec
+from ..common import flightrec, xprof
 from ..common.profiler import OpProfiler
 from ..data import pipeline as _pipe
 from ..data.dataset import DataSet
@@ -293,7 +293,9 @@ class ParallelWrapper:
             OpProfiler.get().count("trace/pw_fit_step")
             return sharded(*args)
 
-        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+        return xprof.register_jit(
+            "pw/fit_step", jax.jit(step, donate_argnums=(0, 1, 2, 3)),
+            donate=(0, 1, 2, 3))
 
     def _build_chunk_step(self):
         """steps_per_dispatch=K: each shard scans its K local slices of the
@@ -347,7 +349,9 @@ class ParallelWrapper:
             OpProfiler.get().count("trace/pw_fit_chunk")
             return sharded(*args)
 
-        return jax.jit(chunk, donate_argnums=(0, 1, 2, 3))
+        return xprof.register_jit(
+            "pw/fit_chunk", jax.jit(chunk, donate_argnums=(0, 1, 2, 3)),
+            donate=(0, 1, 2, 3))
 
     def _param_specs(self):
         """Per-layer partition specs: replicated except row-sharded

@@ -434,22 +434,24 @@ class UIServer:
         from ..common import flightrec
 
         prof = OpProfiler.get()
+        # every derived profiler ledger rides OpProfiler.LEDGERS — the
+        # same list /api/metrics and print_statistics iterate, so a new
+        # ledger (e.g. the xprof "xla" roofline) can never be
+        # metrics-only by accident. The serving section stays the MERGED
+        # view (counters + per-engine latency quantiles).
+        ledgers = {label: getattr(prof, attr)()
+                   for label, attr in OpProfiler.LEDGERS
+                   if label != "serving"}
+        ledgers["serving"] = serving_health()
         return {"status": "ok",
                 "uptime_s": round(time.time() - self._t0, 1),
                 "stores": len(self._stores),
                 "paths": len(self._paths),
                 "records": n,
                 "jsonl_cache": self._jsonl.stats(),
-                "supervisor": prof.supervisor_stats(),
-                "faults": prof.fault_stats(),
-                "collectives": prof.collective_stats(),
-                "precision": prof.precision_stats(),
-                "elastic": prof.elastic_stats(),
-                "pipeline": prof.pipeline_stats(),
-                "tracecheck": prof.tracecheck_stats(),
+                **ledgers,
                 "flightrec": flightrec.stats(),
                 "inference": pool_health(),
-                "serving": serving_health(),
                 **memory_summary()}
 
     def sessions(self) -> List[str]:

@@ -1,0 +1,27 @@
+import jax
+
+
+def unregistered(f):
+    # sin 1: a jit the census never sees
+    return jax.jit(f)
+
+
+def unknown_name(f, xprof):
+    # sin 2: registers under a name EXEC_SITES does not carry
+    return xprof.register_jit("demo/unknown", jax.jit(f))
+
+
+def non_literal(f, name, xprof):
+    # sin 3: computed site name — the registry cannot audit it
+    return xprof.register_jit(name, jax.jit(f))
+
+
+def unregistered_aot(jj, x):
+    # sin 4: an AOT executable outside the census
+    return jj.lower(x).compile()
+
+
+def registered(f, xprof):
+    return xprof.register_jit("demo/step", jax.jit(f))
+# sin 5: "demo/aot" is registered, documented and drilled but nothing
+# ever registers an executable under it — a dead roofline row

@@ -1,0 +1,25 @@
+import functools
+
+import jax
+
+
+def build_step(f, xprof):
+    # direct wrap: the jit is an argument of the register call
+    return xprof.register_jit("demo/step", jax.jit(f, donate_argnums=(0,)),
+                              donate=(0,))
+
+
+def build_decorated(core, xprof):
+    # near-site registration: the decorated jit and its register call
+    # share the builder's scope
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(params, x):
+        return core(params, x)
+
+    return xprof.register_jit("demo/step", step, donate=(0,))
+
+
+def compile_bucket(jj, aval, xprof):
+    exe = jj.lower(aval).compile()
+    xprof.register_aot("demo/aot", exe, variant=str(aval.shape))
+    return exe

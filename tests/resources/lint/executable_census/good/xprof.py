@@ -1,0 +1,12 @@
+"""Mini census registry fixture.
+
+==========  ======================
+demo/step   the registered jit
+demo/aot    AOT bucket executable
+==========  ======================
+"""
+
+EXEC_SITES = {
+    "demo/step": {"desc": "the registered jit", "drill": "test_drills"},
+    "demo/aot": {"desc": "AOT bucket executable", "drill": "test_drills"},
+}

@@ -1,0 +1,11 @@
+import jax
+
+
+def per_call_helper(fn, x):
+    # graftlint: disable=executable-census -- fresh jit per call on a
+    # functional helper; the census tracks long-lived executables
+    return jax.jit(fn)(x)
+
+
+def registered(f, xprof):
+    return xprof.register_jit("demo/step", jax.jit(f))

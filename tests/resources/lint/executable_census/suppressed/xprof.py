@@ -1,0 +1,10 @@
+"""Mini census registry fixture.
+
+==========  ==================
+demo/step   the registered jit
+==========  ==================
+"""
+
+EXEC_SITES = {
+    "demo/step": {"desc": "the registered jit", "drill": "test_drills"},
+}

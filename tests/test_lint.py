@@ -38,6 +38,7 @@ RULES = {
     "lock_discipline": "lock-discipline",
     "fault_site_registry": "fault-site-registry",
     "event_name_registry": "event-name-registry",
+    "executable_census": "executable-census",
 }
 
 
@@ -58,8 +59,8 @@ class TestPackageClean:
         for f in result.suppressed:
             assert f.reason.strip(), f.render()
 
-    def test_seven_rules_active(self):
-        assert len(graftlint.RULE_NAMES) >= 7
+    def test_eight_rules_active(self):
+        assert len(graftlint.RULE_NAMES) >= 8
         assert set(RULES.values()) <= set(graftlint.RULE_NAMES)
 
     # the PR-8 entry points, now shim-backed
@@ -105,7 +106,7 @@ class TestRuleFixtures:
         expect = {"donation_alias": 4, "pallas_guard": 5,
                   "host_sync_in_step": 5, "retrace_hazard": 8,
                   "lock_discipline": 3, "fault_site_registry": 5,
-                  "event_name_registry": 5}
+                  "event_name_registry": 5, "executable_census": 5}
         for fixture, rule in RULES.items():
             res = graftlint.lint(os.path.join(FIXTURES, fixture, "bad"),
                                  [rule])

@@ -2,6 +2,7 @@
 
 from .donation_alias import DonationAliasRule
 from .event_registry import EventNameRegistryRule
+from .exec_census import ExecutableCensusRule
 from .fault_registry import FaultSiteRegistryRule
 from .host_sync import HostSyncRule
 from .lock_discipline import LockDisciplineRule
@@ -13,7 +14,8 @@ def all_rules():
     """Fresh instances — rules may keep per-run state in finalize()."""
     return [DonationAliasRule(), PallasGuardRule(), HostSyncRule(),
             RetraceHazardRule(), LockDisciplineRule(),
-            FaultSiteRegistryRule(), EventNameRegistryRule()]
+            FaultSiteRegistryRule(), EventNameRegistryRule(),
+            ExecutableCensusRule()]
 
 
 RULE_NAMES = [r.name for r in all_rules()]

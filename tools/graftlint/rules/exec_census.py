@@ -1,0 +1,213 @@
+"""executable-census: every compiled executable is on the observatory.
+
+``common/xprof.py`` owns the central ``EXEC_SITES`` registry (census
+name -> what registers it + the drill that proves it). The performance
+observatory (ISSUE 15) is only trustworthy if every ``jax.jit`` /
+``.lower(...).compile()`` call site actually registers — an executable
+the census cannot see is a roofline row that silently never exists.
+This rule closes the loop project-wide, mirroring fault-site-registry's
+4-way pattern:
+
+- every ``jax.jit(...)`` call (plain, ``@jax.jit`` decorator, or
+  ``functools.partial(jax.jit, ...)`` decorator) and every
+  ``.lower(...).compile(...)`` AOT chain must sit inside a
+  ``register_jit``/``register_aot`` call or share a function scope with
+  one (near-site registration); deliberately uncensused executables
+  (a fresh per-call jit) carry a justified suppression;
+- every ``register_jit``/``register_aot``/``note_subexec`` call must
+  name a REGISTERED site with a LITERAL string;
+- every registered site must have at least one register call site in the
+  scanned tree, appear in the xprof module docstring table, and be
+  referenced by at least one test or bench file.
+
+When the scanned tree has no ``EXEC_SITES`` registry at all (no
+xprof.py in scope) the rule stays quiet — linting an unrelated subtree
+or another rule's fixtures must not mass-fire.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..engine import Finding, ModuleContext, Project, Rule, call_name
+
+_REG_FNS = {"register_jit", "register_aot", "note_subexec"}
+
+
+def _parse_registry(mod: ModuleContext) -> Optional[Dict[str, ast.AST]]:
+    """EXEC_SITES = {"name": {...}} at module level (annotated or plain
+    assignment) -> {name: key node}."""
+    for node in mod.tree.body:
+        targets = ()
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = (node.target,)
+        if targets and \
+                any(isinstance(t, ast.Name) and t.id == "EXEC_SITES"
+                    for t in targets) and \
+                isinstance(node.value, ast.Dict):
+            out: Dict[str, ast.AST] = {}
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    out[k.value] = k
+            return out
+    return None
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    f = node.func
+    return isinstance(f, ast.Attribute) and f.attr == "jit"
+
+
+def _is_aot_compile(node: ast.Call) -> bool:
+    """``<expr>.lower(...).compile(...)`` in one chain."""
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "compile"
+            and isinstance(f.value, ast.Call)
+            and isinstance(f.value.func, ast.Attribute)
+            and f.value.func.attr == "lower")
+
+
+def _decorated_with_jit(fn: ast.AST) -> Optional[ast.AST]:
+    """The decorator node when ``fn`` is jit-decorated (bare
+    ``@jax.jit``, ``@jax.jit(...)``, or ``@functools.partial(jax.jit,
+    ...)``), else None."""
+    for dec in getattr(fn, "decorator_list", ()):
+        if isinstance(dec, ast.Attribute) and dec.attr == "jit":
+            return dec
+        if isinstance(dec, ast.Call):
+            if _is_jit_call(dec):
+                return dec
+            if call_name(dec).split(".")[-1] == "partial" and dec.args \
+                    and isinstance(dec.args[0], ast.Attribute) \
+                    and dec.args[0].attr == "jit":
+                return dec
+    return None
+
+
+class ExecutableCensusRule(Rule):
+    name = "executable-census"
+    description = ("every jax.jit / .lower().compile() call site "
+                   "registered with the common.xprof executable census "
+                   "(EXEC_SITES registry, docstring table and drill "
+                   "corpus in 4-way agreement)")
+    hint = ("wrap the jit in xprof.register_jit(\"<site>\", ...) (or "
+            "register_aot for AOT executables), add the site to "
+            "EXEC_SITES and the xprof docstring table, and reference it "
+            "from a test or bench drill")
+
+    def finalize(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        reg_mod = project.module_named("xprof.py")
+        registry: Optional[Dict[str, ast.AST]] = None
+        if reg_mod is not None and reg_mod.tree is not None:
+            registry = _parse_registry(reg_mod)
+        if registry is None:
+            # no census registry in scope: an unrelated subtree / another
+            # rule's fixture — nothing to hold executables against
+            return findings
+
+        seen: Dict[str, int] = {}
+        reg_calls: List[Tuple[ModuleContext, ast.Call, Optional[str]]] = []
+        for mod in project.modules:
+            if mod.tree is None or mod is reg_mod:
+                continue
+            # register calls first: names + the near-site scopes
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) and \
+                        call_name(node).split(".")[-1] in _REG_FNS:
+                    lit: Optional[str] = None
+                    if node.args and isinstance(node.args[0], ast.Constant) \
+                            and isinstance(node.args[0].value, str):
+                        lit = node.args[0].value
+                    reg_calls.append((mod, node, lit))
+            # unregistered compiled-executable call sites
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) and _is_jit_call(node):
+                    if not self._registered(mod, node, node):
+                        findings.append(self.finding(
+                            mod, node,
+                            "jax.jit call site is not registered with "
+                            "the executable census"))
+                elif isinstance(node, ast.Call) and _is_aot_compile(node):
+                    if not self._registered(mod, node, node):
+                        findings.append(self.finding(
+                            mod, node,
+                            ".lower().compile() AOT executable is not "
+                            "registered with the executable census"))
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    dec = _decorated_with_jit(node)
+                    # scope anchor is the DECORATED def itself: its
+                    # enclosing function is the builder that must also
+                    # hold the register call
+                    if dec is not None and \
+                            not self._registered(mod, dec, node):
+                        findings.append(self.finding(
+                            mod, dec,
+                            f"jit-decorated function '{node.name}' is "
+                            "not registered with the executable census"))
+
+        for mod, node, lit in reg_calls:
+            if lit is None:
+                findings.append(self.finding(
+                    mod, node,
+                    "census registration with a non-literal site name — "
+                    "the registry cannot audit it",
+                    hint="pass the census name as a string literal"))
+                continue
+            seen[lit] = seen.get(lit, 0) + 1
+            if lit not in registry:
+                findings.append(self.finding(
+                    mod, node,
+                    f"census site '{lit}' is not registered in "
+                    "common.xprof.EXEC_SITES"))
+
+        # registry COMPLETENESS is a whole-package property (same guard
+        # as fault-site-registry): only judged when register call sites
+        # are actually in scope
+        if not seen:
+            return findings
+
+        docstring = ast.get_docstring(reg_mod.tree) or ""
+        refs = project.reference_texts
+        for site, key_node in registry.items():
+            f_at = lambda msg: Finding(   # noqa: E731
+                rule=self.name, path=reg_mod.path,
+                line=getattr(key_node, "lineno", 1),
+                col=getattr(key_node, "col_offset", 0),
+                message=msg, hint=self.hint)
+            if site not in seen:
+                findings.append(f_at(
+                    f"registered census site '{site}' has no "
+                    "register_jit/register_aot/note_subexec call site in "
+                    "the scanned tree"))
+            if site not in docstring:
+                findings.append(f_at(
+                    f"registered census site '{site}' is missing from "
+                    "the xprof module docstring table"))
+            if refs and not any(site in text for text in refs.values()):
+                findings.append(f_at(
+                    f"registered census site '{site}' has no test or "
+                    "bench reference — no drill exercises it"))
+        return findings
+
+    @staticmethod
+    def _registered(mod: ModuleContext, node: ast.AST,
+                    scope_anchor: ast.AST) -> bool:
+        """True when the call site is inside a register call, or shares
+        its enclosing function scope with one (near-site registration —
+        builders register the jit they just constructed)."""
+        for p in mod.parents(node):
+            if isinstance(p, ast.Call) and \
+                    call_name(p).split(".")[-1] in _REG_FNS:
+                return True
+        fn = mod.enclosing_function(scope_anchor)
+        scope = fn if fn is not None else mod.tree
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Call) and \
+                    call_name(n).split(".")[-1] in _REG_FNS:
+                return True
+        return False

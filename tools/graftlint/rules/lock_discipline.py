@@ -34,6 +34,10 @@ SHARED_CLASSES: Dict[str, Dict[str, Set[str]]] = {
     # the ambient correlation slot is written by the supervisor while
     # the checkpoint writer reads it at event time
     "FlightRecorder": {"locks": {"_lock"}, "allow": set()},
+    # executable census: dispatches land from the training thread,
+    # serving workers and the checkpoint writer; analyze() runs on
+    # whichever thread collects
+    "ExecutableCensus": {"locks": {"_lock"}, "allow": set()},
     # inference/serving pools: worker threads + callers + health probes.
     # ServingEngine splits its locking: _exec_lock guards the AOT
     # executable cache, _lat_lock the latency ring — both are owning
