@@ -46,6 +46,27 @@ class GlobalConf:
     # absent from the reference, whose workspaces only recycle, not
     # recompute). Gradients are bit-identical either way.
     gradient_checkpointing: bool = False
+    # Named rematerialization policy (supersedes the blanket bool above
+    # when set). One of:
+    #   "none"  — store every residual (the jax default; bitwise-
+    #             identical to leaving both knobs off);
+    #   "full"  — recompute everything (what gradient_checkpointing=True
+    #             has always meant);
+    #   "dots_only" — save only matmul/conv outputs, recompute the cheap
+    #             elementwise tail (jax.checkpoint_policies.checkpoint_
+    #             dots): the classic FLOPs-for-HBM trade that keeps the
+    #             MXU-expensive results;
+    #   "checkpoint_dots_with_no_batch_dims" — save only contractions
+    #             with no batch dims (weight-gradient-shaped matmuls),
+    #             recompute activation-shaped ones: the most aggressive
+    #             named policy short of "full";
+    #   [block, ...] — selective: fully rematerialize ONLY the named
+    #             blocks (layer indices for MultiLayerNetwork, vertex
+    #             names for ComputationGraph); everything else stores.
+    # All policies change WHICH residuals are stored, never the math:
+    # loss sequences are bit-identical across policies on a fixed
+    # platform (pinned by tests/test_remat_policies.py).
+    remat_policy: Any = None
     # Fused weight update: flatten params/grads(/updater state) into
     # Zero1Plan per-dtype buckets INSIDE the compiled step and apply the
     # updater through ops/pallas_update — one fused kernel launch per
@@ -59,6 +80,18 @@ class GlobalConf:
     # ``updater.state_dtype`` (bf16 moments + stochastic rounding).
     # Requires an elementwise updater (falls back, warned, otherwise).
     fused_update: bool = False
+    # Backward-epilogue fusion (rides on fused_update): differentiate
+    # w.r.t. the plan's FLAT buckets so the cotangents accumulate
+    # directly into flat layout and the dense grad pytree never
+    # materializes between the backward and the updater — the
+    # 2-copy→1-copy grad-epilogue fix for the HBM roofline. Bitwise
+    # identical to the dense-then-flatten path (the unflatten in the
+    # forward is a pure permutation, so leaf cotangents are computed by
+    # the exact same ops). On by default; set False to force the legacy
+    # dense-grads-then-flatten step (the bench A/B axis). Auto-disabled
+    # when telemetry or a dense-tree grad-normalization mode needs the
+    # dense grads.
+    flat_backward: bool = True
     # Fused inference epilogue (ops/pallas_epilogue): inference-mode
     # BatchNormalization + relu/identity collapse into one kernel, and
     # ComputationGraph additionally fuses the resnet block tail
@@ -69,6 +102,51 @@ class GlobalConf:
     # fallback, ledgered under precision/epilogue_*. Training-mode BN
     # (batch statistics + hand VJP) is never touched.
     fused_epilogue: bool = False
+
+
+#: the named policies remat_wrap resolves (selective lists are the
+#: fourth, open-ended form)
+REMAT_POLICIES = ("none", "full", "dots_only",
+                  "checkpoint_dots_with_no_batch_dims")
+
+
+def effective_remat_policy(gc: GlobalConf):
+    """The policy in force: ``remat_policy`` when set, else the legacy
+    ``gradient_checkpointing`` bool mapped to "full"/"none"."""
+    pol = getattr(gc, "remat_policy", None)
+    if pol is not None:
+        return pol
+    return "full" if gc.gradient_checkpointing else "none"
+
+
+def remat_wrap(gc: GlobalConf, fn, block=None):
+    """Apply the configured rematerialization policy to one block's
+    apply function (the three wrap sites: MLN layer apply, MLN TBPTT
+    segment, graph vertex apply). ``block`` is the block's identity for
+    selective lists — the layer index (MLN) or vertex name (graph).
+    Returns ``fn`` untouched under "none" (zero-cost default) and the
+    ``jax.checkpoint``-wrapped fn otherwise; unknown policy names raise
+    at step-build time, never silently store-everything."""
+    pol = effective_remat_policy(gc)
+    if pol == "none":
+        return fn
+    import jax
+
+    if isinstance(pol, (list, tuple, set)):
+        return jax.checkpoint(fn) if block in pol else fn
+    if pol == "full":
+        return jax.checkpoint(fn)
+    if pol == "dots_only":
+        return jax.checkpoint(
+            fn, policy=jax.checkpoint_policies.checkpoint_dots)
+    if pol == "checkpoint_dots_with_no_batch_dims":
+        return jax.checkpoint(
+            fn,
+            policy=jax.checkpoint_policies
+            .checkpoint_dots_with_no_batch_dims)
+    raise ValueError(
+        f"unknown remat policy {pol!r}; expected one of "
+        f"{sorted(REMAT_POLICIES)} or a selective block list")
 
 
 class NeuralNetConfiguration:
@@ -128,6 +206,18 @@ class Builder:
         (jax.checkpoint): ~constant activation memory in depth for extra
         forward FLOPs; gradients unchanged."""
         self._conf.gradient_checkpointing = bool(v)
+        return self
+
+    def remat_policy(self, policy) -> "Builder":
+        """Named rematerialization policy ("none" | "full" | "dots_only"
+        | "checkpoint_dots_with_no_batch_dims") or a selective list of
+        block identifiers to fully rematerialize. Supersedes
+        gradient_checkpointing(); see GlobalConf.remat_policy."""
+        if isinstance(policy, str) and policy not in REMAT_POLICIES:
+            raise ValueError(
+                f"unknown remat policy {policy!r}; expected one of "
+                f"{sorted(REMAT_POLICIES)} or a selective block list")
+        self._conf.remat_policy = policy
         return self
 
     def fused_update(self, v: bool = True) -> "Builder":

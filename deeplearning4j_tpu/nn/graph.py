@@ -27,7 +27,8 @@ from ..data.dataset import DataSet, MultiDataSet
 from ..ndarray.ndarray import NDArray
 from ..ndarray.rng import get_random
 from .conf import layers as L
-from .conf.builder import GlobalConf, MultiLayerConfiguration, _deser_obj, _ser_obj
+from .conf.builder import (GlobalConf, MultiLayerConfiguration, _deser_obj,
+                           _ser_obj, remat_wrap)
 from .conf.inputs import CNNFlatInput, CNNInput, FFInput, InputType, RNNInput, cnn_to_ff, flat_to_cnn
 
 
@@ -444,6 +445,16 @@ class ComputationGraph:
             self._fit_step = None
             self._chunk_step = None
 
+    def set_remat_policy(self, policy) -> None:
+        """Switch the rematerialization policy in place — a build-time
+        property of the jitted step (see MultiLayerNetwork
+        .set_remat_policy): exactly one rebuild on the next fit."""
+        if policy == self.conf.global_conf.remat_policy:
+            return
+        self.conf.global_conf.remat_policy = policy
+        self._fit_step = None
+        self._chunk_step = None
+
     def num_params(self) -> int:
         return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(self._params))
 
@@ -595,10 +606,13 @@ class ComputationGraph:
                 def run(lp, xx, st, k, _l=node.layer):
                     return _l.apply(lp, xx, st, training, k)
 
-                if self.conf.global_conf.gradient_checkpointing and training:
+                if training:
                     # rematerialize this node's activations in backward
-                    # (see GlobalConf.gradient_checkpointing)
-                    run = jax.checkpoint(run)
+                    # per the configured policy (GlobalConf.remat_policy /
+                    # legacy gradient_checkpointing); selective lists
+                    # match on the vertex NAME here
+                    run = remat_wrap(self.conf.global_conf, run,
+                                     block=name)
                 y, st = run(params.get(name, {}), x,
                             states.get(name, {}), sub)
                 acts[name] = y
@@ -737,6 +751,10 @@ class ComputationGraph:
         updater = gc.updater
         tele = self._telemetry
         fused_plan = self._fused_flat_plan()
+        # backward-epilogue fusion gate — see multilayer._step_core
+        flat_bwd = (fused_plan is not None and tele is None
+                    and not gc.grad_normalization
+                    and getattr(gc, "flat_backward", True))
         from ..learning import precision as _prec
         from ..optimize import telemetry as _tel
         from .multilayer import _apply_fused_flat
@@ -748,22 +766,37 @@ class ComputationGraph:
                                               True, key, w=w)
                 return loss, new_states
 
-            (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-            if gc.grad_normalization:
-                from .multilayer import _normalize_gradients
-
-                grads = _normalize_gradients(grads, gc.grad_normalization,
-                                             gc.grad_norm_threshold)
-            if fused_plan is not None:
+            if flat_bwd:
+                flat_params = fused_plan.flatten(params)
+                (loss, new_states), flat_grads = jax.value_and_grad(
+                    lambda fp: loss_fn(fused_plan.unflatten_diff(fp)),
+                    has_aux=True)(flat_params)
                 new_params, new_upd = _apply_fused_flat(
-                    fused_plan, updater, grads, upd_state, params,
-                    iteration, key)
+                    fused_plan, updater, flat_grads, upd_state, params,
+                    iteration, key, flat_params=flat_params,
+                    grads_flat=True)
             else:
-                new_params, new_upd = _prec.apply_updater(
-                    updater, grads, upd_state, params, iteration, key)
+                (loss, new_states), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                if gc.grad_normalization:
+                    from .multilayer import _normalize_gradients
+
+                    grads = _normalize_gradients(
+                        grads, gc.grad_normalization,
+                        gc.grad_norm_threshold)
+                if fused_plan is not None:
+                    new_params, new_upd = _apply_fused_flat(
+                        fused_plan, updater, grads, upd_state, params,
+                        iteration, key)
+                else:
+                    new_params, new_upd = _prec.apply_updater(
+                        updater, grads, upd_state, params, iteration, key)
             if tele is None:
                 return new_params, new_states, new_upd, loss
             # per-node stats in sorted node-name order (telemetry.groups)
+            # graftlint: disable=donated-grad-escape -- in-graph read: the
+            # telemetry path runs with grads_flat=False, so _apply_fused_flat
+            # flattened a COPY and XLA keeps the traced dense tree alive
             aux = _tel.layer_stats(params, new_params, grads, loss)
             if tele.nan_guard:
                 aux, new_params, new_states, new_upd = _tel.apply_nan_guard(

@@ -25,7 +25,7 @@ from ..data import pipeline as _pipe
 from ..data.dataset import DataSet
 from ..ndarray.ndarray import NDArray
 from ..ndarray.rng import get_random
-from .conf.builder import MultiLayerConfiguration
+from .conf.builder import MultiLayerConfiguration, remat_wrap
 from .conf import layers as L
 
 
@@ -94,6 +94,19 @@ class MultiLayerNetwork:
 
     setListeners = set_listeners
 
+    def set_remat_policy(self, policy) -> None:
+        """Switch the rematerialization policy in place. Like telemetry,
+        the policy is a build-time property of the jitted step: flipping
+        it rebuilds the step exactly ONCE on the next fit (one trace/
+        compile), after which the loop is steady again — asserted by
+        tests/test_remat_policies.py under tracecheck."""
+        if policy == self.conf.global_conf.remat_policy:
+            return
+        self.conf.global_conf.remat_policy = policy
+        self._fit_step = None
+        self._chunk_step = None
+        self._tbptt_step = None
+
     # --- parameter access (flattened, reference params() contract) ------
     def params(self) -> NDArray:
         leaves = jax.tree.leaves(self._params)
@@ -132,12 +145,15 @@ class MultiLayerNetwork:
         return jax.tree.map(cast, params), cast(x)
 
     # --- forward ---------------------------------------------------------
-    def _apply_layer(self, layer, lp, x, st, training, rng, fmask):
+    def _apply_layer(self, layer, lp, x, st, training, rng, fmask,
+                     idx=None):
         """One layer forward, routing through apply_masked when a
-        per-timestep feature mask is present (SURVEY §5.7). With
-        ``gradient_checkpointing`` the whole layer apply is wrapped in
-        jax.checkpoint: backward rematerializes this layer's activations
-        instead of keeping them live across the step."""
+        per-timestep feature mask is present (SURVEY §5.7). Under the
+        configured remat policy (GlobalConf.remat_policy, or the legacy
+        gradient_checkpointing bool) the layer apply is wrapped in
+        jax.checkpoint: backward rematerializes (some of) this layer's
+        activations instead of keeping them live across the step. The
+        selective-list form matches on the layer INDEX here."""
 
         def run(lp, x, st, rng, fmask):
             if layer.weight_noise is not None:
@@ -147,8 +163,8 @@ class MultiLayerNetwork:
                 return layer.apply_masked(lp, x, st, training, rng, fmask)
             return layer.apply(lp, x, st, training, rng)
 
-        if self.conf.global_conf.gradient_checkpointing and training:
-            run = jax.checkpoint(run)
+        if training:
+            run = remat_wrap(self.conf.global_conf, run, block=idx)
         return run(lp, x, st, rng, fmask)
 
     def _forward(self, params, states, x, training: bool, rng, fmask=None):
@@ -165,7 +181,7 @@ class MultiLayerNetwork:
                 fmask = layer.derive_mask(x)
             rng, sub = jax.random.split(rng)
             x, st = self._apply_layer(layer, params[i], x, states[i],
-                                      training, sub, fmask)
+                                      training, sub, fmask, idx=i)
             new_states.append(st)
         return x, new_states
 
@@ -190,10 +206,11 @@ class MultiLayerNetwork:
                 def run_rnn(lp, xx, rs, st, k, _l=layer):
                     return _l.apply_rnn(lp, xx, rs, st, training, k)
 
-                if self.conf.global_conf.gradient_checkpointing and training:
+                if training:
                     # TBPTT recurrent segments are exactly where
-                    # activation memory bites — remat them too
-                    run_rnn = jax.checkpoint(run_rnn)
+                    # activation memory bites — same policy applies
+                    run_rnn = remat_wrap(self.conf.global_conf, run_rnn,
+                                         block=i)
                 x, r, st = run_rnn(params[i], x, rnn_states[i],
                                    states[i], sub)
                 if fmask is not None:
@@ -201,7 +218,7 @@ class MultiLayerNetwork:
                 new_rnn.append(r)
             else:
                 x, st = self._apply_layer(layer, params[i], x, states[i],
-                                          training, sub, fmask)
+                                          training, sub, fmask, idx=i)
                 if rnn_states is not None:
                     new_rnn.append(rnn_states[i])
             new_states.append(st)
@@ -389,6 +406,16 @@ class MultiLayerNetwork:
         frozen = self._frozen_indices()
         tele = self._telemetry
         fused_plan = self._fused_flat_plan()
+        # Backward-epilogue fusion: differentiate w.r.t. the plan's FLAT
+        # buckets (the forward unflattens them — a pure permutation, so
+        # the cotangents accumulate directly into flat layout and the
+        # dense grad pytree never materializes between the backward and
+        # the updater). Gated off when telemetry wants per-layer dense
+        # grads or a grad-normalization mode defined on the dense tree is
+        # configured — those keep the dense-then-flatten path.
+        flat_bwd = (fused_plan is not None and tele is None
+                    and not gc.grad_normalization
+                    and getattr(gc, "flat_backward", True))
         from ..learning import precision as _prec
         from ..optimize import telemetry as _tel
 
@@ -412,17 +439,29 @@ class MultiLayerNetwork:
                                             hp["l2"])
                 return loss, new_states
 
-            (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-            if gc.grad_normalization:
-                grads = _normalize_gradients(grads, gc.grad_normalization,
-                                             gc.grad_norm_threshold)
-            if fused_plan is not None:
+            if flat_bwd:
+                flat_params = fused_plan.flatten(params)
+                (loss, new_states), flat_grads = jax.value_and_grad(
+                    lambda fp: loss_fn(fused_plan.unflatten_diff(fp)),
+                    has_aux=True)(flat_params)
                 new_params, new_upd = _apply_fused_flat(
-                    fused_plan, up, grads, upd_state, params,
-                    iteration, key)
+                    fused_plan, up, flat_grads, upd_state, params,
+                    iteration, key, flat_params=flat_params,
+                    grads_flat=True)
             else:
-                new_params, new_upd = _prec.apply_updater(
-                    up, grads, upd_state, params, iteration, key)
+                (loss, new_states), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                if gc.grad_normalization:
+                    grads = _normalize_gradients(
+                        grads, gc.grad_normalization,
+                        gc.grad_norm_threshold)
+                if fused_plan is not None:
+                    new_params, new_upd = _apply_fused_flat(
+                        fused_plan, up, grads, upd_state, params,
+                        iteration, key)
+                else:
+                    new_params, new_upd = _prec.apply_updater(
+                        up, grads, upd_state, params, iteration, key)
             for i in frozen:
                 # stop_gradient already zeroes their grads; restoring the
                 # original tensors also shields them from stateful-updater
@@ -431,6 +470,10 @@ class MultiLayerNetwork:
             new_params = self._apply_constraints(new_params)
             if tele is None:
                 return new_params, new_states, new_upd, loss
+            # graftlint: disable=donated-grad-escape -- in-graph read: the
+            # telemetry path runs with grads_flat=False, so _apply_fused_flat
+            # flattened a COPY and XLA keeps the traced dense tree alive;
+            # donation frees only jit-boundary buffers, never mid-graph values
             aux = _tel.layer_stats(params, new_params, grads, loss)
             if tele.nan_guard:
                 aux, new_params, new_states, new_upd = _tel.apply_nan_guard(
@@ -956,16 +999,28 @@ def _fused_flat_plan(conf, params):
 
 
 def _apply_fused_flat(plan, updater, grads, upd_state, params, iteration,
-                      key):
+                      key, flat_params=None, grads_flat=False):
     """The single-device fused-update body (traced into the step):
     flatten params/grads/state through ``plan``'s pure-permutation bucket
     layout, run one fused kernel per bucket, unflatten back. The model
     keeps its DENSE layouts between steps — checkpointing, listeners and
-    the serializers see exactly what they always saw."""
+    the serializers see exactly what they always saw.
+
+    ``grads_flat=True`` (the backward-epilogue path): ``grads`` is
+    ALREADY the plan's flat-bucket dict — the backward differentiated
+    w.r.t. the flat params, so no dense grad tree ever existed and no
+    flatten copy is paid here. ``flat_params`` lets the caller reuse the
+    flat view it already built for that backward. The trace-time
+    ``precision/grads_flat_in_step`` gauge records which path the
+    compiled step took (1 = grads born flat, single fused grad+update
+    epilogue; 0 = legacy dense-grads-then-flatten) — the
+    2-dispatch→1-dispatch claim, observable on /api/metrics."""
     from ..ops.pallas_update import apply_flat_updater
 
-    flat_p = plan.flatten(params)
-    flat_g = plan.flatten(grads)
+    OpProfiler.get().gauge("precision/grads_flat_in_step",
+                           1 if grads_flat else 0)
+    flat_p = plan.flatten(params) if flat_params is None else flat_params
+    flat_g = grads if grads_flat else plan.flatten(grads)
     flat_s = (plan.flatten_state(upd_state, xp=jnp)
               if isinstance(upd_state, dict) else upd_state)
     new_flat, new_flat_s = apply_flat_updater(updater, flat_p, flat_g,

@@ -498,10 +498,12 @@ def multi_head_dot_product_attention(q, k, v, wq, wk, wv, wo, mask=None,
 
     qh, kh, vh = split_heads(q, wq), split_heads(k, wk), split_heads(v, wv)
     out = None
-    if mask is None and tq == tk:
-        # unmasked self-attention routes through the Pallas flash kernel
-        # on TPU (3-8x at long T, no T×T buffer — BASELINE.md); the dense
-        # path remains the reference semantics everywhere else
+    if tq == tk:
+        # self-attention routes through the Pallas flash kernel on TPU
+        # (3-8x at long T, no T×T buffer — BASELINE.md); a padding mask
+        # rides as an additive logits bias streamed block-by-block, so
+        # the masked path BERT runs is the SAME fused kernel. The dense
+        # path remains the reference semantics everywhere else.
         from ..common.environment import Environment
         from .pallas_attention import flash_attention, supports_flash
 
@@ -509,7 +511,11 @@ def multi_head_dot_product_attention(q, k, v, wq, wk, wv, wo, mask=None,
                 and jax.default_backend() == "tpu"
                 and supports_flash(tq, qh.shape[-1])):
             scale = (qh.shape[-1] ** -0.5) if scaled else 1.0
-            out = flash_attention(qh, kh, vh, sm_scale=scale,
+            bias = None
+            if mask is not None:
+                bias = jnp.where(mask.reshape(b, 1, 1, tk).astype(bool),
+                                 jnp.float32(0.0), jnp.float32(-1e9))
+            out = flash_attention(qh, kh, vh, sm_scale=scale, bias=bias,
                                   interpret=False)
     if out is None:
         m = None

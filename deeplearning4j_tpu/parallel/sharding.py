@@ -163,6 +163,29 @@ class Zero1Plan:
                 pos += sz
         return jax.tree.unflatten(self.treedef, leaves)
 
+    def unflatten_diff(self, flats: Dict[str, Any]):
+        """:meth:`unflatten` with its exact adjoint spelled out. The
+        autodiff transpose of slice-and-reshape lowers as one
+        full-bucket-size ``pad`` + ``add_any`` PER LEAF, so a flat-backward
+        step through plain :meth:`unflatten` materializes O(n_leaves)
+        bucket-sized temporaries. But unflatten is a pure permutation
+        whose adjoint IS :meth:`flatten` — one concatenate per bucket —
+        and the pad tail's cotangent is identically zero, which flatten's
+        zero tail reproduces bitwise. Use this form wherever a step
+        differentiates through the flat layout."""
+        @jax.custom_vjp
+        def _unflat(f):
+            return self.unflatten(f)
+
+        def _fwd(f):
+            return self.unflatten(f), None
+
+        def _bwd(_, ct):
+            return (self.flatten(ct),)
+
+        _unflat.defvjp(_fwd, _bwd)
+        return _unflat(flats)
+
     def shard_slice(self, flats: Dict[str, Any], idx) -> Dict[str, Any]:
         """Replica ``idx``'s even slice of every bucket (in-graph)."""
         return {b.key: jax.lax.dynamic_slice(flats[b.key],

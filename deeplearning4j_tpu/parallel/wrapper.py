@@ -35,7 +35,7 @@ from ..common.profiler import OpProfiler
 from ..data import pipeline as _pipe
 from ..data.dataset import DataSet
 from ..ndarray.rng import get_random
-from ..nn.multilayer import _same_shapes
+from ..nn.multilayer import _apply_fused_flat, _fused_flat_plan, _same_shapes
 from .accumulator import DenseAllReduceAccumulator, GradientsAccumulator
 from .mesh import elastic_pool, make_mesh, probe_device, shard_batch
 from .sharding import Zero1Plan, is_flat_state
@@ -177,6 +177,23 @@ class ParallelWrapper:
         from ..ops import pallas_update as _pupd
         from ..optimize import telemetry as _tel
 
+        # Backward-epilogue fusion (mirrors the solo _step_core): when the
+        # updater consumes FLAT buckets anyway (ZeRO-1 always; dense when
+        # `fused_update` is on), differentiate w.r.t. the flat params — the
+        # forward unflattens them (a pure permutation), so the cotangents
+        # accumulate directly into flat layout and the dense grad pytree
+        # never materializes between the backward and the exchange. Gated
+        # off when telemetry needs the raw dense per-shard grads
+        # (nonfinite_counts / layer_stats walk the layer tree) and for
+        # stateful accumulators (residual carry is a dense-tree pytree).
+        dense_fused_plan = (None if (zero1 or stateful or tele is not None)
+                            else _fused_flat_plan(model.conf, model._params))
+        flat_bwd = (tele is None and not stateful
+                    and getattr(model.conf.global_conf, "flat_backward",
+                                True)
+                    and (zero1 or dense_fused_plan is not None))
+        bwd_plan = plan if zero1 else dense_fused_plan
+
         def local_step(params, states, upd_state, acc_state, x, y, mask, w,
                        key, it):
             idx = jax.lax.axis_index(axis)
@@ -205,7 +222,16 @@ class ParallelWrapper:
                                                    w_denom=denom)
                 return loss, new_states
 
-            (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            if flat_bwd:
+                flat_params = bwd_plan.flatten(params)
+                (loss, new_states), flat_grads = jax.value_and_grad(
+                    lambda fp: loss_fn(bwd_plan.unflatten_diff(fp)),
+                    has_aux=True)(flat_params)
+                OpProfiler.get().gauge("precision/grads_flat_in_step", 1)
+                grads = None
+            else:
+                (loss, new_states), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
             if tele is not None:
                 # non-finite counts are taken on the RAW per-shard grads
                 # (reduction would smear one shard's NaN across all of
@@ -230,17 +256,28 @@ class ParallelWrapper:
                 # generic elementwise fallback for updaters it doesn't
                 # cover; `key` (already folded per-replica) drives the
                 # bf16-state stochastic rounding when state_dtype is set.
-                flat_g = plan.flatten(grads)
+                flat_g = flat_grads if flat_bwd else plan.flatten(grads)
                 g_sh = {k: jax.lax.psum_scatter(
                     v, axis, scatter_dimension=0, tiled=True)
                     / jnp.asarray(n_shards, v.dtype)
                     for k, v in flat_g.items()}
-                p_sh = plan.shard_slice(plan.flatten(params), idx)
+                p_sh = plan.shard_slice(
+                    flat_params if flat_bwd else plan.flatten(params), idx)
                 new_p_sh, new_upd = _pupd.apply_flat_updater(
                     updater, p_sh, g_sh, upd_state, it, key)
                 new_params = plan.unflatten(
                     {k: jax.lax.all_gather(v, axis, tiled=True)
                      for k, v in new_p_sh.items()})
+            elif flat_bwd:
+                # dense data-parallel fused epilogue: pmean the FLAT buckets
+                # (elementwise — bitwise-equal to flattening the pmean'd
+                # dense tree) and run the fused grad+update in the same
+                # compiled step, full-width on every replica
+                flat_grads = acc.reduce_gradients(flat_grads)
+                new_params, new_upd = _apply_fused_flat(
+                    dense_fused_plan, updater, flat_grads, upd_state,
+                    params, it, key, flat_params=flat_params,
+                    grads_flat=True)
             else:
                 if not stateful:
                     grads = acc.reduce_gradients(grads)
@@ -253,7 +290,7 @@ class ParallelWrapper:
                 # locally, psum'd across the data axis (the full gradient/
                 # update tensors are never materialized for telemetry)
                 parts = [(plan.shard_segment_ids(b.key, idx, b.shard),
-                          g_sh[b.key], new_p_sh[b.key], p_sh[b.key])
+                          g_sh[b.key], new_p_sh[b.key], p_sh[b.key])  # graftlint: disable=donated-grad-escape -- in-graph read: XLA keeps the traced g_sh shards alive for the stats; donation frees only jit-boundary buffers
                          for b in plan.buckets]
                 aux = _tel.sharded_layer_stats(loss, parts, plan.n_layers,
                                                axis, nonfinite=raw_nf)

@@ -1,0 +1,28 @@
+"""Clean shapes the donated-grad-escape rule must NOT flag."""
+from somewhere import apply_flat_updater, fused_apply, log_norm
+
+
+def read_before_consume(up, flat_p, flat_g, st, it, key):
+    norm = log_norm(flat_g)                    # read BEFORE the consume
+    new_p, new_s = apply_flat_updater(up, flat_p, flat_g, st, it, key)
+    return new_p, new_s, norm
+
+
+def return_consume_cannot_leak(up, flat_p, flat_g, st, it, key):
+    # consuming in the return: nothing executes after it in this frame
+    return apply_flat_updater(up, flat_p, flat_g, st, it, key)
+
+
+def dispatch_with_fallback(up, flat_p, flat_g, st, it, key, fused):
+    # the early-return consume does not taint the fallback branch (the
+    # apply_flat_updater-internal shape: fused path returns, generic
+    # path still owns the grads)
+    if fused:
+        return fused_apply(up, flat_p, flat_g, st, it, key)
+    return log_norm(flat_g), st
+
+
+def rebind_clears_taint(up, flat_p, flat_g, st, it, key):
+    new_p, new_s = apply_flat_updater(up, flat_p, flat_g, st, it, key)
+    flat_g = log_norm(new_p)                   # rebound: new value
+    return new_p, new_s, flat_g

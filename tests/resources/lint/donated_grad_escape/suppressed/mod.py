@@ -1,0 +1,13 @@
+"""A justified post-consume read: in-graph telemetry over the reduced
+shards — XLA keeps the traced value alive; donation only frees buffers
+at the jit boundary (the parallel/wrapper.py ZeRO-1 stats shape)."""
+from somewhere import apply_flat_updater, sharded_layer_stats
+
+
+def zero1_stats_after_apply(up, p_sh, g_sh, st, it, key, buckets, loss):
+    new_p_sh, new_s = apply_flat_updater(up, p_sh, g_sh, st, it, key)
+    # graftlint: disable=donated-grad-escape -- in-graph read: the traced
+    # g_sh value is kept alive by XLA for the stats computation; donation
+    # frees only jit-boundary buffers, never mid-graph values
+    parts = [g_sh[b.key] for b in buckets]
+    return new_p_sh, new_s, sharded_layer_stats(loss, parts)

@@ -39,6 +39,7 @@ RULES = {
     "fault_site_registry": "fault-site-registry",
     "event_name_registry": "event-name-registry",
     "executable_census": "executable-census",
+    "donated_grad_escape": "donated-grad-escape",
 }
 
 
@@ -106,7 +107,8 @@ class TestRuleFixtures:
         expect = {"donation_alias": 4, "pallas_guard": 5,
                   "host_sync_in_step": 5, "retrace_hazard": 8,
                   "lock_discipline": 3, "fault_site_registry": 5,
-                  "event_name_registry": 5, "executable_census": 5}
+                  "event_name_registry": 5, "executable_census": 5,
+                  "donated_grad_escape": 4}
         for fixture, rule in RULES.items():
             res = graftlint.lint(os.path.join(FIXTURES, fixture, "bad"),
                                  [rule])

@@ -632,6 +632,21 @@ class TestNN:
         ids = np.array([1, 5, 1])
         check("embedding_lookup", table[ids], table, ids)
 
+    def test_embedding_bag(self):
+        table = r(10, 4)
+        bag = np.array([[1, 5, 2], [0, 3, 3]])
+        mask = np.array([[1.0, 1.0, 0.0], [1.0, 0.0, 0.0]], np.float32)
+        pooled = (table[bag] * mask[..., None]).sum(1)
+        counts = np.maximum(mask.sum(1, keepdims=True), 1.0)
+        check("embedding_bag", pooled / counts, table, bag, mask)
+        check("embedding_bag", pooled, table, bag, mask, mode="sum")
+        # mask=None pools the whole window
+        check("embedding_bag", table[bag].mean(1), table, bag)
+        # the pallas kernel (interpret mode on CPU) matches the xla
+        # reference lowering
+        check("embedding_bag", pooled / counts, table, bag, mask,
+              impl="interpret", atol=1e-6)
+
     def test_attention(self):
         q, k, v = r(2, 5, 8), r(2, 6, 8, seed=1), r(2, 6, 8, seed=2)
         scores = q @ k.transpose(0, 2, 1) / np.sqrt(8)

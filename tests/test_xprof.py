@@ -504,6 +504,25 @@ class TestBenchtrack:
                      "platform": "cpu"}}
         assert benchtrack.compare_records(base, cur)["violations"] == []
 
+    def test_empty_baseline_skips_with_message(self, tmp_path):
+        """An empty baseline round (smoke config that emitted nothing,
+        truncated file) gates nothing, says so, and exits 0 — never a
+        crash, never a silent vacuous pass."""
+        import json as _json
+
+        from tools import benchtrack
+
+        res = benchtrack.compare_records({}, {"m": self._rec()})
+        assert res["violations"] == [] and res["compared"] == []
+        assert any("no records" in s for s in res["skipped"])
+        # end-to-end through the CLI: exit 0 on the empty baseline
+        empty = tmp_path / "BENCH_r00.json"
+        empty.write_text(_json.dumps({"n": 0, "rc": 0, "tail": "",
+                                      "parsed": []}))
+        cur = tmp_path / "BENCH_r01.json"
+        cur.write_text(_json.dumps(self._rec() | {"metric": "m"}))
+        assert benchtrack.main(["--compare", str(empty), str(cur)]) == 0
+
 
 class TestRegistryTable:
     """The 4-way agreement's test-corpus leg (mirrors the fault-site

@@ -193,6 +193,14 @@ def compare_records(baseline: Dict[str, Dict[str, Any]],
     violations: List[str] = []
     skipped: List[str] = []
     compared: List[str] = []
+    if not baseline:
+        # an empty baseline round (e.g. a smoke config that emitted no
+        # records, or a truncated file) is NOT a pass-by-vacuity worth
+        # silence: say so, gate nothing, exit clean
+        skipped.append("baseline round carries no records — nothing to "
+                       "compare, skipping the regression gate")
+        return {"violations": violations, "skipped": skipped,
+                "compared": compared}
     for metric, base in sorted(baseline.items()):
         cur = current.get(metric)
         if cur is None:

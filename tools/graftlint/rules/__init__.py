@@ -1,5 +1,6 @@
 """The graftlint rule set — one module per shipped bug class."""
 
+from .donated_grad_escape import DonatedGradEscapeRule
 from .donation_alias import DonationAliasRule
 from .event_registry import EventNameRegistryRule
 from .exec_census import ExecutableCensusRule
@@ -15,7 +16,7 @@ def all_rules():
     return [DonationAliasRule(), PallasGuardRule(), HostSyncRule(),
             RetraceHazardRule(), LockDisciplineRule(),
             FaultSiteRegistryRule(), EventNameRegistryRule(),
-            ExecutableCensusRule()]
+            ExecutableCensusRule(), DonatedGradEscapeRule()]
 
 
 RULE_NAMES = [r.name for r in all_rules()]
