@@ -37,6 +37,11 @@ BASELINES = {
     # Planning anchor (not reference-derived): V100 BERT-base fine-tune at
     # seq 128 ~ 100 samples/sec in contemporary frameworks.
     "bert_base_finetune": {"value": 100.0, "unit": "samples/sec"},
+    # Planning anchor: the chaos soak heals its 8-fault catalog in under
+    # ~4 min of wall clock (faults healed per soak minute; see
+    # bench_soak_smoke gates — the value is throughput of PROVEN recovery,
+    # every fault must close a complete-chain incident to count at all).
+    "soak_smoke": {"value": 2.0, "unit": "faults/min"},
 }
 
 # Published bf16 peak per chip, TFLOP/s. v5e: 197 (v5p: 459; v4: 275). The
@@ -2892,6 +2897,693 @@ def bench_autoscale_smoke(steps: int, batch: int = 32) -> dict:
         shutil.rmtree(ckdir, ignore_errors=True)
 
 
+def bench_soak_smoke(steps: int, batch: int = 32) -> dict:
+    """Production-day chaos soak (ISSUE 17): the watchtower SLO engine
+    proven end to end. Supervised training publishes checkpoints into a
+    live autoscaled serving fleet under replayed traffic while a
+    scheduled chaos plan fires the FAULT_SITES catalog — train-step
+    crash, device loss, NaN poison, wedged dispatch, SIGTERM preemption,
+    dead serving replica, forced promote-violation, pipeline stage kill —
+    with the watchtower evaluating compressed-window SLOs (5m/1h/6h
+    scaled to 1s/3s/6s) the whole time. Self-validating hard-fails:
+
+    - **clean window is silent**: a no-fault load + train + publish leg
+      must page zero times and open zero incidents (false-positive gate);
+    - **every fault becomes exactly ONE incident** with a COMPLETE
+      cause -> detection -> mitigation -> recovery chain anchored on the
+      right fault site (precision = recall = 1.0 over 8 injected faults),
+      and supervisor incidents carry the blackbox tail;
+    - **zero failed or shed gold requests** through every phase,
+      including the dead-replica and rollback drills;
+    - **a wobbly evaluator loses a sample, not the alert**: the
+      ``watchtower/evaluate`` transient drill must skip exactly one tick
+      with no state transition and no incident;
+    - **watchtower overhead <= 5%** on a warm training loop (interleaved
+      on/off A/B, min-over-ratios via ``_ab_overhead_gate``) with ZERO
+      retrace delta inside the timed window;
+    - the incident registry is served over HTTP: ``/api/incidents``,
+      ``/api/health``'s ``last_incident`` pointer, the ``?corr=``
+      filtered ``/api/trace`` export, and the ``dl4j_alert_state`` /
+      ``dl4j_serving_latency_ms`` Prometheus families all answer."""
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    import jax
+
+    from deeplearning4j_tpu.common import (faultinject, flightrec,
+                                           tracecheck, watchtower)
+    from deeplearning4j_tpu.common.profiler import OpProfiler
+    from deeplearning4j_tpu.data import NDArrayDataSetIterator
+    from deeplearning4j_tpu.learning import Adam, Sgd
+    from deeplearning4j_tpu.ndarray.rng import set_default_seed
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.optimize.listeners import CheckpointListener
+    from deeplearning4j_tpu.optimize.telemetry import NanSentinelListener
+    from deeplearning4j_tpu.parallel import (AutoscalePolicy, Autoscaler,
+                                             Overloaded, PipelineTrainer,
+                                             ServingEngine, SLOClass,
+                                             TrainingSupervisor)
+    from deeplearning4j_tpu.parallel.serving import next_publication_ordinal
+    from deeplearning4j_tpu.ui.server import UIServer
+    from deeplearning4j_tpu.util.checkpoint import committed_checkpoints
+
+    TICK_S = 0.1                 # evaluator cadence (compressed time)
+    # 5m/1h/6h windows compressed to 1s/3s/6s over a 30s budget period:
+    # one bad tick at 0.1s cadence burns fast~100x/mid~33x a 0.1% budget,
+    # comfortably over the stock 14.4x page threshold, and ages out of
+    # every window seconds later — raise-fast/clear-fast, same math
+    WIN = dict(fast_s=1.0, mid_s=3.0, slow_s=6.0, period_s=30.0,
+               clear_ticks=2)
+    REQ_ROWS_MAX = 8
+    CLASS_MIX = ["batch"] * 5 + ["silver"] * 3 + ["gold"] * 2
+
+    def fail(msg, **extra):
+        faultinject.clear_plan()
+        print(json.dumps({"error": msg, **extra}, default=str))
+        sys.exit(1)
+
+    def build_mlp(seed):
+        conf = (NeuralNetConfiguration.builder().seed(seed)
+                .updater(Adam(1e-3)).activation("tanh").list()
+                .layer(L.DenseLayer(n_out=64))
+                .layer(L.DenseLayer(n_out=64))
+                .layer(L.OutputLayer(n_out=10))
+                .set_input_type(InputType.feed_forward(32)).build())
+        return MultiLayerNetwork(conf).init()
+
+    prof = OpProfiler.get()
+    prof.reset()
+    faultinject.clear_plan()
+    # the whole soak timeline in ONE ring: incident assembly walks it
+    flightrec.configure(capacity=65536)
+    flightrec.reset()
+    t_soak0 = time.monotonic()
+
+    incident_dir = tempfile.mkdtemp(prefix="dl4j_soak_incidents_")
+    ckdir = tempfile.mkdtemp(prefix="dl4j_soak_ckpt_")
+    tmpdirs = [incident_dir, ckdir]
+    eng = scaler = ui = None
+    try:
+        # ---- train-commit leg: checkpoints the fleet will consume ------
+        trainee = build_mlp(seed=11)
+        rng = np.random.RandomState(0)
+        xs = rng.randn(8 * batch, 32).astype(np.float32)
+        ys = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8 * batch)]
+        cl = CheckpointListener(ckdir, save_every_n_iterations=4,
+                                keep_last=4)
+        trainee.set_listeners(cl)
+        trainee.fit(NDArrayDataSetIterator(xs, ys, batch_size=batch),
+                    epochs=2)
+        cl.close()
+        ckpts = committed_checkpoints(ckdir)
+        if len(ckpts) < 2:
+            fail("training produced fewer than 2 committed checkpoints",
+                 n=len(ckpts))
+        ck_clean, ck_drill = ckpts[-2], ckpts[-1]
+
+        # ---- serving fleet + autoscaler --------------------------------
+        model = build_mlp(seed=7)
+        eng = (ServingEngine.Builder(model)
+               .buckets([1, 2, 4, 8, 16, batch]).input_shape((32,))
+               .workers(2).max_wait_ms(2.0).queue_limit(512)
+               .request_timeout_ms(15000)
+               .slo_classes([SLOClass("gold", 2, 500.0, queue_budget=256),
+                             SLOClass("silver", 1, 800.0, queue_budget=64),
+                             SLOClass("batch", 0, 2000.0, queue_budget=64)])
+               .brownout(interval_s=0.1, depth_trigger=24, clear_ticks=5)
+               .queue_hwm_window(1.5)
+               .resurrect_dead_replicas(True, backoff_ms=100)
+               .build())
+        scaler = Autoscaler(eng, AutoscalePolicy(
+            min_workers=2, max_workers=4, interval_s=0.1,
+            up_queue_depth=8, up_p99_frac=0.8, down_queue_depth=0,
+            down_idle_s=0.8, down_fill_frac=0.25,
+            cooldown_up_s=0.4, cooldown_down_s=0.8)).start()
+
+        # ---- the watchtower: stock catalog + drill objectives ----------
+        slos = watchtower.default_slos(engine=eng, **WIN)
+        slos += [
+            watchtower.SLO(
+                "replica-health",
+                watchtower.counter_increment_sampler(
+                    "inference/replica_retired"),
+                budget=0.001,
+                description="serving replicas stay alive", **WIN),
+            watchtower.SLO(
+                "rollback-budget",
+                watchtower.counter_increment_sampler("serving/rollbacks"),
+                budget=0.001,
+                description="published checkpoints stick", **WIN),
+            watchtower.SLO(
+                "remap-budget",
+                watchtower.counter_increment_sampler("pipeline/remaps"),
+                budget=0.001,
+                description="pipeline stages stay up", **WIN),
+        ]
+        tower = watchtower.install(watchtower.Watchtower(
+            slos, interval_s=TICK_S, incident_dir=incident_dir,
+            ring_context=600, lookback_s=60.0, finalize_after_s=30.0))
+        tower.start()
+        ui = UIServer()
+        port = ui.enable(0)
+
+        # ---- shared helpers --------------------------------------------
+        inputs = np.random.RandomState(1).randn(
+            REQ_ROWS_MAX, 32).astype(np.float32)
+
+        def phase(n_requests, qps, seed):
+            r = np.random.RandomState(seed)
+            gaps = r.exponential(1.0 / qps, n_requests)
+            sizes = r.randint(1, REQ_ROWS_MAX + 1, n_requests)
+            classes = [CLASS_MIX[i]
+                       for i in r.randint(0, len(CLASS_MIX), n_requests)]
+            shed = {c: 0 for c in ("gold", "silver", "batch")}
+            failures = []
+            lock = threading.Lock()
+            done = threading.Semaphore(0)
+            admitted = 0
+            t0 = time.monotonic()
+            t_next = t0
+            for i in range(n_requests):
+                t_next += gaps[i]
+                delay = t_next - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                cls = classes[i]
+                try:
+                    fut = eng.output_async(inputs[:sizes[i]],
+                                           slo_class=cls)
+                except Overloaded:
+                    shed[cls] += 1
+                    continue
+                admitted += 1
+
+                def on_done(f, c=cls):
+                    with lock:
+                        if f.exception() is not None:
+                            failures.append(f"{c}: {f.exception()}")
+                    done.release()
+
+                fut.add_done_callback(on_done)
+            for _ in range(admitted):
+                if not done.acquire(timeout=30):
+                    fail("soak load phase hung: requests never resolved")
+            return {"shed": shed, "failures": failures, "n": n_requests,
+                    "admitted": admitted,
+                    "wall": time.monotonic() - t0}
+
+        def gate_phase(name, ph):
+            if ph["failures"]:
+                fail(f"{name}: requests failed", n=len(ph["failures"]),
+                     first=ph["failures"][0])
+            if ph["shed"]["gold"]:
+                fail(f"{name}: gold requests shed", shed=ph["shed"])
+
+        gold_x = inputs[:2]
+
+        def gold_load_until(handle):
+            failures = []
+            while not handle.done:
+                try:
+                    eng.output(gold_x, slo_class="gold")
+                except Exception as e:      # census, not control flow
+                    failures.append(str(e))
+            return failures
+
+        def wait_for(cond, timeout_s, what):
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                if cond():
+                    return
+                time.sleep(0.05)
+            fail(f"soak: timed out waiting for {what}",
+                 alert_states=tower.alert_states(),
+                 incidents=watchtower.incidents())
+
+        def incident_ids():
+            return {i["id"] for i in watchtower.incidents()}
+
+        chronicle = {}
+
+        def expect_incident(drill, before_ids, *, kind, cause_site=None,
+                            detection=None, mitigation=None, recovery=None,
+                            timeout_s=25.0):
+            """Exactly ONE new incident, finalized with a complete chain
+            anchored where the drill says it must be."""
+            deadline = time.monotonic() + timeout_s
+            new = []
+            while time.monotonic() < deadline:
+                new = [i for i in watchtower.incidents()
+                       if i["id"] not in before_ids]
+                if len(new) > 1:
+                    fail(f"{drill}: one injected fault opened "
+                         f"{len(new)} incidents", incidents=new)
+                if new and new[0]["finalized"]:
+                    break
+                time.sleep(0.05)
+            if not new or not new[0]["finalized"]:
+                fail(f"{drill}: no finalized incident within "
+                     f"{timeout_s}s", incidents=watchtower.incidents(),
+                     alert_states=tower.alert_states())
+            meta = new[0]
+            # the index flips finalized a beat before the finalize
+            # rewrite lands on disk — read the file until it agrees
+            rep = None
+            file_deadline = time.monotonic() + 5.0
+            while time.monotonic() < file_deadline:
+                with open(meta["path"], "r", encoding="utf-8") as f:
+                    rep = json.load(f)
+                if rep.get("finalized"):
+                    break
+                time.sleep(0.05)
+            ch = rep["chain"]
+            names = {k: (v or {}).get("name")
+                     for k, v in ch.items() if k != "complete"}
+            if not rep["complete"] or not rep["resolved"]:
+                fail(f"{drill}: incident chain incomplete", chain=names,
+                     id=meta["id"],
+                     rep={k: v for k, v in rep.items()
+                          if k not in ("events", "ledgers", "census",
+                                       "watermarks", "blackbox")},
+                     alert_states=tower.alert_states())
+            if rep["kind"] != kind:
+                fail(f"{drill}: incident kind {rep['kind']!r}, "
+                     f"wanted {kind!r}", id=meta["id"])
+            if cause_site is not None and \
+                    ch["cause"]["attrs"].get("site") != cause_site:
+                fail(f"{drill}: cause anchored on the wrong fault site",
+                     cause=ch["cause"])
+            for role, allowed in (("detection", detection),
+                                  ("mitigation", mitigation),
+                                  ("recovery", recovery)):
+                if allowed is not None and ch[role]["name"] not in allowed:
+                    fail(f"{drill}: {role} anchored on "
+                         f"{ch[role]['name']!r}", chain=names)
+            seqs = (ch["cause"]["seq"], ch["mitigation"]["seq"],
+                    ch["recovery"]["seq"])
+            if not (seqs[0] <= seqs[1] <= seqs[2]) or \
+                    ch["cause"]["seq"] > ch["detection"]["seq"]:
+                fail(f"{drill}: chain events out of causal order",
+                     chain=names, seqs=seqs)
+            if kind == "supervisor" and not rep.get("blackbox"):
+                fail(f"{drill}: supervisor incident carries no blackbox "
+                     "tail", id=meta["id"])
+            chronicle[drill] = {
+                "id": meta["id"], "kind": rep["kind"],
+                "reason": rep["reason"], "corr": rep.get("corr"),
+                "chain": names,
+                "mttr_s": round(rep["updated_t"] - rep["opened_t"], 2)}
+            return meta["id"], rep
+
+        # ---- supervised-drill scaffolding ------------------------------
+        n_tr = 8 * batch
+        tx = rng.randn(n_tr, 32).astype(np.float32)
+        ty = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n_tr)]
+
+        def make_train_it():
+            return NDArrayDataSetIterator(tx, ty, batch_size=batch)
+
+        def supervised_run(drill, plan, *, listeners=(), policies=None,
+                           hang_deadline_s=None, poll_s=0.05,
+                           resume="never", sup_dir=None,
+                           expect_status="completed", expect_restarts=0):
+            d = sup_dir or tempfile.mkdtemp(prefix=f"dl4j_soak_sup_")
+            if d not in tmpdirs:
+                tmpdirs.append(d)
+            m = build_mlp(seed=23)
+            if listeners:
+                m.set_listeners(*listeners)
+            sup = TrainingSupervisor(m, d, save_every_n_iterations=3,
+                                     keep_last=2, backoff_base_s=0.01,
+                                     hang_deadline_s=hang_deadline_s,
+                                     poll_s=poll_s, policies=policies)
+            if plan:
+                faultinject.set_plan(faultinject.FaultPlan(plan))
+            try:
+                res = sup.fit(make_train_it, epochs=2, batch_size=batch,
+                              resume=resume)
+            finally:
+                faultinject.clear_plan()
+            if res.status != expect_status or \
+                    (expect_restarts is not None
+                     and res.restarts != expect_restarts):
+                fail(f"{drill}: supervised run ended "
+                     f"{res.status}/{res.restarts} restarts, wanted "
+                     f"{expect_status}/{expect_restarts}",
+                     history=res.history)
+            return res, d
+
+        # ================================================================
+        # Phase 1 — the CLEAN window: load + train + publish, silence
+        # ================================================================
+        pages0 = prof.counter_value("watchtower/pages")
+        clean_phases = [phase(150, 40.0, seed=1),
+                        phase(250, 120.0, seed=2)]
+        for i, ph in enumerate(clean_phases):
+            gate_phase(f"clean-window load {i}", ph)
+        supervised_run("clean-window train", None)
+        h = eng.publish_checkpoint(ck_clean, canary_window_s=0.5,
+                                   confirm_window_s=0.5,
+                                   check_interval_s=0.1)
+        gold_failures = gold_load_until(h)
+        if h.result(timeout=15) != "promoted" or gold_failures:
+            fail("clean-window publish did not promote",
+                 outcome=h.phase, gold_failures=gold_failures[:3])
+        time.sleep(4 * TICK_S)          # let the evaluator see all of it
+        if prof.counter_value("watchtower/pages") != pages0:
+            fail("false-positive page in the clean window",
+                 pages=prof.counter_value("watchtower/pages") - pages0,
+                 alert_states=tower.alert_states())
+        if incident_ids():
+            fail("incident opened during the clean window",
+                 incidents=watchtower.incidents())
+
+        # ================================================================
+        # Phase 2 — watchtower A/B overhead on a warm training loop
+        # ================================================================
+        n_ab = 32 * batch
+        ax = rng.randn(n_ab, 32).astype(np.float32)
+        ay = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n_ab)]
+        ab_model = build_mlp(seed=31)
+
+        def ab_epoch():
+            ab_model.fit(NDArrayDataSetIterator(ax, ay, batch_size=batch),
+                         epochs=3, batch_size=batch)
+            float(np.asarray(ab_model._score_dev))     # value fence
+
+        ab_epoch()                                     # warm/compile
+
+        def timed_epoch(name):
+            tower.configure(enabled=(name == "on"))
+            t0 = time.perf_counter()
+            ab_epoch()
+            return time.perf_counter() - t0
+
+        timed_epoch("on")
+        timed_epoch("off")                             # settle rounds
+        traces0 = prof.trace_counts()
+        try:
+            with tracecheck.steady_state("soak watchtower A/B",
+                                         max_host_syncs=None):
+                overhead, _times, overhead_runs = _ab_overhead_gate(
+                    "watchtower", 0.05,
+                    lambda: _ab_rounds(timed_epoch, rounds=5), fail)
+        except tracecheck.SteadyStateViolation as e:
+            fail("watchtower A/B window retraced/synced",
+                 violation=str(e).splitlines()[0])
+        if prof.trace_counts() != traces0:
+            fail("watchtower A/B window changed the compile footprint",
+                 before=traces0, after=prof.trace_counts())
+        tower.configure(enabled=True)
+
+        # ================================================================
+        # Phase 3 — the chaos plan, one incident per fault
+        # ================================================================
+        # (a) train-step crash -> restart
+        before = incident_ids()
+        supervised_run(
+            "crash", [{"site": "train/step", "index": 10,
+                       "kind": "crash"}], expect_restarts=1)
+        expect_incident(
+            "crash", before, kind="supervisor", cause_site="train/step",
+            detection=("supervisor/attempt_failed",),
+            mitigation=("supervisor/restart",),
+            recovery=("supervisor/attempt_start", "checkpoint/restore"))
+
+        # (b) device loss -> restart (non-elastic target: the documented
+        # shrink_and_continue fallback)
+        before = incident_ids()
+        supervised_run(
+            "device-loss", [{"site": "device/loss", "index": 10,
+                             "kind": "device_loss", "replica": 0}],
+            expect_restarts=1)
+        expect_incident(
+            "device-loss", before, kind="supervisor",
+            cause_site="device/loss",
+            detection=("supervisor/attempt_failed",),
+            mitigation=("supervisor/restart",),
+            recovery=("supervisor/attempt_start", "checkpoint/restore"))
+
+        # (c) NaN poison -> sentinel raises -> policy restart
+        before = incident_ids()
+        supervised_run(
+            "nan-poison", [{"site": "pipeline/bind", "index": 10,
+                            "kind": "nan"}],
+            listeners=(NanSentinelListener("raise", check_every_n=1),),
+            policies={"poisoned_numerics": "restart"}, expect_restarts=1)
+        expect_incident(
+            "nan-poison", before, kind="supervisor",
+            cause_site="pipeline/bind",
+            detection=("supervisor/attempt_failed",),
+            mitigation=("supervisor/restart",),
+            recovery=("supervisor/attempt_start", "checkpoint/restore"))
+
+        # (d) wedged dispatch -> watchdog abandonment -> restart
+        before = incident_ids()
+        supervised_run(
+            "wedge", [{"site": "train/wedge", "index": 9,
+                       "kind": "wedge"}],
+            hang_deadline_s=0.5, poll_s=0.02, expect_restarts=1)
+        expect_incident(
+            "wedge", before, kind="supervisor", cause_site="train/wedge",
+            detection=("supervisor/watchdog_fire",
+                       "supervisor/attempt_failed"),
+            mitigation=("supervisor/restart",),
+            recovery=("supervisor/attempt_start", "checkpoint/restore"))
+
+        # (e) SIGTERM preemption -> flush checkpoint -> exit -> resume
+        before = incident_ids()
+        _, pre_dir = supervised_run(
+            "preempt", [{"site": "train/step", "index": 10,
+                         "kind": "preempt"}],
+            expect_status="preempted", expect_restarts=0)
+        supervised_run("preempt-resume", None, resume="auto",
+                       sup_dir=pre_dir)
+        expect_incident(
+            "preempt", before, kind="supervisor", cause_site="train/step",
+            detection=("supervisor/attempt_failed",),
+            mitigation=("supervisor/preempted",),
+            recovery=("supervisor/attempt_start", "checkpoint/restore"))
+
+        # (f) dead serving replica -> retire -> resurrection
+        before = incident_ids()
+        resurrected0 = len(flightrec.events("inference/resurrected"))
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "serving/dispatch", "kind": "dead_replica",
+              "times": 1}]))
+        dead_ph = phase(120, 80.0, seed=6)
+        faultinject.clear_plan()
+        gate_phase("dead-replica load", dead_ph)
+        wait_for(lambda: len(flightrec.events("inference/resurrected"))
+                 > resurrected0, 10.0, "replica resurrection")
+        expect_incident(
+            "dead-replica", before, kind="alert",
+            cause_site="serving/dispatch",
+            detection=("watchtower/alert",),
+            mitigation=("serving/retire",),
+            recovery=("inference/resurrected", "watchtower/alert"))
+
+        # (g) forced promote-violation -> rollback -> clean republish
+        before = incident_ids()
+        ordinal = next_publication_ordinal()
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "serving/promote", "kind": "transient",
+              "index": ordinal}]))
+        h2 = eng.publish_checkpoint(ck_drill, canary_window_s=0.5,
+                                    confirm_window_s=5.0,
+                                    check_interval_s=0.1)
+        gold_failures = gold_load_until(h2)
+        faultinject.clear_plan()
+        if h2.result(timeout=15) != "rolled_back" or gold_failures:
+            fail("forced-violation drill did not roll back cleanly",
+                 outcome=h2.phase, gold_failures=gold_failures[:3])
+        h3 = eng.publish_checkpoint(ck_clean, canary_window_s=0.5,
+                                    confirm_window_s=0.5,
+                                    check_interval_s=0.1)
+        gold_failures = gold_load_until(h3)
+        if h3.result(timeout=15) != "promoted" or gold_failures:
+            fail("post-rollback republish did not promote",
+                 outcome=h3.phase, gold_failures=gold_failures[:3])
+        expect_incident(
+            "promote-violation", before, kind="alert",
+            cause_site="serving/promote",
+            detection=("watchtower/alert",),
+            mitigation=("serving/rollback",),
+            recovery=("serving/promote", "watchtower/alert"))
+
+        # (h) the evaluator itself wobbles: one skipped tick, no alert
+        wait_for(lambda: all(v == 0
+                             for v in tower.alert_states().values()),
+                 20.0, "alert states to settle before the evaluator drill")
+        states0 = tower.alert_states()
+        stats0 = tower.stats()
+        before = incident_ids()
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "watchtower/evaluate", "kind": "transient",
+              "index": int(stats0["evaluations"]) + 2}]))
+        wait_for(lambda: tower.stats()["skipped_evals"]
+                 >= stats0["skipped_evals"] + 1, 5.0,
+                 "the watchtower/evaluate transient to fire")
+        faultinject.clear_plan()
+        if tower.alert_states() != states0 or incident_ids() != before:
+            fail("a skipped evaluation tick changed alert state or "
+                 "opened an incident", states=tower.alert_states())
+
+        # (i) pipeline stage kill -> remap -> resume (the scaler is done
+        # at this point; stopping it keeps the mitigation anchor exact)
+        scaler.stop()
+        before = incident_ids()
+        batch_pp, M, feat = 16, 4, 16
+        n_pp = 6 * batch_pp
+        set_default_seed(55)
+        pb = (NeuralNetConfiguration.builder().seed(55)
+              .updater(Sgd(learning_rate=0.02)).list())
+        for _ in range(6):
+            pb.layer(L.DenseLayer(n_out=feat, activation="tanh"))
+        pmodel = MultiLayerNetwork(
+            pb.set_input_type(InputType.feed_forward(feat)).build()).init()
+        tr = PipelineTrainer(pmodel, stages=3, n_micro=M,
+                             schedule="1f1b", data=1)
+        prng = np.random.RandomState(9)
+        px = prng.randn(n_pp, feat).astype(np.float32)
+        py = prng.randn(n_pp, feat).astype(np.float32)
+
+        def make_pp_it():
+            return NDArrayDataSetIterator(px, py, batch_size=batch_pp)
+
+        tr.fit(make_pp_it(), epochs=1, batch_size=batch_pp)   # warm
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "pipeline/stage", "kind": "device_loss",
+              "index": 9, "stage": 1}]))
+        try:
+            tr.fit(make_pp_it(), epochs=2, batch_size=batch_pp)
+            fail("pipeline/stage fault plan did not fire")
+        except faultinject.DeviceLostError:
+            pass
+        faultinject.clear_plan()
+        cursor = (int(pmodel._epoch - pmodel._fit_epoch0),
+                  int(pmodel._steps_in_epoch))
+        removed = tr.remap(2, lost_stages=[1])
+        if len(removed) != 1:
+            fail("stage-kill remap did not retire exactly the lost "
+                 "stage column", removed=len(removed))
+        tr.fit(make_pp_it(), epochs=2, batch_size=batch_pp,
+               resume_cursor=cursor)
+        if not np.isfinite(float(np.asarray(pmodel._score_dev))):
+            fail("post-remap loss went non-finite")
+        expect_incident(
+            "stage-kill", before, kind="alert",
+            cause_site="pipeline/stage",
+            detection=("watchtower/alert",),
+            mitigation=("pipeline/remap",),
+            recovery=("watchtower/alert",))
+
+        # ================================================================
+        # Phase 4 — registry totals + the HTTP surface
+        # ================================================================
+        DRILLS = ("crash", "device-loss", "nan-poison", "wedge",
+                  "preempt", "dead-replica", "promote-violation",
+                  "stage-kill")
+        incs = watchtower.incidents()
+        if len(incs) != len(DRILLS):
+            fail(f"{len(DRILLS)} faults injected but {len(incs)} "
+                 "incidents assembled (precision/recall broke)",
+                 incidents=incs)
+        if any(not i["finalized"] or not i["resolved"] for i in incs):
+            fail("unresolved incidents at end of soak", incidents=incs)
+
+        def http_json(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+                return json.loads(r.read().decode("utf-8"))
+
+        http_incs = http_json("/api/incidents")
+        if len(http_incs) != len(DRILLS):
+            fail("/api/incidents does not list every incident",
+                 n=len(http_incs))
+        served = http_json(f"/api/incidents?id={http_incs[-1]['id']}")
+        if not served.get("complete"):
+            fail("/api/incidents?id= served an incomplete report",
+                 id=http_incs[-1]["id"])
+        health = http_json("/api/health")
+        li = health.get("last_incident")
+        if not li or not (li.get("tail") or {}).get("complete"):
+            fail("/api/health last_incident pointer missing or "
+                 "incomplete", last_incident=li)
+        crash_corr = chronicle["crash"]["corr"]
+        trace = http_json(f"/api/trace?corr={crash_corr}")
+        tevs = trace.get("traceEvents", [])
+        if not tevs or any(e.get("args", {}).get("corr") != crash_corr
+                           for e in tevs if e.get("ph") != "M"):
+            fail("/api/trace?corr= filter broke", corr=crash_corr,
+                 n=len(tevs))
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/metrics", timeout=10) as r:
+            metrics_text = r.read().decode("utf-8")
+        for needle in ("dl4j_alert_state{",
+                       'dl4j_serving_latency_ms{class="gold"'):
+            if needle not in metrics_text:
+                fail(f"/api/metrics is missing {needle!r}")
+
+        soak_wall_s = time.monotonic() - t_soak0
+        tower_stats = tower.stats()
+        return {
+            "metric": "soak_smoke",
+            "value": 60.0 * len(DRILLS) / soak_wall_s,
+            "unit": "faults/min",
+            "platform": jax.devices()[0].platform,
+            "faults_injected": len(DRILLS),
+            "incidents_assembled": len(incs),
+            "chains_complete": len(DRILLS),
+            "mttr_s_mean": round(sum(c["mttr_s"]
+                                     for c in chronicle.values())
+                                 / len(chronicle), 2),
+            "incidents": chronicle,
+            "clean_window": {
+                "requests": sum(ph["n"] for ph in clean_phases),
+                "pages": 0, "incidents": 0},
+            "watchtower_overhead_frac": round(overhead, 4),
+            "overhead_runs": overhead_runs,
+            "pages_total": prof.counter_value("watchtower/pages"),
+            "alerts_total": prof.counter_value("watchtower/alerts"),
+            "evaluations": int(tower_stats["evaluations"]),
+            "skipped_evals": int(tower_stats["skipped_evals"]),
+            "soak_wall_s": round(soak_wall_s, 1),
+            "data": "clean diurnal window + 8-fault chaos plan over "
+                    "supervised training publishing into an autoscaled "
+                    "serving fleet; gates: silent clean window, exactly "
+                    "one complete-chain incident per fault, zero "
+                    "failed/shed gold, <=5% watchtower A/B overhead, "
+                    "zero retrace delta, HTTP incident/trace/metrics "
+                    "surface",
+        }
+    finally:
+        faultinject.clear_plan()
+        watchtower.uninstall()
+        if scaler is not None:
+            try:
+                scaler.stop()
+            except Exception:
+                pass
+        if eng is not None:
+            try:
+                eng.shutdown()
+            except Exception:
+                pass
+        if ui is not None:
+            try:
+                ui.stop()
+            except Exception:
+                pass
+        flightrec.configure(capacity=4096)
+        for d in tmpdirs:
+            shutil.rmtree(d, ignore_errors=True)
+
+
 def bench_obs_smoke(steps: int, batch: int = 64) -> dict:
     """CPU-friendly smoke of the observability layer (ISSUE 10). Three
     self-validating phases, every gate a hard fail:
@@ -3756,7 +4448,8 @@ def main() -> None:
     # virtual CPU devices BEFORE anything imports jax (the library import
     # just below does). The flag only affects the host platform —
     # harmless on TPU runs.
-    if ({"zero1-smoke", "elastic-smoke", "pipeline-parallel-smoke"}
+    if ({"zero1-smoke", "elastic-smoke", "pipeline-parallel-smoke",
+         "soak-smoke"}
             & set(sys.argv)) and "jax" not in sys.modules:
         _flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in _flags:
@@ -3789,7 +4482,8 @@ def main() -> None:
                                  "pipeline-parallel-smoke",
                                  "serving-smoke", "autoscale-smoke",
                                  "mfu-smoke", "obs-smoke", "fleet-smoke",
-                                 "xprof-smoke", "remat-smoke"])
+                                 "xprof-smoke", "remat-smoke",
+                                 "soak-smoke"])
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--batch", type=int, default=None,
                         help="per-config default: resnet50=128, bert=32")
@@ -3938,6 +4632,8 @@ def main() -> None:
         result = bench_serving_smoke(steps, batch=args.batch or 32)
     elif args.config == "autoscale-smoke":
         result = bench_autoscale_smoke(steps, batch=args.batch or 32)
+    elif args.config == "soak-smoke":
+        result = bench_soak_smoke(steps, batch=args.batch or 32)
     elif args.config == "obs-smoke":
         result = bench_obs_smoke(steps, batch=args.batch or 64)
     elif args.config == "fleet-smoke":

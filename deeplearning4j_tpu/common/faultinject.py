@@ -25,7 +25,9 @@ them a *deterministic, step-indexed* event:
   requests requeued).
 - Instrumented code calls :func:`fault_point(site, index)` at the matching
   place. Raising kinds (``transient``, ``crash``, ``dead_replica``) raise
-  there; ``slow`` sleeps in place; advisory kinds (``nan``) are returned
+  there; ``slow`` sleeps in place; ``preempt`` delivers a real SIGTERM to
+  this process (the supervisor's handler turns it into a resumable exit
+  at the next step boundary); advisory kinds (``nan``) are returned
   for the caller to apply (e.g. poison the batch it is about to bind).
 - Plans come from code (:func:`set_plan` — tests) or the environment
   (``DL4J_TPU_FAULT_PLAN`` = inline JSON or ``@/path/to/plan.json`` —
@@ -70,9 +72,12 @@ pipeline/bind         transient, slow, nan    test_fault_tolerance retry /
                                               NaN-poison drills; fault-smoke
 pipeline/place        transient, slow         test_fault_tolerance H2D
                                               placement-retry drills
-train/step            crash                   test_kill_resume exact-parity
+train/step            crash, preempt          test_kill_resume exact-parity
                                               kill (exit mode); supervisor
                                               restart drills; fault-smoke
+                                              (``preempt`` delivers a real
+                                              SIGTERM to this process — the
+                                              soak-smoke preemption drill)
 train/wedge           wedge                   test_supervisor watchdog
                                               abandonment drill
 device/loss           device_loss             test_elastic shrink drills;
@@ -109,6 +114,12 @@ pipeline/stage        device_loss, slow,      test_pipeline_parallel
                                               lost STAGE via ``stage``;
                                               ``slow`` = straggler stage;
                                               ``wedge`` = hung schedule)
+watchtower/evaluate   transient               test_watchtower skipped-tick
+                                              drill; soak-smoke (transient
+                                              = one evaluation tick is
+                                              skipped, the loop carries
+                                              on — alerts lose a sample,
+                                              never the state machine)
 ====================  ======================  ==============================
 """
 
@@ -140,8 +151,9 @@ FAULT_SITES = {
         "kinds": ("transient", "slow"),
         "drill": "test_fault_tolerance H2D placement-retry"},
     "train/step": {
-        "kinds": ("crash",),
-        "drill": "test_kill_resume exact-parity kill; supervisor restarts"},
+        "kinds": ("crash", "preempt"),
+        "drill": "test_kill_resume exact-parity kill; supervisor restarts; "
+                 "soak-smoke SIGTERM preemption"},
     "train/wedge": {
         "kinds": ("wedge",),
         "drill": "test_supervisor watchdog abandonment"},
@@ -183,6 +195,9 @@ FAULT_SITES = {
         "kinds": ("device_loss", "slow", "wedge"),
         "drill": "test_pipeline_parallel kill-a-stage remap; "
                  "pipeline-parallel-smoke"},
+    "watchtower/evaluate": {
+        "kinds": ("transient",),
+        "drill": "test_watchtower skipped-tick drill; soak-smoke"},
 }
 
 
@@ -395,6 +410,14 @@ def fault_point(site: str, index: Optional[int] = None) -> List[Dict[str, Any]]:
             if spec.get("mode", "raise") == "exit":
                 os._exit(int(spec.get("code", 137)))
             raise SimulatedCrash(f"injected crash at {site}[{index}]")
+        elif kind == "preempt":
+            # a REAL SIGTERM to our own pid — the supervisor's installed
+            # handler sets its preempt flag and training unwinds at the
+            # next step boundary, exactly the eviction a borg/k8s reclaim
+            # delivers. Nothing raises here: the signal is the fault.
+            import signal as _signal
+
+            os.kill(os.getpid(), _signal.SIGTERM)
         else:
             advisory.append(spec)
     return advisory

@@ -136,6 +136,13 @@ xprof/exec                 info        executable census: a new compiled
 xprof/hbm                  info        HBM watermark: a phase's live-
                                        buffer peak rose (census bytes
                                        attached); test_xprof
+watchtower/alert           warn/error  SLO burn-rate alert transition
+                                       (error = page, warn = warn, info
+                                       = clear); test_watchtower +
+                                       soak-smoke drills
+watchtower/incident        warn/info   incident report opened (warn) or
+                                       finalized (info) with id + path;
+                                       test_watchtower + soak-smoke
 =========================  ==========  =================================
 
 Deliberately stdlib-only (no jax, no profiler import) so every
@@ -277,6 +284,13 @@ EVENT_SITES: Dict[str, Dict[str, str]] = {
         "desc": "HBM watermark peak rose for a phase (live/device bytes "
                 "attached)",
         "drill": "test_xprof watermark test; xprof-smoke"},
+    "watchtower/alert": {
+        "desc": "SLO alert state transition (slo, from/to, burn rates, "
+                "budget remaining)",
+        "drill": "test_watchtower burn/hysteresis drills; soak-smoke"},
+    "watchtower/incident": {
+        "desc": "incident report opened/finalized (id, reason, path)",
+        "drill": "test_watchtower incident drills; soak-smoke"},
 }
 
 DEFAULT_CAPACITY = 4096
@@ -455,15 +469,18 @@ class FlightRecorder:
         return self.snapshot()[-max(0, int(n)):]
 
     # -- consumers --------------------------------------------------------
-    def export_chrome_trace(self, path: str) -> int:
-        """Write the ring as Chrome trace event format (Perfetto /
+    def chrome_trace(self, corr: Optional[str] = None) -> Dict[str, Any]:
+        """The ring as a Chrome trace event document (Perfetto /
         ``chrome://tracing`` loadable). Spans map to ``B``/``E`` pairs,
         instants to ``i``, events carrying a ``dur_s`` attr (the
         profiler's ``time_section`` durations) to complete ``X`` events
         named after their section; each emitting thread gets its own
-        lane with a ``thread_name`` metadata record. Returns the number
-        of trace events written."""
+        lane with a ``thread_name`` metadata record. ``corr`` filters to
+        one correlation id — the incident-link view ``/api/trace``
+        serves over HTTP."""
         evs = self.snapshot()
+        if corr is not None:
+            evs = [e for e in evs if e["corr"] == corr]
         pid = os.getpid()
         out: List[Dict[str, Any]] = []
         threads: Dict[int, str] = {}
@@ -504,11 +521,18 @@ class FlightRecorder:
         for tid, tname in threads.items():
             out.append({"ph": "M", "pid": pid, "tid": tid,
                         "name": "thread_name", "args": {"name": tname}})
+        return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+    def export_chrome_trace(self, path: str,
+                            corr: Optional[str] = None) -> int:
+        """Write :meth:`chrome_trace` atomically (tmp + rename).
+        Returns the number of trace events written."""
+        doc = self.chrome_trace(corr=corr)
         tmp = path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"traceEvents": out, "displayTimeUnit": "ms"}, f)
+            json.dump(doc, f)
         os.replace(tmp, path)
-        return len(out)
+        return len(doc["traceEvents"])
 
     def dump_blackbox(self, path: str,
                       last_n: Optional[int] = None) -> str:
@@ -602,8 +626,12 @@ def reset() -> None:
     get().reset()
 
 
-def export_chrome_trace(path: str) -> int:
-    return get().export_chrome_trace(path)
+def chrome_trace(corr: Optional[str] = None) -> Dict[str, Any]:
+    return get().chrome_trace(corr=corr)
+
+
+def export_chrome_trace(path: str, corr: Optional[str] = None) -> int:
+    return get().export_chrome_trace(path, corr=corr)
 
 
 def dump_blackbox(path: str, last_n: Optional[int] = None) -> str:

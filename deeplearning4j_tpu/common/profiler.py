@@ -45,6 +45,7 @@ class OpProfiler:
         ("xla", "xla_stats"),
         ("tracecheck", "tracecheck_stats"),
         ("faults", "fault_stats"),
+        ("watchtower", "watchtower_stats"),
     )
 
     def __init__(self) -> None:
@@ -408,6 +409,20 @@ class OpProfiler:
         if s:
             out["retry_backoff_s"] = s["total_s"]
         return out
+
+    def watchtower_stats(self) -> Dict[str, float]:
+        """SLO watchtower ledger (``common.watchtower``): per-SLO alert
+        state (0 ok / 1 warn / 2 page), fast-window burn rate and error
+        budget remaining, plus evaluation/incident totals. Riding
+        :data:`LEDGERS` puts it on ``/api/health``, ``/api/metrics`` and
+        ``print_statistics`` in one move. Empty until a
+        :class:`~.watchtower.Watchtower` is installed."""
+        try:
+            from . import watchtower
+
+            return watchtower.stats()
+        except Exception:   # watchtower absent/uninstalled: ledger-silent
+            return {}
 
     def ledger_stats(self) -> Dict[str, Dict[str, float]]:
         """Every non-empty derived ledger (:data:`LEDGERS`), keyed by

@@ -367,6 +367,9 @@ class NanSentinelListener(TrainingListener):
                               for n, c in layers) or "loss"
             self.events.append({"iteration": it, "layers": layers,
                                 "total": int(tot)})
+            # counter per poisoned iteration (not per element): the
+            # watchtower's NaN-free-steps SLO samples increments of this
+            OpProfiler.get().count("telemetry/nan_events")
             if self.policy == "raise":
                 raise FloatingPointError(
                     f"non-finite gradients at iteration {it}: {where}")

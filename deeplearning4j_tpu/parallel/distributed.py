@@ -701,6 +701,13 @@ class TrainingSupervisor:
                            self.blackbox_path(), exc_info=True)
             return None
         try:
+            # incident reports and /api/health's last_incident pointer
+            # find the newest blackbox through the watchtower module
+            from ..common import watchtower
+            watchtower.note_blackbox(path)
+        except Exception:
+            pass
+        try:
             from ..common import xprof
 
             xprof.dump_memory_census(self.memcensus_path())
@@ -1019,6 +1026,17 @@ class TrainingSupervisor:
                                 error=repr(exc)[:300],
                                 steps=run.heartbeat.steps)
                 self._dump_blackbox()
+                # every failure classification triggers incident
+                # assembly on the installed watchtower (no-op when none
+                # is installed — supervision owes observability nothing)
+                try:
+                    from ..common import watchtower
+                    watchtower.note_supervisor_failure(
+                        failure_class=cls, policy=policy,
+                        error=repr(exc)[:200])
+                except Exception:
+                    logger.warning("supervisor: watchtower incident hook "
+                                   "failed", exc_info=True)
                 # the POLICY decides (so a policies={"preemption":
                 # "restart"} override is honored); a grace-window timeout
                 # always exits — the environment is reclaiming us

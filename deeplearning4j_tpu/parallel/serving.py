@@ -1190,6 +1190,36 @@ class ServingEngine(ParallelInference):
             return None
         return float(np.percentile(np.asarray(vals) * 1e3, 99))
 
+    def class_recent_p99(self, name: str, window_s: float = 5.0,
+                         min_samples: int = 5) -> Optional[float]:
+        """Public windowed per-class p99 (ms) — the watchtower's
+        latency-SLO signal; None until ``min_samples`` land in the
+        window."""
+        return self._class_recent_p99(name, window_s=window_s,
+                                      min_samples=min_samples)
+
+    def slo_classes(self) -> List[SLOClass]:
+        """The configured SLO classes, highest priority first (empty for
+        an unclassified engine)."""
+        if self._adm is None:
+            return []
+        return list(reversed(self._adm.by_shed_order))
+
+    def class_latency_stats(self) -> Dict[str, Dict[str, float]]:
+        """Rolling per-SLO-class p50/p99 over each class's last ≤2048
+        served requests, in ms — the engine-wide window alone cannot
+        price a non-top class's burn rate."""
+        with self._lat_lock:
+            per_class = {name: [lat for _, lat in dq]
+                         for name, dq in self._class_lats.items() if dq}
+        out: Dict[str, Dict[str, float]] = {}
+        for name, vals in per_class.items():
+            arr = np.asarray(vals) * 1e3
+            out[name] = {"window": len(vals),
+                         "p50_ms": float(np.percentile(arr, 50)),
+                         "p99_ms": float(np.percentile(arr, 99))}
+        return out
+
     def _on_scaled_out(self, worker_id: int) -> None:
         """A worker exiting via scale-down frees its pinned-device slot
         for whatever scale-up (or resurrection) comes next."""
@@ -1706,6 +1736,9 @@ class ServingEngine(ParallelInference):
         the canary phase, rolling latency quantiles."""
         out: Dict[str, Any] = dict(self.pool_stats())
         out.update(self.latency_stats())
+        cl = self.class_latency_stats()
+        if cl:
+            out["class_latency"] = cl
         with self._exec_lock:
             out["buckets_compiled"] = len(self._exec)
         out["warm"] = self._warm
@@ -1734,11 +1767,26 @@ def serving_health() -> Dict[str, Any]:
     if engines:
         out["engine_stats"] = [e.serving_stats() for e in engines]
         samples: List[float] = []
+        class_samples: Dict[str, List[float]] = {}
         for e in engines:
             with e._lat_lock:
                 samples.extend(e._latencies)
+                for name, dq in e._class_lats.items():
+                    class_samples.setdefault(name, []).extend(
+                        lat for _, lat in dq)
         if samples:
             arr = np.asarray(samples) * 1e3
             out["latency_p50_ms"] = float(np.percentile(arr, 50))
             out["latency_p99_ms"] = float(np.percentile(arr, 99))
+        if class_samples:
+            # fleet-wide per-SLO-class rolling quantiles: the signal the
+            # watchtower latency SLOs and dl4j_serving_latency_ms{class=}
+            # price burn rates from
+            out["class_latency"] = {
+                name: {"window": len(vals),
+                       "p50_ms": float(np.percentile(
+                           np.asarray(vals) * 1e3, 50)),
+                       "p99_ms": float(np.percentile(
+                           np.asarray(vals) * 1e3, 99))}
+                for name, vals in class_samples.items() if vals}
     return out

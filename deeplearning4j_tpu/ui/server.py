@@ -47,8 +47,16 @@ from .stats import (FileStatsStorage, InMemoryStatsStorage,
 
 
 def _prom_escape(value: Any) -> str:
+    """Label-VALUE escaping per the text-exposition spec (0.0.4):
+    backslash, double-quote and line-feed."""
     return (str(value).replace("\\", r"\\").replace('"', r'\"')
             .replace("\n", r"\n"))
+
+
+def _prom_escape_help(text: str) -> str:
+    """HELP-text escaping per the spec: only backslash and line-feed
+    (quotes are legal in help text, unlike in label values)."""
+    return str(text).replace("\\", r"\\").replace("\n", r"\n")
 
 
 def prometheus_text() -> str:
@@ -71,7 +79,7 @@ def prometheus_text() -> str:
         samples = list(samples)
         if not samples:
             return
-        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# HELP {name} {_prom_escape_help(help_text)}")
         lines.append(f"# TYPE {name} {mtype}")
         for labels, value in samples:
             if isinstance(value, float):
@@ -120,11 +128,30 @@ def prometheus_text() -> str:
         health = serving_health()
     except Exception:          # serving tier absent/unimportable: no rows
         health = {}
+    latency_samples: List[Tuple[Dict[str, str], float]] = []
+    for q, key in (("0.5", "latency_p50_ms"), ("0.99", "latency_p99_ms")):
+        if key in health:
+            latency_samples.append(({"quantile": q}, health[key]))
+    # per-SLO-class quantiles (class label values pass through
+    # _prom_escape like every other label — class names are caller data)
+    for cls, cl in sorted(health.get("class_latency", {}).items()):
+        for q, key in (("0.5", "p50_ms"), ("0.99", "p99_ms")):
+            if key in cl:
+                latency_samples.append(
+                    ({"class": cls, "quantile": q}, cl[key]))
     family("dl4j_serving_latency_ms", "gauge",
-           "rolling serving latency quantiles across live engines",
-           ((({"quantile": q}, health[key]))
-            for q, key in (("0.5", "latency_p50_ms"),
-                           ("0.99", "latency_p99_ms")) if key in health))
+           "rolling serving latency quantiles across live engines "
+           "(fleet-wide, and per SLO class when classified)",
+           latency_samples)
+    try:
+        from ..common import watchtower
+
+        alert_rows = sorted(watchtower.alert_states().items())
+    except Exception:          # watchtower absent: no rows
+        alert_rows = []
+    family("dl4j_alert_state", "gauge",
+           "watchtower SLO alert state (0 ok / 1 warn / 2 page)",
+           (({"slo": slo}, state) for slo, state in alert_rows))
     fr = flightrec.stats()
     family("dl4j_flightrec_events_total", "counter",
            "flight-recorder events ever appended", [({}, fr["events_total"])])
@@ -443,7 +470,15 @@ class UIServer:
                    for label, attr in OpProfiler.LEDGERS
                    if label != "serving"}
         ledgers["serving"] = serving_health()
+        # operators find the evidence from here: the newest incident
+        # report (or blackbox when no watchtower ever assembled one)
+        try:
+            from ..common import watchtower
+            last_incident = watchtower.last_incident()
+        except Exception:
+            last_incident = None
         return {"status": "ok",
+                "last_incident": last_incident,
                 "uptime_s": round(time.time() - self._t0, 1),
                 "stores": len(self._stores),
                 "paths": len(self._paths),
@@ -540,6 +575,41 @@ class UIServer:
                     self._send(
                         json.dumps(ui.series(tag, session=session)).encode(),
                         "application/json")
+                elif u.path == "/api/trace":
+                    # the flight-recorder ring as a Perfetto-loadable
+                    # Chrome trace; ?corr= narrows to one incident
+                    from ..common import flightrec
+
+                    q = parse_qs(u.query)
+                    corr = q.get("corr", [None])[0]
+                    self._send(
+                        json.dumps(flightrec.chrome_trace(corr=corr)).encode(),
+                        "application/json")
+                elif u.path == "/api/incidents":
+                    from ..common import watchtower
+
+                    q = parse_qs(u.query)
+                    iid = q.get("id", [None])[0]
+                    if iid is None:
+                        self._send(
+                            json.dumps(watchtower.incidents()).encode(),
+                            "application/json")
+                    else:
+                        match = [i for i in watchtower.incidents()
+                                 if i["id"] == iid]
+                        if not match:
+                            self._send(f"no incident {iid!r}".encode(),
+                                       "text/plain", 404)
+                        else:
+                            try:
+                                with open(match[0]["path"], "rb") as f:
+                                    body = f.read()
+                            except OSError as e:
+                                self._send(f"incident file unreadable: "
+                                           f"{e}".encode(), "text/plain",
+                                           500)
+                            else:
+                                self._send(body, "application/json")
                 else:
                     self._send(b"not found", "text/plain", 404)
 

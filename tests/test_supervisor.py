@@ -286,6 +286,38 @@ class TestPreemption:
         assert res2.status == "completed"
         assert [s for _, s in scores2.scores] == base
 
+    def test_preempt_fault_kind_delivers_real_sigterm(self, tmp_path):
+        """The faultinject "preempt" kind sends an actual SIGTERM: the
+        supervisor must turn it into a resumable preempted exit, and a
+        fresh supervisor on the same directory resumes bit-identically."""
+        base = baseline_scores()
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "train/step", "index": 7, "kind": "preempt"}]))
+        set_default_seed(42)
+        model = make_model()
+        scores = CollectScoresIterationListener()
+        model.set_listeners(scores)
+        sup = TrainingSupervisor(model, str(tmp_path),
+                                 save_every_n_iterations=100,
+                                 backoff_base_s=0.01)
+        res = sup.fit(make_it(), epochs=EPOCHS, batch_size=16,
+                      resume="never")
+        assert res.status == "preempted" and res.resumable
+        assert res.restarts == 0
+        assert res.history[0]["class"] == CLASS_PREEMPTION
+        faultinject.clear_plan()
+
+        set_default_seed(42)
+        model2 = make_model()
+        scores2 = CollectScoresIterationListener()
+        model2.set_listeners(scores2)
+        sup2 = TrainingSupervisor(model2, str(tmp_path),
+                                  save_every_n_iterations=100,
+                                  backoff_base_s=0.01)
+        res2 = sup2.fit(make_it(), epochs=EPOCHS, batch_size=16)
+        assert res2.status == "completed"
+        assert [s for _, s in scores2.scores] == base
+
 
 class TestIncarnationFence:
     def test_stale_writer_cannot_commit(self, tmp_path):

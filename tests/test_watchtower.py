@@ -1,0 +1,678 @@
+"""Watchtower tests (ISSUE 17): SLO error-budget math, multi-window
+burn-rate alerting with hysteresis, the alert → flightrec event →
+profiler ledger → Prometheus round-trip, incident assembly from a REAL
+supervised crash drill (corr-chain asserted end to end), the
+``/api/incidents`` + ``/api/trace`` HTTP surface, the
+``watchtower/evaluate`` transient fault drill, and the disabled /
+uninstalled zero-overhead paths."""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common import faultinject, flightrec, watchtower
+from deeplearning4j_tpu.common.profiler import OpProfiler
+from deeplearning4j_tpu.common.watchtower import (OK, PAGE, WARN, SLO,
+                                                  Watchtower,
+                                                  counter_increment_sampler,
+                                                  counter_ratio_sampler,
+                                                  threshold_sampler)
+from deeplearning4j_tpu.learning import Sgd
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf import layers as L
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faultinject.clear_plan()
+    flightrec.reset()
+    yield
+    watchtower.uninstall()
+    faultinject.clear_plan()
+
+
+class _Script:
+    """Sampler that replays a fixed list of readings (last one sticks)."""
+
+    def __init__(self, values):
+        self.values = list(values)
+        self.i = 0
+
+    def __call__(self):
+        v = self.values[min(self.i, len(self.values) - 1)]
+        self.i += 1
+        return v
+
+
+def _slo(name="t", sampler=None, **kw):
+    """Compressed-window SLO: seconds-scale windows so synthetic ``now``
+    ticks drive the whole state machine."""
+    base = dict(budget=0.1, fast_s=10.0, mid_s=30.0, slow_s=60.0,
+                page_burn=2.0, warn_burn=1.5, clear_ticks=2,
+                period_s=100.0)
+    base.update(kw)
+    return SLO(name, sampler or _Script([False]), **base)
+
+
+def _counter(name):
+    return OpProfiler.get().counter_value(name)
+
+
+# -------------------------------------------------------------------------
+class TestWindowMath:
+    def test_window_burn_reads_window_start_sample(self):
+        samples = [(0.0, 0.0, 0.0), (1.0, 1.0, 2.0), (2.0, 1.0, 4.0),
+                   (3.0, 3.0, 6.0)]
+        # window 2 @ now=3 -> base is the newest sample at/older than t=1
+        burn = watchtower._window_burn(samples, 3.0, 2.0, 0.1)
+        assert burn == pytest.approx(((3.0 - 1.0) / (6.0 - 2.0)) / 0.1)
+        # window older than the series -> base is the first sample
+        burn = watchtower._window_burn(samples, 3.0, 100.0, 0.1)
+        assert burn == pytest.approx((3.0 / 6.0) / 0.1)
+
+    def test_window_burn_degenerate_series(self):
+        assert watchtower._window_burn([], 0.0, 10.0, 0.1) == 0.0
+        assert watchtower._window_burn([(0, 0, 0)], 0.0, 10.0, 0.1) == 0.0
+        # no traffic in the window -> no burn (dt == 0)
+        samples = [(0.0, 1.0, 5.0), (1.0, 1.0, 5.0)]
+        assert watchtower._window_burn(samples, 1.0, 10.0, 0.1) == 0.0
+
+    def test_budget_remaining(self):
+        slo = _slo(budget=0.1, period_s=100.0)
+        st = watchtower._SloState()
+        st.samples = [(0.0, 0.0, 0.0), (50.0, 5.0, 100.0)]
+        # 5% bad against a 10% budget -> half the budget left
+        rem = Watchtower._budget_remaining(slo, st, 50.0)
+        assert rem == pytest.approx(0.5)
+        st.samples = [(0.0, 0.0, 0.0), (50.0, 50.0, 100.0)]
+        assert Watchtower._budget_remaining(slo, st, 50.0) == 0.0
+        st.samples = [(0.0, 0.0, 0.0)]
+        assert Watchtower._budget_remaining(slo, st, 0.0) == 1.0
+
+    def test_gauge_kind_accumulates_per_tick(self):
+        slo = _slo(sampler=_Script([False, True, False]))
+        t = Watchtower([slo])
+        for i in range(3):
+            r = t.evaluate_now(now=float(i))
+        # one violation out of three ticks, all inside every window
+        assert r["states"]["t"]["fast_burn"] == pytest.approx(
+            ((1.0) / 2.0) / 0.1)  # delta vs the first sample
+
+    def test_ratio_counter_reset_rebases(self):
+        slo = _slo(kind="ratio",
+                   sampler=_Script([(5, 100), (6, 110), (2, 10), (3, 20)]))
+        t = Watchtower([slo])
+        t.evaluate_now(now=0.0)
+        r = t.evaluate_now(now=1.0)
+        assert r["states"]["t"]["fast_burn"] > 0.0
+        # counters went backwards (profiler reset): series re-bases,
+        # burn falls to zero instead of going negative
+        r = t.evaluate_now(now=2.0)
+        assert r["states"]["t"]["fast_burn"] == 0.0
+        r = t.evaluate_now(now=3.0)
+        assert r["states"]["t"]["fast_burn"] == pytest.approx(
+            (1.0 / 10.0) / 0.1)
+
+    def test_sampler_exception_is_contained(self):
+        def boom():
+            raise RuntimeError("sampler broke")
+        good = _slo(name="good", sampler=_Script([True, True]))
+        bad = _slo(name="bad", sampler=boom)
+        t = Watchtower([good, bad])
+        for i in range(2):
+            r = t.evaluate_now(now=float(i))
+        # the broken sampler reads as compliant; the good one still pages
+        assert r["states"]["good"]["state"] == PAGE
+        assert r["states"]["bad"]["state"] == OK
+
+    def test_slo_validation(self):
+        with pytest.raises(ValueError, match="kind"):
+            SLO("x", _Script([0]), budget=0.1, kind="nope")
+        with pytest.raises(ValueError, match="incident"):
+            SLO("x", _Script([0]), budget=0.1, incident="maybe")
+        with pytest.raises(ValueError, match="budget"):
+            SLO("x", _Script([0]), budget=0.0)
+        with pytest.raises(ValueError, match="budget"):
+            SLO("x", _Script([0]), budget=1.5)
+        with pytest.raises(ValueError, match="duplicate"):
+            Watchtower([_slo(name="a"), _slo(name="a")])
+
+
+class TestSamplers:
+    def test_counter_ratio_sampler_sums_counters(self):
+        prof = OpProfiler.get()
+        b0, t0 = (_counter("wtst/bad"), _counter("wtst/total"))
+        s = counter_ratio_sampler(bad=("wtst/bad",), total=("wtst/total",))
+        prof.count("wtst/bad")
+        for _ in range(4):
+            prof.count("wtst/total")
+        bad, total = s()
+        assert (bad - b0, total - t0) == (1, 4)
+
+    def test_counter_increment_sampler_arms_on_first_call(self):
+        prof = OpProfiler.get()
+        prof.count("wtst/incr")       # pre-existing history
+        s = counter_increment_sampler("wtst/incr")
+        assert s() is False           # first call arms, never violates
+        assert s() is False           # no increment
+        prof.count("wtst/incr")
+        assert s() is True            # moved since last tick
+        assert s() is False           # stable again
+
+    def test_threshold_sampler(self):
+        vals = iter([None, 10.0, 99.0])
+        s = threshold_sampler(lambda: next(vals), 50.0)
+        assert s() is False           # no reading = compliant
+        assert s() is False           # under the ceiling
+        assert s() is True            # over
+
+        def boom():
+            raise RuntimeError
+        assert threshold_sampler(boom, 1.0)() is False
+
+
+# -------------------------------------------------------------------------
+class TestBurnAlerting:
+    def test_page_fires_on_sustained_violation(self):
+        t = Watchtower([_slo(sampler=_Script([True] * 10))])
+        assert t.evaluate_now(now=0.0)["states"]["t"]["state"] == OK
+        r = t.evaluate_now(now=1.0)
+        assert r["states"]["t"]["state"] == PAGE
+        assert t.alert_states() == {"t": PAGE}
+
+    def test_page_requires_fast_and_mid_windows(self):
+        # 30 clean ticks, then violations: the fast window saturates
+        # first but the mid window must ALSO burn before paging
+        script = [False] * 30 + [True] * 10
+        t = Watchtower([_slo(sampler=_Script(script), warn_burn=1e9)])
+        states = {}
+        for i in range(36):
+            states[i] = t.evaluate_now(now=float(i))["states"]["t"]
+        # fast window already >= 2x burn by t=31, mid still diluted
+        assert states[31]["fast_burn"] >= 2.0
+        assert states[31]["mid_burn"] < 2.0
+        assert states[31]["state"] == OK
+        assert states[34]["state"] == OK
+        # by t=35 six violations sit in the mid window too -> page
+        assert states[35]["mid_burn"] >= 2.0
+        assert states[35]["state"] == PAGE
+
+    def test_warn_on_mid_and_slow_without_page(self):
+        pages0 = _counter("watchtower/pages")
+        t = Watchtower([_slo(sampler=_Script([True, True, False, False]),
+                             page_burn=20.0)])
+        seen = []
+        for now in (0.0, 1.0, 15.0, 16.0):
+            seen.append(t.evaluate_now(now=now)["states"]["t"]["state"])
+        assert WARN in seen and PAGE not in seen
+        assert _counter("watchtower/pages") == pages0
+
+    def test_hysteresis_clear_needs_clean_ticks(self):
+        t = Watchtower([_slo(sampler=_Script([True, True, False]),
+                             clear_ticks=2)])
+        t.evaluate_now(now=0.0)
+        assert t.evaluate_now(now=1.0)["states"]["t"]["state"] == PAGE
+        # first clean tick: target OK but hysteresis holds the page
+        assert t.evaluate_now(now=40.0)["states"]["t"]["state"] == PAGE
+        # second consecutive clean tick clears
+        assert t.evaluate_now(now=41.0)["states"]["t"]["state"] == OK
+        evs = flightrec.events(prefix="watchtower/alert")
+        transitions = [(e["attrs"]["frm"], e["attrs"]["to"]) for e in evs]
+        assert transitions == [("ok", "page"), ("page", "ok")]
+
+    def test_no_refire_while_raised(self):
+        t = Watchtower([_slo(sampler=_Script([True] * 10))])
+        for i in range(6):
+            t.evaluate_now(now=float(i))
+        evs = flightrec.events(prefix="watchtower/alert")
+        assert len(evs) == 1 and evs[0]["attrs"]["to"] == "page"
+
+    def test_alert_event_counters_and_gauge_roundtrip(self):
+        pages0 = _counter("watchtower/pages")
+        clears0 = _counter("watchtower/clears")
+        t = Watchtower([_slo(name="rt", sampler=_Script([True, True, False]),
+                             clear_ticks=1)])
+        for now in (0.0, 1.0, 40.0):
+            t.evaluate_now(now=now)
+        assert _counter("watchtower/pages") == pages0 + 1
+        assert _counter("watchtower/clears") == clears0 + 1
+        prof = OpProfiler.get()
+        assert prof.counter_value("watchtower/alert_state/rt") == OK
+        assert "watchtower/alert_state/rt" in prof.gauge_names()
+        page_ev = [e for e in flightrec.events(prefix="watchtower/alert")
+                   if e["attrs"]["to"] == "page"][0]
+        assert page_ev["sev"] == "error"
+        assert page_ev["attrs"]["slo"] == "rt"
+        assert page_ev["attrs"]["fast_burn"] >= 2.0
+        assert 0.0 <= page_ev["attrs"]["budget_remaining"] <= 1.0
+
+
+# -------------------------------------------------------------------------
+class TestLedgerAndPrometheus:
+    def test_watchtower_ledger_rides_profiler_ledgers(self):
+        t = watchtower.install(Watchtower([_slo(name="led")]))
+        t.evaluate_now(now=0.0)
+        led = OpProfiler.get().ledger_stats()
+        assert "watchtower" in led
+        assert led["watchtower"]["slos"] == 1
+        assert led["watchtower"]["state/led"] == OK
+        assert "budget_remaining/led" in led["watchtower"]
+        watchtower.uninstall()
+        assert "watchtower" not in OpProfiler.get().ledger_stats()
+
+    def test_alert_state_in_prometheus_text(self):
+        from deeplearning4j_tpu.ui.server import prometheus_text
+
+        t = watchtower.install(
+            Watchtower([_slo(name="prom", sampler=_Script([True] * 4))]))
+        for i in range(2):
+            t.evaluate_now(now=float(i))
+        text = prometheus_text()
+        assert "# TYPE dl4j_alert_state gauge" in text
+        assert 'dl4j_alert_state{slo="prom"} 2' in text
+
+
+# -------------------------------------------------------------------------
+def _model():
+    conf = (NeuralNetConfiguration.builder().seed(5)
+            .updater(Sgd(learning_rate=0.1)).activation("tanh").list()
+            .layer(L.DenseLayer(n_out=8))
+            .layer(L.OutputLayer(n_out=2, loss="mcxent",
+                                 activation="softmax"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _it():
+    from deeplearning4j_tpu.data import NDArrayDataSetIterator
+
+    rng = np.random.RandomState(7)
+    x = rng.randn(64, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+    return NDArrayDataSetIterator(x, y, batch_size=16)
+
+
+def _seed_synthetic_incident(corr="inc1.a1"):
+    """A hand-laid fault->classify->restart->resume event chain plus the
+    supervisor-hook incident it should assemble into."""
+    fam = corr.split(".a", 1)[0]
+    flightrec.event("fault/fired", severity="error", corr=corr,
+                    site="train/step", kind="crash")
+    flightrec.event("supervisor/attempt_failed", severity="error",
+                    corr=corr, failure_class="device_failure",
+                    policy="restart")
+    flightrec.event("supervisor/restart", severity="warn", corr=corr)
+    flightrec.event("supervisor/attempt_start", severity="info",
+                    corr=f"{fam}.a2")
+    return watchtower.note_supervisor_failure(
+        "device_failure", "restart", corr=corr, error="SimulatedCrash")
+
+
+class TestIncidents:
+    def test_supervised_crash_drill_assembles_incident(self, tmp_path):
+        from deeplearning4j_tpu.parallel import TrainingSupervisor
+
+        tower = watchtower.install(
+            Watchtower([], incident_dir=str(tmp_path / "incidents")))
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "train/step", "index": 6, "kind": "crash"}]))
+        sup = TrainingSupervisor(_model(), str(tmp_path / "ckpt"),
+                                 save_every_n_iterations=4,
+                                 backoff_base_s=0.01)
+        res = sup.fit(_it(), epochs=3, resume="never")
+        assert res.status == "completed" and res.restarts == 1
+
+        # the failure classification opened EXACTLY ONE incident;
+        # the next evaluation tick finalizes it with a complete chain
+        assert len(tower.incidents()) == 1
+        tower.evaluate_now(now=0.0)
+        idx = tower.incidents()[0]
+        assert idx["kind"] == "supervisor"
+        assert idx["corr"].endswith(".a1")
+        assert idx["finalized"] and idx["resolved"]
+
+        rep = json.load(open(idx["path"]))
+        chain = rep["chain"]
+        assert rep["complete"] and chain["complete"]
+        assert chain["cause"]["name"] == "fault/fired"
+        assert chain["detection"]["name"] == "supervisor/attempt_failed"
+        assert chain["detection"]["attrs"]["failure_class"] == \
+            "device_failure"
+        assert chain["mitigation"]["name"] == "supervisor/restart"
+        assert chain["recovery"]["name"] in ("supervisor/attempt_start",
+                                             "checkpoint/restore")
+        # causal order holds in ring sequence numbers
+        seqs = [chain[k]["seq"] for k in
+                ("cause", "detection", "mitigation", "recovery")]
+        assert seqs == sorted(seqs)
+        # the blackbox the supervisor dumped is joined into the report
+        assert rep["blackbox"]["path"] == sup.blackbox_path()
+        assert len(rep["blackbox"]["tail"]) > 0
+        assert "ledgers" in rep and "watermarks" in rep and "census" in rep
+        assert any(e["name"] == "watchtower/incident"
+                   for e in flightrec.events(prefix="watchtower/"))
+
+    def test_second_fault_same_incarnation_anchors_its_own_attempt(self,
+                                                                   tmp_path):
+        tower = watchtower.install(
+            Watchtower([], incident_dir=str(tmp_path)))
+        _seed_synthetic_incident(corr="inc1.a1")
+        tower.evaluate_now(now=0.0)      # finalizes incident 1
+        # a second, distinct failure later in the SAME incarnation
+        flightrec.event("fault/fired", severity="error", corr="inc1.a3",
+                        site="train/wedge", kind="wedge")
+        flightrec.event("supervisor/watchdog_fire", severity="error",
+                        corr="inc1.a3")
+        flightrec.event("supervisor/restart", severity="warn",
+                        corr="inc1.a3")
+        watchtower.note_supervisor_failure("hang", "restart",
+                                           corr="inc1.a3")
+        incs = tower.incidents()
+        assert len(incs) == 2
+        rep = json.load(open(incs[0]["path"]))
+        # chain anchors on attempt a3's events, not a1's earlier fault
+        assert rep["chain"]["cause"]["corr"] == "inc1.a3"
+        assert rep["chain"]["cause"]["attrs"]["site"] == "train/wedge"
+        assert rep["chain"]["detection"]["name"] == \
+            "supervisor/watchdog_fire"
+
+    def test_recycled_corr_across_fresh_supervisors(self, tmp_path):
+        """Incarnation numbers are per checkpoint directory, so two FRESH
+        supervisors on fresh dirs both run as inc1.a1. The second
+        supervisor's incident must anchor its chain on its OWN events,
+        not the first drill's identically-corr'd ones -- the detection
+        scan is time-bounded to the incident's opening."""
+        tower = watchtower.install(
+            Watchtower([], incident_dir=str(tmp_path), interval_s=0.1))
+        _seed_synthetic_incident(corr="inc1.a1")
+        tower.evaluate_now(now=0.0)          # finalizes incident 1
+        # later than the detection-scan floor (max(1.0, 2*interval_s))
+        time.sleep(1.2)
+        flightrec.event("fault/fired", severity="error", corr="inc1.a1",
+                        site="device/loss", kind="device_loss")
+        flightrec.event("supervisor/attempt_failed", severity="error",
+                        corr="inc1.a1", failure_class="device_failure",
+                        policy="restart")
+        flightrec.event("supervisor/restart", severity="warn",
+                        corr="inc1.a1")
+        watchtower.note_supervisor_failure("device_failure", "restart",
+                                           corr="inc1.a1")
+        incs = tower.incidents()
+        assert len(incs) == 2
+        rep = json.load(open(incs[0]["path"]))
+        chain = rep["chain"]
+        # cause is the SECOND drill's fault, detection its own
+        # attempt_failed (a later ring seq than anything from drill 1)
+        assert chain["cause"]["attrs"]["site"] == "device/loss"
+        assert chain["detection"]["name"] == "supervisor/attempt_failed"
+        assert chain["detection"]["seq"] > chain["cause"]["seq"]
+
+    def test_alert_incident_lifecycle_completes_on_clear(self, tmp_path):
+        flightrec.event("fault/fired", severity="error",
+                        site="serving/dispatch", kind="dead_replica")
+        flightrec.event("serving/retire", severity="warn", replica=0)
+        slo = _slo(name="avail", sampler=_Script([True, True, False]),
+                   clear_ticks=2)
+        tower = watchtower.install(
+            Watchtower([slo], incident_dir=str(tmp_path)))
+        tower.evaluate_now(now=0.0)
+        tower.evaluate_now(now=1.0)          # pages -> opens the incident
+        incs = tower.incidents()
+        assert len(incs) == 1 and incs[0]["kind"] == "alert"
+        assert incs[0]["slo"] == "avail" and not incs[0]["finalized"]
+        rep = json.load(open(incs[0]["path"]))
+        assert not rep["complete"]           # recovery hasn't landed yet
+        assert rep["chain"]["detection"]["attrs"]["to"] == "page"
+        assert rep["chain"]["mitigation"]["name"] == "serving/retire"
+        # two clean ticks clear the alert; the clear event IS the
+        # recovery anchor and the incident finalizes resolved
+        tower.evaluate_now(now=100.0)
+        tower.evaluate_now(now=101.0)
+        idx = tower.incidents()[0]
+        assert idx["finalized"] and idx["resolved"]
+        rep = json.load(open(idx["path"]))
+        assert rep["complete"]
+        assert rep["chain"]["recovery"]["name"] == "watchtower/alert"
+        assert rep["chain"]["recovery"]["attrs"]["to"] == "ok"
+
+    def test_incident_dedup_and_attach(self, tmp_path):
+        tower = watchtower.install(
+            Watchtower([], incident_dir=str(tmp_path),
+                       finalize_after_s=1e9))
+        # attach with nothing open is a refusal, not an incident
+        assert tower.assemble_incident("alert", "nan page",
+                                       slo="train-nan-free",
+                                       attach_only=True) is None
+        assert tower.incidents() == []
+        watchtower.note_supervisor_failure("device_failure", "restart",
+                                           corr="inc7.a1")
+        assert len(tower.incidents()) == 1
+        # an attach-alert from a later attempt joins the same family
+        p = tower.assemble_incident("alert", "train-nan-free page",
+                                    slo="train-nan-free", corr="inc7.a2",
+                                    attach_only=True)
+        assert p == tower.incidents()[0]["path"]
+        assert len(tower.incidents()) == 1
+        rep = json.load(open(p))
+        assert any(a["slo"] == "train-nan-free" for a in rep["alerts"])
+        # same family joins; a new incarnation opens a fresh incident
+        watchtower.note_supervisor_failure("hang", "restart",
+                                           corr="inc7.a2")
+        assert len(tower.incidents()) == 1
+        watchtower.note_supervisor_failure("device_failure", "restart",
+                                           corr="inc8.a1")
+        assert len(tower.incidents()) == 2
+        # open-alert dedup by SLO name
+        tower.assemble_incident("alert", "latency page", slo="lat-gold")
+        tower.assemble_incident("alert", "latency page", slo="lat-gold")
+        assert len(tower.incidents()) == 3
+
+    def test_finalize_timeout_leaves_unresolved(self, tmp_path):
+        tower = watchtower.install(
+            Watchtower([], incident_dir=str(tmp_path),
+                       finalize_after_s=0.0))
+        # no chain events at all: the report can never complete
+        watchtower.note_supervisor_failure("mystery", "restart",
+                                           corr="inc9.a1")
+        tower.evaluate_now(now=0.0)
+        idx = tower.incidents()[0]
+        assert idx["finalized"] and not idx["resolved"]
+
+    def test_last_incident_blackbox_fallback(self, tmp_path):
+        assert watchtower.get() is None
+        assert watchtower.note_supervisor_failure("x", "restart") is None
+        bb = tmp_path / "blackbox.jsonl"
+        bb.write_text(json.dumps({"name": "fault/fired"}) + "\n" +
+                      json.dumps({"name": "supervisor/restart"}) + "\n")
+        watchtower.note_blackbox(str(bb))
+        li = watchtower.last_incident()
+        assert li["kind"] == "blackbox" and li["path"] == str(bb)
+        assert [e["name"] for e in li["tail"]] == \
+            ["fault/fired", "supervisor/restart"]
+
+
+# -------------------------------------------------------------------------
+class TestHttpSurface:
+    def test_incidents_trace_and_health_endpoints(self, tmp_path):
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        tower = watchtower.install(
+            Watchtower([], incident_dir=str(tmp_path)))
+        _seed_synthetic_incident(corr="inc1.a1")
+        tower.evaluate_now(now=0.0)
+        ui = UIServer()
+        port = ui.enable(0)
+        try:
+            def get(path):
+                return urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=15)
+
+            idx = json.load(get("/api/incidents"))
+            assert len(idx) == 1 and idx[0]["id"] == "0001"
+            assert idx[0]["finalized"]
+            rep = json.load(get(f"/api/incidents?id={idx[0]['id']}"))
+            assert rep["complete"]
+            assert rep["chain"]["mitigation"]["name"] == \
+                "supervisor/restart"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                get("/api/incidents?id=9999")
+            assert ei.value.code == 404
+
+            doc = json.load(get("/api/trace"))
+            names = {e["name"] for e in doc["traceEvents"]}
+            assert "fault/fired" in names and "watchtower/incident" in names
+            narrowed = json.load(get("/api/trace?corr=inc1.a1"))
+            rows = [e for e in narrowed["traceEvents"] if e["ph"] != "M"]
+            assert rows and all(
+                e["args"]["corr"] == "inc1.a1" for e in rows)
+
+            health = json.load(get("/api/health"))
+            li = health["last_incident"]
+            assert li["path"].endswith("incident-0001.json")
+            assert li["tail"]["complete"]
+            assert li["tail"]["chain"]["cause"]["name"] == "fault/fired"
+        finally:
+            ui.stop()
+
+    def test_chrome_trace_corr_filter_direct(self):
+        flightrec.event("fault/fired", severity="error", corr="abc")
+        flightrec.event("fault/fired", severity="error", corr="xyz")
+        doc = flightrec.chrome_trace(corr="abc")
+        rows = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+        assert len(rows) == 1 and rows[0]["args"]["corr"] == "abc"
+
+
+# -------------------------------------------------------------------------
+class TestEvaluationFaultDrill:
+    def test_transient_fault_skips_one_tick_only(self):
+        skipped0 = _counter("watchtower/skipped_evals")
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "watchtower/evaluate", "index": 1,
+              "kind": "transient"}]))
+        t = Watchtower([_slo(sampler=_Script([True] * 5))])
+        r0 = t.evaluate_now(now=0.0)
+        r1 = t.evaluate_now(now=1.0)
+        r2 = t.evaluate_now(now=2.0)
+        assert not r0["skipped"] and not r2["skipped"]
+        # the drilled tick loses its SAMPLE, never the state machine
+        assert r1["skipped"] and r1["states"] == {}
+        assert _counter("watchtower/skipped_evals") == skipped0 + 1
+        assert t.stats()["skipped_evals"] == 1
+        assert t.stats()["evaluations"] == 3
+        # the surviving two samples still drive the alert
+        assert r2["states"]["t"]["state"] == PAGE
+
+
+# -------------------------------------------------------------------------
+class TestDisabledAndFacade:
+    def test_disabled_tower_is_inert(self, tmp_path):
+        evals0 = _counter("watchtower/evaluations")
+        t = Watchtower([_slo(sampler=_Script([True] * 5))],
+                       incident_dir=str(tmp_path), enabled=False)
+        r = t.evaluate_now(now=0.0)
+        assert r["skipped"] and r["states"] == {}
+        assert _counter("watchtower/evaluations") == evals0
+        assert t.assemble_incident("alert", "x", slo="s") is None
+        assert not os.listdir(str(tmp_path))
+        # re-enable flows back to the live path
+        t.configure(enabled=True)
+        assert not t.evaluate_now(now=1.0)["skipped"]
+
+    def test_facade_is_empty_without_tower(self):
+        assert watchtower.get() is None
+        assert watchtower.stats() == {}
+        assert watchtower.alert_states() == {}
+        assert watchtower.incidents() == []
+        assert "watchtower" not in OpProfiler.get().ledger_stats()
+
+
+# -------------------------------------------------------------------------
+class TestDefaultCatalog:
+    def test_default_slos_cover_the_stock_signals(self):
+        names = {s.name for s in watchtower.default_slos()}
+        assert names == {"serving-availability", "train-nan-free",
+                         "restart-budget", "retrace-flat"}
+        by_name = {s.name: s for s in watchtower.default_slos()}
+        assert by_name["serving-availability"].kind == "ratio"
+        # supervisor-domain SLOs attach to the supervisor's incident
+        # instead of opening a duplicate per fault
+        assert by_name["restart-budget"].incident == "attach"
+        assert by_name["train-nan-free"].incident == "attach"
+
+    def test_default_slos_with_engine_and_hbm_ceiling(self):
+        class _Cls:
+            def __init__(self, name, p99):
+                self.name, self.p99_ms = name, p99
+
+        class _Eng:
+            def slo_classes(self):
+                return [_Cls("gold", 250.0), _Cls("batch", 1000.0)]
+
+            def class_recent_p99(self, name):
+                return 300.0
+
+        slos = watchtower.default_slos(engine=_Eng(),
+                                       hbm_ceiling_bytes=1e9,
+                                       fast_s=10.0, mid_s=30.0,
+                                       slow_s=60.0, period_s=100.0)
+        names = {s.name for s in slos}
+        assert {"latency-gold", "latency-batch", "hbm-ceiling"} <= names
+        # gold's rolling p99 (300ms) is over its 250ms objective ->
+        # the latency SLO pages; batch (1000ms objective) stays green
+        t = Watchtower(slos)
+        for i in range(3):
+            r = t.evaluate_now(now=float(i))
+        assert r["states"]["latency-gold"]["state"] == PAGE
+        assert r["states"]["latency-batch"]["state"] == OK
+
+
+# -------------------------------------------------------------------------
+class TestServingClassLatency:
+    def test_per_class_quantiles_surface_everywhere(self):
+        from deeplearning4j_tpu.parallel import ServingEngine, SLOClass
+        from deeplearning4j_tpu.parallel.serving import serving_health
+        from deeplearning4j_tpu.ui.server import prometheus_text
+
+        conf = (NeuralNetConfiguration.builder().seed(1)
+                .updater(Sgd(learning_rate=0.05)).activation("tanh").list()
+                .layer(L.DenseLayer(n_out=8))
+                .layer(L.OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(4)).build())
+        model = MultiLayerNetwork(conf).init()
+        eng = (ServingEngine.Builder(model).buckets((1, 2, 4))
+               .input_shape((4,)).workers(1).max_wait_ms(2.0)
+               .request_timeout_ms(15000)
+               .slo_classes([SLOClass("gold", 2, 250.0, queue_budget=64),
+                             SLOClass("batch", 0, 1000.0,
+                                      queue_budget=32)])
+               .brownout(interval_s=60.0)
+               .build())
+        try:
+            assert [c.name for c in eng.slo_classes()][0] == "gold"
+            x = np.zeros((1, 4), np.float32)
+            for _ in range(6):
+                eng.output(x, slo_class="gold")
+            for _ in range(3):
+                eng.output(x, slo_class="batch")
+            cl = eng.class_latency_stats()
+            assert 0.0 < cl["gold"]["p50_ms"] <= cl["gold"]["p99_ms"]
+            assert cl["batch"]["window"] == 3
+            assert eng.class_recent_p99("gold") > 0.0
+            # engine stats and the fleet-wide health view both carry it
+            assert "class_latency" in eng.serving_stats()
+            health = serving_health()
+            assert health["class_latency"]["gold"]["p99_ms"] > 0.0
+            # and /api/metrics exports spec-escaped per-class rows
+            text = prometheus_text()
+            assert 'dl4j_serving_latency_ms{class="gold",quantile="0.99"}' \
+                in text
+            assert 'dl4j_serving_latency_ms{class="batch",quantile="0.5"}' \
+                in text
+        finally:
+            eng.shutdown()

@@ -18,7 +18,11 @@ project-wide:
 When the scanned tree has no ``FAULT_SITES`` at all the rule only
 reports call sites as unregistered if a faultinject module IS present —
 so linting a subpackage stays quiet, while linting the real package (or
-a fixture with a mini registry) checks everything.
+a fixture with a mini registry) checks everything. Registry completeness
+additionally requires a test/bench reference corpus in sight: a subtree
+scan (even one holding a caller, like common/ with the watchtower
+evaluator's fault point) has no drill corpus and must not mass-report
+the package's other sites as dead.
 """
 
 from __future__ import annotations
@@ -107,9 +111,12 @@ class FaultSiteRegistryRule(Rule):
         # registry COMPLETENESS (every site called / documented / drilled)
         # is a whole-package property: a subtree scan that happens to
         # include faultinject.py but not the callers (e.g. linting
-        # common/ alone) must not report every site as dead. Per-call
-        # checks above still ran; completeness needs callers in scope.
-        if not seen:
+        # common/ alone — which DOES hold one caller, the watchtower
+        # evaluator's own fault point) must not report every other site
+        # as dead. Per-call checks above still ran; completeness also
+        # needs the drill corpus (tests/bench) in sight, which only the
+        # package root or a self-contained fixture has.
+        if not seen or not project.reference_texts:
             return findings
 
         docstring = ast.get_docstring(reg_mod.tree) or ""

@@ -72,6 +72,9 @@ SHARED_CLASSES: Dict[str, Dict[str, Set[str]]] = {
                                      "_old_handlers", "incarnation"}},
     "_Heartbeat": {"locks": {"_lock"}, "allow": set()},
     "_Attempt": {"locks": {"_lock"}, "allow": set()},
+    # SLO watchtower: the evaluator thread ticks while HTTP handlers,
+    # the supervisor hook and benches read alert states / open incidents
+    "Watchtower": {"locks": {"_lock"}, "allow": set()},
 }
 
 
