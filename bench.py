@@ -2087,6 +2087,338 @@ def bench_elastic_smoke(steps: int, batch: int = 64, workers: int = 4) -> dict:
     }
 
 
+_CLUSTER_TRAINER = r"""
+import io, json, os, sys, time
+import numpy as np
+from deeplearning4j_tpu.parallel import cluster
+from deeplearning4j_tpu.parallel.sharding import Zero1Plan
+from deeplearning4j_tpu.util import checkpoint as ckpt
+
+(cluster_dir, ckpt_dir, log_path, rank, world, total_iters, crash_rank,
+ crash_iter) = (sys.argv[1], sys.argv[2], sys.argv[3], int(sys.argv[4]),
+                int(sys.argv[5]), int(sys.argv[6]), int(sys.argv[7]),
+                int(sys.argv[8]))
+att = os.environ.get("DL4J_ATTEMPT", "0")
+N = 25   # odd on purpose: padding differs across worker counts
+
+rt = cluster.ClusterRuntime(cluster_dir, rank, world,
+                            heartbeat_interval_s=0.05,
+                            incarnation=int(att))
+rt.form()
+rt.dump_rank_blackbox()
+plan = Zero1Plan({"w": np.zeros(N, np.float32)}, world)
+bucket = plan.buckets[0]
+key, shard, padded = bucket.key, bucket.shard, bucket.padded
+lo, hi = rank * shard, (rank + 1) * shard
+
+params = np.linspace(-1.0, 1.0, N).astype(np.float32)
+m = np.zeros(padded, np.float32)
+start_it = 0
+last = ckpt.last_checkpoint(ckpt_dir) if os.path.isdir(ckpt_dir) else None
+if last is not None:
+    with np.load(last) as z:
+        params = z["params"]
+        start_it = int(z["iteration"])
+        stored = {"m": {key: z["m"]}}
+    # the group checkpoint's flat layout is replica-count independent:
+    # a relaunch at ANY world size reshards the stored padding to its own
+    m = np.asarray(plan.reshard_state(stored)["m"][key])
+if rank == 0:
+    os.makedirs(ckpt_dir, exist_ok=True)
+    rt.claim_commit_incarnation(ckpt_dir)
+
+for it in range(start_it + 1, total_iters + 1):
+    gp = np.zeros(padded, np.float32)
+    gp[:N] = np.float32(0.05) * params + np.float32(0.001) * np.float32(it)
+    m[lo:hi] = np.float32(0.9) * m[lo:hi] + gp[lo:hi]   # OWN shard only
+    np.save(os.path.join(cluster_dir, f"m-a{att}-{it}.r{rank}.npy"),
+            m[lo:hi])
+    rt.barrier(f"step-a{att}", gen=it, deadline_s=30.0)
+    m = np.concatenate([
+        np.load(os.path.join(cluster_dir, f"m-a{att}-{it}.r{r}.npy"))
+        for r in range(world)])
+    params = params - (np.float32(0.1) * m)[:N]
+    if rank == 0:
+        with open(log_path, "a") as f:
+            f.write(json.dumps({"iteration": it,
+                                "loss": float(np.sum(params))}) + "\n")
+    if it % 3 == 0:
+        buf = io.BytesIO()
+        np.savez(buf, params=params, m=m, iteration=np.int64(it))
+        rt.commit_group_checkpoint(ckpt_dir, f"it{it}", buf.getvalue(),
+                                   it, seq=it, barrier_deadline_s=30.0)
+    if att == "0" and rank == crash_rank and it == crash_iter:
+        rt.dump_rank_blackbox()   # the dying rank's last words
+        os._exit(1)
+"""
+
+_CLUSTER_DEAD_COORD = r"""
+import json, sys, time
+from deeplearning4j_tpu.parallel import cluster
+
+cluster_dir, port = sys.argv[1], sys.argv[2]
+rt = cluster.ClusterRuntime(cluster_dir, 1, 2,
+                            coordinator=f"127.0.0.1:{port}",
+                            init_deadline_s=4.0,
+                            init_backoff_base_s=0.1,
+                            init_backoff_max_s=0.5)
+t0 = time.monotonic()
+try:
+    rt.form()
+except cluster.ClusterInitError as e:
+    rt.shutdown()
+    print(json.dumps({"failed": True,
+                      "elapsed_s": round(time.monotonic() - t0, 2),
+                      "attempts": e.attempts, "coordinator": e.coordinator,
+                      "reported": e.reported_ranks, "msg": str(e)}))
+    sys.exit(0)
+print(json.dumps({"failed": False}))
+sys.exit(1)
+"""
+
+
+def bench_cluster_smoke(steps: int, workers: int = 3) -> dict:
+    """Hardened cluster-runtime smoke (ISSUE 18): real OS processes
+    through ``ClusterRuntime`` + elastic ``supervise_processes``.
+    Self-validating hard-fails:
+
+    - kill-a-rank-mid-epoch (full-count restart): the relaunched group
+      must resume from the group checkpoint BIT-exactly vs a fresh
+      uninterrupted N-world run, with exactly ONE finalized watchtower
+      incident whose chain cause is ``cluster/rank_lost`` naming the
+      killed rank and carrying the merged per-rank blackboxes;
+    - shrink-to-survivors: the same drill relaunched at N-1 ranks,
+      resharding the group checkpoint through ``Zero1Plan``'s
+      replica-count-independent layout, bit-exact vs a fresh (N-1) run;
+    - barrier timeout names the missing rank WITH its heartbeat
+      staleness;
+    - bring-up against a dead coordinator fails INSIDE the init
+      deadline with the full diagnosis (address, attempts, ranks that
+      reported) instead of jax's C++ ``abort()``;
+    - zero orphan processes after every drill (process-table sweep for
+      this run's unique workdir token)."""
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+
+    import jax
+
+    from deeplearning4j_tpu.common import faultinject, watchtower
+    from deeplearning4j_tpu.common.profiler import OpProfiler
+    from deeplearning4j_tpu.parallel import cluster
+    from deeplearning4j_tpu.parallel.distributed import supervise_processes
+
+    def fail(msg, **extra):
+        print(json.dumps({"error": msg, **extra}))
+        sys.exit(1)
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = {"PYTHONPATH": repo + (
+        os.pathsep + os.environ["PYTHONPATH"]
+        if os.environ.get("PYTHONPATH") else ""),
+        "JAX_PLATFORMS": "cpu"}
+    total_iters = max(9, min(30, steps))
+    crash_iter = total_iters // 2 + 1
+    prof = OpProfiler.get()
+    faultinject.clear_plan()
+    work = tempfile.mkdtemp(prefix="dl4j_cluster_smoke_")
+    script = os.path.join(work, "trainer.py")
+    with open(script, "w") as f:
+        f.write(_CLUSTER_TRAINER)
+
+    def read_log(path):
+        with open(path) as f:
+            rows = [json.loads(l) for l in f.read().splitlines()]
+        return {r["iteration"]: r["loss"] for r in rows}
+
+    def run_fresh(tag, world):
+        """An uninterrupted baseline group run."""
+        cd = os.path.join(work, f"{tag}-cd")
+        log = os.path.join(work, f"{tag}.jsonl")
+        procs = [subprocess.Popen(
+            [sys.executable, script, cd, os.path.join(work, f"{tag}-ck"),
+             log, str(r), str(world), str(total_iters), "-1", "-1"],
+            env={**os.environ, **env}) for r in range(world)]
+        for r, p in enumerate(procs):
+            if p.wait(timeout=120) != 0:
+                fail(f"baseline {tag} rank {r} failed", rc=p.returncode)
+        return read_log(log)
+
+    def run_drill(tag, world, crash_rank, shrink):
+        """Kill-a-rank-mid-epoch under a fresh watchtower; returns
+        (summary, losses, incident report)."""
+        cd = os.path.join(work, f"{tag}-cd")
+        ck = os.path.join(work, f"{tag}-ck")
+        log = os.path.join(work, f"{tag}.jsonl")
+        inc_dir = os.path.join(work, f"{tag}-inc")
+        watchtower.uninstall()
+        tower = watchtower.install(watchtower.Watchtower(
+            [], incident_dir=inc_dir, interval_s=0.05,
+            finalize_after_s=120.0))
+
+        def make_commands(w, attempt):
+            return [[sys.executable, script, cd, ck, log, str(r), str(w),
+                     str(total_iters), str(crash_rank), str(crash_iter)]
+                    for r in range(w)]
+
+        summary = supervise_processes(
+            make_commands(world, 0), env=env,
+            make_env=lambda attempt: {"DL4J_ATTEMPT": str(attempt)},
+            cluster_dir=cd, heartbeat_stale_s=15.0,
+            make_commands=make_commands if shrink else None,
+            shrink_to_survivors=shrink, min_world=world - 1,
+            max_restarts=2, backoff_base_s=0.05, kill_grace_s=3.0,
+            storm_min_uptime_s=0.0)
+        if summary["status"] != "completed":
+            fail(f"{tag}: supervised group did not complete",
+                 summary=summary)
+        if summary["restarts"] != 1 or \
+                summary["history"][0]["failed_rank"] != crash_rank:
+            fail(f"{tag}: expected exactly one restart for rank "
+                 f"{crash_rank}", summary=summary)
+        tower.evaluate_now()
+        incs = tower.incidents()
+        finalized = [i for i in incs if i.get("finalized")]
+        if len(incs) != 1 or len(finalized) != 1:
+            fail(f"{tag}: expected exactly one finalized incident",
+                 open=len(incs), finalized=len(finalized))
+        with open(finalized[0]["path"]) as f:
+            report = json.load(f)
+        chain = report["chain"]
+        if not report["complete"] or \
+                chain["cause"]["name"] != "cluster/rank_lost" or \
+                chain["cause"]["attrs"].get("rank") != crash_rank:
+            fail(f"{tag}: incident chain does not name the lost rank as "
+                 "cause", chain=chain)
+        if not report.get("attachments", {}).get("rank_blackboxes"):
+            fail(f"{tag}: merged per-rank blackboxes missing from the "
+                 "incident", attachments=list(report.get("attachments",
+                                                         {})))
+        watchtower.uninstall()
+        return summary, read_log(log), report
+
+    try:
+        t0 = time.perf_counter()
+
+        # -- drill 1: kill-a-rank, FULL-count restart, bit-exact resume
+        base_n = run_fresh("base-n", workers)
+        if sorted(base_n) != list(range(1, total_iters + 1)):
+            fail("baseline N-world run incomplete", got=len(base_n))
+        sum_full, losses_full, rep_full = run_drill(
+            "full", workers, crash_rank=1, shrink=False)
+        if sum_full["world"] != workers:
+            fail("full-count drill changed the world size",
+                 summary=sum_full)
+        if losses_full != base_n:
+            bad = next((i for i in sorted(base_n)
+                        if losses_full.get(i) != base_n[i]), None)
+            fail("full-count resume is not bit-exact vs the fresh "
+                 "N-world run", first_diff_iteration=bad)
+
+        # -- drill 2: kill-a-rank, SHRINK to survivors, bit-exact vs a
+        # fresh (N-1)-world run through the resharded flat state
+        base_n1 = run_fresh("base-n1", workers - 1)
+        sum_shr, losses_shr, rep_shr = run_drill(
+            "shrink", workers, crash_rank=workers - 1, shrink=True)
+        if sum_shr["world"] != workers - 1:
+            fail("shrink drill did not shrink the group",
+                 summary=sum_shr)
+        if losses_shr != base_n1:
+            bad = next((i for i in sorted(base_n1)
+                        if losses_shr.get(i) != base_n1[i]), None)
+            fail("shrunk resume is not bit-exact vs the fresh (N-1) "
+                 "run", first_diff_iteration=bad)
+
+        # -- drill 3: barrier timeout names the missing rank + staleness
+        bdir = os.path.join(work, "barrier-cd")
+        rt = cluster.ClusterRuntime(bdir, 0, 2)
+        with open(cluster.heartbeat_path(bdir, 1), "w") as f:
+            json.dump({"rank": 1, "pid": 0, "incarnation": 0, "seq": 1,
+                       "t_wall": time.time() - 4.0, "cadence_s": 0.25}, f)
+        try:
+            rt.barrier("smoke-fence", deadline_s=0.5)
+            fail("barrier against a missing rank did not time out")
+        except cluster.BarrierTimeout as e:
+            if e.missing != [1] or not (3.0 < (e.staleness[1] or 0) < 10.0) \
+                    or "stale" not in str(e):
+                fail("barrier timeout diagnosis incomplete",
+                     missing=e.missing, staleness=e.staleness,
+                     msg=str(e))
+
+        # -- drill 4: dead coordinator fails INSIDE the deadline with
+        # the diagnosis (subprocess: jax's client would abort() us)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()   # nobody listens here any more
+        dc = os.path.join(work, "deadcoord.py")
+        with open(dc, "w") as f:
+            f.write(_CLUSTER_DEAD_COORD)
+        p = subprocess.run(
+            [sys.executable, dc, os.path.join(work, "dead-cd"),
+             str(dead_port)],
+            env={**os.environ, **env}, capture_output=True, text=True,
+            timeout=60)
+        if p.returncode != 0:
+            fail("dead-coordinator drill did not fail cleanly",
+                 rc=p.returncode, err=p.stderr[-1500:])
+        diag = json.loads(p.stdout.strip().splitlines()[-1])
+        if not diag["failed"] or diag["elapsed_s"] > 8.0 or \
+                diag["attempts"] < 2 or \
+                f"127.0.0.1:{dead_port}" not in diag["msg"] or \
+                "ranks that reported a heartbeat" not in diag["msg"]:
+            fail("dead-coordinator diagnosis incomplete", diag=diag)
+
+        # -- drill 5: zero orphans (process-table sweep for this run's
+        # unique workdir token in any live cmdline)
+        token = os.path.basename(work)
+        orphans = []
+        for pid in os.listdir("/proc"):
+            if not pid.isdigit() or int(pid) == os.getpid():
+                continue
+            try:
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    if token.encode() in f.read():
+                        orphans.append(int(pid))
+            except OSError:
+                continue
+        if orphans:
+            fail("orphan worker processes survived the drills",
+                 pids=orphans)
+
+        wall = time.perf_counter() - t0
+        ledger = {k: prof.counter_value(k) for k in
+                  ("cluster/formed", "cluster/groups_formed",
+                   "cluster/barriers", "cluster/barrier_timeouts",
+                   "cluster/group_commits", "cluster/rank_crash",
+                   "cluster/shrinks", "supervisor/proc_restarts")}
+        # supervised iterations actually retrained across both drills
+        return {
+            "metric": "cluster_smoke",
+            "value": (2 * total_iters) / wall,
+            "unit": "supervised-iters/sec",
+            "platform": jax.devices()[0].platform,
+            "workers": workers,
+            "total_iters": total_iters,
+            "crash_iter": crash_iter,
+            "full_count_incident": rep_full["id"],
+            "shrink_incident": rep_shr["id"],
+            "dead_coordinator": {"elapsed_s": diag["elapsed_s"],
+                                 "attempts": diag["attempts"]},
+            "orphans": 0,
+            "resume_parity": "exact",
+            "cluster_ledger": ledger,
+            "data": "Zero1Plan flat-state trainer over real OS process "
+                    "groups; kill-a-rank mid-epoch healed full-count and "
+                    "shrunk-to-survivors, bit-exact vs fresh baselines",
+        }
+    finally:
+        watchtower.uninstall()
+        faultinject.clear_plan()
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def bench_pipeline_parallel_smoke(steps: int, batch: int = 64) -> dict:
     """Self-healing pipeline-parallel smoke (ISSUE 14; ROADMAP item 2):
     a 12-layer homogeneous dense stack through ``PipelineTrainer`` as
@@ -4479,6 +4811,7 @@ def main() -> None:
                                  "pipeline-smoke", "telemetry-smoke",
                                  "fault-smoke", "supervisor-smoke",
                                  "zero1-smoke", "elastic-smoke",
+                                 "cluster-smoke",
                                  "pipeline-parallel-smoke",
                                  "serving-smoke", "autoscale-smoke",
                                  "mfu-smoke", "obs-smoke", "fleet-smoke",
@@ -4626,6 +4959,8 @@ def main() -> None:
         result = bench_remat_smoke(steps, batch=args.batch or 64)
     elif args.config == "elastic-smoke":
         result = bench_elastic_smoke(steps, batch=args.batch or 64)
+    elif args.config == "cluster-smoke":
+        result = bench_cluster_smoke(steps)
     elif args.config == "pipeline-parallel-smoke":
         result = bench_pipeline_parallel_smoke(steps, batch=args.batch or 64)
     elif args.config == "serving-smoke":

@@ -120,6 +120,27 @@ watchtower/evaluate   transient               test_watchtower skipped-tick
                                               skipped, the loop carries
                                               on — alerts lose a sample,
                                               never the state machine)
+cluster/init          transient               test_cluster bring-up retry /
+                                              deadline-diagnosis drills;
+                                              cluster-smoke dead-coordinator
+                                              drill (transient = one
+                                              refused coordinator connect)
+cluster/heartbeat     slow, wedge             test_cluster stale-rank
+                                              drills; cluster-smoke (slow =
+                                              a late beat, wedge = the
+                                              heartbeat thread dies — the
+                                              rank goes stale while its
+                                              process stays alive)
+cluster/barrier       crash                   test_cluster rank-dies-at-
+                                              the-fence drill; cluster-
+                                              smoke (survivors must time
+                                              out naming THIS rank missing
+                                              with its staleness)
+cluster/commit        crash                   test_cluster torn-group-
+                                              commit drill (rank 0 dies
+                                              between the fences; the
+                                              previous generation stays
+                                              restorable)
 ====================  ======================  ==============================
 """
 
@@ -198,6 +219,20 @@ FAULT_SITES = {
     "watchtower/evaluate": {
         "kinds": ("transient",),
         "drill": "test_watchtower skipped-tick drill; soak-smoke"},
+    "cluster/init": {
+        "kinds": ("transient",),
+        "drill": "test_cluster bring-up retry/deadline drills; "
+                 "cluster-smoke dead-coordinator drill"},
+    "cluster/heartbeat": {
+        "kinds": ("slow", "wedge"),
+        "drill": "test_cluster stale-rank drills; cluster-smoke"},
+    "cluster/barrier": {
+        "kinds": ("crash",),
+        "drill": "test_cluster rank-dies-at-the-fence drill; "
+                 "cluster-smoke"},
+    "cluster/commit": {
+        "kinds": ("crash",),
+        "drill": "test_cluster torn-group-commit drill"},
 }
 
 

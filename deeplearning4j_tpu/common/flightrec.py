@@ -143,6 +143,23 @@ watchtower/alert           warn/error  SLO burn-rate alert transition
 watchtower/incident        warn/info   incident report opened (warn) or
                                        finalized (info) with id + path;
                                        test_watchtower + soak-smoke
+cluster/form               info        ClusterRuntime.form bring-up
+                                       landed (rank, world, coordinator,
+                                       attempts); test_cluster +
+                                       cluster-smoke
+cluster/barrier            error       barrier deadline expired (rank,
+                                       missing ranks, per-rank heartbeat
+                                       staleness); test_cluster +
+                                       cluster-smoke timeout drills
+cluster/rank_lost          error       supervisor classified a dead/hung
+                                       rank (rank, class, exit code) —
+                                       the incident chain's CAUSE;
+                                       test_cluster + cluster-smoke
+cluster/group_restart      warn        group restart decision (lost
+                                       rank, world_from/world_to —
+                                       shrink-to-survivors when they
+                                       differ); test_cluster +
+                                       cluster-smoke
 =========================  ==========  =================================
 
 Deliberately stdlib-only (no jax, no profiler import) so every
@@ -291,6 +308,23 @@ EVENT_SITES: Dict[str, Dict[str, str]] = {
     "watchtower/incident": {
         "desc": "incident report opened/finalized (id, reason, path)",
         "drill": "test_watchtower incident drills; soak-smoke"},
+    "cluster/form": {
+        "desc": "cluster bring-up landed (rank, world, coordinator, "
+                "attempts, incarnation)",
+        "drill": "test_cluster form drills; cluster-smoke"},
+    "cluster/barrier": {
+        "desc": "barrier deadline expired (rank, missing ranks, per-rank "
+                "heartbeat staleness)",
+        "drill": "test_cluster barrier-timeout drills; cluster-smoke"},
+    "cluster/rank_lost": {
+        "desc": "a rank classified dead/hung (rank, class, exit code) — "
+                "incident-chain cause",
+        "drill": "test_cluster exit-classification drills; cluster-smoke "
+                 "kill drill"},
+    "cluster/group_restart": {
+        "desc": "group restart decision (lost rank, world_from/world_to; "
+                "shrink-to-survivors when they differ)",
+        "drill": "test_cluster shrink drill; cluster-smoke"},
 }
 
 DEFAULT_CAPACITY = 4096

@@ -83,16 +83,18 @@ _STATE_NAMES = {OK: "ok", WARN: "warn", PAGE: "page"}
 
 # chain-derivation anchors: which registered event names can play which
 # role in a cause→detection→mitigation→recovery chain
-_CAUSE_NAMES = ("fault/fired", "tracecheck/violation")
+_CAUSE_NAMES = ("fault/fired", "tracecheck/violation", "cluster/rank_lost")
 _DETECTION_NAMES = ("watchtower/alert", "supervisor/attempt_failed",
-                    "supervisor/watchdog_fire", "supervisor/give_up")
+                    "supervisor/watchdog_fire", "supervisor/give_up",
+                    "cluster/barrier")
 _MITIGATION_NAMES = ("supervisor/restart", "supervisor/preempted",
                      "elastic/resize", "pipeline/remap",
                      "serving/rollback", "serving/retire", "serving/shed",
-                     "autoscale/scale", "fleet/cull", "fleet/nan_cull")
+                     "autoscale/scale", "fleet/cull", "fleet/nan_cull",
+                     "cluster/group_restart")
 _RECOVERY_NAMES = ("supervisor/attempt_start", "supervisor/completed",
                    "checkpoint/restore", "inference/resurrected",
-                   "serving/promote", "fleet/spawn")
+                   "serving/promote", "fleet/spawn", "cluster/form")
 
 
 # -- samplers --------------------------------------------------------------
@@ -438,10 +440,16 @@ class Watchtower:
     def assemble_incident(self, kind: str, reason: str,
                           corr: Optional[str] = None,
                           slo: Optional[str] = None,
-                          attach_only: bool = False) -> Optional[str]:
+                          attach_only: bool = False,
+                          attachments: Optional[Dict[str, Any]] = None
+                          ) -> Optional[str]:
         """Open (or join) an incident and write its report. Returns the
         report path, or None when assembly is off (no ``incident_dir``)
-        or an ``attach_only`` alert found nothing to join."""
+        or an ``attach_only`` alert found nothing to join.
+        ``attachments`` are caller-supplied payloads carried verbatim in
+        the report (the cluster supervisor attaches the merged per-rank
+        blackboxes here — one incident file tells the whole group's
+        story)."""
         if self.incident_dir is None or not self._enabled:
             return None
         if corr is None:
@@ -462,6 +470,8 @@ class Watchtower:
                 joined["alerts"].append(
                     {"kind": kind, "reason": reason, "slo": slo,
                      "corr": corr, "t": time.time()})
+                if attachments:
+                    joined.setdefault("attachments", {}).update(attachments)
                 inc = joined
             elif attach_only:
                 return None
@@ -472,6 +482,7 @@ class Watchtower:
                        "slo": slo, "corr": corr,
                        "opened_t": time.time(),
                        "opened_m": time.monotonic(),
+                       "attachments": dict(attachments or {}),
                        "alerts": [], "finalized": False, "resolved": False,
                        "path": os.path.join(self.incident_dir,
                                             f"incident-{iid}.json")}
@@ -646,6 +657,7 @@ class Watchtower:
             "resolved": inc["resolved"], "finalized": inc["finalized"],
             "complete": chain["complete"], "chain": chain,
             "alerts": list(inc["alerts"]), "events": evs,
+            "attachments": inc.get("attachments", {}),
             "blackbox": blackbox, "ledgers": ledgers,
             "watermarks": watermarks, "census": census,
         }

@@ -20,6 +20,10 @@ from .distributed import (SharedTrainingMaster, TrainingSupervisor,
                           AbandonedAttempt, ElasticResizeRequested,
                           classify_failure,
                           supervise_processes, initialize, shutdown)
+from .cluster import (ClusterRuntime, ClusterInitError, BarrierTimeout,
+                      GroupCommitError, read_heartbeats, stale_ranks,
+                      merge_rank_blackboxes,
+                      cpu_multiprocess_collectives_available)
 from .ring_attention import ring_attention, ring_self_attention
 from .sharded_embeddings import ShardedEmbedding
 from .pipeline import (HeterogeneousPipeline, PipelineParallel,

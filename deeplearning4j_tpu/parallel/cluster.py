@@ -1,0 +1,563 @@
+"""Fault-tolerant multi-process cluster runtime (control plane hardening).
+
+Reference: the dl4j-scaleout bring-up path — ``VoidConfiguration``'s
+``controllerAddress`` handshake plus the Aeron transport's heartbeat /
+dead-node detection (SURVEY.md §2.4, §5.8). Here the data plane is XLA
+collectives compiled into the step, so this module hardens only the
+CONTROL plane around ``jax.distributed``:
+
+- **bring-up with a deadline** (:meth:`ClusterRuntime.form`): bounded
+  exponential-backoff retries around ``jax.distributed.initialize``,
+  each attempt's timeout clipped to the remaining init deadline. A
+  coordinator that never answers fails with :class:`ClusterInitError`
+  naming the address, the ranks whose heartbeats DID report, and the
+  attempt/elapsed counts — never a silent hang. On the CPU backend the
+  bring-up auto-selects a cross-process collectives implementation
+  (gloo/mpi) when the installed jaxlib ships one, so a multi-process
+  CPU cluster actually computes instead of failing at the first psum.
+
+- **rank heartbeats** (:meth:`ClusterRuntime.start_heartbeat`): a
+  sidecar file per rank (``hb-rank<k>.json`` in the shared cluster
+  directory) rewritten at a fixed cadence by a daemon thread,
+  independent of collectives — a wedged rank is detectable by its
+  heartbeat age even while the survivors are blocked in a psum.
+  :func:`read_heartbeats` is the supervisor-side consumer.
+
+- **barrier with a deadline** (:meth:`ClusterRuntime.barrier`): a
+  token-file rendezvous (no collectives) that, on timeout, names
+  exactly which ranks are missing and how stale each missing rank's
+  heartbeat is, emits a ``cluster/barrier`` flight-recorder event,
+  dumps this rank's blackbox, and raises :class:`BarrierTimeout`.
+
+- **group checkpoint commit** (:meth:`ClusterRuntime
+  .commit_group_checkpoint`): pre-commit barrier → rank-0 commits
+  through the atomic ``util.checkpoint`` machinery (fenced by the
+  manifest incarnation id, so a stale incarnation's writer can never
+  tear a group commit) → post-commit publish barrier → non-zero ranks
+  verify the manifest actually names the new generation before
+  resuming.
+
+- **per-rank blackboxes** (:meth:`ClusterRuntime.dump_rank_blackbox` /
+  :func:`merge_rank_blackboxes`): each rank dumps its flight-recorder
+  ring tagged with its rank + incarnation; the supervisor process
+  joins them into one watchtower incident whose chain names the lost
+  rank as cause (see ``distributed.supervise_processes``).
+
+Everything here is shared-filesystem + stdlib: the control plane must
+keep working precisely when the collective data plane is wedged.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..common import faultinject, flightrec
+from ..common.profiler import OpProfiler
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+#: per-rank heartbeat sidecar file (in the shared cluster directory)
+HEARTBEAT_PREFIX = "hb-rank"
+#: per-rank flight-recorder dump (tagged with rank + incarnation)
+BLACKBOX_PREFIX = "blackbox-rank"
+
+
+class ClusterInitError(RuntimeError):
+    """Cluster bring-up failed inside its deadline — carries the full
+    diagnosis (coordinator address, ranks that did report a heartbeat,
+    attempts, elapsed) instead of the silent hang a raw
+    ``jax.distributed.initialize`` against a dead coordinator gives."""
+
+    def __init__(self, message: str, *, coordinator: Optional[str] = None,
+                 attempts: int = 0, elapsed_s: float = 0.0,
+                 reported_ranks: Optional[List[int]] = None):
+        super().__init__(message)
+        self.coordinator = coordinator
+        self.attempts = attempts
+        self.elapsed_s = elapsed_s
+        self.reported_ranks = list(reported_ranks or [])
+
+
+class BarrierTimeout(RuntimeError):
+    """A barrier deadline expired — names the missing ranks and each
+    one's heartbeat staleness (``None`` = no heartbeat ever seen)."""
+
+    def __init__(self, message: str, *, name: str, gen: int,
+                 missing: List[int],
+                 staleness: Dict[int, Optional[float]]):
+        super().__init__(message)
+        self.name = name
+        self.gen = gen
+        self.missing = list(missing)
+        self.staleness = dict(staleness)
+
+
+class GroupCommitError(RuntimeError):
+    """A non-zero rank could not verify the group commit it was told
+    was published — the manifest's newest intact generation does not
+    match what rank 0 was supposed to have committed."""
+
+
+# ---------------------------------------------------------------------------
+# heartbeat files (supervisor-readable without any live collective)
+# ---------------------------------------------------------------------------
+
+def heartbeat_path(cluster_dir: str, rank: int) -> str:
+    return os.path.join(cluster_dir, f"{HEARTBEAT_PREFIX}{rank}.json")
+
+
+def read_heartbeats(cluster_dir: str) -> Dict[int, Dict[str, Any]]:
+    """Every rank's last heartbeat: ``{rank: {age_s, pid, incarnation,
+    seq, t_wall}}``. Ranks that never beat are absent. Readable by the
+    supervisor (a different process) and by survivors naming a missing
+    peer — wall-clock ages, since the writers are other processes."""
+    out: Dict[int, Dict[str, Any]] = {}
+    try:
+        names = os.listdir(cluster_dir)
+    except OSError:
+        return out
+    now = time.time()
+    for f in names:
+        if not (f.startswith(HEARTBEAT_PREFIX) and f.endswith(".json")):
+            continue
+        try:
+            rank = int(f[len(HEARTBEAT_PREFIX):-len(".json")])
+            with open(os.path.join(cluster_dir, f), encoding="utf-8") as fh:
+                doc = json.load(fh)
+            doc["age_s"] = max(0.0, now - float(doc.get("t_wall", 0.0)))
+            out[rank] = doc
+        except (ValueError, OSError):
+            continue   # a beat mid-replace or a torn read: next poll wins
+    return out
+
+
+def _staleness_text(missing: List[int],
+                    staleness: Dict[int, Optional[float]]) -> str:
+    parts = []
+    for r in missing:
+        age = staleness.get(r)
+        parts.append(f"rank {r}: no heartbeat ever" if age is None
+                     else f"rank {r}: heartbeat {age:.1f}s stale")
+    return "; ".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# the per-process runtime
+# ---------------------------------------------------------------------------
+
+class ClusterRuntime:
+    """One process's membership in a multi-process cluster.
+
+    ``cluster_dir`` is the shared control-plane directory (heartbeats,
+    barrier tokens, per-rank blackboxes); ``rank``/``world`` are this
+    process's id and the group size. ``coordinator`` enables the real
+    ``jax.distributed`` bootstrap in :meth:`form`; ``None`` keeps the
+    runtime file-only (heartbeats/barriers/commits without collectives
+    — what the subprocess drills and a CPU backend without gloo use).
+    """
+
+    def __init__(self, cluster_dir: str, rank: int, world: int, *,
+                 coordinator: Optional[str] = None,
+                 heartbeat_interval_s: float = 0.25,
+                 init_deadline_s: float = 60.0,
+                 init_backoff_base_s: float = 0.25,
+                 init_backoff_max_s: float = 4.0,
+                 incarnation: int = 0,
+                 poll_s: float = 0.02):
+        self.cluster_dir = cluster_dir
+        self.rank = int(rank)
+        self.world = int(world)
+        self.coordinator = coordinator
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self.init_deadline_s = float(init_deadline_s)
+        self.init_backoff_base_s = float(init_backoff_base_s)
+        self.init_backoff_max_s = float(init_backoff_max_s)
+        self.incarnation = int(incarnation)
+        self.poll_s = float(poll_s)
+        #: checkpoint-manifest fence id for group commits (rank 0 claims
+        #: via :meth:`claim_commit_incarnation`; non-zero ranks never
+        #: write, so they carry no fence)
+        self.commit_incarnation: Optional[int] = None
+        os.makedirs(cluster_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
+        self._hb_seq = 0
+        self._commit_ordinal = 0
+        self._formed = False
+        self._form_attempts = 0
+
+    # -- heartbeats -------------------------------------------------------
+
+    def _write_beat(self) -> None:
+        with self._lock:
+            self._hb_seq += 1
+            seq = self._hb_seq
+        path = heartbeat_path(self.cluster_dir, self.rank)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        doc = {"rank": self.rank, "pid": os.getpid(),
+               "incarnation": self.incarnation, "seq": seq,
+               "t_wall": time.time(),
+               "cadence_s": self.heartbeat_interval_s}
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+
+    def start_heartbeat(self) -> None:
+        """Write one beat synchronously (this rank has now REPORTED —
+        the bring-up diagnosis counts it) and start the cadence thread.
+        Idempotent."""
+        self._write_beat()
+        with self._lock:
+            if self._hb_thread is not None and self._hb_thread.is_alive():
+                return
+            self._hb_stop.clear()
+            t = threading.Thread(target=self._beat_loop,
+                                 name=f"cluster-heartbeat-r{self.rank}",
+                                 daemon=True)
+            self._hb_thread = t
+        t.start()
+
+    def _beat_loop(self) -> None:
+        n = 0
+        while not self._hb_stop.wait(self.heartbeat_interval_s):
+            n += 1
+            # slow = a late beat (the rank looks stale until it lands);
+            # wedge = the heartbeat thread dies outright — exactly the
+            # "process alive, making no progress" hang signature the
+            # supervisor classifies as hang, not crash
+            try:
+                faultinject.fault_point("cluster/heartbeat", index=n)
+            except faultinject.WedgeReleased:
+                return   # the wedged thread is dead; the file goes stale
+            try:
+                self._write_beat()
+            except OSError:
+                logger.warning("cluster: rank %d heartbeat write failed",
+                               self.rank, exc_info=True)
+
+    def stop_heartbeat(self) -> None:
+        with self._lock:
+            t = self._hb_thread
+            self._hb_thread = None
+        self._hb_stop.set()
+        if t is not None and t.is_alive():
+            t.join(timeout=2.0)
+
+    # -- bring-up ---------------------------------------------------------
+
+    @staticmethod
+    def _probe_coordinator(coordinator: str, timeout_s: float) -> None:
+        """One bounded TCP connect to the coordinator. jax's distributed
+        client does NOT raise on a dead coordinator — its C++ layer
+        ``abort()``s the whole process once the registration deadline
+        expires — so non-zero ranks probe layer-4 reachability first and
+        turn "nobody listening" into a ConnectionError the retry loop
+        can absorb and diagnose."""
+        import socket
+
+        host, _, port = coordinator.rpartition(":")
+        with socket.create_connection((host or "127.0.0.1", int(port)),
+                                      timeout=max(0.1, min(timeout_s, 5.0))):
+            pass
+
+    @staticmethod
+    def _default_initialize(coordinator: str, world: int, rank: int,
+                            timeout_s: float) -> None:
+        """``jax.distributed.initialize`` with the attempt's timeout and
+        a CPU-backend collectives auto-select: when the platform is CPU
+        and jaxlib ships gloo, pick it — without it a multi-process CPU
+        cluster forms but cannot run a single cross-process collective.
+        Non-zero ranks probe the coordinator first (rank 0 HOSTS it, so
+        it never probes): see :meth:`_probe_coordinator`."""
+        import jax
+
+        if rank != 0:
+            ClusterRuntime._probe_coordinator(coordinator, timeout_s)
+
+        if cpu_multiprocess_collectives_available() and world > 1:
+            platforms = str(getattr(jax.config, "jax_platforms", "") or "")
+            if platforms in ("", "cpu"):
+                try:
+                    jax.config.update(
+                        "jax_cpu_collectives_implementation", "gloo")
+                except Exception:
+                    pass   # backend already initialized: keep its choice
+        jax.distributed.initialize(
+            coordinator_address=coordinator, num_processes=world,
+            process_id=rank,
+            initialization_timeout=max(1, int(timeout_s)))
+
+    def form(self, initialize_fn: Optional[Callable[..., None]] = None
+             ) -> "ClusterRuntime":
+        """Bring this rank up: start the heartbeat, then bootstrap the
+        coordination service under bounded exponential-backoff retries
+        and the init deadline. Emits ``cluster/form`` on success; raises
+        :class:`ClusterInitError` with the full diagnosis on failure —
+        never a silent hang. ``initialize_fn(coordinator, world, rank,
+        timeout_s)`` overrides the ``jax.distributed`` bootstrap (drills
+        inject refused connects through it)."""
+        prof = OpProfiler.get()
+        self.start_heartbeat()
+        t0 = time.monotonic()
+        deadline = t0 + self.init_deadline_s
+        attempts = 0
+        last_err: Optional[BaseException] = None
+        while True:
+            attempts += 1
+            remaining = deadline - time.monotonic()
+            try:
+                # transient = one refused coordinator connect (the
+                # bring-up drill): the retry loop absorbs it
+                faultinject.fault_point("cluster/init", index=attempts - 1)
+                if self.coordinator is not None:
+                    fn = initialize_fn or self._default_initialize
+                    fn(self.coordinator, self.world, self.rank,
+                       max(0.5, remaining))
+                break
+            except (faultinject.TransientFault, ConnectionError, OSError,
+                    RuntimeError) as e:
+                last_err = e
+                prof.count("cluster/init_retries")
+                elapsed = time.monotonic() - t0
+                backoff = min(
+                    self.init_backoff_base_s * (2 ** (attempts - 1)),
+                    self.init_backoff_max_s)
+                if time.monotonic() + backoff >= deadline:
+                    hb = read_heartbeats(self.cluster_dir)
+                    reported = sorted(hb)
+                    msg = (f"cluster bring-up failed on rank {self.rank}: "
+                           f"coordinator {self.coordinator!r} unreachable "
+                           f"after {attempts} attempt(s) over "
+                           f"{elapsed:.1f}s (deadline "
+                           f"{self.init_deadline_s:.1f}s); ranks that "
+                           f"reported a heartbeat: {reported}; "
+                           f"last error: {e}")
+                    prof.count("cluster/init_failures")
+                    logger.error(msg)
+                    raise ClusterInitError(
+                        msg, coordinator=self.coordinator,
+                        attempts=attempts, elapsed_s=elapsed,
+                        reported_ranks=reported) from e
+                logger.warning("cluster: rank %d bring-up attempt %d "
+                               "failed (%s); retrying in %.2fs",
+                               self.rank, attempts, e, backoff)
+                time.sleep(backoff)
+        with self._lock:
+            self._formed = True
+            self._form_attempts = attempts
+        prof.count("cluster/formed")
+        flightrec.event("cluster/form", rank=self.rank, world=self.world,
+                        coordinator=self.coordinator, attempts=attempts,
+                        incarnation=self.incarnation,
+                        elapsed_s=round(time.monotonic() - t0, 3))
+        return self
+
+    @property
+    def formed(self) -> bool:
+        with self._lock:
+            return self._formed
+
+    @property
+    def form_attempts(self) -> int:
+        with self._lock:
+            return self._form_attempts
+
+    # -- barrier ----------------------------------------------------------
+
+    def _token_path(self, name: str, gen: int, rank: int) -> str:
+        safe = "".join(c if (c.isalnum() or c in "-_.") else "_"
+                       for c in name)
+        return os.path.join(self.cluster_dir, f"bar-{safe}-{gen}.r{rank}")
+
+    def barrier(self, name: str, *, deadline_s: float = 30.0,
+                gen: int = 0) -> None:
+        """Deadline-diagnosed rendezvous over token files — independent
+        of collectives, so it works exactly when a collective would hang.
+        On timeout: emits a ``cluster/barrier`` event (missing ranks +
+        per-rank heartbeat staleness as attrs), dumps this rank's
+        blackbox next to the heartbeats, and raises
+        :class:`BarrierTimeout` whose message names every missing rank
+        with its staleness. ``gen`` disambiguates reuses of the same
+        barrier name (e.g. one per commit sequence)."""
+        prof = OpProfiler.get()
+        # crash = a rank dying exactly at the fence (the barrier drill:
+        # survivors must time out with THIS rank named missing)
+        faultinject.fault_point("cluster/barrier", index=gen)
+        token = self._token_path(name, gen, self.rank)
+        with open(token, "w", encoding="utf-8") as f:
+            f.write(str(os.getpid()))
+        t0 = time.monotonic()
+        deadline = t0 + float(deadline_s)
+        while True:
+            missing = [r for r in range(self.world)
+                       if not os.path.exists(self._token_path(name, gen, r))]
+            if not missing:
+                prof.count("cluster/barriers")
+                return
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(self.poll_s)
+        hb = read_heartbeats(self.cluster_dir)
+        staleness: Dict[int, Optional[float]] = {
+            r: (round(hb[r]["age_s"], 3) if r in hb else None)
+            for r in missing}
+        msg = (f"barrier {name!r} (gen {gen}) timed out after "
+               f"{deadline_s:.1f}s on rank {self.rank}: missing ranks "
+               f"{missing} — {_staleness_text(missing, staleness)}")
+        prof.count("cluster/barrier_timeouts")
+        flightrec.event("cluster/barrier", severity="error", rank=self.rank,
+                        barrier=name, gen=gen, missing=missing,
+                        staleness={str(r): s for r, s in staleness.items()},
+                        deadline_s=float(deadline_s))
+        self.dump_rank_blackbox()
+        logger.error(msg)
+        raise BarrierTimeout(msg, name=name, gen=gen, missing=missing,
+                             staleness=staleness)
+
+    # -- group checkpoint commit -----------------------------------------
+
+    def claim_commit_incarnation(self, ckpt_dir: str) -> int:
+        """Rank 0 claims the checkpoint directory's incarnation fence for
+        this incarnation of the group — a pre-restart writer that wakes
+        up late can then never commit over its replacement."""
+        from ..util import checkpoint as _ckpt
+
+        if self.rank != 0:
+            raise GroupCommitError(
+                f"rank {self.rank}: only rank 0 claims the commit fence")
+        self.commit_incarnation = _ckpt.claim_incarnation(ckpt_dir)
+        return self.commit_incarnation
+
+    def commit_group_checkpoint(self, ckpt_dir: str, tag: str, data: bytes,
+                                iteration: int, *, keep_last: int = 4,
+                                seq: Optional[int] = None,
+                                barrier_deadline_s: float = 30.0) -> str:
+        """The cross-process commit protocol. All ranks call it with the
+        same ``tag``: pre-commit barrier (every rank's state is at the
+        boundary) → rank 0 commits atomically under the incarnation
+        fence → publish barrier → non-zero ranks verify the manifest's
+        newest intact generation IS this commit before resuming. Returns
+        the committed path. A rank killed mid-protocol leaves the
+        previous generation restorable: the manifest only ever names
+        fully-committed files."""
+        from ..util import checkpoint as _ckpt
+
+        with self._lock:
+            self._commit_ordinal += 1
+            ordinal = self._commit_ordinal
+        gen = seq if seq is not None else iteration
+        self.barrier(f"commit-{tag}-pre", deadline_s=barrier_deadline_s,
+                     gen=gen)
+        path: Optional[str] = None
+        if self.rank == 0:
+            os.makedirs(ckpt_dir, exist_ok=True)
+            # crash = the torn-group-commit drill: rank 0 dies between
+            # the fences; survivors' publish barrier must time out and
+            # the PREVIOUS generation must stay restorable
+            faultinject.fault_point("cluster/commit", index=ordinal - 1)
+            path = _ckpt.commit_checkpoint(
+                ckpt_dir, tag, data, iteration, keep_last, seq=seq,
+                incarnation=self.commit_incarnation)
+        self.barrier(f"commit-{tag}-pub", deadline_s=barrier_deadline_s,
+                     gen=gen)
+        if self.rank != 0:
+            path = _ckpt.verify_group_commit(ckpt_dir, tag)
+            if path is None:
+                newest = _ckpt.last_checkpoint(ckpt_dir)
+                raise GroupCommitError(
+                    f"rank {self.rank}: group commit {tag!r} not intact "
+                    f"in the manifest after the publish barrier (newest "
+                    f"verified: {newest!r})")
+        OpProfiler.get().count("cluster/group_commits")
+        return path  # type: ignore[return-value]
+
+    # -- blackbox ---------------------------------------------------------
+
+    def dump_rank_blackbox(self) -> str:
+        """Dump this rank's flight-recorder ring, every row tagged with
+        the rank + incarnation, to ``blackbox-rank<k>.jsonl`` in the
+        cluster directory (atomic replace). The supervisor merges these
+        into one incident after a group failure."""
+        path = os.path.join(self.cluster_dir,
+                            f"{BLACKBOX_PREFIX}{self.rank}.jsonl")
+        rows = flightrec.get().snapshot()
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for row in rows:
+                tagged = dict(row)
+                tagged["rank"] = self.rank
+                tagged["incarnation"] = self.incarnation
+                f.write(json.dumps(tagged, default=str) + "\n")
+        os.replace(tmp, path)
+        return path
+
+    def shutdown(self) -> None:
+        """Stop the heartbeat thread (the file stays — its growing age
+        documents when this rank went quiet)."""
+        self.stop_heartbeat()
+
+
+# ---------------------------------------------------------------------------
+# supervisor-side helpers (run in the supervising process)
+# ---------------------------------------------------------------------------
+
+def cpu_multiprocess_collectives_available() -> bool:
+    """Does the installed jaxlib ship a CPU cross-process collectives
+    implementation (gloo or MPI)? Without one a multi-process CPU
+    cluster forms but every cross-process computation fails — the
+    multiprocess test probes this at collection time."""
+    try:
+        from jax._src.lib import xla_client
+
+        return (hasattr(xla_client._xla, "make_gloo_tcp_collectives")
+                or hasattr(xla_client._xla, "make_mpi_collectives"))
+    except Exception:
+        return False
+
+
+def stale_ranks(cluster_dir: str, stale_after_s: float,
+                world: Optional[int] = None) -> List[int]:
+    """Ranks whose heartbeat age exceeds ``stale_after_s`` — the
+    supervisor's hang detector (a rank can be stale while its process
+    is still alive: that is precisely what distinguishes a hang from a
+    crash). Ranks that never beat are only reported when ``world`` says
+    they should exist."""
+    hb = read_heartbeats(cluster_dir)
+    out = [r for r, doc in hb.items() if doc["age_s"] > stale_after_s]
+    if world is not None:
+        out += [r for r in range(world) if r not in hb]
+    return sorted(set(out))
+
+
+def merge_rank_blackboxes(cluster_dir: str) -> List[Dict[str, Any]]:
+    """Join every rank's dumped blackbox into one wall-clock-ordered
+    event list (rows already carry ``rank`` + ``incarnation`` tags from
+    :meth:`ClusterRuntime.dump_rank_blackbox`). The supervisor attaches
+    the merge to the incident report so one file tells the whole
+    group's story with per-rank lanes."""
+    merged: List[Dict[str, Any]] = []
+    try:
+        names = os.listdir(cluster_dir)
+    except OSError:
+        return merged
+    for f in sorted(names):
+        if not (f.startswith(BLACKBOX_PREFIX) and f.endswith(".jsonl")):
+            continue
+        try:
+            with open(os.path.join(cluster_dir, f), encoding="utf-8") as fh:
+                for line in fh:
+                    try:
+                        merged.append(json.loads(line))
+                    except ValueError:
+                        continue
+        except OSError:
+            continue
+    merged.sort(key=lambda e: (e.get("t", 0.0), e.get("rank", -1),
+                               e.get("seq", 0)))
+    return merged

@@ -67,23 +67,59 @@ MEMCENSUS_NAME = "memcensus.json"
 
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
-               process_id: Optional[int] = None) -> None:
+               process_id: Optional[int] = None, *,
+               init_deadline_s: float = 120.0,
+               cluster_dir: Optional[str] = None):
     """Bootstrap the multi-host control plane (jax coordination service).
 
     Mirrors ``jax.distributed.initialize`` with env-var fallbacks
     (DL4J_TPU_COORDINATOR / _NUM_PROCS / _PROC_ID), the analog of the
-    reference's VoidConfiguration(controllerAddress=...).
-    """
-    import jax
+    reference's VoidConfiguration(controllerAddress=...) — hardened
+    through :class:`cluster.ClusterRuntime`: bounded exponential-backoff
+    retries under ``init_deadline_s``, a rank heartbeat sidecar, and a
+    coordinator-unreachable failure that raises
+    :class:`cluster.ClusterInitError` with the full diagnosis (address,
+    ranks that did report, attempts, elapsed) instead of hanging. On the
+    CPU backend the bring-up auto-selects a cross-process collectives
+    implementation when jaxlib ships one. Returns the
+    :class:`cluster.ClusterRuntime` (barriers / group commits /
+    blackboxes), or None on the fully-auto-detected path.
 
+    ``cluster_dir`` is the shared control-plane directory (heartbeats,
+    barrier tokens); default ``$DL4J_TPU_CLUSTER_DIR``, else a tempdir
+    keyed by the coordinator address (single-host drills)."""
     coordinator_address = coordinator_address or os.environ.get("DL4J_TPU_COORDINATOR")
     if num_processes is None and "DL4J_TPU_NUM_PROCS" in os.environ:
         num_processes = int(os.environ["DL4J_TPU_NUM_PROCS"])
     if process_id is None and "DL4J_TPU_PROC_ID" in os.environ:
         process_id = int(os.environ["DL4J_TPU_PROC_ID"])
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    if coordinator_address is None or num_processes is None \
+            or process_id is None:
+        # the TPU-pod auto-detection path: jax reads the cluster env
+        # itself; no coordinator to retry against from here
+        import jax
+
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+        return None
+    from . import cluster as _cluster
+
+    if cluster_dir is None:
+        cluster_dir = os.environ.get("DL4J_TPU_CLUSTER_DIR")
+    if cluster_dir is None:
+        import tempfile
+
+        safe = "".join(c if (c.isalnum() or c in "-_.") else "_"
+                       for c in coordinator_address)
+        cluster_dir = os.path.join(tempfile.gettempdir(),
+                                   f"dl4j_cluster_{safe}")
+    rt = _cluster.ClusterRuntime(
+        cluster_dir, process_id, num_processes,
+        coordinator=coordinator_address, init_deadline_s=init_deadline_s,
+        incarnation=int(os.environ.get("DL4J_ATTEMPT", "0") or 0))
+    rt.form()
+    return rt
 
 
 def shutdown() -> None:
@@ -1190,23 +1226,53 @@ def supervise_processes(commands: List[List[str]], *,
                         poll_s: float = 0.05,
                         kill_grace_s: float = 5.0,
                         resumable_code: int =
-                        SupervisedFitResult.resumable_exit_code) -> dict:
+                        SupervisedFitResult.resumable_exit_code,
+                        cluster_dir: Optional[str] = None,
+                        heartbeat_stale_s: float = 10.0,
+                        make_commands: Optional[Callable[[int, int],
+                                                         List[List[str]]]]
+                        = None,
+                        shrink_to_survivors: bool = False,
+                        min_world: int = 1) -> dict:
     """Supervised restart loop for a synchronous SPMD process group — the
     in-framework replacement for "relaunch the same command" runbooks and
     the reference mesh's dead-node remap. All ``commands`` launch
-    together; if ANY participant exits nonzero, the survivors are
-    terminated (synchronous collectives cannot continue around a lost
-    host — SURVEY §5.8, arXiv:2004.13336) and the WHOLE group relaunches
-    after exponential backoff, resuming from its checkpoint directory.
+    together (command ``i`` is rank ``i``); if ANY participant dies, the
+    survivors are terminated cleanly (SIGTERM → ``kill_grace_s`` →
+    SIGKILL, zero orphans — synchronous collectives cannot continue
+    around a lost host; SURVEY §5.8, arXiv:2004.13336) and the group
+    relaunches after exponential backoff, resuming from its checkpoint
+    directory.
 
-    A participant exiting ``resumable_code`` (EX_TEMPFAIL, what a
-    supervised fit's preempted status maps to) ends the loop with
-    ``status="preempted"`` instead of burning restarts — the cluster
-    scheduler owns the relaunch at that point. ``make_env(attempt)``
-    layers per-attempt environment on top of ``env`` (e.g. a fault plan
-    for the first incarnation only). The restart-storm breaker trips on
-    ``storm_threshold`` consecutive groups that died within
-    ``storm_min_uptime_s``."""
+    Per-rank exit CLASSIFICATION: ``resumable_code`` (75/EX_TEMPFAIL,
+    what a supervised fit's preempted status maps to) → ``"preempted"``
+    — the loop returns ``status="preempted"`` instead of burning
+    restarts (the cluster scheduler owns the relaunch); a rank whose
+    heartbeat in ``cluster_dir`` goes staler than ``heartbeat_stale_s``
+    while its process is still alive → ``"hang"`` (the group is killed
+    and restarted — a wedged collective never exits on its own); any
+    other nonzero exit → ``"crash"``. History rows carry the class per
+    rank.
+
+    With ``cluster_dir`` set the supervisor is also the INCIDENT
+    assembler: on a lost rank it merges every rank's dumped blackbox
+    (``cluster.merge_rank_blackboxes``), emits ``cluster/rank_lost``
+    (the chain cause, ``rank`` attr) + ``cluster/group_restart``, and —
+    when a watchtower is installed — opens one incident carrying the
+    merged per-rank events; the relaunched group's fresh heartbeats emit
+    ``cluster/form`` (the chain recovery).
+
+    ELASTIC restart: with ``shrink_to_survivors=True`` and a
+    ``make_commands(world, attempt)`` factory, a crashed/hung rank
+    SHRINKS the group — the relaunch runs ``world-1`` commands (down to
+    ``min_world``) and the workers re-form the smaller mesh, resharding
+    updater state through the checkpoint's replica-count-independent
+    layout (``Zero1Plan``). Without it the full-count group relaunches.
+
+    ``make_env(attempt)`` layers per-attempt environment on top of
+    ``env`` (e.g. a fault plan for the first incarnation only). The
+    restart-storm breaker trips on ``storm_threshold`` consecutive
+    groups that died within ``storm_min_uptime_s``."""
     import subprocess
 
     prof = OpProfiler.get()
@@ -1214,42 +1280,19 @@ def supervise_processes(commands: List[List[str]], *,
     restarts = 0
     consec_fast = 0
     attempt = 0
-    while True:
-        attempt += 1
-        prof.count("supervisor/proc_attempts")
-        e = dict(os.environ)
-        e.update(env or {})
-        if make_env is not None:
-            e.update(make_env(attempt - 1) or {})
-        t0 = time.monotonic()
-        procs: List[Any] = []
-        try:
-            for c in commands:
-                procs.append(subprocess.Popen(list(c), env=e))
-        except Exception:
-            # a rank that cannot even launch must not orphan the ranks
-            # already running (they would hold the checkpoint dir)
-            for p in procs:
-                p.terminate()
-            for p in procs:
-                try:
-                    p.wait(kill_grace_s)
-                except subprocess.TimeoutExpired:
-                    p.kill()
-                    p.wait(5.0)
-            raise
-        failed_rank: Optional[int] = None
-        while True:
-            codes = [p.poll() for p in procs]
-            failed_rank = next((i for i, c in enumerate(codes)
-                                if c not in (None, 0)), None)
-            if failed_rank is not None or all(c == 0 for c in codes):
-                break
-            time.sleep(poll_s)
-        uptime = time.monotonic() - t0
-        if failed_rank is None:
-            return {"status": "completed", "attempts": attempt,
-                    "restarts": restarts, "history": history}
+    world = len(commands)
+
+    def _classify(code: Optional[int], hung: bool) -> str:
+        if hung:
+            return CLASS_HANG
+        if code == resumable_code:
+            return "preempted"
+        return "crash" if code not in (0, None) else "ok"
+
+    def _reap(procs: List[Any]) -> None:
+        """SIGTERM every survivor, grace, SIGKILL the stragglers — the
+        zero-orphans contract the cluster-smoke process-table sweep
+        asserts."""
         for p in procs:
             if p.poll() is None:
                 p.terminate()
@@ -1260,17 +1303,115 @@ def supervise_processes(commands: List[List[str]], *,
             except subprocess.TimeoutExpired:
                 p.kill()
                 p.wait(5.0)
+
+    while True:
+        attempt += 1
+        prof.count("supervisor/proc_attempts")
+        e = dict(os.environ)
+        e.update(env or {})
+        if make_env is not None:
+            e.update(make_env(attempt - 1) or {})
+        cmds = (make_commands(world, attempt - 1)
+                if make_commands is not None else commands)
+        if cluster_dir is not None:
+            # the previous incarnation's heartbeat files must not count
+            # toward (or against) the relaunched group's formation
+            from . import cluster as _cluster
+
+            for r in range(max(world, len(cmds)) + 8):
+                try:
+                    os.remove(_cluster.heartbeat_path(cluster_dir, r))
+                except OSError:
+                    pass
+        t0 = time.monotonic()
+        procs: List[Any] = []
+        try:
+            for c in cmds:
+                procs.append(subprocess.Popen(list(c), env=e))
+        except Exception:
+            # a rank that cannot even launch must not orphan the ranks
+            # already running (they would hold the checkpoint dir)
+            _reap(procs)
+            raise
+        failed_rank: Optional[int] = None
+        hung = False
+        formed_seen = cluster_dir is None
+        while True:
+            codes = [p.poll() for p in procs]
+            failed_rank = next((i for i, c in enumerate(codes)
+                                if c not in (None, 0)), None)
+            if failed_rank is not None or all(c == 0 for c in codes):
+                break
+            if cluster_dir is not None:
+                from . import cluster as _cluster
+
+                hb = _cluster.read_heartbeats(cluster_dir)
+                if not formed_seen and all(
+                        r in hb and hb[r]["age_s"] <= heartbeat_stale_s
+                        for r in range(len(procs))):
+                    formed_seen = True
+                    prof.count("cluster/groups_formed")
+                    # the supervisor's own recovery anchor: every rank
+                    # of the (re)launched group is heartbeating
+                    flightrec.event("cluster/form", rank=-1,
+                                    world=len(procs), attempts=attempt,
+                                    observer="supervisor")
+                stale = [r for r in range(len(procs))
+                         if codes[r] is None and r in hb
+                         and hb[r]["age_s"] > heartbeat_stale_s]
+                if stale:
+                    failed_rank, hung = stale[0], True
+                    break
+            time.sleep(poll_s)
+        uptime = time.monotonic() - t0
+        if failed_rank is None:
+            return {"status": "completed", "attempts": attempt,
+                    "restarts": restarts, "world": world,
+                    "history": history}
+        failed_code = procs[failed_rank].poll()
+        pre_codes = list(codes)   # the detection-time snapshot
+        _reap(procs)
         codes = [p.poll() for p in procs]
+        classes = {r: ("terminated" if pre_codes[r] is None
+                       and r != failed_rank
+                       else _classify(codes[r], hung and r == failed_rank))
+                   for r in range(len(procs))}
+        cls = classes[failed_rank]
         history.append({"attempt": attempt, "codes": codes,
-                        "failed_rank": failed_rank,
-                        "uptime_s": round(uptime, 3)})
-        logger.warning("supervise_processes: rank %d exited %s after "
-                       "%.2fs; group restart", failed_rank,
-                       codes[failed_rank], uptime)
-        if codes[failed_rank] == resumable_code:
+                        "failed_rank": failed_rank, "classes": classes,
+                        "world": world, "uptime_s": round(uptime, 3)})
+        logger.warning("supervise_processes: rank %d %s (exit %s) after "
+                       "%.2fs", failed_rank, cls,
+                       failed_code if not hung else "none/heartbeat-stale",
+                       uptime)
+        merged: List[dict] = []
+        if cluster_dir is not None:
+            from . import cluster as _cluster
+
+            merged = _cluster.merge_rank_blackboxes(cluster_dir)
+        prof.count(f"cluster/rank_{cls}")
+        flightrec.event("cluster/rank_lost", severity="error",
+                        rank=failed_rank, code=failed_code,
+                        world=world, hung=hung, **{"class": cls})
+        flightrec.event("supervisor/attempt_failed", severity="error",
+                        rank=failed_rank, error=f"rank {failed_rank} "
+                        f"{cls}", **{"class": cls})
+        if cls == "preempted":
             return {"status": "preempted", "resumable": True,
                     "attempts": attempt, "restarts": restarts,
-                    "history": history}
+                    "world": world, "history": history}
+        from ..common import watchtower as _watchtower
+
+        tower = _watchtower.get()
+        if tower is not None:
+            tower.assemble_incident(
+                "rank_lost",
+                f"rank {failed_rank} {cls} "
+                f"(exit {'heartbeat-stale' if hung else failed_code})",
+                attachments={"lost_rank": failed_rank, "class": cls,
+                             "world": world,
+                             "rank_blackboxes": merged} if merged else
+                {"lost_rank": failed_rank, "class": cls, "world": world})
         consec_fast = consec_fast + 1 if uptime < storm_min_uptime_s else 0
         if consec_fast >= storm_threshold:
             prof.count("supervisor/storm_trips")
@@ -1282,6 +1423,15 @@ def supervise_processes(commands: List[List[str]], *,
             raise RestartBudgetExceeded(
                 f"process-group restart budget ({max_restarts}) exhausted",
                 history)
+        world_to = world
+        if shrink_to_survivors and make_commands is not None \
+                and world - 1 >= min_world:
+            world_to = world - 1
+            prof.count("cluster/shrinks")
+        flightrec.event("cluster/group_restart", severity="warn",
+                        rank=failed_rank, world_from=world,
+                        world_to=world_to, attempt=attempt, **{"class": cls})
+        world = world_to
         restarts += 1
         prof.count("supervisor/proc_restarts")
         delay = min(backoff_base_s * (2 ** (restarts - 1)), backoff_max_s)

@@ -543,6 +543,21 @@ def last_checkpoint(directory: str) -> Optional[str]:
     return scan_newest_intact(directory)
 
 
+def verify_group_commit(directory: str, tag: str) -> Optional[str]:
+    """A non-zero rank's post-publish check in the cluster group-commit
+    protocol (``parallel.cluster``): the manifest must name
+    ``checkpoint_<tag>.zip`` AND its checksum must verify — only then
+    may the rank resume past the publish barrier. Returns the verified
+    path, or None (commit absent from the manifest, or torn). The
+    directory-scan fallback is deliberately NOT consulted: a group
+    commit is only published once the MANIFEST says so."""
+    name = f"checkpoint_{tag}.zip"
+    for entry in reversed(read_manifest(directory)):
+        if _entry_name(entry) == name:
+            return verify_checkpoint(directory, entry)
+    return None
+
+
 def scan_newest_intact(directory: str) -> Optional[str]:
     """Manifest-less fallback: every committed ``checkpoint_*.zip`` is
     validated (zip CRC + meta entry) and the one with the highest

@@ -1,0 +1,526 @@
+"""Hardened multi-process cluster runtime drills (PR 18).
+
+Every fault here is DETERMINISTIC — injected through common/faultinject
+at the four cluster sites (``cluster/init``, ``cluster/heartbeat``,
+``cluster/barrier``, ``cluster/commit``) or staged with real OS
+subprocesses killed/preempted on cue — and every diagnosis is asserted
+verbatim: the bring-up deadline names the coordinator and the ranks
+that did report, the barrier timeout names the missing ranks with their
+heartbeat staleness, the supervisor classifies 75 as preempted and a
+stale-heartbeat-while-alive rank as hang (not crash), a torn group
+commit leaves the previous generation restorable, and an elastic
+shrink-to-survivors relaunch resumes bit-exact against a fresh
+(N-1)-world baseline through ``Zero1Plan``'s replica-count-independent
+flat layout."""
+
+import glob
+import io
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common import faultinject, flightrec, watchtower
+from deeplearning4j_tpu.parallel import cluster
+from deeplearning4j_tpu.parallel.distributed import supervise_processes
+from deeplearning4j_tpu.util import checkpoint as ckpt_util
+
+REPO_ROOT = str(Path(__file__).resolve().parents[1])
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.clear_plan()
+    yield
+    faultinject.clear_plan()
+    faultinject.release_wedges()
+    watchtower.uninstall()
+
+
+def _plan(*specs):
+    faultinject.set_plan(faultinject.FaultPlan(list(specs)))
+
+
+def _plant_heartbeat(cluster_dir, rank, age_s=0.0):
+    """A peer rank's heartbeat file as another process would leave it."""
+    with open(cluster.heartbeat_path(str(cluster_dir), rank), "w") as f:
+        json.dump({"rank": rank, "pid": 0, "incarnation": 0, "seq": 1,
+                   "t_wall": time.time() - age_s, "cadence_s": 0.25}, f)
+
+
+def _last_event(name):
+    rows = [e for e in flightrec.get().snapshot() if e["name"] == name]
+    return rows[-1] if rows else None
+
+
+# ---------------------------------------------------------------------------
+# bring-up: bounded retries + deadline diagnosis
+# ---------------------------------------------------------------------------
+
+class TestBringUp:
+    def test_form_retries_transient_init_fault(self, tmp_path):
+        # the cluster/init drill: one refused coordinator connect, then
+        # clean — the retry loop must absorb it inside the deadline
+        _plan({"site": "cluster/init", "kind": "transient", "times": 1})
+        rt = cluster.ClusterRuntime(str(tmp_path), 0, 1,
+                                    init_backoff_base_s=0.01)
+        try:
+            rt.form()
+            assert rt.formed
+            assert rt.form_attempts == 2
+            ev = _last_event("cluster/form")
+            assert ev is not None
+            assert ev["attrs"]["rank"] == 0
+            assert ev["attrs"]["attempts"] == 2
+        finally:
+            rt.shutdown()
+
+    def test_init_deadline_failure_names_full_diagnosis(self, tmp_path):
+        def refused(coordinator, world, rank, timeout_s):
+            raise ConnectionRefusedError(f"connect to {coordinator}: "
+                                         "connection refused")
+
+        _plant_heartbeat(tmp_path, 1)   # the peer that DID come up
+        rt = cluster.ClusterRuntime(str(tmp_path), 0, 2,
+                                    coordinator="198.51.100.7:9999",
+                                    init_deadline_s=0.5,
+                                    init_backoff_base_s=0.05,
+                                    init_backoff_max_s=0.1)
+        try:
+            with pytest.raises(cluster.ClusterInitError) as ei:
+                rt.form(initialize_fn=refused)
+        finally:
+            rt.shutdown()
+        e = ei.value
+        msg = str(e)
+        # the whole diagnosis, not a silent hang: address, attempt and
+        # elapsed counts, and which ranks reported a heartbeat
+        assert "198.51.100.7:9999" in msg
+        assert "ranks that reported a heartbeat: [0, 1]" in msg
+        assert "connection refused" in msg
+        assert e.coordinator == "198.51.100.7:9999"
+        assert e.attempts >= 2
+        assert 0.0 < e.elapsed_s < 5.0
+        assert e.reported_ranks == [0, 1]
+        assert not rt.formed
+
+
+# ---------------------------------------------------------------------------
+# heartbeats + deadline-diagnosed barrier
+# ---------------------------------------------------------------------------
+
+class TestHeartbeatsAndBarrier:
+    def test_heartbeat_wedge_goes_stale_while_process_lives(self, tmp_path):
+        # the cluster/heartbeat drill: the beat thread wedges — this
+        # process is alive yet its rank reads as stale, exactly the hang
+        # signature (process up, no progress) the supervisor must not
+        # call a crash
+        _plan({"site": "cluster/heartbeat", "kind": "wedge", "index": 1,
+               "seconds": 30.0})
+        rt = cluster.ClusterRuntime(str(tmp_path), 0, 1,
+                                    heartbeat_interval_s=0.05)
+        try:
+            rt.start_heartbeat()
+            time.sleep(0.7)
+            assert cluster.stale_ranks(str(tmp_path), 0.4, world=1) == [0]
+        finally:
+            faultinject.release_wedges()
+            rt.shutdown()
+
+    def test_heartbeat_slow_beat_recovers(self, tmp_path):
+        _plan({"site": "cluster/heartbeat", "kind": "slow", "index": 1,
+               "seconds": 0.4})
+        rt = cluster.ClusterRuntime(str(tmp_path), 0, 1,
+                                    heartbeat_interval_s=0.05)
+        try:
+            rt.start_heartbeat()
+            time.sleep(0.25)
+            assert cluster.stale_ranks(str(tmp_path), 0.15, world=1) == [0]
+            time.sleep(0.5)   # the late beat lands; the rank is fresh again
+            assert cluster.stale_ranks(str(tmp_path), 0.25, world=1) == []
+        finally:
+            rt.shutdown()
+
+    def test_never_beaten_rank_needs_world_to_be_reported(self, tmp_path):
+        _plant_heartbeat(tmp_path, 0, age_s=3.0)
+        assert cluster.stale_ranks(str(tmp_path), 1.0) == [0]
+        assert cluster.stale_ranks(str(tmp_path), 1.0, world=3) == [0, 1, 2]
+
+    def test_barrier_timeout_names_missing_ranks_and_staleness(
+            self, tmp_path):
+        rt = cluster.ClusterRuntime(str(tmp_path), 0, 3)
+        _plant_heartbeat(tmp_path, 2, age_s=5.0)   # wedged peer, stale beat
+        with pytest.raises(cluster.BarrierTimeout) as ei:
+            rt.barrier("epoch-fence", deadline_s=0.3)
+        e = ei.value
+        assert e.missing == [1, 2]
+        assert e.staleness[1] is None
+        assert 4.0 < e.staleness[2] < 8.0
+        msg = str(e)
+        assert "rank 1: no heartbeat ever" in msg
+        assert "rank 2: heartbeat" in msg and "stale" in msg
+        # the error event carries the same diagnosis for the incident
+        # chain, and the rank dumped its blackbox next to the heartbeats
+        ev = _last_event("cluster/barrier")
+        assert ev["sev"] == "error"
+        assert ev["attrs"]["rank"] == 0
+        assert ev["attrs"]["missing"] == [1, 2]
+        assert os.path.exists(
+            os.path.join(str(tmp_path), "blackbox-rank0.jsonl"))
+
+    def test_barrier_crash_drill_fires_before_the_token(self, tmp_path):
+        # the cluster/barrier drill: a rank dying AT the fence must not
+        # have published its token (survivors then name it missing)
+        _plan({"site": "cluster/barrier", "kind": "crash", "mode": "raise"})
+        rt = cluster.ClusterRuntime(str(tmp_path), 0, 2)
+        with pytest.raises(faultinject.SimulatedCrash):
+            rt.barrier("epoch-fence", deadline_s=0.2)
+        assert glob.glob(os.path.join(str(tmp_path), "bar-*")) == []
+
+    def test_barrier_completes_when_all_tokens_land(self, tmp_path):
+        a = cluster.ClusterRuntime(str(tmp_path), 0, 2)
+        b = cluster.ClusterRuntime(str(tmp_path), 1, 2)
+        import threading
+
+        t = threading.Thread(
+            target=lambda: b.barrier("sync", deadline_s=5.0))
+        t.start()
+        a.barrier("sync", deadline_s=5.0)
+        t.join(5.0)
+        assert not t.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# cross-process group checkpoint commit
+# ---------------------------------------------------------------------------
+
+class TestGroupCommit:
+    def _rt(self, tmp_path, rank=0, world=1):
+        return cluster.ClusterRuntime(str(tmp_path / "cd"), rank, world)
+
+    def test_commit_publishes_a_verifiable_generation(self, tmp_path):
+        rt = self._rt(tmp_path)
+        ck = str(tmp_path / "ck")
+        os.makedirs(ck)
+        rt.claim_commit_incarnation(ck)
+        path = rt.commit_group_checkpoint(ck, "it3", b"generation-3", 3)
+        assert os.path.basename(path) == "checkpoint_it3.zip"
+        # what a non-zero rank runs after the publish barrier
+        assert ckpt_util.verify_group_commit(ck, "it3") == path
+        assert ckpt_util.verify_group_commit(ck, "it99") is None
+
+    def test_kill_during_commit_leaves_previous_generation(self, tmp_path):
+        # the cluster/commit drill: rank 0 dies between the pre-commit
+        # and publish fences on its SECOND commit — the manifest must
+        # still name generation 1 and nothing of generation 2
+        rt = self._rt(tmp_path)
+        ck = str(tmp_path / "ck")
+        os.makedirs(ck)
+        rt.claim_commit_incarnation(ck)
+        first = rt.commit_group_checkpoint(ck, "it3", b"generation-3", 3)
+        _plan({"site": "cluster/commit", "kind": "crash", "mode": "raise",
+               "index": 1})
+        with pytest.raises(faultinject.SimulatedCrash):
+            rt.commit_group_checkpoint(ck, "it6", b"generation-6", 6)
+        assert ckpt_util.verify_group_commit(ck, "it6") is None
+        assert ckpt_util.last_checkpoint(ck) == first
+        assert ckpt_util.verify_group_commit(ck, "it3") == first
+
+    def test_stale_incarnation_cannot_commit_over_replacement(self,
+                                                              tmp_path):
+        ck = str(tmp_path / "ck")
+        os.makedirs(ck)
+        old = self._rt(tmp_path)
+        old.claim_commit_incarnation(ck)
+        new = cluster.ClusterRuntime(str(tmp_path / "cd2"), 0, 1)
+        new.claim_commit_incarnation(ck)   # the restart fenced it off
+        with pytest.raises(ckpt_util.StaleIncarnationError):
+            old.commit_group_checkpoint(ck, "late", b"zombie-write", 9)
+        new.commit_group_checkpoint(ck, "it1", b"generation-1", 1)
+        assert ckpt_util.verify_group_commit(ck, "it1") is not None
+
+    def test_only_rank_zero_claims_the_fence(self, tmp_path):
+        rt = cluster.ClusterRuntime(str(tmp_path / "cd"), 1, 2)
+        with pytest.raises(cluster.GroupCommitError):
+            rt.claim_commit_incarnation(str(tmp_path / "ck"))
+
+
+# ---------------------------------------------------------------------------
+# per-rank blackboxes
+# ---------------------------------------------------------------------------
+
+class TestBlackboxes:
+    def test_merge_orders_by_wallclock_with_rank_lanes(self, tmp_path):
+        a = cluster.ClusterRuntime(str(tmp_path), 0, 2, incarnation=3)
+        b = cluster.ClusterRuntime(str(tmp_path), 1, 2, incarnation=3)
+        flightrec.event("cluster/form", rank=0, world=2)
+        a.dump_rank_blackbox()
+        b.dump_rank_blackbox()
+        merged = cluster.merge_rank_blackboxes(str(tmp_path))
+        assert merged, "blackbox merge lost every row"
+        assert {r["rank"] for r in merged} == {0, 1}
+        assert all(r["incarnation"] == 3 for r in merged)
+        ts = [r["t"] for r in merged]
+        assert ts == sorted(ts)
+
+
+# ---------------------------------------------------------------------------
+# supervisor exit-code contract (real OS processes)
+# ---------------------------------------------------------------------------
+
+_SUP_WORKER = r"""
+import os, sys, time
+from deeplearning4j_tpu.parallel import cluster
+
+cluster_dir, ckpt_dir, rank, world, mode = (
+    sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+    sys.argv[5])
+att = os.environ.get("DL4J_ATTEMPT", "0")
+rt = cluster.ClusterRuntime(cluster_dir, rank, world,
+                            heartbeat_interval_s=0.05,
+                            incarnation=int(att))
+rt.form()
+rt.dump_rank_blackbox()
+
+if mode == "preempt" and att == "0":
+    # the scheduler reclaimed rank 0's host: the GROUP commits the
+    # resumable state (every rank joins the fences), then rank 0 exits
+    # EX_TEMPFAIL — the supervisor must NOT burn a restart on it
+    if rank == 0:
+        os.makedirs(ckpt_dir, exist_ok=True)
+        rt.claim_commit_incarnation(ckpt_dir)
+    rt.commit_group_checkpoint(ckpt_dir, "evict", b"resumable-state", 1,
+                               barrier_deadline_s=20.0)
+    if rank == 0:
+        time.sleep(0.2)
+        sys.exit(75)
+if mode == "hang" and rank == world - 1 and att == "0":
+    # wedged collective: alive, beating stopped — progress is gone
+    rt.stop_heartbeat()
+    time.sleep(60)
+time.sleep(3.0 if att == "0" else 0.2)
+sys.exit(0)
+"""
+
+
+def _worker_env():
+    env = {"PYTHONPATH": REPO_ROOT + (
+        os.pathsep + os.environ["PYTHONPATH"]
+        if os.environ.get("PYTHONPATH") else ""),
+        "JAX_PLATFORMS": "cpu"}
+    return env
+
+
+def _write_worker(tmp_path, body):
+    script = tmp_path / "worker.py"
+    script.write_text(body)
+    return script
+
+
+class TestSuperviseContract:
+    def test_preempted_exit_returns_resumable_with_checkpoint(
+            self, tmp_path):
+        script = _write_worker(tmp_path, _SUP_WORKER)
+        cd, ck = str(tmp_path / "cd"), str(tmp_path / "ck")
+        cmds = [[sys.executable, str(script), cd, ck, str(r), "2",
+                 "preempt"] for r in range(2)]
+        summary = supervise_processes(
+            cmds, env=_worker_env(),
+            make_env=lambda attempt: {"DL4J_ATTEMPT": str(attempt)},
+            cluster_dir=cd, heartbeat_stale_s=10.0,
+            max_restarts=2, backoff_base_s=0.05, kill_grace_s=2.0)
+        assert summary["status"] == "preempted"
+        assert summary["resumable"] is True
+        assert summary["restarts"] == 0
+        row = summary["history"][0]
+        assert row["failed_rank"] == 0
+        assert row["classes"][0] == "preempted"
+        assert row["classes"][1] == "terminated"   # reaped survivor
+        # the state the NEXT incarnation resumes from is already durable
+        assert ckpt_util.last_checkpoint(ck) is not None
+        assert ckpt_util.verify_group_commit(ck, "evict") is not None
+
+    def test_heartbeat_stale_rank_is_hang_not_crash(self, tmp_path):
+        script = _write_worker(tmp_path, _SUP_WORKER)
+        cd = str(tmp_path / "cd")
+        tower = watchtower.install(watchtower.Watchtower(
+            [], incident_dir=str(tmp_path / "inc"), interval_s=0.05,
+            finalize_after_s=60.0))
+        cmds = [[sys.executable, str(script), cd, str(tmp_path / "ck"),
+                 str(r), "2", "hang"] for r in range(2)]
+        summary = supervise_processes(
+            cmds, env=_worker_env(),
+            make_env=lambda attempt: {"DL4J_ATTEMPT": str(attempt)},
+            cluster_dir=cd, heartbeat_stale_s=0.6,
+            max_restarts=2, backoff_base_s=0.05, kill_grace_s=2.0,
+            storm_min_uptime_s=0.0)
+        assert summary["status"] == "completed"
+        assert summary["restarts"] == 1
+        row = summary["history"][0]
+        assert row["failed_rank"] == 1
+        # alive-but-stale is a HANG: the process never exited on its own
+        assert row["classes"][1] == "hang"
+        assert "crash" not in row["classes"].values()
+        lost = _last_event("cluster/rank_lost")
+        assert lost["attrs"]["rank"] == 1
+        assert lost["attrs"]["class"] == "hang"
+        assert lost["attrs"]["hung"] is True
+        restart = _last_event("cluster/group_restart")
+        assert restart["attrs"]["world_from"] == 2
+        assert restart["attrs"]["world_to"] == 2    # no shrink requested
+        # ONE incident, chain cause names the lost rank, merged per-rank
+        # blackboxes attached, finalized once recovery (cluster/form of
+        # the relaunched group) landed
+        tower.evaluate_now()
+        incs = tower.incidents()
+        assert len(incs) == 1
+        report = json.loads(Path(incs[0]["path"]).read_text())
+        assert report["complete"] is True
+        assert report["chain"]["cause"]["name"] == "cluster/rank_lost"
+        assert report["chain"]["cause"]["attrs"]["rank"] == 1
+        assert report["chain"]["mitigation"]["name"] == \
+            "cluster/group_restart"
+        assert report["chain"]["recovery"]["name"] == "cluster/form"
+        att = report["attachments"]
+        assert att["lost_rank"] == 1 and att["class"] == "hang"
+        ranks = {r.get("rank") for r in att["rank_blackboxes"]}
+        assert 0 in ranks or 1 in ranks
+
+
+# ---------------------------------------------------------------------------
+# elastic shrink-to-survivors: bit-exact vs a fresh (N-1) run
+# ---------------------------------------------------------------------------
+
+_Z1_TRAINER = r"""
+import io, json, os, sys, time
+import numpy as np
+from deeplearning4j_tpu.parallel import cluster
+from deeplearning4j_tpu.parallel.sharding import Zero1Plan
+from deeplearning4j_tpu.util import checkpoint as ckpt
+
+(cluster_dir, ckpt_dir, log_path, rank, world, total_iters, crash_rank,
+ crash_iter) = (sys.argv[1], sys.argv[2], sys.argv[3], int(sys.argv[4]),
+                int(sys.argv[5]), int(sys.argv[6]), int(sys.argv[7]),
+                int(sys.argv[8]))
+att = os.environ.get("DL4J_ATTEMPT", "0")
+N = 25   # odd on purpose: 3-way padding (27) != 2-way padding (26)
+
+rt = cluster.ClusterRuntime(cluster_dir, rank, world,
+                            heartbeat_interval_s=0.05,
+                            incarnation=int(att))
+rt.form()
+rt.dump_rank_blackbox()
+plan = Zero1Plan({"w": np.zeros(N, np.float32)}, world)
+bucket = plan.buckets[0]
+key, shard, padded = bucket.key, bucket.shard, bucket.padded
+lo, hi = rank * shard, (rank + 1) * shard
+
+params = np.linspace(-1.0, 1.0, N).astype(np.float32)
+m = np.zeros(padded, np.float32)
+start_it = 0
+last = ckpt.last_checkpoint(ckpt_dir) if os.path.isdir(ckpt_dir) else None
+if last is not None:
+    with np.load(last) as z:
+        params = z["params"]
+        start_it = int(z["iteration"])
+        stored = {"m": {key: z["m"]}}
+    # the checkpoint's flat layout is replica-count independent: the
+    # SHRUNK world reshards the old world's padding to its own
+    m = np.asarray(plan.reshard_state(stored)["m"][key])
+if rank == 0:
+    os.makedirs(ckpt_dir, exist_ok=True)
+    rt.claim_commit_incarnation(ckpt_dir)
+
+for it in range(start_it + 1, total_iters + 1):
+    gp = np.zeros(padded, np.float32)
+    gp[:N] = np.float32(0.05) * params + np.float32(0.001) * np.float32(it)
+    m[lo:hi] = np.float32(0.9) * m[lo:hi] + gp[lo:hi]   # OWN shard only
+    mine = os.path.join(cluster_dir, f"m-a{att}-{it}.r{rank}.npy")
+    np.save(mine, m[lo:hi])
+    rt.barrier(f"step-a{att}", gen=it, deadline_s=30.0)
+    m = np.concatenate([
+        np.load(os.path.join(cluster_dir, f"m-a{att}-{it}.r{r}.npy"))
+        for r in range(world)])
+    params = params - (np.float32(0.1) * m)[:N]
+    if rank == 0:
+        with open(log_path, "a") as f:
+            f.write(json.dumps({"iteration": it,
+                                "loss": float(np.sum(params))}) + "\n")
+    if it % 3 == 0:
+        buf = io.BytesIO()
+        np.savez(buf, params=params, m=m, iteration=np.int64(it))
+        rt.commit_group_checkpoint(ckpt_dir, f"it{it}", buf.getvalue(),
+                                   it, seq=it, barrier_deadline_s=30.0)
+    if att == "0" and rank == crash_rank and it == crash_iter:
+        rt.dump_rank_blackbox()   # the dying rank's last words
+        os._exit(1)
+print("TRAINER", rank, "DONE", flush=True)
+"""
+
+
+def _run_z1_group(script, cluster_dir, ckpt_dir, log_path, world,
+                  total_iters):
+    """A fresh uninterrupted group run (the baseline)."""
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), cluster_dir, ckpt_dir, log_path,
+         str(r), str(world), str(total_iters), "-1", "-1"],
+        env={**os.environ, **_worker_env()}, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True) for r in range(world)]
+    for r, p in enumerate(procs):
+        out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, f"baseline rank {r}:\n{err[-2000:]}"
+
+
+def _loss_log(path):
+    rows = [json.loads(l) for l in Path(path).read_text().splitlines()]
+    return {r["iteration"]: r["loss"] for r in rows}
+
+
+class TestElasticShrink:
+    def test_shrink_to_survivors_resumes_bit_exact(self, tmp_path):
+        script = _write_worker(tmp_path, _Z1_TRAINER)
+        total = 12
+        # fresh (N-1)=2-world baseline, never interrupted
+        base_log = str(tmp_path / "base.jsonl")
+        _run_z1_group(script, str(tmp_path / "bcd"), str(tmp_path / "bck"),
+                      base_log, 2, total)
+        baseline = _loss_log(base_log)
+        assert sorted(baseline) == list(range(1, total + 1))
+
+        # supervised 3-world run: rank 2 crashes at iteration 5 (after
+        # the it3 commit) -> group reaped -> relaunch SHRUNK to 2 ranks
+        # which reshard the it3 state and finish
+        cd, ck = str(tmp_path / "cd"), str(tmp_path / "ck")
+        log = str(tmp_path / "sup.jsonl")
+
+        def make_commands(world, attempt):
+            return [[sys.executable, str(script), cd, ck, log, str(r),
+                     str(world), str(total), "2", "5"]
+                    for r in range(world)]
+
+        summary = supervise_processes(
+            make_commands(3, 0), env=_worker_env(),
+            make_env=lambda attempt: {"DL4J_ATTEMPT": str(attempt)},
+            cluster_dir=cd, heartbeat_stale_s=15.0,
+            make_commands=make_commands, shrink_to_survivors=True,
+            min_world=2, max_restarts=2, backoff_base_s=0.05,
+            kill_grace_s=2.0, storm_min_uptime_s=0.0)
+        assert summary["status"] == "completed"
+        assert summary["world"] == 2          # the group genuinely shrank
+        assert summary["restarts"] == 1
+        row = summary["history"][0]
+        assert row["failed_rank"] == 2
+        assert row["classes"][2] == "crash"
+        ev = _last_event("cluster/group_restart")
+        assert ev["attrs"]["world_from"] == 3
+        assert ev["attrs"]["world_to"] == 2
+        # last-occurrence per iteration: the crashed incarnation's tail
+        # past its it3 commit was retrained by the shrunk group
+        final = _loss_log(log)
+        assert sorted(final) == list(range(1, total + 1))
+        assert final == baseline   # BIT-exact, not allclose

@@ -75,6 +75,12 @@ SHARED_CLASSES: Dict[str, Dict[str, Set[str]]] = {
     # SLO watchtower: the evaluator thread ticks while HTTP handlers,
     # the supervisor hook and benches read alert states / open incidents
     "Watchtower": {"locks": {"_lock"}, "allow": set()},
+    # cluster runtime: the heartbeat daemon thread beats while the main
+    # thread forms/barriers/commits. commit_incarnation is single-writer
+    # by protocol (rank 0's main thread claims it before any commit and
+    # only that same thread reads it at commit time)
+    "ClusterRuntime": {"locks": {"_lock"},
+                       "allow": {"commit_incarnation"}},
 }
 
 
