@@ -3916,6 +3916,321 @@ def bench_soak_smoke(steps: int, batch: int = 32) -> dict:
             shutil.rmtree(d, ignore_errors=True)
 
 
+def bench_integrity_smoke(steps: int, batch: int = 64,
+                          workers: int = 4) -> dict:
+    """Silent-corruption defense smoke (ISSUE 19): the in-graph
+    replica-consistency fingerprints, the divergent-replica quarantine
+    and the checkpoint scrubber proven end to end. Self-validating
+    hard-fails:
+
+    - **fingerprint overhead <= 5%**: the uint32 bitcast fold over the
+      ZeRO-1 flat buckets plus the cross-replica majority vote, riding
+      the jitted step at the TIGHTEST cadence (``check_every=1``),
+      against the same wrapper with no IntegrityListener — interleaved
+      A/B, min over per-round on/off ratios (the shared
+      ``_ab_overhead_gate``), with ZERO retrace delta: identical warm
+      compile footprints and zero traces inside the timed
+      ``tracecheck.steady_state`` window;
+    - **clean window has zero false positives**: every A/B epoch checks
+      at cadence 1 and must never count a divergence — bitwise-identical
+      replicas are an exact invariant, not a tolerance — and the stock
+      ``replica-consistency`` SLO sampler must stay silent through it;
+    - **bitflip drill**: one ``integrity/fingerprint`` fault (``bitflip``
+      kind) on replica 1 of 4 under a TrainingSupervisor must quarantine
+      exactly that replica through the elastic shrink (no restart
+      consumed, training completes on 3 workers) and assemble exactly
+      ONE finalized watchtower incident whose chain reads cause
+      ``fault/fired`` (site ``integrity/fingerprint``, the replica
+      named) -> detection ``integrity/divergence`` -> mitigation
+      ``integrity/quarantine`` -> recovery; the SLO sampler trips;
+    - **scrub drill**: a ``checkpoint/scrub`` transient skips one entry
+      for one pass (``integrity/scrub_retries``), then the advisory
+      bitflip rots a retained zip ON DISK and the scrubber must
+      quarantine that generation in the manifest WITHOUT deleting the
+      evidence, every restore path skipping it.
+
+    Emits the ``integrity`` ledger alongside the timing."""
+    import shutil
+    import statistics as _stats
+    import tempfile
+
+    # a multi-replica mesh is the whole point: on single-device hosts
+    # (CPU build machines) request virtual CPU devices BEFORE jax loads
+    if "jax" not in sys.modules:
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    from deeplearning4j_tpu.common import (faultinject, flightrec,
+                                           integrity, tracecheck,
+                                           watchtower)
+    from deeplearning4j_tpu.common.profiler import OpProfiler
+    from deeplearning4j_tpu.data import NDArrayDataSetIterator
+    from deeplearning4j_tpu.learning import Adam
+    from deeplearning4j_tpu.ndarray.rng import set_default_seed
+    from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.optimize.listeners import CheckpointListener
+    from deeplearning4j_tpu.parallel import (ParallelWrapper,
+                                             ReduceScatterAccumulator,
+                                             TrainingSupervisor)
+    from deeplearning4j_tpu.util.checkpoint import (committed_checkpoints,
+                                                    last_checkpoint)
+
+    def fail(msg, **extra):
+        faultinject.clear_plan()
+        print(json.dumps({"error": msg, **extra}, default=str))
+        sys.exit(1)
+
+    workers = min(workers, len(jax.devices()))
+    if workers < 4:
+        fail("integrity-smoke needs >= 4 devices for an attributable "
+             "majority vote (virtual CPU device request came too late?)",
+             devices=len(jax.devices()))
+
+    rng_np = np.random.RandomState(0)
+    n = steps * batch
+    x = rng_np.randn(n, 1, 28, 28).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng_np.randint(0, 10, n)]
+
+    def make_it():
+        return NDArrayDataSetIterator(x, y, batch_size=batch)
+
+    prof = OpProfiler.get()
+    prof.reset()
+    faultinject.clear_plan()
+    flightrec.reset()
+
+    # ---- phase 1: A/B overhead at the tightest cadence ----------------
+    wrappers = {}
+    integ_lst = integrity.IntegrityListener(check_every=1)
+    for name in ("off", "on"):
+        set_default_seed(99)
+        model = _lenet_model()
+        pw = (ParallelWrapper.Builder(model).workers(workers)
+              .gradients_accumulator(ReduceScatterAccumulator()).build())
+        if name == "on":
+            pw.set_listeners(integ_lst)
+        wrappers[name] = (model, pw)
+
+    def run(name, epochs=1):
+        model, pw = wrappers[name]
+        pw.fit(make_it(), epochs=epochs, batch_size=batch)
+        float(model._score_dev)          # value fence
+
+    # compile footprint: the fold and the vote ride the SAME jitted
+    # step — ON and OFF each compile once, identically counted
+    warm = {}
+    for name in ("off", "on"):
+        prof.reset()
+        run(name)
+        warm[name] = prof.trace_counts()
+    if warm["on"] != warm["off"]:
+        fail("fingerprinting changed the compile footprint (retrace "
+             "delta)", off_traces=warm["off"], on_traces=warm["on"])
+
+    def timed_epoch(name):
+        t0 = time.perf_counter()
+        run(name)
+        return time.perf_counter() - t0
+
+    timed_epoch("on")
+    timed_epoch("off")                   # settle rounds, untimed
+    prof.reset()
+    try:
+        # the ON config drains one 4-byte verdict per dispatch by
+        # design — host syncs counted, traces policed
+        with tracecheck.steady_state("integrity-smoke timed rounds",
+                                     max_host_syncs=None):
+            overhead, times, overhead_runs = _ab_overhead_gate(
+                "integrity fingerprints", 0.05,
+                lambda: _ab_rounds(timed_epoch, rounds=6), fail)
+    except tracecheck.SteadyStateViolation as e:
+        fail("train step retraced inside a timed window — the "
+             "fingerprint fold must not destabilize shapes",
+             violation=str(e).splitlines()[0])
+    hot = prof.trace_counts()
+    if any(hot.values()):
+        fail("train step retraced inside a timed window", traces=hot)
+    t_off = _stats.median(times["off"])
+    t_on = _stats.median(times["on"])
+
+    # clean window: every timed ON epoch checked at cadence 1 — the
+    # exact-invariant gate is ZERO divergences, ever
+    clean_checks = int(prof.counter_value("integrity/checks"))
+    if not clean_checks or not integ_lst.fingerprints:
+        fail("integrity checks did not run in the ON config",
+             checks=clean_checks)
+    if prof.counter_value("integrity/divergences") or integ_lst.divergences:
+        fail("false positive: clean window counted a divergence",
+             divergences=integ_lst.divergences)
+    slo = next(s for s in watchtower.default_slos()
+               if s.name == "replica-consistency")
+    slo.sampler()                        # arming sample
+    if slo.sampler():
+        fail("replica-consistency SLO sampler tripped on a clean window")
+
+    # ---- phase 2: bitflip -> quarantine -> one finalized incident -----
+    def small_mlp():
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .updater(Adam(learning_rate=0.05)).activation("tanh")
+                .list()
+                .layer(L.DenseLayer(n_out=9))
+                .layer(L.OutputLayer(n_out=3, loss="mcxent",
+                                     activation="softmax"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def small_iter():
+        r = np.random.RandomState(7)
+        xs = r.randn(96, 4).astype(np.float32)
+        ys = np.eye(3, dtype=np.float32)[r.randint(0, 3, 96)]
+        return NDArrayDataSetIterator(xs, ys, batch_size=24, shuffle=True,
+                                      seed=3)
+
+    inc_dir = tempfile.mkdtemp(prefix="dl4j_integrity_inc_")
+    sup_dir = tempfile.mkdtemp(prefix="dl4j_integrity_sup_")
+    scrub_dir = tempfile.mkdtemp(prefix="dl4j_integrity_scrub_")
+    watchtower.uninstall()
+    tower = watchtower.install(watchtower.Watchtower(
+        [], incident_dir=inc_dir, interval_s=0.05,
+        finalize_after_s=120.0))
+    try:
+        flightrec.reset()
+        prof.reset()
+        set_default_seed(99)
+        m = small_mlp()
+        pw = (ParallelWrapper.Builder(m).workers(4)
+              .gradients_accumulator(ReduceScatterAccumulator()).build())
+        pw.set_listeners(integrity.IntegrityListener(check_every=1))
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "integrity/fingerprint", "index": 5,
+              "kind": "bitflip", "replica": 1}]))
+        sup = TrainingSupervisor(pw, checkpoint_dir=sup_dir,
+                                 elastic_grow=False)
+        res = sup.fit(small_iter, epochs=3)
+        faultinject.clear_plan()
+        if res.status != "completed" or res.restarts != 0:
+            fail("quarantine drill did not complete without a restart",
+                 result=repr(res), history=res.history)
+        if [h.get("policy") for h in res.history] \
+                != ["quarantine_and_continue"] or pw.workers_count != 3:
+            fail("divergent replica was not quarantined through the "
+                 "elastic shrink", history=res.history,
+                 workers=pw.workers_count)
+        if prof.counter_value("supervisor/quarantines") != 1 or \
+                prof.counter_value("integrity/divergences") != 1 or \
+                prof.counter_value("integrity/bitflips_injected") != 1:
+            fail("quarantine ledger mismatch",
+                 ledger=prof.integrity_stats())
+        if not slo.sampler():
+            fail("replica-consistency SLO sampler missed the divergence")
+
+        tower.evaluate_now()
+        incs = tower.incidents()
+        finalized = [i for i in incs if i.get("finalized")]
+        if len(incs) != 1 or len(finalized) != 1:
+            fail("expected exactly one finalized incident from the "
+                 "bitflip drill", open=len(incs),
+                 finalized=len(finalized))
+        with open(finalized[0]["path"]) as f:
+            report = json.load(f)
+        chain = report["chain"]
+        if not report["complete"] or \
+                chain["cause"]["name"] != "fault/fired" or \
+                chain["cause"]["attrs"].get("site") != \
+                "integrity/fingerprint" or \
+                chain["cause"]["attrs"].get("replica") != 1:
+            fail("incident chain does not name the flipped replica as "
+                 "cause", chain=chain)
+        if chain["detection"]["name"] != "integrity/divergence" or \
+                chain["mitigation"]["name"] != "integrity/quarantine":
+            fail("incident detection/mitigation anchors wrong",
+                 chain=chain)
+        incident_id = report["id"]
+
+        # ---- phase 3: checkpoint scrub drill ---------------------------
+        set_default_seed(11)
+        trainee = small_mlp()
+        cl = CheckpointListener(scrub_dir, save_every_n_iterations=2,
+                                keep_last=6)
+        trainee.set_listeners(cl)
+        trainee.fit(small_iter(), epochs=2)
+        cl.close()
+        paths = committed_checkpoints(scrub_dir)
+        if len(paths) < 2:
+            fail("scrub drill produced fewer than 2 retained "
+                 "checkpoints", n=len(paths))
+        scrub = integrity.CheckpointScrubber(scrub_dir, interval_s=60.0)
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "checkpoint/scrub", "index": 0,
+              "kind": "transient"}]))
+        s1 = scrub.scrub_now()
+        if s1["skipped"] < 1 or \
+                prof.counter_value("integrity/scrub_retries") != 1:
+            fail("transient scrub fault did not skip-and-retry",
+                 summary=s1)
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "checkpoint/scrub", "index": len(paths),
+              "kind": "bitflip", "offset": 300, "bit": 2}]))
+        s2 = scrub.scrub_now()
+        faultinject.clear_plan()
+        if s2["quarantined"] != 1 or scrub.passes != 2:
+            fail("advisory bitflip did not quarantine the rotten "
+                 "generation", summary=s2, passes=scrub.passes)
+        q = flightrec.events("integrity/quarantine")[-1]
+        rotten = q["attrs"].get("file")
+        if not rotten or not os.path.exists(
+                os.path.join(scrub_dir, rotten)):
+            fail("quarantined checkpoint was deleted — evidence must "
+                 "be retained", file=rotten)
+        lc = last_checkpoint(scrub_dir)
+        if lc is not None and os.path.basename(lc) == rotten:
+            fail("restore path did not skip the quarantined generation",
+                 restored=lc)
+        if prof.counter_value("integrity/quarantined_checkpoints") != 1:
+            fail("quarantined-checkpoint counter mismatch",
+                 ledger=prof.integrity_stats())
+
+        ledger = prof.integrity_stats()
+        return {
+            "metric": "integrity_smoke",
+            "value": n / t_on,
+            "unit": "images/sec",
+            "batch": batch,
+            "workers": workers,
+            "platform": jax.devices()[0].platform,
+            "check_every": 1,
+            "traces": warm["on"],
+            "fingerprint_overhead_frac": round(overhead, 4),
+            "overhead_runs": overhead_runs,
+            "epoch_s_off_median": round(t_off, 4),
+            "epoch_s_on_median": round(t_on, 4),
+            "clean_checks": clean_checks,
+            "false_positives": 0,
+            "quarantine_incident": incident_id,
+            "quarantined_replica": 1,
+            "workers_after_quarantine": pw.workers_count,
+            "scrub": {"passes": scrub.passes, "quarantined_file": rotten,
+                      "retries": 1},
+            "integrity_ledger": {k: (round(v, 5) if isinstance(v, float)
+                                     else v) for k, v in ledger.items()},
+            "data": "LeNet A/B epochs with the in-graph fingerprint "
+                    "fold at check_every=1 vs no listener; one injected "
+                    "bitflip quarantined through the elastic shrink "
+                    "with a finalized incident naming the replica; one "
+                    "rotten retained zip quarantined by the scrubber",
+        }
+    finally:
+        faultinject.clear_plan()
+        watchtower.uninstall()
+        for d in (inc_dir, sup_dir, scrub_dir):
+            shutil.rmtree(d, ignore_errors=True)
+
+
 def bench_obs_smoke(steps: int, batch: int = 64) -> dict:
     """CPU-friendly smoke of the observability layer (ISSUE 10). Three
     self-validating phases, every gate a hard fail:
@@ -4781,7 +5096,7 @@ def main() -> None:
     # just below does). The flag only affects the host platform —
     # harmless on TPU runs.
     if ({"zero1-smoke", "elastic-smoke", "pipeline-parallel-smoke",
-         "soak-smoke"}
+         "soak-smoke", "integrity-smoke"}
             & set(sys.argv)) and "jax" not in sys.modules:
         _flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in _flags:
@@ -4816,7 +5131,7 @@ def main() -> None:
                                  "serving-smoke", "autoscale-smoke",
                                  "mfu-smoke", "obs-smoke", "fleet-smoke",
                                  "xprof-smoke", "remat-smoke",
-                                 "soak-smoke"])
+                                 "soak-smoke", "integrity-smoke"])
     parser.add_argument("--steps", type=int, default=None)
     parser.add_argument("--batch", type=int, default=None,
                         help="per-config default: resnet50=128, bert=32")
@@ -4969,6 +5284,8 @@ def main() -> None:
         result = bench_autoscale_smoke(steps, batch=args.batch or 32)
     elif args.config == "soak-smoke":
         result = bench_soak_smoke(steps, batch=args.batch or 32)
+    elif args.config == "integrity-smoke":
+        result = bench_integrity_smoke(steps, batch=args.batch or 64)
     elif args.config == "obs-smoke":
         result = bench_obs_smoke(steps, batch=args.batch or 64)
     elif args.config == "fleet-smoke":

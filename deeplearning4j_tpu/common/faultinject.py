@@ -27,8 +27,9 @@ them a *deterministic, step-indexed* event:
   place. Raising kinds (``transient``, ``crash``, ``dead_replica``) raise
   there; ``slow`` sleeps in place; ``preempt`` delivers a real SIGTERM to
   this process (the supervisor's handler turns it into a resumable exit
-  at the next step boundary); advisory kinds (``nan``) are returned
-  for the caller to apply (e.g. poison the batch it is about to bind).
+  at the next step boundary); advisory kinds (``nan``, ``bitflip``) are
+  returned for the caller to apply (poison the batch it is about to
+  bind; flip one mantissa bit of one replica's stored param copy).
 - Plans come from code (:func:`set_plan` — tests) or the environment
   (``DL4J_TPU_FAULT_PLAN`` = inline JSON or ``@/path/to/plan.json`` —
   subprocess kill tests), so a hard-killed worker can be relaunched with
@@ -141,6 +142,25 @@ cluster/commit        crash                   test_cluster torn-group-
                                               between the fences; the
                                               previous generation stays
                                               restorable)
+integrity/fingerprint bitflip                 test_integrity bitflip-
+                                              detection / quarantine
+                                              drills; integrity-smoke
+                                              (``bitflip`` flips one
+                                              mantissa bit of ONE
+                                              replica's stored param copy
+                                              between dispatches — spec
+                                              fields ``replica``,
+                                              ``tensor``, ``bit``,
+                                              ``offset``; the in-graph
+                                              fingerprint must catch it)
+checkpoint/scrub      transient, bitflip      test_integrity scrubber
+                                              drills; integrity-smoke
+                                              scrub drill (``bitflip``
+                                              rots a byte of the retained
+                                              zip on disk before hashing;
+                                              ``transient`` skips that
+                                              entry this pass — next pass
+                                              covers it)
 ====================  ======================  ==============================
 """
 
@@ -233,6 +253,14 @@ FAULT_SITES = {
     "cluster/commit": {
         "kinds": ("crash",),
         "drill": "test_cluster torn-group-commit drill"},
+    "integrity/fingerprint": {
+        "kinds": ("bitflip",),
+        "drill": "test_integrity bitflip-detection/quarantine drills; "
+                 "integrity-smoke"},
+    "checkpoint/scrub": {
+        "kinds": ("transient", "bitflip"),
+        "drill": "test_integrity scrubber drills; integrity-smoke "
+                 "scrub drill"},
 }
 
 
@@ -413,9 +441,13 @@ def fault_point(site: str, index: Optional[int] = None) -> List[Dict[str, Any]]:
         kind = spec["kind"]
         prof.count(f"faults/{site}/{kind}")
         # timeline entry BEFORE the fault acts: a crash/wedge that
-        # unwinds from here is already on the record for the black box
+        # unwinds from here is already on the record for the black box.
+        # A replica-addressed spec (bitflip, device_loss) stamps the
+        # replica on the event — the incident chain's cause anchor then
+        # NAMES the corrupted replica, not just the site.
+        extra = ({"replica": spec["replica"]} if "replica" in spec else {})
         flightrec.event("fault/fired", severity="warn", site=site,
-                        kind=kind, index=index)
+                        kind=kind, index=index, **extra)
         logger.warning("faultinject: firing %s at %s[%s]", kind, site, index)
         if kind == "slow":
             time.sleep(float(spec.get("seconds", 0.1)))

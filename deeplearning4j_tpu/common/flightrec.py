@@ -160,6 +160,24 @@ cluster/group_restart      warn        group restart decision (lost
                                        shrink-to-survivors when they
                                        differ); test_cluster +
                                        cluster-smoke
+integrity/fingerprint      info        replica-consistency check window
+                                       drained (iteration, fp, running
+                                       check count); test_integrity +
+                                       integrity-smoke
+integrity/divergence       error       in-graph fingerprint divergence
+                                       (iteration, replica, fp) — a
+                                       DETECTION anchor for the
+                                       incident chain; test_integrity +
+                                       integrity-smoke bitflip drills
+integrity/scrub            info        checkpoint scrub pass summary
+                                       (scanned/verified/quarantined/
+                                       skipped); test_integrity +
+                                       integrity-smoke scrub drill
+integrity/quarantine       warn        divergent replica or rotten
+                                       checkpoint generation
+                                       quarantined (replica or file +
+                                       reason) — a MITIGATION anchor;
+                                       test_integrity + integrity-smoke
 =========================  ==========  =================================
 
 Deliberately stdlib-only (no jax, no profiler import) so every
@@ -325,6 +343,24 @@ EVENT_SITES: Dict[str, Dict[str, str]] = {
         "desc": "group restart decision (lost rank, world_from/world_to; "
                 "shrink-to-survivors when they differ)",
         "drill": "test_cluster shrink drill; cluster-smoke"},
+    "integrity/fingerprint": {
+        "desc": "replica-consistency check window drained (iteration, "
+                "fp, running check count)",
+        "drill": "test_integrity fingerprint drills; integrity-smoke"},
+    "integrity/divergence": {
+        "desc": "in-graph fingerprint divergence (iteration, replica, "
+                "fp) — detection anchor for the incident chain",
+        "drill": "test_integrity bitflip drills; integrity-smoke"},
+    "integrity/scrub": {
+        "desc": "checkpoint scrub pass summary "
+                "(scanned/verified/quarantined/skipped)",
+        "drill": "test_integrity scrubber drills; integrity-smoke "
+                 "scrub drill"},
+    "integrity/quarantine": {
+        "desc": "divergent replica or rotten checkpoint generation "
+                "quarantined (replica or file + reason) — mitigation "
+                "anchor",
+        "drill": "test_integrity quarantine drills; integrity-smoke"},
 }
 
 DEFAULT_CAPACITY = 4096

@@ -1,0 +1,528 @@
+"""Silent-corruption defense: replica fingerprints + checkpoint scrubbing.
+
+Every fault the platform survives elsewhere is *loud* — crashes, hangs,
+NaN storms, dead coordinators. The failures this module polices are
+*silent*: a flaky core computes wrong bits, a replica desyncs after an
+elastic event, a retained checkpoint rots on disk — and training keeps
+running on poisoned state with every SLO green. Data-parallel training
+gives an exact, free invariant to enforce: **replicated state must be
+bitwise-identical across replicas**, and under ZeRO-1 the all_gather'd
+tiles must reconstruct one consistent model (the replicated-weight-update
+contract of automatic cross-replica sharding, PAPERS.md). At param scale
+a per-element host comparison is unaffordable, so verification happens
+*in-graph* — the same fraction-of-peak argument as the TPU
+distributed-linear-algebra work (arXiv:2112.09017).
+
+Four pieces:
+
+- **In-graph fingerprints** (:func:`fingerprint_tree` /
+  :func:`fingerprint_flats`): every leaf is bitcast to uint32 words and
+  folded with two commutative reductions — a wrapping sum and an xor —
+  combined as ``sum * 2654435761 ^ xor``. Commutativity makes the fold
+  *layout-invariant*: the dense tree fold and the Zero1Plan flat-bucket
+  fold (restricted to each bucket's unpadded ``[:total]`` prefix, so
+  shard padding for different worker counts never leaks in) produce the
+  same word for the same params. The wrapper computes the fold under a
+  ``lax.cond`` every ``check_every`` steps (one O(params) read, no dense
+  materialization on the ZeRO-1 path — it rides the existing flat
+  buckets), all_gathers the 4-byte digest across the data axis and
+  majority-votes the verdict in-graph (:func:`replica_verdict`). The
+  result lands in the telemetry aux: zero extra host syncs, zero
+  retraces — the check is a cond arm like the fleet alive-mask.
+
+- **Detection → quarantine** (:class:`IntegrityListener` +
+  :class:`ReplicaCorruptionError`): the listener drains the aux with one
+  batched readback per dispatch window that contains a checked step and
+  raises on divergence, naming the minority replica. The supervisor
+  classifies it ``silent_corruption`` and quarantines via the existing
+  ``resize(lost_replicas=[k])`` shrink — majority-consistent state is
+  re-materialized from a *surviving* replica's shard
+  (:func:`materialize_from_survivors`; a naive ``device_get`` of a
+  "replicated" array reads shard 0, which may be the poisoned copy).
+  An un-attributable divergence (2-way split) falls back to
+  checkpoint-restart from the last scrub-verified generation.
+
+- **Checkpoint scrubber** (:class:`CheckpointScrubber`): a background
+  thread re-hashes retained committed checkpoints against their manifest
+  sha256 on a cadence; a mismatch quarantines the generation in the
+  manifest (never deleted — it is evidence) so ``last_checkpoint`` /
+  restore / ``verify_group_commit`` skip it.
+
+- **Drills**: :func:`apply_bitflip` deterministically flips one mantissa
+  bit of one replica's stored copy of a named tensor between dispatches
+  (the ``integrity/fingerprint`` fault site's ``bitflip`` kind) — the
+  injected corruption persists in carried state exactly like a flaky
+  core's would, making every detection path testable.
+
+Observability: ``integrity/*`` counters feed the profiler's integrity
+ledger, ``integrity/fingerprint|divergence|scrub|quarantine`` flight-rec
+events anchor the watchtower incident chain (divergence is a detection
+anchor, quarantine a mitigation anchor), and the ``replica-consistency``
+SLO burns on divergences and quarantined generations.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import faultinject, flightrec
+from .profiler import OpProfiler
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+# Knuth's multiplicative constant — decorrelates the two commutative
+# folds so a flip that cancels in the sum still moves the combined word
+_FNV = 2654435761
+
+
+class ReplicaCorruptionError(RuntimeError):
+    """In-graph replica-consistency check found divergent state.
+
+    ``replica`` is the majority-voted divergent replica index, or None
+    when the divergence is un-attributable (2-way split, or N=2 where
+    majority is undefined) — the supervisor then falls back to
+    checkpoint-restart from the last scrub-verified generation instead
+    of quarantining."""
+
+    def __init__(self, message: str, replica: Optional[int] = None,
+                 iteration: Optional[int] = None):
+        super().__init__(message)
+        self.replica = replica
+        self.iteration = iteration
+
+
+# --- in-graph fingerprint folds --------------------------------------
+
+def _fold_words(a):
+    """One array -> (uint32 wrapping-sum, uint32 xor) over its raw bits.
+
+    Bitcast, never value-cast: NaN payloads, -0.0 and denormals all
+    participate, so the fold is an exact bit identity. Sub-32-bit dtypes
+    widen after the bitcast (bf16 -> uint16 -> uint32); 64-bit dtypes
+    bitcast to two uint32 words. Both reductions are commutative, which
+    is the whole design: any permutation of the same elements — dense
+    tree order or Zero1Plan flat-bucket order — folds to the same pair.
+    """
+    if a.dtype == jnp.bool_:
+        a = a.astype(jnp.uint8)
+    nbits = a.dtype.itemsize * 8
+    if nbits < 32:
+        u = jax.lax.bitcast_convert_type(
+            a, jnp.dtype("uint%d" % nbits)).astype(jnp.uint32)
+    else:
+        # == 32 is a plain bitcast; > 32 yields a trailing word axis
+        u = jax.lax.bitcast_convert_type(a, jnp.uint32)
+    u = u.reshape(-1)
+    s = jnp.sum(u, dtype=jnp.uint32)
+    x = jax.lax.reduce(u, np.uint32(0), jax.lax.bitwise_xor, (0,))
+    return s, x
+
+
+def combine_fp(a, b):
+    """Fold two digests into one (used to mix params + updater state)."""
+    return a * jnp.uint32(_FNV) ^ b
+
+
+def fingerprint_tree(tree) -> jnp.ndarray:
+    """uint32 digest of every leaf's bits; permutation-invariant, so it
+    equals :func:`fingerprint_flats` of the same params flattened."""
+    s = jnp.zeros((), jnp.uint32)
+    x = jnp.zeros((), jnp.uint32)
+    for leaf in jax.tree.leaves(tree):
+        ls, lx = _fold_words(leaf)
+        s = s + ls
+        x = x ^ lx
+    return s * jnp.uint32(_FNV) ^ x
+
+
+def fingerprint_flats(plan, flats: Dict[str, Any]) -> jnp.ndarray:
+    """Digest of a Zero1Plan flat-bucket dict, folding only each bucket's
+    unpadded ``[:total]`` prefix (``plan.unpadded_views``) — shard padding
+    depends on the worker count and must never enter the digest. Static
+    slices, no gather."""
+    s = jnp.zeros((), jnp.uint32)
+    x = jnp.zeros((), jnp.uint32)
+    for v in plan.unpadded_views(flats).values():
+        ls, lx = _fold_words(v)
+        s = s + ls
+        x = x ^ lx
+    return s * jnp.uint32(_FNV) ^ x
+
+
+def bitwise_neq(a, b):
+    """Exact bitwise inequality (float ``!=`` lies about NaN)."""
+    if a.dtype == jnp.bool_:
+        return jnp.any(a != b)
+    nbits = a.dtype.itemsize * 8
+    dt = jnp.uint32 if nbits >= 32 else jnp.dtype("uint%d" % nbits)
+    return jnp.any(jax.lax.bitcast_convert_type(a, dt)
+                   != jax.lax.bitcast_convert_type(b, dt))
+
+
+def replica_verdict(fp, mismatch, axis: str, do_check):
+    """All_gather the per-replica digests and majority-vote in-graph.
+
+    Returns replicated int32 scalars ``(checked, diverged, replica)``:
+    ``replica`` is the unique minority index when attribution is
+    possible, else -1 (2-way split / N=2 / transport mismatch on more
+    than one receiver). The gathers run unconditionally — a 4-byte
+    scalar per replica per step, constant cost — so no collective ever
+    sits inside a ``lax.cond`` arm; only the O(params) fold is gated."""
+    fps = jax.lax.all_gather(fp, axis)
+    mis = jax.lax.all_gather(mismatch.astype(jnp.int32), axis)
+    n = fps.shape[0]
+    support = jnp.sum((fps[None, :] == fps[:, None]).astype(jnp.int32),
+                      axis=1)
+    fp_div = jnp.any(support < n)
+    bad = (support < jnp.max(support)) | (mis > 0)
+    n_bad = jnp.sum(bad.astype(jnp.int32))
+    diverged = (fp_div | jnp.any(mis > 0)) & do_check
+    replica = jnp.where(diverged & (n_bad == 1),
+                        jnp.argmax(bad).astype(jnp.int32),
+                        jnp.int32(-1))
+    return (do_check.astype(jnp.int32), diverged.astype(jnp.int32),
+            replica)
+
+
+# --- host-side digest (serving publish verify, test oracle) -----------
+
+def host_fingerprint(tree) -> int:
+    """The same digest computed host-side with numpy — the oracle tests
+    compare against the in-graph aux value, and the fleet-publish check
+    serving runs after a canary promote. One batched readback."""
+    leaves = jax.tree.leaves(tree)
+    host = jax.device_get(leaves)
+    s = 0
+    x = 0
+    for a in host:
+        a = np.ascontiguousarray(a)
+        if a.dtype == np.bool_:
+            a = a.astype(np.uint8)
+        nbits = a.dtype.itemsize * 8
+        u = a.reshape(-1).view("uint%d" % min(nbits, 32))
+        if nbits < 32:
+            u = u.astype(np.uint32)
+        s = (s + int(np.add.reduce(u, dtype=np.uint64) & 0xFFFFFFFF)) \
+            & 0xFFFFFFFF
+        x ^= int(np.bitwise_xor.reduce(u)) if u.size else 0
+    return (s * _FNV ^ x) & 0xFFFFFFFF
+
+
+# --- listener: aux -> detection --------------------------------------
+
+class IntegrityListener:
+    """Drains the in-graph consistency verdict and raises on divergence.
+
+    Duck-typed against the listener SPI (iteration_done/telemetry_done/
+    epoch_done). ``wants_telemetry`` turns the telemetry aux on;
+    ``wants_telemetry_stats = False`` keeps the heavy per-layer stats
+    (and their flat-backward opt-out) off — the aux carries just the
+    loss and the four integrity scalars, so the A/B cost of this
+    listener *is* the fingerprint. Readback discipline matches
+    NanSentinelListener: device values are buffered un-synced and
+    drained with ONE batched ``jax.device_get`` per dispatch window —
+    and only for windows that contain a checked step, which the host
+    knows from the iteration counter without touching the device."""
+
+    POLICIES = ("raise", "warn")
+
+    def __init__(self, check_every: int = 8, policy: str = "raise"):
+        if policy not in self.POLICIES:
+            raise ValueError("policy must be one of %r" % (self.POLICIES,))
+        self.check_every = max(1, int(check_every))
+        self.policy = policy
+        self.wants_telemetry = True
+        self.wants_telemetry_stats = False
+        self.wants_integrity = self.check_every
+        self.fingerprints: List[Tuple[int, int]] = []
+        self.divergences: List[Dict[str, int]] = []
+        self._buf: List[Tuple[int, Any]] = []
+
+    def iteration_done(self, model, iteration: int, score) -> None:
+        pass
+
+    def epoch_done(self, model, epoch: int) -> None:
+        self._drain()
+
+    def telemetry_done(self, model, iteration: int, aux) -> None:
+        if "integrity_checked" not in aux:
+            return
+        self._buf.append((iteration, aux))
+        if getattr(model, "_at_dispatch_boundary", True):
+            # the in-graph check ran at step `it` iff it % every == 0,
+            # and note_steps reports iteration = it + 1
+            if any((it - 1) % self.check_every == 0 for it, _ in self._buf):
+                self._drain()
+            else:
+                self._buf.clear()
+
+    def _drain(self) -> None:
+        if not self._buf:
+            return
+        buf, self._buf = self._buf, []
+        prof = OpProfiler.get()
+        with prof.time_section("telemetry/drain"):
+            vals = jax.device_get([
+                (a["integrity_checked"], a["integrity_diverged"],
+                 a["integrity_replica"], a["integrity_fp"])
+                for _, a in buf])
+        last_fp = None
+        for (it, _), (checked, diverged, replica, fp) in zip(buf, vals):
+            if not int(checked):
+                continue
+            prof.count("integrity/checks")
+            last_fp = (it, int(fp))
+            self.fingerprints.append(last_fp)
+            if int(diverged):
+                rep = int(replica)
+                prof.count("integrity/divergences")
+                flightrec.event("integrity/divergence", severity="error",
+                                iteration=it, replica=rep, fp=int(fp))
+                self.divergences.append(
+                    {"iteration": it, "replica": rep, "fp": int(fp)})
+                if self.policy == "raise":
+                    raise ReplicaCorruptionError(
+                        "replica-consistency fingerprint diverged at "
+                        "iteration %d (replica %s)"
+                        % (it, rep if rep >= 0 else "unattributable"),
+                        replica=rep if rep >= 0 else None, iteration=it)
+                logger.warning(
+                    "integrity: fingerprint divergence at iteration %d "
+                    "(replica %s) — policy=warn, training continues",
+                    it, rep if rep >= 0 else "unattributable")
+        if last_fp is not None:
+            flightrec.event("integrity/fingerprint", iteration=last_fp[0],
+                            fp=last_fp[1], checks=len(self.fingerprints))
+
+    def state_dict(self) -> dict:
+        return {"fingerprints": [[i, f] for i, f in self.fingerprints[-64:]]}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.fingerprints = [(int(i), int(f))
+                             for i, f in state.get("fingerprints", [])]
+
+
+# --- drills: deterministic bitflip injection --------------------------
+
+def _uint_view(buf: np.ndarray) -> np.ndarray:
+    if buf.dtype == np.bool_:
+        return buf.reshape(-1).view(np.uint8)
+    return buf.reshape(-1).view("uint%d" % (buf.dtype.itemsize * 8))
+
+
+def apply_bitflip(holder, mesh, spec: Dict[str, Any]) -> Dict[str, Any]:
+    """Flip one mantissa bit of ONE replica's stored copy of a param.
+
+    The ``integrity/fingerprint`` fault site's ``bitflip`` kind: between
+    dispatches, the named replica's per-device copy of a (replicated)
+    param leaf gets exactly one bit flipped — the corruption then rides
+    the carried training state like a flaky core's output would, and the
+    next in-graph check must catch it. Spec fields: ``replica`` (device
+    index on the data axis), ``tensor`` (substring of the leaf path;
+    default = first floating leaf), ``bit`` (default 12 — a mantissa bit
+    for every float dtype in use), ``offset`` (flat element index).
+
+    Implementation detail that makes this a *pure data* fault: the leaf
+    is rebuilt with ``jax.make_array_from_single_device_arrays`` keeping
+    its replicated sharding, so the step's compiled executable, sharding
+    metadata and donation contract are untouched — zero retraces."""
+    replica = int(spec.get("replica", 0))
+    bit = int(spec.get("bit", 12))
+    offset = int(spec.get("offset", 0))
+    name = spec.get("tensor")
+    devices = list(mesh.devices.flat)
+    if not 0 <= replica < len(devices):
+        raise ValueError("bitflip replica %d outside mesh of %d"
+                         % (replica, len(devices)))
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(holder._params)
+    target_i = None
+    for i, (path, leaf) in enumerate(flat):
+        label = jax.tree_util.keystr(path)
+        if name is not None:
+            if name in label:
+                target_i = i
+                break
+        elif jnp.issubdtype(leaf.dtype, jnp.floating):
+            target_i = i
+            break
+    if target_i is None:
+        raise ValueError("bitflip: no param leaf matches %r" % (name,))
+    path, leaf = flat[target_i]
+    label = jax.tree_util.keystr(path)
+
+    from jax.sharding import NamedSharding, PartitionSpec
+    arr = leaf
+    replicated = (isinstance(arr, jax.Array)
+                  and getattr(arr.sharding, "is_fully_replicated", False)
+                  and len(arr.addressable_shards) == len(devices))
+    if not replicated:
+        if (isinstance(arr, jax.Array)
+                and not arr.sharding.is_fully_replicated):
+            raise ValueError("bitflip target %s is sharded — flip a "
+                             "replicated param instead" % label)
+        arr = jax.device_put(jnp.asarray(arr),
+                             NamedSharding(mesh, PartitionSpec()))
+    pieces = []
+    for shard in arr.addressable_shards:
+        buf = np.array(shard.data)
+        if shard.device == devices[replica]:
+            words = _uint_view(buf)
+            words[offset % words.size] ^= np.asarray(
+                1 << bit, dtype=words.dtype)
+        pieces.append(jax.device_put(buf, shard.device))
+    flipped = jax.make_array_from_single_device_arrays(
+        arr.shape, arr.sharding, pieces)
+    leaves = [flipped if i == target_i else l
+              for i, (_, l) in enumerate(flat)]
+    holder._params = jax.tree_util.tree_unflatten(treedef, leaves)
+    OpProfiler.get().count("integrity/bitflips_injected")
+    logger.warning("integrity: injected bitflip on replica %d tensor %s "
+                   "bit %d offset %d", replica, label, bit, offset)
+    return {"replica": replica, "tensor": label, "bit": bit,
+            "offset": offset}
+
+
+# --- majority-consistent host materialization -------------------------
+
+def materialize_from_survivors(tree, devices: Sequence, lost:
+                               Sequence[int]):
+    """Host-materialize carried state reading REPLICATED leaves from a
+    surviving replica's shard. ``jax.device_get`` on a replicated array
+    reads addressable shard 0 — if replica 0 is the quarantined one,
+    the naive path would rebuild the shrunk fleet from the poisoned
+    copy. Sharded leaves (ZeRO-1 flat updater state) assemble normally:
+    every shard is owned by exactly one replica, so there is nothing to
+    choose."""
+    lost_set = {int(r) for r in lost}
+    survivor = next((i for i in range(len(devices)) if i not in lost_set),
+                    None)
+    surv_dev = devices[survivor] if survivor is not None else None
+
+    def pull(leaf):
+        if (surv_dev is not None and isinstance(leaf, jax.Array)
+                and not leaf.is_deleted()
+                and getattr(leaf.sharding, "is_fully_replicated", False)):
+            for shard in leaf.addressable_shards:
+                if shard.device == surv_dev:
+                    return np.array(shard.data)
+        return np.array(jax.device_get(leaf))
+
+    return jax.tree.map(pull, tree)
+
+
+# --- checkpoint scrubber ----------------------------------------------
+
+def _flip_file_byte(path: str, offset: int, bit: int) -> None:
+    """Scrub-drill helper: rot one byte of an on-disk zip in place."""
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    with open(path, "r+b") as f:
+        f.seek(offset % size)
+        byte = f.read(1)
+        f.seek(offset % size)
+        f.write(bytes([byte[0] ^ (1 << (bit % 8))]))
+
+
+class CheckpointScrubber:
+    """Background re-verification of retained committed checkpoints.
+
+    Walks the manifest on a cadence, re-hashes each non-quarantined
+    generation against its committed sha256, stamps passing entries with
+    a ``scrub`` record (the supervisor's 2-way-split fallback resumes
+    only from scrub-verified generations) and quarantines failures in
+    the manifest — the file is never deleted; a rotten checkpoint is
+    evidence. Single writer thread; manifest read-modify-writes go
+    through util.checkpoint's manifest lock, so the scrubber and the
+    async CheckpointWriter never tear each other's updates.
+
+    Fault site ``checkpoint/scrub`` fires once per entry per pass with a
+    monotonically increasing ordinal: ``transient`` skips the entry this
+    pass (verification is retryable by construction — next pass covers
+    it), ``bitflip`` rots the zip on disk *before* hashing, turning the
+    scrubber's own drill into a self-contained corruption scenario."""
+
+    def __init__(self, directory: str, interval_s: float = 30.0):
+        self.directory = directory
+        self.interval_s = max(0.05, float(interval_s))
+        self.passes = 0
+        self._ordinal = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "CheckpointScrubber":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="ckpt-scrubber", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.scrub_now()
+            except Exception:
+                logger.exception("integrity: scrub pass failed")
+
+    def scrub_now(self) -> Dict[str, int]:
+        """One scrub pass; returns {scanned, verified, quarantined,
+        skipped}. Callable directly (tests, drills) — the thread is just
+        a cadence."""
+        from ..util import checkpoint as _ckpt
+        prof = OpProfiler.get()
+        summary = {"scanned": 0, "verified": 0, "quarantined": 0,
+                   "skipped": 0}
+        for entry in _ckpt.read_manifest(self.directory):
+            if not isinstance(entry, dict) or "sha256" not in entry:
+                summary["skipped"] += 1
+                continue
+            if entry.get("quarantined"):
+                summary["skipped"] += 1
+                continue
+            ordinal = self._ordinal
+            self._ordinal += 1
+            try:
+                advisory = faultinject.fault_point("checkpoint/scrub",
+                                                   ordinal)
+            except faultinject.TransientFault:
+                prof.count("integrity/scrub_retries")
+                summary["skipped"] += 1
+                continue
+            path = os.path.join(self.directory, entry["file"])
+            for spec in advisory:
+                if spec.get("kind") == "bitflip":
+                    _flip_file_byte(path, int(spec.get("offset", 128)),
+                                    int(spec.get("bit", 0)))
+            summary["scanned"] += 1
+            try:
+                ok = _ckpt._sha256_file(path) == entry["sha256"]
+            except OSError:
+                ok = False
+            if ok:
+                _ckpt.record_scrub(self.directory, entry["file"], True)
+                prof.count("integrity/scrub_verified")
+                summary["verified"] += 1
+            else:
+                _ckpt.record_scrub(self.directory, entry["file"], False,
+                                   reason="sha256 mismatch on scrub")
+                summary["quarantined"] += 1
+        self.passes += 1
+        prof.count("integrity/scrub_passes")
+        flightrec.event("integrity/scrub", directory=self.directory,
+                        **summary)
+        return summary

@@ -46,6 +46,7 @@ class OpProfiler:
         ("tracecheck", "tracecheck_stats"),
         ("faults", "fault_stats"),
         ("watchtower", "watchtower_stats"),
+        ("integrity", "integrity_stats"),
     )
 
     def __init__(self) -> None:
@@ -409,6 +410,18 @@ class OpProfiler:
         if s:
             out["retry_backoff_s"] = s["total_s"]
         return out
+
+    def integrity_stats(self) -> Dict[str, float]:
+        """Silent-corruption-defense ledger (``integrity/*`` counters):
+        in-graph fingerprint checks and divergences, injected bitflip
+        drills, scrub passes / verified entries / retries, and
+        quarantined checkpoint generations (replica quarantines ride the
+        supervisor ledger as ``quarantines``). Empty until an
+        IntegrityListener or CheckpointScrubber runs — a clean soak
+        window must show ``checks`` advancing with zero ``divergences``
+        and zero ``quarantined_checkpoints``."""
+        return {k.split("/", 1)[1]: v for k, v in self._counters.items()
+                if k.startswith("integrity/")}
 
     def watchtower_stats(self) -> Dict[str, float]:
         """SLO watchtower ledger (``common.watchtower``): per-SLO alert

@@ -86,12 +86,12 @@ _STATE_NAMES = {OK: "ok", WARN: "warn", PAGE: "page"}
 _CAUSE_NAMES = ("fault/fired", "tracecheck/violation", "cluster/rank_lost")
 _DETECTION_NAMES = ("watchtower/alert", "supervisor/attempt_failed",
                     "supervisor/watchdog_fire", "supervisor/give_up",
-                    "cluster/barrier")
+                    "cluster/barrier", "integrity/divergence")
 _MITIGATION_NAMES = ("supervisor/restart", "supervisor/preempted",
                      "elastic/resize", "pipeline/remap",
                      "serving/rollback", "serving/retire", "serving/shed",
                      "autoscale/scale", "fleet/cull", "fleet/nan_cull",
-                     "cluster/group_restart")
+                     "cluster/group_restart", "integrity/quarantine")
 _RECOVERY_NAMES = ("supervisor/attempt_start", "supervisor/completed",
                    "checkpoint/restore", "inference/resurrected",
                    "serving/promote", "fleet/spawn", "cluster/form")
@@ -833,6 +833,12 @@ def default_slos(engine: Any = None,
                                       "fleet/nan_culls"),
             budget=0.001, incident="attach",
             description="no poisoned updates reach the params", **win),
+        SLO("replica-consistency",
+            counter_increment_sampler("integrity/divergences",
+                                      "integrity/quarantined_checkpoints"),
+            budget=0.001, incident="attach",
+            description="replicas stay bitwise-identical and retained "
+                        "checkpoints verify on scrub", **win),
         SLO("restart-budget",
             counter_increment_sampler("supervisor/restarts",
                                       "supervisor/storm_trips"),

@@ -58,24 +58,50 @@ class TelemetryConfig:
     guard skipped additionally has its alive-mask bit flipped in-graph —
     permanent isolation instead of a transient skip. Solo step builders
     ignore it (a ``"cull"`` sentinel on a solo model degrades to
-    ``"skip"``)."""
+    ``"skip"``).
+
+    ``stats`` gates the per-layer norm/ratio aux (:func:`layer_stats`) —
+    integrity-only listeners leave it off, so the aux carries just the
+    loss plus the consistency verdict and the flat-backward path stays
+    eligible (the A/B overhead of a fingerprint-only config measures the
+    fingerprint, nothing else). ``nan_guard`` forces it back on: the
+    skip policy reads ``nonfinite_total`` from the stats.
+    ``integrity_every > 0`` compiles the replica-consistency fingerprint
+    check (common.integrity) into the parallel step at that iteration
+    cadence — a ``lax.cond``-gated bitcast fold, verdict in the aux."""
 
     nan_guard: bool = False
     member_cull: bool = False
+    stats: bool = True
+    integrity_every: int = 0
 
 
 def config_for(listeners) -> Optional[TelemetryConfig]:
     """The telemetry config a listener set implies (None = aux disabled).
     Listeners opt in with a ``wants_telemetry`` attribute; a skip-policy
-    ``NanSentinelListener`` additionally sets ``wants_nan_guard``, and the
-    fleet ``"cull"`` policy sets ``wants_member_cull`` on top."""
+    ``NanSentinelListener`` additionally sets ``wants_nan_guard``, the
+    fleet ``"cull"`` policy sets ``wants_member_cull`` on top, and an
+    ``IntegrityListener`` sets ``wants_integrity`` (its check cadence)
+    while opting out of per-layer stats via ``wants_telemetry_stats =
+    False`` — stats stay on if ANY listener wants them (absence of the
+    attribute means a classic stats consumer)."""
     if not any(getattr(l, "wants_telemetry", False) for l in listeners):
         return None
+    nan_guard = any(getattr(l, "wants_nan_guard", False) for l in listeners)
+    stats = nan_guard or any(
+        getattr(l, "wants_telemetry_stats",
+                getattr(l, "wants_telemetry", False))
+        for l in listeners)
+    integrity_every = 0
+    for l in listeners:
+        integrity_every = max(integrity_every,
+                              int(getattr(l, "wants_integrity", 0) or 0))
     return TelemetryConfig(
-        nan_guard=any(getattr(l, "wants_nan_guard", False)
-                      for l in listeners),
+        nan_guard=nan_guard,
         member_cull=any(getattr(l, "wants_member_cull", False)
-                        for l in listeners))
+                        for l in listeners),
+        stats=stats,
+        integrity_every=integrity_every)
 
 
 # --- in-graph statistics (called inside the jitted step) --------------------

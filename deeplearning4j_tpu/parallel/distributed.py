@@ -138,6 +138,7 @@ CLASS_DEVICE = "device_failure"
 CLASS_PREEMPTION = "preemption"
 CLASS_HANG = "hang"
 CLASS_USER = "user_error"
+CLASS_CORRUPTION = "silent_corruption"
 
 #: classification → what the supervisor does about it. "retry" restarts
 #: from the last intact checkpoint with FLAT backoff (a transient input
@@ -161,7 +162,14 @@ CLASS_USER = "user_error"
 #: boundary and training continues in memory from the exact cursor, with
 #: the same checkpoint-restart fallback whenever the remap gate refuses
 #: (surviving stages < 2, unidentifiable stage, state not
-#: boundary-consistent).
+#: boundary-consistent). "quarantine_and_continue" (silent corruption —
+#: the in-graph replica-consistency fingerprint named a divergent
+#: replica) reuses the shrink machinery: the named replica's device is
+#: quarantined out of the mesh, majority-consistent state is
+#: re-materialized from a SURVIVOR's shard, and training continues in
+#: memory from the exact boundary — falling back to checkpoint-restart
+#: from the last scrub-VERIFIED generation when the divergence is
+#: un-attributable (2-way split, N=2) or the shrink gate refuses.
 DEFAULT_POLICIES: Dict[str, str] = {
     CLASS_TRANSIENT: "retry",
     CLASS_NUMERIC: "raise",
@@ -169,6 +177,7 @@ DEFAULT_POLICIES: Dict[str, str] = {
     CLASS_HANG: "restart",
     CLASS_PREEMPTION: "exit",
     CLASS_USER: "raise",
+    CLASS_CORRUPTION: "quarantine_and_continue",
 }
 
 
@@ -225,6 +234,12 @@ def classify_failure(exc: Optional[BaseException]) -> str:
         return CLASS_PREEMPTION
     if faultinject.is_transient(exc):
         return CLASS_TRANSIENT
+    # lazy: common.integrity pulls in jax, which this module defers to
+    # function scope (the multiprocess launcher imports us pre-env)
+    from ..common.integrity import ReplicaCorruptionError
+
+    if isinstance(exc, ReplicaCorruptionError):
+        return CLASS_CORRUPTION
     if isinstance(exc, FloatingPointError):
         return CLASS_NUMERIC
     if isinstance(exc, (faultinject.SimulatedCrash,
@@ -582,6 +597,27 @@ class TrainingSupervisor:
             return None
         return lost
 
+    def _quarantine_plan(self, exc: BaseException) -> Optional[List[int]]:
+        """Which replica to quarantine for a silent-corruption failure,
+        or None to fall back to checkpoint-restart. Same gate as
+        :meth:`_shrink_plan` minus the device probe — the device is
+        HEALTHY, its *state* diverged, so the only admissible
+        attribution is the in-graph majority vote the exception carries.
+        ``exc.replica is None`` (2-way split, N=2) is un-attributable by
+        construction: evicting a guess could quarantine the clean copy
+        and keep the poisoned one."""
+        t = self.target
+        if not callable(getattr(t, "resize", None)) \
+                or getattr(t, "model_axis", 1) != 1:
+            return None
+        n = int(getattr(t, "workers_count", 0))
+        if n <= 1 or not self._holder_state_intact():
+            return None
+        rep = getattr(exc, "replica", None)
+        if rep is None or not 0 <= int(rep) < n:
+            return None
+        return [int(rep)]
+
     def _apply_shrink(self, lost: List[int]) -> Optional[List[Any]]:
         """Resize the target's data axis over the survivors; arm the
         grow-back probe. Returns the removed devices, or None when the
@@ -857,6 +893,10 @@ class TrainingSupervisor:
         # for an IN-MEMORY continuation — the next attempt resumes from
         # the holder's live state instead of a checkpoint
         mem_resume: Optional[tuple] = None
+        # set when a silent-corruption failure falls back to restart:
+        # the live holder state is poisoned, so the resume point must be
+        # a generation the background scrubber has re-verified
+        prefer_scrubbed = False
         status = "completed"
         resume_path: Optional[str] = None
         final_exc: Optional[BaseException] = None
@@ -908,7 +948,9 @@ class TrainingSupervisor:
                     # in-memory continuation (post-shrink/grow): the
                     # holder IS the resume point — no checkpoint restore
                     resume_from = (None if mem_resume is not None
-                                   else _ckpt.last_checkpoint(self.dir))
+                                   else _ckpt.last_checkpoint(
+                                       self.dir,
+                                       require_scrubbed=prefer_scrubbed))
                     if make_data:
                         src = make_data()
                     elif source_state is not None:
@@ -1046,6 +1088,18 @@ class TrainingSupervisor:
                         remap_lost = self._remap_plan(exc)
                     if remap_lost is None:
                         policy = "restart"
+                quarantine_lost: Optional[List[int]] = None
+                if policy == "quarantine_and_continue":
+                    # same boundary-trust rule; an un-attributable
+                    # divergence (exc.replica None — 2-way split, N=2)
+                    # or a refused gate falls back to checkpoint-restart
+                    # from a scrub-VERIFIED generation: the live state
+                    # is poisoned and majority vote cannot say where
+                    if outcome == "done" and not run.abandoned:
+                        quarantine_lost = self._quarantine_plan(exc)
+                    if quarantine_lost is None:
+                        policy = "restart"
+                        prefer_scrubbed = True
                 history.append({
                     "attempt": attempt, "class": cls, "policy": policy,
                     "error": repr(exc), "steps": run.heartbeat.steps,
@@ -1132,6 +1186,38 @@ class TrainingSupervisor:
                         # so it consumes no restart and resets the storm
                         # breaker: a single device loss can never
                         # contribute to a RestartStorm trip
+                        consec_no_progress = 0
+                        mem_resume = (self._cursor_of(),
+                                      run.rng_state or entry_rng)
+                        continue
+                if policy == "quarantine_and_continue":
+                    # the mitigation anchor BEFORE the resize: the
+                    # incident chain reads decision → elastic/resize →
+                    # next attempt_start, with the cause (fault/fired)
+                    # and detection (integrity/divergence) already on
+                    # the record naming the replica
+                    flightrec.event(
+                        "integrity/quarantine", severity="warn",
+                        replica=quarantine_lost[0],
+                        iteration=int(getattr(self.holder,
+                                              "_iteration", 0)))
+                    removed = self._apply_shrink(quarantine_lost)
+                    if removed is None:
+                        # the resize itself failed mid-flight — rare
+                        # (the plan vetted the gate); restart from a
+                        # scrub-verified generation owns it
+                        history[-1]["policy"] = "quarantine_failed_restart"
+                        policy = "restart"
+                        prefer_scrubbed = True
+                    else:
+                        prof.count("supervisor/quarantines")
+                        # same budget accounting as shrink: quarantining
+                        # the divergent replica IS progress — survivors
+                        # carry majority-consistent state from the exact
+                        # boundary — so no restart is consumed and the
+                        # storm breaker resets; the quarantined device
+                        # gets the same grow-back probe (it must prove
+                        # itself before rejoining)
                         consec_no_progress = 0
                         mem_resume = (self._cursor_of(),
                                       run.rng_state or entry_rng)

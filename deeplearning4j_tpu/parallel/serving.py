@@ -112,6 +112,7 @@ import jax
 import jax.numpy as jnp
 
 from ..common import faultinject, flightrec, xprof
+from ..common import integrity as _integ
 from ..common.profiler import OpProfiler
 from ..data.pipeline import pad_rows
 from ..ndarray.ndarray import NDArray
@@ -1630,6 +1631,24 @@ class ServingEngine(ParallelInference):
         self._set_canary_phase("confirm")
         flightrec.event("serving/promote", corr=can["corr"],
                         file=can["file"], replicas=self.alive_replicas())
+        # post-promote fleet verify: every slot's freshly-installed param
+        # copy must digest bitwise-identical. A copy corrupted in transit
+        # (device_put, HBM) would otherwise serve divergent answers from
+        # one replica until the NEXT publication; the digest read is one
+        # batched host readback per slot, off the request path.
+        prof = OpProfiler.get()
+        prof.count("integrity/publish_checks")
+        digests = {slot: _integ.host_fingerprint(entry[0])
+                   for slot, entry in can["new"].items()}
+        counts = collections.Counter(digests.values())
+        if len(counts) > 1:
+            majority = counts.most_common(1)[0][0]
+            bad = sorted(s for s, d in digests.items() if d != majority)
+            prof.count("integrity/publish_divergences")
+            self._rollback(can, "confirm",
+                           f"post-promote fingerprint mismatch on "
+                           f"slot(s) {bad}")
+            return
         deadline = time.monotonic() + confirm_window_s
         while time.monotonic() < deadline:
             if self._shutdown:

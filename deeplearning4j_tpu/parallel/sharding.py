@@ -192,6 +192,15 @@ class Zero1Plan:
                                              (idx * b.shard,), (b.shard,))
                 for b in self.buckets}
 
+    def unpadded_views(self, flats: Dict[str, Any]) -> Dict[str, Any]:
+        """Each bucket's live prefix (``[:total]``, a static slice) with
+        the worker-count pad tail dropped. This is the integrity-fold
+        contract (:func:`common.integrity.fingerprint_flats`): the pad
+        tail's length changes with the replica count, so any digest that
+        folded it in would break fingerprint stability across elastic
+        resizes — only the live prefix is ever hashed."""
+        return {b.key: flats[b.key][:b.total] for b in self.buckets}
+
     def shard_segment_ids(self, key: str, idx, shard: int):
         """Telemetry layer id for each flat position of replica ``idx``'s
         slice of bucket ``key``, derived IN-GRAPH from the bucket's tiny

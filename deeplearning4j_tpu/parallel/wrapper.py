@@ -30,7 +30,8 @@ from jax.experimental.shard_map import shard_map
 
 import logging
 
-from ..common import flightrec, xprof
+from ..common import faultinject, flightrec, xprof
+from ..common import integrity as _integ
 from ..common.profiler import OpProfiler
 from ..data import pipeline as _pipe
 from ..data.dataset import DataSet
@@ -177,18 +178,32 @@ class ParallelWrapper:
         from ..ops import pallas_update as _pupd
         from ..optimize import telemetry as _tel
 
+        stats = tele is not None and tele.stats
+        integ = tele.integrity_every if tele is not None else 0
+        if integ and not zero1:
+            pspec = self._param_specs()
+            specs = ([] if pspec == P() else
+                     jax.tree.leaves(pspec,
+                                     is_leaf=lambda s: isinstance(s, P)))
+            if self.model_axis != 1 or any(s != P() for s in specs):
+                raise NotImplementedError(
+                    "integrity fingerprints police the replicated-state "
+                    "invariant — model-sharded params have no replica "
+                    "copies to compare")
+
         # Backward-epilogue fusion (mirrors the solo _step_core): when the
         # updater consumes FLAT buckets anyway (ZeRO-1 always; dense when
         # `fused_update` is on), differentiate w.r.t. the flat params — the
         # forward unflattens them (a pure permutation), so the cotangents
         # accumulate directly into flat layout and the dense grad pytree
         # never materializes between the backward and the exchange. Gated
-        # off when telemetry needs the raw dense per-shard grads
+        # off when telemetry stats need the raw dense per-shard grads
         # (nonfinite_counts / layer_stats walk the layer tree) and for
-        # stateful accumulators (residual carry is a dense-tree pytree).
-        dense_fused_plan = (None if (zero1 or stateful or tele is not None)
+        # stateful accumulators (residual carry is a dense-tree pytree) —
+        # a stats-off aux (integrity fingerprints only) keeps it on.
+        dense_fused_plan = (None if (zero1 or stateful or stats)
                             else _fused_flat_plan(model.conf, model._params))
-        flat_bwd = (tele is None and not stateful
+        flat_bwd = (not stats and not stateful
                     and getattr(model.conf.global_conf, "flat_backward",
                                 True)
                     and (zero1 or dense_fused_plan is not None))
@@ -232,7 +247,7 @@ class ParallelWrapper:
             else:
                 (loss, new_states), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(params)
-            if tele is not None:
+            if stats:
                 # non-finite counts are taken on the RAW per-shard grads
                 # (reduction would smear one shard's NaN across all of
                 # them) and aggregated with the same collective family as
@@ -261,13 +276,13 @@ class ParallelWrapper:
                     v, axis, scatter_dimension=0, tiled=True)
                     / jnp.asarray(n_shards, v.dtype)
                     for k, v in flat_g.items()}
-                p_sh = plan.shard_slice(
-                    flat_params if flat_bwd else plan.flatten(params), idx)
+                flat_p = flat_params if flat_bwd else plan.flatten(params)
+                p_sh = plan.shard_slice(flat_p, idx)
                 new_p_sh, new_upd = _pupd.apply_flat_updater(
                     updater, p_sh, g_sh, upd_state, it, key)
-                new_params = plan.unflatten(
-                    {k: jax.lax.all_gather(v, axis, tiled=True)
-                     for k, v in new_p_sh.items()})
+                gathered = {k: jax.lax.all_gather(v, axis, tiled=True)
+                            for k, v in new_p_sh.items()}
+                new_params = plan.unflatten(gathered)
             elif flat_bwd:
                 # dense data-parallel fused epilogue: pmean the FLAT buckets
                 # (elementwise — bitwise-equal to flattening the pmean'd
@@ -285,7 +300,11 @@ class ParallelWrapper:
                     updater, grads, upd_state, params, it, key)
             if tele is None:
                 return new_params, new_states, new_upd, acc_state, loss
-            if zero1:
+            if not stats:
+                # integrity-only aux: the loss plus the consistency
+                # verdict below — no per-layer stats, no dense grads
+                aux = {"loss": loss}
+            elif zero1:
                 # per-layer norms from the flat shards: segment-summed
                 # locally, psum'd across the data axis (the full gradient/
                 # update tensors are never materialized for telemetry)
@@ -307,6 +326,63 @@ class ParallelWrapper:
                 aux, new_params, new_states, new_upd = _tel.apply_nan_guard(
                     aux, new_params, params, new_states, states, new_upd,
                     upd_state)
+            if integ:
+                # Replica-consistency fingerprint (common.integrity): the
+                # O(params) bitcast fold of the step's INPUT state — the
+                # state every replica stored from the previous step, which
+                # the data-parallel contract requires bitwise-identical —
+                # runs under a lax.cond every `integrity_every` steps (the
+                # alive-mask pattern: predicated fold, no retrace). Only
+                # the 4-byte digest and the tile-transport bit travel:
+                # their all_gather runs unconditionally so no collective
+                # ever sits inside a cond arm.
+                do_check = (it % integ) == 0
+                zero_fp = jnp.zeros((), jnp.uint32)
+                if zero1:
+                    # digest the unpadded flat buckets (no dense
+                    # materialization), and cross-check the tile this
+                    # replica republished against what the all_gather
+                    # round-tripped — a corrupt interconnect receive
+                    # flags the observing replica
+                    fp_p, fp_chk = jax.lax.cond(
+                        do_check,
+                        lambda: (lambda f: (f, f))(
+                            _integ.fingerprint_flats(plan, flat_p)),
+                        lambda: (zero_fp, zero_fp))
+                    mism = jax.lax.cond(
+                        do_check,
+                        lambda: jnp.any(jnp.stack([
+                            _integ.bitwise_neq(
+                                plan.shard_slice(gathered, idx)[b.key],
+                                new_p_sh[b.key])
+                            for b in plan.buckets])).astype(jnp.int32),
+                        lambda: jnp.zeros((), jnp.int32))
+                else:
+                    # dense: params AND the replicated updater state must
+                    # match — a desynced Adam moment corrupts training
+                    # just as surely as a desynced weight
+                    fp_p, fp_chk = jax.lax.cond(
+                        do_check,
+                        lambda: (lambda f: (f, _integ.combine_fp(
+                            f, _integ.fingerprint_tree(upd_state))))(
+                            _integ.fingerprint_tree(params)),
+                        lambda: (zero_fp, zero_fp))
+                    mism = jnp.zeros((), jnp.int32)
+                checked, diverged, replica = _integ.replica_verdict(
+                    fp_chk, mism, axis, do_check)
+                aux["integrity_checked"] = checked
+                aux["integrity_diverged"] = diverged
+                aux["integrity_replica"] = replica
+                aux["integrity_fp"] = fp_p
+                # freeze-on-divergence (the nan-guard pattern): survivors
+                # carry their clean pre-step state to the quarantine
+                # boundary; the corrupt replica's output stays its own
+                # poisoned input, so the fault persists and re-detects
+                ok = diverged == 0
+                keep = lambda nw, od: jnp.where(ok, nw, od)
+                new_params = jax.tree.map(keep, new_params, params)
+                new_states = jax.tree.map(keep, new_states, states)
+                new_upd = jax.tree.map(keep, new_upd, upd_state)
             return new_params, new_states, new_upd, acc_state, loss, aux
 
         return local_step
@@ -700,11 +776,19 @@ class ParallelWrapper:
             # 1) host-materialize the training state with OWNING copies —
             # the compiled steps donate their argument buffers, and on
             # the CPU backend device_get returns zero-copy views (the
-            # PR-3 heap-corruption lesson)
-            params, states, upd, acc = jax.tree.map(
-                np.array, jax.device_get(
-                    (model._params, model._states, model._updater_state,
-                     getattr(model, "_acc_state", None) or None)))
+            # PR-3 heap-corruption lesson). When replicas are being
+            # quarantined, replicated leaves are read from a SURVIVOR's
+            # shard: a plain device_get reads shard 0, which may be the
+            # silently-corrupted copy the shrink exists to discard.
+            live = (model._params, model._states, model._updater_state,
+                    getattr(model, "_acc_state", None) or None)
+            if lost:
+                params, states, upd, acc = \
+                    _integ.materialize_from_survivors(
+                        live, list(self.mesh.devices.flat), lost)
+            else:
+                params, states, upd, acc = jax.tree.map(
+                    np.array, jax.device_get(live))
             # 2) per-replica accumulator state rides the permutation too
             if acc is not None:
                 acc = self.accumulator.resize_state(acc, old_n, n,
@@ -906,9 +990,22 @@ class ParallelWrapper:
         self.model._last_batch_size = int(x.shape[0])
         return x, y, mask, np.asarray(w, np.float32)
 
+    def _inject_faults(self, model) -> None:
+        """Pre-dispatch drill hook: the ``integrity/fingerprint`` site's
+        ``bitflip`` kind corrupts ONE replica's stored param copy between
+        dispatches (common.integrity.apply_bitflip) — pure data, zero
+        retraces — so the in-graph consistency check has something real
+        to catch. Indexed by the iteration the dispatch starts at; under
+        steps_per_dispatch the flip lands at the chunk boundary."""
+        for spec in faultinject.fault_point("integrity/fingerprint",
+                                            int(model._iteration)):
+            if spec.get("kind") == "bitflip":
+                _integ.apply_bitflip(model, self.mesh, spec)
+
     def _dispatch_one(self, b, prof) -> None:
         model = self.model
         xs, ys, ms, ws = b
+        self._inject_faults(model)
         key = get_random().next_key()
         with prof.time_section("pipeline/dispatch"):
             out = self._step(model._params, model._states,
@@ -929,6 +1026,7 @@ class ParallelWrapper:
         # jnp.stack composes shardings device-side ([K, B, ...] with B
         # still split over the data axis), matching the chunk in_specs
         stack = lambda i: jnp.stack([b[i] for b in group])  # noqa: E731
+        self._inject_faults(model)
         keys = jnp.stack([get_random().next_key() for _ in group])
         with prof.time_section("pipeline/dispatch"):
             out = self._chunk_step(model._params, model._states,

@@ -47,6 +47,7 @@ import logging
 import os
 import queue
 import threading
+import time
 import zipfile
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -61,6 +62,13 @@ MANIFEST_NAME = "checkpoint.json"
 RESUME_ENTRY = "resume.json"
 ACC_ENTRY = "accumulatorState.npz"
 MANIFEST_FORMAT = 2
+
+# In-process serialization of manifest read-modify-writes: the async
+# CheckpointWriter thread folds commits while the integrity scrubber
+# thread stamps scrub results / quarantines generations — without one
+# owning lock the two would tear each other's updates (the file write
+# itself is atomic; the read-modify-write around it is not)
+_MANIFEST_LOCK = threading.RLock()
 
 
 class StaleIncarnationError(RuntimeError):
@@ -308,9 +316,11 @@ def claim_incarnation(directory: str) -> int:
     :class:`StaleIncarnationError`). Called once per supervised (re)start
     BEFORE the new attempt's writer is built."""
     os.makedirs(directory, exist_ok=True)
-    doc = read_manifest_doc(directory)
-    inc = int(doc.get("incarnation", 0) or 0) + 1
-    write_manifest(directory, doc.get("checkpoints", []), incarnation=inc)
+    with _MANIFEST_LOCK:
+        doc = read_manifest_doc(directory)
+        inc = int(doc.get("incarnation", 0) or 0) + 1
+        write_manifest(directory, doc.get("checkpoints", []),
+                       incarnation=inc)
     return inc
 
 
@@ -349,46 +359,50 @@ def _append_and_retain(directory: str, name: str, sha: str, iteration: int,
     two leaves an orphan file, never a dangling index. ``incarnation``
     fences the fold: an older-incarnation writer raises
     :class:`StaleIncarnationError` and the manifest is untouched."""
-    doc = read_manifest_doc(directory)
-    current = int(doc.get("incarnation", 0) or 0)
-    if incarnation is not None and int(incarnation) < current:
-        raise StaleIncarnationError(
-            f"writer incarnation {incarnation} is stale: {directory} was "
-            f"claimed by incarnation {current}; refusing to commit {name}")
-    old = doc.get("checkpoints", [])
-    entries = [e for e in (old if isinstance(old, list) else [])
-               if _entry_name(e) != name]
-    entry: Dict[str, Any] = {"file": name, "sha256": sha,
-                             "iteration": int(iteration),
-                             "tag": name[len("checkpoint_"):-len(".zip")]}
-    if size is not None:
-        entry["bytes"] = int(size)
-    if state_dtype is not None:
-        # low-precision updater state: surfaced in the manifest so ops
-        # tooling (and humans) can see the stored-moment dtype without
-        # opening the zip
-        entry["state_dtype"] = str(state_dtype)
-    if fleet is not None:
-        # fleet provenance (parallel.fleet): {"members": M} for a stacked
-        # fleet checkpoint, plus {"member": k} for a sliced single-member
-        # one — ops tooling can tell a member export from a solo run and
-        # a stacked state from a dense one without opening the zip
-        entry["fleet"] = {k: int(v) for k, v in fleet.items()}
-    entries.append(entry)
-    retained, dropped = entries, []
-    if keep_last and len(entries) > keep_last:
-        retained, dropped = entries[-keep_last:], entries[:-keep_last]
-    if max_total_bytes:
-        total = sum(_entry_bytes(directory, e) for e in retained)
-        while len(retained) > 1 and total > max_total_bytes:
-            total -= _entry_bytes(directory, retained[0])
-            dropped.append(retained[0])
-            retained = retained[1:]
-            OpProfiler.get().count("checkpoint/bytes_gc")
-    # pass the resolved value through (0 included) — None would make
-    # write_manifest re-read the manifest it was just handed
-    write_manifest(directory, retained,
-                   incarnation=max(current, int(incarnation or 0)))
+    with _MANIFEST_LOCK:
+        doc = read_manifest_doc(directory)
+        current = int(doc.get("incarnation", 0) or 0)
+        if incarnation is not None and int(incarnation) < current:
+            raise StaleIncarnationError(
+                f"writer incarnation {incarnation} is stale: {directory} "
+                f"was claimed by incarnation {current}; refusing to "
+                f"commit {name}")
+        old = doc.get("checkpoints", [])
+        entries = [e for e in (old if isinstance(old, list) else [])
+                   if _entry_name(e) != name]
+        entry: Dict[str, Any] = {"file": name, "sha256": sha,
+                                 "iteration": int(iteration),
+                                 "tag": name[len("checkpoint_"):
+                                             -len(".zip")]}
+        if size is not None:
+            entry["bytes"] = int(size)
+        if state_dtype is not None:
+            # low-precision updater state: surfaced in the manifest so
+            # ops tooling (and humans) can see the stored-moment dtype
+            # without opening the zip
+            entry["state_dtype"] = str(state_dtype)
+        if fleet is not None:
+            # fleet provenance (parallel.fleet): {"members": M} for a
+            # stacked fleet checkpoint, plus {"member": k} for a sliced
+            # single-member one — ops tooling can tell a member export
+            # from a solo run and a stacked state from a dense one
+            # without opening the zip
+            entry["fleet"] = {k: int(v) for k, v in fleet.items()}
+        entries.append(entry)
+        retained, dropped = entries, []
+        if keep_last and len(entries) > keep_last:
+            retained, dropped = entries[-keep_last:], entries[:-keep_last]
+        if max_total_bytes:
+            total = sum(_entry_bytes(directory, e) for e in retained)
+            while len(retained) > 1 and total > max_total_bytes:
+                total -= _entry_bytes(directory, retained[0])
+                dropped.append(retained[0])
+                retained = retained[1:]
+                OpProfiler.get().count("checkpoint/bytes_gc")
+        # pass the resolved value through (0 included) — None would make
+        # write_manifest re-read the manifest it was just handed
+        write_manifest(directory, retained,
+                       incarnation=max(current, int(incarnation or 0)))
     for e in dropped:
         try:
             os.remove(os.path.join(directory, _entry_name(e)))
@@ -513,14 +527,76 @@ def _checkpoint_iteration(path: str) -> int:
         return -1
 
 
+def _update_entry(directory: str, name: str, mutate) -> bool:
+    """Locked read-modify-write of one manifest entry (by file name).
+    Returns whether an entry was found and rewritten."""
+    with _MANIFEST_LOCK:
+        doc = read_manifest_doc(directory)
+        entries = doc.get("checkpoints", [])
+        if not isinstance(entries, list):
+            return False
+        hit = False
+        for e in entries:
+            if isinstance(e, dict) and e.get("file") == name:
+                mutate(e)
+                hit = True
+        if hit:
+            write_manifest(directory, entries)
+        return hit
+
+
+def quarantine_checkpoint(directory: str, name: str,
+                          reason: str = "") -> bool:
+    """Mark one generation quarantined in the manifest. The file is
+    NEVER deleted — a rotten checkpoint is evidence for the post-mortem
+    (which bits flipped, when the scrub caught it) — but every reader
+    (:func:`verify_checkpoint`, :func:`last_checkpoint`,
+    :func:`verify_group_commit`, :func:`scan_newest_intact`) skips it
+    from now on, even if a later re-hash happens to pass: quarantine is
+    sticky by design."""
+    def mut(e):
+        e["quarantined"] = True
+        e["quarantine_reason"] = str(reason)[:200]
+        e["quarantine_t"] = time.time()
+    hit = _update_entry(directory, name, mut)
+    if hit:
+        OpProfiler.get().count("integrity/quarantined_checkpoints")
+        flightrec.event("integrity/quarantine", severity="warn",
+                        file=name, reason=str(reason)[:200])
+        logger.warning("checkpoint %s quarantined: %s", name, reason)
+    return hit
+
+
+def record_scrub(directory: str, name: str, ok: bool,
+                 reason: str = "") -> bool:
+    """Fold one scrub verdict into the manifest: a pass stamps the entry
+    with ``scrub = {ok, t}`` (the supervisor's corruption fallback
+    resumes only from scrub-verified generations); a fail quarantines
+    the generation (:func:`quarantine_checkpoint`)."""
+    if not ok:
+        return quarantine_checkpoint(
+            directory, name, reason or "scrub checksum mismatch")
+
+    def mut(e):
+        e["scrub"] = {"ok": True, "t": time.time()}
+    return _update_entry(directory, name, mut)
+
+
 def verify_checkpoint(directory: str, entry: Any) -> Optional[str]:
-    """One manifest entry → verified path, or None (with a warning)."""
+    """One manifest entry → verified path, or None (with a warning).
+    Quarantined generations are refused even when the bytes re-hash
+    clean — the scrubber marked them as evidence, not candidates."""
     if isinstance(entry, str):      # v1 manifest: existence + zip CRC only
         path = entry if os.path.isabs(entry) else os.path.join(
             directory, os.path.basename(entry))
         if os.path.exists(path) and _zip_intact(path):
             return path
         logger.warning("checkpoint %s missing or corrupt; skipping", path)
+        return None
+    if entry.get("quarantined"):
+        logger.warning("checkpoint %s is quarantined (%s); skipping",
+                       entry.get("file"),
+                       entry.get("quarantine_reason", "scrub"))
         return None
     path = os.path.join(directory, entry["file"])
     if not os.path.exists(path):
@@ -533,10 +609,28 @@ def verify_checkpoint(directory: str, entry: Any) -> Optional[str]:
     return path
 
 
-def last_checkpoint(directory: str) -> Optional[str]:
+def last_checkpoint(directory: str,
+                    require_scrubbed: bool = False) -> Optional[str]:
     """Newest checkpoint that PROVES intact — manifest+checksum first,
-    newest→oldest, then the directory-scan fallback."""
-    for entry in reversed(read_manifest(directory)):
+    newest→oldest (quarantined generations skipped), then the
+    directory-scan fallback. ``require_scrubbed`` (the supervisor's
+    silent-corruption restart fallback) PREFERS the newest
+    scrub-verified generation — a background re-hash vouched for the
+    bytes after commit — falling back to the ordinary walk (whose
+    verify re-hashes at read time anyway) with a warning when no scrub
+    pass has stamped anything yet."""
+    entries = read_manifest(directory)
+    if require_scrubbed:
+        for entry in reversed(entries):
+            if (isinstance(entry, dict) and not entry.get("quarantined")
+                    and (entry.get("scrub") or {}).get("ok")):
+                path = verify_checkpoint(directory, entry)
+                if path is not None:
+                    return path
+        logger.warning(
+            "no scrub-verified checkpoint in %s; falling back to the "
+            "newest checksum-verified generation", directory)
+    for entry in reversed(entries):
         path = verify_checkpoint(directory, entry)
         if path is not None:
             return path
@@ -548,9 +642,11 @@ def verify_group_commit(directory: str, tag: str) -> Optional[str]:
     protocol (``parallel.cluster``): the manifest must name
     ``checkpoint_<tag>.zip`` AND its checksum must verify — only then
     may the rank resume past the publish barrier. Returns the verified
-    path, or None (commit absent from the manifest, or torn). The
-    directory-scan fallback is deliberately NOT consulted: a group
-    commit is only published once the MANIFEST says so."""
+    path, or None (commit absent from the manifest, torn, or
+    quarantined by the scrubber — :func:`verify_checkpoint` refuses
+    quarantined generations). The directory-scan fallback is
+    deliberately NOT consulted: a group commit is only published once
+    the MANIFEST says so."""
     name = f"checkpoint_{tag}.zip"
     for entry in reversed(read_manifest(directory)):
         if _entry_name(entry) == name:
@@ -561,14 +657,23 @@ def verify_group_commit(directory: str, tag: str) -> Optional[str]:
 def scan_newest_intact(directory: str) -> Optional[str]:
     """Manifest-less fallback: every committed ``checkpoint_*.zip`` is
     validated (zip CRC + meta entry) and the one with the highest
-    iteration (mtime tiebreak) wins."""
+    iteration (mtime tiebreak) wins. Generations the manifest marks
+    quarantined stay skipped here too — the scan must not resurrect
+    what the scrubber condemned (a flip inside zip payload bytes can
+    leave the CRC walk green)."""
     try:
         names = os.listdir(directory)
     except FileNotFoundError:
         return None
+    quarantined = {_entry_name(e) for e in read_manifest(directory)
+                   if isinstance(e, dict) and e.get("quarantined")}
     cands = []
     for f in names:
         if not (f.startswith("checkpoint_") and f.endswith(".zip")):
+            continue
+        if f in quarantined:
+            logger.warning("checkpoint %s is quarantined; scan skips it",
+                           f)
             continue
         path = os.path.join(directory, f)
         if _zip_intact(path):

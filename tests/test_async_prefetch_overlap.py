@@ -13,18 +13,57 @@ Costs are host sleeps, so the assertion is about the iterator's threading
 pipeline itself — the same mechanism that overlaps JPEG decode /
 vectorization / H2D staging with device steps in training (the worker
 thread stages ``jax.device_put`` before the queue, ``_stage``).
-Margins are wide (25%+) to stay robust on loaded CI hosts.
+Margins are wide (25%+) to stay robust on loaded CI hosts, and the two
+wall-clock tests additionally gate themselves on MEASURED scheduler
+contention (:func:`_sleep_overshoot`): when concurrent ``time.sleep``
+calls on this host overshoot their nap by more than the margin the
+assertions budget for, the timing evidence is about the host, not the
+pipeline, and the tests skip instead of flaking.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.data.iterators import DataSetIterator
 from deeplearning4j_tpu.data.record_iterator import AsyncDataSetIterator
+
+
+def _sleep_overshoot(n_threads: int = 4, naps: int = 6,
+                     nap_s: float = 0.01) -> float:
+    """Median overshoot factor of concurrent ``time.sleep`` calls — the
+    exact primitive both the synthetic producer and consumer are built
+    from. 1.0 = nominal; a loaded/oversubscribed host runs well above.
+    Threaded on purpose: the overlap pipeline sleeps in two threads at
+    once, so single-threaded sleep accuracy would under-measure."""
+    samples: list = []
+
+    def sleeper():
+        for _ in range(naps):
+            t0 = time.perf_counter()
+            time.sleep(nap_s)
+            samples.append((time.perf_counter() - t0) / nap_s)
+
+    threads = [threading.Thread(target=sleeper) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    samples.sort()
+    return samples[len(samples) // 2]
+
+
+def _skip_if_contended(budget: float = 1.6) -> None:
+    overshoot = _sleep_overshoot()
+    if overshoot > budget:
+        pytest.skip("scheduler contention: concurrent sleeps overshoot "
+                    f"{overshoot:.2f}x (budget {budget}x) — wall-clock "
+                    "overlap assertions are not meaningful on this host")
 
 
 class _SlowProducer(DataSetIterator):
@@ -59,6 +98,7 @@ class TestPrefetchOverlap:
     N = 16
 
     def test_overlap_when_decode_cheaper_than_step(self):
+        _skip_if_contended()
         decode, step = 0.02, 0.03
         serial = _consume(_SlowProducer(self.N, decode), step)
         overlapped = _consume(
@@ -70,6 +110,7 @@ class TestPrefetchOverlap:
         assert overlapped < self.N * (decode + step) * 0.80
 
     def test_degrades_to_producer_bound_when_decode_dominates(self):
+        _skip_if_contended()
         decode, step = 0.04, 0.005
         overlapped = _consume(
             AsyncDataSetIterator(_SlowProducer(self.N, decode),

@@ -1,0 +1,681 @@
+"""Silent-corruption defense (ISSUE 19): in-graph replica-consistency
+fingerprints, divergent-replica quarantine, and checkpoint scrubbing.
+
+The defended invariant is exact and free: data-parallel training keeps
+replicated state bitwise-identical on every replica, so a uint32 bitcast
+fold compared across the data axis detects a flaky core / desynced
+replica with zero tolerance for "close enough". Drills here:
+
+- fingerprint stability: dense tree fold == Zero1Plan flat-bucket fold
+  == the numpy host oracle, invariant across steps_per_dispatch chunking
+  and (at iteration 0) across worker counts;
+- ``integrity/fingerprint`` fault site, ``bitflip`` kind: one flipped
+  mantissa bit on one replica is caught within ``check_every`` steps and
+  attributed to that replica by the in-graph majority vote;
+- quarantine: the supervisor's ``quarantine_and_continue`` policy evicts
+  the divergent replica through the elastic shrink and the continuation
+  is BITWISE equal to a fresh (N-1)-worker run handed the
+  majority-consistent state (``materialize_from_survivors``);
+- un-attributable divergence (N=2 — majority vote cannot name a side)
+  falls back to checkpoint-restart;
+- ``checkpoint/scrub`` fault site + :class:`CheckpointScrubber`: a
+  rotten retained zip is quarantined in the manifest (never deleted) and
+  every restore path skips it; scrub stamps feed
+  ``last_checkpoint(require_scrubbed=True)``;
+- serving post-promote fleet verify: a corrupted per-slot param copy
+  triggers ``serving/rollback`` naming the slot;
+- zero false positives: clean sweeps with ``check_every=1`` never count
+  a divergence.
+
+Flight-recorder anchors exercised here: ``integrity/fingerprint``,
+``integrity/divergence``, ``integrity/scrub``, ``integrity/quarantine``.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common import faultinject, flightrec, integrity
+from deeplearning4j_tpu.common.integrity import (CheckpointScrubber,
+                                                 IntegrityListener,
+                                                 ReplicaCorruptionError,
+                                                 bitwise_neq,
+                                                 fingerprint_flats,
+                                                 fingerprint_tree,
+                                                 host_fingerprint,
+                                                 materialize_from_survivors)
+from deeplearning4j_tpu.common.profiler import OpProfiler
+from deeplearning4j_tpu.data import NDArrayDataSetIterator
+from deeplearning4j_tpu.learning import Adam, Sgd
+from deeplearning4j_tpu.ndarray.rng import get_random, set_default_seed
+from deeplearning4j_tpu.nn import (InputType, MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.optimize.listeners import CheckpointListener
+from deeplearning4j_tpu.parallel import (ParallelWrapper,
+                                         ReduceScatterAccumulator,
+                                         TrainingSupervisor)
+from deeplearning4j_tpu.parallel.distributed import (CLASS_CORRUPTION,
+                                                     DEFAULT_POLICIES,
+                                                     classify_failure)
+from deeplearning4j_tpu.parallel.sharding import Zero1Plan
+from deeplearning4j_tpu.util import checkpoint as ckpt
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faultinject.clear_plan()
+    OpProfiler.get().reset()
+    flightrec.reset()
+    yield
+    faultinject.clear_plan()
+
+
+def small_model(updater=None, seed=5):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(updater or Adam(learning_rate=0.05))
+            .activation("tanh").list()
+            .layer(L.DenseLayer(n_out=9))      # odd widths: uneven leaves
+            .layer(L.OutputLayer(n_out=3, loss="mcxent",
+                                 activation="softmax"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def make_iter(n=96, batch=24):
+    rng = np.random.RandomState(7)
+    x = rng.randn(n, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, n)]
+    return NDArrayDataSetIterator(x, y, batch_size=batch, shuffle=True,
+                                  seed=3)
+
+
+def build_wrapper(model, workers=4, zero1=True, check_every=1,
+                  policy="raise"):
+    b = ParallelWrapper.Builder(model).workers(workers)
+    if zero1:
+        b.gradients_accumulator(ReduceScatterAccumulator())
+    pw = b.build()
+    lst = IntegrityListener(check_every=check_every, policy=policy)
+    pw.set_listeners(lst)
+    return pw, lst
+
+
+def install_state(model, state):
+    params, states, upd, acc = state
+    model._params = jax.tree.map(jnp.array, params)
+    model._states = jax.tree.map(jnp.array, states)
+    model._updater_state = upd
+    model._acc_state = acc
+
+
+def leaves_equal(a, b):
+    la = jax.tree.leaves(jax.device_get(a))
+    lb = jax.tree.leaves(jax.device_get(b))
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb))
+
+
+def run_to_corruption(pw, step, replica, epochs=3, **fit_kwargs):
+    """Fit until the injected bitflip is detected; return the resume
+    cursor and rng state at the boundary the fit unwound at."""
+    faultinject.set_plan(faultinject.FaultPlan(
+        [{"site": "integrity/fingerprint", "index": step, "kind": "bitflip",
+          "replica": replica}]))
+    with pytest.raises(ReplicaCorruptionError) as ei:
+        pw.fit(make_iter(), epochs=epochs, **fit_kwargs)
+    faultinject.clear_plan()
+    m = pw.model
+    assert ei.value.replica == replica
+    return ((m._epoch - m._fit_epoch0, m._steps_in_epoch),
+            get_random().get_state(), ei.value)
+
+
+# ---------------------------------------------------------------------------
+# fingerprint primitives (pure, no training loop)
+# ---------------------------------------------------------------------------
+
+class TestFingerprintPrimitives:
+    def _tree(self):
+        rng = np.random.RandomState(0)
+        return {
+            "w": jnp.asarray(rng.randn(7, 5).astype(np.float32)),
+            "b": jnp.asarray(rng.randn(11).astype(np.float32)
+                             ).astype(jnp.bfloat16),
+            "n": jnp.asarray(rng.randint(0, 9, (4,)), jnp.int32),
+            "m": jnp.asarray([True, False, True]),
+        }
+
+    def test_graph_fold_matches_host_oracle(self):
+        tree = self._tree()
+        got = int(jax.jit(fingerprint_tree)(tree))
+        assert got == host_fingerprint(tree)
+        assert got != 0
+
+    def test_fold_is_permutation_and_layout_invariant(self):
+        tree = self._tree()
+        # reversed leaf order folds to the same word — commutativity is
+        # what makes dense-vs-flat layout equivalence possible at all
+        rev = {k: tree[k] for k in reversed(list(tree))}
+        assert int(fingerprint_tree(tree)) == int(fingerprint_tree(rev))
+
+    def test_flat_bucket_fold_equals_dense_fold(self):
+        m = small_model()
+        for n_shards in (2, 4, 8):      # padding differs per count
+            plan = Zero1Plan(m._params, n_shards)
+            flats = plan.flatten(m._params)
+            assert int(fingerprint_flats(plan, flats)) \
+                == int(fingerprint_tree(m._params))
+
+    def test_single_bitflip_moves_the_digest(self):
+        tree = self._tree()
+        before = int(fingerprint_tree(tree))
+        w = np.array(tree["w"])
+        words = w.reshape(-1).view(np.uint32)
+        words[3] ^= np.uint32(1 << 12)
+        tree["w"] = jnp.asarray(w)
+        assert int(fingerprint_tree(tree)) != before
+
+    def test_bitwise_neq_distinguishes_nan_payloads(self):
+        a = np.array([1.0, np.nan], np.float32)
+        b = a.copy()
+        assert not bool(bitwise_neq(jnp.asarray(a), jnp.asarray(b)))
+        # same NaN-ness, different payload bits: float != cannot see it
+        bv = b.view(np.uint32)
+        bv[1] ^= np.uint32(1)
+        assert bool(bitwise_neq(jnp.asarray(a), jnp.asarray(b)))
+
+    def test_corruption_error_classifies_for_quarantine(self):
+        exc = ReplicaCorruptionError("diverged", replica=2, iteration=9)
+        assert classify_failure(exc) == CLASS_CORRUPTION
+        assert DEFAULT_POLICIES[CLASS_CORRUPTION] == "quarantine_and_continue"
+
+
+# ---------------------------------------------------------------------------
+# in-graph check riding the training step
+# ---------------------------------------------------------------------------
+
+class TestInGraphConsistency:
+    def test_fingerprints_stable_dense_vs_zero1_vs_chunked(self):
+        # three builds of the same trajectory must report the SAME
+        # fingerprint sequence: dense tree fold, ZeRO-1 flat-bucket fold,
+        # and the chunked (steps_per_dispatch=2) dispatch of the latter
+        set_default_seed(99)
+        m1 = small_model()
+        init_fp = host_fingerprint(m1._params)
+        pw1, l1 = build_wrapper(m1, workers=4, zero1=False)
+        pw1.fit(make_iter(), epochs=2)
+        assert l1.divergences == []
+        assert len(l1.fingerprints) == 8          # 4 steps/epoch * 2
+        # iteration-0 check fingerprints the step's INPUT params =
+        # the seeded init — the host oracle pins the exact value
+        assert l1.fingerprints[0] == (1, init_fp)
+
+        set_default_seed(99)
+        m2 = small_model()
+        pw2, l2 = build_wrapper(m2, workers=4, zero1=True)
+        pw2.fit(make_iter(), epochs=2)
+        assert l2.fingerprints == l1.fingerprints
+
+        set_default_seed(99)
+        m3 = small_model()
+        pw3, l3 = build_wrapper(m3, workers=4, zero1=True)
+        pw3.fit(make_iter(), epochs=2, steps_per_dispatch=2)
+        assert l3.fingerprints == l1.fingerprints
+
+    def test_iteration_zero_fingerprint_invariant_across_worker_counts(
+            self):
+        # trajectories diverge numerically with N (different batch
+        # splits), but the FIRST check fingerprints the seeded init
+        # params before any update — identical for every worker count
+        fps = []
+        for workers in (2, 4):
+            set_default_seed(99)
+            m = small_model()
+            pw, lst = build_wrapper(m, workers=workers, zero1=True)
+            pw.fit(make_iter(), epochs=1)
+            assert lst.divergences == []
+            fps.append(lst.fingerprints[0])
+        assert fps[0] == fps[1]
+
+    def test_check_every_cadence_and_ledger(self):
+        set_default_seed(99)
+        m = small_model()
+        pw, lst = build_wrapper(m, workers=4, check_every=4)
+        pw.fit(make_iter(), epochs=3)             # 12 steps
+        # in-graph check at steps 0,4,8 -> reported iterations 1,5,9
+        assert [it for it, _ in lst.fingerprints] == [1, 5, 9]
+        prof = OpProfiler.get()
+        assert prof.counter_value("integrity/checks") == 3
+        assert prof.counter_value("integrity/divergences") == 0
+        assert prof.integrity_stats()["checks"] == 3
+        assert "integrity" in prof.ledger_stats()
+        # one integrity/fingerprint info event per drained window
+        assert flightrec.events("integrity/fingerprint")
+
+    def test_clean_sweep_has_zero_false_positives(self):
+        # the acceptance guard: an UNDRILLED multi-epoch run at the
+        # tightest cadence must never count a divergence, dense or zero1
+        for zero1 in (False, True):
+            OpProfiler.get().reset()
+            set_default_seed(99)
+            m = small_model()
+            pw, lst = build_wrapper(m, workers=4, zero1=zero1)
+            pw.fit(make_iter(), epochs=3)
+            assert lst.divergences == []
+            assert OpProfiler.get().counter_value(
+                "integrity/divergences") == 0
+            assert OpProfiler.get().counter_value(
+                "integrity/checks") == 12
+
+    def test_listener_state_roundtrip(self):
+        set_default_seed(99)
+        m = small_model()
+        pw, lst = build_wrapper(m, workers=2)
+        pw.fit(make_iter(), epochs=1)
+        fresh = IntegrityListener(check_every=1)
+        fresh.load_state_dict(lst.state_dict())
+        assert fresh.fingerprints == lst.fingerprints
+
+    def test_model_sharded_params_refused(self):
+        # integrity polices REPLICATED state; a model-parallel wrapper
+        # has no replica copies to compare and must say so loudly
+        set_default_seed(99)
+        m = small_model()
+        pw = (ParallelWrapper.Builder(m).workers(2).model_axis(2)
+              .build())
+        pw.set_listeners(IntegrityListener(check_every=1))
+        with pytest.raises(NotImplementedError, match="replicated"):
+            pw.fit(make_iter(), epochs=1)
+
+
+# ---------------------------------------------------------------------------
+# bitflip drill: detection + attribution
+# ---------------------------------------------------------------------------
+
+class TestBitflipDetection:
+    def test_flip_on_check_step_attributed_zero1(self):
+        set_default_seed(99)
+        m = small_model()
+        pw, lst = build_wrapper(m, workers=4, zero1=True, check_every=2)
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "integrity/fingerprint", "index": 6,
+              "kind": "bitflip", "replica": 2, "bit": 12, "offset": 3}]))
+        with pytest.raises(ReplicaCorruptionError) as ei:
+            pw.fit(make_iter(), epochs=3)
+        assert ei.value.replica == 2
+        assert ei.value.iteration == 7    # caught at the entering step
+        prof = OpProfiler.get()
+        assert prof.counter_value("integrity/bitflips_injected") == 1
+        assert prof.counter_value("integrity/divergences") == 1
+        div = flightrec.events("integrity/divergence")[-1]
+        assert div["attrs"]["replica"] == 2
+        assert div["sev"] == "error"
+        # the fault/fired cause anchor names the replica too — the
+        # incident chain can read attribution straight off the cause
+        fired = flightrec.events("fault/fired")[-1]
+        assert fired["attrs"]["site"] == "integrity/fingerprint"
+        assert fired["attrs"]["replica"] == 2
+
+    def test_flip_detected_within_cadence_dense(self):
+        # dense replicas carry their own full params, so a flipped copy
+        # STAYS divergent until the next check — the detection-latency
+        # bound is exactly check_every dispatches
+        set_default_seed(99)
+        m = small_model(updater=Sgd(learning_rate=0.1))
+        pw, lst = build_wrapper(m, workers=4, zero1=False, check_every=4)
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "integrity/fingerprint", "index": 6,
+              "kind": "bitflip", "replica": 1}]))
+        with pytest.raises(ReplicaCorruptionError) as ei:
+            pw.fit(make_iter(), epochs=3)
+        assert ei.value.replica == 1
+        assert ei.value.iteration == 9    # next check step (8) reports 9
+        assert ei.value.iteration - 6 <= 4
+
+    def test_zero1_republish_heals_off_slice_flip(self):
+        # ZeRO-1's all_gather republish is ITSELF a defense: a flip
+        # landing outside the replica's owned slice is overwritten by
+        # the owner's clean tile at the next update, so a flip between
+        # check steps self-heals with no divergence ever visible. (The
+        # residual risk — contamination laundered through the psum —
+        # is replica-consistent by construction and outside the
+        # replicated-state invariant this check enforces.)
+        set_default_seed(99)
+        m = small_model()
+        pw, lst = build_wrapper(m, workers=4, zero1=True, check_every=4)
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "integrity/fingerprint", "index": 5,
+              "kind": "bitflip", "replica": 2, "offset": 3}]))
+        pw.fit(make_iter(), epochs=3)     # completes: healed, not missed
+        assert lst.divergences == []
+        assert OpProfiler.get().counter_value(
+            "integrity/bitflips_injected") == 1
+
+    def test_warn_policy_records_without_raising(self):
+        set_default_seed(99)
+        m = small_model()
+        pw, lst = build_wrapper(m, workers=4, policy="warn")
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "integrity/fingerprint", "index": 2,
+              "kind": "bitflip", "replica": 0}]))
+        pw.fit(make_iter(), epochs=1)             # completes
+        assert lst.divergences
+        assert lst.divergences[0]["replica"] == 0
+
+    def test_named_tensor_and_sharded_target_validation(self):
+        set_default_seed(99)
+        m = small_model()
+        pw, _ = build_wrapper(m, workers=2)
+        pw.fit(make_iter(), epochs=1)
+        with pytest.raises(ValueError, match="no param leaf"):
+            integrity.apply_bitflip(m, pw.mesh, {"replica": 0,
+                                                 "tensor": "nope"})
+        with pytest.raises(ValueError, match="outside mesh"):
+            integrity.apply_bitflip(m, pw.mesh, {"replica": 7})
+
+
+# ---------------------------------------------------------------------------
+# quarantine: supervised drill + bitwise parity vs fresh (N-1) fleet
+# ---------------------------------------------------------------------------
+
+class TestQuarantine:
+    def test_supervised_quarantine_drill_bitwise_parity(self, tmp_path):
+        # THE acceptance drill: a bitflip on replica 1 of 4 is detected,
+        # the supervisor quarantines that replica (no restart consumed),
+        # training completes on 3 workers — and the final params equal a
+        # fresh 3-worker run handed the majority-consistent state
+        set_default_seed(99)
+        m1 = small_model()
+        pw, _ = build_wrapper(m1, workers=4, zero1=True)
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "integrity/fingerprint", "index": 5,
+              "kind": "bitflip", "replica": 1}]))
+        sup = TrainingSupervisor(pw, checkpoint_dir=str(tmp_path),
+                                 elastic_grow=False)
+        res = sup.fit(make_iter, epochs=3)
+        faultinject.clear_plan()
+        assert res.status == "completed"
+        assert res.restarts == 0
+        assert [h["class"] for h in res.history] == ["silent_corruption"]
+        assert [h["policy"] for h in res.history] \
+            == ["quarantine_and_continue"]
+        assert pw.workers_count == 3
+        prof = OpProfiler.get()
+        assert prof.counter_value("supervisor/quarantines") == 1
+        q = flightrec.events("integrity/quarantine")[-1]
+        assert q["attrs"]["replica"] == 1
+        assert q["sev"] == "warn"
+
+        # manual reference: same flip caught by hand, snapshot from a
+        # SURVIVOR's shard, manual resize, fresh continuation
+        OpProfiler.get().reset()
+        set_default_seed(99)
+        m2 = small_model()
+        pw2, _ = build_wrapper(m2, workers=4, zero1=True)
+        cursor, rng, exc = run_to_corruption(pw2, step=5, replica=1)
+        snap = materialize_from_survivors(
+            (m2._params, m2._states, m2._updater_state, None),
+            list(pw2.mesh.devices.flat), [1])
+        it, ep = m2._iteration, m2._epoch
+        pw2.resize(3, lost_replicas=[1])
+        pw2.fit(make_iter(), epochs=3, resume_cursor=cursor)
+        assert leaves_equal(m1._params, m2._params)
+
+        # fresh-fleet reference: a brand-new 3-worker wrapper handed the
+        # survivor snapshot must land on the same bits
+        set_default_seed(99)
+        m3 = small_model()
+        install_state(m3, snap)
+        m3._iteration, m3._epoch = it, ep
+        get_random().set_state(rng)
+        pw3, _ = build_wrapper(m3, workers=3, zero1=True)
+        pw3.fit(make_iter(), epochs=3, resume_cursor=cursor)
+        assert leaves_equal(m1._params, m3._params)
+        assert leaves_equal(m1._updater_state, m3._updater_state)
+
+    def test_survivor_materialization_skips_poisoned_shard_zero(self):
+        # the trap materialize_from_survivors exists for: replica 0 is
+        # the corrupted one, and device_get of a replicated array reads
+        # shard 0 — the naive snapshot would keep the poison
+        set_default_seed(99)
+        m = small_model()
+        pw, _ = build_wrapper(m, workers=4)
+        pw.fit(make_iter(), epochs=1)
+        clean = host_fingerprint(m._params)
+        integrity.apply_bitflip(m, pw.mesh, {"replica": 0, "bit": 12})
+        naive = jax.tree.map(np.array, jax.device_get(m._params))
+        majority = materialize_from_survivors(
+            m._params, list(pw.mesh.devices.flat), [0])
+        assert host_fingerprint(naive) != clean        # poisoned copy
+        assert host_fingerprint(majority) == clean     # survivor copy
+
+    def test_two_way_split_falls_back_to_restart(self, tmp_path):
+        # N=2: the majority vote cannot name a side (support ties), the
+        # error carries replica=None, the quarantine gate refuses, and
+        # the supervisor takes the checkpoint-restart fallback
+        set_default_seed(99)
+        m = small_model()
+        pw, _ = build_wrapper(m, workers=2, zero1=True)
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "integrity/fingerprint", "index": 9,
+              "kind": "bitflip", "replica": 1}]))
+        sup = TrainingSupervisor(pw, checkpoint_dir=str(tmp_path),
+                                 backoff_base_s=0.01, elastic_grow=False)
+        res = sup.fit(make_iter, epochs=4)         # flip lands in epoch 3
+        faultinject.clear_plan()
+        assert res.status == "completed"
+        assert res.restarts == 1
+        assert [h["class"] for h in res.history] == ["silent_corruption"]
+        assert [h["policy"] for h in res.history] == ["restart"]
+        assert pw.workers_count == 2               # nobody was evicted
+        assert OpProfiler.get().counter_value(
+            "supervisor/quarantines") == 0
+
+
+# ---------------------------------------------------------------------------
+# checkpoint scrubber + manifest quarantine
+# ---------------------------------------------------------------------------
+
+def _make_checkpoints(directory, n_epochs=2):
+    set_default_seed(11)
+    m = small_model()
+    cl = CheckpointListener(str(directory), save_every_n_iterations=2,
+                            keep_last=6)
+    m.set_listeners(cl)
+    m.fit(make_iter(), epochs=n_epochs)
+    cl.close()
+    paths = ckpt.committed_checkpoints(str(directory))
+    assert len(paths) >= 2
+    return paths
+
+
+class TestCheckpointScrubber:
+    def test_scrub_stamps_pass_and_require_scrubbed_prefers_it(
+            self, tmp_path):
+        paths = _make_checkpoints(tmp_path)
+        d = str(tmp_path)
+        # before any scrub: require_scrubbed warns + falls back
+        assert ckpt.last_checkpoint(d, require_scrubbed=True) == paths[-1]
+        scrub = CheckpointScrubber(d, interval_s=60.0)
+        summary = scrub.scrub_now()
+        assert summary["quarantined"] == 0
+        assert summary["verified"] == len(paths)
+        for e in ckpt.read_manifest(d):
+            assert e["scrub"]["ok"] is True
+        assert ckpt.last_checkpoint(d, require_scrubbed=True) == paths[-1]
+        prof = OpProfiler.get()
+        assert prof.counter_value("integrity/scrub_passes") == 1
+        assert prof.counter_value("integrity/scrub_verified") == len(paths)
+        ev = flightrec.events("integrity/scrub")[-1]
+        assert ev["attrs"]["verified"] == len(paths)
+
+    def test_rotten_zip_is_quarantined_not_deleted(self, tmp_path):
+        paths = _make_checkpoints(tmp_path)
+        d = str(tmp_path)
+        newest = paths[-1]
+        integrity._flip_file_byte(newest, offset=256, bit=3)
+        summary = CheckpointScrubber(d).scrub_now()
+        assert summary["quarantined"] == 1
+        # evidence retention: the rotten file is still on disk
+        assert os.path.exists(newest)
+        name = os.path.basename(newest)
+        entry = [e for e in ckpt.read_manifest(d)
+                 if e.get("file") == name][0]
+        assert entry["quarantined"] is True
+        assert "scrub" in entry["quarantine_reason"] \
+            or "mismatch" in entry["quarantine_reason"]
+        # every restore path skips the condemned generation
+        assert ckpt.last_checkpoint(d) == paths[-2]
+        assert ckpt.last_checkpoint(d, require_scrubbed=True) == paths[-2]
+        assert ckpt.verify_checkpoint(d, entry) is None
+        assert ckpt.scan_newest_intact(d) != newest
+        assert OpProfiler.get().counter_value(
+            "integrity/quarantined_checkpoints") == 1
+        q = flightrec.events("integrity/quarantine")[-1]
+        assert q["attrs"]["file"] == name
+
+    def test_quarantine_is_sticky_across_passes(self, tmp_path):
+        paths = _make_checkpoints(tmp_path)
+        d = str(tmp_path)
+        name = os.path.basename(paths[-1])
+        assert ckpt.quarantine_checkpoint(d, name, "operator drill")
+        # the bytes still hash clean — quarantine must hold anyway
+        scrub = CheckpointScrubber(d)
+        first = scrub.scrub_now()
+        assert first["skipped"] >= 1          # condemned entry not re-hashed
+        entry = [e for e in ckpt.read_manifest(d)
+                 if e.get("file") == name][0]
+        assert entry["quarantined"] is True
+        assert ckpt.last_checkpoint(d) == paths[-2]
+
+    def test_group_commit_refuses_quarantined(self, tmp_path):
+        d = str(tmp_path)
+        path = ckpt.commit_checkpoint(d, "g7", b"payload-bytes",
+                                      iteration=7, keep_last=4)
+        assert ckpt.verify_group_commit(d, "g7") == path
+        ckpt.quarantine_checkpoint(d, os.path.basename(path), "scrub")
+        assert ckpt.verify_group_commit(d, "g7") is None
+
+    def test_scrub_fault_drills_transient_and_bitflip(self, tmp_path):
+        paths = _make_checkpoints(tmp_path)
+        d = str(tmp_path)
+        # ordinal 0 = first entry of the first pass: transient -> that
+        # entry is skipped this pass and the NEXT pass covers it
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "checkpoint/scrub", "index": 0,
+              "kind": "transient"}]))
+        scrub = CheckpointScrubber(d)
+        s1 = scrub.scrub_now()
+        assert s1["skipped"] >= 1
+        assert s1["scanned"] == len(paths) - 1
+        assert OpProfiler.get().counter_value(
+            "integrity/scrub_retries") == 1
+        # the self-contained corruption drill: the advisory bitflip rots
+        # the zip ON DISK before hashing, so this pass must quarantine it
+        faultinject.set_plan(faultinject.FaultPlan(
+            [{"site": "checkpoint/scrub", "index": len(paths),
+              "kind": "bitflip", "offset": 300, "bit": 2}]))
+        s2 = scrub.scrub_now()
+        faultinject.clear_plan()
+        assert s2["quarantined"] == 1
+        assert scrub.passes == 2
+
+    def test_background_thread_scrubs_on_cadence(self, tmp_path):
+        _make_checkpoints(tmp_path)
+        scrub = CheckpointScrubber(str(tmp_path), interval_s=0.05).start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while scrub.passes < 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+        finally:
+            scrub.stop()
+        assert scrub.passes >= 2
+        assert OpProfiler.get().counter_value(
+            "integrity/scrub_passes") >= 2
+
+
+# ---------------------------------------------------------------------------
+# serving: post-promote fleet fingerprint verify
+# ---------------------------------------------------------------------------
+
+class TestServingPublishVerify:
+    def _engine_and_ckpt(self, tmp_path, workers=2):
+        from deeplearning4j_tpu.parallel import ServingEngine, SLOClass
+        paths = _make_checkpoints(tmp_path)
+        set_default_seed(11)
+        eng = (ServingEngine.Builder(small_model())
+               .buckets((1, 2, 4)).input_shape((4,))
+               .workers(workers).max_wait_ms(2.0)
+               .pin_devices()      # ≥2 param slots: the fleet the
+               .slo_classes([SLOClass("gold", 1, 250.0,   # verify sweeps
+                                      queue_budget=64)])
+               .brownout(interval_s=60.0)
+               .build())
+        return eng, paths[-1]
+
+    def test_clean_publish_runs_fleet_check_and_promotes(self, tmp_path):
+        eng, path = self._engine_and_ckpt(tmp_path)
+        x = np.random.randn(2, 4).astype(np.float32)
+        try:
+            h = eng.publish_checkpoint(path, canary_window_s=0.2,
+                                       confirm_window_s=0.1,
+                                       check_interval_s=0.05)
+            while not h.done:
+                eng.output(x, slo_class="gold")
+            assert h.result(timeout=10) == "promoted"
+            prof = OpProfiler.get()
+            assert prof.counter_value("integrity/publish_checks") == 1
+            assert prof.counter_value(
+                "integrity/publish_divergences") == 0
+        finally:
+            eng.shutdown()
+
+    def test_corrupt_slot_rolls_back_after_promote(self, tmp_path):
+        eng, path = self._engine_and_ckpt(tmp_path)
+        x = np.random.randn(2, 4).astype(np.float32)
+        try:
+            prior = [np.array(a)
+                     for a in jax.tree.leaves(eng._dev_params[0])]
+            h = eng.publish_checkpoint(path, canary_window_s=0.4,
+                                       confirm_window_s=0.3,
+                                       check_interval_s=0.05)
+            # corrupt slot 1's candidate copy while the canary runs —
+            # the post-promote fleet digest must catch the torn slot
+            deadline = time.monotonic() + 5.0
+            while eng._canary is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            with eng._lock:
+                can = eng._canary
+            assert can is not None
+            p, s = can["new"][1]
+            leaves, treedef = jax.tree.flatten(p)
+            buf = np.array(leaves[0])
+            words = buf.reshape(-1).view(np.uint32)
+            words[0] ^= np.uint32(1 << 12)
+            leaves[0] = jnp.asarray(buf)
+            with eng._lock:
+                can["new"][1] = (jax.tree.unflatten(treedef, leaves), s)
+            while not h.done:
+                eng.output(x, slo_class="gold")
+            assert h.result(timeout=10) == "rolled_back"
+            rb = flightrec.events("serving/rollback")[-1]
+            assert rb["attrs"]["phase"] == "confirm"
+            assert "fingerprint mismatch" in rb["attrs"]["reason"]
+            assert "1" in rb["attrs"]["reason"]    # the slot is named
+            prof = OpProfiler.get()
+            assert prof.counter_value(
+                "integrity/publish_divergences") == 1
+            # BITWISE: the exact prior fleet params are back
+            after = [np.array(a)
+                     for a in jax.tree.leaves(eng._dev_params[0])]
+            assert all(np.array_equal(a, b)
+                       for a, b in zip(after, prior))
+        finally:
+            eng.shutdown()

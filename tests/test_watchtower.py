@@ -597,13 +597,15 @@ class TestDefaultCatalog:
     def test_default_slos_cover_the_stock_signals(self):
         names = {s.name for s in watchtower.default_slos()}
         assert names == {"serving-availability", "train-nan-free",
-                         "restart-budget", "retrace-flat"}
+                         "restart-budget", "retrace-flat",
+                         "replica-consistency"}
         by_name = {s.name: s for s in watchtower.default_slos()}
         assert by_name["serving-availability"].kind == "ratio"
         # supervisor-domain SLOs attach to the supervisor's incident
         # instead of opening a duplicate per fault
         assert by_name["restart-budget"].incident == "attach"
         assert by_name["train-nan-free"].incident == "attach"
+        assert by_name["replica-consistency"].incident == "attach"
 
     def test_default_slos_with_engine_and_hbm_ceiling(self):
         class _Cls:
